@@ -1,0 +1,19 @@
+"""netobserv_tpu — a TPU-native network-flow observability framework.
+
+Capability parity target: the NetObserv eBPF Agent (see SURVEY.md). Two planes:
+
+- **Capture plane** (host-native): an eBPF C datapath (``netobserv_tpu/datapath/bpf``)
+  aggregates packets into kernel flow maps; a loader/evictor brings flow records into
+  userspace (reference seam: ``pkg/tracer/tracer.go:52-76``).
+- **Analytics plane** (TPU-idiomatic, the new part): evicted records are packed into
+  fixed-shape columnar batches and folded into streaming sketches (Count-Min,
+  HyperLogLog, top-K heavy hitters, latency quantiles, EWMA anomaly scores) as
+  JAX/Pallas programs, sharded over a `jax.sharding.Mesh` and merged with ICI
+  collectives (reference seam replaced: ``pkg/flow`` + ``pkg/exporter``).
+
+Nothing in this package imports jax at module import time except the `ops`, `sketch`
+and `parallel` subpackages, so the thin host agent can run on machines without
+accelerators.
+"""
+
+__version__ = "0.1.0"

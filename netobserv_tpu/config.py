@@ -1,0 +1,317 @@
+"""Agent configuration: a single env-var-driven settings object.
+
+Capability parity with the reference's env-tag struct (`pkg/config/config.go:83-308`):
+same variable names, same defaults, zero flags / zero files. TPU-specific knobs are
+added under the ``SKETCH_*`` prefix (the `tpu-sketch` exporter backend is new).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DURATION_UNITS = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0,
+}
+
+
+def parse_duration(text: str) -> float:
+    """Parse a Go-style duration string ("5s", "300ms", "1m30s") into seconds."""
+    text = text.strip()
+    if not text:
+        return 0.0
+    try:
+        return float(text)  # plain number = seconds
+    except ValueError:
+        pass
+    total = 0.0
+    pos = 0
+    for m in _DURATION_RE.finditer(text):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration: {text!r}")
+        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(text):
+        raise ValueError(f"invalid duration: {text!r}")
+    return total
+
+
+def _parse_bool(text: str) -> bool:
+    return text.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env(name: str, default: str = "") -> dict:
+    return {"metadata": {"env": name, "default": default}}
+
+
+# Exporter backend names (reference: `pkg/agent/agent.go:246-261` switch).
+EXPORT_GRPC = "grpc"
+EXPORT_KAFKA = "kafka"
+EXPORT_IPFIX_UDP = "ipfix+udp"
+EXPORT_IPFIX_TCP = "ipfix+tcp"
+EXPORT_DIRECT_FLP = "direct-flp"
+# New in this framework: offload aggregation/analytics to TPU sketches.
+EXPORT_TPU_SKETCH = "tpu-sketch"
+# Debug-friendly terminal exporter (stdout JSON lines).
+EXPORT_STDOUT = "stdout"
+
+VALID_EXPORTERS = (
+    EXPORT_GRPC, EXPORT_KAFKA, EXPORT_IPFIX_UDP, EXPORT_IPFIX_TCP,
+    EXPORT_DIRECT_FLP, EXPORT_TPU_SKETCH, EXPORT_STDOUT,
+)
+
+
+@dataclass
+class FlowFilterRule:
+    """One flow-filter rule (reference schema: `pkg/config/config.go:27-81`)."""
+
+    ip_cidr: str = "0.0.0.0/0"
+    action: str = "Accept"  # Accept | Reject
+    direction: str = ""  # Ingress | Egress | ""
+    protocol: str = ""  # TCP | UDP | SCTP | ICMP | ICMPv6
+    source_port: int = 0
+    source_port_range: str = ""
+    source_ports: str = ""
+    destination_port: int = 0
+    destination_port_range: str = ""
+    destination_ports: str = ""
+    port: int = 0
+    port_range: str = ""
+    ports: str = ""
+    icmp_type: int = 0
+    icmp_code: int = 0
+    peer_ip: str = ""
+    peer_cidr: str = ""
+    tcp_flags: str = ""  # e.g. "SYN", "SYN-ACK"
+    drops: bool = False
+    sample: int = 0  # per-rule sampling override
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "FlowFilterRule":
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in obj.items() if k in names})
+
+
+def parse_filter_rules(text: str) -> list[FlowFilterRule]:
+    """Parse the JSON-in-env FLOW_FILTER_RULES list (reference: `agent.go:445-474`)."""
+    if not text.strip():
+        return []
+    data = json.loads(text)
+    if not isinstance(data, list):
+        raise ValueError("FLOW_FILTER_RULES must be a JSON array")
+    return [FlowFilterRule.from_json_obj(o) for o in data]
+
+
+@dataclass
+class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
+    """All agent knobs. Field metadata carries the env var name and default.
+
+    Reference: `pkg/config/config.go:83-308` (same env names/defaults unless noted).
+    """
+
+    # --- identity / export target ---
+    agent_ip: str = field(default="", **_env("AGENT_IP"))
+    agent_ip_iface: str = field(default="external", **_env("AGENT_IP_IFACE", "external"))
+    agent_ip_type: str = field(default="any", **_env("AGENT_IP_TYPE", "any"))
+    export: str = field(default="grpc", **_env("EXPORT", "grpc"))
+    target_host: str = field(default="", **_env("TARGET_HOST"))
+    target_port: int = field(default=0, **_env("TARGET_PORT", "0"))
+    target_tls_ca_cert_path: str = field(default="", **_env("TARGET_TLS_CA_CERT_PATH"))
+    target_tls_user_cert_path: str = field(default="", **_env("TARGET_TLS_USER_CERT_PATH"))
+    target_tls_user_key_path: str = field(default="", **_env("TARGET_TLS_USER_KEY_PATH"))
+    grpc_message_max_flows: int = field(default=10000, **_env("GRPC_MESSAGE_MAX_FLOWS", "10000"))
+    grpc_reconnect_timer: float = field(default=0.0, **_env("GRPC_RECONNECT_TIMER"))
+    grpc_reconnect_timer_randomization: float = field(
+        default=0.0, **_env("GRPC_RECONNECT_TIMER_RANDOMIZATION"))
+
+    # --- interface selection ---
+    interfaces: list[str] = field(default_factory=list, **_env("INTERFACES"))
+    exclude_interfaces: list[str] = field(
+        default_factory=lambda: ["lo"], **_env("EXCLUDE_INTERFACES", "lo"))
+    interface_ips: list[str] = field(default_factory=list, **_env("INTERFACE_IPS"))
+    listen_interfaces: str = field(default="watch", **_env("LISTEN_INTERFACES", "watch"))
+    listen_poll_period: float = field(default=10.0, **_env("LISTEN_POLL_PERIOD", "10s"))
+    preferred_interface_for_mac_prefix: str = field(
+        default="", **_env("PREFERRED_INTERFACE_FOR_MAC_PREFIX"))
+
+    # --- pipeline sizing ---
+    buffers_length: int = field(default=50, **_env("BUFFERS_LENGTH", "50"))
+    exporter_buffer_length: int = field(default=0, **_env("EXPORTER_BUFFER_LENGTH", "0"))
+    cache_max_flows: int = field(default=5000, **_env("CACHE_MAX_FLOWS", "5000"))
+    cache_active_timeout: float = field(default=5.0, **_env("CACHE_ACTIVE_TIMEOUT", "5s"))
+    direction: str = field(default="both", **_env("DIRECTION", "both"))
+    sampling: int = field(default=0, **_env("SAMPLING", "0"))
+    enable_flows_ringbuf_fallback: bool = field(
+        default=False, **_env("ENABLE_FLOWS_RINGBUF_FALLBACK", "false"))
+    force_garbage_collection: bool = field(
+        default=True, **_env("FORCE_GARBAGE_COLLECTION", "true"))
+    stale_entries_evict_timeout: float = field(
+        default=5.0, **_env("STALE_ENTRIES_EVICT_TIMEOUT", "5s"))
+
+    # --- attach behavior ---
+    tc_attach_mode: str = field(default="tcx", **_env("TC_ATTACH_MODE", "tcx"))
+    tc_attach_retries: int = field(default=4, **_env("TC_ATTACH_RETRIES", "4"))
+    tcx_attach_anchor_ingress: str = field(
+        default="none", **_env("TCX_ATTACH_ANCHOR_INGRESS", "none"))
+    tcx_attach_anchor_egress: str = field(
+        default="none", **_env("TCX_ATTACH_ANCHOR_EGRESS", "none"))
+
+    # --- kafka ---
+    kafka_brokers: list[str] = field(default_factory=list, **_env("KAFKA_BROKERS"))
+    kafka_topic: str = field(default="network-flows", **_env("KAFKA_TOPIC", "network-flows"))
+    kafka_batch_messages: int = field(default=1000, **_env("KAFKA_BATCH_MESSAGES", "1000"))
+    kafka_batch_size: int = field(default=1048576, **_env("KAFKA_BATCH_SIZE", "1048576"))
+    kafka_async: bool = field(default=True, **_env("KAFKA_ASYNC", "true"))
+    kafka_compression: str = field(default="none", **_env("KAFKA_COMPRESSION", "none"))
+    kafka_enable_tls: bool = field(default=False, **_env("KAFKA_ENABLE_TLS", "false"))
+    kafka_tls_insecure_skip_verify: bool = field(
+        default=False, **_env("KAFKA_TLS_INSECURE_SKIP_VERIFY", "false"))
+    kafka_tls_ca_cert_path: str = field(default="", **_env("KAFKA_TLS_CA_CERT_PATH"))
+    kafka_tls_user_cert_path: str = field(default="", **_env("KAFKA_TLS_USER_CERT_PATH"))
+    kafka_tls_user_key_path: str = field(default="", **_env("KAFKA_TLS_USER_KEY_PATH"))
+    kafka_enable_sasl: bool = field(default=False, **_env("KAFKA_ENABLE_SASL", "false"))
+    kafka_sasl_type: str = field(default="plain", **_env("KAFKA_SASL_TYPE", "plain"))
+    kafka_sasl_client_id_path: str = field(default="", **_env("KAFKA_SASL_CLIENT_ID_PATH"))
+    kafka_sasl_client_secret_path: str = field(
+        default="", **_env("KAFKA_SASL_CLIENT_SECRET_PATH"))
+
+    # --- observability ---
+    log_level: str = field(default="info", **_env("LOG_LEVEL", "info"))
+    pprof_addr: str = field(default="", **_env("PPROF_ADDR"))
+    metrics_enable: bool = field(default=False, **_env("METRICS_ENABLE", "false"))
+    metrics_level: str = field(default="info", **_env("METRICS_LEVEL", "info"))
+    metrics_server_address: str = field(default="", **_env("METRICS_SERVER_ADDRESS"))
+    metrics_server_port: int = field(default=9090, **_env("METRICS_SERVER_PORT", "9090"))
+    metrics_tls_cert_path: str = field(default="", **_env("METRICS_TLS_CERT_PATH"))
+    metrics_tls_key_path: str = field(default="", **_env("METRICS_TLS_KEY_PATH"))
+    metrics_prefix: str = field(default="ebpf_agent_", **_env("METRICS_PREFIX", "ebpf_agent_"))
+
+    # --- feature enables (propagated to the datapath as compile-time consts) ---
+    enable_rtt: bool = field(default=False, **_env("ENABLE_RTT", "false"))
+    enable_pkt_drops: bool = field(default=False, **_env("ENABLE_PKT_DROPS", "false"))
+    enable_dns_tracking: bool = field(default=False, **_env("ENABLE_DNS_TRACKING", "false"))
+    dns_tracking_port: int = field(default=53, **_env("DNS_TRACKING_PORT", "53"))
+    enable_network_events_monitoring: bool = field(
+        default=False, **_env("ENABLE_NETWORK_EVENTS_MONITORING", "false"))
+    network_events_monitoring_group_id: int = field(
+        default=10, **_env("NETWORK_EVENTS_MONITORING_GROUP_ID", "10"))
+    enable_pkt_translation: bool = field(
+        default=False, **_env("ENABLE_PKT_TRANSLATION", "false"))
+    enable_ipsec_tracking: bool = field(
+        default=False, **_env("ENABLE_IPSEC_TRACKING", "false"))
+    enable_openssl_tracking: bool = field(
+        default=False, **_env("ENABLE_OPENSSL_TRACKING", "false"))
+    openssl_path: str = field(default="/usr/bin/openssl", **_env("OPENSSL_PATH", "/usr/bin/openssl"))
+    enable_tls_tracking: bool = field(default=False, **_env("ENABLE_TLS_TRACKING", "false"))
+    quic_tracking_mode: int = field(default=0, **_env("QUIC_TRACKING_MODE", "0"))
+    enable_udn_mapping: bool = field(default=False, **_env("ENABLE_UDN_MAPPING", "false"))
+
+    # --- filtering ---
+    flow_filter_rules: str = field(default="", **_env("FLOW_FILTER_RULES"))
+
+    # --- program-manager (bpfman) mode ---
+    ebpf_program_manager_mode: bool = field(
+        default=False, **_env("EBPF_PROGRAM_MANAGER_MODE", "false"))
+    bpfman_bpf_fs_path: str = field(
+        default="/run/netobserv/maps", **_env("BPFMAN_BPF_FS_PATH", "/run/netobserv/maps"))
+
+    # --- PCA (packet capture) mode ---
+    enable_pca: bool = field(default=False, **_env("ENABLE_PCA", "false"))
+    pca_server_port: int = field(default=0, **_env("PCA_SERVER_PORT", "0"))
+
+    # --- direct-FLP ---
+    flp_config: str = field(default="", **_env("FLP_CONFIG"))
+
+    # --- deprecated aliases (reference: `config.go:298-323`) ---
+    flows_target_host: str = field(default="", **_env("FLOWS_TARGET_HOST"))
+    flows_target_port: int = field(default=0, **_env("FLOWS_TARGET_PORT", "0"))
+
+    # --- TPU sketch backend (new; no reference equivalent) ---
+    sketch_batch_size: int = field(default=8192, **_env("SKETCH_BATCH_SIZE", "8192"))
+    sketch_cm_depth: int = field(default=4, **_env("SKETCH_CM_DEPTH", "4"))
+    sketch_cm_width: int = field(default=65536, **_env("SKETCH_CM_WIDTH", "65536"))
+    sketch_hll_precision: int = field(default=14, **_env("SKETCH_HLL_PRECISION", "14"))
+    sketch_topk: int = field(default=1024, **_env("SKETCH_TOPK", "1024"))
+    sketch_window: float = field(default=60.0, **_env("SKETCH_WINDOW", "60s"))
+    sketch_ewma_alpha: float = field(default=0.3, **_env("SKETCH_EWMA_ALPHA", "0.3"))
+    sketch_checkpoint_dir: str = field(default="", **_env("SKETCH_CHECKPOINT_DIR"))
+    sketch_checkpoint_every: int = field(default=0, **_env("SKETCH_CHECKPOINT_EVERY", "0"))
+    sketch_mesh_shape: str = field(default="", **_env("SKETCH_MESH_SHAPE"))  # e.g. "2x4"
+    sketch_devices: str = field(default="", **_env("SKETCH_DEVICES"))  # "", "cpu", "tpu"
+
+    def parsed_filter_rules(self) -> list[FlowFilterRule]:
+        return parse_filter_rules(self.flow_filter_rules)
+
+    def manage_deprecated(self) -> None:
+        """Apply deprecated-key shims (reference: `config.go:310-323`)."""
+        if self.flows_target_host and not self.target_host:
+            self.target_host = self.flows_target_host
+        if self.flows_target_port and not self.target_port:
+            self.target_port = self.flows_target_port
+        if self.enable_pca and self.pca_server_port and not self.target_port:
+            self.target_port = self.pca_server_port
+
+    def validate(self) -> None:
+        if self.export not in VALID_EXPORTERS:
+            raise ValueError(
+                f"EXPORT={self.export!r} is not one of {', '.join(VALID_EXPORTERS)}")
+        if self.export in (EXPORT_GRPC, EXPORT_IPFIX_UDP, EXPORT_IPFIX_TCP):
+            if not self.target_host or not self.target_port:
+                raise ValueError(
+                    f"EXPORT={self.export}: TARGET_HOST and TARGET_PORT are required")
+        if self.export == EXPORT_KAFKA and not self.kafka_brokers:
+            raise ValueError("EXPORT=kafka: KAFKA_BROKERS is required")
+        if self.sketch_cm_width < 2 or self.sketch_cm_width & (self.sketch_cm_width - 1):
+            raise ValueError("SKETCH_CM_WIDTH must be a power of two >= 2")
+        if not (4 <= self.sketch_hll_precision <= 18):
+            raise ValueError("SKETCH_HLL_PRECISION must be in [4, 18]")
+
+
+_DURATION_FIELDS = {
+    "cache_active_timeout", "listen_poll_period", "stale_entries_evict_timeout",
+    "grpc_reconnect_timer", "grpc_reconnect_timer_randomization", "sketch_window",
+}
+
+
+def _coerce(f: dataclasses.Field, raw: str) -> Any:
+    if f.name in _DURATION_FIELDS:
+        return parse_duration(raw)
+    if f.type in ("bool", bool):
+        return _parse_bool(raw)
+    if f.type in ("int", int):
+        return int(raw)
+    if f.type in ("float", float):
+        return float(raw)
+    if f.type in ("list[str]",):
+        return [s.strip() for s in raw.split(",") if s.strip()]
+    return raw
+
+
+def load_config(environ: Optional[dict] = None) -> AgentConfig:
+    """Build an AgentConfig from environment variables (reference: env.Parse)."""
+    environ = os.environ if environ is None else environ
+    kwargs: dict[str, Any] = {}
+    for f in dataclasses.fields(AgentConfig):
+        env_name = f.metadata.get("env")
+        if not env_name:
+            continue
+        raw = environ.get(env_name)
+        if raw is None:
+            continue
+        if raw == "":
+            # set-but-empty clears string/list fields (e.g. EXCLUDE_INTERFACES="")
+            # but cannot express a numeric/bool value — treat as unset for those.
+            if f.type in ("str", str):
+                kwargs[f.name] = ""
+            elif f.type in ("list[str]",):
+                kwargs[f.name] = []
+            continue
+        kwargs[f.name] = _coerce(f, raw)
+    cfg = AgentConfig(**kwargs)
+    cfg.manage_deprecated()
+    return cfg

@@ -1,0 +1,18 @@
+"""Data model (cross-cutting layer X1 in SURVEY.md §1).
+
+- `binfmt` — numpy structured dtypes that pin the byte layout of the datapath's C
+  structs (the C side is `netobserv_tpu/datapath/bpf/records.h`; parity is enforced by
+  `tests/test_layout_parity.py` which compiles the header with g++ and compares
+  offsets). Reference analog: `pkg/model/record.go:63` + `bpf/types.h:209-215`.
+- `flow` — enums and Python-facing key/stats views.
+- `accumulate` — per-feature merge semantics (the CPU oracle the TPU sketches are
+  validated against). Reference analog: `pkg/model/flow_content.go:28-197`.
+- `columnar` — fixed-shape columnar FlowBatch fed to the TPU analytics plane.
+- `record` — enriched flow record handed to exporters.
+"""
+
+from netobserv_tpu.model.flow import (  # noqa: F401
+    Direction, TcpFlags, GlobalCounter, FlowKey,
+)
+from netobserv_tpu.model.record import Record  # noqa: F401
+from netobserv_tpu.model.columnar import FlowBatch, KEY_WORDS  # noqa: F401

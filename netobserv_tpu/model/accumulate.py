@@ -1,0 +1,172 @@
+"""Per-feature merge semantics — the single source of truth for "how two partial
+observations of the same flow combine".
+
+Reference analog: `pkg/model/flow_content.go:24-197`. These rules are applied in
+three places and must agree everywhere (SURVEY.md §7.3 "merge semantics fidelity"):
+1. host-side merge of per-CPU feature-map partials at eviction,
+2. userspace re-aggregation of ringbuffer singles (Accounter),
+3. on-device sketch folds (bytes/packets add, RTT max, DNS-latency max).
+
+All functions mutate `dst` (a numpy structured scalar or 1-element view) in place,
+merging `src` into it. Semantics follow the reference function by function; tests
+in `tests/test_model.py` pin them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+U16_MAX = np.uint64(0xFFFF)
+U32_MAX = np.uint64(0xFFFF_FFFF)
+U64_MAX = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
+
+
+def _sat_add(a, b, cap) -> int:
+    s = int(a) + int(b)
+    return int(cap) if s > int(cap) else s
+
+
+def _merge_times(dst, src) -> None:
+    """first_seen = min (zero means unset), last_seen = max."""
+    s_first, s_last = int(src["first_seen_ns"]), int(src["last_seen_ns"])
+    d_first = int(dst["first_seen_ns"])
+    if d_first == 0 or (s_first != 0 and s_first < d_first):
+        dst["first_seen_ns"] = s_first
+    if int(dst["last_seen_ns"]) < s_last:
+        dst["last_seen_ns"] = s_last
+
+
+def accumulate_base(dst, src) -> None:
+    """Merge two base flow_stats partials (reference: AccumulateBase,
+    `flow_content.go:28-63`): add bytes/packets, OR flags, min/max times,
+    latest-non-zero wins for eth_protocol/dscp/sampling, MACs fill if unset."""
+    dst_was_empty = int(dst["first_seen_ns"]) == 0 and int(dst["packets"]) == 0
+    _merge_times(dst, src)
+    dst["bytes"] = _sat_add(dst["bytes"], src["bytes"], U64_MAX)
+    dst["packets"] = _sat_add(dst["packets"], src["packets"], U32_MAX)
+    dst["tcp_flags"] = int(dst["tcp_flags"]) | int(src["tcp_flags"])
+    if int(src["eth_protocol"]) != 0:
+        dst["eth_protocol"] = src["eth_protocol"]
+    if int(src["dscp"]) != 0:
+        dst["dscp"] = src["dscp"]
+    if int(src["sampling"]) != 0:
+        dst["sampling"] = src["sampling"]
+    if not np.any(dst["src_mac"]):
+        dst["src_mac"] = src["src_mac"]
+    if not np.any(dst["dst_mac"]):
+        dst["dst_mac"] = src["dst_mac"]
+    if int(src["errno_fallback"]) != 0:
+        dst["errno_fallback"] = src["errno_fallback"]
+    # first-seen identity fields: keep dst's unless dst was a fresh zero entry
+    if dst_was_empty:
+        dst["if_index_first"] = src["if_index_first"]
+        dst["direction_first"] = src["direction_first"]
+    for fld in ("ssl_version", "tls_cipher_suite", "tls_key_share"):
+        if int(src[fld]) != 0:
+            dst[fld] = src[fld]
+    dst["tls_types"] = int(dst["tls_types"]) | int(src["tls_types"])
+    dst["misc_flags"] = int(dst["misc_flags"]) | int(src["misc_flags"])
+    # observed-interfaces dedup (bounded at MAX_OBSERVED_INTERFACES)
+    n_dst = int(dst["n_observed_intf"])
+    cap = len(dst["observed_intf"])
+    for j in range(int(src["n_observed_intf"])):
+        oi, od = int(src["observed_intf"][j]), int(src["observed_direction"][j])
+        seen = any(
+            int(dst["observed_intf"][i]) == oi
+            and int(dst["observed_direction"][i]) == od
+            for i in range(n_dst))
+        if not seen and n_dst < cap:
+            dst["observed_intf"][n_dst] = oi
+            dst["observed_direction"][n_dst] = od
+            n_dst += 1
+    dst["n_observed_intf"] = n_dst
+
+
+def accumulate_dns(dst, src) -> None:
+    """DNS: max latency wins, flags OR, latest id/errno observation adopted
+    (reference: AccumulateDNS, `flow_content.go:76-96` — errno is assigned from
+    the incoming partial even when it clears a previous error)."""
+    _merge_times(dst, src)
+    dst["dns_flags"] = int(dst["dns_flags"]) | int(src["dns_flags"])
+    if int(src["dns_id"]) != 0:
+        dst["dns_id"] = src["dns_id"]
+    if int(dst["errno"]) != int(src["errno"]):
+        dst["errno"] = src["errno"]
+    if int(src["latency_ns"]) > int(dst["latency_ns"]):
+        dst["latency_ns"] = src["latency_ns"]
+    if bytes(src["name"]).rstrip(b"\x00"):
+        dst["name"] = src["name"]
+
+
+def accumulate_drops(dst, src) -> None:
+    """Packet drops: saturating u16 adds, flags OR, latest non-zero cause/state
+    win (reference: AccumulateDrops, `flow_content.go:98-117`)."""
+    _merge_times(dst, src)
+    dst["bytes"] = _sat_add(dst["bytes"], src["bytes"], U16_MAX)
+    dst["packets"] = _sat_add(dst["packets"], src["packets"], U16_MAX)
+    dst["latest_flags"] = int(dst["latest_flags"]) | int(src["latest_flags"])
+    if int(src["latest_cause"]) != 0:
+        dst["latest_cause"] = src["latest_cause"]
+    if int(src["latest_state"]) != 0:
+        dst["latest_state"] = src["latest_state"]
+
+
+def accumulate_extra(dst, src) -> None:
+    """RTT max-merge + IPsec highest-return-code priority (reference:
+    AccumulateAdditional, `flow_content.go:154-178`)."""
+    _merge_times(dst, src)
+    if int(src["rtt_ns"]) > int(dst["rtt_ns"]):
+        dst["rtt_ns"] = src["rtt_ns"]
+    if int(dst["ipsec_ret"]) < int(src["ipsec_ret"]):
+        dst["ipsec_ret"] = src["ipsec_ret"]
+        dst["ipsec_encrypted"] = src["ipsec_encrypted"]
+    elif int(dst["ipsec_ret"]) == int(src["ipsec_ret"]) and int(src["ipsec_encrypted"]):
+        dst["ipsec_encrypted"] = src["ipsec_encrypted"]
+
+
+def accumulate_xlat(dst, src) -> None:
+    """NAT translation: a complete (both-endpoints) observation replaces
+    (reference: AccumulateXlat, `flow_content.go:139-152`)."""
+    _merge_times(dst, src)
+    if np.any(src["src_ip"]) and np.any(src["dst_ip"]):
+        for fld in ("src_ip", "dst_ip", "src_port", "dst_port", "zone_id"):
+            dst[fld] = src[fld]
+
+
+def accumulate_network_events(dst, src) -> None:
+    """Network events: dedup append into a wrapping ring of MAX_NETWORK_EVENTS
+    (reference: AccumulateNetworkEvents, `flow_content.go:119-137`)."""
+    _merge_times(dst, src)
+    idx = int(dst["n_events"]) % dst["events"].shape[0]
+    cap = dst["events"].shape[0]
+    for j in range(src["events"].shape[0]):
+        ev = src["events"][j]
+        if int(src["packets"][j]) == 0:
+            continue
+        dup = any(np.array_equal(dst["events"][i], ev) for i in range(cap))
+        if not dup:
+            dst["events"][idx] = ev
+            dst["bytes"][idx] = _sat_add(dst["bytes"][idx], src["bytes"][j], U16_MAX)
+            dst["packets"][idx] = _sat_add(dst["packets"][idx], src["packets"][j], U16_MAX)
+            idx = (idx + 1) % cap
+    dst["n_events"] = idx
+
+
+def accumulate_quic(dst, src) -> None:
+    """QUIC: max version wins, header-seen flags max/OR (reference:
+    AccumulateQuic, `flow_content.go:179-197`)."""
+    _merge_times(dst, src)
+    if int(src["version"]) > int(dst["version"]):
+        dst["version"] = src["version"]
+    if int(dst["seen_long_hdr"]) < int(src["seen_long_hdr"]):
+        dst["seen_long_hdr"] = src["seen_long_hdr"]
+    if int(dst["seen_short_hdr"]) < int(src["seen_short_hdr"]):
+        dst["seen_short_hdr"] = src["seen_short_hdr"]
+
+
+def merge_percpu(values: np.ndarray, accumulate_fn) -> np.ndarray:
+    """Merge per-CPU partial records (shape (n_cpu,) structured) into one."""
+    out = values[0].copy()
+    for i in range(1, len(values)):
+        accumulate_fn(out, values[i])
+    return out

@@ -1,0 +1,230 @@
+"""Fixed-shape columnar flow batches — the TPU feed format.
+
+This is the Accounter-equivalent that builds arrays instead of a hashmap
+(SURVEY.md §7.2 step 4). Every batch has a static shape `(batch_size,)` per column
+with a validity mask, so the jitted sketch-ingest step never retraces.
+
+Key packing: the 37-byte flow identity is packed into `KEY_WORDS`=10 little-endian
+uint32 lanes (4 src words, 4 dst words, ports word, proto/icmp word) — byte-wise
+hashing reformulated as wide integer vector math (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields as dfields
+from typing import Iterable, Optional
+
+import numpy as np
+
+from netobserv_tpu.model import binfmt
+from netobserv_tpu.model.record import Record
+
+KEY_WORDS = 10
+
+_COLUMNS: list[tuple[str, np.dtype, tuple]] = [
+    ("keys", np.uint32, (KEY_WORDS,)),
+    ("bytes", np.uint64, ()),
+    ("packets", np.uint32, ()),
+    ("tcp_flags", np.uint32, ()),
+    ("eth_protocol", np.uint32, ()),
+    ("direction", np.uint32, ()),
+    ("if_index", np.uint32, ()),
+    ("dscp", np.uint32, ()),
+    ("sampling", np.uint32, ()),
+    ("first_seen_ns", np.uint64, ()),
+    ("last_seen_ns", np.uint64, ()),
+    ("rtt_us", np.uint32, ()),
+    ("dns_latency_us", np.uint32, ()),
+    ("dns_id", np.uint32, ()),
+    ("dns_flags", np.uint32, ()),
+    ("dns_errno", np.uint32, ()),
+    ("drop_bytes", np.uint32, ()),
+    ("drop_packets", np.uint32, ()),
+    ("valid", np.bool_, ()),
+]
+
+
+def pack_key_words(key_arr: np.ndarray) -> np.ndarray:
+    """Pack a structured FLOW_KEY array (N,) into uint32 words (N, KEY_WORDS)."""
+    n = len(key_arr)
+    out = np.zeros((n, KEY_WORDS), dtype=np.uint32)
+    if n == 0:
+        return out
+    src = np.ascontiguousarray(key_arr["src_ip"]).view(np.uint32).reshape(n, 4)
+    dst = np.ascontiguousarray(key_arr["dst_ip"]).view(np.uint32).reshape(n, 4)
+    out[:, 0:4] = src
+    out[:, 4:8] = dst
+    out[:, 8] = (key_arr["src_port"].astype(np.uint32) << np.uint32(16)) | \
+        key_arr["dst_port"].astype(np.uint32)
+    out[:, 9] = (key_arr["proto"].astype(np.uint32) << np.uint32(16)) | \
+        (key_arr["icmp_type"].astype(np.uint32) << np.uint32(8)) | \
+        key_arr["icmp_code"].astype(np.uint32)
+    return out
+
+
+def unpack_key_words(words: np.ndarray) -> np.ndarray:
+    """Inverse of pack_key_words — back to a structured FLOW_KEY array."""
+    n = len(words)
+    out = np.zeros(n, dtype=binfmt.FLOW_KEY_DTYPE)
+    if n == 0:
+        return out
+    out["src_ip"] = np.ascontiguousarray(words[:, 0:4]).view(np.uint8).reshape(n, 16)
+    out["dst_ip"] = np.ascontiguousarray(words[:, 4:8]).view(np.uint8).reshape(n, 16)
+    out["src_port"] = (words[:, 8] >> np.uint32(16)).astype(np.uint16)
+    out["dst_port"] = (words[:, 8] & np.uint32(0xFFFF)).astype(np.uint16)
+    out["proto"] = ((words[:, 9] >> np.uint32(16)) & np.uint32(0xFF)).astype(np.uint8)
+    out["icmp_type"] = ((words[:, 9] >> np.uint32(8)) & np.uint32(0xFF)).astype(np.uint8)
+    out["icmp_code"] = (words[:, 9] & np.uint32(0xFF)).astype(np.uint8)
+    return out
+
+
+@dataclass
+class FlowBatch:
+    """One fixed-shape columnar batch of flows.
+
+    `valid[i]` marks live rows; padding rows are all-zero and must be masked by
+    every consumer. `epoch_wall_ns - epoch_mono_ns` converts the mono timestamps
+    to wall clock (clock reconstruction happens on-host; SURVEY.md §7.3).
+    """
+
+    keys: np.ndarray
+    bytes: np.ndarray
+    packets: np.ndarray
+    tcp_flags: np.ndarray
+    eth_protocol: np.ndarray
+    direction: np.ndarray
+    if_index: np.ndarray
+    dscp: np.ndarray
+    sampling: np.ndarray
+    first_seen_ns: np.ndarray
+    last_seen_ns: np.ndarray
+    rtt_us: np.ndarray
+    dns_latency_us: np.ndarray
+    dns_id: np.ndarray
+    dns_flags: np.ndarray
+    dns_errno: np.ndarray
+    drop_bytes: np.ndarray
+    drop_packets: np.ndarray
+    valid: np.ndarray
+    epoch_mono_ns: int = 0
+    epoch_wall_ns: int = 0
+
+    @property
+    def size(self) -> int:
+        return len(self.valid)
+
+    @property
+    def n_valid(self) -> int:
+        return int(self.valid.sum())
+
+    @classmethod
+    def empty(cls, batch_size: int) -> "FlowBatch":
+        cols = {name: np.zeros((batch_size,) + shape, dtype=dt)
+                for name, dt, shape in _COLUMNS}
+        return cls(**cols)
+
+    @classmethod
+    def from_events(cls, events: np.ndarray, batch_size: Optional[int] = None,
+                    extra: Optional[np.ndarray] = None,
+                    dns: Optional[np.ndarray] = None,
+                    drops: Optional[np.ndarray] = None) -> "FlowBatch":
+        """Build a batch from a decoded FLOW_EVENT structured array.
+
+        `extra`/`dns`/`drops` are optional parallel arrays of the per-feature
+        record dtypes (already merged per flow, aligned with `events`).
+        """
+        n = len(events)
+        batch_size = batch_size or n
+        if n > batch_size:
+            raise ValueError(f"{n} events exceed batch size {batch_size}")
+        b = cls.empty(batch_size)
+        if n == 0:
+            return b
+        stats = events["stats"]
+        b.keys[:n] = pack_key_words(events["key"])
+        b.bytes[:n] = stats["bytes"]
+        b.packets[:n] = stats["packets"]
+        b.tcp_flags[:n] = stats["tcp_flags"]
+        b.eth_protocol[:n] = stats["eth_protocol"]
+        b.direction[:n] = stats["direction_first"]
+        b.if_index[:n] = stats["if_index_first"]
+        b.dscp[:n] = stats["dscp"]
+        b.sampling[:n] = stats["sampling"]
+        b.first_seen_ns[:n] = stats["first_seen_ns"]
+        b.last_seen_ns[:n] = stats["last_seen_ns"]
+        if extra is not None and len(extra):
+            b.rtt_us[:n] = extra["rtt_ns"] // 1000
+        if dns is not None and len(dns):
+            b.dns_latency_us[:n] = dns["latency_ns"] // 1000
+            b.dns_id[:n] = dns["dns_id"]
+            b.dns_flags[:n] = dns["dns_flags"]
+            b.dns_errno[:n] = dns["errno"]
+        if drops is not None and len(drops):
+            b.drop_bytes[:n] = drops["bytes"]
+            b.drop_packets[:n] = drops["packets"]
+        b.valid[:n] = True
+        return b
+
+    @classmethod
+    def from_records(cls, records: Iterable[Record],
+                     batch_size: Optional[int] = None) -> "FlowBatch":
+        recs = list(records)
+        n = len(recs)
+        batch_size = batch_size or max(n, 1)
+        if n > batch_size:
+            raise ValueError(f"{n} records exceed batch size {batch_size}")
+        b = cls.empty(batch_size)
+        key_arr = np.zeros(n, dtype=binfmt.FLOW_KEY_DTYPE)
+        for i, r in enumerate(recs):
+            key_arr[i]["src_ip"] = np.frombuffer(r.key.src_ip, dtype=np.uint8)
+            key_arr[i]["dst_ip"] = np.frombuffer(r.key.dst_ip, dtype=np.uint8)
+            key_arr[i]["src_port"] = r.key.src_port
+            key_arr[i]["dst_port"] = r.key.dst_port
+            key_arr[i]["proto"] = r.key.proto
+            key_arr[i]["icmp_type"] = r.key.icmp_type
+            key_arr[i]["icmp_code"] = r.key.icmp_code
+            b.bytes[i] = r.bytes_
+            b.packets[i] = r.packets
+            b.tcp_flags[i] = r.tcp_flags
+            b.eth_protocol[i] = r.eth_protocol
+            b.direction[i] = r.direction
+            b.if_index[i] = r.if_index
+            b.dscp[i] = r.dscp
+            b.sampling[i] = r.sampling
+            b.first_seen_ns[i] = r.mono_start_ns
+            b.last_seen_ns[i] = r.mono_end_ns
+            b.rtt_us[i] = r.features.rtt_ns // 1000
+            b.dns_latency_us[i] = r.features.dns_latency_ns // 1000
+            b.dns_id[i] = r.features.dns_id
+            b.dns_flags[i] = r.features.dns_flags
+            b.dns_errno[i] = r.features.dns_errno
+            b.drop_bytes[i] = r.features.drop_bytes
+            b.drop_packets[i] = r.features.drop_packets
+        if n:
+            b.keys[:n] = pack_key_words(key_arr)
+            b.valid[:n] = True
+        return b
+
+    def columns(self) -> dict[str, np.ndarray]:
+        return {f.name: getattr(self, f.name) for f in dfields(self)
+                if f.name not in ("epoch_mono_ns", "epoch_wall_ns")}
+
+
+def exact_aggregate(batches: Iterable[FlowBatch]) -> dict[bytes, tuple[int, int]]:
+    """Exact per-key (bytes, packets) aggregation — the CPU oracle.
+
+    This is the reference's `Accounter`/hashmap aggregation semantics
+    (`pkg/flow/account.go:204-246`) that sketch outputs are scored against
+    (BASELINE.md acceptance bound: <1% heavy-hitter recall loss).
+    """
+    acc: dict[bytes, tuple[int, int]] = {}
+    for b in batches:
+        idx = np.nonzero(b.valid)[0]
+        if len(idx) == 0:
+            continue
+        kb = np.ascontiguousarray(b.keys[idx]).view(np.uint8).reshape(len(idx), -1)
+        for i, row in zip(idx, kb):
+            k = row.tobytes()
+            by, pk = acc.get(k, (0, 0))
+            acc[k] = (by + int(b.bytes[i]), pk + int(b.packets[i]))
+    return acc

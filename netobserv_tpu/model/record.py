@@ -1,0 +1,192 @@
+"""Enriched flow record — what exporters consume.
+
+Reference analog: `pkg/model/record.go:66-159` (`Record`, `NewRecord`): reconstructs
+wall-clock times from the datapath's monotonic timestamps, names interfaces, and
+attaches per-feature metrics. Unlike the reference (which decodes one record per Go
+struct), enrichment here operates per *batch* where possible; `Record` objects are
+only materialized at exporter boundaries that need them (gRPC/IPFIX/stdout), while
+the tpu-sketch backend consumes the columnar batch directly.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field as dfield
+from typing import Callable, Optional
+
+import numpy as np
+
+from netobserv_tpu.model.flow import (
+    Direction, FlowFeatures, FlowKey, ip_from_16,
+)
+
+# interfaceNamer hook (reference: `model.SetInterfaceNamer`,
+# `pkg/agent/interfaces_listener.go:74-81`)
+InterfaceNamer = Callable[[int, bytes], str]
+
+
+def default_namer(if_index: int, mac: bytes) -> str:
+    return str(if_index)
+
+
+_namer: InterfaceNamer = default_namer
+
+
+def set_interface_namer(namer: InterfaceNamer) -> None:
+    global _namer
+    _namer = namer
+
+
+def interface_namer() -> InterfaceNamer:
+    return _namer
+
+
+class MonotonicClock:
+    """Maps datapath monotonic-ns timestamps to wall-clock epochs.
+
+    Reference analog: `pkg/model/record.go:90-97` — current wall time minus the
+    (current mono - sample mono) delta. One instance is shared per agent so every
+    batch uses a consistent mapping.
+    """
+
+    def now_pair(self) -> tuple[int, int]:
+        return time.clock_gettime_ns(time.CLOCK_MONOTONIC), time.time_ns()
+
+    def wall_ns(self, mono_ns: int) -> int:
+        cur_mono, cur_wall = self.now_pair()
+        return cur_wall - (cur_mono - mono_ns)
+
+    def wall_ns_array(self, mono_ns: np.ndarray) -> np.ndarray:
+        cur_mono, cur_wall = self.now_pair()
+        offset = cur_wall - cur_mono
+        return mono_ns.astype(np.int64) + offset
+
+
+@dataclass
+class Record:
+    """One enriched flow (reference: `pkg/model/record.go:66-80`)."""
+
+    key: FlowKey
+    bytes_: int = 0
+    packets: int = 0
+    eth_protocol: int = 0
+    tcp_flags: int = 0
+    direction: int = int(Direction.INGRESS)
+    src_mac: bytes = b"\x00" * 6
+    dst_mac: bytes = b"\x00" * 6
+    if_index: int = 0
+    interface: str = ""
+    udn: str = ""
+    dscp: int = 0
+    sampling: int = 0
+    errno_fallback: int = 0
+    time_flow_start_ns: int = 0  # wall clock
+    time_flow_end_ns: int = 0
+    mono_start_ns: int = 0
+    mono_end_ns: int = 0
+    agent_ip: str = ""
+    # (interface, direction, udn) observations across NICs — the reference's DupMap
+    dup_list: list[tuple[str, int, str]] = dfield(default_factory=list)
+    features: FlowFeatures = dfield(default_factory=FlowFeatures)
+    ssl_version: int = 0
+    tls_cipher_suite: int = 0
+    tls_key_share: int = 0
+    tls_types: int = 0
+    ssl_mismatch: bool = False
+
+    def to_json_obj(self) -> dict:
+        """Stable JSON shape for the stdout/direct exporter."""
+        f = self.features
+        obj = {
+            "SrcAddr": self.key.src,
+            "DstAddr": self.key.dst,
+            "SrcPort": self.key.src_port,
+            "DstPort": self.key.dst_port,
+            "Proto": self.key.proto,
+            "Bytes": self.bytes_,
+            "Packets": self.packets,
+            "Flags": self.tcp_flags,
+            "Etype": self.eth_protocol,
+            "Dscp": self.dscp,
+            "IfDirection": self.direction,
+            "Interface": self.interface or str(self.if_index),
+            "TimeFlowStartMs": self.time_flow_start_ns // 1_000_000,
+            "TimeFlowEndMs": self.time_flow_end_ns // 1_000_000,
+            "AgentIP": self.agent_ip,
+            "Sampling": self.sampling,
+        }
+        if self.key.proto in (1, 58):  # ICMP / ICMPv6
+            obj["IcmpType"] = self.key.icmp_type
+            obj["IcmpCode"] = self.key.icmp_code
+        if f.dns_id or f.dns_latency_ns:
+            obj.update(DnsId=f.dns_id, DnsFlags=f.dns_flags,
+                       DnsLatencyMs=f.dns_latency_ns // 1_000_000,
+                       DnsErrno=f.dns_errno)
+            if f.dns_name:
+                obj["DnsName"] = f.dns_name
+        if f.drop_packets or f.drop_bytes:
+            obj.update(PktDropBytes=f.drop_bytes, PktDropPackets=f.drop_packets,
+                       PktDropLatestFlags=f.drop_latest_flags,
+                       PktDropLatestState=f.drop_latest_state,
+                       PktDropLatestDropCause=f.drop_latest_cause)
+        if f.rtt_ns:
+            obj["TimeFlowRttNs"] = f.rtt_ns
+        if f.xlat_src_ip:
+            obj.update(XlatSrcAddr=ip_from_16(f.xlat_src_ip),
+                       XlatDstAddr=ip_from_16(f.xlat_dst_ip),
+                       XlatSrcPort=f.xlat_src_port, XlatDstPort=f.xlat_dst_port,
+                       XlatZoneId=f.xlat_zone_id)
+        return obj
+
+
+def records_from_events(
+    events: np.ndarray,
+    clock: Optional[MonotonicClock] = None,
+    agent_ip: str = "",
+    namer: Optional[InterfaceNamer] = None,
+) -> list[Record]:
+    """Materialize Record objects from a decoded structured array of flow events."""
+    clock = clock or MonotonicClock()
+    namer = namer or _namer
+    if len(events) == 0:
+        return []
+    cur_mono, cur_wall = clock.now_pair()
+    offset = cur_wall - cur_mono  # one offset per batch keeps spans exact
+    starts = np.asarray(events["stats"]["first_seen_ns"]).astype(np.int64) + offset
+    ends = np.asarray(events["stats"]["last_seen_ns"]).astype(np.int64) + offset
+    out: list[Record] = []
+    for i in range(len(events)):
+        k = events["key"][i]
+        s = events["stats"][i]
+        key = FlowKey(
+            src_ip=k["src_ip"].tobytes(), dst_ip=k["dst_ip"].tobytes(),
+            src_port=int(k["src_port"]), dst_port=int(k["dst_port"]),
+            proto=int(k["proto"]), icmp_type=int(k["icmp_type"]),
+            icmp_code=int(k["icmp_code"]),
+        )
+        mac = s["src_mac"].tobytes()
+        if_index = int(s["if_index_first"])
+        rec = Record(
+            key=key,
+            bytes_=int(s["bytes"]), packets=int(s["packets"]),
+            eth_protocol=int(s["eth_protocol"]), tcp_flags=int(s["tcp_flags"]),
+            direction=int(s["direction_first"]),
+            src_mac=mac, dst_mac=s["dst_mac"].tobytes(),
+            if_index=if_index, interface=namer(if_index, mac),
+            dscp=int(s["dscp"]), sampling=int(s["sampling"]),
+            errno_fallback=int(s["errno_fallback"]),
+            time_flow_start_ns=int(starts[i]), time_flow_end_ns=int(ends[i]),
+            mono_start_ns=int(s["first_seen_ns"]), mono_end_ns=int(s["last_seen_ns"]),
+            agent_ip=agent_ip,
+            ssl_version=int(s["ssl_version"]),
+            tls_cipher_suite=int(s["tls_cipher_suite"]),
+            tls_key_share=int(s["tls_key_share"]), tls_types=int(s["tls_types"]),
+            ssl_mismatch=bool(int(s["misc_flags"]) & 0x01),
+        )
+        n = int(s["n_observed_intf"])
+        for j in range(min(n, len(s["observed_intf"]))):
+            oi = int(s["observed_intf"][j])
+            od = int(s["observed_direction"][j])
+            rec.dup_list.append((namer(oi, mac), od, ""))
+        out.append(rec)
+    return out

@@ -1,0 +1,13 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before jax initializes its backend (hence env mutation at import time).
+Real-TPU performance runs live in bench.py, not here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,77 @@
+import pytest
+
+from netobserv_tpu import config as cfg
+
+
+def test_defaults():
+    c = cfg.load_config(environ={})
+    assert c.export == "grpc"
+    assert c.cache_max_flows == 5000
+    assert c.cache_active_timeout == 5.0
+    assert c.exclude_interfaces == ["lo"]
+    assert c.kafka_topic == "network-flows"
+    assert c.metrics_prefix == "ebpf_agent_"
+    assert c.sketch_cm_depth == 4
+
+
+def test_env_parsing():
+    c = cfg.load_config(environ={
+        "EXPORT": "tpu-sketch",
+        "CACHE_ACTIVE_TIMEOUT": "300ms",
+        "CACHE_MAX_FLOWS": "123",
+        "INTERFACES": "eth0, eth1",
+        "ENABLE_DNS_TRACKING": "true",
+        "SAMPLING": "50",
+        "SKETCH_CM_WIDTH": "4096",
+    })
+    assert c.export == "tpu-sketch"
+    assert c.cache_active_timeout == pytest.approx(0.3)
+    assert c.cache_max_flows == 123
+    assert c.interfaces == ["eth0", "eth1"]
+    assert c.enable_dns_tracking is True
+    assert c.sampling == 50
+    c.validate()
+
+
+def test_durations():
+    assert cfg.parse_duration("5s") == 5.0
+    assert cfg.parse_duration("1m30s") == 90.0
+    assert cfg.parse_duration("250ms") == pytest.approx(0.25)
+    assert cfg.parse_duration("2h") == 7200.0
+    with pytest.raises(ValueError):
+        cfg.parse_duration("5parsecs")
+
+
+def test_deprecated_aliases():
+    c = cfg.load_config(environ={
+        "FLOWS_TARGET_HOST": "collector", "FLOWS_TARGET_PORT": "9999"})
+    assert c.target_host == "collector"
+    assert c.target_port == 9999
+
+
+def test_validate_rejects_bad_export():
+    c = cfg.load_config(environ={"EXPORT": "carrier-pigeon"})
+    with pytest.raises(ValueError):
+        c.validate()
+
+
+def test_validate_requires_target():
+    c = cfg.load_config(environ={"EXPORT": "grpc"})
+    with pytest.raises(ValueError):
+        c.validate()
+    c2 = cfg.load_config(environ={
+        "EXPORT": "grpc", "TARGET_HOST": "h", "TARGET_PORT": "1"})
+    c2.validate()
+
+
+def test_filter_rules_parse():
+    rules = cfg.parse_filter_rules(
+        '[{"ip_cidr":"10.0.0.0/8","action":"Reject","protocol":"TCP",'
+        '"destination_port":443,"sample":10}]')
+    assert len(rules) == 1
+    r = rules[0]
+    assert r.ip_cidr == "10.0.0.0/8"
+    assert r.action == "Reject"
+    assert r.destination_port == 443
+    assert r.sample == 10
+    assert cfg.parse_filter_rules("") == []

@@ -1,0 +1,118 @@
+"""C ↔ host binary-layout parity.
+
+Compiles `netobserv_tpu/datapath/bpf/records.h` with the host compiler, prints
+offsetof/sizeof for every field of every record struct, and compares against the
+numpy dtypes in `netobserv_tpu.model.binfmt`. This is the rebuild's version of the
+reference's comment-enforced contract (`bpf/types.h:209-215`).
+"""
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from netobserv_tpu.model import binfmt
+
+REPO = Path(__file__).resolve().parent.parent
+HEADER = REPO / "netobserv_tpu" / "datapath" / "bpf" / "records.h"
+
+# (C struct name, dtype, host field name -> C field name overrides)
+STRUCTS = [
+    ("no_flow_key", binfmt.FLOW_KEY_DTYPE, {}),
+    ("no_flow_stats", binfmt.FLOW_STATS_DTYPE, {}),
+    ("no_flow_event", binfmt.FLOW_EVENT_DTYPE, {}),
+    ("no_dns_rec", binfmt.DNS_REC_DTYPE, {"errno": "errno_code"}),
+    ("no_drops_rec", binfmt.DROPS_REC_DTYPE, {}),
+    ("no_nevents_rec", binfmt.NEVENTS_REC_DTYPE, {}),
+    ("no_xlat_rec", binfmt.XLAT_REC_DTYPE, {}),
+    ("no_extra_rec", binfmt.EXTRA_REC_DTYPE, {}),
+    ("no_quic_rec", binfmt.QUIC_REC_DTYPE, {}),
+    ("no_packet_event", binfmt.PACKET_EVENT_DTYPE, {}),
+    ("no_ssl_event", binfmt.SSL_EVENT_DTYPE, {}),
+]
+
+
+def _cc() -> str | None:
+    for cc in ("cc", "gcc", "g++", "clang"):
+        if shutil.which(cc):
+            return cc
+    return None
+
+
+def _dtype_fields(dtype: np.dtype, overrides: dict) -> dict[str, tuple[int, int]]:
+    """host field name -> (offset, size), skipping explicit pad fields."""
+    out = {}
+    for name in dtype.names:
+        sub, offset = dtype.fields[name][0], dtype.fields[name][1]
+        if name.startswith("pad"):
+            continue
+        out[overrides.get(name, name)] = (offset, sub.itemsize)
+    return out
+
+
+@pytest.fixture(scope="module")
+def c_layout(tmp_path_factory):
+    cc = _cc()
+    if cc is None:
+        pytest.skip("no host C compiler available")
+    tmp = tmp_path_factory.mktemp("layout")
+    lines = [
+        "#define NO_HOST_BUILD 1",
+        f'#include "{HEADER}"',
+        "#include <stdio.h>",
+        "#include <stddef.h>",
+        "int main(void) {",
+    ]
+    for cname, dtype, overrides in STRUCTS:
+        lines.append(
+            f'printf("{cname} __size__ %zu\\n", sizeof(struct {cname}));')
+        for fname in _dtype_fields(dtype, overrides):
+            lines.append(
+                f'printf("{cname} {fname} %zu %zu\\n", '
+                f"offsetof(struct {cname}, {fname}), "
+                f"sizeof(((struct {cname}*)0)->{fname}));")
+    lines += ["return 0;", "}"]
+    src = tmp / "layout.c"
+    src.write_text("\n".join(lines))
+    exe = tmp / "layout"
+    # g++ needs the file treated as C++; plain C is fine for either
+    args = [cc, "-x", "c++" if cc == "g++" else "c", str(src), "-o", str(exe)]
+    subprocess.run(args, check=True, capture_output=True, text=True)
+    out = subprocess.run([str(exe)], check=True, capture_output=True, text=True)
+    layout: dict[str, dict[str, tuple[int, int]]] = {}
+    for line in out.stdout.splitlines():
+        sname, fname, *nums = line.split()
+        if fname == "__size__":
+            layout.setdefault(sname, {})["__size__"] = (int(nums[0]), 0)
+        else:
+            layout.setdefault(sname, {})[fname] = (int(nums[0]), int(nums[1]))
+    return layout
+
+
+@pytest.mark.parametrize("cname,dtype,overrides", STRUCTS,
+                         ids=[s[0] for s in STRUCTS])
+def test_struct_layout(c_layout, cname, dtype, overrides):
+    c_fields = c_layout[cname]
+    assert c_fields["__size__"][0] == dtype.itemsize, (
+        f"sizeof({cname})={c_fields['__size__'][0]} != dtype {dtype.itemsize}")
+    for fname, (offset, size) in _dtype_fields(dtype, overrides).items():
+        assert fname in c_fields, f"{cname}.{fname} missing in C"
+        c_off, c_size = c_fields[fname]
+        assert c_off == offset, (
+            f"{cname}.{fname}: C offset {c_off} != host {offset}")
+        assert c_size == size, (
+            f"{cname}.{fname}: C size {c_size} != host {size}")
+
+
+def test_no_implicit_padding_surprises(c_layout):
+    """Every byte of every struct is either a named field or an explicit pad —
+    i.e. the dtype covers the full C size (checked via itemsize equality above),
+    and numpy sees no alignment gaps we didn't declare."""
+    for cname, dtype, _ in STRUCTS:
+        covered = 0
+        for name in dtype.names:
+            covered += dtype.fields[name][0].itemsize
+        assert covered == dtype.itemsize, f"{cname} dtype has implicit gaps"

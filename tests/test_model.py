@@ -1,0 +1,209 @@
+import numpy as np
+import pytest
+
+from netobserv_tpu.model import binfmt, columnar
+from netobserv_tpu.model import accumulate as acc
+from netobserv_tpu.model.flow import FlowKey, ip_from_16, ip_to_16
+from netobserv_tpu.model.record import MonotonicClock, Record, records_from_events
+
+
+def make_event(src="10.0.0.1", dst="10.0.0.2", sport=1234, dport=80, proto=6,
+               nbytes=1500, pkts=3, first=1_000, last=2_000):
+    ev = np.zeros(1, dtype=binfmt.FLOW_EVENT_DTYPE)[0]
+    ev["key"]["src_ip"] = np.frombuffer(ip_to_16(src), dtype=np.uint8)
+    ev["key"]["dst_ip"] = np.frombuffer(ip_to_16(dst), dtype=np.uint8)
+    ev["key"]["src_port"] = sport
+    ev["key"]["dst_port"] = dport
+    ev["key"]["proto"] = proto
+    ev["stats"]["bytes"] = nbytes
+    ev["stats"]["packets"] = pkts
+    ev["stats"]["first_seen_ns"] = first
+    ev["stats"]["last_seen_ns"] = last
+    ev["stats"]["eth_protocol"] = 0x0800
+    ev["stats"]["direction_first"] = 1
+    ev["stats"]["if_index_first"] = 7
+    return ev
+
+
+class TestIPCodec:
+    def test_v4_mapped(self):
+        raw = ip_to_16("192.168.1.5")
+        assert len(raw) == 16
+        assert raw[:12] == b"\x00" * 10 + b"\xff\xff"
+        assert ip_from_16(raw) == "192.168.1.5"
+
+    def test_v6_roundtrip(self):
+        raw = ip_to_16("2001:db8::1")
+        assert ip_from_16(raw) == "2001:db8::1"
+
+
+class TestBinfmt:
+    def test_flow_event_roundtrip(self):
+        events = np.zeros(5, dtype=binfmt.FLOW_EVENT_DTYPE)
+        for i in range(5):
+            events[i] = make_event(sport=1000 + i, nbytes=100 * i)
+        raw = binfmt.encode_flow_events(events)
+        assert len(raw) == 5 * binfmt.FLOW_EVENT_DTYPE.itemsize
+        back = binfmt.decode_flow_events(raw)
+        assert np.array_equal(back, events)
+
+    def test_decode_rejects_misaligned(self):
+        with pytest.raises(ValueError):
+            binfmt.decode_flow_events(b"\x00" * 13)
+
+
+class TestKeyPacking:
+    def test_roundtrip(self):
+        keys = np.zeros(4, dtype=binfmt.FLOW_KEY_DTYPE)
+        for i, (src, dst) in enumerate([
+            ("10.0.0.1", "10.0.0.2"), ("2001:db8::1", "2001:db8::2"),
+            ("0.0.0.0", "255.255.255.255"), ("172.16.5.4", "8.8.8.8"),
+        ]):
+            keys[i]["src_ip"] = np.frombuffer(ip_to_16(src), np.uint8)
+            keys[i]["dst_ip"] = np.frombuffer(ip_to_16(dst), np.uint8)
+            keys[i]["src_port"] = 100 + i
+            keys[i]["dst_port"] = 200 + i
+            keys[i]["proto"] = 6
+        words = columnar.pack_key_words(keys)
+        assert words.shape == (4, columnar.KEY_WORDS)
+        back = columnar.unpack_key_words(words)
+        assert np.array_equal(back, keys)
+
+    def test_distinct_keys_distinct_words(self):
+        k1 = np.zeros(1, dtype=binfmt.FLOW_KEY_DTYPE)
+        k2 = np.zeros(1, dtype=binfmt.FLOW_KEY_DTYPE)
+        k1[0]["src_port"], k2[0]["dst_port"] = 53, 53
+        w1, w2 = columnar.pack_key_words(k1), columnar.pack_key_words(k2)
+        assert not np.array_equal(w1, w2)
+
+
+class TestFlowBatch:
+    def test_from_events_pads(self):
+        events = np.zeros(3, dtype=binfmt.FLOW_EVENT_DTYPE)
+        for i in range(3):
+            events[i] = make_event(sport=i)
+        b = columnar.FlowBatch.from_events(events, batch_size=8)
+        assert b.size == 8
+        assert b.n_valid == 3
+        assert b.bytes[:3].sum() == 3 * 1500
+        assert not b.valid[3:].any()
+
+    def test_overflow_raises(self):
+        events = np.zeros(3, dtype=binfmt.FLOW_EVENT_DTYPE)
+        with pytest.raises(ValueError):
+            columnar.FlowBatch.from_events(events, batch_size=2)
+
+    def test_exact_aggregate(self):
+        e1 = np.zeros(2, dtype=binfmt.FLOW_EVENT_DTYPE)
+        e1[0] = make_event(nbytes=100, pkts=1)
+        e1[1] = make_event(sport=9999, nbytes=7, pkts=2)
+        e2 = np.zeros(1, dtype=binfmt.FLOW_EVENT_DTYPE)
+        e2[0] = make_event(nbytes=50, pkts=4)  # same key as e1[0]
+        b1 = columnar.FlowBatch.from_events(e1, batch_size=4)
+        b2 = columnar.FlowBatch.from_events(e2, batch_size=4)
+        agg = columnar.exact_aggregate([b1, b2])
+        assert len(agg) == 2
+        assert (150, 5) in agg.values()
+        assert (7, 2) in agg.values()
+
+
+class TestAccumulate:
+    def test_base_merge(self):
+        a = make_event(nbytes=100, pkts=1, first=100, last=200)["stats"].copy()
+        b = make_event(nbytes=50, pkts=2, first=50, last=150)["stats"].copy()
+        a["tcp_flags"], b["tcp_flags"] = 0x02, 0x10
+        a["dscp"], b["dscp"] = 10, 46
+        b["if_index_first"] = 3
+        acc.accumulate_base(a, b)
+        assert int(a["bytes"]) == 150
+        assert int(a["packets"]) == 3
+        assert int(a["tcp_flags"]) == 0x12
+        assert int(a["first_seen_ns"]) == 50
+        assert int(a["last_seen_ns"]) == 200
+        # latest non-zero wins (reference AccumulateBase semantics)
+        assert int(a["dscp"]) == 46
+        # identity fields of an already-populated dst are kept
+        assert int(a["if_index_first"]) == 7
+
+    def test_base_merge_into_empty(self):
+        a = np.zeros(1, dtype=binfmt.FLOW_STATS_DTYPE)[0]
+        b = make_event(nbytes=50, pkts=2, first=50, last=150)["stats"].copy()
+        acc.accumulate_base(a, b)
+        assert int(a["if_index_first"]) == 7
+        assert int(a["direction_first"]) == 1
+        assert int(a["first_seen_ns"]) == 50
+
+    def test_drops_saturate(self):
+        a = np.zeros(1, dtype=binfmt.DROPS_REC_DTYPE)[0]
+        b = np.zeros(1, dtype=binfmt.DROPS_REC_DTYPE)[0]
+        a["bytes"], b["bytes"] = 0xFFF0, 0x0100
+        a["latest_flags"], b["latest_flags"] = 0x02, 0x10
+        b["latest_cause"] = 77
+        acc.accumulate_drops(a, b)
+        assert int(a["bytes"]) == 0xFFFF  # saturated, not wrapped
+        assert int(a["latest_cause"]) == 77
+        assert int(a["latest_flags"]) == 0x12  # OR-merged, not replaced
+
+    def test_dns_max_latency(self):
+        a = np.zeros(1, dtype=binfmt.DNS_REC_DTYPE)[0]
+        b = np.zeros(1, dtype=binfmt.DNS_REC_DTYPE)[0]
+        a["latency_ns"], b["latency_ns"] = 500, 1500
+        b["name"] = b"example.com"
+        a["errno"], b["errno"] = 3, 0
+        acc.accumulate_dns(a, b)
+        assert int(a["latency_ns"]) == 1500
+        assert bytes(a["name"]).rstrip(b"\x00") == b"example.com"
+        # latest errno observation wins, even when it clears an error
+        assert int(a["errno"]) == 0
+
+    def test_rtt_max(self):
+        a = np.zeros(1, dtype=binfmt.EXTRA_REC_DTYPE)[0]
+        b = np.zeros(1, dtype=binfmt.EXTRA_REC_DTYPE)[0]
+        a["rtt_ns"], b["rtt_ns"] = 900, 300
+        acc.accumulate_extra(a, b)
+        assert int(a["rtt_ns"]) == 900
+
+    def test_network_events_dedup(self):
+        a = np.zeros(1, dtype=binfmt.NEVENTS_REC_DTYPE)[0]
+        b = np.zeros(1, dtype=binfmt.NEVENTS_REC_DTYPE)[0]
+        a["events"][0] = [1, 2, 3, 4, 5, 6, 7, 8]
+        a["n_events"] = 1
+        b["events"][0] = [1, 2, 3, 4, 5, 6, 7, 8]  # dup of a[0]
+        b["events"][1] = [9, 9, 9, 9, 9, 9, 9, 9]
+        b["packets"][:2] = 1
+        b["n_events"] = 2
+        acc.accumulate_network_events(a, b)
+        assert int(a["n_events"]) == 2
+        assert np.array_equal(a["events"][1], b["events"][1])
+
+    def test_percpu_merge(self):
+        vals = np.zeros(4, dtype=binfmt.EXTRA_REC_DTYPE)
+        vals["rtt_ns"] = [10, 40, 20, 30]
+        merged = acc.merge_percpu(vals, acc.accumulate_extra)
+        assert int(merged["rtt_ns"]) == 40
+
+
+class TestRecord:
+    def test_records_from_events_and_json(self):
+        events = np.zeros(1, dtype=binfmt.FLOW_EVENT_DTYPE)
+        clock = MonotonicClock()
+        mono_now = clock.now_pair()[0]
+        events[0] = make_event(first=mono_now - 10**9, last=mono_now)
+        recs = records_from_events(events, clock=clock, agent_ip="1.2.3.4")
+        assert len(recs) == 1
+        r = recs[0]
+        assert r.key.src == "10.0.0.1"
+        assert r.interface == "7"
+        # wall times ~now, 1s apart
+        import time
+        assert abs(r.time_flow_end_ns - time.time_ns()) < 5 * 10**9
+        assert r.time_flow_end_ns - r.time_flow_start_ns == 10**9
+        obj = r.to_json_obj()
+        assert obj["SrcAddr"] == "10.0.0.1"
+        assert obj["Bytes"] == 1500
+        assert obj["AgentIP"] == "1.2.3.4"
+
+    def test_normalized_key_symmetric(self):
+        k1 = FlowKey.make("10.0.0.1", "10.0.0.2", 10, 20, 6)
+        k2 = FlowKey.make("10.0.0.2", "10.0.0.1", 20, 10, 6)
+        assert k1.normalized() == k2.normalized()
