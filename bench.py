@@ -1,0 +1,135 @@
+"""Benchmark: sketch-ingest throughput on one TPU chip vs CPU exact aggregation.
+
+Prints ONE JSON line:
+  {"metric": "flow_records_per_sec_per_chip", "value": N, "unit": "records/s",
+   "vs_baseline": R}
+
+- value: steady-state flow records folded per second into the full sketch state
+  (Count-Min bytes+packets, top-K, HLL, per-dst HLL, 2 histograms, EWMA) on the
+  default device (the real TPU chip under the driver).
+- vs_baseline: ratio against the CPU exact-aggregation baseline measured in the
+  same process (vectorized numpy per-key aggregation — the honest stand-in for
+  the reference's Go Accounter/map-eviction path, BASELINE.md "baseline to
+  beat"; the reference publishes no absolute numbers).
+
+Run `python bench.py --check` to additionally report heavy-hitter recall vs the
+exact oracle on stderr (BASELINE acceptance bound: <1% recall loss).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 16384
+N_BATCHES_POOL = 8
+WARMUP_ITERS = 3
+TIMED_ITERS = 40
+N_DISTINCT = 50_000
+ZIPF_A = 1.2
+
+
+def make_pool(rng: np.random.Generator):
+    universe = rng.integers(0, 2**32, (N_DISTINCT, 10), dtype=np.uint32)
+    pool = []
+    for _ in range(N_BATCHES_POOL):
+        ranks = np.minimum(rng.zipf(ZIPF_A, BATCH) - 1, N_DISTINCT - 1)
+        pool.append(({
+            "keys": universe[ranks],
+            "bytes": rng.integers(64, 9000, BATCH).astype(np.float32),
+            "packets": rng.integers(1, 12, BATCH).astype(np.int32),
+            "rtt_us": rng.integers(0, 5000, BATCH).astype(np.int32),
+            "dns_latency_us": rng.integers(0, 2000, BATCH).astype(np.int32),
+            "valid": np.ones(BATCH, np.bool_),
+        }, ranks))
+    return universe, pool
+
+
+def cpu_exact_baseline(pool) -> float:
+    """Vectorized exact per-key aggregation (bytes+packets) — records/sec."""
+    # warm one pass
+    def run():
+        t0 = time.perf_counter()
+        n = 0
+        for arrays, _ in pool:
+            kb = arrays["keys"].view(
+                [("k", "u4", 10)]).ravel()  # structured view for np.unique
+            uniq, inv = np.unique(kb, return_inverse=True)
+            by = np.zeros(len(uniq), np.float64)
+            pk = np.zeros(len(uniq), np.int64)
+            np.add.at(by, inv, arrays["bytes"])
+            np.add.at(pk, inv, arrays["packets"])
+            n += len(kb)
+        return n / (time.perf_counter() - t0)
+    run()
+    return run()
+
+
+def tpu_ingest_rate(pool):
+    import jax
+
+    from netobserv_tpu.sketch import state as sk
+
+    cfg = sk.SketchConfig()  # production defaults: cm 4x65536, topk 1024
+    state = sk.init_state(cfg)
+    ingest = sk.make_ingest_fn(donate=True)
+    dev_batches = [
+        {k: jax.device_put(v) for k, v in arrays.items()} for arrays, _ in pool]
+
+    feed: list[int] = []  # exact pool indices folded into the state
+    for i in range(WARMUP_ITERS):
+        bi = i % len(dev_batches)
+        feed.append(bi)
+        state = ingest(state, dev_batches[bi])
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for i in range(TIMED_ITERS):
+        bi = i % len(dev_batches)
+        feed.append(bi)
+        state = ingest(state, dev_batches[bi])
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    return TIMED_ITERS * BATCH / dt, state, feed
+
+
+def check_recall(state, feed, universe, pool) -> float:
+    """Heavy-hitter recall of the device top-K vs the exact oracle, computed
+    over the exact batch sequence that was folded into the state."""
+    exact: dict[int, float] = {}
+    for bi in feed:
+        arrays, ranks = pool[bi]
+        np_bytes = arrays["bytes"]
+        for r, b in zip(ranks, np_bytes):
+            exact[int(r)] = exact.get(int(r), 0.0) + float(b)
+    k = 100
+    true_top = sorted(exact, key=exact.get, reverse=True)[:k]
+    got = {tuple(w) for w, v in zip(np.asarray(state.heavy.words),
+                                    np.asarray(state.heavy.valid)) if v}
+    hits = sum(tuple(universe[t]) in got for t in true_top)
+    return hits / k
+
+
+def main():
+    from netobserv_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()  # honor explicit CPU request (offline verification)
+    rng = np.random.default_rng(2026)
+    universe, pool = make_pool(rng)
+    baseline = cpu_exact_baseline(pool)
+    rate, state, feed = tpu_ingest_rate(pool)
+    if "--check" in sys.argv:
+        recall = check_recall(state, feed, universe, pool)
+        print(f"heavy-hitter recall@100 vs exact: {recall:.3f}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "flow_records_per_sec_per_chip",
+        "value": round(rate),
+        "unit": "records/s",
+        "vs_baseline": round(rate / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

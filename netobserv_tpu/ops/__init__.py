@@ -1,0 +1,12 @@
+"""TPU sketch operators (JAX).
+
+Everything here follows three TPU rules (SURVEY.md §7.3, pallas_guide.md):
+- **No dynamic shapes.** Batches are fixed-size with validity masks; tables are
+  fixed-K; histograms fixed-width.
+- **Integer lane math.** Flow keys are uint32 word vectors; hashing is murmur-style
+  multiply/rotate/xor in 32-bit lanes — never byte loops.
+- **Functional state.** Every sketch is a pytree updated by pure folds, so the whole
+  ingest step jits, donates, and shards with `shard_map`.
+"""
+
+from netobserv_tpu.ops import hashing, countmin, hll, topk, quantile, ewma  # noqa: F401

@@ -1,0 +1,119 @@
+"""Count-Min sketch: point-queryable frequency table in O(d*w) memory.
+
+The TPU replacement for exact per-key hashmap aggregation (reference:
+`pkg/flow/account.go` Accounter). Counters are a dense [depth, width] array;
+updates are masked scatter-adds over a batch, queries are gather+min. Merging two
+sketches (across chips over ICI) is elementwise `+` / `psum` — that linearity is
+why this sketch family suits SPMD (SURVEY.md §2.3 item 1).
+
+Error bound (Cormode & Muthukrishnan): with w = 2^k, depth d, a point query
+overestimates by at most eps*N with probability 1-delta, eps = e/w, delta = e^-d.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from netobserv_tpu.ops import hashing
+
+
+class CountMin(NamedTuple):
+    """Sketch state: counts[depth, width]. dtype float32 for byte volumes
+    (exact below 2^24, ~1e-7 relative above — fine for heavy-hitter ranking),
+    int32 for packet counts."""
+
+    counts: jax.Array
+
+    @property
+    def depth(self) -> int:
+        return self.counts.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.counts.shape[1]
+
+
+def init(depth: int = 4, width: int = 1 << 16, dtype=jnp.float32) -> CountMin:
+    assert width & (width - 1) == 0, "width must be a power of two"
+    return CountMin(counts=jnp.zeros((depth, width), dtype=dtype))
+
+
+def update(cm: CountMin, h1: jax.Array, h2: jax.Array, values: jax.Array,
+           valid: jax.Array) -> CountMin:
+    """Fold one batch into the sketch.
+
+    h1/h2: uint32[B] base hashes; values: [B]; valid: bool[B].
+    Duplicate keys within a batch accumulate correctly (scatter-add semantics).
+    """
+    d, w = cm.counts.shape
+    idx = hashing.row_indices(h1, h2, d, w)  # uint32[d, B]
+    vals = jnp.where(valid, values, 0).astype(cm.counts.dtype)
+    vals = jnp.broadcast_to(vals[None, :], idx.shape)
+    rows = jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32)[:, None], idx.shape)
+    new = cm.counts.at[rows, idx.astype(jnp.int32)].add(
+        vals, mode="drop", unique_indices=False)
+    return CountMin(counts=new)
+
+
+def query(cm: CountMin, h1: jax.Array, h2: jax.Array) -> jax.Array:
+    """Point-query estimated counts for keys given their base hashes."""
+    d, w = cm.counts.shape
+    idx = hashing.row_indices(h1, h2, d, w)  # [d, B]
+    rows = jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32)[:, None], idx.shape)
+    ests = cm.counts[rows, idx.astype(jnp.int32)]  # [d, B]
+    return jnp.min(ests, axis=0)
+
+
+def merge(a: CountMin, b: CountMin) -> CountMin:
+    """Linear merge — the ICI collective for this sketch is psum."""
+    return CountMin(counts=a.counts + b.counts)
+
+
+# ---------------------------------------------------------------------------
+# Width-sharded variants: the [d, W] counter array is split column-wise across
+# the `sketch` mesh axis (model-parallel sketches — SURVEY.md §2.3 mapping).
+# Each device owns counts[:, j*w_local:(j+1)*w_local]; updates mask out-of-shard
+# indices, queries psum masked partial gathers over the axis.
+# ---------------------------------------------------------------------------
+
+def update_sharded(cm_local: CountMin, h1: jax.Array, h2: jax.Array,
+                   values: jax.Array, valid: jax.Array,
+                   axis_name: str, n_shards: int) -> CountMin:
+    """Fold a batch into a width-sharded sketch (call inside shard_map)."""
+    d, w_local = cm_local.counts.shape
+    w_global = w_local * n_shards
+    shard = jax.lax.axis_index(axis_name).astype(jnp.int32)
+    idx = hashing.row_indices(h1, h2, d, w_global).astype(jnp.int32)  # [d, B]
+    local_idx = idx - shard * w_local
+    in_shard = (local_idx >= 0) & (local_idx < w_local)
+    vals = jnp.where(valid, values, 0).astype(cm_local.counts.dtype)
+    vals = jnp.where(in_shard, vals[None, :], 0)
+    rows = jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32)[:, None], idx.shape)
+    new = cm_local.counts.at[rows, jnp.clip(local_idx, 0, w_local - 1)].add(
+        vals, mode="drop", unique_indices=False)
+    return CountMin(counts=new)
+
+
+def query_sharded(cm_local: CountMin, h1: jax.Array, h2: jax.Array,
+                  axis_name: str, n_shards: int) -> jax.Array:
+    """Point query against a width-sharded sketch (call inside shard_map)."""
+    d, w_local = cm_local.counts.shape
+    w_global = w_local * n_shards
+    shard = jax.lax.axis_index(axis_name).astype(jnp.int32)
+    idx = hashing.row_indices(h1, h2, d, w_global).astype(jnp.int32)
+    local_idx = idx - shard * w_local
+    in_shard = (local_idx >= 0) & (local_idx < w_local)
+    rows = jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32)[:, None], idx.shape)
+    part = jnp.where(in_shard,
+                     cm_local.counts[rows, jnp.clip(local_idx, 0, w_local - 1)],
+                     0)
+    ests = jax.lax.psum(part, axis_name)  # exactly one shard owns each index
+    return jnp.min(ests, axis=0)
+
+
+def total(cm: CountMin) -> jax.Array:
+    """Total inserted mass (any single row sums to N)."""
+    return jnp.sum(cm.counts[0])

@@ -1,0 +1,70 @@
+"""Streaming EWMA anomaly / DDoS scoring over hashed destination buckets.
+
+BASELINE.json config 5: "Streaming EWMA anomaly/DDoS score over merged sketches".
+Per destination-hash bucket we accumulate the current window's byte/packet rate,
+then at each window roll compute a z-score against an exponentially weighted
+mean/variance and decay the baselines. Buckets whose z-score exceeds a threshold
+are DDoS suspects; the top-K table maps hot buckets back to concrete keys.
+
+State is three float32[m] arrays; the cross-chip merge for `rate` is psum (rates
+are additive), baselines are replicated and updated identically on every chip.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class EWMA(NamedTuple):
+    mean: jax.Array     # f32[m] — EW mean of per-window rates
+    var: jax.Array      # f32[m] — EW variance
+    rate: jax.Array     # f32[m] — current-window accumulator
+    windows: jax.Array  # i32[] — number of completed windows
+
+
+def init(buckets: int = 4096) -> EWMA:
+    assert buckets & (buckets - 1) == 0
+    return EWMA(
+        mean=jnp.zeros((buckets,), jnp.float32),
+        var=jnp.zeros((buckets,), jnp.float32),
+        rate=jnp.zeros((buckets,), jnp.float32),
+        windows=jnp.zeros((), jnp.int32),
+    )
+
+
+def accumulate(s: EWMA, dst_h: jax.Array, values: jax.Array,
+               valid: jax.Array) -> EWMA:
+    """Add one batch's mass into the current window, bucketed by dst hash."""
+    m = s.rate.shape[0]
+    idx = (dst_h & jnp.uint32(m - 1)).astype(jnp.int32)
+    vals = jnp.where(valid, values, 0).astype(jnp.float32)
+    return s._replace(rate=s.rate.at[idx].add(vals, mode="drop"))
+
+
+def roll(s: EWMA, alpha: float = 0.3) -> tuple[EWMA, jax.Array]:
+    """Close the window: return (new_state, z_scores[m]) and reset rates.
+
+    Warmup: the first two windows only seed the baseline (scores stay zero).
+    The variance floor is proportional to the mean so a bucket with a tiny but
+    noisy baseline doesn't alarm on ordinary jitter.
+    """
+    first = s.windows == 0
+    warming = s.windows < 2
+    diff = s.rate - s.mean
+    floor = (0.05 * s.mean) ** 2 + 1.0
+    z = diff / jnp.sqrt(s.var + floor)
+    z = jnp.where(warming, 0.0, z)
+    new_mean = jnp.where(first, s.rate, (1 - alpha) * s.mean + alpha * s.rate)
+    new_var = jnp.where(first, jnp.zeros_like(s.var),
+                        (1 - alpha) * (s.var + alpha * diff * diff))
+    return EWMA(mean=new_mean, var=new_var,
+                rate=jnp.zeros_like(s.rate),
+                windows=s.windows + 1), z
+
+
+def suspects(z: jax.Array, threshold: float = 6.0) -> jax.Array:
+    """Boolean mask of anomalous buckets."""
+    return z > threshold

@@ -1,0 +1,72 @@
+"""Vectorized flow-key hashing in uint32 lanes.
+
+Murmur3-style mixing (multiply/rotate/xor) over the packed KEY_WORDS uint32 words
+of each flow key, fully unrolled (word count is static), batched over the leading
+axis. Double hashing (Kirsch–Mitzenmacher) derives the d Count-Min row indices
+from two base hashes, so each batch is hashed exactly twice regardless of depth.
+
+Replaces the reference's per-record Go map hashing + FNV (implicit in Go's
+runtime map, `pkg/flow/account.go:204-246`) with VPU-friendly lane math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+_M5 = jnp.uint32(5)
+_N1 = jnp.uint32(0xE6546B64)
+_F1 = jnp.uint32(0x85EBCA6B)
+_F2 = jnp.uint32(0xC2B2AE35)
+
+
+def _rotl32(x: jax.Array, r: int) -> jax.Array:
+    return (x << r) | (x >> (32 - r))
+
+
+def fmix32(h: jax.Array) -> jax.Array:
+    """Murmur3 finalizer: full avalanche on a uint32 lane."""
+    h = h ^ (h >> 16)
+    h = h * _F1
+    h = h ^ (h >> 13)
+    h = h * _F2
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash_words(words: jax.Array, seed: int | jax.Array) -> jax.Array:
+    """Hash packed key words -> uint32.
+
+    words: uint32[..., W] (W static, typically KEY_WORDS=10)
+    seed:  scalar (python int or uint32 array)
+    returns uint32[...]
+    """
+    words = words.astype(jnp.uint32)
+    w = words.shape[-1]
+    h = jnp.broadcast_to(jnp.asarray(seed, dtype=jnp.uint32), words.shape[:-1])
+    for i in range(w):  # static unroll
+        k = words[..., i] * _C1
+        k = _rotl32(k, 15) * _C2
+        h = h ^ k
+        h = _rotl32(h, 13) * _M5 + _N1
+    h = h ^ jnp.uint32(w * 4)
+    return fmix32(h)
+
+
+def base_hashes(words: jax.Array, seed: int = 0) -> tuple[jax.Array, jax.Array]:
+    """Two independent base hashes (h2 forced odd so strides generate Z_{2^k})."""
+    h1 = hash_words(words, jnp.uint32(0x9747B28C) ^ jnp.uint32(seed))
+    h2 = hash_words(words, jnp.uint32(0x5BD1E995) ^ jnp.uint32(seed))
+    return h1, h2 | jnp.uint32(1)
+
+
+def row_indices(h1: jax.Array, h2: jax.Array, depth: int, width: int) -> jax.Array:
+    """Kirsch–Mitzenmacher: index for row i is (h1 + i*h2) mod width.
+
+    width must be a power of two. Returns uint32[depth, ...].
+    """
+    assert width & (width - 1) == 0, "width must be a power of two"
+    rows = jnp.arange(depth, dtype=jnp.uint32).reshape((depth,) + (1,) * h1.ndim)
+    return (h1[None] + rows * h2[None]) & jnp.uint32(width - 1)

@@ -1,0 +1,95 @@
+"""HyperLogLog cardinality sketches, flat and per-destination-bucket.
+
+BASELINE.json config 3: "HyperLogLog distinct-src-IP-per-dst cardinality sketch,
+ICI-merged across 4 chips". Registers are int32 (TPU-friendly; int8 would save
+memory but costs sublane packing); merge is elementwise max, i.e. `pmax` over ICI.
+
+Register index comes from h1's low p bits, the rank from the leading zeros of an
+independent h2 (`lax.clz`) — no byte-wise processing anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class HLL(NamedTuple):
+    regs: jax.Array  # int32[m] — m = 2^precision
+
+    @property
+    def precision(self) -> int:
+        return int(self.regs.shape[-1]).bit_length() - 1
+
+
+class PerDstHLL(NamedTuple):
+    """D independent small HLLs, one per destination hash bucket."""
+
+    regs: jax.Array  # int32[D, m]
+
+
+def init(precision: int = 14) -> HLL:
+    return HLL(regs=jnp.zeros((1 << precision,), dtype=jnp.int32))
+
+
+def init_per_dst(dst_buckets: int = 4096, precision: int = 6) -> PerDstHLL:
+    assert dst_buckets & (dst_buckets - 1) == 0
+    return PerDstHLL(regs=jnp.zeros((dst_buckets, 1 << precision), dtype=jnp.int32))
+
+
+def _rank(h2: jax.Array) -> jax.Array:
+    """Leading-zero rank in [1, 33] of an independent uniform 32-bit hash."""
+    return jax.lax.clz(h2.astype(jnp.int32)) + 1
+
+
+def update(hll: HLL, h1: jax.Array, h2: jax.Array, valid: jax.Array) -> HLL:
+    m = hll.regs.shape[0]
+    idx = (h1 & jnp.uint32(m - 1)).astype(jnp.int32)
+    rank = jnp.where(valid, _rank(h2), 0)
+    return HLL(regs=hll.regs.at[idx].max(rank, mode="drop"))
+
+
+def update_per_dst(s: PerDstHLL, dst_h: jax.Array, src_h1: jax.Array,
+                   src_h2: jax.Array, valid: jax.Array) -> PerDstHLL:
+    """Fold (dst, src) pairs: register (dst_bucket, src_reg) <- max rank."""
+    dbuckets, m = s.regs.shape
+    di = (dst_h & jnp.uint32(dbuckets - 1)).astype(jnp.int32)
+    ri = (src_h1 & jnp.uint32(m - 1)).astype(jnp.int32)
+    rank = jnp.where(valid, _rank(src_h2), 0)
+    return PerDstHLL(regs=s.regs.at[di, ri].max(rank, mode="drop"))
+
+
+def _alpha(m: int) -> float:
+    if m <= 16:
+        return 0.673
+    if m <= 32:
+        return 0.697
+    if m <= 64:
+        return 0.709
+    return 0.7213 / (1.0 + 1.079 / m)
+
+
+def estimate(regs: jax.Array) -> jax.Array:
+    """Cardinality estimate with small/large-range corrections (Flajolet et al.).
+
+    regs: int32[..., m]; returns float32[...] — works for flat and per-dst.
+    """
+    m = regs.shape[-1]
+    harm = jnp.sum(jnp.exp2(-regs.astype(jnp.float32)), axis=-1)
+    raw = _alpha(m) * m * m / harm
+    zeros = jnp.sum((regs == 0).astype(jnp.float32), axis=-1)
+    # linear counting below the 2.5m threshold when empty registers remain
+    lin = m * jnp.log(jnp.where(zeros > 0, m / jnp.maximum(zeros, 1e-9), 1.0))
+    est = jnp.where((raw <= 2.5 * m) & (zeros > 0), lin, raw)
+    # large-range correction for 32-bit hashes
+    two32 = jnp.float32(2.0**32)
+    est = jnp.where(est > two32 / 30.0,
+                    -two32 * jnp.log1p(-est / two32), est)
+    return est
+
+
+def merge_regs(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Merge = elementwise max — the ICI collective for HLL is pmax."""
+    return jnp.maximum(a, b)

@@ -1,0 +1,77 @@
+"""Log-bucketed latency histograms with quantile queries (DDSketch-flavored).
+
+BASELINE.json config 4: "RTT-histogram + DNS-latency quantile sketch". Buckets are
+log-gamma spaced, so any quantile estimate has bounded *relative* error
+(gamma = 1.02 -> ~1%); updates are masked scatter-adds; merge is `+`/psum, same
+collective as Count-Min.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_GAMMA = 1.02
+DEFAULT_BUCKETS = 1024
+DEFAULT_MAX_VALUE = 10_000_000  # 10 s in microseconds
+
+
+def gamma_for(n_buckets: int, max_value: float = DEFAULT_MAX_VALUE) -> float:
+    """Gamma such that `max_value` still lands below the clip bucket.
+
+    With fewer buckets the spacing coarsens (worse relative error) instead of
+    silently saturating the range."""
+    return float(math.exp(math.log(max_value) / max(n_buckets - 2, 1)))
+
+
+class LogHist(NamedTuple):
+    counts: jax.Array  # int32[n_buckets]; bucket 0 holds zero-valued samples
+
+    @property
+    def n_buckets(self) -> int:
+        return self.counts.shape[0]
+
+
+def init(n_buckets: int = DEFAULT_BUCKETS) -> LogHist:
+    return LogHist(counts=jnp.zeros((n_buckets,), dtype=jnp.int32))
+
+
+def bucket_of(values: jax.Array, n_buckets: int,
+              gamma: float = DEFAULT_GAMMA) -> jax.Array:
+    """Bucket index for non-negative integer samples (e.g. microseconds)."""
+    v = values.astype(jnp.float32)
+    b = jnp.ceil(jnp.log(jnp.maximum(v, 1.0)) / math.log(gamma)).astype(jnp.int32)
+    b = jnp.clip(b + 1, 1, n_buckets - 1)  # shift: bucket 0 reserved for v == 0
+    return jnp.where(values == 0, 0, b)
+
+
+def update(h: LogHist, values: jax.Array, valid: jax.Array,
+           gamma: float = DEFAULT_GAMMA) -> LogHist:
+    idx = bucket_of(values, h.n_buckets, gamma)
+    inc = valid.astype(jnp.int32)
+    return LogHist(counts=h.counts.at[idx].add(inc, mode="drop"))
+
+
+def bucket_value(bucket: jax.Array, gamma: float = DEFAULT_GAMMA) -> jax.Array:
+    """Representative value of a bucket (midpoint estimator: 2*g^b/(g+1))."""
+    b = bucket.astype(jnp.float32) - 1.0  # undo the zero-reservation shift
+    val = 2.0 * jnp.power(gamma, b) / (gamma + 1.0)
+    return jnp.where(bucket == 0, 0.0, val)
+
+
+def quantile(h: LogHist, qs: jax.Array, gamma: float = DEFAULT_GAMMA) -> jax.Array:
+    """Estimate quantiles qs in [0,1]. Returns float32[len(qs)] sample values."""
+    c = jnp.cumsum(h.counts)
+    n = c[-1]
+    targets = jnp.ceil(qs * jnp.maximum(n, 1).astype(jnp.float32)).astype(jnp.int32)
+    targets = jnp.maximum(targets, 1)
+    buckets = jnp.searchsorted(c, targets, side="left")
+    vals = bucket_value(buckets, gamma)
+    return jnp.where(n > 0, vals, 0.0)  # empty histogram -> 0, not max bucket
+
+
+def merge(a: LogHist, b: LogHist) -> LogHist:
+    return LogHist(counts=a.counts + b.counts)

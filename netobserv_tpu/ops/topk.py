@@ -1,0 +1,100 @@
+"""Fixed-K heavy-hitter table maintained entirely on device.
+
+The CM+candidate-set approach (cf. SpaceSaving / "CM + heap" from the sketch
+literature, PAPERS.md top-K): after the Count-Min fold, every batch key is a
+candidate; candidates and the current table are re-scored by CM point query,
+deduplicated with a lexicographic `lax.sort` on their (h1, h2) identity, and the
+top K survive via `lax.top_k`. Everything is fixed-shape — no heaps, no dynamic
+growth — so it jits and shards cleanly (reference analog being replaced: the Go
+map in `pkg/flow/account.go`).
+
+Key identity here is the (h1, h2) 64-bit pair; the full 40-byte key words ride
+along through gathers so results can be rendered exactly. A cross-key (h1, h2)
+collision is ~2^-64 per pair — negligible at flow scale.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from netobserv_tpu.ops import countmin
+
+
+class TopK(NamedTuple):
+    words: jax.Array   # uint32[K, W] — packed key material
+    h1: jax.Array      # uint32[K]
+    h2: jax.Array      # uint32[K]
+    counts: jax.Array  # float32[K] — CM-estimated totals, -1 for empty slots
+    valid: jax.Array   # bool[K]
+
+    @property
+    def k(self) -> int:
+        return self.words.shape[0]
+
+
+def init(k: int = 1024, key_words: int = 10) -> TopK:
+    return TopK(
+        words=jnp.zeros((k, key_words), dtype=jnp.uint32),
+        h1=jnp.zeros((k,), dtype=jnp.uint32),
+        h2=jnp.zeros((k,), dtype=jnp.uint32),
+        counts=jnp.full((k,), -1.0, dtype=jnp.float32),
+        valid=jnp.zeros((k,), dtype=jnp.bool_),
+    )
+
+
+def _select(words, h1, h2, est, k: int) -> TopK:
+    """Dedup by (h1, h2) identity and keep the top-k by est (invalid est = -1)."""
+    n = h1.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    s_h1, s_h2, s_idx = jax.lax.sort((h1, h2, idx), num_keys=2)
+    s_est = est[s_idx]
+    first = jnp.concatenate([
+        jnp.ones((1,), dtype=jnp.bool_),
+        (s_h1[1:] != s_h1[:-1]) | (s_h2[1:] != s_h2[:-1]),
+    ])
+    s_est = jnp.where(first, s_est, -1.0)
+    top_est, top_pos = jax.lax.top_k(s_est, k)
+    orig = s_idx[top_pos]
+    sel_valid = top_est > 0
+    return TopK(
+        words=jnp.where(sel_valid[:, None], words[orig], 0),
+        h1=jnp.where(sel_valid, s_h1[top_pos], 0),
+        h2=jnp.where(sel_valid, s_h2[top_pos], 0),
+        counts=jnp.where(sel_valid, top_est, -1.0),
+        valid=sel_valid,
+    )
+
+
+def update(table: TopK, cm: countmin.CountMin, words: jax.Array, h1: jax.Array,
+           h2: jax.Array, valid: jax.Array, query_fn=None) -> TopK:
+    """Fold one batch (whose mass is already in `cm`) into the table.
+
+    `query_fn(h1, h2) -> est` overrides the plain CM point query (used for
+    width-sharded sketches, where the query needs a psum over the sketch axis).
+    """
+    if query_fn is None:
+        query_fn = lambda a, b: countmin.query(cm, a, b)  # noqa: E731
+    batch_est = jnp.where(valid, query_fn(h1, h2), -1.0)
+    table_est = jnp.where(table.valid,
+                          query_fn(table.h1, table.h2), -1.0)
+    all_words = jnp.concatenate([table.words, words], axis=0)
+    all_h1 = jnp.concatenate([table.h1, h1])
+    all_h2 = jnp.concatenate([table.h2, h2])
+    all_est = jnp.concatenate([table_est, batch_est])
+    return _select(all_words, all_h1, all_h2, all_est, table.k)
+
+
+def merge_stacked(stacked: TopK, cm_merged: countmin.CountMin, k: int,
+                  query_fn=None) -> TopK:
+    """Merge per-device tables stacked along axis 0 into one size-k table.
+
+    stacked arrays have shape [n_dev * K, ...]. Counts are re-queried against
+    the merged CM so the selection reflects cluster-wide mass (SURVEY.md §5.8:
+    "allgather + re-select top-K over ICI")."""
+    if query_fn is None:
+        query_fn = lambda a, b: countmin.query(cm_merged, a, b)  # noqa: E731
+    est = jnp.where(stacked.valid, query_fn(stacked.h1, stacked.h2), -1.0)
+    return _select(stacked.words, stacked.h1, stacked.h2, est, k)

@@ -1,0 +1,21 @@
+"""Multi-chip SPMD for sketch state (the distributed communication backend).
+
+The reference scales out with per-CPU kernel maps merged in userspace, one agent
+per node, and gRPC/Kafka to a collector tier (SURVEY.md §2.3). Here the same
+roles map onto the TPU stack:
+
+- per-CPU partial maps      -> per-device partial sketches (batch sharded on the
+                               `data` mesh axis, folded locally, no collectives)
+- userspace eviction merge  -> ICI collectives at window roll: psum (Count-Min,
+                               histograms, EWMA rates), max (HLL registers),
+                               all_gather + re-select (top-K)
+- DaemonSet-per-node        -> one process per TPU host, same SPMD program,
+                               DCN handled by jax.distributed
+- memory scale-out          -> optional `sketch` mesh axis sharding the Count-Min
+                               width across devices (model-parallel sketches)
+"""
+
+from netobserv_tpu.parallel.mesh import make_mesh, MeshSpec  # noqa: F401
+from netobserv_tpu.parallel.merge import (  # noqa: F401
+    make_sharded_ingest_fn, merge_states, make_merge_fn,
+)

@@ -1,0 +1,201 @@
+"""Sharded sketch ingest + ICI window merge (shard_map over the device mesh).
+
+Layout of the distributed state (`DistState` = SketchState pytree with a leading
+`data`-axis dimension on every array):
+
+- every leaf:               [n_data, ...]  sharded P("data") — per-device partials
+- Count-Min counts:         [n_data, depth, width] sharded P("data", None, "sketch")
+                            — width additionally split across the `sketch` axis
+- EWMA mean/var:            identical across the data axis (baselines are global;
+                            only `rate` is a true partial)
+
+Steady state does **zero collectives**: each device folds its batch shard into
+its partial (the per-CPU-map analog, SURVEY.md §2.3 item 1). All communication
+happens at window roll: psum for linear sketches, max for HLL registers,
+all_gather + re-select for the top-K table — the ICI merge the north star asks
+for (BASELINE.json config 3).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from netobserv_tpu.ops import countmin, ewma, hll, quantile, topk
+from netobserv_tpu.parallel.mesh import DATA_AXIS, SKETCH_AXIS
+from netobserv_tpu.sketch import state as sk
+
+# ---------------------------------------------------------------------------
+# sharding specs
+# ---------------------------------------------------------------------------
+
+
+def _state_specs(state: sk.SketchState) -> sk.SketchState:
+    """PartitionSpec tree for the distributed state (leading data axis added;
+    Count-Min width additionally split over the sketch axis)."""
+    d = P(DATA_AXIS)
+    return sk.SketchState(
+        cm_bytes=countmin.CountMin(counts=P(DATA_AXIS, None, SKETCH_AXIS)),
+        cm_pkts=countmin.CountMin(counts=P(DATA_AXIS, None, SKETCH_AXIS)),
+        heavy=topk.TopK(words=d, h1=d, h2=d, counts=d, valid=d),
+        hll_src=hll.HLL(regs=d),
+        hll_per_dst=hll.PerDstHLL(regs=d),
+        hist_rtt=quantile.LogHist(counts=d),
+        hist_dns=quantile.LogHist(counts=d),
+        ddos=ewma.EWMA(mean=d, var=d, rate=d, windows=d),
+        total_records=d, total_bytes=d, window=d,
+    )
+
+
+def _batch_specs(arrays: dict) -> dict:
+    return {k: P(DATA_AXIS) for k in arrays}
+
+
+def init_dist_state(cfg: sk.SketchConfig, mesh: Mesh) -> sk.SketchState:
+    """Per-device partial sketch state, zeros, laid out across the mesh."""
+    ndata = mesh.shape[DATA_AXIS]
+    template = sk.init_state(cfg)
+    specs = _state_specs(template)
+
+    def place(leaf, spec):
+        arr = np.zeros((ndata,) + leaf.shape, dtype=leaf.dtype)
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, template, specs)
+
+
+def shard_batch(mesh: Mesh, arrays: dict[str, np.ndarray]) -> dict[str, jax.Array]:
+    """Place a global columnar batch (leading dim divisible by n_data) onto the
+    mesh, split along the data axis and replicated along the sketch axis."""
+    out = {}
+    for k, v in arrays.items():
+        out[k] = jax.device_put(v, NamedSharding(mesh, P(DATA_AXIS)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sharded ingest (no collectives)
+# ---------------------------------------------------------------------------
+
+
+def make_sharded_ingest_fn(mesh: Mesh, cfg: sk.SketchConfig,
+                           donate: bool = True) -> Callable:
+    """Jitted `(dist_state, batch_arrays) -> dist_state` over the mesh."""
+    nsk = mesh.shape[SKETCH_AXIS]
+    template = sk.init_state(cfg)
+    specs = _state_specs(template)
+
+    def local_step(pstate: sk.SketchState, arrays: dict) -> sk.SketchState:
+        s = jax.tree.map(lambda x: x[0], pstate)  # drop the data-axis dim
+        s = sk.ingest(s, arrays,
+                      sketch_axis=SKETCH_AXIS if nsk > 1 else None,
+                      sketch_shards=nsk)
+        return jax.tree.map(lambda x: x[None], s)
+
+    shmapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, _batch_specs({"keys": 0, "bytes": 0, "packets": 0,
+                                       "rtt_us": 0, "dns_latency_us": 0,
+                                       "valid": 0})),
+        out_specs=specs,
+        check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=(0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# window roll: merge partials over ICI, emit a replicated report, reset
+# ---------------------------------------------------------------------------
+
+
+def merge_states(s: sk.SketchState, nsk: int) -> sk.SketchState:
+    """Merge per-device partials into a replicated view (call inside shard_map;
+    arrays here are local slices without the data-axis dim)."""
+    cm_b = countmin.CountMin(jax.lax.psum(s.cm_bytes.counts, DATA_AXIS))
+    cm_p = countmin.CountMin(jax.lax.psum(s.cm_pkts.counts, DATA_AXIS))
+    stacked = topk.TopK(
+        words=jax.lax.all_gather(s.heavy.words, DATA_AXIS, axis=0, tiled=True),
+        h1=jax.lax.all_gather(s.heavy.h1, DATA_AXIS, axis=0, tiled=True),
+        h2=jax.lax.all_gather(s.heavy.h2, DATA_AXIS, axis=0, tiled=True),
+        counts=jax.lax.all_gather(s.heavy.counts, DATA_AXIS, axis=0, tiled=True),
+        valid=jax.lax.all_gather(s.heavy.valid, DATA_AXIS, axis=0, tiled=True),
+    )
+    if nsk > 1:
+        qfn = lambda a, b: countmin.query_sharded(  # noqa: E731
+            cm_b, a, b, SKETCH_AXIS, nsk)
+    else:
+        qfn = None
+    heavy = topk.merge_stacked(stacked, cm_b, s.heavy.k, query_fn=qfn)
+    return sk.SketchState(
+        cm_bytes=cm_b, cm_pkts=cm_p, heavy=heavy,
+        hll_src=hll.HLL(jax.lax.pmax(s.hll_src.regs, DATA_AXIS)),
+        hll_per_dst=hll.PerDstHLL(jax.lax.pmax(s.hll_per_dst.regs, DATA_AXIS)),
+        hist_rtt=quantile.LogHist(jax.lax.psum(s.hist_rtt.counts, DATA_AXIS)),
+        hist_dns=quantile.LogHist(jax.lax.psum(s.hist_dns.counts, DATA_AXIS)),
+        ddos=ewma.EWMA(mean=s.ddos.mean, var=s.ddos.var,
+                       rate=jax.lax.psum(s.ddos.rate, DATA_AXIS),
+                       windows=s.ddos.windows),
+        total_records=jax.lax.psum(s.total_records, DATA_AXIS),
+        total_bytes=jax.lax.psum(s.total_bytes, DATA_AXIS),
+        window=s.window,
+    )
+
+
+def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
+                  reset_sketches: bool = True) -> Callable:
+    """Jitted `(dist_state) -> (dist_state, WindowReport)`.
+
+    The report is fully replicated (every device computes the cluster-wide
+    merge); the returned state is reset for the next window with EWMA baselines
+    rolled on the merged rates.
+    """
+    nsk = mesh.shape[SKETCH_AXIS]
+    template = sk.init_state(cfg)
+    specs = _state_specs(template)
+
+    report_specs = sk.WindowReport(
+        heavy=topk.TopK(words=P(), h1=P(), h2=P(), counts=P(), valid=P()),
+        distinct_src=P(), per_dst_cardinality=P(), rtt_quantiles_us=P(),
+        dns_quantiles_us=P(), ddos_z=P(), total_records=P(), total_bytes=P(),
+        window=P(),
+    )
+
+    def local_roll(pstate: sk.SketchState):
+        s = jax.tree.map(lambda x: x[0], pstate)
+        merged = merge_states(s, nsk)
+        ddos_state, z = ewma.roll(merged.ddos, cfg.ewma_alpha)
+        gamma = quantile.gamma_for(merged.hist_rtt.n_buckets)
+        report = sk.WindowReport(
+            heavy=merged.heavy,
+            distinct_src=hll.estimate(merged.hll_src.regs),
+            per_dst_cardinality=hll.estimate(merged.hll_per_dst.regs),
+            rtt_quantiles_us=quantile.quantile(merged.hist_rtt,
+                                               jnp.asarray(sk.QS), gamma),
+            dns_quantiles_us=quantile.quantile(merged.hist_dns,
+                                               jnp.asarray(sk.QS), gamma),
+            ddos_z=z,
+            total_records=merged.total_records,
+            total_bytes=merged.total_bytes,
+            window=merged.window,
+        )
+        if reset_sketches:
+            fresh = jax.tree.map(jnp.zeros_like, s)
+            new = fresh._replace(
+                heavy=topk.init(s.heavy.k, s.heavy.words.shape[-1]),
+                ddos=ddos_state._replace(rate=jnp.zeros_like(s.ddos.rate)),
+                window=s.window + 1,
+            )
+        else:
+            new = s._replace(ddos=ddos_state, window=s.window + 1)
+        return jax.tree.map(lambda x: x[None], new), report
+
+    shmapped = jax.shard_map(
+        local_roll, mesh=mesh, in_specs=(specs,),
+        out_specs=(specs, report_specs), check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=(0,))

@@ -1,0 +1,11 @@
+"""The TPU analytics plane: streaming sketch state + ingest/window pipeline.
+
+This package replaces the reference's CPU eviction→aggregation→export hot loop
+(`pkg/flow/tracer_map.go:103-146`, `pkg/flow/account.go:204-270` — its
+acknowledged hottest path) with constant-size sketch state folded on-device.
+"""
+
+from netobserv_tpu.sketch.state import (  # noqa: F401
+    SketchConfig, SketchState, init_state, ingest, make_ingest_fn,
+    batch_to_device, roll_window,
+)

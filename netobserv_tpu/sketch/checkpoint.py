@@ -1,0 +1,61 @@
+"""Sketch-state checkpoint/restore.
+
+The reference is stateless across restarts (flows are a lossy stream; the only
+persistence is bpfman-pinned kernel maps, SURVEY.md §5.4). Sketches are
+long-lived accumulators, so the rebuild adds real checkpointing: the whole
+SketchState pytree (single-device or distributed) is saved with orbax and
+restored with the same sharding layout.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+    HAVE_ORBAX = True
+except Exception:  # pragma: no cover - orbax is baked into the image
+    HAVE_ORBAX = False
+
+
+class SketchCheckpointer:
+    """Versioned checkpoints of a sketch-state pytree under `directory`."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        if not HAVE_ORBAX:
+            raise RuntimeError("orbax is not available")
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, template: Any, step: Optional[int] = None) -> Any:
+        """Restore into the shardings/dtypes of `template` (an abstract or
+        concrete state pytree laid out as desired)."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self._dir}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=getattr(x, "sharding", None)),
+            template)
+        return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()

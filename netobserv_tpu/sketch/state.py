@@ -1,0 +1,194 @@
+"""Combined sketch state and the jittable ingest step — the framework's
+"flagship model".
+
+One `ingest` call folds a fixed-shape columnar flow batch into:
+- Count-Min (bytes, float32) + Count-Min (packets, int32) over the 5-tuple,
+- a top-K heavy-hitter table scored by CM byte estimates,
+- a global distinct-source HyperLogLog and a per-destination-bucket HLL grid,
+- RTT and DNS-latency log-histograms,
+- an EWMA DDoS accumulator over destination buckets.
+
+The streaming-chunk design is the long-context answer for this domain
+(SURVEY.md §5.7): state is constant-size in stream length; batches are the
+"sequence chunks"; time is windowed by `roll_window`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from netobserv_tpu.model.columnar import KEY_WORDS, FlowBatch
+from netobserv_tpu.ops import countmin, ewma, hashing, hll, quantile, topk
+
+
+class SketchConfig(NamedTuple):
+    cm_depth: int = 4
+    cm_width: int = 1 << 16
+    hll_precision: int = 14
+    perdst_buckets: int = 4096
+    perdst_precision: int = 6
+    topk: int = 1024
+    hist_buckets: int = 1024
+    ewma_buckets: int = 4096
+    ewma_alpha: float = 0.3
+
+    @classmethod
+    def from_agent_config(cls, cfg) -> "SketchConfig":
+        return cls(cm_depth=cfg.sketch_cm_depth, cm_width=cfg.sketch_cm_width,
+                   hll_precision=cfg.sketch_hll_precision, topk=cfg.sketch_topk,
+                   ewma_alpha=cfg.sketch_ewma_alpha)
+
+
+class SketchState(NamedTuple):
+    cm_bytes: countmin.CountMin
+    cm_pkts: countmin.CountMin
+    heavy: topk.TopK
+    hll_src: hll.HLL
+    hll_per_dst: hll.PerDstHLL
+    hist_rtt: quantile.LogHist
+    hist_dns: quantile.LogHist
+    ddos: ewma.EWMA
+    total_records: jax.Array  # f32[] — window totals
+    total_bytes: jax.Array    # f32[]
+    window: jax.Array         # i32[]
+
+
+class WindowReport(NamedTuple):
+    """Snapshot emitted at each window roll (still on device until pulled)."""
+
+    heavy: topk.TopK
+    distinct_src: jax.Array        # f32[] global cardinality estimate
+    per_dst_cardinality: jax.Array  # f32[D]
+    rtt_quantiles_us: jax.Array    # f32[5] for q = .5 .9 .95 .99 .999
+    dns_quantiles_us: jax.Array    # f32[5]
+    ddos_z: jax.Array              # f32[m] z-score per dst bucket
+    total_records: jax.Array
+    total_bytes: jax.Array
+    window: jax.Array
+
+
+QS = np.array([0.5, 0.9, 0.95, 0.99, 0.999], dtype=np.float32)
+
+
+def init_state(cfg: SketchConfig = SketchConfig()) -> SketchState:
+    return SketchState(
+        cm_bytes=countmin.init(cfg.cm_depth, cfg.cm_width, jnp.float32),
+        cm_pkts=countmin.init(cfg.cm_depth, cfg.cm_width, jnp.int32),
+        heavy=topk.init(cfg.topk, KEY_WORDS),
+        hll_src=hll.init(cfg.hll_precision),
+        hll_per_dst=hll.init_per_dst(cfg.perdst_buckets, cfg.perdst_precision),
+        hist_rtt=quantile.init(cfg.hist_buckets),
+        hist_dns=quantile.init(cfg.hist_buckets),
+        ddos=ewma.init(cfg.ewma_buckets),
+        total_records=jnp.zeros((), jnp.float32),
+        total_bytes=jnp.zeros((), jnp.float32),
+        window=jnp.zeros((), jnp.int32),
+    )
+
+
+def batch_to_device(batch: FlowBatch) -> dict[str, np.ndarray]:
+    """Convert a host FlowBatch into the dtype-stable array dict the jitted
+    ingest expects (bytes to float32 — u64 is unavailable without x64; sketch
+    counters are float anyway)."""
+    return {
+        "keys": batch.keys.astype(np.uint32),
+        "bytes": batch.bytes.astype(np.float32),
+        "packets": batch.packets.astype(np.int32),
+        "rtt_us": batch.rtt_us.astype(np.int32),
+        "dns_latency_us": batch.dns_latency_us.astype(np.int32),
+        "valid": batch.valid.astype(np.bool_),
+    }
+
+
+def ingest(state: SketchState, arrays: dict[str, jax.Array],
+           sketch_axis: str | None = None, sketch_shards: int = 1) -> SketchState:
+    """Fold one batch into all sketches. Pure; jit with donate_argnums=0.
+
+    When `sketch_axis` is set (inside shard_map over a 2D mesh), the Count-Min
+    arrays are width-sharded across that axis: updates mask out-of-shard
+    columns, queries psum partial gathers (model-parallel sketches).
+    """
+    words = arrays["keys"]
+    valid = arrays["valid"]
+    bytes_f = arrays["bytes"]
+    pkts = arrays["packets"]
+
+    h1, h2 = hashing.base_hashes(words)
+    src_h1, src_h2 = hashing.base_hashes(words[:, 0:4], seed=0x0517)
+    dst_h1, _ = hashing.base_hashes(words[:, 4:8], seed=0x0D57)
+
+    if sketch_axis is None:
+        cm_b = countmin.update(state.cm_bytes, h1, h2, bytes_f, valid)
+        cm_p = countmin.update(state.cm_pkts, h1, h2, pkts, valid)
+        query_fn = None
+    else:
+        cm_b = countmin.update_sharded(state.cm_bytes, h1, h2, bytes_f, valid,
+                                       sketch_axis, sketch_shards)
+        cm_p = countmin.update_sharded(state.cm_pkts, h1, h2, pkts, valid,
+                                       sketch_axis, sketch_shards)
+        query_fn = lambda a, b: countmin.query_sharded(  # noqa: E731
+            cm_b, a, b, sketch_axis, sketch_shards)
+    heavy = topk.update(state.heavy, cm_b, words, h1, h2, valid,
+                        query_fn=query_fn)
+    hll_src = hll.update(state.hll_src, src_h1, src_h2, valid)
+    per_dst = hll.update_per_dst(state.hll_per_dst, dst_h1, src_h1, src_h2, valid)
+    rtt = arrays["rtt_us"]
+    dns = arrays["dns_latency_us"]
+    gamma = quantile.gamma_for(state.hist_rtt.n_buckets)
+    hist_rtt = quantile.update(state.hist_rtt, rtt, valid & (rtt > 0), gamma)
+    hist_dns = quantile.update(state.hist_dns, dns, valid & (dns > 0), gamma)
+    ddos = ewma.accumulate(state.ddos, dst_h1, bytes_f, valid)
+
+    return SketchState(
+        cm_bytes=cm_b, cm_pkts=cm_p, heavy=heavy, hll_src=hll_src,
+        hll_per_dst=per_dst, hist_rtt=hist_rtt, hist_dns=hist_dns, ddos=ddos,
+        total_records=state.total_records + jnp.sum(valid.astype(jnp.float32)),
+        total_bytes=state.total_bytes + jnp.sum(
+            jnp.where(valid, bytes_f, 0.0)),
+        window=state.window,
+    )
+
+
+def make_ingest_fn(donate: bool = True):
+    """Jitted ingest; donates the state buffers so updates are in-place on HBM."""
+    return jax.jit(ingest, donate_argnums=(0,) if donate else ())
+
+
+def roll_window(state: SketchState, cfg: SketchConfig,
+                reset_sketches: bool = True) -> tuple[SketchState, WindowReport]:
+    """Close the current window: emit a report, roll EWMA baselines, and
+    (optionally) reset the windowed sketch state while keeping the baselines."""
+    ddos_state, z = ewma.roll(state.ddos, cfg.ewma_alpha)
+    gamma = quantile.gamma_for(state.hist_rtt.n_buckets)
+    report = WindowReport(
+        heavy=state.heavy,
+        distinct_src=hll.estimate(state.hll_src.regs),
+        per_dst_cardinality=hll.estimate(state.hll_per_dst.regs),
+        rtt_quantiles_us=quantile.quantile(state.hist_rtt, jnp.asarray(QS), gamma),
+        dns_quantiles_us=quantile.quantile(state.hist_dns, jnp.asarray(QS), gamma),
+        ddos_z=z,
+        total_records=state.total_records,
+        total_bytes=state.total_bytes,
+        window=state.window,
+    )
+    if reset_sketches:
+        fresh = init_state(SketchConfig(
+            cm_depth=state.cm_bytes.depth, cm_width=state.cm_bytes.width,
+            hll_precision=state.hll_src.precision,
+            perdst_buckets=state.hll_per_dst.regs.shape[0],
+            perdst_precision=int(state.hll_per_dst.regs.shape[1]).bit_length() - 1,
+            topk=state.heavy.k, hist_buckets=state.hist_rtt.n_buckets,
+            ewma_buckets=state.ddos.rate.shape[0], ewma_alpha=cfg.ewma_alpha))
+        new_state = fresh._replace(ddos=ddos_state,
+                                   window=state.window + 1)
+    else:
+        new_state = state._replace(ddos=ddos_state, window=state.window + 1)
+    return new_state, report
+
+
+def make_roll_fn(cfg: SketchConfig, reset_sketches: bool = True):
+    return jax.jit(lambda s: roll_window(s, cfg, reset_sketches))

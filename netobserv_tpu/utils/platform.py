@@ -1,0 +1,28 @@
+"""JAX platform selection helpers.
+
+This image's TPU plugin registers itself from sitecustomize and force-sets
+`jax_platforms="axon,cpu"`, clobbering a `JAX_PLATFORMS=cpu` env request. Every
+entry point that must honor an explicit CPU request (tests, dryruns, offline
+bench) calls `maybe_force_cpu()` before first backend use.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_force_cpu() -> bool:
+    """If the environment asks for CPU, re-apply it over the plugin's override.
+
+    Returns True if CPU was requested. Must run before any JAX backend
+    initializes (jax.devices(), first jit, ...).
+    """
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+        return False
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return True

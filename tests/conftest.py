@@ -1,13 +1,26 @@
 """Test config: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before jax initializes its backend (hence env mutation at import time).
-Real-TPU performance runs live in bench.py, not here.
+Two layers of forcing are needed in this image:
+- XLA_FLAGS must be set before the CPU backend initializes (env, below);
+- the axon TPU plugin's sitecustomize calls jax.config.update("jax_platforms",
+  "axon,cpu") at interpreter start, clobbering any JAX_PLATFORMS env value — so
+  we re-update the config here, before any backend is initialized.
+
+Real-TPU performance runs live in bench.py, not in tests.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+from netobserv_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
+
+import jax  # noqa: E402
+
+assert jax.devices()[0].platform == "cpu"

@@ -1,0 +1,272 @@
+"""Sketch-kernel correctness vs exact CPU aggregation (the reference's
+Accounter-style hashmap is the oracle — SURVEY.md §4 implication (b))."""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401 — force CPU platform before jax import
+import jax
+import jax.numpy as jnp
+
+from netobserv_tpu.ops import countmin, ewma, hashing, hll, quantile, topk
+
+KW = 10
+
+
+def rand_keys(n, n_distinct, rng, zipf_a=0.0):
+    """n key rows drawn from n_distinct distinct keys (optionally zipf-skewed).
+    Returns (words[n, KW], ids[n])."""
+    universe = rng.integers(0, 2**32, size=(n_distinct, KW), dtype=np.uint32)
+    if zipf_a > 0:
+        ranks = rng.zipf(zipf_a, size=n)
+        ids = np.minimum(ranks - 1, n_distinct - 1).astype(np.int64)
+    else:
+        ids = rng.integers(0, n_distinct, size=n)
+    return universe[ids], ids
+
+
+class TestHashing:
+    def test_deterministic_and_seeded(self):
+        rng = np.random.default_rng(0)
+        words = jnp.asarray(rng.integers(0, 2**32, (64, KW), dtype=np.uint32))
+        a = hashing.hash_words(words, 7)
+        b = hashing.hash_words(words, 7)
+        c = hashing.hash_words(words, 8)
+        assert np.array_equal(a, b)
+        assert not np.array_equal(a, c)
+        assert a.dtype == jnp.uint32
+
+    def test_single_bit_avalanche(self):
+        base = jnp.zeros((1, KW), dtype=jnp.uint32)
+        flipped = base.at[0, 3].set(jnp.uint32(1))
+        h0 = int(hashing.hash_words(base, 0)[0])
+        h1 = int(hashing.hash_words(flipped, 0)[0])
+        diff = bin(h0 ^ h1).count("1")
+        assert 8 <= diff <= 24  # ~16 expected for a good mixer
+
+    def test_uniformity(self):
+        rng = np.random.default_rng(1)
+        words = jnp.asarray(rng.integers(0, 2**32, (20000, KW), dtype=np.uint32))
+        h = np.asarray(hashing.hash_words(words, 0))
+        buckets = np.bincount(h % 64, minlength=64)
+        # chi-square-ish sanity: all buckets within 25% of the mean
+        assert buckets.min() > 20000 / 64 * 0.75
+        assert buckets.max() < 20000 / 64 * 1.25
+
+    def test_row_indices_distinct_rows(self):
+        h1 = jnp.asarray([5], dtype=jnp.uint32)
+        h2 = jnp.asarray([3], dtype=jnp.uint32)
+        idx = hashing.row_indices(h1, h2, 4, 1 << 10)
+        vals = [int(idx[i, 0]) for i in range(4)]
+        assert vals == [(5 + i * 3) % 1024 for i in range(4)]
+
+
+class TestCountMin:
+    def test_never_underestimates_and_bounds(self):
+        rng = np.random.default_rng(2)
+        n, n_distinct = 4096, 300
+        words, ids = rand_keys(n, n_distinct, rng)
+        vals = rng.integers(1, 1000, size=n)
+        exact = np.zeros(n_distinct)
+        np.add.at(exact, ids, vals)
+
+        cm = countmin.init(4, 1 << 12, jnp.float32)
+        wj = jnp.asarray(words)
+        h1, h2 = hashing.base_hashes(wj)
+        cm = countmin.update(cm, h1, h2, jnp.asarray(vals, jnp.float32),
+                             jnp.ones(n, jnp.bool_))
+        # query each distinct key once
+        uniq_words, uniq_idx = np.unique(ids, return_index=True)
+        qw = jnp.asarray(words[uniq_idx])
+        q1, q2 = hashing.base_hashes(qw)
+        est = np.asarray(countmin.query(cm, q1, q2))
+        truth = exact[uniq_words]
+        assert np.all(est >= truth - 1e-3)  # CM never underestimates
+        # error bound: eps = e/w with prob 1-e^-d; allow generous slack
+        total = vals.sum()
+        assert np.mean(est - truth) < 2.72 / (1 << 12) * total * 2
+
+    def test_masked_rows_ignored(self):
+        cm = countmin.init(2, 1 << 8, jnp.int32)
+        words = jnp.asarray(np.arange(4 * KW, dtype=np.uint32).reshape(4, KW))
+        h1, h2 = hashing.base_hashes(words)
+        valid = jnp.asarray([True, False, True, False])
+        cm = countmin.update(cm, h1, h2, jnp.full((4,), 10, jnp.int32), valid)
+        assert int(countmin.total(cm)) == 20
+
+    def test_merge_linear(self):
+        rng = np.random.default_rng(3)
+        words = jnp.asarray(rng.integers(0, 2**32, (16, KW), dtype=np.uint32))
+        h1, h2 = hashing.base_hashes(words)
+        v = jnp.ones((16,), jnp.float32)
+        ok = jnp.ones((16,), jnp.bool_)
+        a = countmin.update(countmin.init(2, 256), h1, h2, v, ok)
+        b = countmin.update(countmin.init(2, 256), h1, h2, v * 2, ok)
+        m = countmin.merge(a, b)
+        est = countmin.query(m, h1, h2)
+        assert np.all(np.asarray(est) >= 3.0)
+
+
+class TestHLL:
+    @pytest.mark.parametrize("true_card", [100, 5000, 200_000])
+    def test_cardinality_error(self, true_card):
+        rng = np.random.default_rng(4)
+        words = rng.integers(0, 2**32, (true_card, 4), dtype=np.uint32)
+        # feed each distinct key ~2x in shuffled order
+        feed = np.concatenate([words, words[: true_card // 2]])
+        rng.shuffle(feed)
+        h = hll.init(precision=12)
+        for start in range(0, len(feed), 65536):
+            chunk = jnp.asarray(feed[start:start + 65536])
+            h1, h2 = hashing.base_hashes(chunk)
+            h = hll.update(h, h1, h2, jnp.ones(len(chunk), jnp.bool_))
+        est = float(hll.estimate(h.regs))
+        rel_err = abs(est - true_card) / true_card
+        # theoretical std err = 1.04/sqrt(4096) ~ 1.6%; allow 4 sigma
+        assert rel_err < 0.065, f"{est} vs {true_card}"
+
+    def test_merge_max_equals_union(self):
+        rng = np.random.default_rng(5)
+        w1 = jnp.asarray(rng.integers(0, 2**32, (1000, 4), dtype=np.uint32))
+        w2 = jnp.asarray(rng.integers(0, 2**32, (1000, 4), dtype=np.uint32))
+        ones = jnp.ones(1000, jnp.bool_)
+        a = hll.init(10)
+        b = hll.init(10)
+        h11, h12 = hashing.base_hashes(w1)
+        h21, h22 = hashing.base_hashes(w2)
+        a = hll.update(a, h11, h12, ones)
+        b = hll.update(b, h21, h22, ones)
+        both = hll.init(10)
+        both = hll.update(both, h11, h12, ones)
+        both = hll.update(both, h21, h22, ones)
+        merged = hll.merge_regs(a.regs, b.regs)
+        assert np.array_equal(np.asarray(merged), np.asarray(both.regs))
+
+    def test_per_dst(self):
+        rng = np.random.default_rng(6)
+        n_dst = 8
+        dsts = rng.integers(0, 2**32, (n_dst, 4), dtype=np.uint32)
+        per_dst_srcs = [rng.integers(0, 2**32, (500 * (i + 1), 4), dtype=np.uint32)
+                        for i in range(n_dst)]
+        s = hll.init_per_dst(dst_buckets=256, precision=10)
+        for i in range(n_dst):
+            srcs = per_dst_srcs[i]
+            drow = jnp.asarray(np.tile(dsts[i], (len(srcs), 1)))
+            srow = jnp.asarray(srcs)
+            dh, _ = hashing.base_hashes(drow, seed=1)
+            sh1, sh2 = hashing.base_hashes(srow)
+            s = hll.update_per_dst(s, dh, sh1, sh2,
+                                   jnp.ones(len(srcs), jnp.bool_))
+        ests = np.asarray(hll.estimate(s.regs))
+        for i in range(n_dst):
+            dh = int(hashing.base_hashes(jnp.asarray(dsts[i][None, :]), seed=1)[0][0])
+            bucket = dh & 255
+            true = 500 * (i + 1)
+            assert abs(ests[bucket] - true) / true < 0.25  # small m -> coarse
+
+
+class TestTopK:
+    def test_recall_on_zipf(self):
+        rng = np.random.default_rng(7)
+        n, n_distinct, k = 50_000, 5000, 64
+        words, ids = rand_keys(n, n_distinct, rng, zipf_a=1.3)
+        vals = rng.integers(100, 1500, size=n)
+        exact = {}
+        for i, v in zip(ids, vals):
+            exact[i] = exact.get(i, 0) + int(v)
+        true_top = set(sorted(exact, key=exact.get, reverse=True)[:k])
+
+        cm = countmin.init(4, 1 << 14, jnp.float32)
+        table = topk.init(k=256, key_words=KW)
+        bs = 8192
+        for s in range(0, n, bs):
+            chunk = words[s:s + bs]
+            pad = bs - len(chunk)
+            wj = jnp.asarray(np.pad(chunk, ((0, pad), (0, 0))))
+            vj = jnp.asarray(np.pad(vals[s:s + bs].astype(np.float32), (0, pad)))
+            ok = jnp.asarray(np.pad(np.ones(len(chunk), bool), (0, pad)))
+            h1, h2 = hashing.base_hashes(wj)
+            cm = countmin.update(cm, h1, h2, vj, ok)
+            table = topk.update(table, cm, wj, h1, h2, ok)
+
+        got_words = np.asarray(table.words)[np.asarray(table.valid)]
+        got = {tuple(r) for r in got_words}
+        true_words = {tuple(words[np.nonzero(ids == t)[0][0]]) for t in true_top}
+        recall = len(got & true_words) / k
+        assert recall >= 0.99, f"top-{k} recall {recall}"
+
+    def test_dedup_within_batch(self):
+        words = jnp.asarray(np.tile(
+            np.arange(KW, dtype=np.uint32), (8, 1)))  # 8 copies of one key
+        h1, h2 = hashing.base_hashes(words)
+        cm = countmin.update(countmin.init(2, 256), h1, h2,
+                             jnp.ones(8, jnp.float32), jnp.ones(8, jnp.bool_))
+        t = topk.update(topk.init(k=4, key_words=KW), cm, words, h1, h2,
+                        jnp.ones(8, jnp.bool_))
+        assert int(t.valid.sum()) == 1  # one key, one slot
+        assert float(t.counts[0]) == pytest.approx(8.0)
+
+    def test_empty_batch_keeps_table_empty(self):
+        t = topk.init(k=8, key_words=KW)
+        cm = countmin.init(2, 256)
+        words = jnp.zeros((4, KW), jnp.uint32)
+        h1, h2 = hashing.base_hashes(words)
+        t = topk.update(t, cm, words, h1, h2, jnp.zeros(4, jnp.bool_))
+        assert int(t.valid.sum()) == 0
+
+
+class TestQuantile:
+    def test_relative_error(self):
+        rng = np.random.default_rng(8)
+        samples = rng.lognormal(mean=8, sigma=1.5, size=40_000).astype(np.int32)
+        h = quantile.init(1024)
+        for s in range(0, len(samples), 8192):
+            chunk = jnp.asarray(samples[s:s + 8192])
+            h = quantile.update(h, chunk, jnp.ones(len(chunk), jnp.bool_))
+        qs = np.array([0.5, 0.9, 0.99], dtype=np.float32)
+        est = np.asarray(quantile.quantile(h, jnp.asarray(qs)))
+        truth = np.quantile(samples, qs)
+        rel = np.abs(est - truth) / truth
+        assert np.all(rel < 0.06), f"{est} vs {truth}"
+
+    def test_empty_histogram_quantiles_are_zero(self):
+        h = quantile.init(128)
+        est = np.asarray(quantile.quantile(h, jnp.asarray([0.5, 0.99])))
+        assert np.all(est == 0.0)
+
+    def test_small_bucket_count_still_covers_range(self):
+        # gamma_for widens spacing so 5000us doesn't saturate 64 buckets
+        g = quantile.gamma_for(64)
+        h = quantile.init(64)
+        h = quantile.update(h, jnp.full(100, 5000, jnp.int32),
+                            jnp.ones(100, jnp.bool_), gamma=g)
+        est = float(quantile.quantile(h, jnp.asarray([0.5]), gamma=g)[0])
+        assert abs(est - 5000) / 5000 < 0.5  # coarse buckets, right ballpark
+
+    def test_zero_bucket(self):
+        h = quantile.init(64)
+        h = quantile.update(h, jnp.zeros(10, jnp.int32), jnp.ones(10, jnp.bool_))
+        assert int(h.counts[0]) == 10
+
+
+class TestEWMA:
+    def test_spike_detection(self):
+        s = ewma.init(256)
+        dsts = jnp.asarray(np.arange(16, dtype=np.uint32))
+        ok = jnp.ones(16, jnp.bool_)
+        # 5 calm windows of rate ~100
+        rng = np.random.default_rng(9)
+        for _ in range(5):
+            vals = jnp.asarray(rng.normal(100, 5, 16).astype(np.float32))
+            s = ewma.accumulate(s, dsts, vals, ok)
+            s, z = ewma.roll(s, alpha=0.3)
+            assert not bool(ewma.suspects(z).any())
+        # attack window: dst 3 gets 100x
+        vals = np.full(16, 100.0, np.float32)
+        vals[3] = 10_000.0
+        s = ewma.accumulate(s, dsts, jnp.asarray(vals), ok)
+        s, z = ewma.roll(s, alpha=0.3)
+        sus = np.asarray(ewma.suspects(z))
+        bucket3 = int(np.asarray(dsts)[3]) & 255
+        assert sus[bucket3]
+        assert sus.sum() == 1

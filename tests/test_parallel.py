@@ -1,0 +1,158 @@
+"""Multi-device sharded ingest + ICI merge must agree with single-device ingest
+of the same stream (the distributed path is exact, not approximate — the same
+guarantee the reference gets from per-CPU map merging, `pkg/tracer/tracer.go`
+eviction merge)."""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from netobserv_tpu.parallel import make_mesh, MeshSpec, merge as pmerge
+from netobserv_tpu.sketch import state as sk
+
+KW = 10
+CFG = sk.SketchConfig(cm_depth=3, cm_width=1 << 10, hll_precision=8,
+                      perdst_buckets=64, perdst_precision=5, topk=32,
+                      hist_buckets=128, ewma_buckets=64)
+
+
+def make_arrays(n, rng, n_distinct=200):
+    universe = rng.integers(0, 2**32, (n_distinct, KW), dtype=np.uint32)
+    ids = rng.integers(0, n_distinct, n)
+    return {
+        "keys": universe[ids],
+        "bytes": rng.integers(1, 10_000, n).astype(np.float32),
+        "packets": rng.integers(1, 10, n).astype(np.int32),
+        "rtt_us": rng.integers(0, 5_000, n).astype(np.int32),
+        "dns_latency_us": rng.integers(0, 100, n).astype(np.int32),
+        "valid": np.ones(n, np.bool_),
+    }
+
+
+def single_device_report(arrays):
+    s = sk.init_state(CFG)
+    s = sk.ingest(s, {k: jnp.asarray(v) for k, v in arrays.items()})
+    _, report = sk.roll_window(s, CFG)
+    return report
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4)])
+def test_sharded_matches_single_device(mesh_shape):
+    """Exactness: with a key universe that fits every local table, the merged
+    distributed report equals the single-device report bit-for-bit. (With more
+    keys than table slots, distributed top-K is a union-of-local-top-K
+    candidate heuristic — covered by test_topk_recall_skewed below.)"""
+    ndata, nsk = mesh_shape
+    if ndata * nsk > len(jax.devices()):
+        pytest.skip("not enough devices")
+    rng = np.random.default_rng(42)
+    arrays = make_arrays(ndata * 128, rng, n_distinct=24)
+
+    ref = single_device_report(arrays)
+
+    mesh = make_mesh(MeshSpec(data=ndata, sketch=nsk))
+    dist = pmerge.init_dist_state(CFG, mesh)
+    ingest_fn = pmerge.make_sharded_ingest_fn(mesh, CFG)
+    merge_fn = pmerge.make_merge_fn(mesh, CFG)
+    dist = ingest_fn(dist, pmerge.shard_batch(mesh, arrays))
+    dist, report = merge_fn(dist)
+
+    assert float(report.total_records) == float(ref.total_records)
+    assert float(report.total_bytes) == pytest.approx(
+        float(ref.total_bytes), rel=1e-6)
+    assert float(report.distinct_src) == pytest.approx(
+        float(ref.distinct_src), rel=1e-6)
+    np.testing.assert_allclose(np.asarray(report.rtt_quantiles_us),
+                               np.asarray(ref.rtt_quantiles_us), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(report.dns_quantiles_us),
+                               np.asarray(ref.dns_quantiles_us), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(report.per_dst_cardinality),
+                               np.asarray(ref.per_dst_cardinality), rtol=1e-6)
+    # top-K: same key set, same estimates
+    ref_set = {tuple(w) for w, v in zip(np.asarray(ref.heavy.words),
+                                        np.asarray(ref.heavy.valid)) if v}
+    got_set = {tuple(w) for w, v in zip(np.asarray(report.heavy.words),
+                                        np.asarray(report.heavy.valid)) if v}
+    assert ref_set == got_set
+    ref_counts = {tuple(w): float(c) for w, c, v in zip(
+        np.asarray(ref.heavy.words), np.asarray(ref.heavy.counts),
+        np.asarray(ref.heavy.valid)) if v}
+    got_counts = {tuple(w): float(c) for w, c, v in zip(
+        np.asarray(report.heavy.words), np.asarray(report.heavy.counts),
+        np.asarray(report.heavy.valid)) if v}
+    for k in ref_counts:
+        assert got_counts[k] == pytest.approx(ref_counts[k], rel=1e-5)
+
+
+def test_topk_recall_skewed():
+    """On zipf-skewed traffic (the realistic heavy-hitter regime) the merged
+    distributed table recalls the true global top keys."""
+    ndata, nsk = 4, 2
+    rng = np.random.default_rng(7)
+    n, n_distinct = ndata * 2048, 1000
+    universe = rng.integers(0, 2**32, (n_distinct, KW), dtype=np.uint32)
+    ranks = np.minimum(rng.zipf(1.4, n) - 1, n_distinct - 1)
+    arrays = {
+        "keys": universe[ranks],
+        "bytes": rng.integers(100, 1500, n).astype(np.float32),
+        "packets": np.ones(n, np.int32),
+        "rtt_us": np.zeros(n, np.int32),
+        "dns_latency_us": np.zeros(n, np.int32),
+        "valid": np.ones(n, np.bool_),
+    }
+    exact: dict[int, float] = {}
+    for r, b in zip(ranks, arrays["bytes"]):
+        exact[r] = exact.get(r, 0.0) + float(b)
+    check_k = 16
+    true_top = sorted(exact, key=exact.get, reverse=True)[:check_k]
+
+    mesh = make_mesh(MeshSpec(data=ndata, sketch=nsk))
+    dist = pmerge.init_dist_state(CFG, mesh)
+    ingest_fn = pmerge.make_sharded_ingest_fn(mesh, CFG)
+    merge_fn = pmerge.make_merge_fn(mesh, CFG)
+    dist = ingest_fn(dist, pmerge.shard_batch(mesh, arrays))
+    dist, report = merge_fn(dist)
+
+    got = {tuple(w) for w, v in zip(np.asarray(report.heavy.words),
+                                    np.asarray(report.heavy.valid)) if v}
+    hits = sum(tuple(universe[t]) in got for t in true_top)
+    assert hits / check_k >= 0.95, f"recall {hits}/{check_k}"
+
+
+def test_multiple_windows_and_state_reset():
+    mesh = make_mesh(MeshSpec(data=4, sketch=2))
+    rng = np.random.default_rng(1)
+    dist = pmerge.init_dist_state(CFG, mesh)
+    ingest_fn = pmerge.make_sharded_ingest_fn(mesh, CFG)
+    merge_fn = pmerge.make_merge_fn(mesh, CFG)
+    for w in range(3):
+        arrays = make_arrays(4 * 64, rng)
+        dist = ingest_fn(dist, pmerge.shard_batch(mesh, arrays))
+        dist, report = merge_fn(dist)
+        assert int(report.window) == w
+        assert float(report.total_records) == 4 * 64
+    # after reset, partial counters are zero again
+    assert float(jnp.sum(dist.cm_bytes.counts)) == 0.0
+    assert float(jnp.sum(dist.total_records)) == 0.0
+
+
+def test_ddos_alarm_travels_through_merge():
+    mesh = make_mesh(MeshSpec(data=8, sketch=1))
+    rng = np.random.default_rng(2)
+    dist = pmerge.init_dist_state(CFG, mesh)
+    ingest_fn = pmerge.make_sharded_ingest_fn(mesh, CFG)
+    merge_fn = pmerge.make_merge_fn(mesh, CFG)
+    calm = make_arrays(8 * 64, rng)
+    for _ in range(4):
+        dist = ingest_fn(dist, pmerge.shard_batch(mesh, calm))
+        dist, report = merge_fn(dist)
+        assert not bool((report.ddos_z > 6.0).any())
+    # attack: all traffic to one destination, 100x volume
+    attack = make_arrays(8 * 64, rng, n_distinct=1)
+    attack["bytes"] = np.full(8 * 64, 1e6, np.float32)
+    dist = ingest_fn(dist, pmerge.shard_batch(mesh, attack))
+    dist, report = merge_fn(dist)
+    assert bool((report.ddos_z > 6.0).any())
