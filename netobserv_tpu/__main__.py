@@ -1,0 +1,64 @@
+"""CLI entry: env-configured agent binary (reference analog:
+`cmd/netobserv-ebpf-agent.go` — zero flags, SIGTERM-driven shutdown)."""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+import threading
+
+from netobserv_tpu import __version__
+from netobserv_tpu.agent import FlowsAgent
+from netobserv_tpu.config import load_config
+from netobserv_tpu.metrics.server import start_metrics_server
+
+log = logging.getLogger("netobserv_tpu")
+
+
+def main() -> int:
+    from netobserv_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()  # honor an explicit JAX_PLATFORMS=cpu request
+    cfg = load_config()
+    logging.basicConfig(
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+        stream=sys.stderr)
+    log.info("starting netobserv_tpu agent %s (export=%s)",
+             __version__, cfg.export)
+
+    if cfg.enable_pca:
+        log.error("PCA packet-capture mode is not wired into the CLI yet")
+        return 2
+
+    try:
+        agent = FlowsAgent.from_config(cfg)
+    except ValueError as exc:
+        log.error("invalid configuration: %s", exc)
+        return 2
+
+    srv = None
+    if cfg.metrics_enable:
+        srv = start_metrics_server(
+            agent.metrics.registry, cfg.metrics_server_address,
+            cfg.metrics_server_port, cfg.metrics_tls_cert_path,
+            cfg.metrics_tls_key_path)
+
+    stop = threading.Event()
+
+    def _terminate(signum, _frame):
+        log.info("received %s, stopping agent", signal.Signals(signum).name)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+
+    agent.run(stop)
+    if srv is not None:
+        srv.shutdown()
+    log.info("agent stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""Agent orchestration (L5 in SURVEY.md §1)."""
+
+from netobserv_tpu.agent.agent import FlowsAgent, Status  # noqa: F401

@@ -1,0 +1,205 @@
+"""FlowsAgent: builds and runs the flow pipeline.
+
+Reference analog: `pkg/agent/agent.go:71-230,347-442` — constructs metrics,
+exporter, fetcher; wires the stage graph with bounded queues; exposes a status
+state machine; injectable constructor for fake-driven tests.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import queue
+import threading
+from typing import Optional
+
+from netobserv_tpu.config import AgentConfig
+from netobserv_tpu.datapath.fetcher import FlowFetcher
+from netobserv_tpu.exporter import build_exporter
+from netobserv_tpu.exporter.base import Exporter, QueueExporter
+from netobserv_tpu.flow import Accounter, CapacityLimiter, MapTracer, RingBufTracer
+from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+
+log = logging.getLogger("netobserv_tpu.agent")
+
+
+class Status(enum.Enum):
+    NOT_STARTED = "NotStarted"
+    STARTING = "Starting"
+    STARTED = "Started"
+    STOPPING = "Stopping"
+    STOPPED = "Stopped"
+
+
+class FlowsAgent:
+    """Build with `FlowsAgent.from_config(cfg)` for the real wiring, or inject
+    fetcher/exporter directly for tests (reference: the private `flowsAgent`
+    ctor, `agent.go:180`)."""
+
+    def __init__(self, cfg: AgentConfig, fetcher: FlowFetcher,
+                 exporter: Exporter, metrics: Optional[Metrics] = None,
+                 agent_ip: str = ""):
+        self.cfg = cfg
+        self.fetcher = fetcher
+        self.exporter = exporter
+        self.metrics = metrics or Metrics(MetricsSettings(
+            prefix=cfg.metrics_prefix, level=cfg.metrics_level))
+        self._status = Status.NOT_STARTED
+        self._status_lock = threading.Lock()
+        self._stop = threading.Event()
+
+        buf = cfg.buffers_length
+        export_buf = cfg.exporter_buffer_length or buf
+        self._evicted_q: queue.Queue = queue.Queue(maxsize=buf)
+        self._export_q: queue.Queue = queue.Queue(maxsize=export_buf)
+
+        self.map_tracer = MapTracer(
+            fetcher, self._evicted_q,
+            active_timeout_s=cfg.cache_active_timeout, agent_ip=agent_ip,
+            metrics=self.metrics,
+            stale_purge_s=cfg.stale_entries_evict_timeout)
+        self.limiter = CapacityLimiter(
+            self._evicted_q, self._export_q, metrics=self.metrics)
+        self.terminal = QueueExporter(
+            exporter, self._export_q, metrics=self.metrics)
+
+        self.rb_tracer: Optional[RingBufTracer] = None
+        self.accounter: Optional[Accounter] = None
+        if cfg.enable_flows_ringbuf_fallback:
+            self._rb_q: queue.Queue = queue.Queue(maxsize=buf * 10)
+            self.rb_tracer = RingBufTracer(
+                fetcher, self._rb_q, flusher=self.map_tracer.flush,
+                metrics=self.metrics)
+            self.accounter = Accounter(
+                self._rb_q, self._evicted_q,
+                max_entries=cfg.cache_max_flows,
+                evict_timeout_s=cfg.cache_active_timeout,
+                agent_ip=agent_ip, metrics=self.metrics)
+
+        if cfg.sampling:
+            self.metrics.sampling_rate.set(cfg.sampling)
+
+    @classmethod
+    def from_config(cls, cfg: AgentConfig) -> "FlowsAgent":
+        cfg.validate()
+        agent_ip = resolve_agent_ip(cfg)
+        metrics = Metrics(MetricsSettings(
+            prefix=cfg.metrics_prefix, level=cfg.metrics_level))
+        exporter = build_exporter(cfg, metrics=metrics)
+        fetcher = build_fetcher(cfg)
+        return cls(cfg, fetcher, exporter, metrics=metrics, agent_ip=agent_ip)
+
+    @property
+    def status(self) -> Status:
+        with self._status_lock:
+            return self._status
+
+    def _set_status(self, s: Status) -> None:
+        with self._status_lock:
+            self._status = s
+        log.debug("agent status: %s", s.value)
+
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        """Start the pipeline and block until `stop` is set (or .stop())."""
+        self._set_status(Status.STARTING)
+        self.terminal.start()
+        self.limiter.start()
+        if self.accounter is not None:
+            self.accounter.start()
+        if self.rb_tracer is not None:
+            self.rb_tracer.start()
+        self.map_tracer.start()
+        self._set_status(Status.STARTED)
+        self._active_stop = stop = stop or self._stop
+        stop.wait()
+        self.shutdown()
+
+    def stop(self) -> None:
+        self._stop.set()
+        active = getattr(self, "_active_stop", None)
+        if active is not None:
+            active.set()
+
+    def shutdown(self) -> None:
+        if self.status in (Status.STOPPING, Status.STOPPED):
+            return
+        self._set_status(Status.STOPPING)
+        # stop stages source-first, with a final eviction so nothing is lost
+        self.map_tracer.stop(final_evict=True)
+        if self.rb_tracer is not None:
+            self.rb_tracer.stop()
+        if self.accounter is not None:
+            self.accounter.stop()
+        self.limiter.stop()
+        self.terminal.stop()
+        self.fetcher.close()
+        self._set_status(Status.STOPPED)
+
+
+def build_fetcher(cfg: AgentConfig) -> FlowFetcher:
+    """Datapath selection: kernel loader when available, replay otherwise.
+
+    DATAPATH env ("kernel" | "synthetic" | "pcap:<path>") overrides; default
+    tries the kernel loader and falls back to synthetic with a warning.
+    """
+    import os
+
+    mode = os.environ.get("DATAPATH", "auto")
+    if mode.startswith("pcap:"):
+        from netobserv_tpu.datapath.replay import PcapReplayFetcher
+        return PcapReplayFetcher(mode[5:], window_s=cfg.cache_active_timeout)
+    if mode == "synthetic":
+        from netobserv_tpu.datapath.replay import SyntheticFetcher
+        return SyntheticFetcher()
+    try:
+        from netobserv_tpu.datapath.loader import KernelFetcher
+        return KernelFetcher.load(cfg)
+    except Exception as exc:
+        if mode == "kernel":
+            raise
+        log.warning("kernel datapath unavailable (%s); using synthetic replay",
+                    exc)
+        from netobserv_tpu.datapath.replay import SyntheticFetcher
+        return SyntheticFetcher()
+
+
+def resolve_agent_ip(cfg: AgentConfig) -> str:
+    """Agent IP resolution (reference analog: `pkg/agent/ip.go:27-126`).
+
+    AGENT_IP takes precedence; otherwise derive from the routing table
+    (external) or hostname (local), honoring AGENT_IP_TYPE (any/ipv4/ipv6).
+    """
+    import socket
+
+    if cfg.agent_ip:
+        return cfg.agent_ip
+    want = cfg.agent_ip_type
+    if cfg.agent_ip_iface == "local":
+        host = socket.gethostname()
+        try:
+            infos = socket.getaddrinfo(host, None)
+        except OSError:
+            return "127.0.0.1"
+        for family in ((socket.AF_INET,) if want in ("any", "ipv4")
+                       else ()) + ((socket.AF_INET6,)
+                                   if want in ("any", "ipv6") else ()):
+            for info in infos:
+                if info[0] == family:
+                    return info[4][0]
+        return "127.0.0.1"
+    # "external": learn the egress address by opening a dummy UDP socket
+    probes = []
+    if want in ("any", "ipv4"):
+        probes.append((socket.AF_INET, "8.8.8.8"))
+    if want in ("any", "ipv6"):
+        probes.append((socket.AF_INET6, "2001:4860:4860::8888"))
+    for family, target in probes:
+        try:
+            s = socket.socket(family, socket.SOCK_DGRAM)
+            s.connect((target, 80))
+            ip = s.getsockname()[0]
+            s.close()
+            return ip
+        except OSError:
+            continue
+    return "127.0.0.1"

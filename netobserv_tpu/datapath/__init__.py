@@ -1,0 +1,8 @@
+"""Capture plane: eBPF C datapath sources, loader, and the fetcher seam.
+
+The narrow fetcher interface (`netobserv_tpu.datapath.fetcher`) is the testing
+seam the whole agent hangs off — the reference's `ebpfFlowFetcher` /
+`mapFetcher` / `ringBufReader` interfaces (`pkg/agent/agent.go:94-102`,
+`pkg/flow/tracer_map.go:37-40`) reproduced so the fake-driven test strategy
+ports (SURVEY.md §4).
+"""

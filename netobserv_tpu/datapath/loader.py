@@ -1,0 +1,43 @@
+"""Kernel datapath loader (libbpf-backed), gated on environment support.
+
+Reference analog: `pkg/tracer/tracer.go` (NewFlowFetcher: load spec, resize
+maps, rewrite config constants, attach TCX/TC, evict via lookup-and-delete).
+
+The BPF object is compiled from `netobserv_tpu/datapath/bpf/` by the cmake
+build (`netobserv_tpu/datapath/native/`), which requires clang with BPF target
+support — not present in every environment, so everything here degrades to a
+clear error and the agent falls back to replay datapaths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import os
+
+from netobserv_tpu.config import AgentConfig
+
+_OBJ_PATH = os.path.join(os.path.dirname(__file__), "native", "build",
+                         "flowpath.bpf.o")
+
+
+class KernelFetcher:
+    """FlowFetcher backed by real kernel maps. Requires:
+    - CAP_BPF + CAP_PERFMON (or root),
+    - a compiled BPF object (see datapath/native/CMakeLists.txt),
+    - libbpf.so available to the dynamic linker.
+    """
+
+    @classmethod
+    def load(cls, cfg: AgentConfig) -> "KernelFetcher":
+        lib = ctypes.util.find_library("bpf")
+        if lib is None:
+            raise RuntimeError("libbpf not found")
+        if not os.path.exists(_OBJ_PATH):
+            raise RuntimeError(
+                f"BPF object not built ({_OBJ_PATH}); run the datapath build "
+                "(requires clang with -target bpf)")
+        if os.geteuid() != 0:
+            raise RuntimeError("kernel datapath requires root/CAP_BPF")
+        raise NotImplementedError(
+            "kernel loader attach path lands with the native evictor")

@@ -1,0 +1,45 @@
+"""Export/communication layer (L4 in SURVEY.md §1).
+
+Every exporter is a terminal consumer of `list[Record]` batches — the
+`ExportFlows(<-chan []*model.Record)` contract (`pkg/agent/agent.go:83`). The
+tpu-sketch backend plugs in at this exact seam (BASELINE.json north star), so
+agent wiring is backend-agnostic.
+"""
+
+from netobserv_tpu.exporter.base import Exporter, QueueExporter  # noqa: F401
+from netobserv_tpu.exporter.stdout_json import StdoutJSONExporter  # noqa: F401
+from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter  # noqa: F401
+
+
+def build_exporter(cfg, metrics=None):
+    """Backend switch (reference analog: `pkg/agent/agent.go:246-261`)."""
+    from netobserv_tpu import config as c
+    if cfg.export in (c.EXPORT_STDOUT, c.EXPORT_DIRECT_FLP):
+        # direct-flp mode: in-process pipeline consuming FLP-style maps; the
+        # stdout exporter emits the same GenericMap JSON shape
+        return StdoutJSONExporter(metrics=metrics,
+                                  flp_format=(cfg.export == c.EXPORT_DIRECT_FLP),
+                                  flp_config=cfg.flp_config)
+    if cfg.export == c.EXPORT_TPU_SKETCH:
+        return TpuSketchExporter.from_config(cfg, metrics=metrics)
+    if cfg.export == c.EXPORT_GRPC:
+        from netobserv_tpu.exporter.grpc_flow import GRPCFlowExporter
+        return GRPCFlowExporter(
+            host=cfg.target_host, port=cfg.target_port,
+            max_flows_per_message=cfg.grpc_message_max_flows,
+            tls_ca=cfg.target_tls_ca_cert_path,
+            tls_cert=cfg.target_tls_user_cert_path,
+            tls_key=cfg.target_tls_user_key_path,
+            reconnect_every_s=cfg.grpc_reconnect_timer or None,
+            reconnect_randomization_s=cfg.grpc_reconnect_timer_randomization,
+            metrics=metrics)
+    if cfg.export in (c.EXPORT_IPFIX_UDP, c.EXPORT_IPFIX_TCP):
+        from netobserv_tpu.exporter.ipfix import IPFIXExporter
+        return IPFIXExporter(
+            host=cfg.target_host, port=cfg.target_port,
+            transport="udp" if cfg.export == c.EXPORT_IPFIX_UDP else "tcp",
+            metrics=metrics)
+    if cfg.export == c.EXPORT_KAFKA:
+        from netobserv_tpu.exporter.kafka import KafkaExporter
+        return KafkaExporter.from_config(cfg, metrics=metrics)
+    raise ValueError(f"unknown exporter {cfg.export!r}")

@@ -1,0 +1,75 @@
+"""Exporter base: a terminal thread consuming record batches from a queue."""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Optional
+
+from netobserv_tpu.model.record import Record
+
+log = logging.getLogger("netobserv_tpu.exporter")
+
+
+class Exporter:
+    """Subclasses implement export_batch(); name is the metrics label."""
+
+    name = "exporter"
+
+    def export_batch(self, records: list[Record]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class QueueExporter:
+    """Runs an Exporter as the pipeline's terminal node."""
+
+    def __init__(self, exporter: Exporter,
+                 inp: "queue.Queue[list[Record]]", metrics=None):
+        self._exporter = exporter
+        self._in = inp
+        self._metrics = metrics
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name=f"export-{self._exporter.name}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        self._drain()
+        self._exporter.close()
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                self._export(self._in.get_nowait())
+            except queue.Empty:
+                return
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                batch = self._in.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            self._export(batch)
+
+    def _export(self, batch: list[Record]) -> None:
+        try:
+            self._exporter.export_batch(batch)
+            if self._metrics is not None:
+                self._metrics.count_exported(self._exporter.name, len(batch))
+        except Exception as exc:  # exporter errors must not kill the pipeline
+            if self._metrics is not None:
+                self._metrics.count_export_error(
+                    self._exporter.name, type(exc).__name__)
+            log.error("%s export failed: %s", self._exporter.name, exc)

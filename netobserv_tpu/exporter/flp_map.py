@@ -1,0 +1,112 @@
+"""Record -> FLP GenericMap field naming.
+
+Reference analog: `pkg/decode/decode_protobuf.go:57-197` (`RecordToMap`) — the
+field names the flowlogs-pipeline ecosystem expects. Used by the direct-flp
+exporter and by the Kafka JSON option.
+"""
+
+from __future__ import annotations
+
+from netobserv_tpu.model.flow import ip_from_16
+from netobserv_tpu.model.record import Record
+from netobserv_tpu.model import tls_types
+
+# drop-cause/state naming subsets (full tables in the reference's decode layer)
+TCP_STATES = {
+    1: "TCP_ESTABLISHED", 2: "TCP_SYN_SENT", 3: "TCP_SYN_RECV",
+    4: "TCP_FIN_WAIT1", 5: "TCP_FIN_WAIT2", 6: "TCP_TIME_WAIT",
+    7: "TCP_CLOSE", 8: "TCP_CLOSE_WAIT", 9: "TCP_LAST_ACK",
+    10: "TCP_LISTEN", 11: "TCP_CLOSING", 12: "TCP_NEW_SYN_RECV",
+}
+
+DNS_RCODES = {
+    0: "NoError", 1: "FormErr", 2: "ServFail", 3: "NXDomain", 4: "NotImp",
+    5: "Refused", 6: "YXDomain", 7: "YXRRSet", 8: "NXRRSet", 9: "NotAuth",
+    10: "NotZone",
+}
+
+
+def _mac(raw: bytes) -> str:
+    return ":".join(f"{b:02X}" for b in raw)
+
+
+def record_to_map(r: Record) -> dict:
+    """FLP GenericMap for one flow record."""
+    f = r.features
+    out = {
+        "FlowDirection": r.direction,
+        "Bytes": r.bytes_,
+        "Packets": r.packets,
+        "SrcAddr": r.key.src,
+        "DstAddr": r.key.dst,
+        "SrcMac": _mac(r.src_mac),
+        "DstMac": _mac(r.dst_mac),
+        "Etype": r.eth_protocol,
+        "Duplicate": False,
+        "TimeFlowStartMs": r.time_flow_start_ns // 1_000_000,
+        "TimeFlowEndMs": r.time_flow_end_ns // 1_000_000,
+        "TimeReceived": r.time_flow_end_ns // 1_000_000_000,
+        "Interface": r.interface,
+        "Interfaces": [d[0] for d in r.dup_list] or [r.interface],
+        "IfDirections": [d[1] for d in r.dup_list] or [r.direction],
+        "AgentIP": r.agent_ip,
+        "Sampling": r.sampling,
+    }
+    if r.udn or any(d[2] for d in r.dup_list):
+        out["Udns"] = [d[2] for d in r.dup_list] or [r.udn]
+    if r.dscp:
+        out["Dscp"] = r.dscp
+    out["Proto"] = r.key.proto
+    if r.key.proto in (1, 58):  # ICMP / ICMPv6
+        out["IcmpType"] = r.key.icmp_type
+        out["IcmpCode"] = r.key.icmp_code
+    elif r.key.proto in (6, 17, 132):  # TCP / UDP / SCTP carry ports
+        out["SrcPort"] = r.key.src_port
+        out["DstPort"] = r.key.dst_port
+    if r.key.proto == 6:
+        out["Flags"] = r.tcp_flags
+    if f.drop_packets or f.drop_bytes:
+        out["PktDropBytes"] = f.drop_bytes
+        out["PktDropPackets"] = f.drop_packets
+        out["PktDropLatestFlags"] = f.drop_latest_flags
+        out["PktDropLatestState"] = TCP_STATES.get(
+            f.drop_latest_state, str(f.drop_latest_state))
+        out["PktDropLatestDropCause"] = f.drop_latest_cause
+    if f.dns_id or f.dns_latency_ns or f.dns_errno:
+        out["DnsId"] = f.dns_id
+        out["DnsFlags"] = f.dns_flags
+        out["DnsErrno"] = f.dns_errno
+        out["DnsFlagsResponseCode"] = DNS_RCODES.get(
+            f.dns_flags & 0xF, str(f.dns_flags & 0xF))
+        if f.dns_latency_ns:
+            out["DnsLatencyMs"] = f.dns_latency_ns // 1_000_000
+        if f.dns_name:
+            out["DnsName"] = f.dns_name
+    if f.rtt_ns:
+        out["TimeFlowRttNs"] = f.rtt_ns
+    if f.network_events:
+        out["NetworkEvents"] = [ev.hex() for ev in f.network_events]
+    if f.xlat_src_ip:
+        out["XlatSrcAddr"] = ip_from_16(f.xlat_src_ip)
+        out["XlatDstAddr"] = ip_from_16(f.xlat_dst_ip)
+        out["XlatSrcPort"] = f.xlat_src_port
+        out["XlatDstPort"] = f.xlat_dst_port
+        out["ZoneId"] = f.xlat_zone_id
+    if f.ipsec_encrypted or f.ipsec_encrypted_ret:
+        out["IPSecRet"] = f.ipsec_encrypted_ret
+        out["IPSecStatus"] = "success" if f.ipsec_encrypted else "failure"
+    if r.ssl_version:
+        out["TlsVersion"] = tls_types.tls_version_name(r.ssl_version)
+        if r.tls_cipher_suite:
+            out["TlsCipher"] = tls_types.cipher_suite_name(r.tls_cipher_suite)
+        if r.tls_key_share:
+            out["TlsKeyShare"] = tls_types.key_share_name(r.tls_key_share)
+        if r.tls_types:
+            out["TlsTypes"] = tls_types.tls_types_names(r.tls_types)
+        if r.ssl_mismatch:
+            out["TlsMismatch"] = True
+    if f.quic_version or f.quic_seen_long_hdr or f.quic_seen_short_hdr:
+        out["QuicVersion"] = f.quic_version
+        out["QuicLongHdr"] = f.quic_seen_long_hdr
+        out["QuicShortHdr"] = f.quic_seen_short_hdr
+    return out

@@ -1,0 +1,156 @@
+"""Record <-> pbflow protobuf converters.
+
+Reference analog: `pkg/pbflow/proto.go:20-151` (FlowsToPB/FlowToPB/PBToFlow).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from netobserv_tpu.model.flow import FlowFeatures, FlowKey, ip_to_16
+from netobserv_tpu.model.record import Record
+from netobserv_tpu.pb import flow_pb2
+
+V4_PREFIX = b"\x00" * 10 + b"\xff\xff"
+
+
+def _set_ip(pb_ip: flow_pb2.IP, raw16: bytes) -> None:
+    if raw16[:12] == V4_PREFIX:
+        pb_ip.ipv4 = struct.unpack(">I", raw16[12:16])[0]
+    else:
+        pb_ip.ipv6 = raw16
+
+
+def _get_ip(pb_ip: flow_pb2.IP) -> bytes:
+    if pb_ip.WhichOneof("ip_family") == "ipv4":
+        return V4_PREFIX + struct.pack(">I", pb_ip.ipv4)
+    return bytes(pb_ip.ipv6) if pb_ip.ipv6 else b"\x00" * 16
+
+
+def _mac_to_u64(mac: bytes) -> int:
+    return int.from_bytes(mac[:6], "big")
+
+
+def _u64_to_mac(v: int) -> bytes:
+    return v.to_bytes(8, "big")[2:]
+
+
+def record_to_pb(r: Record) -> flow_pb2.Record:
+    pb = flow_pb2.Record()
+    pb.eth_protocol = r.eth_protocol
+    pb.direction = (flow_pb2.EGRESS if r.direction == 1 else flow_pb2.INGRESS)
+    pb.time_flow_start.FromNanoseconds(r.time_flow_start_ns)
+    pb.time_flow_end.FromNanoseconds(r.time_flow_end_ns)
+    pb.data_link.src_mac = _mac_to_u64(r.src_mac)
+    pb.data_link.dst_mac = _mac_to_u64(r.dst_mac)
+    _set_ip(pb.network.src_addr, r.key.src_ip)
+    _set_ip(pb.network.dst_addr, r.key.dst_ip)
+    pb.network.dscp = r.dscp
+    pb.transport.src_port = r.key.src_port
+    pb.transport.dst_port = r.key.dst_port
+    pb.transport.protocol = r.key.proto
+    pb.bytes = r.bytes_
+    pb.packets = r.packets
+    pb.interface = r.interface
+    if r.agent_ip:
+        _set_ip(pb.agent_ip, ip_to_16(r.agent_ip))
+    pb.flags = r.tcp_flags
+    pb.icmp_type = r.key.icmp_type
+    pb.icmp_code = r.key.icmp_code
+    pb.sampling = r.sampling
+    for iface, direction, udn in r.dup_list:
+        d = pb.dup_list.add()
+        d.interface = iface
+        d.direction = (flow_pb2.EGRESS if direction == 1 else flow_pb2.INGRESS)
+        d.udn = udn
+    f = r.features
+    if f.drop_bytes or f.drop_packets:
+        pb.pkt_drop_bytes = f.drop_bytes
+        pb.pkt_drop_packets = f.drop_packets
+        pb.pkt_drop_latest_flags = f.drop_latest_flags
+        pb.pkt_drop_latest_state = f.drop_latest_state
+        pb.pkt_drop_latest_drop_cause = f.drop_latest_cause
+    if f.dns_id or f.dns_latency_ns or f.dns_errno:
+        pb.dns_id = f.dns_id
+        pb.dns_flags = f.dns_flags
+        pb.dns_errno = f.dns_errno
+        pb.dns_latency.FromNanoseconds(f.dns_latency_ns)
+        pb.dns_name = f.dns_name
+    if f.rtt_ns:
+        pb.time_flow_rtt.FromNanoseconds(f.rtt_ns)
+    for ev in f.network_events:
+        ne = pb.network_events_metadata.add()
+        ne.events["raw"] = ev.hex()
+    if f.xlat_src_ip:
+        _set_ip(pb.xlat.src_addr, f.xlat_src_ip)
+        _set_ip(pb.xlat.dst_addr, f.xlat_dst_ip)
+        pb.xlat.src_port = f.xlat_src_port
+        pb.xlat.dst_port = f.xlat_dst_port
+        pb.xlat.zone_id = f.xlat_zone_id
+    pb.ipsec_encrypted = int(f.ipsec_encrypted)
+    pb.ipsec_encrypted_ret = f.ipsec_encrypted_ret
+    pb.ssl_version = r.ssl_version
+    pb.ssl_mismatch = r.ssl_mismatch
+    pb.tls_types = r.tls_types
+    pb.tls_cipher_suite = r.tls_cipher_suite
+    pb.tls_key_share = r.tls_key_share
+    if f.quic_version or f.quic_seen_long_hdr or f.quic_seen_short_hdr:
+        pb.quic.version = f.quic_version
+        pb.quic.seen_long_hdr = int(f.quic_seen_long_hdr)
+        pb.quic.seen_short_hdr = int(f.quic_seen_short_hdr)
+    return pb
+
+
+def pb_to_record(pb: flow_pb2.Record) -> Record:
+    key = FlowKey(
+        src_ip=_get_ip(pb.network.src_addr),
+        dst_ip=_get_ip(pb.network.dst_addr),
+        src_port=pb.transport.src_port, dst_port=pb.transport.dst_port,
+        proto=pb.transport.protocol,
+        icmp_type=pb.icmp_type, icmp_code=pb.icmp_code)
+    f = FlowFeatures(
+        dns_id=pb.dns_id, dns_flags=pb.dns_flags,
+        dns_latency_ns=pb.dns_latency.ToNanoseconds(),
+        dns_errno=pb.dns_errno, dns_name=pb.dns_name,
+        drop_bytes=pb.pkt_drop_bytes, drop_packets=pb.pkt_drop_packets,
+        drop_latest_flags=pb.pkt_drop_latest_flags,
+        drop_latest_state=pb.pkt_drop_latest_state,
+        drop_latest_cause=pb.pkt_drop_latest_drop_cause,
+        rtt_ns=pb.time_flow_rtt.ToNanoseconds(),
+        ipsec_encrypted=bool(pb.ipsec_encrypted),
+        ipsec_encrypted_ret=pb.ipsec_encrypted_ret,
+        quic_version=pb.quic.version,
+        quic_seen_long_hdr=bool(pb.quic.seen_long_hdr),
+        quic_seen_short_hdr=bool(pb.quic.seen_short_hdr))
+    if pb.HasField("xlat"):
+        f.xlat_src_ip = _get_ip(pb.xlat.src_addr)
+        f.xlat_dst_ip = _get_ip(pb.xlat.dst_addr)
+        f.xlat_src_port = pb.xlat.src_port
+        f.xlat_dst_port = pb.xlat.dst_port
+        f.xlat_zone_id = pb.xlat.zone_id
+    agent_ip = ""
+    if pb.HasField("agent_ip"):
+        from netobserv_tpu.model.flow import ip_from_16
+        agent_ip = ip_from_16(_get_ip(pb.agent_ip))
+    return Record(
+        key=key, bytes_=pb.bytes, packets=pb.packets,
+        eth_protocol=pb.eth_protocol, tcp_flags=pb.flags,
+        direction=int(pb.direction),
+        src_mac=_u64_to_mac(pb.data_link.src_mac),
+        dst_mac=_u64_to_mac(pb.data_link.dst_mac),
+        interface=pb.interface,
+        dscp=pb.network.dscp, sampling=pb.sampling,
+        time_flow_start_ns=pb.time_flow_start.ToNanoseconds(),
+        time_flow_end_ns=pb.time_flow_end.ToNanoseconds(),
+        agent_ip=agent_ip,
+        dup_list=[(d.interface, int(d.direction), d.udn) for d in pb.dup_list],
+        features=f,
+        ssl_version=pb.ssl_version, ssl_mismatch=pb.ssl_mismatch,
+        tls_types=pb.tls_types, tls_cipher_suite=pb.tls_cipher_suite,
+        tls_key_share=pb.tls_key_share)
+
+
+def records_to_pb(records: list[Record]) -> flow_pb2.Records:
+    out = flow_pb2.Records()
+    out.entries.extend(record_to_pb(r) for r in records)
+    return out

@@ -1,0 +1,13 @@
+"""Flow processing pipeline stages (layer L3 in SURVEY.md §1).
+
+Stages are threads connected by bounded queues (the gopipes-node analog,
+`pkg/agent/agent.go:387-442`): MapTracer -> CapacityLimiter -> exporter, with
+the optional ringbuffer fallback path RingBufTracer -> Accounter feeding the
+same limiter. Backpressure is explicit and lossy at exactly one point
+(CapacityLimiter), like the reference.
+"""
+
+from netobserv_tpu.flow.map_tracer import MapTracer  # noqa: F401
+from netobserv_tpu.flow.ringbuf_tracer import RingBufTracer  # noqa: F401
+from netobserv_tpu.flow.accounter import Accounter  # noqa: F401
+from netobserv_tpu.flow.limiter import CapacityLimiter  # noqa: F401

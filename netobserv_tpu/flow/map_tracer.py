@@ -1,0 +1,143 @@
+"""MapTracer: the timer-driven eviction loop.
+
+Reference analog: `pkg/flow/tracer_map.go:42-146` — a ticker drains the kernel
+aggregation map every CACHE_ACTIVE_TIMEOUT; a Flush() signal (raised by the
+ringbuffer path under map pressure) forces an early eviction; only one eviction
+runs at a time.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Optional
+
+from netobserv_tpu.datapath.fetcher import FlowFetcher
+from netobserv_tpu.model.record import (
+    InterfaceNamer, MonotonicClock, Record, interface_namer,
+    records_from_events,
+)
+
+log = logging.getLogger("netobserv_tpu.flow.map_tracer")
+
+
+class MapTracer:
+    def __init__(self, fetcher: FlowFetcher, out: "queue.Queue[list[Record]]",
+                 active_timeout_s: float = 5.0, agent_ip: str = "",
+                 namer: Optional[InterfaceNamer] = None,
+                 metrics=None, stale_purge_s: float = 5.0):
+        self._fetcher = fetcher
+        self._out = out
+        self._timeout = active_timeout_s
+        self._agent_ip = agent_ip
+        self._namer = namer
+        self._clock = MonotonicClock()
+        self._metrics = metrics
+        self._stale_purge_s = stale_purge_s
+        self._flush = threading.Event()
+        self._stop = threading.Event()
+        self._evict_lock = threading.Lock()  # one eviction at a time
+        self._thread: Optional[threading.Thread] = None
+
+    def flush(self) -> None:
+        """Force an early eviction (map-pressure relief)."""
+        self._flush.set()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="map-tracer", daemon=True)
+        self._thread.start()
+
+    def stop(self, final_evict: bool = True) -> None:
+        self._stop.set()
+        self._flush.set()
+        if self._thread:
+            self._thread.join(timeout=self._timeout + 2)
+        if final_evict:
+            self._evict_once()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            # wait for either the ticker period or an explicit flush
+            self._flush.wait(timeout=self._timeout)
+            self._flush.clear()
+            if self._stop.is_set():
+                return
+            self._evict_once()
+
+    def _evict_once(self) -> None:
+        with self._evict_lock:
+            self._evict_locked()
+
+    def _evict_locked(self) -> None:
+        t0 = time.perf_counter()
+        evicted = self._fetcher.lookup_and_delete()
+        # purge orphaned auxiliary entries (e.g. DNS queries never answered)
+        purge = getattr(self._fetcher, "purge_stale", None)
+        if purge is not None:
+            purge(self._stale_purge_s)
+        if self._metrics is not None:
+            self._metrics.observe_eviction(
+                "map", len(evicted), time.perf_counter() - t0)
+            for key, val in self._fetcher.read_global_counters().items():
+                self._metrics.add_global_counter(key, val)
+        if len(evicted) == 0:
+            return
+        namer = self._namer or interface_namer()
+        records = records_from_events(
+            evicted.events, clock=self._clock, agent_ip=self._agent_ip,
+            namer=namer)
+        _attach_features(records, evicted)
+        try:
+            self._out.put_nowait(records)
+        except queue.Full:
+            # downstream full: the limiter's role; count and drop
+            if self._metrics is not None:
+                self._metrics.count_dropped(len(records), "map_tracer")
+            log.warning("eviction dropped: downstream buffer full (%d records)",
+                        len(records))
+
+
+def _attach_features(records: list[Record], evicted) -> None:
+    """Copy per-feature arrays onto the enriched records (already merged)."""
+    for i, rec in enumerate(records):
+        f = rec.features
+        if evicted.dns is not None and i < len(evicted.dns):
+            d = evicted.dns[i]
+            f.dns_id = int(d["dns_id"])
+            f.dns_flags = int(d["dns_flags"])
+            f.dns_latency_ns = int(d["latency_ns"])
+            f.dns_errno = int(d["errno"])
+            f.dns_name = bytes(d["name"]).rstrip(b"\x00").decode(
+                "ascii", "replace")
+        if evicted.drops is not None and i < len(evicted.drops):
+            d = evicted.drops[i]
+            f.drop_bytes = int(d["bytes"])
+            f.drop_packets = int(d["packets"])
+            f.drop_latest_flags = int(d["latest_flags"])
+            f.drop_latest_state = int(d["latest_state"])
+            f.drop_latest_cause = int(d["latest_cause"])
+        if evicted.extra is not None and i < len(evicted.extra):
+            e = evicted.extra[i]
+            f.rtt_ns = int(e["rtt_ns"])
+            f.ipsec_encrypted = bool(e["ipsec_encrypted"])
+            f.ipsec_encrypted_ret = int(e["ipsec_ret"])
+        if evicted.xlat is not None and i < len(evicted.xlat):
+            x = evicted.xlat[i]
+            if x["src_ip"].any() or x["dst_ip"].any():
+                f.xlat_src_ip = x["src_ip"].tobytes()
+                f.xlat_dst_ip = x["dst_ip"].tobytes()
+                f.xlat_src_port = int(x["src_port"])
+                f.xlat_dst_port = int(x["dst_port"])
+                f.xlat_zone_id = int(x["zone_id"])
+        if evicted.nevents is not None and i < len(evicted.nevents):
+            n = evicted.nevents[i]
+            for j in range(int(n["n_events"])):
+                f.network_events.append(n["events"][j].tobytes())
+        if evicted.quic is not None and i < len(evicted.quic):
+            q = evicted.quic[i]
+            f.quic_version = int(q["version"])
+            f.quic_seen_long_hdr = bool(q["seen_long_hdr"])
+            f.quic_seen_short_hdr = bool(q["seen_short_hdr"])

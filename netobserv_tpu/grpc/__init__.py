@@ -1,0 +1,11 @@
+"""gRPC plumbing: flow/packet clients and test-oriented collector servers.
+
+Reference analog: `pkg/grpc/` (client with TLS/mTLS options; in-process
+collector server forwarding to a channel for tests/examples). Service stubs are
+hand-written over grpcio's generic API since grpc_tools isn't available for
+codegen in this image — the method path and message types match proto/flow.proto.
+"""
+
+from netobserv_tpu.grpc.flow import (  # noqa: F401
+    FlowClient, start_flow_collector,
+)

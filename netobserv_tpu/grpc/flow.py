@@ -1,0 +1,95 @@
+"""Flow collector gRPC client/server (service `pbflow.Collector`)."""
+
+from __future__ import annotations
+
+import logging
+import queue
+from typing import Optional
+
+import grpc
+
+from netobserv_tpu.pb import flow_pb2
+
+log = logging.getLogger("netobserv_tpu.grpc.flow")
+
+_SEND = "/pbflow.Collector/Send"
+
+
+def _channel_credentials(ca_path: str = "", cert_path: str = "",
+                         key_path: str = "") -> Optional[grpc.ChannelCredentials]:
+    if not ca_path and not cert_path:
+        return None
+    root = open(ca_path, "rb").read() if ca_path else None
+    if cert_path and key_path:  # mTLS
+        return grpc.ssl_channel_credentials(
+            root_certificates=root,
+            private_key=open(key_path, "rb").read(),
+            certificate_chain=open(cert_path, "rb").read())
+    return grpc.ssl_channel_credentials(root_certificates=root)
+
+
+class FlowClient:
+    """Thin client for Collector.Send (reference: `pkg/grpc/flow/client.go`)."""
+
+    def __init__(self, host: str, port: int, tls_ca: str = "",
+                 tls_cert: str = "", tls_key: str = ""):
+        self._target = f"{host}:{port}"
+        self._creds = _channel_credentials(tls_ca, tls_cert, tls_key)
+        self._channel: Optional[grpc.Channel] = None
+        self._send = None
+        self.connect()
+
+    def connect(self) -> None:
+        self.close()
+        if self._creds is not None:
+            self._channel = grpc.secure_channel(self._target, self._creds)
+        else:
+            self._channel = grpc.insecure_channel(self._target)
+        self._send = self._channel.unary_unary(
+            _SEND,
+            request_serializer=flow_pb2.Records.SerializeToString,
+            response_deserializer=flow_pb2.CollectorReply.FromString,
+        )
+
+    def send(self, records: flow_pb2.Records,
+             timeout_s: float = 10.0) -> flow_pb2.CollectorReply:
+        return self._send(records, timeout=timeout_s)
+
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+
+
+def start_flow_collector(port: int = 0,
+                         out: Optional["queue.Queue[flow_pb2.Records]"] = None,
+                         tls_cert: str = "", tls_key: str = ""):
+    """In-process collector server; returns (server, bound_port, queue).
+
+    Reference analog: `pkg/grpc/flow/server.go:34-77` — forwards every received
+    Records message to a queue (used by tests and the flowlogs-dump example).
+    """
+    from concurrent import futures
+
+    out = out if out is not None else queue.Queue()
+
+    def send(request: flow_pb2.Records, context) -> flow_pb2.CollectorReply:
+        out.put(request)
+        return flow_pb2.CollectorReply()
+
+    handler = grpc.method_handlers_generic_handler(
+        "pbflow.Collector",
+        {"Send": grpc.unary_unary_rpc_method_handler(
+            send,
+            request_deserializer=flow_pb2.Records.FromString,
+            response_serializer=flow_pb2.CollectorReply.SerializeToString)})
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((handler,))
+    if tls_cert and tls_key:
+        creds = grpc.ssl_server_credentials(
+            [(open(tls_key, "rb").read(), open(tls_cert, "rb").read())])
+        bound = server.add_secure_port(f"0.0.0.0:{port}", creds)
+    else:
+        bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    server.start()
+    return server, bound, out

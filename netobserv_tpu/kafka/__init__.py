@@ -1,0 +1,12 @@
+"""Minimal pure-Python Kafka produce-only client.
+
+No Kafka client library exists in this image, so the wire protocol is spoken
+directly: Metadata (v1) for leader discovery, Produce (v3, record-batch v2 with
+crc32c), SaslHandshake/SaslAuthenticate (PLAIN/SCRAM) and TLS sockets. Only
+what a flow exporter needs — no consumer, no idempotence, no transactions.
+
+Reference analog: the segmentio/kafka-go writer usage in
+`pkg/exporter/kafka_proto.go` + `pkg/agent/agent.go:283-331`.
+"""
+
+from netobserv_tpu.kafka.producer import KafkaProducer  # noqa: F401

@@ -1,0 +1,9 @@
+"""Observability: declarative Prometheus metrics + HTTP exposition.
+
+Reference analog: `pkg/metrics/metrics.go` (declarative metric defs, prefix,
+verbosity levels) and `pkg/prometheus/prom_server.go` (async /metrics server
+with TLS option).
+"""
+
+from netobserv_tpu.metrics.registry import Metrics, MetricsSettings  # noqa: F401
+from netobserv_tpu.metrics.server import start_metrics_server  # noqa: F401

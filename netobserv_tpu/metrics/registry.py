@@ -1,0 +1,128 @@
+"""Declarative agent metrics.
+
+Mirrors the reference's registry (`pkg/metrics/metrics.go:66-162`): eviction
+counters/sizes, dropped flows, ringbuf events, kernel global counters, buffer
+gauges, interface events, eviction-latency histogram, sampling gauge, errors by
+severity — all behind a configurable prefix and verbosity level.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from prometheus_client import (
+    CollectorRegistry, Counter, Gauge, Histogram,
+)
+
+from netobserv_tpu.model.flow import GlobalCounter
+
+log = logging.getLogger("netobserv_tpu.metrics")
+
+LEVELS = ("info", "debug", "trace")
+
+
+@dataclass
+class MetricsSettings:
+    prefix: str = "ebpf_agent_"
+    level: str = "info"
+
+
+class Metrics:
+    """Facade handed to every pipeline stage (reference: `metrics.Metrics`)."""
+
+    def __init__(self, settings: MetricsSettings = MetricsSettings(),
+                 registry: CollectorRegistry | None = None):
+        self.settings = settings
+        self.registry = registry if registry is not None else CollectorRegistry()
+        p = settings.prefix
+
+        self.evictions_total = Counter(
+            p + "evictions_total", "Eviction cycles", ["source"],
+            registry=self.registry)
+        self.evicted_flows_total = Counter(
+            p + "evicted_flows_total", "Flows evicted", ["source"],
+            registry=self.registry)
+        self.dropped_flows_total = Counter(
+            p + "dropped_flows_total", "Flows dropped by the pipeline",
+            ["source"], registry=self.registry)
+        self.ringbuf_events_total = Counter(
+            p + "ringbuf_events_total",
+            "Flow events received via the map-full fallback ring buffer",
+            registry=self.registry)
+        self.kernel_counters_total = Counter(
+            p + "kernel_counters_total",
+            "Datapath global counters (scraped each eviction)", ["name"],
+            registry=self.registry)
+        self.exported_batches_total = Counter(
+            p + "exported_batches_total", "Batches exported", ["exporter"],
+            registry=self.registry)
+        self.exported_flows_total = Counter(
+            p + "exported_flows_total", "Flows exported", ["exporter"],
+            registry=self.registry)
+        self.export_errors_total = Counter(
+            p + "export_errors_total", "Export errors", ["exporter", "error"],
+            registry=self.registry)
+        self.errors_total = Counter(
+            p + "errors_total", "Agent errors by component and severity",
+            ["component", "severity"], registry=self.registry)
+        self.buffer_size = Gauge(
+            p + "buffer_size", "Pipeline buffer occupancy", ["name"],
+            registry=self.registry)
+        self.interface_events_total = Counter(
+            p + "interface_events_total", "Interface attach/detach events",
+            ["type"], registry=self.registry)
+        self.sampling_rate = Gauge(
+            p + "sampling_rate", "Configured sampling (1/N; 0=all)",
+            registry=self.registry)
+        self.eviction_seconds = Histogram(
+            p + "lookup_and_delete_map_duration_seconds",
+            "Map eviction (lookup+delete) latency",
+            buckets=(.001, .005, .01, .025, .05, .1, .25, .5, 1, 2.5, 5),
+            registry=self.registry)
+        # tpu-sketch backend metrics (new)
+        self.sketch_batches_total = Counter(
+            p + "sketch_batches_total", "Columnar batches folded on device",
+            registry=self.registry)
+        self.sketch_records_total = Counter(
+            p + "sketch_records_total", "Flow records folded on device",
+            registry=self.registry)
+        self.sketch_window_reports_total = Counter(
+            p + "sketch_window_reports_total", "Window reports emitted",
+            registry=self.registry)
+        self.sketch_ingest_seconds = Histogram(
+            p + "sketch_ingest_seconds", "Device ingest step latency",
+            buckets=(.0001, .0005, .001, .005, .01, .05, .1, .5),
+            registry=self.registry)
+
+    # --- convenience methods used by pipeline stages ---
+    def observe_eviction(self, source: str, n_flows: int, seconds: float) -> None:
+        self.evictions_total.labels(source).inc()
+        if n_flows:
+            self.evicted_flows_total.labels(source).inc(n_flows)
+        if seconds > 0:
+            self.eviction_seconds.observe(seconds)
+
+    def count_dropped(self, n: int, source: str) -> None:
+        self.dropped_flows_total.labels(source).inc(n)
+
+    def count_ringbuf_event(self) -> None:
+        self.ringbuf_events_total.inc()
+
+    def add_global_counter(self, key: GlobalCounter, val: int) -> None:
+        if val:
+            self.kernel_counters_total.labels(key.name.lower()).inc(val)
+
+    def count_exported(self, exporter: str, n_flows: int) -> None:
+        self.exported_batches_total.labels(exporter).inc()
+        if n_flows:
+            self.exported_flows_total.labels(exporter).inc(n_flows)
+
+    def count_export_error(self, exporter: str, error: str) -> None:
+        self.export_errors_total.labels(exporter, error).inc()
+
+    def count_error(self, component: str, severity: str = "error") -> None:
+        self.errors_total.labels(component, severity).inc()
+
+    def count_interface_event(self, kind: str) -> None:
+        self.interface_events_total.labels(kind).inc()

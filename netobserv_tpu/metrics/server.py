@@ -1,0 +1,57 @@
+"""Async /metrics HTTP server with optional TLS.
+
+Reference analog: `pkg/prometheus/prom_server.go:27-70` (TLS1.3 minimum when
+certs are configured) and the hardened defaults in `pkg/server/common.go`.
+"""
+
+from __future__ import annotations
+
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from prometheus_client import CollectorRegistry, generate_latest
+from prometheus_client.exposition import CONTENT_TYPE_LATEST
+
+log = logging.getLogger("netobserv_tpu.metrics.server")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: CollectorRegistry = None  # set per-server subclass
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.path.split("?")[0] not in ("/metrics", "/"):
+            self.send_error(404)
+            return
+        payload = generate_latest(self.registry)
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE_LATEST)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, fmt, *args):  # quiet access logs
+        log.debug("metrics http: " + fmt, *args)
+
+
+def start_metrics_server(registry: CollectorRegistry, address: str = "",
+                         port: int = 9090, tls_cert_path: str = "",
+                         tls_key_path: str = "") -> ThreadingHTTPServer:
+    """Start the exposition server on a daemon thread; returns the server
+    (call .shutdown() to stop)."""
+    handler = type("Handler", (_Handler,), {"registry": registry})
+    srv = ThreadingHTTPServer((address or "0.0.0.0", port), handler)
+    srv.timeout = 10  # hardened-ish defaults (reference: pkg/server/common.go)
+    if tls_cert_path and tls_key_path:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_3
+        ctx.load_cert_chain(tls_cert_path, tls_key_path)
+        srv.socket = ctx.wrap_socket(srv.socket, server_side=True)
+    t = threading.Thread(target=srv.serve_forever, name="metrics-http",
+                         daemon=True)
+    t.start()
+    log.info("metrics server listening on %s:%d (tls=%s)",
+             address or "0.0.0.0", srv.server_address[1],
+             bool(tls_cert_path))
+    return srv

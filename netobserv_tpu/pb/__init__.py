@@ -1,0 +1,4 @@
+"""Generated protobuf modules (protoc --python_out over proto/*.proto).
+
+Regenerate with: make gen-protobuf (see Makefile).
+"""

@@ -111,6 +111,11 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     When `sketch_axis` is set (inside shard_map over a 2D mesh), the Count-Min
     arrays are width-sharded across that axis: updates mask out-of-shard
     columns, queries psum partial gathers (model-parallel sketches).
+
+    Note: width-sharded mode pays two small psums per batch (top-K candidate
+    scoring) over the sketch axis — ~d*B floats, e.g. 128KB at d=4/B=8192,
+    negligible on ICI. The data axis stays collective-free until window roll.
+    A future refinement could defer table re-scoring entirely to the merge.
     """
     words = arrays["keys"]
     valid = arrays["valid"]
