@@ -38,7 +38,7 @@ class FlowsAgent:
 
     def __init__(self, cfg: AgentConfig, fetcher: FlowFetcher,
                  exporter: Exporter, metrics: Optional[Metrics] = None,
-                 agent_ip: str = ""):
+                 agent_ip: str = "", iface_informer=None):
         self.cfg = cfg
         self.fetcher = fetcher
         self.exporter = exporter
@@ -57,7 +57,10 @@ class FlowsAgent:
             fetcher, self._evicted_q,
             active_timeout_s=cfg.cache_active_timeout, agent_ip=agent_ip,
             metrics=self.metrics,
-            stale_purge_s=cfg.stale_entries_evict_timeout)
+            stale_purge_s=cfg.stale_entries_evict_timeout,
+            # columnar fast path: exporters that consume raw evictions skip
+            # per-record Python object materialization entirely
+            columnar=getattr(exporter, "supports_columnar", False))
         self.limiter = CapacityLimiter(
             self._evicted_q, self._export_q, metrics=self.metrics)
         self.terminal = QueueExporter(
@@ -78,6 +81,16 @@ class FlowsAgent:
 
         if cfg.sampling:
             self.metrics.sampling_rate.set(cfg.sampling)
+
+        # discovery is only useful when the datapath actually attaches to
+        # interfaces (kernel loader); replay/fake fetchers skip it unless
+        # a custom informer is injected
+        self.iface_listener = None
+        if iface_informer is not None or getattr(
+                fetcher, "needs_iface_discovery", False):
+            from netobserv_tpu.agent.interfaces_listener import InterfaceListener
+            self.iface_listener = InterfaceListener(
+                cfg, fetcher, metrics=self.metrics, informer=iface_informer)
 
     @classmethod
     def from_config(cls, cfg: AgentConfig) -> "FlowsAgent":
@@ -102,6 +115,8 @@ class FlowsAgent:
     def run(self, stop: Optional[threading.Event] = None) -> None:
         """Start the pipeline and block until `stop` is set (or .stop())."""
         self._set_status(Status.STARTING)
+        if self.iface_listener is not None:
+            self.iface_listener.start()
         self.terminal.start()
         self.limiter.start()
         if self.accounter is not None:
@@ -125,6 +140,8 @@ class FlowsAgent:
             return
         self._set_status(Status.STOPPING)
         # stop stages source-first, with a final eviction so nothing is lost
+        if self.iface_listener is not None:
+            self.iface_listener.stop()
         self.map_tracer.stop(final_evict=True)
         if self.rb_tracer is not None:
             self.rb_tracer.stop()

@@ -1,0 +1,109 @@
+"""Interface listener: discovery events -> filtered attach/detach with retry.
+
+Reference analog: `pkg/agent/interfaces_listener.go` — allow/deny filtering,
+per-event retry with linear backoff (TC_ATTACH_RETRIES, 300ms*attempt),
+tcx/tc/any attach-mode fallback, and registration of the interface namer.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Optional
+
+from netobserv_tpu.config import AgentConfig
+from netobserv_tpu.datapath.fetcher import FlowFetcher
+from netobserv_tpu.ifaces import (
+    Event, EventType, InterfaceFilter, Poller, Registerer, Watcher,
+)
+from netobserv_tpu.model.record import set_interface_namer
+
+log = logging.getLogger("netobserv_tpu.agent.ifaces")
+
+_RETRY_BACKOFF_S = 0.3
+
+
+class DoNotRetryError(Exception):
+    """Attach failure that retrying cannot fix (reference: tracer.Error with
+    DoNotRetry, `pkg/tracer/errors.go`)."""
+
+
+class InterfaceListener:
+    def __init__(self, cfg: AgentConfig, fetcher: FlowFetcher,
+                 metrics=None, informer=None):
+        self._cfg = cfg
+        self._fetcher = fetcher
+        self._metrics = metrics
+        if informer is not None:
+            self._informer = informer
+        elif cfg.listen_interfaces == "poll":
+            self._informer = Poller(period_s=cfg.listen_poll_period)
+        else:
+            self._informer = Watcher()
+        self._filter = InterfaceFilter(
+            allowed=cfg.interfaces, excluded=cfg.exclude_interfaces,
+            ip_cidrs=cfg.interface_ips)
+        self._registerer = Registerer(cfg.preferred_interface_for_mac_prefix)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.attached: set[int] = set()
+
+    def start(self) -> None:
+        set_interface_namer(self._registerer.name_for)
+        events = self._informer.subscribe()
+        self._thread = threading.Thread(
+            target=self._loop, args=(events,), name="iface-listener",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._informer.stop()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self, events: "queue.Queue[Event]") -> None:
+        while not self._stop.is_set():
+            try:
+                event = events.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            self._registerer.observe(event)
+            if self._metrics is not None:
+                self._metrics.count_interface_event(event.type.value)
+            iface = event.interface
+            if event.type == EventType.ADDED:
+                if not self._filter.allowed(iface):
+                    log.debug("interface %s excluded by filter", iface.name)
+                    continue
+                self._attach_with_retry(iface)
+            else:
+                try:
+                    self._fetcher.detach(iface.index, iface.name)
+                    self.attached.discard(iface.index)
+                except Exception as exc:
+                    log.debug("detach %s failed: %s", iface.name, exc)
+
+    def _attach_with_retry(self, iface) -> None:
+        retries = max(self._cfg.tc_attach_retries, 1)
+        for attempt in range(1, retries + 1):
+            if self._stop.is_set():
+                return
+            try:
+                self._fetcher.attach(iface.index, iface.name,
+                                     self._cfg.direction)
+                self.attached.add(iface.index)
+                log.info("attached to %s (index %d)", iface.name, iface.index)
+                return
+            except DoNotRetryError as exc:
+                log.warning("attach %s failed permanently: %s",
+                            iface.name, exc)
+                return
+            except Exception as exc:
+                log.warning("attach %s failed (attempt %d/%d): %s",
+                            iface.name, attempt, retries, exc)
+                time.sleep(_RETRY_BACKOFF_S * attempt)
+        if self._metrics is not None:
+            self._metrics.count_error("iface-listener")

@@ -1,0 +1,26 @@
+"""Canonical datapath map-name registry.
+
+Reference analog: `pkg/maps/maps.go` + `make verify-maps` — one authoritative
+list, consistency-tested against the C source (tests/test_datapath.py) so the
+loader, bpfman deployment args, and the C can never drift apart.
+"""
+
+MAPS = [
+    "aggregated_flows",
+    "direct_flows",
+    "flows_dns",
+    "flows_drops",
+    "flows_nevents",
+    "flows_xlat",
+    "flows_extra",
+    "flows_quic",
+    "packet_records",
+    "dns_inflight",
+    "dns_scratch",
+    "global_counters",
+    "filter_rules",
+    "filter_peers",
+    "ipsec_ingress_inflight",
+    "ipsec_egress_inflight",
+    "ssl_events",
+]

@@ -1,0 +1,199 @@
+// flowpack — native host-side hot path for the capture plane.
+//
+// Converts raw flow-event buffers (as drained from kernel maps / ring buffers)
+// into the columnar tensors the TPU analytics plane consumes, and merges
+// per-CPU feature-map partials. This is the native replacement for the
+// reference's per-record decode loop (pkg/model/record.go:227, its hottest
+// allocation site) — done as flat array passes instead.
+//
+// Layout contract: struct definitions come from ../bpf/records.h, the same
+// header the eBPF datapath compiles; tests/test_layout_parity.py pins both
+// sides against the numpy dtypes.
+//
+// C ABI only (consumed via ctypes). All output buffers are caller-allocated.
+
+#include <cstdint>
+#include <cstring>
+
+#define NO_HOST_BUILD 1
+#include "../bpf/records.h"
+
+extern "C" {
+
+// Column pointers for fp_pack. Each points at a caller-allocated array of
+// capacity >= n rows (keys: n*10 u32, row-major).
+struct fp_columns {
+    uint32_t *keys;        // [n][10] packed key words
+    uint64_t *bytes;       // [n]
+    uint32_t *packets;     // [n]
+    uint32_t *tcp_flags;   // [n]
+    uint32_t *eth_protocol;// [n]
+    uint32_t *direction;   // [n]
+    uint32_t *if_index;    // [n]
+    uint32_t *dscp;        // [n]
+    uint32_t *sampling;    // [n]
+    uint64_t *first_seen_ns; // [n]
+    uint64_t *last_seen_ns;  // [n]
+};
+
+// Pack n contiguous no_flow_event records into columns. Returns n.
+size_t fp_pack(const uint8_t *events, size_t n, struct fp_columns *out) {
+    const struct no_flow_event *ev =
+        reinterpret_cast<const struct no_flow_event *>(events);
+    for (size_t i = 0; i < n; i++) {
+        const struct no_flow_key *k = &ev[i].key;
+        const struct no_flow_stats *s = &ev[i].stats;
+        uint32_t *kw = out->keys + i * 10;
+        std::memcpy(kw, k->src_ip, 16);      // words 0..3
+        std::memcpy(kw + 4, k->dst_ip, 16);  // words 4..7
+        kw[8] = (static_cast<uint32_t>(k->src_port) << 16) | k->dst_port;
+        kw[9] = (static_cast<uint32_t>(k->proto) << 16) |
+                (static_cast<uint32_t>(k->icmp_type) << 8) | k->icmp_code;
+        out->bytes[i] = s->bytes;
+        out->packets[i] = s->packets;
+        out->tcp_flags[i] = s->tcp_flags;
+        out->eth_protocol[i] = s->eth_protocol;
+        out->direction[i] = s->direction_first;
+        out->if_index[i] = s->if_index_first;
+        out->dscp[i] = s->dscp;
+        out->sampling[i] = s->sampling;
+        out->first_seen_ns[i] = s->first_seen_ns;
+        out->last_seen_ns[i] = s->last_seen_ns;
+    }
+    return n;
+}
+
+static inline void merge_times(uint64_t *dfirst, uint64_t *dlast,
+                               uint64_t sfirst, uint64_t slast) {
+    if (*dfirst == 0 || (sfirst != 0 && sfirst < *dfirst))
+        *dfirst = sfirst;
+    if (slast > *dlast)
+        *dlast = slast;
+}
+
+static inline uint16_t sat_add16(uint16_t a, uint16_t b) {
+    uint32_t s = static_cast<uint32_t>(a) + b;
+    return s > 0xFFFF ? 0xFFFF : static_cast<uint16_t>(s);
+}
+
+// Merge per-CPU partials of the base stats struct.
+// values: n_cpu consecutive no_flow_stats images for ONE map entry.
+// out: one no_flow_stats. Mirrors model/accumulate.py accumulate_base.
+void fp_merge_stats(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
+    struct no_flow_stats out;
+    std::memcpy(&out, values, sizeof(out));
+    const struct no_flow_stats *v =
+        reinterpret_cast<const struct no_flow_stats *>(values);
+    for (size_t c = 1; c < n_cpu; c++) {
+        const struct no_flow_stats *s = &v[c];
+        bool dst_empty = out.first_seen_ns == 0 && out.packets == 0;
+        merge_times(&out.first_seen_ns, &out.last_seen_ns,
+                    s->first_seen_ns, s->last_seen_ns);
+        uint64_t nb = out.bytes + s->bytes;
+        out.bytes = nb < out.bytes ? UINT64_MAX : nb;  // saturate on wrap
+        uint64_t np = static_cast<uint64_t>(out.packets) + s->packets;
+        out.packets = np > UINT32_MAX ? UINT32_MAX
+                                      : static_cast<uint32_t>(np);
+        out.tcp_flags |= s->tcp_flags;
+        if (s->eth_protocol) out.eth_protocol = s->eth_protocol;
+        if (s->dscp) out.dscp = s->dscp;
+        if (s->sampling) out.sampling = s->sampling;
+        if (s->errno_fallback) out.errno_fallback = s->errno_fallback;
+        bool src_mac_zero = true, dst_mac_zero = true;
+        for (int i = 0; i < NO_ETH_ALEN; i++) {
+            if (out.src_mac[i]) src_mac_zero = false;
+            if (out.dst_mac[i]) dst_mac_zero = false;
+        }
+        if (src_mac_zero) std::memcpy(out.src_mac, s->src_mac, NO_ETH_ALEN);
+        if (dst_mac_zero) std::memcpy(out.dst_mac, s->dst_mac, NO_ETH_ALEN);
+        if (dst_empty) {
+            out.if_index_first = s->if_index_first;
+            out.direction_first = s->direction_first;
+        }
+        if (s->ssl_version) out.ssl_version = s->ssl_version;
+        if (s->tls_cipher_suite) out.tls_cipher_suite = s->tls_cipher_suite;
+        if (s->tls_key_share) out.tls_key_share = s->tls_key_share;
+        out.tls_types |= s->tls_types;
+        out.misc_flags |= s->misc_flags;
+        for (int j = 0; j < s->n_observed_intf; j++) {
+            bool seen = false;
+            for (int i = 0; i < out.n_observed_intf; i++) {
+                if (out.observed_intf[i] == s->observed_intf[j] &&
+                    out.observed_direction[i] == s->observed_direction[j]) {
+                    seen = true;
+                    break;
+                }
+            }
+            if (!seen && out.n_observed_intf < NO_MAX_OBSERVED_INTERFACES) {
+                out.observed_intf[out.n_observed_intf] = s->observed_intf[j];
+                out.observed_direction[out.n_observed_intf] =
+                    s->observed_direction[j];
+                out.n_observed_intf++;
+            }
+        }
+    }
+    std::memcpy(out_buf, &out, sizeof(out));
+}
+
+// Merge per-CPU partials of the extra (rtt/ipsec) record.
+void fp_merge_extra(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
+    struct no_extra_rec out;
+    std::memcpy(&out, values, sizeof(out));
+    const struct no_extra_rec *v =
+        reinterpret_cast<const struct no_extra_rec *>(values);
+    for (size_t c = 1; c < n_cpu; c++) {
+        const struct no_extra_rec *s = &v[c];
+        merge_times(&out.first_seen_ns, &out.last_seen_ns,
+                    s->first_seen_ns, s->last_seen_ns);
+        if (s->rtt_ns > out.rtt_ns) out.rtt_ns = s->rtt_ns;
+        if (out.ipsec_ret < s->ipsec_ret) {
+            out.ipsec_ret = s->ipsec_ret;
+            out.ipsec_encrypted = s->ipsec_encrypted;
+        } else if (out.ipsec_ret == s->ipsec_ret && s->ipsec_encrypted) {
+            out.ipsec_encrypted = s->ipsec_encrypted;
+        }
+    }
+    std::memcpy(out_buf, &out, sizeof(out));
+}
+
+// Merge per-CPU partials of the drops record.
+void fp_merge_drops(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
+    struct no_drops_rec out;
+    std::memcpy(&out, values, sizeof(out));
+    const struct no_drops_rec *v =
+        reinterpret_cast<const struct no_drops_rec *>(values);
+    for (size_t c = 1; c < n_cpu; c++) {
+        const struct no_drops_rec *s = &v[c];
+        merge_times(&out.first_seen_ns, &out.last_seen_ns,
+                    s->first_seen_ns, s->last_seen_ns);
+        out.bytes = sat_add16(out.bytes, s->bytes);
+        out.packets = sat_add16(out.packets, s->packets);
+        out.latest_flags |= s->latest_flags;
+        if (s->latest_cause) out.latest_cause = s->latest_cause;
+        if (s->latest_state) out.latest_state = s->latest_state;
+    }
+    std::memcpy(out_buf, &out, sizeof(out));
+}
+
+// Merge per-CPU partials of the DNS record (max latency wins).
+void fp_merge_dns(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
+    struct no_dns_rec out;
+    std::memcpy(&out, values, sizeof(out));
+    const struct no_dns_rec *v =
+        reinterpret_cast<const struct no_dns_rec *>(values);
+    for (size_t c = 1; c < n_cpu; c++) {
+        const struct no_dns_rec *s = &v[c];
+        merge_times(&out.first_seen_ns, &out.last_seen_ns,
+                    s->first_seen_ns, s->last_seen_ns);
+        out.dns_flags |= s->dns_flags;
+        if (s->dns_id) out.dns_id = s->dns_id;
+        if (out.errno_code != s->errno_code) out.errno_code = s->errno_code;
+        if (s->latency_ns > out.latency_ns) out.latency_ns = s->latency_ns;
+        if (s->name[0]) std::memcpy(out.name, s->name, NO_DNS_NAME_MAX_LEN);
+    }
+    std::memcpy(out_buf, &out, sizeof(out));
+}
+
+uint32_t fp_abi_version(void) { return 1; }
+
+}  // extern "C"

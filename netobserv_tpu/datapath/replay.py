@@ -102,6 +102,17 @@ class PcapReplayFetcher:
         self._idx = 0
         self._lock = threading.Lock()
         self.attached: dict[int, str] = {}
+        # rebase capture timestamps into the monotonic domain so the standard
+        # mono->wall reconstruction yields sane (current) wall times
+        if self._windows:
+            first_ts = min(int(w["stats"]["first_seen_ns"].min())
+                           for w in self._windows if len(w))
+            offset = time.clock_gettime_ns(time.CLOCK_MONOTONIC) - first_ts
+            for w in self._windows:
+                for fld in ("first_seen_ns", "last_seen_ns"):
+                    w["stats"][fld] = (
+                        w["stats"][fld].astype(np.int64) + offset
+                    ).astype(np.uint64)
 
     @property
     def n_windows(self) -> int:
@@ -115,7 +126,7 @@ class PcapReplayFetcher:
         with open(path, "rb") as fh:
             data = fh.read()
         if len(data) < 24:
-            return []
+            raise ValueError(f"not a pcap file (too short): {path}")
         magic = struct.unpack("<I", data[:4])[0]
         if magic == 0xA1B2C3D4:
             endian, tscale = "<", 1_000  # usec -> ns

@@ -13,11 +13,20 @@ log = logging.getLogger("netobserv_tpu.exporter")
 
 
 class Exporter:
-    """Subclasses implement export_batch(); name is the metrics label."""
+    """Subclasses implement export_batch(); name is the metrics label.
+
+    Exporters that can consume raw evictions columnar-first (without Record
+    materialization — the per-record decode loop is the reference's hottest
+    path) set `supports_columnar` and implement export_evicted().
+    """
 
     name = "exporter"
+    supports_columnar = False
 
     def export_batch(self, records: list[Record]) -> None:
+        raise NotImplementedError
+
+    def export_evicted(self, evicted) -> None:  # EvictedFlows
         raise NotImplementedError
 
     def close(self) -> None:
@@ -63,9 +72,12 @@ class QueueExporter:
                 continue
             self._export(batch)
 
-    def _export(self, batch: list[Record]) -> None:
+    def _export(self, batch) -> None:
         try:
-            self._exporter.export_batch(batch)
+            if isinstance(batch, list):
+                self._exporter.export_batch(batch)
+            else:  # EvictedFlows on the columnar fast path
+                self._exporter.export_evicted(batch)
             if self._metrics is not None:
                 self._metrics.count_exported(self._exporter.name, len(batch))
         except Exception as exc:  # exporter errors must not kill the pipeline

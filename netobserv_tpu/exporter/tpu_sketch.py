@@ -23,6 +23,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from netobserv_tpu.datapath import flowpack
 from netobserv_tpu.exporter.base import Exporter
 from netobserv_tpu.model.columnar import FlowBatch, unpack_key_words
 from netobserv_tpu.model.flow import ip_from_16
@@ -79,6 +80,7 @@ def report_to_json(report, max_heavy: int = 64) -> dict:
 
 class TpuSketchExporter(Exporter):
     name = "tpu-sketch"
+    supports_columnar = True
 
     def __init__(self, batch_size: int = 8192, window_s: float = 60.0,
                  sketch_cfg=None, mesh_shape: str = "", devices: str = "",
@@ -96,6 +98,8 @@ class TpuSketchExporter(Exporter):
         self._metrics = metrics
         self._lock = threading.Lock()
         self._pending: list[Record] = []
+        self._pending_ev: list = []  # EvictedFlows on the columnar fast path
+        self._pending_ev_n = 0
         self._window_deadline = time.monotonic() + window_s
         self._n_windows_saved = 0
         self._ckpt = None
@@ -158,12 +162,101 @@ class TpuSketchExporter(Exporter):
                     self._pending = []
                 self._emit_window()
 
+    def export_evicted(self, evicted) -> None:
+        """Columnar fast path: fold raw evictions without building Records."""
+        with self._lock:
+            self._pending_ev.append(evicted)
+            self._pending_ev_n += len(evicted)
+            if self._pending_ev_n >= self._batch_size:
+                self._fold_pending_events()
+            if time.monotonic() >= self._window_deadline:
+                self._drain_pending_locked()
+                self._emit_window()
+
+    @staticmethod
+    def _concat_feature(pending, attr, dtype):
+        cols = [getattr(e, attr) for e in pending]
+        if not any(c is not None and len(c) for c in cols):
+            return None
+        return np.concatenate([
+            c if c is not None and len(c) else np.zeros(len(e.events), dtype)
+            for e, c in zip(pending, cols)])
+
+    def _fold_pending_events(self, final: bool = False) -> None:
+        """Concatenate queued evictions and fold full batches; the remainder is
+        requeued (or, when `final`, folded as a padded partial batch)."""
+        from netobserv_tpu.datapath.fetcher import EvictedFlows
+        from netobserv_tpu.model import binfmt
+
+        if not self._pending_ev:
+            return
+        events = np.concatenate([e.events for e in self._pending_ev])
+        extra = self._concat_feature(self._pending_ev, "extra",
+                                     binfmt.EXTRA_REC_DTYPE)
+        dns = self._concat_feature(self._pending_ev, "dns",
+                                   binfmt.DNS_REC_DTYPE)
+        drops = self._concat_feature(self._pending_ev, "drops",
+                                     binfmt.DROPS_REC_DTYPE)
+        bs = self._batch_size
+
+        def sl(col, lo, hi):
+            return col[lo:hi] if col is not None else None
+
+        off = 0
+        while len(events) - off >= bs:
+            self._fold_events(events[off:off + bs], sl(extra, off, off + bs),
+                              sl(dns, off, off + bs), sl(drops, off, off + bs))
+            off += bs
+        rest = len(events) - off
+        if rest and final:
+            self._fold_events(events[off:], sl(extra, off, None),
+                              sl(dns, off, None), sl(drops, off, None))
+            rest = 0
+        if rest:
+            self._pending_ev = [EvictedFlows(
+                events[off:], extra=sl(extra, off, None),
+                dns=sl(dns, off, None), drops=sl(drops, off, None))]
+            self._pending_ev_n = rest
+        else:
+            self._pending_ev = []
+            self._pending_ev_n = 0
+
+    def _fold_events(self, events, extra, dns, drops) -> None:
+        t0 = time.perf_counter()
+        batch = flowpack.pack_events(events, batch_size=self._batch_size)
+        n = len(events)
+        # keep this overlay in lockstep with FlowBatch.from_events so the
+        # Record path and the columnar fast path can never diverge
+        if extra is not None:
+            batch.rtt_us[:n] = extra["rtt_ns"] // 1000
+        if dns is not None:
+            batch.dns_latency_us[:n] = dns["latency_ns"] // 1000
+            batch.dns_id[:n] = dns["dns_id"]
+            batch.dns_flags[:n] = dns["dns_flags"]
+            batch.dns_errno[:n] = dns["errno"]
+        if drops is not None:
+            batch.drop_bytes[:n] = drops["bytes"]
+            batch.drop_packets[:n] = drops["packets"]
+        arrays = self._sk.batch_to_device(batch)
+        if self._distributed:
+            arrays = self._pm.shard_batch(self._mesh, arrays)
+        self._state = self._ingest(self._state, arrays)
+        if self._metrics is not None:
+            self._metrics.sketch_batches_total.inc()
+            self._metrics.sketch_records_total.inc(n)
+            self._metrics.sketch_ingest_seconds.observe(
+                time.perf_counter() - t0)
+
+    def _drain_pending_locked(self) -> None:
+        if self._pending:
+            self._fold(self._pending)
+            self._pending = []
+        self._fold_pending_events(final=True)
+
     def flush(self) -> None:
         """Fold pending records and close the current window now."""
         with self._lock:
-            if self._pending:
-                self._fold(self._pending)
-                self._pending = []
+            self._drain_pending_locked()
             self._emit_window()
 
     def close(self) -> None:
@@ -178,9 +271,7 @@ class TpuSketchExporter(Exporter):
         while not self._closed.wait(timeout=poll):
             with self._lock:
                 if time.monotonic() >= self._window_deadline:
-                    if self._pending:
-                        self._fold(self._pending)
-                        self._pending = []
+                    self._drain_pending_locked()
                     self._emit_window()
 
     # --- internals ---

@@ -27,7 +27,8 @@ class MapTracer:
     def __init__(self, fetcher: FlowFetcher, out: "queue.Queue[list[Record]]",
                  active_timeout_s: float = 5.0, agent_ip: str = "",
                  namer: Optional[InterfaceNamer] = None,
-                 metrics=None, stale_purge_s: float = 5.0):
+                 metrics=None, stale_purge_s: float = 5.0,
+                 columnar: bool = False):
         self._fetcher = fetcher
         self._out = out
         self._timeout = active_timeout_s
@@ -36,6 +37,9 @@ class MapTracer:
         self._clock = MonotonicClock()
         self._metrics = metrics
         self._stale_purge_s = stale_purge_s
+        # columnar mode: forward EvictedFlows untouched (no per-record Python
+        # objects) for exporters that consume columns directly (tpu-sketch)
+        self._columnar = columnar
         self._flush = threading.Event()
         self._stop = threading.Event()
         self._evict_lock = threading.Lock()  # one eviction at a time
@@ -84,6 +88,15 @@ class MapTracer:
             for key, val in self._fetcher.read_global_counters().items():
                 self._metrics.add_global_counter(key, val)
         if len(evicted) == 0:
+            return
+        if self._columnar:
+            try:
+                self._out.put_nowait(evicted)
+            except queue.Full:
+                if self._metrics is not None:
+                    self._metrics.count_dropped(len(evicted), "map_tracer")
+                log.warning("eviction dropped: downstream buffer full "
+                            "(%d flows)", len(evicted))
             return
         namer = self._namer or interface_namer()
         records = records_from_events(

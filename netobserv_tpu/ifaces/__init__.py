@@ -1,0 +1,13 @@
+"""Interface discovery (L2 in SURVEY.md §1).
+
+Reference analog: `pkg/ifaces/` — an Informer (watcher via netlink
+subscription, or poller via periodic link dumps) feeding attach/detach events,
+a Registerer caching (ifindex, MAC) -> name, and name/CIDR filters. Implemented
+over raw AF_NETLINK sockets (no external deps).
+"""
+
+from netobserv_tpu.ifaces.informers import (  # noqa: F401
+    Event, EventType, Interface, Poller, Watcher,
+)
+from netobserv_tpu.ifaces.registerer import Registerer  # noqa: F401
+from netobserv_tpu.ifaces.filter import InterfaceFilter  # noqa: F401

@@ -1,0 +1,156 @@
+"""Informers: interface lifecycle event sources.
+
+Reference analog: `pkg/ifaces/watcher.go` (netlink subscription + netns dir
+watching) and `pkg/ifaces/poller.go` (periodic LinkList diff). Both emit the
+same Event stream into a queue.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import os
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from netobserv_tpu.ifaces import netlink
+
+log = logging.getLogger("netobserv_tpu.ifaces")
+
+NETNS_DIR = "/var/run/netns"
+
+
+class EventType(enum.Enum):
+    ADDED = "added"
+    REMOVED = "removed"
+
+
+@dataclass(frozen=True)
+class Interface:
+    index: int
+    name: str
+    mac: bytes
+    netns: str = ""  # "" = default namespace
+
+
+@dataclass
+class Event:
+    type: EventType
+    interface: Interface
+
+
+class _InformerBase:
+    def __init__(self, out: "Optional[queue.Queue[Event]]" = None):
+        self.events: "queue.Queue[Event]" = out if out is not None else queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._known: dict[tuple[str, int], Interface] = {}
+
+    def subscribe(self) -> "queue.Queue[Event]":
+        self._thread = threading.Thread(
+            target=self._loop, name=type(self).__name__.lower(), daemon=True)
+        self._thread.start()
+        return self.events
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _emit_current(self, links: list[netlink.LinkInfo], netns: str = "") -> None:
+        """Diff a full link list against known state, emitting add/remove."""
+        current = {}
+        for link in links:
+            if not link.up:
+                continue
+            iface = Interface(link.index, link.name, link.mac, netns)
+            current[(netns, link.index)] = iface
+        for key, iface in current.items():
+            if key not in self._known:
+                self._known[key] = iface
+                self.events.put(Event(EventType.ADDED, iface))
+        for key in [k for k in self._known if k[0] == netns]:
+            if key not in current:
+                iface = self._known.pop(key)
+                self.events.put(Event(EventType.REMOVED, iface))
+
+    def _loop(self) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class Poller(_InformerBase):
+    """Periodic full link dumps, diffed (LISTEN_INTERFACES=poll)."""
+
+    def __init__(self, period_s: float = 10.0, **kw):
+        super().__init__(**kw)
+        self._period = period_s
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._emit_current(netlink.dump_links())
+            except OSError as exc:
+                log.warning("link dump failed: %s", exc)
+            self._stop.wait(self._period)
+
+
+class Watcher(_InformerBase):
+    """netlink link-event subscription with an initial dump; also notices new
+    network namespaces appearing under /var/run/netns (LISTEN_INTERFACES=watch).
+    """
+
+    def __init__(self, netns_dir: str = NETNS_DIR, **kw):
+        super().__init__(**kw)
+        self._netns_dir = netns_dir
+        self._known_netns: set[str] = set()
+
+    def _loop(self) -> None:
+        try:
+            sock = netlink.subscribe_links()
+        except OSError as exc:
+            log.warning("netlink subscription failed (%s); falling back to "
+                        "polling", exc)
+            self._poll_fallback()
+            return
+        try:
+            self._emit_current(netlink.dump_links())
+            while not self._stop.is_set():
+                for link in netlink.read_link_events(sock):
+                    self._handle_event(link)
+                self._check_netns()
+        finally:
+            sock.close()
+
+    def _handle_event(self, link: netlink.LinkInfo) -> None:
+        key = ("", link.index)
+        if link.change_type == netlink.RTM_DELLINK or not link.up:
+            iface = self._known.pop(key, None)
+            if iface is not None:
+                self.events.put(Event(EventType.REMOVED, iface))
+        else:
+            iface = Interface(link.index, link.name, link.mac, "")
+            if key not in self._known:
+                self._known[key] = iface
+                self.events.put(Event(EventType.ADDED, iface))
+
+    def _check_netns(self) -> None:
+        """Lightweight namespace discovery: list /var/run/netns for additions.
+        (Entering the namespace to enumerate its links needs setns/CAP_SYS_ADMIN
+        and lands with the kernel loader.)"""
+        try:
+            names = set(os.listdir(self._netns_dir))
+        except OSError:
+            return
+        for name in names - self._known_netns:
+            log.info("new network namespace observed: %s", name)
+        self._known_netns = names
+
+    def _poll_fallback(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._emit_current(netlink.dump_links())
+            except OSError:
+                pass
+            self._stop.wait(10.0)

@@ -1,0 +1,54 @@
+"""Registerer: caches (ifindex, MAC) -> name for interface naming.
+
+Reference analog: `pkg/ifaces/registerer.go` — a decorator over an informer
+that remembers every interface it has seen, so flow records can be named even
+after the interface disappears. MAC is part of the key because ifindexes are
+reused across namespaces; when several names share an index, a matching MAC
+wins, with an optional preferred-name tie-break for MAC-prefix collisions
+(PREFERRED_INTERFACE_FOR_MAC_PREFIX).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from netobserv_tpu.ifaces.informers import Event, EventType, Interface
+
+
+class Registerer:
+    def __init__(self, preferred_for_mac_prefix: str = ""):
+        self._lock = threading.Lock()
+        self._by_index: dict[int, list[Interface]] = {}
+        # "0a58:ovn-k8s-mp" style "prefix:name" preference
+        self._pref_prefix = b""
+        self._pref_name = ""
+        if preferred_for_mac_prefix and ":" in preferred_for_mac_prefix:
+            prefix, name = preferred_for_mac_prefix.split(":", 1)
+            self._pref_prefix = bytes.fromhex(prefix)
+            self._pref_name = name
+
+    def observe(self, event: Event) -> None:
+        iface = event.interface
+        with self._lock:
+            entries = self._by_index.setdefault(iface.index, [])
+            if event.type == EventType.ADDED:
+                if all(e.mac != iface.mac or e.name != iface.name
+                       for e in entries):
+                    entries.append(iface)
+            # REMOVED keeps the cache entry: records may still reference it
+
+    def name_for(self, if_index: int, mac: bytes) -> str:
+        """The interfaceNamer hook (`model.set_interface_namer` target)."""
+        with self._lock:
+            entries = self._by_index.get(if_index, [])
+            if not entries:
+                return str(if_index)
+            matches = [e for e in entries if e.mac == mac]
+            if not matches:
+                return entries[-1].name
+            if (len(matches) > 1 and self._pref_prefix
+                    and mac.startswith(self._pref_prefix)):
+                for e in matches:
+                    if e.name.startswith(self._pref_name):
+                        return e.name
+            return matches[-1].name

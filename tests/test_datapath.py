@@ -1,0 +1,56 @@
+"""Datapath C source checks: syntax validity (host compiler), map-name
+registry consistency (the reference's `make verify-maps` analog), and config
+constant <-> loader contract."""
+
+import re
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from netobserv_tpu.datapath.maps import MAPS
+from netobserv_tpu.model.flow import GlobalCounter
+
+BPF_DIR = Path(__file__).resolve().parent.parent / "netobserv_tpu" / "datapath" / "bpf"
+
+
+def test_flowpath_syntax_checks_as_c():
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    res = subprocess.run(
+        [cc, "-fsyntax-only", "-x", "c", "-std=gnu11", "-Wall",
+         "-DNO_BPF_BUILD", str(BPF_DIR / "flowpath.c")],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+
+
+def test_map_registry_matches_c_source():
+    src = (BPF_DIR / "maps.h").read_text()
+    defined = set(re.findall(r"DEF_(?:MAP|RINGBUF)\((\w+)", src)) - {"_name"}
+    assert defined == set(MAPS), (
+        f"registry drift: only-in-C={defined - set(MAPS)}, "
+        f"only-in-registry={set(MAPS) - defined}")
+
+
+def test_counter_enum_matches_c():
+    src = (BPF_DIR / "config.h").read_text()
+    for ctr in GlobalCounter:
+        if ctr is GlobalCounter.MAX:
+            assert f"NO_COUNTER_MAX = {ctr.value}" in src
+        else:
+            assert f"NO_CTR_{ctr.name} = {ctr.value}" in src, ctr
+
+
+def test_config_constants_present():
+    """Every loader-rewritten knob the agent config can set must exist in C."""
+    src = (BPF_DIR / "config.h").read_text()
+    for knob in ["cfg_sampling", "cfg_trace_messages", "cfg_enable_rtt",
+                 "cfg_enable_dns_tracking", "cfg_dns_port",
+                 "cfg_enable_pkt_drops", "cfg_enable_flow_filtering",
+                 "cfg_enable_network_events", "cfg_network_events_group_id",
+                 "cfg_enable_pkt_translation", "cfg_enable_ipsec",
+                 "cfg_enable_tls_tracking", "cfg_quic_mode",
+                 "cfg_enable_ringbuf_fallback", "cfg_enable_pca"]:
+        assert re.search(rf"volatile const \w+ {knob}\b", src), knob

@@ -1,0 +1,92 @@
+"""Native flowpack vs numpy fallback equivalence (and the native build)."""
+
+import numpy as np
+import pytest
+
+from netobserv_tpu.datapath import flowpack
+from netobserv_tpu.model import binfmt
+from tests.test_model import make_event
+
+
+@pytest.fixture(scope="module")
+def native():
+    if not flowpack.build_native():
+        pytest.skip("no g++ available to build libflowpack")
+    assert flowpack.native_available()
+    return True
+
+
+def _events(n=17):
+    events = np.zeros(n, dtype=binfmt.FLOW_EVENT_DTYPE)
+    for i in range(n):
+        events[i] = make_event(sport=1000 + i, nbytes=10 * i + 1, pkts=i + 1)
+    events["stats"]["sampling"] = 50
+    events["stats"]["dscp"] = 46
+    return events
+
+
+class TestPack:
+    def test_native_matches_numpy(self, native):
+        events = _events()
+        a = flowpack.pack_events(events, batch_size=32, use_native=True)
+        b = flowpack.pack_events(events, batch_size=32, use_native=False)
+        for name, col in a.columns().items():
+            np.testing.assert_array_equal(
+                col, getattr(b, name), err_msg=f"column {name}")
+
+    def test_pack_from_raw_bytes(self, native):
+        events = _events(5)
+        batch = flowpack.pack_events(events.tobytes(), use_native=True)
+        assert batch.n_valid == 5
+        assert batch.bytes[:5].tolist() == [1, 11, 21, 31, 41]
+
+    def test_empty(self, native):
+        batch = flowpack.pack_events(b"", batch_size=4)
+        assert batch.n_valid == 0
+
+
+class TestMergePercpu:
+    @pytest.mark.parametrize("kind", ["stats", "extra", "drops", "dns"])
+    def test_native_matches_python(self, native, kind):
+        rng = np.random.default_rng(3)
+        dtype = flowpack._MERGE_FNS[kind][1]
+        vals = np.zeros(4, dtype=dtype)
+        # random-ish partials with valid fields
+        for i in range(4):
+            vals[i]["first_seen_ns"] = int(rng.integers(1, 10**9))
+            vals[i]["last_seen_ns"] = int(rng.integers(10**9, 2 * 10**9))
+            if kind == "stats":
+                vals[i]["bytes"] = int(rng.integers(0, 10**6))
+                vals[i]["packets"] = int(rng.integers(0, 1000))
+                vals[i]["tcp_flags"] = int(rng.integers(0, 0xFFF))
+                vals[i]["dscp"] = int(rng.integers(0, 64))
+            elif kind == "extra":
+                vals[i]["rtt_ns"] = int(rng.integers(0, 10**8))
+                vals[i]["ipsec_ret"] = int(rng.integers(-2, 3))
+                vals[i]["ipsec_encrypted"] = int(rng.integers(0, 2))
+            elif kind == "drops":
+                vals[i]["bytes"] = int(rng.integers(0, 0xFFFF))
+                vals[i]["packets"] = int(rng.integers(0, 0xFFFF))
+                vals[i]["latest_cause"] = int(rng.integers(0, 5))
+                vals[i]["latest_flags"] = int(rng.integers(0, 0xFF))
+            elif kind == "dns":
+                vals[i]["latency_ns"] = int(rng.integers(0, 10**7))
+                vals[i]["dns_id"] = int(rng.integers(0, 2**16))
+                vals[i]["dns_flags"] = int(rng.integers(0, 2**16))
+        a = flowpack.merge_percpu(kind, vals, use_native=True)
+        b = flowpack.merge_percpu(kind, vals, use_native=False)
+        assert a.tobytes() == b.tobytes(), kind
+
+    def test_stats_saturating_and_dedup(self, native):
+        vals = np.zeros(2, dtype=binfmt.FLOW_STATS_DTYPE)
+        vals[0]["bytes"] = 2**64 - 10
+        vals[1]["bytes"] = 100
+        vals[0]["packets"] = 1
+        vals[0]["n_observed_intf"] = 1
+        vals[0]["observed_intf"][0] = 3
+        vals[1]["n_observed_intf"] = 2
+        vals[1]["observed_intf"][0] = 3
+        vals[1]["observed_intf"][1] = 9
+        out = flowpack.merge_percpu("stats", vals, use_native=True)
+        assert int(out["bytes"]) == 2**64 - 1  # saturated
+        assert int(out["n_observed_intf"]) == 2  # 3 deduped, 9 appended

@@ -1,0 +1,170 @@
+"""Interface discovery tests: live netlink dump (runs in any Linux netns),
+filters, registerer, and the attach/retry listener over fakes."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from netobserv_tpu.agent.interfaces_listener import (
+    DoNotRetryError, InterfaceListener,
+)
+from netobserv_tpu.config import load_config
+from netobserv_tpu.datapath.fetcher import FakeFetcher
+from netobserv_tpu.ifaces import (
+    Event, EventType, Interface, InterfaceFilter, Poller, Registerer,
+)
+from netobserv_tpu.ifaces import netlink
+
+
+class TestNetlink:
+    def test_dump_links_sees_loopback(self):
+        links = netlink.dump_links()
+        names = {l.name for l in links}
+        assert "lo" in names
+        lo = next(l for l in links if l.name == "lo")
+        assert lo.index >= 1
+
+    def test_dump_addrs(self):
+        addrs = netlink.dump_addrs()
+        # loopback always carries 127.0.0.1
+        assert any(raw == b"\x7f\x00\x00\x01" for _idx, raw in addrs)
+
+
+class TestPoller:
+    def test_emits_added_for_current_links(self):
+        p = Poller(period_s=60)
+        events = p.subscribe()
+        try:
+            ev = events.get(timeout=3)
+            assert ev.type == EventType.ADDED
+            assert ev.interface.name
+        finally:
+            p.stop()
+
+
+class TestFilter:
+    def _iface(self, name):
+        return Interface(1, name, b"\x00" * 6)
+
+    def test_exclude(self):
+        f = InterfaceFilter(excluded=["lo"])
+        assert not f.allowed(self._iface("lo"))
+        assert f.allowed(self._iface("eth0"))
+
+    def test_allow_list(self):
+        f = InterfaceFilter(allowed=["eth0", "/^veth/"])
+        assert f.allowed(self._iface("eth0"))
+        assert f.allowed(self._iface("veth1234"))
+        assert not f.allowed(self._iface("docker0"))
+
+    def test_exclude_wins(self):
+        f = InterfaceFilter(allowed=["/eth/"], excluded=["eth9"])
+        assert f.allowed(self._iface("eth0"))
+        assert not f.allowed(self._iface("eth9"))
+
+    def test_cidr_mutually_exclusive(self):
+        with pytest.raises(ValueError):
+            InterfaceFilter(allowed=["eth0"], ip_cidrs=["10.0.0.0/8"])
+
+    def test_cidr_matches_loopback(self):
+        links = netlink.dump_links()
+        lo = next(l for l in links if l.name == "lo")
+        f = InterfaceFilter(ip_cidrs=["127.0.0.0/8"])
+        assert f.allowed(Interface(lo.index, "lo", lo.mac))
+        f2 = InterfaceFilter(ip_cidrs=["203.0.113.0/24"])
+        assert not f2.allowed(Interface(lo.index, "lo", lo.mac))
+
+
+class TestRegisterer:
+    def test_name_cache_and_mac_match(self):
+        r = Registerer()
+        mac_a, mac_b = b"\x02\x00\x00\x00\x00\x0a", b"\x02\x00\x00\x00\x00\x0b"
+        r.observe(Event(EventType.ADDED, Interface(4, "eth-a", mac_a)))
+        r.observe(Event(EventType.ADDED, Interface(4, "eth-b", mac_b)))
+        assert r.name_for(4, mac_a) == "eth-a"
+        assert r.name_for(4, mac_b) == "eth-b"
+        assert r.name_for(9, b"\x00" * 6) == "9"  # unknown -> index
+        # removal keeps the cache (records may still reference the name)
+        r.observe(Event(EventType.REMOVED, Interface(4, "eth-a", mac_a)))
+        assert r.name_for(4, mac_a) == "eth-a"
+
+
+class TestListener:
+    def _run(self, fake, env=None, informer_events=None):
+        cfg = load_config(environ={
+            "EXPORT": "stdout", "TC_ATTACH_RETRIES": "3", **(env or {})})
+
+        class FakeInformer:
+            def __init__(self):
+                self.q = queue.Queue()
+
+            def subscribe(self):
+                for e in informer_events or []:
+                    self.q.put(e)
+                return self.q
+
+            def stop(self):
+                pass
+
+        listener = InterfaceListener(cfg, fake, informer=FakeInformer())
+        listener.start()
+        return listener
+
+    def test_attach_and_filter(self):
+        fake = FakeFetcher()
+        events = [
+            Event(EventType.ADDED, Interface(1, "lo", b"\x00" * 6)),
+            Event(EventType.ADDED, Interface(2, "eth0", b"\x02" * 6)),
+        ]
+        listener = self._run(fake, informer_events=events)
+        try:
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline and 2 not in fake.attached:
+                time.sleep(0.05)
+            assert fake.attached == {2: "eth0"}  # lo excluded by default
+        finally:
+            listener.stop()
+
+    def test_retry_then_success(self):
+        fake = FakeFetcher()
+        calls = []
+        orig = fake.attach
+
+        def flaky(idx, name, direction):
+            calls.append(name)
+            if len(calls) < 3:
+                raise OSError("transient")
+            orig(idx, name, direction)
+
+        fake.attach = flaky
+        listener = self._run(
+            fake, informer_events=[
+                Event(EventType.ADDED, Interface(5, "eth5", b"\x05" * 6))])
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and 5 not in fake.attached:
+                time.sleep(0.05)
+            assert len(calls) == 3
+            assert 5 in fake.attached
+        finally:
+            listener.stop()
+
+    def test_do_not_retry(self):
+        fake = FakeFetcher()
+        calls = []
+
+        def always_fail(idx, name, direction):
+            calls.append(name)
+            raise DoNotRetryError("unsupported kernel")
+
+        fake.attach = always_fail
+        listener = self._run(
+            fake, informer_events=[
+                Event(EventType.ADDED, Interface(6, "eth6", b"\x06" * 6))])
+        try:
+            time.sleep(1.0)
+            assert calls == ["eth6"]  # exactly one attempt
+        finally:
+            listener.stop()

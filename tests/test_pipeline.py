@@ -174,6 +174,36 @@ class TestTpuSketchExporter:
         assert top["EstBytes"] >= 1_000_000
         assert rep["DistinctSrcEstimate"] > 0
 
+    def test_columnar_fast_path(self):
+        from netobserv_tpu.datapath.fetcher import EvictedFlows
+        from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+        from netobserv_tpu.sketch.state import SketchConfig
+
+        reports = []
+        exp = TpuSketchExporter(
+            batch_size=8192,  # larger than the injected evictions: the
+            # window drain must still fold the partial batch
+            window_s=3600,
+            sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10,
+                                    hll_precision=6, perdst_buckets=32,
+                                    perdst_precision=4, topk=16,
+                                    hist_buckets=64, ewma_buckets=32),
+            sink=reports.append)
+        assert exp.supports_columnar
+        import numpy as np
+
+        from netobserv_tpu.model import binfmt
+        extra = np.zeros(3, dtype=binfmt.EXTRA_REC_DTYPE)
+        extra["rtt_ns"] = [5_000_000, 1_000_000, 9_000_000]
+        exp.export_evicted(EvictedFlows(make_events(3), extra=extra))
+        exp.export_evicted(EvictedFlows(make_events(2, sport0=9000)))
+        exp.flush()
+        assert len(reports) == 1
+        rep = reports[0]
+        assert rep["Records"] == 5
+        # rtt feature column reached the histogram (values in ms range)
+        assert rep["RttQuantilesUs"]["0.99"] > 1000
+
     def test_window_rolls_and_resets(self):
         from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
         from netobserv_tpu.model.record import records_from_events
