@@ -1,0 +1,53 @@
+# netobserv_tpu build/test entry points (reference analog: the Go Makefile's
+# compile / gen-bpf / gen-protobuf / test / bench targets).
+
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: all test test-cpu bench gen-protobuf native bpf verify-maps lint \
+        dryrun smoke clean
+
+all: native gen-protobuf
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# explicit CPU-mesh run (tests force this themselves; here for symmetry)
+test-cpu:
+	$(CPU_ENV) $(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py --check
+
+bench-cpu:
+	JAX_PLATFORMS=cpu $(PY) bench.py --check
+
+gen-protobuf:
+	protoc --python_out=netobserv_tpu/pb -I proto proto/flow.proto proto/packet.proto
+
+# host-side native components (always buildable with g++)
+native:
+	$(PY) -c "from netobserv_tpu.datapath.flowpack import build_native; \
+	          import sys; sys.exit(0 if build_native(force=True) else 1)"
+
+# eBPF datapath object — needs clang with BPF target support
+bpf:
+	cmake -S netobserv_tpu/datapath/native -B netobserv_tpu/datapath/native/build \
+	      -DDATAPATH_BPF=ON
+	cmake --build netobserv_tpu/datapath/native/build
+
+# consistency between the C map definitions and the canonical registry
+verify-maps:
+	$(PY) -m pytest tests/test_datapath.py -x -q
+
+dryrun:
+	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# minimum end-to-end slice: synthetic datapath -> pipeline -> stdout flows
+smoke:
+	DATAPATH=synthetic EXPORT=stdout CACHE_ACTIVE_TIMEOUT=300ms \
+	  timeout 3 $(PY) -m netobserv_tpu | head -5 || true
+
+clean:
+	rm -rf netobserv_tpu/datapath/native/build
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,57 @@
+#!/usr/bin/env python3
+"""flowlogs-dump: a tcpdump-style standalone gRPC flow collector.
+
+Reference analog: examples/flowlogs-dump. Run the agent with EXPORT=grpc
+TARGET_HOST=<here> TARGET_PORT=<port> and watch flows print.
+
+    python examples/flowlogs_dump.py --port 9999
+"""
+
+import argparse
+import signal
+import sys
+import queue
+
+sys.path.insert(0, ".")
+
+from netobserv_tpu.grpc.flow import start_flow_collector  # noqa: E402
+from netobserv_tpu.exporter.pb_convert import pb_to_record  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=9999)
+    args = ap.parse_args()
+    server, port, out = start_flow_collector(args.port)
+    print(f"flowlogs-dump listening on :{port}", file=sys.stderr)
+    running = True
+
+    def stop(_sig, _frm):
+        nonlocal running
+        running = False
+
+    signal.signal(signal.SIGINT, stop)
+    signal.signal(signal.SIGTERM, stop)
+    while running:
+        try:
+            msg = out.get(timeout=0.5)
+        except queue.Empty:
+            continue
+        for entry in msg.entries:
+            r = pb_to_record(entry)
+            f = r.features
+            print(f"{r.time_flow_end_ns // 10**9}: "
+                  f"{r.key.src}:{r.key.src_port} -> "
+                  f"{r.key.dst}:{r.key.dst_port} "
+                  f"proto={r.key.proto} dir={r.direction} "
+                  f"bytes={r.bytes_} packets={r.packets} "
+                  f"flags={r.tcp_flags:#x} iface={r.interface}"
+                  + (f" rtt={f.rtt_ns / 1e6:.2f}ms" if f.rtt_ns else "")
+                  + (f" dnsLat={f.dns_latency_ns / 1e6:.2f}ms"
+                     if f.dns_latency_ns else ""))
+    server.stop(0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""packet-capture: receives a PCA pcap stream and writes a .pcap file.
+
+Reference analog: examples/packetcapture-dump. Run the agent with
+ENABLE_PCA=true TARGET_HOST=<here> PCA_SERVER_PORT=<port>.
+
+    python examples/packet_capture.py --port 9990 --out capture.pcap
+"""
+
+import argparse
+import queue
+import signal
+import sys
+
+sys.path.insert(0, ".")
+
+from netobserv_tpu.exporter.grpc_packets import start_packet_collector  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=9990)
+    ap.add_argument("--out", default="capture.pcap")
+    args = ap.parse_args()
+    server, port, out = start_packet_collector(args.port)
+    print(f"packet-capture listening on :{port}, writing {args.out}",
+          file=sys.stderr)
+    running = True
+
+    def stop(_sig, _frm):
+        nonlocal running
+        running = False
+
+    signal.signal(signal.SIGINT, stop)
+    signal.signal(signal.SIGTERM, stop)
+    n = 0
+    with open(args.out, "wb") as fh:
+        while running:
+            try:
+                chunk = out.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            fh.write(chunk)
+            fh.flush()
+            n += 1
+            if n % 100 == 0:
+                print(f"{n} chunks written", file=sys.stderr)
+    server.stop(0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,20 +27,31 @@ def main() -> int:
     log.info("starting netobserv_tpu agent %s (export=%s)",
              __version__, cfg.export)
 
-    if cfg.enable_pca:
-        log.error("PCA packet-capture mode is not wired into the CLI yet")
-        return 2
+    dbg = None
+    if cfg.pprof_addr:
+        from netobserv_tpu.server import start_debug_server
+        dbg = start_debug_server(cfg.pprof_addr)
 
     try:
-        agent = FlowsAgent.from_config(cfg)
-    except ValueError as exc:
+        if cfg.enable_pca:
+            if not cfg.target_host or not cfg.target_port:
+                raise ValueError(
+                    "ENABLE_PCA: TARGET_HOST and TARGET_PORT (or "
+                    "PCA_SERVER_PORT) are required")
+            from netobserv_tpu.agent.packets_agent import PacketsAgent
+            from netobserv_tpu.datapath.loader import KernelFetcher
+            agent = PacketsAgent(cfg, KernelFetcher.load(cfg))
+        else:
+            agent = FlowsAgent.from_config(cfg)
+    except (ValueError, RuntimeError) as exc:
         log.error("invalid configuration: %s", exc)
         return 2
 
     srv = None
-    if cfg.metrics_enable:
+    metrics = getattr(agent, "metrics", None)
+    if cfg.metrics_enable and metrics is not None:
         srv = start_metrics_server(
-            agent.metrics.registry, cfg.metrics_server_address,
+            metrics.registry, cfg.metrics_server_address,
             cfg.metrics_server_port, cfg.metrics_tls_cert_path,
             cfg.metrics_tls_key_path)
 
@@ -56,6 +67,8 @@ def main() -> int:
     agent.run(stop)
     if srv is not None:
         srv.shutdown()
+    if dbg is not None:
+        dbg.shutdown()
     log.info("agent stopped")
     return 0
 

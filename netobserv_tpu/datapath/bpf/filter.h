@@ -85,6 +85,32 @@ NO_INLINE int no_peer_in_cidr(const __u8 *peer_ip) {
     return bpf_map_lookup_elem(&filter_peers, &key) != 0;
 }
 
+/* one side's evaluation: -1 = no usable match (caller may retry other side),
+ * 0 = reject, 1 = accept */
+NO_INLINE int no_filter_try(const struct no_pkt *pkt, const __u8 *keyed_ip,
+                            const __u8 *peer_ip, __u8 direction,
+                            __u8 is_drop_path, __u32 *sampling_out) {
+    struct no_filter_key lkey;
+    lkey.prefix_len = 128;
+    __builtin_memcpy(lkey.ip, keyed_ip, NO_IP_LEN);
+    const struct no_filter_rule *rule =
+        bpf_map_lookup_elem(&filter_rules, &lkey);
+    if (!rule)
+        return -1;
+    if (!no_rule_matches(rule, pkt, direction, is_drop_path))
+        return -1;
+    if (rule->peer_cidr_check && !no_peer_in_cidr(peer_ip))
+        return -1;
+    if (rule->action == NO_FILTER_REJECT) {
+        no_count(NO_CTR_FILTER_REJECT);
+        return 0;
+    }
+    if (rule->sample_override && sampling_out)
+        *sampling_out = rule->sample_override;
+    no_count(NO_CTR_FILTER_ACCEPT);
+    return 1;
+}
+
 /*
  * Returns 1 = keep the packet, 0 = drop it from flow tracking.
  * `*sampling_out` is set when a matching rule overrides sampling.
@@ -94,41 +120,19 @@ NO_INLINE int no_flow_filter(const struct no_pkt *pkt, __u8 direction,
     if (!cfg_enable_flow_filtering)
         return 1;
 
-    struct no_filter_key lkey;
-    lkey.prefix_len = 128;
-    const struct no_filter_rule *rule = 0;
-    const __u8 *peer = 0;
-
-    /* source CIDR first, then destination CIDR */
-    __builtin_memcpy(lkey.ip, pkt->key.src_ip, NO_IP_LEN);
-    rule = bpf_map_lookup_elem(&filter_rules, &lkey);
-    if (rule) {
-        peer = pkt->key.dst_ip;
-    } else {
-        __builtin_memcpy(lkey.ip, pkt->key.dst_ip, NO_IP_LEN);
-        rule = bpf_map_lookup_elem(&filter_rules, &lkey);
-        peer = pkt->key.src_ip;
-    }
-    if (!rule) {
+    /* source CIDR first; if the src-side rule exists but its full evaluation
+     * (predicates + peer check) doesn't match, retry with the dst CIDR —
+     * same fallback order as the parity target (flows_filter.h:251) */
+    int verdict = no_filter_try(pkt, pkt->key.src_ip, pkt->key.dst_ip,
+                                direction, is_drop_path, sampling_out);
+    if (verdict < 0)
+        verdict = no_filter_try(pkt, pkt->key.dst_ip, pkt->key.src_ip,
+                                direction, is_drop_path, sampling_out);
+    if (verdict < 0) {
         no_count(NO_CTR_FILTER_NOMATCH);
         return 0; /* rules configured but none matched -> not interesting */
     }
-    if (!no_rule_matches(rule, pkt, direction, is_drop_path)) {
-        no_count(NO_CTR_FILTER_NOMATCH);
-        return 0;
-    }
-    if (rule->peer_cidr_check && !no_peer_in_cidr(peer)) {
-        no_count(NO_CTR_FILTER_NOMATCH);
-        return 0;
-    }
-    if (rule->action == NO_FILTER_REJECT) {
-        no_count(NO_CTR_FILTER_REJECT);
-        return 0;
-    }
-    if (rule->sample_override && sampling_out)
-        *sampling_out = rule->sample_override;
-    no_count(NO_CTR_FILTER_ACCEPT);
-    return 1;
+    return verdict;
 }
 
 #endif /* NO_FILTER_H */

@@ -55,23 +55,26 @@ static __always_inline void v4_mapped(__u8 *dst16, __be32 addr) {
     __builtin_memcpy(dst16 + 12, &addr, 4);
 }
 
-/* build a flow key from a struct sock (TCP paths) */
-static __always_inline int key_from_sock(struct sock *sk,
-                                         struct no_flow_key *k) {
+/* build a flow key from a struct sock. tcp_rcv_established fires on the
+ * RECEIVE path, so the tracked flow's source is the REMOTE endpoint (the TC
+ * ingress key) — remote goes in src, local in dst, matching how the TC path
+ * keyed this flow. */
+static __always_inline int key_from_sock_rx(struct sock *sk,
+                                            struct no_flow_key *k) {
     __u16 family = BPF_CORE_READ(sk, __sk_common.skc_family);
     k->proto = PROTO_TCP;
-    k->src_port = BPF_CORE_READ(sk, __sk_common.skc_num);
-    k->dst_port = bpf_ntohs(BPF_CORE_READ(sk, __sk_common.skc_dport));
+    k->src_port = bpf_ntohs(BPF_CORE_READ(sk, __sk_common.skc_dport));
+    k->dst_port = BPF_CORE_READ(sk, __sk_common.skc_num);
     if (family == AF_INET_) {
-        v4_mapped(k->src_ip, BPF_CORE_READ(sk, __sk_common.skc_rcv_saddr));
-        v4_mapped(k->dst_ip, BPF_CORE_READ(sk, __sk_common.skc_daddr));
+        v4_mapped(k->src_ip, BPF_CORE_READ(sk, __sk_common.skc_daddr));
+        v4_mapped(k->dst_ip, BPF_CORE_READ(sk, __sk_common.skc_rcv_saddr));
         return 0;
     }
     if (family == AF_INET6_) {
         BPF_CORE_READ_INTO(&k->src_ip, sk,
-                           __sk_common.skc_v6_rcv_saddr.in6_u.u6_addr8);
-        BPF_CORE_READ_INTO(&k->dst_ip, sk,
                            __sk_common.skc_v6_daddr.in6_u.u6_addr8);
+        BPF_CORE_READ_INTO(&k->dst_ip, sk,
+                           __sk_common.skc_v6_rcv_saddr.in6_u.u6_addr8);
         return 0;
     }
     return -1;
@@ -130,7 +133,7 @@ static __always_inline int handle_rtt(struct sock *sk) {
     if (!cfg_enable_rtt)
         return 0;
     struct no_flow_key k = {};
-    if (key_from_sock(sk, &k) != 0)
+    if (key_from_sock_rx(sk, &k) != 0)
         return 0;
     struct tcp_sock *ts = (struct tcp_sock *)sk;
     __u32 srtt_us_8 = BPF_CORE_READ(ts, srtt_us);
@@ -232,7 +235,9 @@ int BPF_KPROBE(nevents_kprobe, struct psample_group *group,
     void *cookie_src = BPF_CORE_READ(meta, user_cookie);
     if (!cookie_src || cookie_len == 0)
         return 0;
-    bpf_probe_read_kernel(cookie, sizeof(cookie), cookie_src);
+    /* read only the cookie's own length — over-reading can fault (zero-fill)
+     * or capture trailing garbage that defeats the dedup memcmp */
+    bpf_probe_read_kernel(cookie, cookie_len, cookie_src);
     __u32 len = BPF_CORE_READ(skb, len);
     __u64 now = bpf_ktime_get_ns();
     struct no_nevents_rec *rec = bpf_map_lookup_elem(&flows_nevents, &k);
@@ -376,14 +381,15 @@ int BPF_KPROBE(ssl_write_uprobe, void *ssl, const void *buf, int num) {
         return 0;
     ev->timestamp_ns = bpf_ktime_get_ns();
     ev->pid_tgid = bpf_get_current_pid_tgid();
-    int n = num;
-    if (n < 0)
-        n = 0;
+    __u32 n = num < 0 ? 0 : (__u32)num;
     if (n > NO_MAX_SSL_DATA)
         n = NO_MAX_SSL_DATA;
     ev->data_len = n;
     ev->ssl_type = 1; /* write direction */
-    bpf_probe_read_user(ev->data, NO_MAX_SSL_DATA, buf);
+    /* read exactly the caller's length: over-reading past the user buffer
+     * either faults (zero-filled payload) or leaks adjacent process memory */
+    if (n > 0)
+        bpf_probe_read_user(ev->data, n, buf);
     bpf_ringbuf_submit(ev, 0);
     return 0;
 }

@@ -17,7 +17,9 @@ from typing import Optional
 import numpy as np
 
 from netobserv_tpu.model import accumulate, binfmt
-from netobserv_tpu.model.columnar import KEY_WORDS, FlowBatch, pack_key_words
+from netobserv_tpu.model.columnar import (
+    KEY_WORDS, FlowBatch, overlay_features, pack_key_words,
+)
 
 log = logging.getLogger("netobserv_tpu.datapath.flowpack")
 
@@ -82,12 +84,21 @@ def _ptr(a: np.ndarray) -> ctypes.c_void_p:
 
 def pack_events(events_raw: bytes | np.ndarray,
                 batch_size: Optional[int] = None,
+                extra: Optional[np.ndarray] = None,
+                dns: Optional[np.ndarray] = None,
+                drops: Optional[np.ndarray] = None,
                 use_native: Optional[bool] = None) -> FlowBatch:
-    """Raw flow-event buffer -> columnar FlowBatch."""
+    """Raw flow-event buffer (+ optional feature arrays) -> columnar FlowBatch."""
     if isinstance(events_raw, np.ndarray):
         events = np.ascontiguousarray(events_raw, dtype=binfmt.FLOW_EVENT_DTYPE)
     else:
         events = binfmt.decode_flow_events(events_raw)
+    if use_native is None:
+        use_native = native_available()
+    if not (use_native and native_available()):
+        # the pure-python path IS FlowBatch.from_events — one definition
+        return FlowBatch.from_events(events, batch_size=batch_size,
+                                     extra=extra, dns=dns, drops=drops)
     n = len(events)
     batch_size = batch_size or max(n, 1)
     if n > batch_size:
@@ -95,33 +106,16 @@ def pack_events(events_raw: bytes | np.ndarray,
     b = FlowBatch.empty(batch_size)
     if n == 0:
         return b
-    if use_native is None:
-        use_native = native_available()
-    if use_native and native_available():
-        cols = _Columns(
-            keys=_ptr(b.keys), bytes=_ptr(b.bytes), packets=_ptr(b.packets),
-            tcp_flags=_ptr(b.tcp_flags), eth_protocol=_ptr(b.eth_protocol),
-            direction=_ptr(b.direction), if_index=_ptr(b.if_index),
-            dscp=_ptr(b.dscp), sampling=_ptr(b.sampling),
-            first_seen_ns=_ptr(b.first_seen_ns),
-            last_seen_ns=_ptr(b.last_seen_ns))
-        raw = events.tobytes()
-        _lib.fp_pack(raw, ctypes.c_size_t(n), ctypes.byref(cols))
-        b.valid[:n] = True
-        return b
-    # numpy fallback: identical semantics
-    stats = events["stats"]
-    b.keys[:n] = pack_key_words(events["key"])
-    b.bytes[:n] = stats["bytes"]
-    b.packets[:n] = stats["packets"]
-    b.tcp_flags[:n] = stats["tcp_flags"]
-    b.eth_protocol[:n] = stats["eth_protocol"]
-    b.direction[:n] = stats["direction_first"]
-    b.if_index[:n] = stats["if_index_first"]
-    b.dscp[:n] = stats["dscp"]
-    b.sampling[:n] = stats["sampling"]
-    b.first_seen_ns[:n] = stats["first_seen_ns"]
-    b.last_seen_ns[:n] = stats["last_seen_ns"]
+    cols = _Columns(
+        keys=_ptr(b.keys), bytes=_ptr(b.bytes), packets=_ptr(b.packets),
+        tcp_flags=_ptr(b.tcp_flags), eth_protocol=_ptr(b.eth_protocol),
+        direction=_ptr(b.direction), if_index=_ptr(b.if_index),
+        dscp=_ptr(b.dscp), sampling=_ptr(b.sampling),
+        first_seen_ns=_ptr(b.first_seen_ns),
+        last_seen_ns=_ptr(b.last_seen_ns))
+    raw = events.tobytes()
+    _lib.fp_pack(raw, ctypes.c_size_t(n), ctypes.byref(cols))
+    overlay_features(b, n, extra=extra, dns=dns, drops=drops)
     b.valid[:n] = True
     return b
 

@@ -22,13 +22,13 @@ _OBJ_PATH = os.path.join(os.path.dirname(__file__), "native", "build",
 
 
 class KernelFetcher:
-    needs_iface_discovery = True  # the agent starts an InterfaceListener
-
     """FlowFetcher backed by real kernel maps. Requires:
     - CAP_BPF + CAP_PERFMON (or root),
     - a compiled BPF object (see datapath/native/CMakeLists.txt),
     - libbpf.so available to the dynamic linker.
     """
+
+    needs_iface_discovery = True  # the agent starts an InterfaceListener
 
     @classmethod
     def load(cls, cfg: AgentConfig) -> "KernelFetcher":

@@ -1,0 +1,87 @@
+"""gRPC packet exporter (PCA): pcap-framed packet stream to a collector.
+
+Reference analog: `pkg/exporter/grpc_packets.go` — the pcap file header goes
+out once, then each packet as a pcap-framed chunk wrapped in pbpacket.Packet.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import grpc
+from google.protobuf import any_pb2, wrappers_pb2
+
+from netobserv_tpu.model.packet_record import (
+    PacketRecord, frame_packet, pcap_file_header,
+)
+from netobserv_tpu.pb import packet_pb2
+
+log = logging.getLogger("netobserv_tpu.exporter.grpc_packets")
+
+_SEND = "/pbpacket.Collector/Send"
+
+
+class PacketClient:
+    def __init__(self, host: str, port: int):
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        self._send = self._channel.unary_unary(
+            _SEND,
+            request_serializer=packet_pb2.Packet.SerializeToString,
+            response_deserializer=packet_pb2.CollectorReply.FromString)
+
+    def send_bytes(self, payload: bytes, timeout_s: float = 10.0):
+        wrapped = any_pb2.Any()
+        wrapped.Pack(wrappers_pb2.BytesValue(value=payload))
+        return self._send(packet_pb2.Packet(pcap=wrapped), timeout=timeout_s)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class GRPCPacketExporter:
+    """Terminal for PCA packet batches."""
+
+    name = "grpc-packets"
+
+    def __init__(self, host: str, port: int,
+                 client: Optional[PacketClient] = None):
+        self._client = client or PacketClient(host, port)
+        self._sent_header = False
+
+    def export_packets(self, packets: list[PacketRecord]) -> None:
+        if not self._sent_header:
+            self._client.send_bytes(pcap_file_header())
+            self._sent_header = True
+        for rec in packets:
+            self._client.send_bytes(frame_packet(rec))
+
+    def close(self) -> None:
+        self._client.close()
+
+
+def start_packet_collector(port: int = 0, out=None):
+    """In-process pbpacket collector for tests/examples; returns
+    (server, bound_port, queue-of-bytes)."""
+    import queue as _queue
+    from concurrent import futures
+
+    out = out if out is not None else _queue.Queue()
+
+    def send(request: packet_pb2.Packet, context) -> packet_pb2.CollectorReply:
+        val = wrappers_pb2.BytesValue()
+        request.pcap.Unpack(val)
+        out.put(val.value)
+        return packet_pb2.CollectorReply()
+
+    handler = grpc.method_handlers_generic_handler(
+        "pbpacket.Collector",
+        {"Send": grpc.unary_unary_rpc_method_handler(
+            send,
+            request_deserializer=packet_pb2.Packet.FromString,
+            response_serializer=packet_pb2.CollectorReply.SerializeToString)})
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    server.start()
+    return server, bound, out

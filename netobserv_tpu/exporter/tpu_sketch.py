@@ -223,20 +223,9 @@ class TpuSketchExporter(Exporter):
 
     def _fold_events(self, events, extra, dns, drops) -> None:
         t0 = time.perf_counter()
-        batch = flowpack.pack_events(events, batch_size=self._batch_size)
         n = len(events)
-        # keep this overlay in lockstep with FlowBatch.from_events so the
-        # Record path and the columnar fast path can never diverge
-        if extra is not None:
-            batch.rtt_us[:n] = extra["rtt_ns"] // 1000
-        if dns is not None:
-            batch.dns_latency_us[:n] = dns["latency_ns"] // 1000
-            batch.dns_id[:n] = dns["dns_id"]
-            batch.dns_flags[:n] = dns["dns_flags"]
-            batch.dns_errno[:n] = dns["errno"]
-        if drops is not None:
-            batch.drop_bytes[:n] = drops["bytes"]
-            batch.drop_packets[:n] = drops["packets"]
+        batch = flowpack.pack_events(events, batch_size=self._batch_size,
+                                     extra=extra, dns=dns, drops=drops)
         arrays = self._sk.batch_to_device(batch)
         if self._distributed:
             arrays = self._pm.shard_batch(self._mesh, arrays)

@@ -19,13 +19,20 @@ class Registerer:
     def __init__(self, preferred_for_mac_prefix: str = ""):
         self._lock = threading.Lock()
         self._by_index: dict[int, list[Interface]] = {}
-        # "0a58:ovn-k8s-mp" style "prefix:name" preference
-        self._pref_prefix = b""
-        self._pref_name = ""
-        if preferred_for_mac_prefix and ":" in preferred_for_mac_prefix:
-            prefix, name = preferred_for_mac_prefix.split(":", 1)
-            self._pref_prefix = bytes.fromhex(prefix)
-            self._pref_name = name
+        # comma-separated "mac_prefix=name" pairs with colon-delimited MACs,
+        # e.g. "0a:58=eth0,02:42=docker" (reference env-var contract)
+        self._prefs: list[tuple[bytes, str]] = []
+        for pair in preferred_for_mac_prefix.split(","):
+            pair = pair.strip()
+            if not pair or "=" not in pair:
+                continue
+            prefix_str, name = pair.split("=", 1)
+            try:
+                prefix = bytes.fromhex(prefix_str.replace(":", ""))
+            except ValueError:
+                continue  # malformed prefix: ignore the pair, don't crash
+            if prefix and name:
+                self._prefs.append((prefix, name))
 
     def observe(self, event: Event) -> None:
         iface = event.interface
@@ -46,9 +53,11 @@ class Registerer:
             matches = [e for e in entries if e.mac == mac]
             if not matches:
                 return entries[-1].name
-            if (len(matches) > 1 and self._pref_prefix
-                    and mac.startswith(self._pref_prefix)):
-                for e in matches:
-                    if e.name.startswith(self._pref_name):
-                        return e.name
+            if len(matches) > 1:
+                for prefix, pref_name in self._prefs:
+                    if not mac.startswith(prefix):
+                        continue
+                    for e in matches:
+                        if e.name.startswith(pref_name):
+                            return e.name
             return matches[-1].name

@@ -152,16 +152,7 @@ class FlowBatch:
         b.sampling[:n] = stats["sampling"]
         b.first_seen_ns[:n] = stats["first_seen_ns"]
         b.last_seen_ns[:n] = stats["last_seen_ns"]
-        if extra is not None and len(extra):
-            b.rtt_us[:n] = extra["rtt_ns"] // 1000
-        if dns is not None and len(dns):
-            b.dns_latency_us[:n] = dns["latency_ns"] // 1000
-            b.dns_id[:n] = dns["dns_id"]
-            b.dns_flags[:n] = dns["dns_flags"]
-            b.dns_errno[:n] = dns["errno"]
-        if drops is not None and len(drops):
-            b.drop_bytes[:n] = drops["bytes"]
-            b.drop_packets[:n] = drops["packets"]
+        overlay_features(b, n, extra=extra, dns=dns, drops=drops)
         b.valid[:n] = True
         return b
 
@@ -208,6 +199,27 @@ class FlowBatch:
     def columns(self) -> dict[str, np.ndarray]:
         return {f.name: getattr(self, f.name) for f in dfields(self)
                 if f.name not in ("epoch_mono_ns", "epoch_wall_ns")}
+
+
+def overlay_features(b: FlowBatch, n: int,
+                     extra: Optional[np.ndarray] = None,
+                     dns: Optional[np.ndarray] = None,
+                     drops: Optional[np.ndarray] = None) -> None:
+    """Overlay per-feature record arrays onto the first n rows of a batch.
+
+    The single definition shared by FlowBatch.from_events, the native
+    flowpack pack path, and the tpu-sketch columnar fold — so the feature
+    column set can never drift between paths."""
+    if extra is not None and len(extra):
+        b.rtt_us[:n] = extra["rtt_ns"][:n] // 1000
+    if dns is not None and len(dns):
+        b.dns_latency_us[:n] = dns["latency_ns"][:n] // 1000
+        b.dns_id[:n] = dns["dns_id"][:n]
+        b.dns_flags[:n] = dns["dns_flags"][:n]
+        b.dns_errno[:n] = dns["errno"][:n]
+    if drops is not None and len(drops):
+        b.drop_bytes[:n] = drops["bytes"][:n]
+        b.drop_packets[:n] = drops["packets"][:n]
 
 
 def exact_aggregate(batches: Iterable[FlowBatch]) -> dict[bytes, tuple[int, int]]:

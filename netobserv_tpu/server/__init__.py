@@ -1,0 +1,10 @@
+"""Debug/profiling HTTP server (the pprof analog).
+
+Reference analog: the Go pprof endpoint enabled by PPROF_ADDR
+(`cmd/netobserv-ebpf-agent.go:49-56`). Python equivalents exposed:
+- /debug/threads      — live stack dump of every thread (faulthandler style)
+- /debug/tracemalloc  — top allocation sites (starts tracemalloc on first hit)
+- /debug/gc           — GC stats + object counts by type (top 40)
+"""
+
+from netobserv_tpu.server.debug import start_debug_server  # noqa: F401

@@ -1,0 +1,81 @@
+"""Debug HTTP endpoints (see package docstring)."""
+
+from __future__ import annotations
+
+import gc
+import io
+import logging
+import sys
+import threading
+import traceback
+import tracemalloc
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("netobserv_tpu.server.debug")
+
+
+def _threads_dump() -> str:
+    out = io.StringIO()
+    frames = sys._current_frames()
+    for t in threading.enumerate():
+        out.write(f"--- thread {t.name} (daemon={t.daemon})\n")
+        frame = frames.get(t.ident)
+        if frame is not None:
+            traceback.print_stack(frame, file=out)
+        out.write("\n")
+    return out.getvalue()
+
+
+def _tracemalloc_dump(top: int = 25) -> str:
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return "tracemalloc started; hit this endpoint again for a snapshot\n"
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:top]
+    return "".join(f"{s.size / 1024:.1f} KiB  {s.count} blocks  "
+                   f"{s.traceback}\n" for s in stats)
+
+
+def _gc_dump() -> str:
+    counts = Counter(type(o).__name__ for o in gc.get_objects())
+    lines = [f"gc counts: {gc.get_count()} thresholds: {gc.get_threshold()}\n"]
+    lines += [f"{n:>10}  {name}\n" for name, n in counts.most_common(40)]
+    return "".join(lines)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        routes = {
+            "/debug/threads": _threads_dump,
+            "/debug/tracemalloc": _tracemalloc_dump,
+            "/debug/gc": _gc_dump,
+        }
+        path = self.path.split("?")[0]
+        if path in ("/", "/debug", "/debug/"):
+            body = "\n".join(routes) + "\n"
+        elif path in routes:
+            body = routes[path]()
+        else:
+            self.send_error(404)
+            return
+        payload = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, fmt, *args):
+        log.debug("debug http: " + fmt, *args)
+
+
+def start_debug_server(addr: str) -> ThreadingHTTPServer:
+    """addr is "host:port" or ":port" (reference PPROF_ADDR shape)."""
+    host, _, port = addr.rpartition(":")
+    srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), _Handler)
+    t = threading.Thread(target=srv.serve_forever, name="debug-http",
+                         daemon=True)
+    t.start()
+    log.info("debug server on %s", addr)
+    return srv
