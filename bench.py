@@ -68,14 +68,14 @@ def cpu_exact_baseline(pool) -> float:
     return run()
 
 
-def tpu_ingest_rate(pool):
+def tpu_ingest_rate(pool, use_pallas: bool = False):
     import jax
 
     from netobserv_tpu.sketch import state as sk
 
     cfg = sk.SketchConfig()  # production defaults: cm 4x65536, topk 1024
     state = sk.init_state(cfg)
-    ingest = sk.make_ingest_fn(donate=True)
+    ingest = sk.make_ingest_fn(donate=True, use_pallas=use_pallas)
     dev_batches = [
         {k: jax.device_put(v) for k, v in arrays.items()} for arrays, _ in pool]
 
@@ -119,7 +119,7 @@ def main():
     rng = np.random.default_rng(2026)
     universe, pool = make_pool(rng)
     baseline = cpu_exact_baseline(pool)
-    rate, state, feed = tpu_ingest_rate(pool)
+    rate, state, feed = tpu_ingest_rate(pool, use_pallas="--pallas" in sys.argv)
     if "--check" in sys.argv:
         recall = check_recall(state, feed, universe, pool)
         print(f"heavy-hitter recall@100 vs exact: {recall:.3f}", file=sys.stderr)

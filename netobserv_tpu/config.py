@@ -243,6 +243,7 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_checkpoint_every: int = field(default=0, **_env("SKETCH_CHECKPOINT_EVERY", "0"))
     sketch_mesh_shape: str = field(default="", **_env("SKETCH_MESH_SHAPE"))  # e.g. "2x4"
     sketch_devices: str = field(default="", **_env("SKETCH_DEVICES"))  # "", "cpu", "tpu"
+    sketch_use_pallas: bool = field(default=False, **_env("SKETCH_USE_PALLAS", "false"))
 
     def parsed_filter_rules(self) -> list[FlowFilterRule]:
         return parse_filter_rules(self.flow_filter_rules)

@@ -14,12 +14,11 @@ from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter  # noqa: F401
 def build_exporter(cfg, metrics=None):
     """Backend switch (reference analog: `pkg/agent/agent.go:246-261`)."""
     from netobserv_tpu import config as c
-    if cfg.export in (c.EXPORT_STDOUT, c.EXPORT_DIRECT_FLP):
-        # direct-flp mode: in-process pipeline consuming FLP-style maps; the
-        # stdout exporter emits the same GenericMap JSON shape
-        return StdoutJSONExporter(metrics=metrics,
-                                  flp_format=(cfg.export == c.EXPORT_DIRECT_FLP),
-                                  flp_config=cfg.flp_config)
+    if cfg.export == c.EXPORT_STDOUT:
+        return StdoutJSONExporter(metrics=metrics)
+    if cfg.export == c.EXPORT_DIRECT_FLP:
+        from netobserv_tpu.exporter.direct_flp import DirectFLPExporter
+        return DirectFLPExporter(flp_config=cfg.flp_config)
     if cfg.export == c.EXPORT_TPU_SKETCH:
         return TpuSketchExporter.from_config(cfg, metrics=metrics)
     if cfg.export == c.EXPORT_GRPC:

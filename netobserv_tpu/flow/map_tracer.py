@@ -15,6 +15,7 @@ import time
 from typing import Optional
 
 from netobserv_tpu.datapath.fetcher import FlowFetcher
+from netobserv_tpu.utils.dnsnames import decode_qname
 from netobserv_tpu.model.record import (
     InterfaceNamer, MonotonicClock, Record, interface_namer,
     records_from_events,
@@ -123,8 +124,7 @@ def _attach_features(records: list[Record], evicted) -> None:
             f.dns_flags = int(d["dns_flags"])
             f.dns_latency_ns = int(d["latency_ns"])
             f.dns_errno = int(d["errno"])
-            f.dns_name = bytes(d["name"]).rstrip(b"\x00").decode(
-                "ascii", "replace")
+            f.dns_name = decode_qname(bytes(d["name"]))
         if evicted.drops is not None and i < len(evicted.drops):
             d = evicted.drops[i]
             f.drop_bytes = int(d["bytes"])

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the sketch hot path.
+
+XLA's scatter lowering serializes random-index updates; these kernels
+reformulate them as tiled one-hot contractions that ride the MXU
+(`countmin_kernel`), the classic TPU trick for histogram/scatter workloads.
+Selected at runtime via SKETCH_USE_PALLAS=1 (default: XLA scatter, which wins
+on CPU and small widths).
+"""

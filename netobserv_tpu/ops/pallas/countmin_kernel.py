@@ -1,0 +1,88 @@
+"""Count-Min fold as a Pallas kernel: scatter-add -> tiled one-hot matmul.
+
+For each width tile of TILE_W counters, the kernel walks the batch in chunks,
+builds the one-hot membership matrix (chunk x TILE_W) in VMEM, and contracts
+it with the value vector on the MXU — so the per-batch cost is a dense
+d * B * W multiply-accumulate instead of B random HBM touches. FLOPs at the
+default config (d=4, B=8192, W=65536): ~4.3 GFLOP/batch, well under a chip's
+headroom at the target ingest rate.
+
+The counters are donated (input_output_aliases) so the fold is in-place in
+HBM. Falls back transparently: callers use `countmin.update` unless
+SKETCH_USE_PALLAS is set.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from netobserv_tpu.ops import hashing
+from netobserv_tpu.ops.countmin import CountMin
+
+TILE_W = 512
+CHUNK_B = 1024
+
+
+def _fold_kernel(counts_ref, idx_ref, vals_ref, out_ref, *, depth: int,
+                 n_chunks: int):
+    j = pl.program_id(0)
+    base = j * TILE_W
+    lanes = base + jax.lax.broadcasted_iota(jnp.int32, (1, TILE_W), 1)
+
+    def chunk_body(i, acc):
+        sl = pl.dslice(i * CHUNK_B, CHUNK_B)
+        vals = vals_ref[sl].reshape(1, CHUNK_B)
+        new_rows = []
+        for r in range(depth):  # static unroll over sketch depth
+            idx = idx_ref[r, sl].reshape(CHUNK_B, 1)
+            onehot = (idx == lanes).astype(jnp.float32)  # [CHUNK_B, TILE_W]
+            contrib = jnp.dot(vals, onehot,
+                              preferred_element_type=jnp.float32)
+            new_rows.append(acc[r] + contrib[0])
+        return jnp.stack(new_rows)
+
+    acc = counts_ref[...]
+    acc = jax.lax.fori_loop(0, n_chunks, chunk_body, acc)
+    out_ref[...] = acc
+
+
+def update(cm: CountMin, h1: jax.Array, h2: jax.Array, values: jax.Array,
+           valid: jax.Array, interpret: bool | None = None) -> CountMin:
+    """Drop-in replacement for countmin.update (float32 sketches).
+
+    `interpret` defaults to True off-TPU so the kernel is testable on the
+    CPU mesh; on TPU it compiles through Mosaic."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    d, w = cm.counts.shape
+    assert w % TILE_W == 0, f"width {w} must be a multiple of {TILE_W}"
+    b = h1.shape[0]
+    pad = (-b) % CHUNK_B
+    if pad:
+        h1 = jnp.pad(h1, (0, pad))
+        h2 = jnp.pad(h2, (0, pad), constant_values=1)
+        values = jnp.pad(values, (0, pad))
+        valid = jnp.pad(valid, (0, pad))
+    idx = hashing.row_indices(h1, h2, d, w).astype(jnp.int32)  # [d, B]
+    vals = jnp.where(valid, values, 0).astype(jnp.float32)
+    n_chunks = idx.shape[1] // CHUNK_B
+
+    kernel = functools.partial(_fold_kernel, depth=d, n_chunks=n_chunks)
+    new_counts = pl.pallas_call(
+        kernel,
+        grid=(w // TILE_W,),
+        in_specs=[
+            pl.BlockSpec((d, TILE_W), lambda j: (0, j)),   # counts tile
+            pl.BlockSpec((d, idx.shape[1]), lambda j: (0, 0)),  # all indices
+            pl.BlockSpec((idx.shape[1],), lambda j: (0,)),      # all values
+        ],
+        out_specs=pl.BlockSpec((d, TILE_W), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((d, w), jnp.float32),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(cm.counts.astype(jnp.float32), idx, vals)
+    return CountMin(counts=new_counts)

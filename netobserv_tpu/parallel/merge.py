@@ -94,7 +94,10 @@ def make_sharded_ingest_fn(mesh: Mesh, cfg: sk.SketchConfig,
         s = jax.tree.map(lambda x: x[0], pstate)  # drop the data-axis dim
         s = sk.ingest(s, arrays,
                       sketch_axis=SKETCH_AXIS if nsk > 1 else None,
-                      sketch_shards=nsk)
+                      sketch_shards=nsk,
+                      # width-sharded sketches keep the masked-scatter path;
+                      # the Pallas fold applies to whole-width replicas
+                      use_pallas=cfg.use_pallas and nsk == 1)
         return jax.tree.map(lambda x: x[None], s)
 
     shmapped = jax.shard_map(

@@ -35,12 +35,16 @@ class SketchConfig(NamedTuple):
     hist_buckets: int = 1024
     ewma_buckets: int = 4096
     ewma_alpha: float = 0.3
+    # Pallas one-hot-matmul Count-Min fold instead of XLA scatter (TPU-only
+    # win; scatter is faster on CPU)
+    use_pallas: bool = False
 
     @classmethod
     def from_agent_config(cls, cfg) -> "SketchConfig":
         return cls(cm_depth=cfg.sketch_cm_depth, cm_width=cfg.sketch_cm_width,
                    hll_precision=cfg.sketch_hll_precision, topk=cfg.sketch_topk,
-                   ewma_alpha=cfg.sketch_ewma_alpha)
+                   ewma_alpha=cfg.sketch_ewma_alpha,
+                   use_pallas=cfg.sketch_use_pallas)
 
 
 class SketchState(NamedTuple):
@@ -76,8 +80,10 @@ QS = np.array([0.5, 0.9, 0.95, 0.99, 0.999], dtype=np.float32)
 
 def init_state(cfg: SketchConfig = SketchConfig()) -> SketchState:
     return SketchState(
+        # both counter planes are float32: packet counts stay exact below
+        # 2^24 per window, and a single dtype lets the Pallas fold serve both
         cm_bytes=countmin.init(cfg.cm_depth, cfg.cm_width, jnp.float32),
-        cm_pkts=countmin.init(cfg.cm_depth, cfg.cm_width, jnp.int32),
+        cm_pkts=countmin.init(cfg.cm_depth, cfg.cm_width, jnp.float32),
         heavy=topk.init(cfg.topk, KEY_WORDS),
         hll_src=hll.init(cfg.hll_precision),
         hll_per_dst=hll.init_per_dst(cfg.perdst_buckets, cfg.perdst_precision),
@@ -105,7 +111,8 @@ def batch_to_device(batch: FlowBatch) -> dict[str, np.ndarray]:
 
 
 def ingest(state: SketchState, arrays: dict[str, jax.Array],
-           sketch_axis: str | None = None, sketch_shards: int = 1) -> SketchState:
+           sketch_axis: str | None = None, sketch_shards: int = 1,
+           use_pallas: bool = False) -> SketchState:
     """Fold one batch into all sketches. Pure; jit with donate_argnums=0.
 
     When `sketch_axis` is set (inside shard_map over a 2D mesh), the Count-Min
@@ -127,8 +134,17 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     dst_h1, _ = hashing.base_hashes(words[:, 4:8], seed=0x0D57)
 
     if sketch_axis is None:
-        cm_b = countmin.update(state.cm_bytes, h1, h2, bytes_f, valid)
-        cm_p = countmin.update(state.cm_pkts, h1, h2, pkts, valid)
+        # the Pallas kernel needs the width to tile; silently use the XLA
+        # scatter otherwise (static shape check, resolved at trace time)
+        if use_pallas and state.cm_bytes.width % 512 == 0:
+            from netobserv_tpu.ops.pallas import countmin_kernel
+            cm_b = countmin_kernel.update(state.cm_bytes, h1, h2, bytes_f,
+                                          valid)
+            cm_p = countmin_kernel.update(state.cm_pkts, h1, h2,
+                                          pkts.astype(jnp.float32), valid)
+        else:
+            cm_b = countmin.update(state.cm_bytes, h1, h2, bytes_f, valid)
+            cm_p = countmin.update(state.cm_pkts, h1, h2, pkts, valid)
         query_fn = None
     else:
         cm_b = countmin.update_sharded(state.cm_bytes, h1, h2, bytes_f, valid,
@@ -158,9 +174,10 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     )
 
 
-def make_ingest_fn(donate: bool = True):
+def make_ingest_fn(donate: bool = True, use_pallas: bool = False):
     """Jitted ingest; donates the state buffers so updates are in-place on HBM."""
-    return jax.jit(ingest, donate_argnums=(0,) if donate else ())
+    fn = lambda s, a: ingest(s, a, use_pallas=use_pallas)  # noqa: E731
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def roll_window(state: SketchState, cfg: SketchConfig,

@@ -1,0 +1,79 @@
+import io
+import json
+
+from netobserv_tpu.exporter.direct_flp import DirectFLPExporter
+from tests.test_exporters import make_record
+
+CFG = """
+pipeline:
+  - name: filter1
+  - name: rename
+    follows: filter1
+  - name: out
+    follows: rename
+parameters:
+  - name: filter1
+    transform:
+      type: filter
+      filter:
+        rules:
+          - type: keep_entry_if_equal
+            keepEntryField: Proto
+            keepEntryValue: 6
+          - type: remove_field
+            removeField: SrcMac
+  - name: rename
+    transform:
+      type: generic
+      generic:
+        policy: preserve
+        rules:
+          - input: SrcAddr
+            output: SourceAddress
+  - name: out
+    write:
+      type: stdout
+"""
+
+
+def _run(cfg, records):
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=cfg, stream=buf)
+    exp.export_batch(records)
+    return [json.loads(l) for l in buf.getvalue().splitlines()]
+
+
+def test_pipeline_filters_renames_and_writes():
+    tcp = make_record(proto=6)
+    udp = make_record(proto=17)
+    out = _run(CFG, [tcp, udp])
+    assert len(out) == 1  # UDP filtered by keep_entry_if_equal Proto=6
+    entry = out[0]
+    assert "SrcMac" not in entry  # removed
+    assert entry["SourceAddress"] == "10.1.1.1"  # renamed (preserve policy)
+    assert entry["SrcAddr"] == "10.1.1.1"
+
+
+def test_empty_config_passthrough():
+    out = _run("", [make_record()])
+    assert len(out) == 1
+    assert out[0]["DstPort"] == 443
+
+
+def test_replace_keys_policy():
+    cfg = """
+pipeline: [{name: t}, {name: w, follows: t}]
+parameters:
+  - name: t
+    transform:
+      type: generic
+      generic:
+        policy: replace_keys
+        rules:
+          - {input: Bytes, output: octets}
+          - {input: Packets, output: packets}
+  - name: w
+    write: {type: stdout}
+"""
+    out = _run(cfg, [make_record(nbytes=777)])
+    assert out[0] == {"octets": 777, "packets": 7}

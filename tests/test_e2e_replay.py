@@ -1,0 +1,106 @@
+"""End-to-end replay suite (the kind-cluster e2e analog, SURVEY.md §4):
+synthesize traffic as a pcap, run the FULL agent binary over it, and assert
+per-flow byte accounting on the exported stream — the same assertion shape as
+the reference's e2e basic suite (per-packet byte accounting of ICMP flows)."""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def build_pcap(path: str):
+    sys.path.insert(0, str(REPO))
+    from netobserv_tpu.model.packet_record import pcap_file_header
+
+    def eth(proto=0x0800):
+        return b"\x02" * 6 + b"\x04" * 6 + struct.pack(">H", proto)
+
+    def ipv4(src, dst, proto, payload_len):
+        total = 20 + payload_len
+        return struct.pack(">BBHHHBBH4s4s", 0x45, 0, total, 1, 0, 64, proto,
+                           0, bytes(src), bytes(dst))
+
+    def icmp_echo(seq, payload=56):
+        return struct.pack(">BBHHH", 8, 0, 0, 42, seq) + b"\x00" * payload
+
+    def udp(sport, dport, payload=24):
+        return struct.pack(">HHHH", sport, dport, 8 + payload, 0) + \
+            b"\x00" * payload
+
+    packets = []
+    t0 = 1_700_000_000
+    # 5 pings of 64B ICMP payload+header each from 10.0.0.5 -> 10.0.0.9
+    for i in range(5):
+        pkt = eth() + ipv4([10, 0, 0, 5], [10, 0, 0, 9], 1, 64 + 20 - 20) + \
+            icmp_echo(i)
+        # recompute: ip payload length is icmp length
+        pkt = eth() + ipv4([10, 0, 0, 5], [10, 0, 0, 9], 1,
+                           len(icmp_echo(i))) + icmp_echo(i)
+        hdr = struct.pack("<IIII", t0 + i, 0, len(pkt), len(pkt))
+        packets.append(hdr + pkt)
+    # 3 DNS-ish UDP packets 10.0.0.5:5353 -> 10.0.0.53:53
+    for i in range(3):
+        body = udp(5353, 53)
+        pkt = eth() + ipv4([10, 0, 0, 5], [10, 0, 0, 53], 17, len(body)) + body
+        hdr = struct.pack("<IIII", t0 + i, 500_000, len(pkt), len(pkt))
+        packets.append(hdr + pkt)
+    with open(path, "wb") as fh:
+        fh.write(pcap_file_header(65535) + b"".join(packets))
+
+
+@pytest.fixture(scope="module")
+def exported_flows(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("e2e")
+    pcap = str(tmp / "traffic.pcap")
+    build_pcap(pcap)
+    env = dict(os.environ, DATAPATH=f"pcap:{pcap}", EXPORT="stdout",
+               CACHE_ACTIVE_TIMEOUT="100ms", LOG_LEVEL="warning")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "netobserv_tpu"], cwd=str(REPO), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    time.sleep(2.5)
+    proc.terminate()
+    out, _ = proc.communicate(timeout=10)
+    return [json.loads(line) for line in out.splitlines()]
+
+
+def agg(flows, **match):
+    found = [f for f in flows
+             if all(f.get(k) == v for k, v in match.items())]
+    return (sum(f["Bytes"] for f in found), sum(f["Packets"] for f in found))
+
+
+def test_icmp_flow_byte_accounting(exported_flows):
+    # each ping frame: 20 IP + 8 ICMP + 56 payload = 84 bytes, 5 packets
+    nbytes, pkts = agg(exported_flows, SrcAddr="10.0.0.5", DstAddr="10.0.0.9",
+                       Proto=1)
+    assert pkts == 5
+    assert nbytes == 5 * 84
+    icmp = [f for f in exported_flows if f.get("Proto") == 1]
+    assert icmp[0]["IcmpType"] == 8  # echo request
+
+
+def test_udp_flow_accounting(exported_flows):
+    nbytes, pkts = agg(exported_flows, SrcAddr="10.0.0.5",
+                       DstAddr="10.0.0.53", Proto=17, DstPort=53)
+    assert pkts == 3
+    assert nbytes == 3 * (20 + 8 + 24)
+
+
+def test_no_unexpected_flows(exported_flows):
+    keys = {(f["SrcAddr"], f["DstAddr"], f.get("Proto")) for f in exported_flows}
+    assert keys == {("10.0.0.5", "10.0.0.9", 1), ("10.0.0.5", "10.0.0.53", 17)}
+
+
+def test_wall_times_are_current(exported_flows):
+    now_ms = time.time_ns() // 10**6
+    for f in exported_flows:
+        assert abs(f["TimeFlowEndMs"] - now_ms) < 60_000

@@ -1,0 +1,32 @@
+from netobserv_tpu.datapath import kernel
+
+
+def test_version_code_ordering():
+    assert kernel.version_code("6.6.0") > kernel.version_code("5.19.7")
+    assert kernel.version_code("5.10") > kernel.version_code("5.6.3")
+    assert kernel.version_code("bogus") == 0
+
+
+def test_is_kernel_older_than():
+    assert kernel.is_kernel_older_than("5.8", release="5.4.0-generic")
+    assert not kernel.is_kernel_older_than("5.8", release="6.1.0")
+    # unparseable release: not treated as older (fail open, attach and see)
+    assert not kernel.is_kernel_older_than("5.8", release="weird")
+
+
+def test_capability_ladder():
+    assert kernel.supports_tcx(release="6.6.1")
+    assert not kernel.supports_tcx(release="6.1.0")
+    assert kernel.supports_fentry(release="5.7.0")
+    assert not kernel.supports_fentry(release="5.4.0")
+    assert kernel.supports_ringbuf(release="5.8.0")
+    assert not kernel.supports_lookup_and_delete_batch(release="5.4.0")
+
+
+def test_rt_detection():
+    assert kernel.is_realtime_kernel(release="5.14.0-rt21")
+    assert not kernel.is_realtime_kernel(release="6.1.0-generic")
+
+
+def test_current_host_parses():
+    assert kernel.version_code(kernel.current_release()) > 0
