@@ -162,12 +162,16 @@ def build_fetcher(cfg: AgentConfig) -> FlowFetcher:
     import os
 
     mode = os.environ.get("DATAPATH", "auto")
+    # an explicit DATAPATH replay request overrides everything (debug/replay)
     if mode.startswith("pcap:"):
         from netobserv_tpu.datapath.replay import PcapReplayFetcher
         return PcapReplayFetcher(mode[5:], window_s=cfg.cache_active_timeout)
     if mode == "synthetic":
         from netobserv_tpu.datapath.replay import SyntheticFetcher
         return SyntheticFetcher()
+    if cfg.ebpf_program_manager_mode:
+        from netobserv_tpu.datapath.loader import BpfmanFetcher
+        return BpfmanFetcher.load(cfg)
     try:
         from netobserv_tpu.datapath.loader import KernelFetcher
         return KernelFetcher.load(cfg)

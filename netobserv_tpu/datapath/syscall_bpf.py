@@ -1,0 +1,322 @@
+"""Direct bpf(2) syscall access to BPF maps — no libbpf dependency.
+
+Powers EBPF_PROGRAM_MANAGER_MODE (bpfman): an external lifecycle manager owns
+the programs and pins the maps on bpffs; the agent opens the pinned maps and
+evicts through them (reference analog: `pkg/tracer/tracer.go:275-384`). Also
+used by tests to create scratch maps and exercise the real kernel eviction
+path where CAP_BPF is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import os
+import platform
+import struct
+from typing import Optional
+
+# syscall numbers for bpf(2)
+_SYSCALL_TABLE = {
+    "x86_64": 321,
+    "aarch64": 280,
+    "ppc64le": 361,
+    "s390x": 351,
+    "riscv64": 280,
+}
+_MACHINE = platform.machine()
+if _MACHINE not in _SYSCALL_TABLE:
+    raise ImportError(
+        f"bpf(2) syscall number unknown for architecture {_MACHINE!r}")
+_SYSCALL_NR = _SYSCALL_TABLE[_MACHINE]
+
+# bpf(2) commands
+BPF_MAP_CREATE = 0
+BPF_MAP_LOOKUP_ELEM = 1
+BPF_MAP_UPDATE_ELEM = 2
+BPF_MAP_DELETE_ELEM = 3
+BPF_MAP_GET_NEXT_KEY = 4
+BPF_OBJ_PIN = 6
+BPF_OBJ_GET = 7
+BPF_MAP_LOOKUP_AND_DELETE_ELEM = 21
+BPF_OBJ_GET_INFO_BY_FD = 15
+
+BPF_ANY = 0
+BPF_NOEXIST = 1
+
+_libc = ctypes.CDLL(None, use_errno=True)
+
+
+def _bpf(cmd: int, attr: bytes) -> int:
+    buf = ctypes.create_string_buffer(attr, len(attr))
+    ret = _libc.syscall(_SYSCALL_NR, cmd, buf, len(attr))
+    if ret < 0:
+        err = ctypes.get_errno()
+        raise OSError(err, os.strerror(err))
+    return ret
+
+
+def _bpf_inout(cmd: int, attr: bytearray) -> int:
+    buf = (ctypes.c_char * len(attr)).from_buffer(attr)
+    ret = _libc.syscall(_SYSCALL_NR, cmd, buf, len(attr))
+    if ret < 0:
+        err = ctypes.get_errno()
+        raise OSError(err, os.strerror(err))
+    return ret
+
+
+class BpfMap:
+    """One open BPF map fd with typed key/value byte access."""
+
+    def __init__(self, fd: int, key_size: int, value_size: int,
+                 max_entries: int = 0, n_cpus: int = 1):
+        self.fd = fd
+        self.key_size = key_size
+        self.value_size = value_size
+        self.max_entries = max_entries
+        self.n_cpus = n_cpus  # >1 for per-CPU maps (value is per-cpu array)
+        self._no_lookup_and_delete = False  # latched capability probe
+
+    # --- constructors ---
+    @classmethod
+    def create(cls, map_type: int, key_size: int, value_size: int,
+               max_entries: int, name: bytes = b"") -> "BpfMap":
+        attr = struct.pack("<IIII", map_type, key_size, value_size,
+                           max_entries)
+        attr += struct.pack("<I", 0)  # map_flags
+        attr += b"\x00" * 4  # inner_map_fd
+        attr += b"\x00" * 4  # numa_node
+        attr += name[:15].ljust(16, b"\x00")
+        fd = _bpf(BPF_MAP_CREATE, attr)
+        return cls(fd, key_size, value_size, max_entries)
+
+    def pin(self, path: str) -> None:
+        pathbuf = ctypes.create_string_buffer(path.encode() + b"\x00")
+        attr = struct.pack("<QI", ctypes.addressof(pathbuf), self.fd)
+        _bpf(BPF_OBJ_PIN, attr)
+
+    @staticmethod
+    def get_info(fd: int) -> tuple[int, int, int, int]:
+        """(map_type, key_size, value_size, max_entries) via
+        BPF_OBJ_GET_INFO_BY_FD."""
+        info = ctypes.create_string_buffer(88)  # struct bpf_map_info
+        attr = struct.pack("<IIQ", fd, len(info), ctypes.addressof(info))
+        _bpf(BPF_OBJ_GET_INFO_BY_FD, attr)
+        map_type, _id, key_size, value_size, max_entries = struct.unpack_from(
+            "<IIIII", info.raw, 0)
+        return map_type, key_size, value_size, max_entries
+
+    @classmethod
+    def open_pinned(cls, path: str, key_size: int, value_size: int,
+                    n_cpus: int = 1) -> "BpfMap":
+        pathbuf = path.encode() + b"\x00"
+        str_ptr = ctypes.create_string_buffer(pathbuf)
+        attr = struct.pack("<Q", ctypes.addressof(str_ptr))
+        fd = _bpf(BPF_OBJ_GET, attr)
+        # validate the pinned map's REAL sizes: a layout mismatch would let
+        # the kernel write past our value buffer (heap corruption)
+        _mtype, real_key, real_value, _max_entries = cls.get_info(fd)
+        if real_key != key_size or real_value != value_size:
+            os.close(fd)
+            raise ValueError(
+                f"pinned map {path} layout mismatch: kernel has "
+                f"key={real_key}/value={real_value}, expected "
+                f"key={key_size}/value={value_size} (datapath version skew?)")
+        return cls(fd, key_size, value_size, _max_entries, n_cpus=n_cpus)
+
+    # --- element ops ---
+    def _ptr_attr(self, key: bytes, value_buf=None, flags: int = 0) -> tuple:
+        kbuf = ctypes.create_string_buffer(key, self.key_size)
+        vsize = self.value_size * self.n_cpus
+        vbuf = value_buf if value_buf is not None else \
+            ctypes.create_string_buffer(vsize)
+        attr = struct.pack("<IxxxxQQQ", self.fd, ctypes.addressof(kbuf),
+                           ctypes.addressof(vbuf), flags)
+        return attr, kbuf, vbuf
+
+    def update(self, key: bytes, value: bytes, flags: int = BPF_ANY) -> None:
+        vsize = self.value_size * self.n_cpus
+        vbuf = ctypes.create_string_buffer(value, vsize)
+        attr, _k, _v = self._ptr_attr(key, vbuf, flags)
+        _bpf(BPF_MAP_UPDATE_ELEM, attr)
+
+    def lookup(self, key: bytes) -> Optional[bytes]:
+        attr, _k, vbuf = self._ptr_attr(key)
+        try:
+            _bpf(BPF_MAP_LOOKUP_ELEM, attr)
+        except OSError as exc:
+            if exc.errno == errno.ENOENT:
+                return None
+            raise
+        return vbuf.raw
+
+    def lookup_and_delete(self, key: bytes) -> Optional[bytes]:
+        attr, _k, vbuf = self._ptr_attr(key)
+        try:
+            _bpf(BPF_MAP_LOOKUP_AND_DELETE_ELEM, attr)
+        except OSError as exc:
+            if exc.errno == errno.ENOENT:
+                return None
+            if exc.errno in (errno.EINVAL, errno.ENOTSUP, errno.EPERM):
+                raise NotImplementedError(
+                    "LOOKUP_AND_DELETE unsupported for this map/kernel") from exc
+            raise
+        return vbuf.raw
+
+    def delete(self, key: bytes) -> bool:
+        kbuf = ctypes.create_string_buffer(key, self.key_size)
+        attr = struct.pack("<IxxxxQQQ", self.fd, ctypes.addressof(kbuf), 0, 0)
+        try:
+            _bpf(BPF_MAP_DELETE_ELEM, attr)
+            return True
+        except OSError as exc:
+            if exc.errno == errno.ENOENT:
+                return False
+            raise
+
+    def next_key(self, key: Optional[bytes]) -> Optional[bytes]:
+        kbuf = ctypes.create_string_buffer(
+            key if key is not None else b"\x00" * self.key_size, self.key_size)
+        nbuf = ctypes.create_string_buffer(self.key_size)
+        attr = struct.pack("<IxxxxQQQ", self.fd,
+                           0 if key is None else ctypes.addressof(kbuf),
+                           ctypes.addressof(nbuf), 0)
+        try:
+            _bpf(BPF_MAP_GET_NEXT_KEY, attr)
+        except OSError as exc:
+            if exc.errno == errno.ENOENT:
+                return None
+            raise
+        return nbuf.raw
+
+    def keys(self) -> list[bytes]:
+        out = []
+        key = self.next_key(None)
+        while key is not None:
+            out.append(key)
+            key = self.next_key(key)
+        return out
+
+    def drain(self) -> list[tuple[bytes, bytes]]:
+        """Two-phase eviction: iterate keys, then lookup-and-delete each
+        (falling back to lookup+delete on old kernels, latched after the
+        first failure) — the reference's eviction idiom
+        (`tracer.go:1022-1054`, legacy `tracer_legacy.go:11-35`)."""
+        out = []
+        for key in self.keys():
+            if self._no_lookup_and_delete:
+                val = self.lookup(key)
+                self.delete(key)
+            else:
+                try:
+                    val = self.lookup_and_delete(key)
+                except NotImplementedError:
+                    self._no_lookup_and_delete = True
+                    val = self.lookup(key)
+                    self.delete(key)
+            if val is not None:
+                out.append((key, val))
+        return out
+
+    def close(self) -> None:
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+
+
+RINGBUF_BUSY_BIT = 0x80000000
+RINGBUF_DISCARD_BIT = 0x40000000
+_RB_HDR_SIZE = 8
+
+
+def parse_ringbuf_records(data, consumer_pos: int, producer_pos: int,
+                          mask: int) -> tuple[list[bytes], int]:
+    """Walk ring records in [consumer_pos, producer_pos); returns
+    (records, new_consumer_pos). Stops at a BUSY (still-being-written)
+    record. Pure function so the wire format is unit-testable."""
+    out: list[bytes] = []
+    pos = consumer_pos
+    while pos < producer_pos:
+        off = pos & mask
+        hdr = int.from_bytes(data[off:off + 4], "little")
+        if hdr & RINGBUF_BUSY_BIT:
+            break
+        length = hdr & ~(RINGBUF_BUSY_BIT | RINGBUF_DISCARD_BIT)
+        if not (hdr & RINGBUF_DISCARD_BIT):
+            start = off + _RB_HDR_SIZE
+            out.append(bytes(data[start:start + length]))
+        pos += (_RB_HDR_SIZE + length + 7) & ~7  # 8-byte aligned advance
+    return out, pos
+
+
+class RingBufReader:
+    """mmap consumer for a BPF_MAP_TYPE_RINGBUF map (libbpf ring layout:
+    consumer page rw at offset 0; producer page + data ro at PAGE_SIZE)."""
+
+    def __init__(self, ringbuf_map: BpfMap):
+        import mmap as _mmap
+        import select
+
+        self._map = ringbuf_map
+        _mtype, _k, _v, max_entries = BpfMap.get_info(ringbuf_map.fd)
+        self._size = max_entries
+        self._mask = max_entries - 1
+        page = _mmap.PAGESIZE
+        self._cons = _mmap.mmap(ringbuf_map.fd, page, _mmap.MAP_SHARED,
+                                _mmap.PROT_READ | _mmap.PROT_WRITE, offset=0)
+        self._prod = _mmap.mmap(ringbuf_map.fd, page + 2 * max_entries,
+                                _mmap.MAP_SHARED, _mmap.PROT_READ,
+                                offset=page)
+        self._data_off = page
+        self._epoll = select.epoll()
+        self._epoll.register(ringbuf_map.fd, select.EPOLLIN)
+        self._pending: list[bytes] = []
+
+    def _positions(self) -> tuple[int, int]:
+        cons = int.from_bytes(self._cons[0:8], "little")
+        prod = int.from_bytes(self._prod[0:8], "little")
+        return cons, prod
+
+    def read(self, timeout_s: float) -> Optional[bytes]:
+        """One record, or None on timeout."""
+        if self._pending:
+            return self._pending.pop(0)
+        cons, prod = self._positions()
+        if cons >= prod:
+            if not self._epoll.poll(timeout_s):
+                return None
+            cons, prod = self._positions()
+        data = memoryview(self._prod)[self._data_off:]
+        records, new_cons = parse_ringbuf_records(data, cons, prod, self._mask)
+        self._cons[0:8] = new_cons.to_bytes(8, "little")
+        if not records:
+            return None
+        self._pending = records[1:]
+        return records[0]
+
+    def close(self) -> None:
+        self._epoll.close()
+        self._cons.close()
+        self._prod.close()
+
+
+def n_possible_cpus() -> int:
+    try:
+        with open("/sys/devices/system/cpu/possible") as fh:
+            spec = fh.read().strip()
+        last = spec.split("-")[-1].split(",")[-1]
+        return int(last) + 1
+    except (OSError, ValueError):
+        return os.cpu_count() or 1
+
+
+def bpf_available() -> bool:
+    """Can this process create BPF maps? (CAP_BPF or root + kernel support)"""
+    try:
+        m = BpfMap.create(1, 4, 8, 4, b"probe")  # BPF_MAP_TYPE_HASH
+        m.close()
+        return True
+    except OSError:
+        return False

@@ -1,0 +1,233 @@
+"""EBPF_PROGRAM_MANAGER_MODE e2e over REAL kernel maps.
+
+Simulates bpfman: creates and pins genuine BPF maps on bpffs, fills them with
+flow entries (per-CPU feature partials included), then drives the agent's
+bpfman fetcher + pipeline and asserts on the exported records. This exercises
+the actual bpf(2) eviction path (lookup-and-delete / iterate+delete, per-CPU
+merge) against the running kernel — no fakes.
+
+Skipped where CAP_BPF or a writable bpffs is unavailable.
+"""
+
+import os
+import queue
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from netobserv_tpu.datapath import syscall_bpf as sb
+from netobserv_tpu.model import binfmt
+from netobserv_tpu.model.flow import GlobalCounter, ip_to_16
+
+BPFFS = "/sys/fs/bpf"
+PIN_DIR = os.path.join(BPFFS, "netobserv_tpu_test")
+
+BPF_MAP_TYPE_HASH = 1
+BPF_MAP_TYPE_PERCPU_HASH = 5
+BPF_MAP_TYPE_PERCPU_ARRAY = 6
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.ismount(BPFFS) and os.access(BPFFS, os.W_OK)
+         and sb.bpf_available()),
+    reason="needs CAP_BPF and a writable bpffs")
+
+
+def make_key(sport):
+    key = np.zeros(1, dtype=binfmt.FLOW_KEY_DTYPE)[0]
+    key["src_ip"] = np.frombuffer(ip_to_16("10.7.7.1"), np.uint8)
+    key["dst_ip"] = np.frombuffer(ip_to_16("10.7.7.2"), np.uint8)
+    key["src_port"] = sport
+    key["dst_port"] = 443
+    key["proto"] = 6
+    return key
+
+
+def make_stats(nbytes, pkts):
+    now = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+    stats = np.zeros(1, dtype=binfmt.FLOW_STATS_DTYPE)[0]
+    stats["bytes"] = nbytes
+    stats["packets"] = pkts
+    stats["first_seen_ns"] = now - 10**9
+    stats["last_seen_ns"] = now
+    stats["eth_protocol"] = 0x0800
+    stats["if_index_first"] = 2
+    return stats
+
+
+@pytest.fixture
+def pinned_maps():
+    os.makedirs(PIN_DIR, exist_ok=True)
+    n_cpus = sb.n_possible_cpus()
+    created = {}
+
+    agg = sb.BpfMap.create(BPF_MAP_TYPE_HASH,
+                           binfmt.FLOW_KEY_DTYPE.itemsize,
+                           binfmt.FLOW_STATS_DTYPE.itemsize, 1024, b"agg")
+    agg.pin(os.path.join(PIN_DIR, "aggregated_flows"))
+    created["aggregated_flows"] = agg
+
+    extra = sb.BpfMap.create(BPF_MAP_TYPE_PERCPU_HASH,
+                             binfmt.FLOW_KEY_DTYPE.itemsize,
+                             binfmt.EXTRA_REC_DTYPE.itemsize, 1024, b"extra")
+    extra.n_cpus = n_cpus
+    extra.pin(os.path.join(PIN_DIR, "flows_extra"))
+    created["flows_extra"] = extra
+
+    ctrs = sb.BpfMap.create(BPF_MAP_TYPE_PERCPU_ARRAY, 4, 8,
+                            int(GlobalCounter.MAX), b"ctrs")
+    ctrs.n_cpus = n_cpus
+    ctrs.pin(os.path.join(PIN_DIR, "global_counters"))
+    created["global_counters"] = ctrs
+
+    yield created
+    for m in created.values():
+        m.close()
+    shutil.rmtree(PIN_DIR, ignore_errors=True)
+
+
+def test_bpfman_fetcher_drains_real_kernel_maps(pinned_maps):
+    from netobserv_tpu.datapath.loader import BpfmanFetcher
+
+    n_cpus = sb.n_possible_cpus()
+    # two flows in the aggregation map
+    for sport, nbytes in ((1001, 5000), (1002, 64)):
+        pinned_maps["aggregated_flows"].update(
+            make_key(sport).tobytes(), make_stats(nbytes, 3).tobytes())
+    # per-CPU RTT partials for flow 1001: max across CPUs should win
+    partials = np.zeros(n_cpus, dtype=binfmt.EXTRA_REC_DTYPE)
+    for c in range(min(n_cpus, 3)):
+        partials[c]["rtt_ns"] = (c + 1) * 1_000_000
+    pinned_maps["flows_extra"].update(
+        make_key(1001).tobytes(), partials.tobytes())
+
+    fetcher = BpfmanFetcher(PIN_DIR)
+    evicted = fetcher.lookup_and_delete()
+    assert len(evicted) == 2
+    by_port = {int(evicted.events["key"][i]["src_port"]): i
+               for i in range(len(evicted))}
+    i1 = by_port[1001]
+    assert int(evicted.events["stats"][i1]["bytes"]) == 5000
+    assert int(evicted.extra[i1]["rtt_ns"]) == min(sb.n_possible_cpus(), 3) * 1_000_000
+    # maps are empty after eviction (real kernel delete happened)
+    assert pinned_maps["aggregated_flows"].keys() == []
+    # second eviction returns nothing
+    assert len(fetcher.lookup_and_delete()) == 0
+    fetcher.close()
+
+
+def test_bpfman_full_agent_pipeline(pinned_maps):
+    from netobserv_tpu.agent import FlowsAgent
+    from netobserv_tpu.config import load_config
+    from netobserv_tpu.datapath.loader import BpfmanFetcher
+    from tests.test_pipeline import CollectExporter
+
+    pinned_maps["aggregated_flows"].update(
+        make_key(2001).tobytes(), make_stats(7777, 9).tobytes())
+
+    cfg = load_config(environ={
+        "EXPORT": "stdout", "CACHE_ACTIVE_TIMEOUT": "100ms",
+        "EBPF_PROGRAM_MANAGER_MODE": "true",
+        "BPFMAN_BPF_FS_PATH": PIN_DIR})
+    out = CollectExporter()
+    agent = FlowsAgent(cfg, BpfmanFetcher.load(cfg), out)
+    stop = threading.Event()
+    t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        batch = out.batches.get(timeout=5)
+        assert len(batch) == 1
+        rec = batch[0]
+        assert rec.key.src == "10.7.7.1"
+        assert rec.key.src_port == 2001
+        assert rec.bytes_ == 7777
+        assert rec.packets == 9
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_orphan_feature_record_becomes_standalone_event(pinned_maps):
+    """A feature record with no matching aggregation entry must not be lost
+    (reference keeps unmatched per-CPU metrics as fresh flow records)."""
+    from netobserv_tpu.datapath.loader import BpfmanFetcher
+    n_cpus = sb.n_possible_cpus()
+    partials = np.zeros(n_cpus, dtype=binfmt.EXTRA_REC_DTYPE)
+    partials[0]["rtt_ns"] = 42_000_000
+    partials[0]["first_seen_ns"] = 123
+    partials[0]["last_seen_ns"] = 456
+    pinned_maps["flows_extra"].update(
+        make_key(3333).tobytes(), partials.tobytes())
+    fetcher = BpfmanFetcher(PIN_DIR)
+    evicted = fetcher.lookup_and_delete()
+    assert len(evicted) == 1
+    assert int(evicted.events["key"][0]["src_port"]) == 3333
+    assert int(evicted.extra[0]["rtt_ns"]) == 42_000_000
+    assert int(evicted.events["stats"][0]["first_seen_ns"]) == 123
+    fetcher.close()
+
+
+def test_ringbuf_reader_opens_and_times_out(pinned_maps):
+    """A pinned BPF_MAP_TYPE_RINGBUF can be mmap'd and polled (only a BPF
+    program can submit records, so data-path parsing is covered by the pure
+    parser test below)."""
+    rb = sb.BpfMap.create(27, 0, 0, 4096, b"rb")  # BPF_MAP_TYPE_RINGBUF
+    rb.pin(os.path.join(PIN_DIR, "direct_flows"))
+    try:
+        from netobserv_tpu.datapath.loader import BpfmanFetcher
+        fetcher = BpfmanFetcher(PIN_DIR)
+        assert fetcher._ringbuf is not None
+        t0 = time.monotonic()
+        assert fetcher.read_ringbuf(0.1) is None
+        assert time.monotonic() - t0 < 2.0
+        fetcher.close()
+    finally:
+        rb.close()
+
+
+def test_ringbuf_record_parser():
+    """Wire-format walk: normal, discarded, and busy records."""
+    import struct
+
+    def rec(payload, busy=False, discard=False):
+        hdr = len(payload)
+        if busy:
+            hdr |= sb.RINGBUF_BUSY_BIT
+        if discard:
+            hdr |= sb.RINGBUF_DISCARD_BIT
+        body = struct.pack("<II", hdr, 0) + payload
+        return body + b"\x00" * ((-len(body)) % 8)
+
+    data = rec(b"AAAA") + rec(b"BB", discard=True) + rec(b"CCCCCCCC")
+    records, pos = sb.parse_ringbuf_records(
+        memoryview(data), 0, len(data), mask=0xFFFF)
+    assert records == [b"AAAA", b"CCCCCCCC"]
+    assert pos == len(data)
+    # busy record stops the walk mid-stream
+    data2 = rec(b"XX") + rec(b"YY", busy=True) + rec(b"ZZ")
+    records2, pos2 = sb.parse_ringbuf_records(
+        memoryview(data2), 0, len(data2), mask=0xFFFF)
+    assert records2 == [b"XX"]
+    assert pos2 == 16  # stopped at the busy record's header
+
+
+def test_counters_scrape_and_reset(pinned_maps):
+    import struct
+
+    from netobserv_tpu.datapath.loader import BpfmanFetcher
+    n_cpus = sb.n_possible_cpus()
+    # simulate the datapath bumping FILTER_ACCEPT on two cpus
+    vals = bytearray(8 * n_cpus)
+    struct.pack_into("<Q", vals, 0, 5)
+    if n_cpus > 1:
+        struct.pack_into("<Q", vals, 8, 7)
+    pinned_maps["global_counters"].update(
+        struct.pack("<I", int(GlobalCounter.FILTER_ACCEPT)), bytes(vals))
+    fetcher = BpfmanFetcher(PIN_DIR)
+    counters = fetcher.read_global_counters()
+    assert counters[GlobalCounter.FILTER_ACCEPT] == (12 if n_cpus > 1 else 5)
+    # reset-on-read
+    assert fetcher.read_global_counters() == {}
+    fetcher.close()
