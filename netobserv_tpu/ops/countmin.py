@@ -58,6 +58,36 @@ def update(cm: CountMin, h1: jax.Array, h2: jax.Array, values: jax.Array,
     return CountMin(counts=new)
 
 
+def update_two(cm_a: CountMin, cm_b: CountMin, h1: jax.Array, h2: jax.Array,
+               vals_a: jax.Array, vals_b: jax.Array,
+               valid: jax.Array) -> tuple[CountMin, CountMin]:
+    """Fold one batch into two same-shape sketches with ONE scatter.
+
+    The two counter planes (bytes, packets) share hash indices, so stacking
+    them on a trailing axis halves the scatter count on the hot path.
+
+    Both sketches must use inexact (float) counters: the fold accumulates in
+    float32, which would silently round large int32 counters."""
+    d, w = cm_a.counts.shape
+    assert cm_b.counts.shape == (d, w)
+    assert (jnp.issubdtype(cm_a.counts.dtype, jnp.inexact)
+            and jnp.issubdtype(cm_b.counts.dtype, jnp.inexact)), \
+        "update_two requires float sketches (use countmin.update for int)"
+    idx = hashing.row_indices(h1, h2, d, w).astype(jnp.int32)  # [d, B]
+    stacked = jnp.stack(
+        [cm_a.counts.astype(jnp.float32), cm_b.counts.astype(jnp.float32)],
+        axis=-1)  # [d, w, 2]
+    vals = jnp.stack([
+        jnp.where(valid, vals_a, 0).astype(jnp.float32),
+        jnp.where(valid, vals_b, 0).astype(jnp.float32)], axis=-1)  # [B, 2]
+    vals = jnp.broadcast_to(vals[None], (d,) + vals.shape)  # [d, B, 2]
+    rows = jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32)[:, None],
+                            idx.shape)
+    new = stacked.at[rows, idx].add(vals, mode="drop", unique_indices=False)
+    return (CountMin(counts=new[..., 0].astype(cm_a.counts.dtype)),
+            CountMin(counts=new[..., 1].astype(cm_b.counts.dtype)))
+
+
 def query(cm: CountMin, h1: jax.Array, h2: jax.Array) -> jax.Array:
     """Point-query estimated counts for keys given their base hashes."""
     d, w = cm.counts.shape

@@ -143,8 +143,8 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
             cm_p = countmin_kernel.update(state.cm_pkts, h1, h2,
                                           pkts.astype(jnp.float32), valid)
         else:
-            cm_b = countmin.update(state.cm_bytes, h1, h2, bytes_f, valid)
-            cm_p = countmin.update(state.cm_pkts, h1, h2, pkts, valid)
+            cm_b, cm_p = countmin.update_two(
+                state.cm_bytes, state.cm_pkts, h1, h2, bytes_f, pkts, valid)
         query_fn = None
     else:
         cm_b = countmin.update_sharded(state.cm_bytes, h1, h2, bytes_f, valid,
@@ -154,7 +154,7 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
         query_fn = lambda a, b: countmin.query_sharded(  # noqa: E731
             cm_b, a, b, sketch_axis, sketch_shards)
     heavy = topk.update(state.heavy, cm_b, words, h1, h2, valid,
-                        query_fn=query_fn)
+                        query_fn=query_fn, salt=state.window)
     hll_src = hll.update(state.hll_src, src_h1, src_h2, valid)
     per_dst = hll.update_per_dst(state.hll_per_dst, dst_h1, src_h1, src_h2, valid)
     rtt = arrays["rtt_us"]
