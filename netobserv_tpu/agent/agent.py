@@ -156,8 +156,11 @@ class FlowsAgent:
 def build_fetcher(cfg: AgentConfig) -> FlowFetcher:
     """Datapath selection: kernel loader when available, replay otherwise.
 
-    DATAPATH env ("kernel" | "synthetic" | "pcap:<path>") overrides; default
-    tries the kernel loader and falls back to synthetic with a warning.
+    DATAPATH env ("kernel" | "synthetic" | "pcap:<path>" | "grpc:<port>")
+    overrides; default tries the kernel loader (bpfman mode when
+    EBPF_PROGRAM_MANAGER_MODE is set) and falls back to synthetic with a
+    warning. "grpc:<port>" turns this process into a collector-tier worker
+    consuming other agents' pbflow streams.
     """
     import os
 
@@ -169,6 +172,9 @@ def build_fetcher(cfg: AgentConfig) -> FlowFetcher:
     if mode == "synthetic":
         from netobserv_tpu.datapath.replay import SyntheticFetcher
         return SyntheticFetcher()
+    if mode.startswith("grpc:"):
+        from netobserv_tpu.datapath.grpc_ingest import GrpcIngestFetcher
+        return GrpcIngestFetcher(int(mode[5:]))
     if cfg.ebpf_program_manager_mode:
         from netobserv_tpu.datapath.loader import BpfmanFetcher
         return BpfmanFetcher.load(cfg)

@@ -1,0 +1,120 @@
+"""gRPC-ingest datapath: a TPU worker's record source.
+
+Deployment story (docs/architecture.md): per-node agents export over gRPC
+(pbflow wire format); a central TPU worker runs with `DATAPATH=grpc:<port>`
+and `EXPORT=tpu-sketch`, turning the incoming stream into cluster-wide sketch
+analytics. This replaces the reference's collector tier (flowlogs-pipeline)
+with the sketch plane while speaking the identical wire format.
+
+Implements the FlowFetcher seam: each lookup_and_delete() drains everything
+received since the previous eviction.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import time
+from typing import Optional
+
+import numpy as np
+
+from netobserv_tpu.datapath.fetcher import EvictedFlows
+from netobserv_tpu.model import binfmt
+from netobserv_tpu.model.flow import GlobalCounter
+
+log = logging.getLogger("netobserv_tpu.datapath.grpc_ingest")
+
+
+def pb_records_to_events(entries) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """pbflow.Record list -> (FLOW_EVENT, EXTRA_REC, DNS_REC) arrays.
+
+    Wall-clock pb timestamps are rebased against the local monotonic clock so
+    the standard pipeline enrichment yields the original wall times.
+    """
+    n = len(entries)
+    events = np.zeros(n, dtype=binfmt.FLOW_EVENT_DTYPE)
+    extra = np.zeros(n, dtype=binfmt.EXTRA_REC_DTYPE)
+    dns = np.zeros(n, dtype=binfmt.DNS_REC_DTYPE)
+    mono_now = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+    wall_now = time.time_ns()
+    offset = wall_now - mono_now  # wall -> mono rebase
+    from netobserv_tpu.exporter.pb_convert import _get_ip
+    for i, pb in enumerate(entries):
+        k = events[i]["key"]
+        k["src_ip"] = np.frombuffer(_get_ip(pb.network.src_addr), np.uint8)
+        k["dst_ip"] = np.frombuffer(_get_ip(pb.network.dst_addr), np.uint8)
+        k["src_port"] = pb.transport.src_port
+        k["dst_port"] = pb.transport.dst_port
+        k["proto"] = pb.transport.protocol
+        k["icmp_type"] = pb.icmp_type
+        k["icmp_code"] = pb.icmp_code
+        s = events[i]["stats"]
+        s["bytes"] = pb.bytes
+        s["packets"] = pb.packets
+        s["eth_protocol"] = pb.eth_protocol
+        s["tcp_flags"] = pb.flags
+        s["direction_first"] = int(pb.direction)
+        s["dscp"] = pb.network.dscp
+        s["sampling"] = pb.sampling
+        s["first_seen_ns"] = max(pb.time_flow_start.ToNanoseconds() - offset, 0)
+        s["last_seen_ns"] = max(pb.time_flow_end.ToNanoseconds() - offset, 0)
+        rtt = pb.time_flow_rtt.ToNanoseconds()
+        if rtt:
+            extra[i]["rtt_ns"] = rtt
+            extra[i]["first_seen_ns"] = s["first_seen_ns"]
+            extra[i]["last_seen_ns"] = s["last_seen_ns"]
+        lat = pb.dns_latency.ToNanoseconds()
+        if lat or pb.dns_id or pb.dns_errno:
+            dns[i]["latency_ns"] = lat
+            dns[i]["dns_id"] = pb.dns_id
+            dns[i]["dns_flags"] = pb.dns_flags
+            dns[i]["errno"] = pb.dns_errno
+            dns[i]["name"] = pb.dns_name.encode()[:31]
+            dns[i]["first_seen_ns"] = s["first_seen_ns"]
+            dns[i]["last_seen_ns"] = s["last_seen_ns"]
+    return events, extra, dns
+
+
+class GrpcIngestFetcher:
+    """FlowFetcher over an embedded pbflow.Collector server."""
+
+    def __init__(self, port: int):
+        from netobserv_tpu.grpc.flow import start_flow_collector
+        self._server, self.port, self._inbox = start_flow_collector(port)
+        log.info("grpc ingest listening on :%d", self.port)
+
+    def lookup_and_delete(self) -> EvictedFlows:
+        batches = []
+        while True:
+            try:
+                batches.append(self._inbox.get_nowait())
+            except queue.Empty:
+                break
+        if not batches:
+            return EvictedFlows(np.zeros(0, dtype=binfmt.FLOW_EVENT_DTYPE))
+        entries = [e for msg in batches for e in msg.entries]
+        events, extra, dns = pb_records_to_events(entries)
+        return EvictedFlows(
+            events,
+            extra=extra if extra["rtt_ns"].any() else None,
+            dns=dns if (dns["latency_ns"].any() or dns["dns_id"].any()) else None)
+
+    def read_ringbuf(self, timeout_s: float) -> Optional[bytes]:
+        time.sleep(timeout_s)
+        return None
+
+    def read_global_counters(self) -> dict[GlobalCounter, int]:
+        return {}
+
+    def purge_stale(self, older_than_s: float) -> int:
+        return 0
+
+    def attach(self, if_index: int, if_name: str, direction: str) -> None:
+        pass
+
+    def detach(self, if_index: int, if_name: str) -> None:
+        pass
+
+    def close(self) -> None:
+        self._server.stop(grace=0.5)

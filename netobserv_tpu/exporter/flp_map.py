@@ -85,7 +85,8 @@ def record_to_map(r: Record) -> dict:
     if f.rtt_ns:
         out["TimeFlowRttNs"] = f.rtt_ns
     if f.network_events:
-        out["NetworkEvents"] = [ev.hex() for ev in f.network_events]
+        from netobserv_tpu.utils.networkevents import decode_cookie
+        out["NetworkEvents"] = [decode_cookie(ev) for ev in f.network_events]
     if f.xlat_src_ip:
         out["XlatSrcAddr"] = ip_from_16(f.xlat_src_ip)
         out["XlatDstAddr"] = ip_from_16(f.xlat_dst_ip)

@@ -102,6 +102,13 @@ class TpuSketchExporter(Exporter):
         self._pending_ev_n = 0
         self._window_deadline = time.monotonic() + window_s
         self._n_windows_saved = 0
+        # distributed init MUST precede anything that touches the JAX backend
+        # (including orbax CheckpointManager construction)
+        from netobserv_tpu.parallel.distributed import (
+            maybe_initialize_distributed,
+        )
+        maybe_initialize_distributed()
+
         self._ckpt = None
         self._ckpt_every = checkpoint_every
         if checkpoint_dir:

@@ -1,0 +1,60 @@
+"""Multi-host initialization for the sketch analytics tier.
+
+The reference scales across hosts with one independent agent per node and a
+collector assembling results (SURVEY.md §2.3 item 3). The sketch tier instead
+runs ONE SPMD program across all hosts' chips: `jax.distributed` wires the
+processes (DCN), the mesh spans every device, and the same shard_map
+ingest/merge code runs unchanged — collectives ride ICI within a slice and
+DCN between hosts.
+
+Environment (standard JAX multi-process contract):
+    SKETCH_COORDINATOR   host:port of process 0 (JAX coordinator)
+    SKETCH_NUM_PROCESSES total process count
+    SKETCH_PROCESS_ID    this process's index
+On TPU pods these usually come from the scheduler and jax.distributed
+auto-detects; the env vars are the manual override.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("netobserv_tpu.parallel.distributed")
+
+
+def maybe_initialize_distributed() -> bool:
+    """Initialize jax.distributed when configured; returns True if multi-host.
+
+    Safe to call unconditionally: no-op without configuration.
+    """
+    import jax
+
+    coord = os.environ.get("SKETCH_COORDINATOR", "")
+    nproc = os.environ.get("SKETCH_NUM_PROCESSES", "")
+    pid = os.environ.get("SKETCH_PROCESS_ID", "")
+    if coord and not nproc:
+        raise ValueError(
+            "SKETCH_COORDINATOR is set but SKETCH_NUM_PROCESSES is not — "
+            "multi-host init needs both (plus SKETCH_PROCESS_ID per worker)")
+    if coord and nproc:
+        if not pid:
+            raise ValueError(
+                "SKETCH_PROCESS_ID must be set per worker (0..N-1) when "
+                "SKETCH_COORDINATOR/SKETCH_NUM_PROCESSES are configured")
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=int(nproc),
+            process_id=int(pid))
+        log.info("jax.distributed initialized: process %s/%s via %s",
+                 pid, nproc, coord)
+        return True
+    # TPU pod auto-detection path
+    if os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") >= 1:
+        try:
+            jax.distributed.initialize()
+            log.info("jax.distributed auto-initialized (%d processes)",
+                     jax.process_count())
+            return jax.process_count() > 1
+        except Exception as exc:  # pragma: no cover - env dependent
+            log.warning("jax.distributed auto-init failed: %s", exc)
+    return False

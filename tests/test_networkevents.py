@@ -1,0 +1,24 @@
+from netobserv_tpu.utils.networkevents import decode_cookie, is_drop_event
+
+
+def test_decode_v1_cookie():
+    cookie = bytes([1, 1, 0, 0]) + (4242).to_bytes(4, "little")
+    out = decode_cookie(cookie)
+    assert out == {"Feature": "acl", "Action": "drop", "Type": "acl",
+                   "Direction": "ingress", "Name": "4242"}
+    assert is_drop_event(cookie)
+
+
+def test_unknown_layout_surfaces_raw():
+    out = decode_cookie(b"\x07\x01")
+    assert out == {"raw": "0701"}
+    assert not is_drop_event(b"\x07\x01")
+
+
+def test_allow_egress():
+    cookie = bytes([1, 0, 2, 1]) + (7).to_bytes(4, "little")
+    out = decode_cookie(cookie)
+    assert out["Action"] == "allow"
+    assert out["Type"] == "lb"
+    assert out["Direction"] == "egress"
+    assert not is_drop_event(cookie)

@@ -1,0 +1,67 @@
+"""Two-tier deployment e2e: per-node agent (gRPC export) -> collector-tier
+worker (gRPC ingest -> tpu-sketch reports). The distributed story from
+docs/architecture.md exercised fully in-process."""
+
+import threading
+import time
+
+import pytest
+
+from netobserv_tpu.agent import FlowsAgent
+from netobserv_tpu.config import load_config
+from netobserv_tpu.datapath.fetcher import FakeFetcher
+from netobserv_tpu.datapath.grpc_ingest import GrpcIngestFetcher
+from netobserv_tpu.exporter import build_exporter
+from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+from netobserv_tpu.sketch.state import SketchConfig
+from tests.test_pipeline import make_events
+
+
+def test_agent_to_tpu_worker():
+    reports = []
+
+    # tier 2: worker consuming gRPC, folding into sketches
+    worker_fetcher = GrpcIngestFetcher(0)
+    worker_cfg = load_config(environ={
+        "EXPORT": "tpu-sketch", "CACHE_ACTIVE_TIMEOUT": "150ms"})
+    sketch_exp = TpuSketchExporter(
+        batch_size=256, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10, hll_precision=6,
+                                perdst_buckets=32, perdst_precision=4,
+                                topk=16, hist_buckets=64, ewma_buckets=32),
+        sink=reports.append)
+    worker = FlowsAgent(worker_cfg, worker_fetcher, sketch_exp)
+
+    # tier 1: "node" agent exporting over gRPC to the worker
+    agent_cfg = load_config(environ={
+        "EXPORT": "grpc", "TARGET_HOST": "127.0.0.1",
+        "TARGET_PORT": str(worker_fetcher.port),
+        "CACHE_ACTIVE_TIMEOUT": "100ms"})
+    fake = FakeFetcher()
+    agent = FlowsAgent(agent_cfg, fake, build_exporter(agent_cfg))
+
+    stop_w, stop_a = threading.Event(), threading.Event()
+    tw = threading.Thread(target=worker.run, args=(stop_w,), daemon=True)
+    ta = threading.Thread(target=agent.run, args=(stop_a,), daemon=True)
+    tw.start()
+    ta.start()
+    try:
+        # node agent observes flows (incl. one elephant)
+        fake.inject_events(make_events(1, sport0=7777, nbytes=900_000))
+        fake.inject_events(make_events(20, nbytes=50))
+        # windows reset at each flush, so aggregate across reports
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            sketch_exp.flush()
+            if sum(r["Records"] for r in reports) >= 21:
+                break
+            time.sleep(0.3)
+        assert sum(r["Records"] for r in reports) >= 21
+        tops = [hh for r in reports for hh in r["HeavyHitters"]
+                if hh["SrcPort"] == 7777]
+        assert tops and tops[0]["EstBytes"] >= 900_000
+    finally:
+        stop_a.set()
+        ta.join(timeout=5)
+        stop_w.set()
+        tw.join(timeout=5)
