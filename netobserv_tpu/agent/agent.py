@@ -82,6 +82,11 @@ class FlowsAgent:
         if cfg.sampling:
             self.metrics.sampling_rate.set(cfg.sampling)
 
+        # program kernel flow filters when the datapath supports it
+        if cfg.flow_filter_rules and hasattr(fetcher, "program_filters"):
+            n = fetcher.program_filters(cfg.parsed_filter_rules())
+            log.info("programmed %d flow-filter rules", n)
+
         # discovery is only useful when the datapath actually attaches to
         # interfaces (kernel loader); replay/fake fetchers skip it unless
         # a custom informer is injected

@@ -16,28 +16,7 @@
 
 #define NO_PIN_BY_NAME 1
 
-/* key for the LPM filter tries */
-struct no_filter_key {
-    __u32 prefix_len;
-    __u8 ip[NO_IP_LEN];
-};
-
-/* value of a filter rule (see filter.h for matching semantics) */
-struct no_filter_rule {
-    __u8 proto;
-    __u8 icmp_type;
-    __u8 icmp_code;
-    __u8 direction;      /* 0 ingress, 1 egress, 255 any */
-    __u8 action;         /* 0 accept, 1 reject */
-    __u8 want_drops;
-    __u8 peer_cidr_check;
-    __u8 _pad;
-    __u16 dport_start, dport_end, dport1, dport2;
-    __u16 sport_start, sport_end, sport1, sport2;
-    __u16 port_start, port_end, port1, port2;
-    __u16 tcp_flags;
-    __u32 sample_override;
-};
+/* filter key/rule structs live in records.h (userspace writes them) */
 
 /* DNS query/response correlation key */
 struct no_dns_corr_key {

@@ -162,6 +162,32 @@ struct no_quic_rec {
     __u8 seen_short_hdr;
 };
 
+/* LPM filter-trie key: prefix length + 16B address (v4 mapped). 20 bytes.
+ * Written by the userspace rule compiler (datapath/filter_compile.py). */
+struct no_filter_key {
+    __u32 prefix_len;
+    __u8 ip[NO_IP_LEN];
+};
+
+/* One flow-filter rule (LPM trie value). 40 bytes.
+ * Written by the userspace rule compiler; matched in bpf/filter.h. */
+struct no_filter_rule {
+    __u8 proto;
+    __u8 icmp_type;
+    __u8 icmp_code;
+    __u8 direction;      /* 0 ingress, 1 egress, 255 any */
+    __u8 action;         /* 0 accept, 1 reject */
+    __u8 want_drops;
+    __u8 peer_cidr_check;
+    __u8 __pad0;
+    __u16 dport_start, dport_end, dport1, dport2;
+    __u16 sport_start, sport_end, sport1, sport2;
+    __u16 port_start, port_end, port1, port2;
+    __u16 tcp_flags;
+    __u8 __pad1[2];
+    __u32 sample_override;
+};
+
 /* PCA captured-packet record (packet ringbuf payload). 272 bytes. */
 struct no_packet_event {
     __u32 if_index;

@@ -1,0 +1,137 @@
+"""Flow-filter rule compiler: config rules -> LPM trie entries.
+
+Reference analog: `pkg/tracer/flow_filter.go` — converts the JSON
+FLOW_FILTER_RULES into the datapath's `filter_rules` LPM entries (struct
+no_filter_rule in bpf/maps.h, byte layout pinned here) plus `filter_peers`
+entries for peer-CIDR predicates. Used by the kernel loader at program time;
+pure and fully testable without a kernel.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import struct
+from dataclasses import dataclass
+
+from netobserv_tpu.config import FlowFilterRule
+from netobserv_tpu.model.flow import TcpFlags, ip_to_16
+
+_PROTOS = {"TCP": 6, "UDP": 17, "SCTP": 132, "ICMP": 1, "ICMPV6": 58}
+_DIRECTIONS = {"": 255, "INGRESS": 0, "EGRESS": 1}
+_TCP_FLAG_NAMES = {
+    "FIN": TcpFlags.FIN, "SYN": TcpFlags.SYN, "RST": TcpFlags.RST,
+    "PSH": TcpFlags.PSH, "ACK": TcpFlags.ACK, "URG": TcpFlags.URG,
+    "ECE": TcpFlags.ECE, "CWR": TcpFlags.CWR,
+    "SYN-ACK": TcpFlags.SYN_ACK, "FIN-ACK": TcpFlags.FIN_ACK,
+    "RST-ACK": TcpFlags.RST_ACK,
+}
+
+from netobserv_tpu.model import binfmt
+
+# layouts are pinned against the C structs by tests/test_layout_parity.py
+FILTER_KEY_SIZE = binfmt.FILTER_KEY_DTYPE.itemsize  # 20
+_RULE_FMT = "<8B12HH2xI"
+FILTER_RULE_SIZE = struct.calcsize(_RULE_FMT)
+assert FILTER_RULE_SIZE == binfmt.FILTER_RULE_DTYPE.itemsize
+
+
+@dataclass(frozen=True)
+class CompiledFilter:
+    rules: list[tuple[bytes, bytes]]  # (lpm key, rule value)
+    peers: list[tuple[bytes, bytes]]  # (lpm key, 1-byte marker)
+
+
+def _parse_ports(single: int, range_: str, list_: str) -> tuple[int, int, int, int]:
+    """-> (start, end, p1, p2); reference semantics: range XOR up-to-2 ports."""
+    if range_ and (single or list_):
+        raise ValueError("port range is exclusive with port/ports")
+    if range_:
+        lo, _, hi = range_.partition("-")
+        start, end = int(lo), int(hi)
+        if start >= end:
+            raise ValueError(f"invalid port range {range_!r}")
+        return start, end, 0, 0
+    if list_:
+        ports = [int(p) for p in list_.split(",") if p.strip()]
+        if not 1 <= len(ports) <= 2:
+            raise ValueError("ports list supports one or two ports")
+        p1 = ports[0]
+        p2 = ports[1] if len(ports) > 1 else ports[0]
+        return 0, 0, p1, p2
+    if single:
+        return 0, 0, single, single
+    return 0, 0, 0, 0
+
+
+def _lpm_key(cidr: str) -> bytes:
+    net = ipaddress.ip_network(cidr, strict=False)
+    raw = ip_to_16(str(net.network_address))
+    prefix = net.prefixlen + (96 if net.version == 4 else 0)
+    return struct.pack("<I", prefix) + raw
+
+
+def _tcp_flags_value(name: str) -> int:
+    if not name:
+        return 0
+    key = name.strip().upper()
+    if key not in _TCP_FLAG_NAMES:
+        raise ValueError(f"unknown tcp flag {name!r}")
+    return int(_TCP_FLAG_NAMES[key])
+
+
+def compile_rule(rule: FlowFilterRule) -> tuple[bytes, bytes, list[bytes]]:
+    """-> (lpm key, rule value bytes, peer lpm keys)."""
+    proto = 0
+    if rule.protocol:
+        key = rule.protocol.strip().upper()
+        if key not in _PROTOS:
+            raise ValueError(f"unknown protocol {rule.protocol!r}")
+        proto = _PROTOS[key]
+    direction = _DIRECTIONS.get(rule.direction.strip().upper(), None)
+    if direction is None:
+        raise ValueError(f"unknown direction {rule.direction!r}")
+    action = {"ACCEPT": 0, "REJECT": 1}.get(rule.action.strip().upper())
+    if action is None:
+        raise ValueError(f"unknown action {rule.action!r}")
+
+    dstart, dend, d1, d2 = _parse_ports(
+        rule.destination_port, rule.destination_port_range,
+        rule.destination_ports)
+    sstart, send_, s1, s2 = _parse_ports(
+        rule.source_port, rule.source_port_range, rule.source_ports)
+    pstart, pend, p1, p2 = _parse_ports(rule.port, rule.port_range, rule.ports)
+
+    peer_keys: list[bytes] = []
+    peer_cidr = rule.peer_cidr or (f"{rule.peer_ip}/32" if rule.peer_ip and
+                                   ":" not in rule.peer_ip else
+                                   f"{rule.peer_ip}/128" if rule.peer_ip else "")
+    if peer_cidr:
+        peer_keys.append(_lpm_key(peer_cidr))
+
+    value = struct.pack(
+        _RULE_FMT,
+        proto, rule.icmp_type, rule.icmp_code, direction, action,
+        1 if rule.drops else 0, 1 if peer_keys else 0, 0,
+        dstart, dend, d1, d2,
+        sstart, send_, s1, s2,
+        pstart, pend, p1, p2,
+        _tcp_flags_value(rule.tcp_flags),
+        rule.sample)
+    return _lpm_key(rule.ip_cidr), value, peer_keys
+
+
+def compile_filters(rules: list[FlowFilterRule]) -> CompiledFilter:
+    out_rules: list[tuple[bytes, bytes]] = []
+    out_peers: list[tuple[bytes, bytes]] = []
+    seen_keys: set[bytes] = set()
+    for rule in rules:
+        key, value, peers = compile_rule(rule)
+        if key in seen_keys:
+            raise ValueError(
+                f"duplicate filter CIDR {rule.ip_cidr!r}: LPM tries hold one "
+                "rule per prefix")
+        seen_keys.add(key)
+        out_rules.append((key, value))
+        for pk in peers:
+            out_peers.append((pk, b"\x01"))
+    return CompiledFilter(rules=out_rules, peers=out_peers)

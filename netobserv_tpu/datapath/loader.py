@@ -182,6 +182,34 @@ class BpfmanFetcher:
                 self._counters.update(key, b"\x00" * len(raw))
         return out
 
+    def program_filters(self, rules) -> int:
+        """Compile FLOW_FILTER_RULES into the pinned LPM tries (reference:
+        Filter.ProgramFilter). Returns the number of rules written; 0 when
+        the filter maps aren't pinned."""
+        from netobserv_tpu.datapath import filter_compile
+
+        compiled = filter_compile.compile_filters(rules)
+        try:
+            rules_map = syscall_bpf.BpfMap.open_pinned(
+                os.path.join(self._base, "filter_rules"),
+                key_size=filter_compile.FILTER_KEY_SIZE,
+                value_size=filter_compile.FILTER_RULE_SIZE)
+            peers_map = syscall_bpf.BpfMap.open_pinned(
+                os.path.join(self._base, "filter_peers"),
+                key_size=filter_compile.FILTER_KEY_SIZE, value_size=1)
+        except OSError:
+            log.warning("filter maps not pinned; FLOW_FILTER_RULES ignored")
+            return 0
+        try:
+            for key, value in compiled.rules:
+                rules_map.update(key, value)
+            for key, value in compiled.peers:
+                peers_map.update(key, value)
+        finally:
+            rules_map.close()
+            peers_map.close()
+        return len(compiled.rules)
+
     def purge_stale(self, older_than_s: float) -> int:
         return 0  # DNS-orphan purge needs the dns_inflight map; next round
 

@@ -81,10 +81,11 @@ class BpfMap:
     # --- constructors ---
     @classmethod
     def create(cls, map_type: int, key_size: int, value_size: int,
-               max_entries: int, name: bytes = b"") -> "BpfMap":
+               max_entries: int, name: bytes = b"",
+               flags: int = 0) -> "BpfMap":
         attr = struct.pack("<IIII", map_type, key_size, value_size,
                            max_entries)
-        attr += struct.pack("<I", 0)  # map_flags
+        attr += struct.pack("<I", flags)  # map_flags (LPM needs NO_PREALLOC)
         attr += b"\x00" * 4  # inner_map_fd
         attr += b"\x00" * 4  # numa_node
         attr += name[:15].ljust(16, b"\x00")

@@ -156,6 +156,37 @@ QUIC_REC_DTYPE = np.dtype([
 assert QUIC_REC_DTYPE.itemsize == 24, QUIC_REC_DTYPE.itemsize
 
 # ---------------------------------------------------------------------------
+# flow-filter LPM entries — C: struct no_filter_key / no_filter_rule
+# (written by datapath/filter_compile.py, matched by bpf/filter.h)
+# ---------------------------------------------------------------------------
+FILTER_KEY_DTYPE = np.dtype([
+    ("prefix_len", "<u4"),
+    ("ip", "u1", 16),
+])
+assert FILTER_KEY_DTYPE.itemsize == 20
+
+FILTER_RULE_DTYPE = np.dtype([
+    ("proto", "u1"),
+    ("icmp_type", "u1"),
+    ("icmp_code", "u1"),
+    ("direction", "u1"),
+    ("action", "u1"),
+    ("want_drops", "u1"),
+    ("peer_cidr_check", "u1"),
+    ("pad0", "u1"),
+    ("dport_start", "<u2"), ("dport_end", "<u2"),
+    ("dport1", "<u2"), ("dport2", "<u2"),
+    ("sport_start", "<u2"), ("sport_end", "<u2"),
+    ("sport1", "<u2"), ("sport2", "<u2"),
+    ("port_start", "<u2"), ("port_end", "<u2"),
+    ("port1", "<u2"), ("port2", "<u2"),
+    ("tcp_flags", "<u2"),
+    ("pad1", "u1", 2),
+    ("sample_override", "<u4"),
+])
+assert FILTER_RULE_DTYPE.itemsize == 40, FILTER_RULE_DTYPE.itemsize
+
+# ---------------------------------------------------------------------------
 # PCA packet payload record — C: struct no_packet_event
 # ---------------------------------------------------------------------------
 MAX_PAYLOAD_SIZE = 256

@@ -213,6 +213,57 @@ def test_ringbuf_record_parser():
     assert pos2 == 16  # stopped at the busy record's header
 
 
+def test_filter_rules_programmed_into_real_lpm_trie(pinned_maps):
+    """Compile FLOW_FILTER_RULES, write them into a REAL kernel LPM trie, and
+    verify longest-prefix-match semantics with userspace lookups."""
+    import struct
+
+    from netobserv_tpu.config import parse_filter_rules
+    from netobserv_tpu.datapath import filter_compile as fc
+    from netobserv_tpu.datapath.loader import BpfmanFetcher
+    from netobserv_tpu.model.flow import ip_to_16
+
+    BPF_MAP_TYPE_LPM_TRIE = 11
+    BPF_F_NO_PREALLOC = 1
+    rules_map = sb.BpfMap.create(
+        BPF_MAP_TYPE_LPM_TRIE, fc.FILTER_KEY_SIZE, fc.FILTER_RULE_SIZE, 16,
+        b"frules", flags=BPF_F_NO_PREALLOC)
+    peers_map = sb.BpfMap.create(
+        BPF_MAP_TYPE_LPM_TRIE, fc.FILTER_KEY_SIZE, 1, 16, b"fpeers",
+        flags=BPF_F_NO_PREALLOC)
+    rules_map.pin(os.path.join(PIN_DIR, "filter_rules"))
+    peers_map.pin(os.path.join(PIN_DIR, "filter_peers"))
+    try:
+        rules = parse_filter_rules(
+            '[{"ip_cidr":"10.0.0.0/8","action":"Accept","protocol":"TCP"},'
+            '{"ip_cidr":"10.1.1.1/32","action":"Reject",'
+            '"peer_cidr":"192.168.0.0/16"}]')
+        fetcher = BpfmanFetcher(PIN_DIR)
+        assert fetcher.program_filters(rules) == 2
+
+        def lookup(ip):
+            key = struct.pack("<I", 128) + ip_to_16(ip)
+            raw = rules_map.lookup(key)
+            if raw is None:
+                return None
+            return np.frombuffer(raw, dtype=binfmt.FILTER_RULE_DTYPE)[0]
+
+        # longest prefix wins: /32 host rule beats the /8
+        host = lookup("10.1.1.1")
+        assert int(host["action"]) == 1  # reject
+        assert int(host["peer_cidr_check"]) == 1
+        wide = lookup("10.2.2.2")
+        assert int(wide["action"]) == 0 and int(wide["proto"]) == 6
+        assert lookup("172.16.0.1") is None
+        # peer trie got the peer CIDR
+        peer_key = struct.pack("<I", 128) + ip_to_16("192.168.55.1")
+        assert peers_map.lookup(peer_key) is not None
+        fetcher.close()
+    finally:
+        rules_map.close()
+        peers_map.close()
+
+
 def test_counters_scrape_and_reset(pinned_maps):
     import struct
 

@@ -1,0 +1,95 @@
+"""Filter-rule compiler tests (reference analog: flow_filter_test coverage of
+rule -> LPM entry conversion)."""
+
+import numpy as np
+import pytest
+
+from netobserv_tpu.config import FlowFilterRule, parse_filter_rules
+from netobserv_tpu.datapath import filter_compile as fc
+from netobserv_tpu.model import binfmt
+
+
+def decode_rule(raw: bytes):
+    return np.frombuffer(raw, dtype=binfmt.FILTER_RULE_DTYPE)[0]
+
+
+def decode_key(raw: bytes):
+    return np.frombuffer(raw, dtype=binfmt.FILTER_KEY_DTYPE)[0]
+
+
+def test_basic_rule():
+    rules = parse_filter_rules(
+        '[{"ip_cidr":"10.0.0.0/8","action":"Reject","protocol":"TCP",'
+        '"destination_port":443,"sample":10,"direction":"Ingress"}]')
+    out = fc.compile_filters(rules)
+    assert len(out.rules) == 1 and not out.peers
+    key = decode_key(out.rules[0][0])
+    assert int(key["prefix_len"]) == 96 + 8  # v4-mapped prefix
+    assert bytes(key["ip"])[10:12] == b"\xff\xff"
+    rule = decode_rule(out.rules[0][1])
+    assert int(rule["proto"]) == 6
+    assert int(rule["action"]) == 1
+    assert int(rule["direction"]) == 0
+    assert int(rule["dport1"]) == 443 and int(rule["dport2"]) == 443
+    assert int(rule["sample_override"]) == 10
+
+
+def test_port_ranges_and_lists():
+    rule = FlowFilterRule(ip_cidr="10.0.0.0/8", source_port_range="100-200",
+                          destination_ports="53,5353")
+    _key, raw, _ = fc.compile_rule(rule)
+    r = decode_rule(raw)
+    assert (int(r["sport_start"]), int(r["sport_end"])) == (100, 200)
+    assert (int(r["dport1"]), int(r["dport2"])) == (53, 5353)
+
+
+def test_either_direction_ports():
+    rule = FlowFilterRule(ip_cidr="0.0.0.0/0", port_range="8000-9000")
+    _k, raw, _ = fc.compile_rule(rule)
+    r = decode_rule(raw)
+    assert (int(r["port_start"]), int(r["port_end"])) == (8000, 9000)
+
+
+def test_v6_and_peer_cidr():
+    rule = FlowFilterRule(ip_cidr="2001:db8::/32", peer_cidr="10.1.0.0/16",
+                          tcp_flags="SYN-ACK")
+    key_raw, raw, peers = fc.compile_rule(rule)
+    key = decode_key(key_raw)
+    assert int(key["prefix_len"]) == 32
+    r = decode_rule(raw)
+    assert int(r["peer_cidr_check"]) == 1
+    assert int(r["tcp_flags"]) == 0x100
+    assert len(peers) == 1
+    pk = decode_key(peers[0])
+    assert int(pk["prefix_len"]) == 96 + 16
+
+
+def test_peer_ip_single_host():
+    rule = FlowFilterRule(ip_cidr="0.0.0.0/0", peer_ip="10.9.9.9")
+    _k, _r, peers = fc.compile_rule(rule)
+    assert int(decode_key(peers[0])["prefix_len"]) == 96 + 32
+
+
+def test_rejects_bad_inputs():
+    with pytest.raises(ValueError):
+        fc.compile_rule(FlowFilterRule(ip_cidr="10.0.0.0/8",
+                                       protocol="CARRIER_PIGEON"))
+    with pytest.raises(ValueError):
+        fc.compile_rule(FlowFilterRule(ip_cidr="10.0.0.0/8",
+                                       port_range="90-10"))
+    with pytest.raises(ValueError):
+        fc.compile_rule(FlowFilterRule(ip_cidr="10.0.0.0/8", port=1,
+                                       port_range="1-2"))
+    with pytest.raises(ValueError):
+        fc.compile_rule(FlowFilterRule(ip_cidr="10.0.0.0/8",
+                                       tcp_flags="WAT"))
+    with pytest.raises(ValueError):
+        fc.compile_filters([FlowFilterRule(ip_cidr="10.0.0.0/8"),
+                            FlowFilterRule(ip_cidr="10.0.0.0/8",
+                                           action="Reject")])
+
+
+def test_drops_flag():
+    rule = FlowFilterRule(ip_cidr="0.0.0.0/0", drops=True)
+    _k, raw, _ = fc.compile_rule(rule)
+    assert int(decode_rule(raw)["want_drops"]) == 1

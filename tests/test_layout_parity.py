@@ -30,6 +30,8 @@ STRUCTS = [
     ("no_xlat_rec", binfmt.XLAT_REC_DTYPE, {}),
     ("no_extra_rec", binfmt.EXTRA_REC_DTYPE, {}),
     ("no_quic_rec", binfmt.QUIC_REC_DTYPE, {}),
+    ("no_filter_key", binfmt.FILTER_KEY_DTYPE, {}),
+    ("no_filter_rule", binfmt.FILTER_RULE_DTYPE, {}),
     ("no_packet_event", binfmt.PACKET_EVENT_DTYPE, {}),
     ("no_ssl_event", binfmt.SSL_EVENT_DTYPE, {}),
 ]
