@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""ipfix-collector: receives IPFIX over UDP, learns templates, prints flows.
+
+Reference analog: examples/ipfix-collector. Run the agent with
+EXPORT=ipfix+udp TARGET_HOST=<here> TARGET_PORT=<port>.
+
+    python examples/ipfix_collector.py --port 2055
+"""
+
+import argparse
+import signal
+import socket
+import struct
+import sys
+
+# IANA IE id -> (name, size) for the fields our templates carry
+IE_NAMES = {
+    152: "flowStartMs", 153: "flowEndMs", 1: "bytes", 2: "packets",
+    10: "ingressIface", 61: "direction", 56: "srcMac", 80: "dstMac",
+    256: "etherType", 4: "proto", 6: "tcpFlags", 7: "srcPort", 11: "dstPort",
+    8: "srcV4", 12: "dstV4", 27: "srcV6", 28: "dstV6",
+    176: "icmpType", 177: "icmpCode", 178: "icmpType6", 179: "icmpCode6",
+}
+
+
+def parse_templates(payload: bytes, templates: dict) -> None:
+    off = 0
+    while off + 4 <= len(payload):
+        tid, n_fields = struct.unpack(">HH", payload[off:off + 4])
+        off += 4
+        fields = []
+        for _ in range(n_fields):
+            ie, ln = struct.unpack(">HH", payload[off:off + 4])
+            fields.append((ie, ln))
+            off += 4
+        templates[tid] = fields
+
+
+def render(ie: int, raw: bytes) -> str:
+    name = IE_NAMES.get(ie, f"ie{ie}")
+    if ie in (8, 12):
+        return f"{name}={socket.inet_ntop(socket.AF_INET, raw)}"
+    if ie in (27, 28):
+        return f"{name}={socket.inet_ntop(socket.AF_INET6, raw)}"
+    if ie in (56, 80):
+        return f"{name}={':'.join(f'{b:02x}' for b in raw)}"
+    return f"{name}={int.from_bytes(raw, 'big')}"
+
+
+def parse_data(payload: bytes, fields) -> list[str]:
+    rec_len = sum(ln for _, ln in fields)
+    out = []
+    off = 0
+    while off + rec_len <= len(payload):
+        parts = []
+        for ie, ln in fields:
+            parts.append(render(ie, payload[off:off + ln]))
+            off += ln
+        out.append(" ".join(parts))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=2055)
+    args = ap.parse_args()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("0.0.0.0", args.port))
+    sock.settimeout(0.5)
+    print(f"ipfix-collector listening on udp:{args.port}", file=sys.stderr)
+    running = True
+    templates: dict[int, list] = {}
+
+    def stop(_s, _f):
+        nonlocal running
+        running = False
+
+    signal.signal(signal.SIGINT, stop)
+    signal.signal(signal.SIGTERM, stop)
+    while running:
+        try:
+            msg, addr = sock.recvfrom(65535)
+        except socket.timeout:
+            continue
+        if len(msg) < 16:
+            continue
+        version, length, _ts, _seq, _domain = struct.unpack(">HHIII", msg[:16])
+        if version != 10:
+            continue
+        off = 16
+        while off + 4 <= min(length, len(msg)):
+            set_id, set_len = struct.unpack(">HH", msg[off:off + 4])
+            payload = msg[off + 4:off + set_len]
+            if set_id == 2:
+                parse_templates(payload, templates)
+                print(f"templates learned: {sorted(templates)}",
+                      file=sys.stderr)
+            elif set_id in templates:
+                for line in parse_data(payload, templates[set_id]):
+                    print(line)
+            off += max(set_len, 4)
+    sock.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

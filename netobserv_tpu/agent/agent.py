@@ -84,8 +84,7 @@ class FlowsAgent:
 
         # program kernel flow filters when the datapath supports it
         if cfg.flow_filter_rules and hasattr(fetcher, "program_filters"):
-            n = fetcher.program_filters(cfg.parsed_filter_rules())
-            log.info("programmed %d flow-filter rules", n)
+            fetcher.program_filters(cfg.parsed_filter_rules())
 
         # discovery is only useful when the datapath actually attaches to
         # interfaces (kernel loader); replay/fake fetchers skip it unless

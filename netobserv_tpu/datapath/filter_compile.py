@@ -26,13 +26,16 @@ _TCP_FLAG_NAMES = {
     "RST-ACK": TcpFlags.RST_ACK,
 }
 
+import numpy as np
+
 from netobserv_tpu.model import binfmt
 
-# layouts are pinned against the C structs by tests/test_layout_parity.py
+# layouts are pinned against the C structs by tests/test_layout_parity.py;
+# the dtype is the single source of truth for the value encoding
 FILTER_KEY_SIZE = binfmt.FILTER_KEY_DTYPE.itemsize  # 20
-_RULE_FMT = "<8B12HH2xI"
-FILTER_RULE_SIZE = struct.calcsize(_RULE_FMT)
-assert FILTER_RULE_SIZE == binfmt.FILTER_RULE_DTYPE.itemsize
+FILTER_RULE_SIZE = binfmt.FILTER_RULE_DTYPE.itemsize  # 40
+# LPM trie capacity in bpf/maps.h (MAX_FILTER_ENTRIES analog)
+MAX_FILTER_RULES = 16
 
 
 @dataclass(frozen=True)
@@ -41,24 +44,31 @@ class CompiledFilter:
     peers: list[tuple[bytes, bytes]]  # (lpm key, 1-byte marker)
 
 
+def _check_port(p: int) -> int:
+    if not 0 <= p <= 65535:
+        raise ValueError(f"port {p} out of range 0-65535")
+    return p
+
+
 def _parse_ports(single: int, range_: str, list_: str) -> tuple[int, int, int, int]:
     """-> (start, end, p1, p2); reference semantics: range XOR up-to-2 ports."""
     if range_ and (single or list_):
         raise ValueError("port range is exclusive with port/ports")
     if range_:
         lo, _, hi = range_.partition("-")
-        start, end = int(lo), int(hi)
+        start, end = _check_port(int(lo)), _check_port(int(hi))
         if start >= end:
             raise ValueError(f"invalid port range {range_!r}")
         return start, end, 0, 0
     if list_:
-        ports = [int(p) for p in list_.split(",") if p.strip()]
+        ports = [_check_port(int(p)) for p in list_.split(",") if p.strip()]
         if not 1 <= len(ports) <= 2:
             raise ValueError("ports list supports one or two ports")
         p1 = ports[0]
         p2 = ports[1] if len(ports) > 1 else ports[0]
         return 0, 0, p1, p2
     if single:
+        _check_port(single)
         return 0, 0, single, single
     return 0, 0, 0, 0
 
@@ -108,19 +118,30 @@ def compile_rule(rule: FlowFilterRule) -> tuple[bytes, bytes, list[bytes]]:
     if peer_cidr:
         peer_keys.append(_lpm_key(peer_cidr))
 
-    value = struct.pack(
-        _RULE_FMT,
-        proto, rule.icmp_type, rule.icmp_code, direction, action,
-        1 if rule.drops else 0, 1 if peer_keys else 0, 0,
-        dstart, dend, d1, d2,
-        sstart, send_, s1, s2,
-        pstart, pend, p1, p2,
-        _tcp_flags_value(rule.tcp_flags),
-        rule.sample)
-    return _lpm_key(rule.ip_cidr), value, peer_keys
+    rec = np.zeros(1, dtype=binfmt.FILTER_RULE_DTYPE)[0]
+    rec["proto"] = proto
+    rec["icmp_type"] = rule.icmp_type
+    rec["icmp_code"] = rule.icmp_code
+    rec["direction"] = direction
+    rec["action"] = action
+    rec["want_drops"] = 1 if rule.drops else 0
+    rec["peer_cidr_check"] = 1 if peer_keys else 0
+    rec["dport_start"], rec["dport_end"] = dstart, dend
+    rec["dport1"], rec["dport2"] = d1, d2
+    rec["sport_start"], rec["sport_end"] = sstart, send_
+    rec["sport1"], rec["sport2"] = s1, s2
+    rec["port_start"], rec["port_end"] = pstart, pend
+    rec["port1"], rec["port2"] = p1, p2
+    rec["tcp_flags"] = _tcp_flags_value(rule.tcp_flags)
+    rec["sample_override"] = rule.sample
+    return _lpm_key(rule.ip_cidr), rec.tobytes(), peer_keys
 
 
 def compile_filters(rules: list[FlowFilterRule]) -> CompiledFilter:
+    if len(rules) > MAX_FILTER_RULES:
+        raise ValueError(
+            f"{len(rules)} filter rules exceed the datapath LPM capacity of "
+            f"{MAX_FILTER_RULES}")
     out_rules: list[tuple[bytes, bytes]] = []
     out_peers: list[tuple[bytes, bytes]] = []
     seen_keys: set[bytes] = set()

@@ -189,25 +189,39 @@ class BpfmanFetcher:
         from netobserv_tpu.datapath import filter_compile
 
         compiled = filter_compile.compile_filters(rules)
+        rules_map = peers_map = None
         try:
-            rules_map = syscall_bpf.BpfMap.open_pinned(
-                os.path.join(self._base, "filter_rules"),
-                key_size=filter_compile.FILTER_KEY_SIZE,
-                value_size=filter_compile.FILTER_RULE_SIZE)
-            peers_map = syscall_bpf.BpfMap.open_pinned(
-                os.path.join(self._base, "filter_peers"),
-                key_size=filter_compile.FILTER_KEY_SIZE, value_size=1)
-        except OSError:
-            log.warning("filter maps not pinned; FLOW_FILTER_RULES ignored")
-            return 0
-        try:
+            try:
+                rules_map = syscall_bpf.BpfMap.open_pinned(
+                    os.path.join(self._base, "filter_rules"),
+                    key_size=filter_compile.FILTER_KEY_SIZE,
+                    value_size=filter_compile.FILTER_RULE_SIZE)
+                peers_map = syscall_bpf.BpfMap.open_pinned(
+                    os.path.join(self._base, "filter_peers"),
+                    key_size=filter_compile.FILTER_KEY_SIZE, value_size=1)
+            except OSError:
+                log.warning("filter maps not pinned; FLOW_FILTER_RULES ignored")
+                return 0
+            if (rules_map.max_entries
+                    and len(compiled.rules) > rules_map.max_entries):
+                raise ValueError(
+                    f"{len(compiled.rules)} filter rules exceed the pinned "
+                    f"trie capacity {rules_map.max_entries}")
             for key, value in compiled.rules:
                 rules_map.update(key, value)
             for key, value in compiled.peers:
                 peers_map.update(key, value)
         finally:
-            rules_map.close()
-            peers_map.close()
+            if rules_map is not None:
+                rules_map.close()
+            if peers_map is not None:
+                peers_map.close()
+        # NOTE: matching only takes effect if the external manager loaded the
+        # datapath with cfg_enable_flow_filtering=1 (a load-time constant this
+        # process cannot flip)
+        log.info("wrote %d filter rules (+%d peer CIDRs); effective only if "
+                 "the datapath was loaded with filtering enabled",
+                 len(compiled.rules), len(compiled.peers))
         return len(compiled.rules)
 
     def purge_stale(self, older_than_s: float) -> int:

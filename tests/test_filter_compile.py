@@ -89,6 +89,20 @@ def test_rejects_bad_inputs():
                                            action="Reject")])
 
 
+def test_rejects_out_of_range_ports():
+    for kwargs in ({"destination_port": 70000}, {"ports": "53,70000"},
+                   {"port_range": "1-70000"}):
+        with pytest.raises(ValueError):
+            fc.compile_rule(FlowFilterRule(ip_cidr="0.0.0.0/0", **kwargs))
+
+
+def test_rejects_too_many_rules():
+    rules = [FlowFilterRule(ip_cidr=f"10.{i}.0.0/16")
+             for i in range(fc.MAX_FILTER_RULES + 1)]
+    with pytest.raises(ValueError):
+        fc.compile_filters(rules)
+
+
 def test_drops_flag():
     rule = FlowFilterRule(ip_cidr="0.0.0.0/0", drops=True)
     _k, raw, _ = fc.compile_rule(rule)
