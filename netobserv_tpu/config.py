@@ -244,6 +244,13 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_mesh_shape: str = field(default="", **_env("SKETCH_MESH_SHAPE"))  # e.g. "2x4"
     sketch_devices: str = field(default="", **_env("SKETCH_DEVICES"))  # "", "cpu", "tpu"
     sketch_use_pallas: bool = field(default=False, **_env("SKETCH_USE_PALLAS", "false"))
+    # window handling: "reset" zeroes sketches each window; "decay" multiplies
+    # linear sketches by SKETCH_DECAY_FACTOR instead (sliding-window flavor)
+    sketch_window_mode: str = field(default="reset", **_env("SKETCH_WINDOW_MODE", "reset"))
+    sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))
+    # where window reports go: "stdout" (JSON lines) or "kafka" (uses the
+    # KAFKA_* settings; one message per report, key = "sketch_report")
+    sketch_report_sink: str = field(default="stdout", **_env("SKETCH_REPORT_SINK", "stdout"))
 
     def parsed_filter_rules(self) -> list[FlowFilterRule]:
         return parse_filter_rules(self.flow_filter_rules)
@@ -271,6 +278,13 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
             raise ValueError("SKETCH_CM_WIDTH must be a power of two >= 2")
         if not (4 <= self.sketch_hll_precision <= 18):
             raise ValueError("SKETCH_HLL_PRECISION must be in [4, 18]")
+        if self.sketch_window_mode not in ("reset", "decay"):
+            raise ValueError(
+                f"SKETCH_WINDOW_MODE={self.sketch_window_mode!r} "
+                "(want reset|decay)")
+        if self.sketch_window_mode == "decay" and not (
+                0.0 < self.sketch_decay_factor < 1.0):
+            raise ValueError("SKETCH_DECAY_FACTOR must be in (0, 1)")
 
 
 _DURATION_FIELDS = {

@@ -39,6 +39,50 @@ def _default_sink(report: dict) -> None:
     sys.stdout.flush()
 
 
+class KafkaReportSink:
+    """Publishes window reports as JSON Kafka messages; closeable."""
+
+    def __init__(self, cfg):
+        from netobserv_tpu.kafka.producer import (
+            KafkaProducer, SASLSettings, TLSSettings,
+        )
+        sasl = SASLSettings(enable=cfg.kafka_enable_sasl,
+                            mechanism=cfg.kafka_sasl_type)
+        if sasl.enable:
+            from netobserv_tpu.exporter.kafka import _read_secret
+            sasl.username = _read_secret(cfg.kafka_sasl_client_id_path)
+            sasl.password = _read_secret(cfg.kafka_sasl_client_secret_path)
+        self._producer = KafkaProducer(
+            brokers=cfg.kafka_brokers, topic=cfg.kafka_topic,
+            acks=0 if cfg.kafka_async else 1,
+            tls=TLSSettings(
+                enable=cfg.kafka_enable_tls,
+                insecure_skip_verify=cfg.kafka_tls_insecure_skip_verify,
+                ca_path=cfg.kafka_tls_ca_cert_path,
+                cert_path=cfg.kafka_tls_user_cert_path,
+                key_path=cfg.kafka_tls_user_key_path),
+            sasl=sasl, compression=cfg.kafka_compression)
+
+    def __call__(self, report: dict) -> None:
+        self._producer.send_batch([
+            (b"sketch_report",
+             json.dumps(report, separators=(",", ":")).encode())])
+
+    def close(self) -> None:
+        self._producer.close()
+
+
+def make_report_sink(cfg) -> ReportSink:
+    """SKETCH_REPORT_SINK switch: stdout JSON lines (default) or Kafka
+    (BASELINE config 5: anomaly scores over the Kafka export path)."""
+    if cfg.sketch_report_sink == "kafka":
+        return KafkaReportSink(cfg)
+    if cfg.sketch_report_sink not in ("", "stdout"):
+        raise ValueError(
+            f"SKETCH_REPORT_SINK={cfg.sketch_report_sink!r} (want stdout|kafka)")
+    return _default_sink
+
+
 def report_to_json(report, max_heavy: int = 64) -> dict:
     """Render a device WindowReport into a host JSON object."""
     words = np.asarray(report.heavy.words)
@@ -85,7 +129,8 @@ class TpuSketchExporter(Exporter):
     def __init__(self, batch_size: int = 8192, window_s: float = 60.0,
                  sketch_cfg=None, mesh_shape: str = "", devices: str = "",
                  sink: Optional[ReportSink] = None, metrics=None,
-                 checkpoint_dir: str = "", checkpoint_every: int = 0):
+                 checkpoint_dir: str = "", checkpoint_every: int = 0,
+                 decay_factor: Optional[float] = None):
         # jax-importing modules are pulled in lazily so the host agent can run
         # exporter-free on machines without accelerators
         from netobserv_tpu.sketch import state as sk
@@ -129,12 +174,13 @@ class TpuSketchExporter(Exporter):
             self._pm = pmerge
             self._state = pmerge.init_dist_state(self._cfg, self._mesh)
             self._ingest = pmerge.make_sharded_ingest_fn(self._mesh, self._cfg)
-            self._roll = pmerge.make_merge_fn(self._mesh, self._cfg)
+            self._roll = pmerge.make_merge_fn(self._mesh, self._cfg,
+                                              decay_factor=decay_factor)
         else:
             self._ndata = 1
             self._state = sk.init_state(self._cfg)
             self._ingest = sk.make_ingest_fn(use_pallas=self._cfg.use_pallas)
-            self._roll = sk.make_roll_fn(self._cfg)
+            self._roll = sk.make_roll_fn(self._cfg, decay_factor=decay_factor)
         # restore prior sketch state if a checkpoint exists
         if self._ckpt is not None and self._ckpt.latest_step() is not None:
             self._state = self._ckpt.restore(self._state)
@@ -149,11 +195,15 @@ class TpuSketchExporter(Exporter):
     @classmethod
     def from_config(cls, cfg, metrics=None, sink=None):
         from netobserv_tpu.sketch.state import SketchConfig
+        if sink is None:
+            sink = make_report_sink(cfg)
         return cls(batch_size=cfg.sketch_batch_size, window_s=cfg.sketch_window,
                    sketch_cfg=SketchConfig.from_agent_config(cfg),
                    mesh_shape=cfg.sketch_mesh_shape, metrics=metrics, sink=sink,
                    checkpoint_dir=cfg.sketch_checkpoint_dir,
-                   checkpoint_every=cfg.sketch_checkpoint_every)
+                   checkpoint_every=cfg.sketch_checkpoint_every,
+                   decay_factor=(cfg.sketch_decay_factor
+                                 if cfg.sketch_window_mode == "decay" else None))
 
     # --- Exporter interface ---
     def export_batch(self, records: list[Record]) -> None:
@@ -261,6 +311,9 @@ class TpuSketchExporter(Exporter):
         self.flush()
         if self._ckpt is not None:
             self._ckpt.close()
+        sink_close = getattr(self._sink, "close", None)
+        if sink_close is not None:
+            sink_close()
 
     def _window_loop(self) -> None:
         poll = min(1.0, self._window_s / 10)

@@ -150,7 +150,8 @@ def merge_states(s: sk.SketchState, nsk: int) -> sk.SketchState:
 
 
 def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
-                  reset_sketches: bool = True) -> Callable:
+                  reset_sketches: bool = True,
+                  decay_factor: float | None = None) -> Callable:
     """Jitted `(dist_state) -> (dist_state, WindowReport)`.
 
     The report is fully replicated (every device computes the cluster-wide
@@ -186,7 +187,13 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
             total_bytes=merged.total_bytes,
             window=merged.window,
         )
-        if reset_sketches:
+        if decay_factor is not None:
+            # decay the local PARTIAL (linearity makes per-shard decay exact)
+            new = sk.decay_state(s, decay_factor)._replace(
+                ddos=ddos_state._replace(rate=jnp.zeros_like(s.ddos.rate)),
+                window=s.window + 1,
+            )
+        elif reset_sketches:
             fresh = jax.tree.map(jnp.zeros_like, s)
             new = fresh._replace(
                 heavy=topk.init(s.heavy.k, s.heavy.words.shape[-1]),

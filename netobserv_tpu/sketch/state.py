@@ -180,10 +180,39 @@ def make_ingest_fn(donate: bool = True, use_pallas: bool = False):
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
+def decay_state(state: SketchState, factor: float) -> SketchState:
+    """Sliding-window flavor: scale the linear sketches by `factor` instead of
+    zeroing them (Count-Min and histograms are linear, so decay is exact for
+    them; HLL registers cannot decay and are reset). Top-K counts are CM
+    estimates, so they decay by the same factor to stay consistent with the
+    window totals (they are also re-scored at the next ingest)."""
+    return state._replace(
+        heavy=state.heavy._replace(
+            counts=jnp.where(state.heavy.valid, state.heavy.counts * factor,
+                             state.heavy.counts)),
+        cm_bytes=countmin.CountMin(state.cm_bytes.counts * factor),
+        cm_pkts=countmin.CountMin(
+            (state.cm_pkts.counts.astype(jnp.float32) * factor
+             ).astype(state.cm_pkts.counts.dtype)),
+        hll_src=hll.HLL(jnp.zeros_like(state.hll_src.regs)),
+        hll_per_dst=hll.PerDstHLL(jnp.zeros_like(state.hll_per_dst.regs)),
+        hist_rtt=quantile.LogHist(
+            (state.hist_rtt.counts.astype(jnp.float32) * factor
+             ).astype(jnp.int32)),
+        hist_dns=quantile.LogHist(
+            (state.hist_dns.counts.astype(jnp.float32) * factor
+             ).astype(jnp.int32)),
+        total_records=state.total_records * factor,
+        total_bytes=state.total_bytes * factor,
+    )
+
+
 def roll_window(state: SketchState, cfg: SketchConfig,
-                reset_sketches: bool = True) -> tuple[SketchState, WindowReport]:
+                reset_sketches: bool = True,
+                decay_factor: float | None = None
+                ) -> tuple[SketchState, WindowReport]:
     """Close the current window: emit a report, roll EWMA baselines, and
-    (optionally) reset the windowed sketch state while keeping the baselines."""
+    reset (or decay) the windowed sketch state while keeping the baselines."""
     ddos_state, z = ewma.roll(state.ddos, cfg.ewma_alpha)
     gamma = quantile.gamma_for(state.hist_rtt.n_buckets)
     report = WindowReport(
@@ -197,7 +226,10 @@ def roll_window(state: SketchState, cfg: SketchConfig,
         total_bytes=state.total_bytes,
         window=state.window,
     )
-    if reset_sketches:
+    if decay_factor is not None:
+        new_state = decay_state(state, decay_factor)._replace(
+            ddos=ddos_state, window=state.window + 1)
+    elif reset_sketches:
         fresh = init_state(SketchConfig(
             cm_depth=state.cm_bytes.depth, cm_width=state.cm_bytes.width,
             hll_precision=state.hll_src.precision,
@@ -212,5 +244,6 @@ def roll_window(state: SketchState, cfg: SketchConfig,
     return new_state, report
 
 
-def make_roll_fn(cfg: SketchConfig, reset_sketches: bool = True):
-    return jax.jit(lambda s: roll_window(s, cfg, reset_sketches))
+def make_roll_fn(cfg: SketchConfig, reset_sketches: bool = True,
+                 decay_factor: float | None = None):
+    return jax.jit(lambda s: roll_window(s, cfg, reset_sketches, decay_factor))

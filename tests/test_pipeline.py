@@ -223,3 +223,28 @@ class TestTpuSketchExporter:
         assert [r["Window"] for r in reports] == [0, 1]
         assert reports[0]["Records"] == 5
         assert reports[1]["Records"] == 7  # reset between windows
+
+
+class TestDecayWindows:
+    def test_decay_keeps_half_the_mass(self):
+        from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+        from netobserv_tpu.model.record import records_from_events
+        from netobserv_tpu.sketch.state import SketchConfig
+
+        reports = []
+        exp = TpuSketchExporter(
+            batch_size=8, window_s=3600, decay_factor=0.5,
+            sketch_cfg=SketchConfig(cm_depth=2, cm_width=256, hll_precision=6,
+                                    perdst_buckets=32, perdst_precision=4,
+                                    topk=8, hist_buckets=64, ewma_buckets=32),
+            sink=reports.append)
+        exp.export_batch(records_from_events(make_events(4, nbytes=1000)))
+        exp.flush()
+        exp.flush()  # no new traffic: the decayed mass remains visible
+        assert reports[0]["Bytes"] == 4000
+        assert reports[1]["Bytes"] == 2000  # decayed by 0.5, not reset to 0
+        # heavy-hitter table survives decay AND its counts decay consistently
+        assert len(reports[1]["HeavyHitters"]) > 0
+        assert reports[1]["HeavyHitters"][0]["EstBytes"] == 500.0
+        total_hh = sum(h["EstBytes"] for h in reports[1]["HeavyHitters"])
+        assert total_hh <= reports[1]["Bytes"] + 1e-6
