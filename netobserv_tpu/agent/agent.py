@@ -53,6 +53,10 @@ class FlowsAgent:
         self._evicted_q: queue.Queue = queue.Queue(maxsize=buf)
         self._export_q: queue.Queue = queue.Queue(maxsize=export_buf)
 
+        udn_mapper = None
+        if cfg.enable_udn_mapping:
+            from netobserv_tpu.ifaces.udn import UdnMapper
+            udn_mapper = UdnMapper()
         self.map_tracer = MapTracer(
             fetcher, self._evicted_q,
             active_timeout_s=cfg.cache_active_timeout, agent_ip=agent_ip,
@@ -60,11 +64,22 @@ class FlowsAgent:
             stale_purge_s=cfg.stale_entries_evict_timeout,
             # columnar fast path: exporters that consume raw evictions skip
             # per-record Python object materialization entirely
-            columnar=getattr(exporter, "supports_columnar", False))
+            columnar=getattr(exporter, "supports_columnar", False),
+            udn_mapper=udn_mapper)
         self.limiter = CapacityLimiter(
             self._evicted_q, self._export_q, metrics=self.metrics)
         self.terminal = QueueExporter(
             exporter, self._export_q, metrics=self.metrics)
+
+        self.ssl_tracer = None
+        if cfg.enable_openssl_tracking and hasattr(fetcher, "read_ssl"):
+            from netobserv_tpu.flow.ssl_tracer import SSLTracer
+
+            def _ssl_log(event):
+                log.debug("ssl %s pid=%d %dB", "write" if event.direction
+                          else "read", event.pid, len(event.data))
+
+            self.ssl_tracer = SSLTracer(fetcher, _ssl_log)
 
         self.rb_tracer: Optional[RingBufTracer] = None
         self.accounter: Optional[Accounter] = None
@@ -127,6 +142,8 @@ class FlowsAgent:
             self.accounter.start()
         if self.rb_tracer is not None:
             self.rb_tracer.start()
+        if self.ssl_tracer is not None:
+            self.ssl_tracer.start()
         self.map_tracer.start()
         self._set_status(Status.STARTED)
         self._active_stop = stop = stop or self._stop
@@ -147,6 +164,8 @@ class FlowsAgent:
         if self.iface_listener is not None:
             self.iface_listener.stop()
         self.map_tracer.stop(final_evict=True)
+        if self.ssl_tracer is not None:
+            self.ssl_tracer.stop()
         if self.rb_tracer is not None:
             self.rb_tracer.stop()
         if self.accounter is not None:

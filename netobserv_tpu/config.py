@@ -285,6 +285,10 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
         if self.sketch_window_mode == "decay" and not (
                 0.0 < self.sketch_decay_factor < 1.0):
             raise ValueError("SKETCH_DECAY_FACTOR must be in (0, 1)")
+        if self.sketch_report_sink not in ("", "stdout", "kafka"):
+            raise ValueError(
+                f"SKETCH_REPORT_SINK={self.sketch_report_sink!r} "
+                "(want stdout|kafka)")
 
 
 _DURATION_FIELDS = {

@@ -57,6 +57,11 @@ class FlowFetcher(Protocol):
         Returns None on timeout."""
         ...
 
+    def read_ssl(self, timeout_s: float) -> Optional[bytes]:
+        """Block up to timeout_s for one raw SSL plaintext event (OpenSSL
+        uprobe ring buffer). Returns None on timeout."""
+        ...
+
     def read_global_counters(self) -> dict[GlobalCounter, int]:
         """Scrape-and-reset the datapath's global counters."""
         ...
@@ -83,6 +88,7 @@ class FakeFetcher:
     def __init__(self):
         self._evictions: queue.Queue[EvictedFlows] = queue.Queue()
         self._ringbuf: queue.Queue[bytes] = queue.Queue()
+        self._ssl: queue.Queue[bytes] = queue.Queue()
         self._counters: dict[GlobalCounter, int] = {}
         self._lock = threading.Lock()
         self.attached: dict[int, str] = {}
@@ -101,6 +107,9 @@ class FakeFetcher:
                 event, dtype=binfmt.FLOW_EVENT_DTYPE).tobytes()
         self._ringbuf.put(event)
 
+    def inject_ssl(self, event: bytes) -> None:
+        self._ssl.put(event)
+
     def bump_counter(self, key: GlobalCounter, n: int = 1) -> None:
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + n
@@ -115,6 +124,12 @@ class FakeFetcher:
     def read_ringbuf(self, timeout_s: float) -> Optional[bytes]:
         try:
             return self._ringbuf.get(timeout=timeout_s)
+        except queue.Empty:
+            return None
+
+    def read_ssl(self, timeout_s: float) -> Optional[bytes]:
+        try:
+            return self._ssl.get(timeout=timeout_s)
         except queue.Empty:
             return None
 

@@ -106,6 +106,15 @@ class BpfmanFetcher:
             self._ringbuf = syscall_bpf.RingBufReader(rb_map)
         except (OSError, ValueError):
             log.debug("pinned direct_flows ringbuf absent; fallback disabled")
+        # OpenSSL-uprobe plaintext events (consumed via mmap when pinned)
+        self._ssl_rb = None
+        try:
+            ssl_map = syscall_bpf.BpfMap.open_pinned(
+                os.path.join(bpf_fs_path, "ssl_events"), key_size=0,
+                value_size=0)
+            self._ssl_rb = syscall_bpf.RingBufReader(ssl_map)
+        except (OSError, ValueError):
+            log.debug("pinned ssl_events ringbuf absent")
 
     @classmethod
     def load(cls, cfg: AgentConfig) -> "BpfmanFetcher":
@@ -161,6 +170,12 @@ class BpfmanFetcher:
             time.sleep(timeout_s)
             return None
         return self._ringbuf.read(timeout_s)
+
+    def read_ssl(self, timeout_s: float) -> Optional[bytes]:
+        if self._ssl_rb is None:
+            time.sleep(timeout_s)
+            return None
+        return self._ssl_rb.read(timeout_s)
 
     def read_global_counters(self) -> dict[GlobalCounter, int]:
         out: dict[GlobalCounter, int] = {}
@@ -241,3 +256,5 @@ class BpfmanFetcher:
             self._counters.close()
         if self._ringbuf is not None:
             self._ringbuf.close()
+        if self._ssl_rb is not None:
+            self._ssl_rb.close()

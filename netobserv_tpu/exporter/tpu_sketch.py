@@ -318,10 +318,18 @@ class TpuSketchExporter(Exporter):
     def _window_loop(self) -> None:
         poll = min(1.0, self._window_s / 10)
         while not self._closed.wait(timeout=poll):
-            with self._lock:
-                if time.monotonic() >= self._window_deadline:
-                    self._drain_pending_locked()
-                    self._emit_window()
+            try:
+                with self._lock:
+                    if time.monotonic() >= self._window_deadline:
+                        self._drain_pending_locked()
+                        self._emit_window()
+            except Exception as exc:
+                # a sink outage (e.g. Kafka down) must not kill the timer —
+                # the next window retries
+                log.error("window roll failed (will retry next window): %s",
+                          exc)
+                if self._metrics is not None:
+                    self._metrics.count_error("tpu-sketch")
 
     # --- internals ---
     def _fold(self, records: list[Record]) -> None:

@@ -29,7 +29,7 @@ class MapTracer:
                  active_timeout_s: float = 5.0, agent_ip: str = "",
                  namer: Optional[InterfaceNamer] = None,
                  metrics=None, stale_purge_s: float = 5.0,
-                 columnar: bool = False):
+                 columnar: bool = False, udn_mapper=None):
         self._fetcher = fetcher
         self._out = out
         self._timeout = active_timeout_s
@@ -41,6 +41,10 @@ class MapTracer:
         # columnar mode: forward EvictedFlows untouched (no per-record Python
         # objects) for exporters that consume columns directly (tpu-sketch)
         self._columnar = columnar
+        self._udn_mapper = udn_mapper  # ifaces.udn.UdnMapper when enabled
+        if columnar and udn_mapper is not None:
+            log.warning("UDN mapping is a no-op on the columnar fast path "
+                        "(records are never materialized)")
         self._flush = threading.Event()
         self._stop = threading.Event()
         self._evict_lock = threading.Lock()  # one eviction at a time
@@ -104,6 +108,12 @@ class MapTracer:
             evicted.events, clock=self._clock, agent_ip=self._agent_ip,
             namer=namer)
         _attach_features(records, evicted)
+        if self._udn_mapper is not None:
+            for rec in records:
+                rec.udn = self._udn_mapper.udn_for(rec.interface)
+                rec.dup_list = [
+                    (name, d, self._udn_mapper.udn_for(name))
+                    for name, d, _u in rec.dup_list]
         try:
             self._out.put_nowait(records)
         except queue.Full:

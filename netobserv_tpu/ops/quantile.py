@@ -28,7 +28,9 @@ def gamma_for(n_buckets: int, max_value: float = DEFAULT_MAX_VALUE) -> float:
 
 
 class LogHist(NamedTuple):
-    counts: jax.Array  # int32[n_buckets]; bucket 0 holds zero-valued samples
+    counts: jax.Array  # float32[n_buckets]; bucket 0 holds zero-valued
+    # samples (float so sliding-window decay is exact; counts stay integral
+    # in reset mode)
 
     @property
     def n_buckets(self) -> int:
@@ -36,7 +38,7 @@ class LogHist(NamedTuple):
 
 
 def init(n_buckets: int = DEFAULT_BUCKETS) -> LogHist:
-    return LogHist(counts=jnp.zeros((n_buckets,), dtype=jnp.int32))
+    return LogHist(counts=jnp.zeros((n_buckets,), dtype=jnp.float32))
 
 
 def bucket_of(values: jax.Array, n_buckets: int,
@@ -51,7 +53,7 @@ def bucket_of(values: jax.Array, n_buckets: int,
 def update(h: LogHist, values: jax.Array, valid: jax.Array,
            gamma: float = DEFAULT_GAMMA) -> LogHist:
     idx = bucket_of(values, h.n_buckets, gamma)
-    inc = valid.astype(jnp.int32)
+    inc = valid.astype(h.counts.dtype)
     return LogHist(counts=h.counts.at[idx].add(inc, mode="drop"))
 
 
@@ -64,11 +66,11 @@ def bucket_value(bucket: jax.Array, gamma: float = DEFAULT_GAMMA) -> jax.Array:
 
 def quantile(h: LogHist, qs: jax.Array, gamma: float = DEFAULT_GAMMA) -> jax.Array:
     """Estimate quantiles qs in [0,1]. Returns float32[len(qs)] sample values."""
-    c = jnp.cumsum(h.counts)
+    c = jnp.cumsum(h.counts.astype(jnp.float32))
     n = c[-1]
-    targets = jnp.ceil(qs * jnp.maximum(n, 1).astype(jnp.float32)).astype(jnp.int32)
-    targets = jnp.maximum(targets, 1)
-    buckets = jnp.searchsorted(c, targets, side="left")
+    targets = jnp.ceil(qs * jnp.maximum(n, 1.0))
+    targets = jnp.maximum(targets, 1.0)
+    buckets = jnp.searchsorted(c, targets - 0.5, side="left")
     vals = bucket_value(buckets, gamma)
     return jnp.where(n > 0, vals, 0.0)  # empty histogram -> 0, not max bucket
 

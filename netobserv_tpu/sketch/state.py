@@ -196,12 +196,8 @@ def decay_state(state: SketchState, factor: float) -> SketchState:
              ).astype(state.cm_pkts.counts.dtype)),
         hll_src=hll.HLL(jnp.zeros_like(state.hll_src.regs)),
         hll_per_dst=hll.PerDstHLL(jnp.zeros_like(state.hll_per_dst.regs)),
-        hist_rtt=quantile.LogHist(
-            (state.hist_rtt.counts.astype(jnp.float32) * factor
-             ).astype(jnp.int32)),
-        hist_dns=quantile.LogHist(
-            (state.hist_dns.counts.astype(jnp.float32) * factor
-             ).astype(jnp.int32)),
+        hist_rtt=quantile.LogHist(state.hist_rtt.counts * factor),
+        hist_dns=quantile.LogHist(state.hist_dns.counts * factor),
         total_records=state.total_records * factor,
         total_bytes=state.total_bytes * factor,
     )

@@ -1,0 +1,118 @@
+"""SSL event tracer + UDN mapping tests."""
+
+import json
+import queue
+import threading
+import time
+
+import numpy as np
+
+from netobserv_tpu.flow.ssl_tracer import SSLTracer, decode_ssl_event
+from netobserv_tpu.ifaces.udn import UdnMapper
+from netobserv_tpu.model import binfmt
+
+
+def make_ssl_event(data=b"GET / HTTP/1.1\r\n", pid=1234, tid=77):
+    ev = np.zeros(1, dtype=binfmt.SSL_EVENT_DTYPE)
+    ev[0]["timestamp_ns"] = 42
+    ev[0]["pid_tgid"] = (pid << 32) | tid
+    ev[0]["data_len"] = len(data)
+    ev[0]["ssl_type"] = 1
+    ev[0]["data"][:len(data)] = np.frombuffer(data, np.uint8)
+    return ev.tobytes()
+
+
+class TestSSLDecode:
+    def test_decode(self):
+        ev = decode_ssl_event(make_ssl_event())
+        assert ev.pid == 1234 and ev.tid == 77
+        assert ev.direction == 1
+        assert ev.data == b"GET / HTTP/1.1\r\n"
+
+    def test_bad_size(self):
+        assert decode_ssl_event(b"\x00" * 10) is None
+
+    def test_negative_len_clamped(self):
+        raw = bytearray(make_ssl_event())
+        raw[16:20] = (-5).to_bytes(4, "little", signed=True)
+        ev = decode_ssl_event(bytes(raw))
+        assert ev.data == b""
+
+
+class TestSSLTracer:
+    def test_tracer_drains_handler(self):
+        q = queue.Queue()
+
+        class F:
+            def read_ssl(self, timeout_s):
+                try:
+                    return q.get(timeout=timeout_s)
+                except queue.Empty:
+                    return None
+
+        got = []
+        tracer = SSLTracer(F(), got.append, poll_timeout_s=0.05)
+        tracer.start()
+        try:
+            q.put(make_ssl_event(b"hello"))
+            deadline = time.monotonic() + 2
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert got and got[0].data == b"hello"
+        finally:
+            tracer.stop()
+
+
+class TestAgentSSLWiring:
+    def test_agent_starts_ssl_tracer_when_enabled(self):
+        from netobserv_tpu.datapath.fetcher import FakeFetcher
+        from tests.test_pipeline import CollectExporter, make_agent
+
+        fake = FakeFetcher()
+        agent = make_agent(fake, CollectExporter(),
+                           ENABLE_OPENSSL_TRACKING="true")
+        assert agent.ssl_tracer is not None
+        stop = threading.Event()
+        t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+        t.start()
+        try:
+            fake.inject_ssl(make_ssl_event(b"tls plaintext"))
+            time.sleep(0.3)  # handler is a debug log; just ensure no crash
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+    def test_agent_skips_ssl_tracer_by_default(self):
+        from netobserv_tpu.datapath.fetcher import FakeFetcher
+        from tests.test_pipeline import CollectExporter, make_agent
+
+        agent = make_agent(FakeFetcher(), CollectExporter())
+        assert agent.ssl_tracer is None
+
+
+class TestUdn:
+    def test_file_mapping(self, tmp_path):
+        path = tmp_path / "udn.json"
+        path.write_text(json.dumps({"eth0": "tenant-blue", "eth1": "tenant-red"}))
+        mapper = UdnMapper(mapping_file=str(path))
+        assert mapper.udn_for("eth0") == "tenant-blue"
+        assert mapper.udn_for("missing") == ""
+
+    def test_map_tracer_attaches_udn(self, tmp_path):
+        from netobserv_tpu.datapath.fetcher import FakeFetcher
+        from netobserv_tpu.flow.map_tracer import MapTracer
+        from tests.test_pipeline import make_events
+
+        path = tmp_path / "udn.json"
+        path.write_text(json.dumps({"1": "tenant-x"}))
+        out = queue.Queue()
+        fake = FakeFetcher()
+        tracer = MapTracer(fake, out, active_timeout_s=0.1,
+                           udn_mapper=UdnMapper(mapping_file=str(path)))
+        fake.inject_events(make_events(1))
+        tracer.start()
+        try:
+            batch = out.get(timeout=3)
+            assert batch[0].udn == "tenant-x"  # iface "1" mapped
+        finally:
+            tracer.stop()
