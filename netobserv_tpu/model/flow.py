@@ -91,7 +91,7 @@ def ip_from_16(raw: bytes) -> str:
     return socket.inet_ntop(socket.AF_INET6, raw)
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class FlowKey:
     """The 5-tuple-ish flow identity (reference: `bpf/types.h` flow_id_t)."""
 
@@ -131,7 +131,7 @@ class FlowKey:
                        self.proto, self.icmp_type, self.icmp_code)
 
 
-@dataclass
+@dataclass(slots=True)
 class FlowFeatures:
     """Optional per-feature metrics attached to a flow at eviction time.
 

@@ -62,7 +62,7 @@ class MonotonicClock:
         return mono_ns.astype(np.int64) + offset
 
 
-@dataclass
+@dataclass(slots=True)
 class Record:
     """One enriched flow (reference: `pkg/model/record.go:66-80`)."""
 
@@ -152,41 +152,69 @@ def records_from_events(
         return []
     cur_mono, cur_wall = clock.now_pair()
     offset = cur_wall - cur_mono  # one offset per batch keeps spans exact
-    starts = np.asarray(events["stats"]["first_seen_ns"]).astype(np.int64) + offset
-    ends = np.asarray(events["stats"]["last_seen_ns"]).astype(np.int64) + offset
+    stats = events["stats"]
+    keys = events["key"]
+    n = len(events)
+    # bulk-convert columns ONCE (C-speed) instead of per-element numpy scalar
+    # conversions — this loop is the Record-path hot spot (the reference's
+    # "single hottest allocation site", pkg/model/record_bench_test.go)
+    starts = (stats["first_seen_ns"].astype(np.int64) + offset).tolist()
+    ends = (stats["last_seen_ns"].astype(np.int64) + offset).tolist()
+    monos_s = stats["first_seen_ns"].tolist()
+    monos_e = stats["last_seen_ns"].tolist()
+    ip_w = keys["src_ip"].shape[1]  # stride from the dtype, not a literal
+    mac_w = stats["src_mac"].shape[1]
+    src_ip_buf = np.ascontiguousarray(keys["src_ip"]).tobytes()
+    dst_ip_buf = np.ascontiguousarray(keys["dst_ip"]).tobytes()
+    src_mac_buf = np.ascontiguousarray(stats["src_mac"]).tobytes()
+    dst_mac_buf = np.ascontiguousarray(stats["dst_mac"]).tobytes()
+    sports = keys["src_port"].tolist()
+    dports = keys["dst_port"].tolist()
+    protos = keys["proto"].tolist()
+    itypes = keys["icmp_type"].tolist()
+    icodes = keys["icmp_code"].tolist()
+    nbytes = stats["bytes"].tolist()
+    pkts = stats["packets"].tolist()
+    eths = stats["eth_protocol"].tolist()
+    flags = stats["tcp_flags"].tolist()
+    dirs = stats["direction_first"].tolist()
+    ifidx = stats["if_index_first"].tolist()
+    dscps = stats["dscp"].tolist()
+    samplings = stats["sampling"].tolist()
+    errnos = stats["errno_fallback"].tolist()
+    ssl_vers = stats["ssl_version"].tolist()
+    ciphers = stats["tls_cipher_suite"].tolist()
+    shares = stats["tls_key_share"].tolist()
+    ttypes = stats["tls_types"].tolist()
+    miscs = stats["misc_flags"].tolist()
+    n_obs = stats["n_observed_intf"].tolist()
+    obs_if = stats["observed_intf"].tolist()
+    obs_dir = stats["observed_direction"].tolist()
+
     out: list[Record] = []
-    for i in range(len(events)):
-        k = events["key"][i]
-        s = events["stats"][i]
+    for i in range(n):
         key = FlowKey(
-            src_ip=k["src_ip"].tobytes(), dst_ip=k["dst_ip"].tobytes(),
-            src_port=int(k["src_port"]), dst_port=int(k["dst_port"]),
-            proto=int(k["proto"]), icmp_type=int(k["icmp_type"]),
-            icmp_code=int(k["icmp_code"]),
+            src_ip=src_ip_buf[i * ip_w:(i + 1) * ip_w],
+            dst_ip=dst_ip_buf[i * ip_w:(i + 1) * ip_w],
+            src_port=sports[i], dst_port=dports[i], proto=protos[i],
+            icmp_type=itypes[i], icmp_code=icodes[i],
         )
-        mac = s["src_mac"].tobytes()
-        if_index = int(s["if_index_first"])
+        mac = src_mac_buf[i * mac_w:(i + 1) * mac_w]
         rec = Record(
             key=key,
-            bytes_=int(s["bytes"]), packets=int(s["packets"]),
-            eth_protocol=int(s["eth_protocol"]), tcp_flags=int(s["tcp_flags"]),
-            direction=int(s["direction_first"]),
-            src_mac=mac, dst_mac=s["dst_mac"].tobytes(),
-            if_index=if_index, interface=namer(if_index, mac),
-            dscp=int(s["dscp"]), sampling=int(s["sampling"]),
-            errno_fallback=int(s["errno_fallback"]),
-            time_flow_start_ns=int(starts[i]), time_flow_end_ns=int(ends[i]),
-            mono_start_ns=int(s["first_seen_ns"]), mono_end_ns=int(s["last_seen_ns"]),
+            bytes_=nbytes[i], packets=pkts[i],
+            eth_protocol=eths[i], tcp_flags=flags[i], direction=dirs[i],
+            src_mac=mac, dst_mac=dst_mac_buf[i * mac_w:(i + 1) * mac_w],
+            if_index=ifidx[i], interface=namer(ifidx[i], mac),
+            dscp=dscps[i], sampling=samplings[i], errno_fallback=errnos[i],
+            time_flow_start_ns=starts[i], time_flow_end_ns=ends[i],
+            mono_start_ns=monos_s[i], mono_end_ns=monos_e[i],
             agent_ip=agent_ip,
-            ssl_version=int(s["ssl_version"]),
-            tls_cipher_suite=int(s["tls_cipher_suite"]),
-            tls_key_share=int(s["tls_key_share"]), tls_types=int(s["tls_types"]),
-            ssl_mismatch=bool(int(s["misc_flags"]) & 0x01),
+            ssl_version=ssl_vers[i], tls_cipher_suite=ciphers[i],
+            tls_key_share=shares[i], tls_types=ttypes[i],
+            ssl_mismatch=bool(miscs[i] & 0x01),
         )
-        n = int(s["n_observed_intf"])
-        for j in range(min(n, len(s["observed_intf"]))):
-            oi = int(s["observed_intf"][j])
-            od = int(s["observed_direction"][j])
-            rec.dup_list.append((namer(oi, mac), od, ""))
+        for j in range(min(n_obs[i], len(obs_if[i]))):
+            rec.dup_list.append((namer(obs_if[i][j], mac), obs_dir[i][j], ""))
         out.append(rec)
     return out
