@@ -51,3 +51,6 @@ smoke:
 clean:
 	rm -rf netobserv_tpu/datapath/native/build
 	find . -name __pycache__ -type d -exec rm -rf {} +
+
+bench-micro:
+	$(PY) benchmarks/micro_bench.py

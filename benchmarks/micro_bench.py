@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Micro-benchmarks for the host hot paths (the Go `make bench` analog:
+BenchmarkNewRecord / eviction loop / protobuf conversion, SURVEY.md §4).
+
+    make bench-micro   (or: python benchmarks/micro_bench.py)
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+from netobserv_tpu.datapath import flowpack  # noqa: E402
+from netobserv_tpu.model import binfmt  # noqa: E402
+from netobserv_tpu.model.record import records_from_events  # noqa: E402
+
+
+def make_events(n):
+    from netobserv_tpu.model.flow import ip_to_16
+    events = np.zeros(n, dtype=binfmt.FLOW_EVENT_DTYPE)
+    rng = np.random.default_rng(0)
+    events["key"]["src_port"] = rng.integers(1024, 65535, n)
+    events["key"]["dst_port"] = 443
+    events["key"]["proto"] = 6
+    src = np.frombuffer(ip_to_16("10.1.2.3"), np.uint8)
+    events["key"]["src_ip"] = src
+    events["key"]["dst_ip"] = src
+    events["stats"]["bytes"] = rng.integers(64, 9000, n)
+    events["stats"]["packets"] = rng.integers(1, 10, n)
+    now = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+    events["stats"]["first_seen_ns"] = now - 10**9
+    events["stats"]["last_seen_ns"] = now
+    return events
+
+
+def bench(name, fn, n_items, repeat=10, warmup=2):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        fn()
+    dt = (time.perf_counter() - t0) / repeat
+    print(f"{name:42s} {dt*1e3:8.2f} ms   {n_items/dt/1e6:8.2f} M items/s")
+
+
+def main():
+    n = 10_000
+    events = make_events(n)
+    raw = events.tobytes()
+
+    bench("decode_flow_events (bulk frombuffer)",
+          lambda: binfmt.decode_flow_events(raw), n)
+    recs = records_from_events(events)
+    bench("records_from_events (enrichment)",
+          lambda: records_from_events(events), n)
+
+    have_native = flowpack.build_native()
+    if have_native:
+        bench("flowpack.pack_events (native C++)",
+              lambda: flowpack.pack_events(events, use_native=True), n)
+    bench("flowpack.pack_events (numpy fallback)",
+          lambda: flowpack.pack_events(events, use_native=False), n)
+
+    from netobserv_tpu.exporter.pb_convert import pb_to_record, records_to_pb
+    bench("records_to_pb (protobuf encode)",
+          lambda: records_to_pb(recs[:1000]), 1000)
+    pb = records_to_pb(recs[:1000])
+    bench("pb_to_record (protobuf decode)",
+          lambda: [pb_to_record(e) for e in pb.entries], 1000)
+
+    from netobserv_tpu.exporter.flp_map import record_to_map
+    bench("record_to_map (FLP GenericMap)",
+          lambda: [record_to_map(r) for r in recs[:1000]], 1000)
+
+    from netobserv_tpu.kafka.wire import crc32c
+    blob = raw[:100_000]
+    bench("crc32c (100KB; native when built)", lambda: crc32c(blob), 1)
+
+    from netobserv_tpu.model import accumulate
+    vals = np.zeros(8, dtype=binfmt.EXTRA_REC_DTYPE)
+    vals["rtt_ns"] = np.arange(8)
+    if have_native:
+        bench("merge_percpu extra x1000 (native)",
+              lambda: [flowpack.merge_percpu("extra", vals, use_native=True)
+                       for _ in range(1000)], 1000)
+    bench("merge_percpu extra x1000 (python)",
+          lambda: [accumulate.merge_percpu(vals, accumulate.accumulate_extra)
+                   for _ in range(1000)], 1000)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""packet-counter: PCA load-test collector — counts packets/bytes per second.
+
+Reference analog: examples/performance packet-counter-collector.
+
+    python examples/packet_counter.py --port 9990
+"""
+
+import argparse
+import queue
+import signal
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from netobserv_tpu.exporter.grpc_packets import start_packet_collector  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=9990)
+    args = ap.parse_args()
+    server, port, out = start_packet_collector(args.port)
+    print(f"packet-counter listening on :{port}", file=sys.stderr)
+    running = True
+
+    def stop(_s, _f):
+        nonlocal running
+        running = False
+
+    signal.signal(signal.SIGINT, stop)
+    signal.signal(signal.SIGTERM, stop)
+    pkts = nbytes = 0
+    t0 = time.monotonic()
+    while running:
+        try:
+            chunk = out.get(timeout=0.5)
+            pkts += 1
+            nbytes += len(chunk)
+        except queue.Empty:
+            pass
+        elapsed = time.monotonic() - t0
+        if elapsed >= 5:
+            print(f"{pkts / elapsed:.1f} packets/s, "
+                  f"{nbytes / elapsed / 1e6:.2f} MB/s")
+            pkts = nbytes = 0
+            t0 = time.monotonic()
+    server.stop(0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

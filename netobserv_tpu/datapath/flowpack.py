@@ -44,14 +44,26 @@ class _Columns(ctypes.Structure):
 _lib: Optional[ctypes.CDLL] = None
 
 
+_ABI_VERSION = 2
+
+
 def _find_lib() -> Optional[ctypes.CDLL]:
     for path in _LIB_PATHS:
         if os.path.exists(path):
             lib = ctypes.CDLL(path)
-            if lib.fp_abi_version() == 1:
+            if lib.fp_abi_version() == _ABI_VERSION:
+                lib.fp_crc32c.restype = ctypes.c_uint32
                 return lib
-            log.warning("flowpack ABI mismatch at %s", path)
+            log.warning("flowpack ABI mismatch at %s (rebuild with "
+                        "`make native`)", path)
     return None
+
+
+def crc32c(data: bytes) -> Optional[int]:
+    """Native crc32c, or None when the library isn't built."""
+    if not native_available():
+        return None
+    return int(_lib.fp_crc32c(data, ctypes.c_size_t(len(data))))
 
 
 def build_native(force: bool = False) -> bool:

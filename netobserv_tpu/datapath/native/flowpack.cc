@@ -194,6 +194,50 @@ void fp_merge_dns(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
     std::memcpy(out_buf, &out, sizeof(out));
 }
 
-uint32_t fp_abi_version(void) { return 1; }
+// crc32c (Castagnoli) — slice-by-8; used by the Kafka record-batch encoder.
+static uint32_t crc32c_table[8][256];
+static bool crc32c_ready = false;
+
+static void crc32c_init() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+        crc32c_table[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = crc32c_table[0][i];
+        for (int t = 1; t < 8; t++) {
+            c = crc32c_table[0][c & 0xFF] ^ (c >> 8);
+            crc32c_table[t][i] = c;
+        }
+    }
+    crc32c_ready = true;
+}
+
+uint32_t fp_crc32c(const uint8_t *data, size_t n) {
+    if (!crc32c_ready)
+        crc32c_init();
+    uint32_t crc = 0xFFFFFFFFu;
+    size_t i = 0;
+    while (n - i >= 8) {
+        uint32_t lo, hi;
+        std::memcpy(&lo, data + i, 4);
+        std::memcpy(&hi, data + i + 4, 4);
+        crc ^= lo;
+        crc = crc32c_table[7][crc & 0xFF] ^ crc32c_table[6][(crc >> 8) & 0xFF] ^
+              crc32c_table[5][(crc >> 16) & 0xFF] ^
+              crc32c_table[4][(crc >> 24) & 0xFF] ^
+              crc32c_table[3][hi & 0xFF] ^ crc32c_table[2][(hi >> 8) & 0xFF] ^
+              crc32c_table[1][(hi >> 16) & 0xFF] ^
+              crc32c_table[0][(hi >> 24) & 0xFF];
+        i += 8;
+    }
+    for (; i < n; i++)
+        crc = crc32c_table[0][(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
+uint32_t fp_abi_version(void) { return 2; }
 
 }  // extern "C"

@@ -24,6 +24,30 @@ for _i in range(256):
 
 
 def crc32c(data: bytes) -> int:
+    # prefer the native implementation (~100x) when flowpack is built
+    native = _native_crc32c()
+    if native is not None:
+        result = native(data)
+        if result is not None:
+            return result
+    return _crc32c_py(data)
+
+
+_native_cached = None
+
+
+def _native_crc32c():
+    global _native_cached
+    if _native_cached is None:
+        try:
+            from netobserv_tpu.datapath.flowpack import crc32c as fp_crc
+            _native_cached = fp_crc
+        except Exception:  # flowpack unavailable: stick with pure python
+            _native_cached = False
+    return _native_cached if _native_cached is not False else None
+
+
+def _crc32c_py(data: bytes) -> int:
     crc = 0xFFFFFFFF
     n = len(data)
     i = 0
