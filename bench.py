@@ -152,6 +152,10 @@ def host_path_rate(seconds: float = 3.0) -> float:
 
 
 def main():
+    import os
+
+    # persistent XLA compile cache: repeat bench runs skip recompilation
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
     from netobserv_tpu.utils.platform import maybe_force_cpu
     maybe_force_cpu()  # honor explicit CPU request (offline verification)
     rng = np.random.default_rng(2026)

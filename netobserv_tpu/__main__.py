@@ -34,13 +34,21 @@ def main() -> int:
 
     try:
         if cfg.enable_pca:
+            import os as _os
+
             if not cfg.target_host or not cfg.target_port:
                 raise ValueError(
                     "ENABLE_PCA: TARGET_HOST and TARGET_PORT (or "
                     "PCA_SERVER_PORT) are required")
             from netobserv_tpu.agent.packets_agent import PacketsAgent
-            from netobserv_tpu.datapath.loader import KernelFetcher
-            agent = PacketsAgent(cfg, KernelFetcher.load(cfg))
+            mode = _os.environ.get("DATAPATH", "auto")
+            if mode.startswith("pcap:"):
+                from netobserv_tpu.datapath.replay import PcapPacketFetcher
+                pkt_fetcher = PcapPacketFetcher(mode[5:])
+            else:
+                from netobserv_tpu.datapath.loader import KernelFetcher
+                pkt_fetcher = KernelFetcher.load(cfg)
+            agent = PacketsAgent(cfg, pkt_fetcher)
         else:
             agent = FlowsAgent.from_config(cfg)
     except (ValueError, RuntimeError) as exc:

@@ -60,7 +60,9 @@ class PerfTracer:
                 payload=ev["payload"][:min(
                     int(ev["pkt_len"]), binfmt.MAX_PAYLOAD_SIZE)].tobytes())
             try:
-                self._out.put_nowait(rec)
+                # brief blocking put: the ring buffer already absorbed the
+                # burst, so give the batcher a moment before shedding
+                self._out.put(rec, timeout=0.5)
             except queue.Full:
                 log.debug("packet dropped: buffer full")
 

@@ -155,7 +155,12 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
             cm_b, a, b, sketch_axis, sketch_shards)
     heavy = topk.update(state.heavy, cm_b, words, h1, h2, valid,
                         query_fn=query_fn, salt=state.window)
-    hll_src = hll.update(state.hll_src, src_h1, src_h2, valid)
+    if (use_pallas and sketch_axis is None
+            and state.hll_src.regs.shape[0] % 512 == 0):
+        from netobserv_tpu.ops.pallas import hll_kernel
+        hll_src = hll_kernel.update(state.hll_src, src_h1, src_h2, valid)
+    else:
+        hll_src = hll.update(state.hll_src, src_h1, src_h2, valid)
     per_dst = hll.update_per_dst(state.hll_per_dst, dst_h1, src_h1, src_h2, valid)
     rtt = arrays["rtt_us"]
     dns = arrays["dns_latency_us"]

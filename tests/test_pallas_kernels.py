@@ -40,6 +40,46 @@ def test_pallas_countmin_accumulates_across_calls():
     assert float(jnp.min(est)) >= 3.0
 
 
+def test_pallas_hll_matches_xla_scatter():
+    from netobserv_tpu.ops import hll
+    from netobserv_tpu.ops.pallas import hll_kernel
+    rng = np.random.default_rng(21)
+    b = 3000  # ragged (not a CHUNK_B multiple)
+    words = jnp.asarray(rng.integers(0, 2**32, (b, 4), dtype=np.uint32))
+    valid = jnp.asarray(rng.random(b) < 0.9)
+    h1, h2 = hashing.base_hashes(words)
+    ref = hll.update(hll.init(12), h1, h2, valid)  # 4096 regs
+    got = hll_kernel.update(hll.init(12), h1, h2, valid, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got.regs), np.asarray(ref.regs))
+
+
+def test_full_ingest_pallas_matches_default():
+    from netobserv_tpu.sketch import state as sk
+    rng = np.random.default_rng(22)
+    cfg = sk.SketchConfig(cm_width=1024, topk=16, hll_precision=10,
+                          perdst_buckets=32, perdst_precision=4,
+                          hist_buckets=64, ewma_buckets=32)
+    arrays = {
+        "keys": jnp.asarray(rng.integers(0, 2**32, (512, KW), dtype=np.uint32)),
+        "bytes": jnp.asarray(rng.integers(1, 100, 512).astype(np.float32)),
+        "packets": jnp.ones(512, jnp.int32),
+        "rtt_us": jnp.zeros(512, jnp.int32),
+        "dns_latency_us": jnp.zeros(512, jnp.int32),
+        "valid": jnp.ones(512, jnp.bool_),
+    }
+    import jax
+    s_ref = jax.jit(lambda s, a: __import__("netobserv_tpu.sketch.state",
+                                            fromlist=["ingest"]).ingest(s, a))(
+        sk.init_state(cfg), arrays)
+    s_pal = sk.make_ingest_fn(donate=False, use_pallas=True)(
+        sk.init_state(cfg), arrays)
+    np.testing.assert_allclose(np.asarray(s_pal.cm_bytes.counts),
+                               np.asarray(s_ref.cm_bytes.counts), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(s_pal.hll_src.regs),
+                                  np.asarray(s_ref.hll_src.regs))
+    assert float(s_pal.total_records) == float(s_ref.total_records)
+
+
 def test_pallas_countmin_pads_ragged_batch():
     rng = np.random.default_rng(13)
     b = 777  # not a multiple of CHUNK_B
