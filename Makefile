@@ -54,3 +54,6 @@ clean:
 
 bench-micro:
 	$(PY) benchmarks/micro_bench.py
+
+gen-docs:
+	$(PY) scripts/gen_config_docs.py

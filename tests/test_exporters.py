@@ -83,6 +83,38 @@ class TestGRPC:
         finally:
             server.stop(0)
 
+    def test_periodic_reconnect(self):
+        from netobserv_tpu.exporter.grpc_flow import GRPCFlowExporter
+
+        class CountingClient:
+            def __init__(self):
+                self.connects = 0
+                self.sent = 0
+
+            def connect(self):
+                self.connects += 1
+
+            def send(self, records, timeout_s=10.0):
+                self.sent += len(records.entries)
+
+            def close(self):
+                pass
+
+        import time
+        client = CountingClient()
+        exp = GRPCFlowExporter("h", 1, client=client,
+                               reconnect_every_s=60.0,
+                               reconnect_randomization_s=0.0)
+        exp.export_batch([make_record()])
+        assert client.connects == 0  # timer not yet due
+        exp._next_reconnect = time.monotonic() - 1  # force the deadline
+        exp.export_batch([make_record()])
+        assert client.connects == 1  # reconnected and rescheduled
+        assert exp._next_reconnect > time.monotonic() + 30
+        exp.export_batch([make_record()])
+        assert client.connects == 1
+        assert client.sent == 3
+
     def test_send_failure_raises(self):
         from netobserv_tpu.exporter.grpc_flow import GRPCFlowExporter
         exp = GRPCFlowExporter("127.0.0.1", 1, max_flows_per_message=10)

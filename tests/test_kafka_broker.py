@@ -1,0 +1,202 @@
+"""KafkaProducer end-to-end against a minimal in-process fake broker.
+
+The fake speaks just enough Kafka wire protocol (Metadata v1, Produce v3,
+SaslHandshake/Authenticate) to exercise the producer's real network path:
+framing, correlation ids, metadata-driven leader routing, record-batch
+submission, acks handling, and SASL PLAIN.
+"""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from netobserv_tpu.kafka.producer import (
+    API_METADATA, API_PRODUCE, API_SASL_AUTHENTICATE, API_SASL_HANDSHAKE,
+    KafkaProducer, SASLSettings,
+)
+
+
+def _kstr(s):
+    raw = s.encode()
+    return struct.pack(">h", len(raw)) + raw
+
+
+class FakeBroker(threading.Thread):
+    """Single-connection-at-a-time fake broker on localhost."""
+
+    def __init__(self, topic="network-flows", n_partitions=2,
+                 require_sasl=False):
+        super().__init__(daemon=True)
+        self.topic = topic
+        self.n_partitions = n_partitions
+        self.require_sasl = require_sasl
+        self.produced: list[tuple[int, bytes]] = []  # (partition, batch)
+        self.sasl_tokens: list[bytes] = []
+        self._sock = socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+
+    def stop(self):
+        self._stop = True
+        self._sock.close()
+
+    def run(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _recv_exact(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def _serve(self, conn):
+        try:
+            while True:
+                (size,) = struct.unpack(">i", self._recv_exact(conn, 4))
+                frame = self._recv_exact(conn, size)
+                api, ver, corr = struct.unpack(">hhi", frame[:8])
+                (cid_len,) = struct.unpack(">h", frame[8:10])
+                body = frame[10 + max(cid_len, 0):]
+                resp = self._respond(api, ver, body)
+                if resp is None:
+                    continue  # acks=0 produce: no response
+                payload = struct.pack(">i", corr) + resp
+                conn.sendall(struct.pack(">i", len(payload)) + payload)
+        except (ConnectionError, OSError):
+            pass
+
+    def _respond(self, api, ver, body):
+        if api == API_SASL_HANDSHAKE:
+            return struct.pack(">h", 0) + struct.pack(">i", 1) + _kstr("PLAIN")
+        if api == API_SASL_AUTHENTICATE:
+            (tok_len,) = struct.unpack(">i", body[:4])
+            self.sasl_tokens.append(body[4:4 + tok_len])
+            return struct.pack(">h", 0) + _kstr("") + struct.pack(">i", 0)
+        if api == API_METADATA:
+            out = struct.pack(">i", 1)  # one broker
+            out += struct.pack(">i", 0) + _kstr("127.0.0.1") + \
+                struct.pack(">i", self.port) + struct.pack(">h", -1)  # rack null
+            out += struct.pack(">i", 0)  # controller id
+            out += struct.pack(">i", 1)  # one topic
+            out += struct.pack(">h", 0) + _kstr(self.topic) + b"\x00"
+            out += struct.pack(">i", self.n_partitions)
+            for p in range(self.n_partitions):
+                out += struct.pack(">hii", 0, p, 0)  # err, pid, leader 0
+                out += struct.pack(">i", 0)  # replicas
+                out += struct.pack(">i", 0)  # isr
+            return out
+        if api == API_PRODUCE:
+            off = 0
+            (_txn_len,) = struct.unpack(">h", body[off:off + 2])
+            off += 2 + max(_txn_len, 0)
+            acks, _timeout = struct.unpack(">hi", body[off:off + 6])
+            off += 6
+            (n_topics,) = struct.unpack(">i", body[off:off + 4])
+            off += 4
+            topic_resps = b""
+            for _ in range(n_topics):
+                (tlen,) = struct.unpack(">h", body[off:off + 2])
+                name = body[off + 2:off + 2 + tlen]
+                off += 2 + tlen
+                (n_parts,) = struct.unpack(">i", body[off:off + 4])
+                off += 4
+                part_resps = b""
+                for _ in range(n_parts):
+                    (pid,) = struct.unpack(">i", body[off:off + 4])
+                    off += 4
+                    (blen,) = struct.unpack(">i", body[off:off + 4])
+                    off += 4
+                    self.produced.append((pid, body[off:off + blen]))
+                    off += blen
+                    part_resps += struct.pack(">ihqq", pid, 0, 0, -1)
+                topic_resps += struct.pack(">h", tlen) + name + \
+                    struct.pack(">i", n_parts) + part_resps
+            if acks == 0:
+                return None
+            return struct.pack(">i", n_topics) + topic_resps + \
+                struct.pack(">i", 0)  # throttle
+        raise AssertionError(f"unexpected api {api}")
+
+
+@pytest.fixture
+def broker():
+    b = FakeBroker()
+    b.start()
+    yield b
+    b.stop()
+
+
+def test_produce_roundtrip(broker):
+    p = KafkaProducer([f"127.0.0.1:{broker.port}"], broker.topic, acks=1)
+    p.send_batch([(b"key1", b"value1"), (b"key2", b"value2")])
+    p.close()
+    assert broker.produced
+    # record batches carry magic v2 and valid framing
+    for _pid, batch in broker.produced:
+        assert batch[16] == 2  # magic byte
+    total = sum(struct.unpack(">i", b[57:61])[0] for _p, b in broker.produced)
+    assert total == 2
+
+
+def test_partition_routing_stable(broker):
+    p = KafkaProducer([f"127.0.0.1:{broker.port}"], broker.topic, acks=1)
+    p.send_batch([(b"same-key", b"v1")])
+    p.send_batch([(b"same-key", b"v2")])
+    p.close()
+    pids = {pid for pid, _ in broker.produced}
+    assert len(pids) == 1  # same key -> same partition
+
+
+def test_acks_zero_does_not_block(broker):
+    p = KafkaProducer([f"127.0.0.1:{broker.port}"], broker.topic, acks=0)
+    import time
+    t0 = time.monotonic()
+    p.send_batch([(b"k", b"v")])
+    assert time.monotonic() - t0 < 2.0  # no response wait
+    p.close()
+    # give the broker thread a moment to register the produce
+    deadline = time.monotonic() + 2
+    while not broker.produced and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert broker.produced
+
+
+def test_sasl_plain():
+    b = FakeBroker(require_sasl=True)
+    b.start()
+    try:
+        p = KafkaProducer(
+            [f"127.0.0.1:{b.port}"], b.topic, acks=1,
+            sasl=SASLSettings(enable=True, mechanism="plain",
+                              username="user", password="secret"))
+        p.send_batch([(b"k", b"v")])
+        p.close()
+        assert b"\x00user\x00secret" in b.sasl_tokens
+        assert b.produced
+    finally:
+        b.stop()
+
+
+def test_exporter_through_fake_broker(broker):
+    from netobserv_tpu.exporter.kafka import KafkaExporter
+    from tests.test_exporters import make_record
+
+    p = KafkaProducer([f"127.0.0.1:{broker.port}"], broker.topic, acks=1)
+    exp = KafkaExporter(p)
+    exp.export_batch([make_record(sport=i) for i in range(5)])
+    exp.close()
+    total = sum(struct.unpack(">i", b[57:61])[0] for _p, b in broker.produced)
+    assert total == 5
