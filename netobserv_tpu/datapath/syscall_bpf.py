@@ -227,6 +227,72 @@ class BpfMap:
             pass
 
 
+# ---------------------------------------------------------------------------
+# program load: raw instruction assembly + BPF_PROG_LOAD
+# ---------------------------------------------------------------------------
+BPF_PROG_LOAD = 5
+BPF_PROG_TYPE_SCHED_CLS = 3
+
+
+def insn(opcode: int, dst: int = 0, src: int = 0, off: int = 0,
+         imm: int = 0) -> bytes:
+    """Encode one eBPF instruction (struct bpf_insn)."""
+    return struct.pack("<BBhi", opcode, (src << 4) | dst, off, imm)
+
+
+def ld_map_fd(dst: int, map_fd: int) -> bytes:
+    """BPF_LD_IMM64 with BPF_PSEUDO_MAP_FD (two instruction slots)."""
+    return insn(0x18, dst, 1, 0, map_fd) + insn(0x00)
+
+
+def packet_counter_prog(map_fd: int) -> bytes:
+    """A minimal TC classifier: atomically bump slot 0 of an array map and
+    pass the packet. Used to validate the load/attach path end-to-end with a
+    real program when no compiler is available."""
+    return b"".join([
+        insn(0x62, 10, 0, -4, 0),      # *(u32*)(r10-4) = 0   (key)
+        insn(0xBF, 2, 10),             # r2 = r10
+        insn(0x07, 2, 0, 0, -4),       # r2 += -4
+        ld_map_fd(1, map_fd),          # r1 = map
+        insn(0x85, 0, 0, 0, 1),        # call map_lookup_elem
+        insn(0x15, 0, 0, 3, 0),        # if r0 == 0 goto +3
+        insn(0xB7, 1, 0, 0, 1),        # r1 = 1
+        insn(0xDB, 0, 1, 0, 0x00),     # lock *(u64*)(r0+0) += r1
+        insn(0xB7, 0, 0, 0, 0),        # r0 = TC_ACT_OK
+        insn(0x95),                    # exit
+    ])
+
+
+def prog_load(insns: bytes, prog_type: int = BPF_PROG_TYPE_SCHED_CLS,
+              license_: bytes = b"GPL", name: bytes = b"netobserv") -> int:
+    """BPF_PROG_LOAD; returns the program fd (raises OSError with the
+    verifier log on rejection)."""
+    n_insns = len(insns) // 8
+    insn_buf = ctypes.create_string_buffer(insns, len(insns))
+    lic_buf = ctypes.create_string_buffer(license_ + b"\x00")
+    log_buf = ctypes.create_string_buffer(65536)
+    attr = struct.pack(
+        "<IIQQIIQI",
+        prog_type, n_insns, ctypes.addressof(insn_buf),
+        ctypes.addressof(lic_buf),
+        2, len(log_buf), ctypes.addressof(log_buf),  # log_level/size/buf
+        0)  # kern_version
+    attr += struct.pack("<I", 0)  # prog_flags
+    attr += name[:15].ljust(16, b"\x00")
+    try:
+        return _bpf(BPF_PROG_LOAD, attr)
+    except OSError as exc:
+        log_txt = log_buf.value.decode(errors="replace").strip()
+        raise OSError(exc.errno,
+                      f"{exc.strerror}; verifier log:\n{log_txt}") from exc
+
+
+def obj_pin(fd: int, path: str) -> None:
+    pathbuf = ctypes.create_string_buffer(path.encode() + b"\x00")
+    attr = struct.pack("<QI", ctypes.addressof(pathbuf), fd)
+    _bpf(BPF_OBJ_PIN, attr)
+
+
 RINGBUF_BUSY_BIT = 0x80000000
 RINGBUF_DISCARD_BIT = 0x40000000
 _RB_HDR_SIZE = 8

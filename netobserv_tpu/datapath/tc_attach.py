@@ -1,0 +1,64 @@
+"""TC/TCX attachment of pinned BPF programs.
+
+Reference analog: the attach half of `pkg/tracer/tracer.go` (TCX links with
+legacy TC qdisc/filter fallback, stale cleanup). Programs arrive pinned on
+bpffs (loaded by this process via syscall_bpf.prog_load, by the cmake-built
+object through libbpf, or by an external manager); attachment drives the
+iproute2 `tc` binary — the netlink encoding is deferred until the full
+self-managed loader lands (the CLI path covers both clsact setup and filter
+lifecycle and is what operators can replay by hand).
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+
+log = logging.getLogger("netobserv_tpu.datapath.tc")
+
+
+class TcError(RuntimeError):
+    pass
+
+
+def _tc(*args: str) -> str:
+    res = subprocess.run(["tc", *args], capture_output=True, text=True,
+                         timeout=10)
+    if res.returncode != 0:
+        raise TcError(f"tc {' '.join(args)}: {res.stderr.strip()}")
+    return res.stdout
+
+
+def ensure_clsact(ifname: str) -> None:
+    """Create the clsact qdisc if absent (idempotent)."""
+    try:
+        _tc("qdisc", "add", "dev", ifname, "clsact")
+    except TcError as exc:
+        if "Exclusivity flag on" not in str(exc) and "File exists" not in str(exc):
+            raise
+
+
+def attach_pinned(ifname: str, direction: str, pin_path: str,
+                  priority: int = 1) -> None:
+    """Attach a pinned classifier at <direction> (ingress|egress)."""
+    ensure_clsact(ifname)
+    _tc("filter", "add", "dev", ifname, direction, "prio", str(priority),
+        "bpf", "object-pinned", pin_path, "direct-action")
+    log.info("attached %s to %s %s", pin_path, ifname, direction)
+
+
+def detach(ifname: str, direction: str, priority: int = 1) -> None:
+    _tc("filter", "del", "dev", ifname, direction, "prio", str(priority))
+
+
+def remove_clsact(ifname: str) -> None:
+    """Remove the clsact qdisc (drops all our filters with it) — the stale
+    cleanup used between agent restarts."""
+    try:
+        _tc("qdisc", "del", "dev", ifname, "clsact")
+    except TcError:
+        pass
+
+
+def list_filters(ifname: str, direction: str) -> str:
+    return _tc("filter", "show", "dev", ifname, direction)

@@ -1,0 +1,101 @@
+"""REAL program load + TC attach e2e.
+
+Hand-assembles a packet-counter classifier, loads it through the kernel
+verifier via BPF_PROG_LOAD, attaches it with tc to a veth pair (peer in its
+own netns), sends real pings across, and reads the counter map — proving the
+whole load/attach/count path against the live kernel with zero compilers
+involved. Skipped without CAP_BPF/CAP_NET_ADMIN.
+"""
+
+import os
+import shutil
+import struct
+import subprocess
+import time
+
+import pytest
+
+from netobserv_tpu.datapath import syscall_bpf as sb
+from netobserv_tpu.datapath import tc_attach
+
+BPFFS = "/sys/fs/bpf"
+NS = "nvtest"
+
+pytestmark = pytest.mark.skipif(
+    not (os.geteuid() == 0 and shutil.which("tc") and shutil.which("ip")
+         and os.path.ismount(BPFFS) and sb.bpf_available()),
+    reason="needs root, tc/ip, bpffs, and CAP_BPF")
+
+
+def _run(*cmd):
+    return subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+@pytest.fixture
+def veth_pair():
+    _run("ip", "link", "add", "nv0", "type", "veth", "peer", "name", "nv1")
+    subprocess.run(["ip", "netns", "add", NS], check=True)
+    try:
+        _run("ip", "link", "set", "nv1", "netns", NS)
+        _run("ip", "addr", "add", "10.199.0.1/24", "dev", "nv0")
+        _run("ip", "link", "set", "nv0", "up")
+        _run("ip", "netns", "exec", NS, "ip", "addr", "add",
+             "10.199.0.2/24", "dev", "nv1")
+        _run("ip", "netns", "exec", NS, "ip", "link", "set", "nv1", "up")
+        _run("ip", "netns", "exec", NS, "ip", "link", "set", "lo", "up")
+        yield "nv0"
+    finally:
+        subprocess.run(["ip", "link", "del", "nv0"],
+                       capture_output=True)
+        subprocess.run(["ip", "netns", "del", NS], capture_output=True)
+
+
+def test_verifier_accepts_counter_program():
+    counter = sb.BpfMap.create(2, 4, 8, 1, b"cnt")  # BPF_MAP_TYPE_ARRAY
+    try:
+        fd = sb.prog_load(sb.packet_counter_prog(counter.fd))
+        assert fd > 0
+        os.close(fd)
+    finally:
+        counter.close()
+
+
+def test_verifier_rejects_bad_program():
+    # dereference r0 without a null check -> must be rejected with a log
+    bad = b"".join([
+        sb.insn(0x79, 0, 1, 0, 0),  # r0 = *(u64*)(r1+0)  (ctx deref, wrong)
+        sb.insn(0x95),
+    ])
+    with pytest.raises(OSError) as exc_info:
+        sb.prog_load(bad)
+    assert "verifier log" in str(exc_info.value)
+
+
+def test_count_real_packets_over_veth(veth_pair):
+    counter = sb.BpfMap.create(2, 4, 8, 1, b"cnt")
+    pin = os.path.join(BPFFS, "nv_counter_prog")
+    prog_fd = sb.prog_load(sb.packet_counter_prog(counter.fd))
+    try:
+        sb.obj_pin(prog_fd, pin)
+        tc_attach.attach_pinned(veth_pair, "egress", pin)
+        assert "direct-action" in tc_attach.list_filters(veth_pair, "egress")
+        # real traffic: UDP datagrams routed to the namespaced peer leave
+        # through nv0 egress, where our program counts them
+        import socket
+
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for i in range(5):
+            s.sendto(b"x" * 64, ("10.199.0.2", 9))
+            time.sleep(0.05)
+        s.close()
+        time.sleep(0.2)
+        raw = counter.lookup(struct.pack("<I", 0))
+        count = struct.unpack("<Q", raw[:8])[0]
+        assert count >= 5, f"program counted {count} packets"
+        tc_attach.detach(veth_pair, "egress")
+        tc_attach.remove_clsact(veth_pair)
+    finally:
+        os.close(prog_fd)
+        counter.close()
+        if os.path.exists(pin):
+            os.unlink(pin)
