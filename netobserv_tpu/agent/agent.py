@@ -205,6 +205,17 @@ def build_fetcher(cfg: AgentConfig) -> FlowFetcher:
         from netobserv_tpu.datapath.loader import KernelFetcher
         return KernelFetcher.load(cfg)
     except Exception as exc:
+        log.debug("full kernel datapath unavailable: %s", exc)
+    try:
+        # hand-assembled minimal datapath: real IPv4 TCP/UDP flow capture
+        # without a compiled BPF object (datapath/asm_flowpath.py)
+        from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+        fetcher = MinimalKernelFetcher.load(cfg)
+        log.info("using the minimal hand-assembled kernel datapath "
+                 "(IPv4 TCP/UDP base flows; build the clang object for "
+                 "full features)")
+        return fetcher
+    except Exception as exc:
         if mode == "kernel":
             raise
         log.warning("kernel datapath unavailable (%s); using synthetic replay",

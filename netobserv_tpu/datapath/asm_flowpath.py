@@ -1,0 +1,149 @@
+"""Hand-assembled minimal flow datapath (no compiler required).
+
+Builds a TC classifier that aggregates IPv4 TCP/UDP packets into the
+`aggregated_flows` hash (same no_flow_key/no_flow_stats layout as the full C
+datapath, so the entire userspace pipeline runs unchanged on top):
+
+    parse eth/IPv4 (no options) -> v4-mapped flow key on the stack
+    -> map lookup: hit  -> atomic bytes/packets add + last_seen update
+                   miss -> build a fresh no_flow_stats and insert
+
+Deliberate limits vs flowpath.c (the clang-built full datapath): IPv4 only,
+no IP options, no TCP-flag accumulation, no sampling/filters/trackers, racy
+(non-spin-locked) last_seen, and the per-flow direction/first-seen identity
+reflects the program instance (one program is loaded per attach direction).
+It exists so real kernel flow capture works in build environments without
+clang — validated by the live verifier and by end-to-end veth traffic tests.
+"""
+
+from __future__ import annotations
+
+from netobserv_tpu.datapath.asm import (
+    Asm, BPF_B, BPF_DW, BPF_H, BPF_W, HELPER_KTIME_GET_NS, HELPER_MAP_LOOKUP,
+    HELPER_MAP_UPDATE, R0, R1, R2, R3, R4, R6, R7, R8, R9, R10,
+)
+
+# __sk_buff field offsets
+SKB_LEN = 0
+SKB_IFINDEX = 40
+SKB_DATA = 76
+SKB_DATA_END = 80
+
+from netobserv_tpu.model import binfmt
+
+# stack layout (relative to r10)
+KEY = -binfmt.FLOW_KEY_DTYPE.itemsize              # no_flow_key, 40 bytes
+VAL = KEY - binfmt.FLOW_STATS_DTYPE.itemsize       # no_flow_stats, 104 bytes
+
+
+def _st(field: str) -> int:
+    """no_flow_stats field offset, derived from the layout-pinned dtype so
+    the assembled stores can never drift from records.h/binfmt."""
+    return binfmt.FLOW_STATS_DTYPE.fields[field][1]
+
+
+def _ky(field: str) -> int:
+    return binfmt.FLOW_KEY_DTYPE.fields[field][1]
+
+
+ST_FIRST = _st("first_seen_ns")
+ST_LAST = _st("last_seen_ns")
+ST_BYTES = _st("bytes")
+ST_PACKETS = _st("packets")
+ST_ETH = _st("eth_protocol")
+ST_IFINDEX = _st("if_index_first")
+ST_DIR = _st("direction_first")
+ST_NOBS = _st("n_observed_intf")
+ST_OBSDIR = _st("observed_direction")
+ST_OBSIF = _st("observed_intf")
+KY_SRC_IP = _ky("src_ip")
+KY_DST_IP = _ky("dst_ip")
+KY_SPORT = _ky("src_port")
+KY_DPORT = _ky("dst_port")
+KY_PROTO = _ky("proto")
+
+
+def build_flow_program(map_fd: int, direction: int = 0) -> bytes:
+    a = Asm()
+    a.mov_reg(R6, R1)                       # r6 = ctx
+    a.ldx(BPF_W, R7, R6, SKB_DATA)          # r7 = data
+    a.ldx(BPF_W, R8, R6, SKB_DATA_END)      # r8 = data_end
+
+    # need eth(14) + ip(20) + 8 bytes of L4
+    a.mov_reg(R2, R7)
+    a.alu_imm(0x07, R2, 42)                 # r2 = data + 42
+    a.jmp_reg(0x2D, R2, R8, "out")          # if r2 > data_end: out
+
+    a.ldx(BPF_H, R3, R7, 12)                # ethertype (LE view of BE bytes)
+    a.jmp_imm(0x55, R3, 0x0008, "out")      # != IPv4: out
+    a.ldx(BPF_B, R3, R7, 14)                # version/ihl
+    a.alu_imm(0x57, R3, 0x0F)               # & 0x0f
+    a.jmp_imm(0x55, R3, 5, "out")           # IP options: out (minimal path)
+    a.ldx(BPF_B, R9, R7, 23)                # protocol
+    a.jmp_imm(0x15, R9, 6, "proto_ok")      # TCP
+    a.jmp_imm(0x55, R9, 17, "out")          # not UDP either: out
+    a.label("proto_ok")
+
+    # zero the 40-byte key
+    for off in range(KEY, 0, 8):
+        a.st_imm(BPF_DW, R10, off, 0)
+    # v4-mapped addresses: ::ffff prefix + 4 address bytes
+    a.st_imm(BPF_H, R10, KEY + KY_SRC_IP + 10, 0xFFFF)
+    a.ldx(BPF_W, R3, R7, 26)                    # saddr (BE bytes as-is)
+    a.stx(BPF_W, R10, R3, KEY + KY_SRC_IP + 12)
+    a.st_imm(BPF_H, R10, KEY + KY_DST_IP + 10, 0xFFFF)
+    a.ldx(BPF_W, R3, R7, 30)                    # daddr
+    a.stx(BPF_W, R10, R3, KEY + KY_DST_IP + 12)
+    # ports (bswap16 to host order)
+    a.ldx(BPF_H, R3, R7, 34)
+    a.endian_be(R3, 16)
+    a.stx(BPF_H, R10, R3, KEY + KY_SPORT)
+    a.ldx(BPF_H, R3, R7, 36)
+    a.endian_be(R3, 16)
+    a.stx(BPF_H, R10, R3, KEY + KY_DPORT)
+    a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
+
+    a.call(HELPER_KTIME_GET_NS)
+    a.mov_reg(R9, R0)                           # r9 = now_ns
+
+    a.ld_map_fd(R1, map_fd)
+    a.mov_reg(R2, R10)
+    a.alu_imm(0x07, R2, KEY)
+    a.call(HELPER_MAP_LOOKUP)
+    a.jmp_imm(0x15, R0, 0, "miss")
+
+    # hit: bytes += skb->len (atomic), packets += 1 (atomic), last_seen = now
+    a.ldx(BPF_W, R3, R6, SKB_LEN)
+    a.atomic_add(BPF_DW, R0, R3, ST_BYTES)
+    a.mov_imm(R4, 1)
+    a.atomic_add(BPF_W, R0, R4, ST_PACKETS)
+    a.stx(BPF_DW, R0, R9, ST_LAST)              # benign race (lock-free)
+    a.jmp("out")
+
+    a.label("miss")
+    for off in range(VAL, KEY, 8):              # zero the 104-byte value
+        a.st_imm(BPF_DW, R10, off, 0)
+    a.stx(BPF_DW, R10, R9, VAL + ST_FIRST)
+    a.stx(BPF_DW, R10, R9, VAL + ST_LAST)
+    a.ldx(BPF_W, R3, R6, SKB_LEN)
+    a.stx(BPF_DW, R10, R3, VAL + ST_BYTES)
+    a.st_imm(BPF_W, R10, VAL + ST_PACKETS, 1)
+    a.st_imm(BPF_H, R10, VAL + ST_ETH, 0x0800)
+    a.ldx(BPF_W, R4, R6, SKB_IFINDEX)
+    a.stx(BPF_W, R10, R4, VAL + ST_IFINDEX)
+    a.st_imm(BPF_B, R10, VAL + ST_DIR, direction)
+    a.st_imm(BPF_B, R10, VAL + ST_NOBS, 1)
+    a.st_imm(BPF_B, R10, VAL + ST_OBSDIR, direction)
+    a.stx(BPF_W, R10, R4, VAL + ST_OBSIF)       # observed_intf[0]
+    a.ld_map_fd(R1, map_fd)
+    a.mov_reg(R2, R10)
+    a.alu_imm(0x07, R2, KEY)
+    a.mov_reg(R3, R10)
+    a.alu_imm(0x07, R3, VAL)
+    a.mov_imm(R4, 0)                            # BPF_ANY (lossy race ok)
+    a.call(HELPER_MAP_UPDATE)
+
+    a.label("out")
+    a.mov_imm(R0, 0)                            # TC_ACT_OK
+    a.exit()
+    return a.assemble()

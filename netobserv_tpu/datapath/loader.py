@@ -258,3 +258,121 @@ class BpfmanFetcher:
             self._ringbuf.close()
         if self._ssl_rb is not None:
             self._ssl_rb.close()
+
+
+class MinimalKernelFetcher(BpfmanFetcher):
+    """Self-managed kernel datapath from the hand-assembled minimal flow
+    program (datapath/asm_flowpath.py): creates the aggregation map, loads one
+    program per direction through the live verifier, attaches/detaches
+    interfaces via TC, and evicts with the same syscall drain as bpfman mode.
+
+    The full-featured path (all trackers, filters, sampling) still requires
+    the clang-built object; this fetcher provides real IPv4 TCP/UDP flow
+    capture wherever the agent has CAP_BPF+CAP_NET_ADMIN and no compiler.
+    """
+
+    needs_iface_discovery = True
+    _PIN_PREFIX = "/sys/fs/bpf/netobserv_minflow_"
+
+    def __init__(self, cache_max_flows: int = 5000):
+        from netobserv_tpu.datapath import asm_flowpath
+
+        self._init_empty_maps()
+        self._sweep_stale_pins()
+        BPF_MAP_TYPE_HASH = 1
+        self._agg = syscall_bpf.BpfMap.create(
+            BPF_MAP_TYPE_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
+            binfmt.FLOW_STATS_DTYPE.itemsize, cache_max_flows, b"agg_flows")
+        # one program instance per direction so direction_first is correct
+        self._prog_fds: dict[str, int] = {}
+        self._pins: dict[str, str] = {}
+        for name, code in (("ingress", 0), ("egress", 1)):
+            fd = syscall_bpf.prog_load(
+                asm_flowpath.build_flow_program(self._agg.fd, direction=code))
+            pin = f"{self._PIN_PREFIX}{os.getpid()}_{name}"
+            if os.path.exists(pin):
+                os.unlink(pin)
+            syscall_bpf.obj_pin(fd, pin)
+            self._prog_fds[name] = fd
+            self._pins[name] = pin
+        # if_index -> (if_name, set of attached directions)
+        self._attached: dict[int, tuple[str, set[str]]] = {}
+
+    def _init_empty_maps(self) -> None:
+        """The inherited eviction path expects these BpfmanFetcher fields."""
+        self._n_cpus = syscall_bpf.n_possible_cpus()
+        self._base = ""
+        self._features = {}
+        self._counters = None
+        self._ringbuf = None
+        self._ssl_rb = None
+
+    def _sweep_stale_pins(self) -> None:
+        """Unpin leftovers from crashed runs (their TC filters die with the
+        clsact qdisc, which attach() resets per interface)."""
+        import glob
+
+        for path in glob.glob(self._PIN_PREFIX + "*"):
+            try:
+                os.unlink(path)
+                log.info("removed stale program pin %s", path)
+            except OSError:
+                pass
+
+    @classmethod
+    def load(cls, cfg: AgentConfig) -> "MinimalKernelFetcher":
+        import shutil
+
+        if os.geteuid() != 0:
+            raise RuntimeError("kernel datapath requires root/CAP_BPF")
+        if shutil.which("tc") is None:
+            raise RuntimeError("tc (iproute2) not found; cannot attach")
+        return cls(cache_max_flows=cfg.cache_max_flows)
+
+    def attach(self, if_index: int, if_name: str, direction: str) -> None:
+        from netobserv_tpu.datapath import tc_attach
+
+        wanted = (["ingress", "egress"] if direction == "both"
+                  else [direction])
+        name, done = self._attached.setdefault(if_index, (if_name, set()))
+        if not done:
+            # fresh interface: drop any stale clsact state from prior runs
+            tc_attach.remove_clsact(if_name)
+        for d in wanted:
+            if d in done:
+                continue  # idempotent across listener retries
+            tc_attach.attach_pinned(if_name, d, self._pins[d])
+            done.add(d)
+
+    def detach(self, if_index: int, if_name: str) -> None:
+        from netobserv_tpu.datapath import tc_attach
+
+        entry = self._attached.pop(if_index, None)
+        if entry is None:
+            return
+        name, done = entry
+        for d in done:
+            try:
+                tc_attach.detach(name, d)
+            except Exception as exc:
+                log.debug("detach %s %s failed: %s", name, d, exc)
+
+    def close(self) -> None:
+        from netobserv_tpu.datapath import tc_attach
+
+        for if_index in list(self._attached):
+            name, _dirs = self._attached[if_index]
+            try:
+                self.detach(if_index, name)
+                tc_attach.remove_clsact(name)
+            except Exception as exc:
+                log.debug("cleanup of %s failed: %s", name, exc)
+        for fd in self._prog_fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        for pin in self._pins.values():
+            if os.path.exists(pin):
+                os.unlink(pin)
+        self._agg.close()

@@ -236,13 +236,16 @@ BPF_PROG_TYPE_SCHED_CLS = 3
 
 def insn(opcode: int, dst: int = 0, src: int = 0, off: int = 0,
          imm: int = 0) -> bytes:
-    """Encode one eBPF instruction (struct bpf_insn)."""
-    return struct.pack("<BBhi", opcode, (src << 4) | dst, off, imm)
+    """Encode one eBPF instruction (delegates to the single encoding
+    definition in datapath.asm)."""
+    from netobserv_tpu.datapath.asm import encode
+    return encode(opcode, dst, src, off, imm)
 
 
 def ld_map_fd(dst: int, map_fd: int) -> bytes:
     """BPF_LD_IMM64 with BPF_PSEUDO_MAP_FD (two instruction slots)."""
-    return insn(0x18, dst, 1, 0, map_fd) + insn(0x00)
+    from netobserv_tpu.datapath.asm import encode_ld_map_fd
+    return encode_ld_map_fd(dst, map_fd)
 
 
 def packet_counter_prog(map_fd: int) -> bytes:

@@ -1,0 +1,125 @@
+"""REAL kernel flow capture end-to-end with the hand-assembled datapath:
+veth traffic -> in-kernel aggregation (our program) -> syscall eviction ->
+the full agent pipeline -> exported records. No compiler involved."""
+
+import os
+import queue
+import shutil
+import socket
+import subprocess
+import threading
+import time
+
+import pytest
+
+from netobserv_tpu.datapath import syscall_bpf as sb
+
+BPFFS = "/sys/fs/bpf"
+NS = "nvflow"
+
+pytestmark = pytest.mark.skipif(
+    not (os.geteuid() == 0 and shutil.which("tc") and shutil.which("ip")
+         and os.path.ismount(BPFFS) and sb.bpf_available()),
+    reason="needs root, tc/ip, bpffs, and CAP_BPF")
+
+
+def _run(*cmd):
+    return subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+@pytest.fixture
+def veth():
+    _run("ip", "link", "add", "nf0", "type", "veth", "peer", "name", "nf1")
+    subprocess.run(["ip", "netns", "add", NS], check=True)
+    try:
+        _run("ip", "link", "set", "nf1", "netns", NS)
+        _run("ip", "addr", "add", "10.198.0.1/24", "dev", "nf0")
+        _run("ip", "link", "set", "nf0", "up")
+        _run("ip", "netns", "exec", NS, "ip", "addr", "add",
+             "10.198.0.2/24", "dev", "nf1")
+        _run("ip", "netns", "exec", NS, "ip", "link", "set", "nf1", "up")
+        yield "nf0"
+    finally:
+        subprocess.run(["ip", "link", "del", "nf0"], capture_output=True)
+        subprocess.run(["ip", "netns", "del", NS], capture_output=True)
+
+
+def _send_udp(n=8, size=120, dport=5353):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("10.198.0.1", 44444))
+    for _ in range(n):
+        s.sendto(b"z" * size, ("10.198.0.2", dport))
+        time.sleep(0.02)
+    s.close()
+
+
+def test_kernel_flow_capture_and_eviction(veth):
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024)
+    try:
+        fetcher.attach(1, veth, "egress")
+        _send_udp(n=8, size=120)
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        flows = {}
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            flows[(int(k["src_port"]), int(k["dst_port"]),
+                   int(k["proto"]))] = evicted.events["stats"][i]
+        assert (44444, 5353, 17) in flows, f"flows seen: {list(flows)}"
+        st = flows[(44444, 5353, 17)]
+        # 8 datagrams: 120 payload + 8 UDP + 20 IP + 14 eth = 162B skb->len
+        # (L2 frame length, matching the reference's accounting)
+        assert int(st["packets"]) == 8
+        assert int(st["bytes"]) == 8 * 162
+        assert int(st["n_observed_intf"]) == 1
+        # map drained: second eviction is empty
+        assert len(fetcher.lookup_and_delete()) == 0
+    finally:
+        fetcher.close()
+
+
+def test_full_agent_over_kernel_datapath(veth):
+    from netobserv_tpu.agent import FlowsAgent
+    from netobserv_tpu.config import load_config
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+    from tests.test_pipeline import CollectExporter
+
+    cfg = load_config(environ={
+        "EXPORT": "stdout", "CACHE_ACTIVE_TIMEOUT": "200ms",
+        "INTERFACES": "nf0", "DIRECTION": "egress"})
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024)
+    out = CollectExporter()
+    agent = FlowsAgent(cfg, fetcher, out)
+    # the iface listener discovers nf0 via live netlink and attaches
+    assert agent.iface_listener is not None
+    stop = threading.Event()
+    t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not fetcher._attached:
+            time.sleep(0.05)
+        assert fetcher._attached, "listener never attached to nf0"
+        _send_udp(n=5, size=80, dport=9999)
+        # evictions every 200ms may split the burst across windows: aggregate
+        got = []
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline and sum(
+                r.packets for r in got) < 5:
+            try:
+                batch = out.batches.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            got.extend(r for r in batch if r.key.dst_port == 9999)
+        assert got, "kernel-captured flow never exported"
+        assert got[0].key.src == "10.198.0.1"
+        assert got[0].key.dst == "10.198.0.2"
+        assert sum(r.packets for r in got) == 5
+        assert sum(r.bytes_ for r in got) == 5 * (80 + 28 + 14)
+        assert got[0].interface == "nf0"  # named via live netlink discovery
+        assert got[0].direction == 1  # egress program instance
+    finally:
+        stop.set()
+        t.join(timeout=5)
