@@ -8,10 +8,14 @@ datapath, so the entire userspace pipeline runs unchanged on top):
     -> map lookup: hit  -> atomic bytes/packets add + last_seen update
                    miss -> build a fresh no_flow_stats and insert
 
+Covered: IPv4 TCP/UDP/ICMP keys (ports or icmp type/code), byte/packet
+accounting, TCP-flag accumulation (racy-benign OR), per-direction program
+instances, and optional 1/N sampling baked in at build time (the loader
+rebuilds per config — the moral equivalent of the C datapath's
+loader-rewritten `volatile const`).
+
 Deliberate limits vs flowpath.c (the clang-built full datapath): IPv4 only,
-no IP options, no TCP-flag accumulation, no sampling/filters/trackers, racy
-(non-spin-locked) last_seen, and the per-flow direction/first-seen identity
-reflects the program instance (one program is loaded per attach direction).
+no IP options, no filters/trackers, racy (non-spin-locked) last_seen/flags.
 It exists so real kernel flow capture works in build environments without
 clang — validated by the live verifier and by end-to-end veth traffic tests.
 """
@@ -56,22 +60,36 @@ ST_DIR = _st("direction_first")
 ST_NOBS = _st("n_observed_intf")
 ST_OBSDIR = _st("observed_direction")
 ST_OBSIF = _st("observed_intf")
+ST_FLAGS = _st("tcp_flags")
 KY_SRC_IP = _ky("src_ip")
 KY_DST_IP = _ky("dst_ip")
 KY_SPORT = _ky("src_port")
 KY_DPORT = _ky("dst_port")
 KY_PROTO = _ky("proto")
+KY_ICMP_TYPE = _ky("icmp_type")
+KY_ICMP_CODE = _ky("icmp_code")
+
+HELPER_PRANDOM_U32 = 7
+FLAGS_SPILL = VAL - 8  # stack slot holding this packet's classified tcp flags
 
 
-def build_flow_program(map_fd: int, direction: int = 0) -> bytes:
+def build_flow_program(map_fd: int, direction: int = 0,
+                       sampling: int = 0) -> bytes:
     a = Asm()
     a.mov_reg(R6, R1)                       # r6 = ctx
+
+    if sampling > 1:
+        # 1/N gate, baked in at build time (loader-rewritten-const analog)
+        a.call(HELPER_PRANDOM_U32)
+        a.alu_imm(0x97, R0, sampling)       # r0 %= N (ALU64 MOD K)
+        a.jmp_imm(0x55, R0, 0, "out")       # not the sampled 1/N: out
+
     a.ldx(BPF_W, R7, R6, SKB_DATA)          # r7 = data
     a.ldx(BPF_W, R8, R6, SKB_DATA_END)      # r8 = data_end
 
-    # need eth(14) + ip(20) + 8 bytes of L4
+    # need eth(14) + ip(20) + 4 bytes of L4 (ports / icmp type+code)
     a.mov_reg(R2, R7)
-    a.alu_imm(0x07, R2, 42)                 # r2 = data + 42
+    a.alu_imm(0x07, R2, 38)                 # r2 = data + 38
     a.jmp_reg(0x2D, R2, R8, "out")          # if r2 > data_end: out
 
     a.ldx(BPF_H, R3, R7, 12)                # ethertype (LE view of BE bytes)
@@ -80,13 +98,11 @@ def build_flow_program(map_fd: int, direction: int = 0) -> bytes:
     a.alu_imm(0x57, R3, 0x0F)               # & 0x0f
     a.jmp_imm(0x55, R3, 5, "out")           # IP options: out (minimal path)
     a.ldx(BPF_B, R9, R7, 23)                # protocol
-    a.jmp_imm(0x15, R9, 6, "proto_ok")      # TCP
-    a.jmp_imm(0x55, R9, 17, "out")          # not UDP either: out
-    a.label("proto_ok")
 
-    # zero the 40-byte key
+    # zero the 40-byte key + the flags spill slot
     for off in range(KEY, 0, 8):
         a.st_imm(BPF_DW, R10, off, 0)
+    a.st_imm(BPF_DW, R10, FLAGS_SPILL, 0)
     # v4-mapped addresses: ::ffff prefix + 4 address bytes
     a.st_imm(BPF_H, R10, KEY + KY_SRC_IP + 10, 0xFFFF)
     a.ldx(BPF_W, R3, R7, 26)                    # saddr (BE bytes as-is)
@@ -94,14 +110,37 @@ def build_flow_program(map_fd: int, direction: int = 0) -> bytes:
     a.st_imm(BPF_H, R10, KEY + KY_DST_IP + 10, 0xFFFF)
     a.ldx(BPF_W, R3, R7, 30)                    # daddr
     a.stx(BPF_W, R10, R3, KEY + KY_DST_IP + 12)
-    # ports (bswap16 to host order)
-    a.ldx(BPF_H, R3, R7, 34)
+    a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
+
+    a.jmp_imm(0x15, R9, 6, "tcp")
+    a.jmp_imm(0x15, R9, 17, "udp")
+    a.jmp_imm(0x15, R9, 1, "icmp")
+    a.jmp("out")                                # other protocols: untracked
+
+    a.label("tcp")
+    a.mov_reg(R2, R7)
+    a.alu_imm(0x07, R2, 48)                     # TCP flags byte needs +48
+    a.jmp_reg(0x2D, R2, R8, "ports")            # truncated: skip flags
+    a.ldx(BPF_B, R3, R7, 47)                    # TCP flags byte (l4 + 13)
+    a.stx(BPF_DW, R10, R3, FLAGS_SPILL)
+    a.jmp("ports")
+
+    a.label("icmp")
+    a.ldx(BPF_B, R3, R7, 34)                    # icmp type
+    a.stx(BPF_B, R10, R3, KEY + KY_ICMP_TYPE)
+    a.ldx(BPF_B, R3, R7, 35)                    # icmp code
+    a.stx(BPF_B, R10, R3, KEY + KY_ICMP_CODE)
+    a.jmp("key_done")
+
+    a.label("udp")
+    a.label("ports")
+    a.ldx(BPF_H, R3, R7, 34)                    # bswap16 to host order
     a.endian_be(R3, 16)
     a.stx(BPF_H, R10, R3, KEY + KY_SPORT)
     a.ldx(BPF_H, R3, R7, 36)
     a.endian_be(R3, 16)
     a.stx(BPF_H, R10, R3, KEY + KY_DPORT)
-    a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
+    a.label("key_done")
 
     a.call(HELPER_KTIME_GET_NS)
     a.mov_reg(R9, R0)                           # r9 = now_ns
@@ -112,12 +151,18 @@ def build_flow_program(map_fd: int, direction: int = 0) -> bytes:
     a.call(HELPER_MAP_LOOKUP)
     a.jmp_imm(0x15, R0, 0, "miss")
 
-    # hit: bytes += skb->len (atomic), packets += 1 (atomic), last_seen = now
+    # hit: bytes += skb->len (atomic), packets += 1 (atomic), last_seen = now,
+    # flags |= this packet's flags (read-modify-write; benign race: bits only
+    # accumulate, a lost update costs one OR)
     a.ldx(BPF_W, R3, R6, SKB_LEN)
     a.atomic_add(BPF_DW, R0, R3, ST_BYTES)
     a.mov_imm(R4, 1)
     a.atomic_add(BPF_W, R0, R4, ST_PACKETS)
     a.stx(BPF_DW, R0, R9, ST_LAST)              # benign race (lock-free)
+    a.ldx(BPF_H, R3, R0, ST_FLAGS)
+    a.ldx(BPF_DW, R4, R10, FLAGS_SPILL)
+    a.alu_reg(0x4F, R3, R4)                     # r3 |= packet flags
+    a.stx(BPF_H, R0, R3, ST_FLAGS)
     a.jmp("out")
 
     a.label("miss")
@@ -129,6 +174,8 @@ def build_flow_program(map_fd: int, direction: int = 0) -> bytes:
     a.stx(BPF_DW, R10, R3, VAL + ST_BYTES)
     a.st_imm(BPF_W, R10, VAL + ST_PACKETS, 1)
     a.st_imm(BPF_H, R10, VAL + ST_ETH, 0x0800)
+    a.ldx(BPF_DW, R3, R10, FLAGS_SPILL)
+    a.stx(BPF_H, R10, R3, VAL + ST_FLAGS)
     a.ldx(BPF_W, R4, R6, SKB_IFINDEX)
     a.stx(BPF_W, R10, R4, VAL + ST_IFINDEX)
     a.st_imm(BPF_B, R10, VAL + ST_DIR, direction)
