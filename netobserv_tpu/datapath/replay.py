@@ -315,4 +315,6 @@ def _parse_packet(pkt: bytes):
             flags = l4[13]
     elif proto in (1, 58) and len(l4) >= 2:  # ICMP type/code
         key["icmp_type"], key["icmp_code"] = l4[0], l4[1]
-    return key.tobytes(), total_len, flags
+    # L2 frame length (IP total + ethernet header) — the same accounting as
+    # the kernel datapath's skb->len
+    return key.tobytes(), total_len + 14, flags

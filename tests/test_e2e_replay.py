@@ -79,11 +79,12 @@ def agg(flows, **match):
 
 
 def test_icmp_flow_byte_accounting(exported_flows):
-    # each ping frame: 20 IP + 8 ICMP + 56 payload = 84 bytes, 5 packets
+    # each ping frame: 14 eth + 20 IP + 8 ICMP + 56 payload = 98B L2 length
+    # (skb->len semantics, same as the kernel datapath)
     nbytes, pkts = agg(exported_flows, SrcAddr="10.0.0.5", DstAddr="10.0.0.9",
                        Proto=1)
     assert pkts == 5
-    assert nbytes == 5 * 84
+    assert nbytes == 5 * 98
     icmp = [f for f in exported_flows if f.get("Proto") == 1]
     assert icmp[0]["IcmpType"] == 8  # echo request
 
@@ -92,7 +93,7 @@ def test_udp_flow_accounting(exported_flows):
     nbytes, pkts = agg(exported_flows, SrcAddr="10.0.0.5",
                        DstAddr="10.0.0.53", Proto=17, DstPort=53)
     assert pkts == 3
-    assert nbytes == 3 * (20 + 8 + 24)
+    assert nbytes == 3 * (14 + 20 + 8 + 24)
 
 
 def test_no_unexpected_flows(exported_flows):
