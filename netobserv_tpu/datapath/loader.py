@@ -239,8 +239,38 @@ class BpfmanFetcher:
                  len(compiled.rules), len(compiled.peers))
         return len(compiled.rules)
 
+    # no_dns_corr_key layout (bpf/maps.h); value = u64 query timestamp (mono)
+    DNS_CORR_KEY_SIZE = 40
+
     def purge_stale(self, older_than_s: float) -> int:
-        return 0  # DNS-orphan purge needs the dns_inflight map; next round
+        """Drop unanswered DNS correlations older than the deadline
+        (reference: DeleteMapsStaleEntries, `tracer.go:1188-1216`). Lazily
+        opens the pinned dns_inflight map; returns the purge count."""
+        if not hasattr(self, "_dns_inflight"):
+            try:
+                self._dns_inflight = syscall_bpf.BpfMap.open_pinned(
+                    os.path.join(self._base, "dns_inflight"),
+                    key_size=self.DNS_CORR_KEY_SIZE, value_size=8)
+            except (OSError, ValueError):
+                self._dns_inflight = None
+        if self._dns_inflight is None:
+            return 0
+        import struct as _struct
+
+        deadline = time.clock_gettime_ns(time.CLOCK_MONOTONIC) - int(
+            older_than_s * 1e9)
+        purged = 0
+        for key in self._dns_inflight.keys():
+            raw = self._dns_inflight.lookup(key)
+            if raw is None:
+                continue
+            (sent_ns,) = _struct.unpack_from("<Q", raw, 0)
+            if sent_ns < deadline:
+                if self._dns_inflight.delete(key):
+                    purged += 1
+        if purged:
+            log.debug("purged %d stale DNS correlations", purged)
+        return purged
 
     def attach(self, if_index: int, if_name: str, direction: str) -> None:
         pass  # programs are attached by the external manager
@@ -258,6 +288,9 @@ class BpfmanFetcher:
             self._ringbuf.close()
         if self._ssl_rb is not None:
             self._ssl_rb.close()
+        dns = getattr(self, "_dns_inflight", None)
+        if dns is not None:
+            dns.close()
 
 
 class MinimalKernelFetcher(BpfmanFetcher):

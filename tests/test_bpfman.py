@@ -264,6 +264,33 @@ def test_filter_rules_programmed_into_real_lpm_trie(pinned_maps):
         peers_map.close()
 
 
+def test_dns_stale_purge(pinned_maps):
+    """Unanswered DNS correlations older than the deadline are purged from
+    the REAL kernel map; fresh ones survive (reference parity:
+    DeleteMapsStaleEntries)."""
+    import struct
+
+    from netobserv_tpu.datapath.loader import BpfmanFetcher
+
+    dns_map = sb.BpfMap.create(1, BpfmanFetcher.DNS_CORR_KEY_SIZE, 8, 64,
+                               b"dnsq")
+    dns_map.pin(os.path.join(PIN_DIR, "dns_inflight"))
+    try:
+        now = time.clock_gettime_ns(time.CLOCK_MONOTONIC)
+        stale_key = b"\x01" * BpfmanFetcher.DNS_CORR_KEY_SIZE
+        fresh_key = b"\x02" * BpfmanFetcher.DNS_CORR_KEY_SIZE
+        dns_map.update(stale_key, struct.pack("<Q", now - 60 * 10**9))
+        dns_map.update(fresh_key, struct.pack("<Q", now))
+        fetcher = BpfmanFetcher(PIN_DIR)
+        assert fetcher.purge_stale(5.0) == 1
+        assert dns_map.lookup(stale_key) is None
+        assert dns_map.lookup(fresh_key) is not None
+        assert fetcher.purge_stale(5.0) == 0  # idempotent
+        fetcher.close()
+    finally:
+        dns_map.close()
+
+
 def test_counters_scrape_and_reset(pinned_maps):
     import struct
 
