@@ -207,3 +207,12 @@ class TestRecord:
         k1 = FlowKey.make("10.0.0.1", "10.0.0.2", 10, 20, 6)
         k2 = FlowKey.make("10.0.0.2", "10.0.0.1", 20, 10, 6)
         assert k1.normalized() == k2.normalized()
+
+
+class TestNetFormat:
+    def test_addr_port(self):
+        from netobserv_tpu.utils.net import format_addr_port, format_mac
+        assert format_addr_port(ip_to_16("10.0.0.1"), 80) == "10.0.0.1:80"
+        assert format_addr_port(ip_to_16("2001:db8::1"), 443) == \
+            "[2001:db8::1]:443"
+        assert format_mac(b"\x02\xab\x00\x00\x00\x01") == "02:AB:00:00:00:01"

@@ -65,3 +65,55 @@ def test_agent_to_tpu_worker():
         ta.join(timeout=5)
         stop_w.set()
         tw.join(timeout=5)
+
+
+def test_two_agents_fan_in_to_one_worker():
+    """Cluster shape: several per-node agents exporting into one collector-
+    tier worker; the worker's sketch merges both streams."""
+    reports = []
+    worker_fetcher = GrpcIngestFetcher(0)
+    worker_cfg = load_config(environ={
+        "EXPORT": "tpu-sketch", "CACHE_ACTIVE_TIMEOUT": "150ms"})
+    sketch_exp = TpuSketchExporter(
+        batch_size=256, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10, hll_precision=6,
+                                perdst_buckets=32, perdst_precision=4,
+                                topk=16, hist_buckets=64, ewma_buckets=32),
+        sink=reports.append)
+    worker = FlowsAgent(worker_cfg, worker_fetcher, sketch_exp)
+
+    agents, fakes, stops, threads = [], [], [], []
+    stop_w = threading.Event()
+    tw = threading.Thread(target=worker.run, args=(stop_w,), daemon=True)
+    tw.start()
+    try:
+        for n in range(2):
+            cfg = load_config(environ={
+                "EXPORT": "grpc", "TARGET_HOST": "127.0.0.1",
+                "TARGET_PORT": str(worker_fetcher.port),
+                "CACHE_ACTIVE_TIMEOUT": "100ms"})
+            fake = FakeFetcher()
+            agent = FlowsAgent(cfg, fake, build_exporter(cfg))
+            stop = threading.Event()
+            t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+            t.start()
+            agents.append(agent)
+            fakes.append(fake)
+            stops.append(stop)
+            threads.append(t)
+        # node 0 sees 10 flows, node 1 sees 15 (disjoint ports)
+        fakes[0].inject_events(make_events(10, sport0=10_000))
+        fakes[1].inject_events(make_events(15, sport0=20_000))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            sketch_exp.flush()
+            if sum(r["Records"] for r in reports) >= 25:
+                break
+            time.sleep(0.3)
+        assert sum(r["Records"] for r in reports) >= 25
+    finally:
+        for stop, t in zip(stops, threads):
+            stop.set()
+            t.join(timeout=5)
+        stop_w.set()
+        tw.join(timeout=5)
