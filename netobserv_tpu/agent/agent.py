@@ -65,7 +65,8 @@ class FlowsAgent:
             # columnar fast path: exporters that consume raw evictions skip
             # per-record Python object materialization entirely
             columnar=getattr(exporter, "supports_columnar", False),
-            udn_mapper=udn_mapper)
+            udn_mapper=udn_mapper,
+            force_gc=cfg.force_garbage_collection)
         self.limiter = CapacityLimiter(
             self._evicted_q, self._export_q, metrics=self.metrics)
         self.terminal = QueueExporter(

@@ -64,6 +64,9 @@ class CapacityLimiter:
                 continue
             try:
                 self._out.put_nowait(batch)
+                if self._metrics is not None:
+                    self._metrics.buffer_size.labels("export").set(
+                        self._out.qsize())
                 self._log_period = _INITIAL_LOG_PERIOD_S  # recovered
             except queue.Full:
                 self._dropped_since_log += len(batch)

@@ -29,7 +29,8 @@ class MapTracer:
                  active_timeout_s: float = 5.0, agent_ip: str = "",
                  namer: Optional[InterfaceNamer] = None,
                  metrics=None, stale_purge_s: float = 5.0,
-                 columnar: bool = False, udn_mapper=None):
+                 columnar: bool = False, udn_mapper=None,
+                 force_gc: bool = False):
         self._fetcher = fetcher
         self._out = out
         self._timeout = active_timeout_s
@@ -45,6 +46,9 @@ class MapTracer:
         if columnar and udn_mapper is not None:
             log.warning("UDN mapping is a no-op on the columnar fast path "
                         "(records are never materialized)")
+        # FORCE_GARBAGE_COLLECTION parity: collect after each eviction so
+        # the burst of short-lived record objects returns to the allocator
+        self._force_gc = force_gc
         self._flush = threading.Event()
         self._stop = threading.Event()
         self._evict_lock = threading.Lock()  # one eviction at a time
@@ -90,8 +94,13 @@ class MapTracer:
         if self._metrics is not None:
             self._metrics.observe_eviction(
                 "map", len(evicted), time.perf_counter() - t0)
+            self._metrics.buffer_size.labels("evicted").set(
+                self._out.qsize())
             for key, val in self._fetcher.read_global_counters().items():
                 self._metrics.add_global_counter(key, val)
+        if self._force_gc:
+            import gc
+            gc.collect()
         if len(evicted) == 0:
             return
         if self._columnar:
