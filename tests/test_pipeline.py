@@ -80,15 +80,17 @@ class TestAgentPipeline:
         fake = FakeFetcher()
         out = CollectExporter()
         agent = make_agent(fake, out, ENABLE_FLOWS_RINGBUF_FALLBACK="true")
+        # two ringbuf singles for the same flow must be re-aggregated; queue
+        # them BEFORE the agent starts so they land in one accounter window
+        # even under heavy host load (they'd otherwise race the 100ms evict)
+        ev = make_events(1, nbytes=40)
+        fake.inject_ringbuf(ev)
+        fake.inject_ringbuf(ev)
         stop = threading.Event()
         t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
         t.start()
         try:
-            # two ringbuf singles for the same flow must be re-aggregated
-            ev = make_events(1, nbytes=40)
-            fake.inject_ringbuf(ev)
-            fake.inject_ringbuf(ev)
-            deadline = time.monotonic() + 3
+            deadline = time.monotonic() + 8
             merged = None
             while time.monotonic() < deadline:
                 try:
