@@ -79,10 +79,12 @@ class TestAgentPipeline:
     def test_ringbuf_fallback_path(self):
         fake = FakeFetcher()
         out = CollectExporter()
-        agent = make_agent(fake, out, ENABLE_FLOWS_RINGBUF_FALLBACK="true")
+        # a 2s accounter window: both pre-queued singles are always accounted
+        # long before the first eviction, even under heavy host load
+        agent = make_agent(fake, out, ENABLE_FLOWS_RINGBUF_FALLBACK="true",
+                           CACHE_ACTIVE_TIMEOUT="2s")
         # two ringbuf singles for the same flow must be re-aggregated; queue
         # them BEFORE the agent starts so they land in one accounter window
-        # even under heavy host load (they'd otherwise race the 100ms evict)
         ev = make_events(1, nbytes=40)
         fake.inject_ringbuf(ev)
         fake.inject_ringbuf(ev)
