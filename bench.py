@@ -197,7 +197,15 @@ def main():
     rng = np.random.default_rng(2026)
     universe, pool = make_pool(rng)
     baseline = cpu_exact_baseline(pool)
-    rate, state, feed = tpu_ingest_rate(pool, use_pallas="--pallas" in sys.argv)
+    use_pallas = "--pallas" in sys.argv
+    if use_pallas:
+        import jax
+        if jax.default_backend() != "tpu":
+            print("WARNING: --pallas off-TPU runs the kernels in interpret "
+                  "mode (a Python loop) — the number below is meaningless "
+                  "for comparison; use the default scatter path on CPU",
+                  file=sys.stderr)
+    rate, state, feed = tpu_ingest_rate(pool, use_pallas=use_pallas)
     if "--check" in sys.argv:
         recall = check_recall(state, feed, universe, pool)
         print(f"heavy-hitter recall@100 vs exact: {recall:.3f}", file=sys.stderr)
