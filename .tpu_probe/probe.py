@@ -1,0 +1,24 @@
+import json, time, sys, traceback
+t0 = time.time()
+log = open("/root/repo/.tpu_probe/probe.log", "a", buffering=1)
+def say(m): log.write(f"[{time.time()-t0:8.1f}s] {m}\n")
+say("probe start: importing jax (axon platform allowed)")
+try:
+    import jax
+    say(f"jax {jax.__version__} imported; calling jax.devices()")
+    devs = jax.devices()
+    say(f"devices: {devs}")
+    d = devs[0]
+    say(f"platform={d.platform} kind={getattr(d,'device_kind','?')}")
+    import jax.numpy as jnp
+    say("running tiny matmul on device...")
+    x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    say(f"matmul ok, sum={float(jnp.sum(y.astype(jnp.float32)))}")
+    json.dump({"ok": True, "platform": d.platform, "kind": str(getattr(d,'device_kind','?')),
+               "elapsed_s": time.time()-t0}, open("/root/repo/.tpu_probe/result.json","w"))
+    say("PROBE OK")
+except Exception as e:
+    say(f"PROBE FAILED: {e}\n{traceback.format_exc()}")
+    json.dump({"ok": False, "error": str(e), "elapsed_s": time.time()-t0},
+              open("/root/repo/.tpu_probe/result.json","w"))

@@ -166,8 +166,12 @@ def _attach_features(records: list[Record], evicted) -> None:
                 f.xlat_zone_id = int(x["zone_id"])
         if evicted.nevents is not None and i < len(evicted.nevents):
             n = evicted.nevents[i]
-            for j in range(int(n["n_events"])):
-                f.network_events.append(n["events"][j].tobytes())
+            # n_events is a wrapping ring cursor (accumulate_network_events),
+            # not a count: render every occupied slot instead, keyed on
+            # packets[j] != 0 like the reference (pkg/model/record.go:129-131)
+            for j in range(n["events"].shape[0]):
+                if int(n["packets"][j]) != 0 or n["events"][j].any():
+                    f.network_events.append(n["events"][j].tobytes())
         if evicted.quic is not None and i < len(evicted.quic):
             q = evicted.quic[i]
             f.quic_version = int(q["version"])

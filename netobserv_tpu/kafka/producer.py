@@ -291,6 +291,26 @@ class KafkaProducer:
             self._produce(leader, parts)
 
     def _produce(self, leader: int, parts: dict[int, list]) -> None:
+        try:
+            self._produce_once(leader, parts)
+        except (OSError, ConnectionError):
+            # the usual reason a send fails is that partition leadership
+            # moved: refresh metadata, then re-group the partitions by their
+            # *current* leaders before retrying (not the stale leader id)
+            self._leader_conns.pop(leader, None)
+            self._refresh_metadata()
+            current = dict(self._partitions)
+            regrouped: dict[int, dict[int, list]] = {}
+            for pid, msgs in parts.items():
+                new_leader = current.get(pid)
+                if new_leader is None:
+                    raise IOError(
+                        f"partition {pid} missing after metadata refresh")
+                regrouped.setdefault(new_leader, {})[pid] = msgs
+            for new_leader, new_parts in regrouped.items():
+                self._produce_once(new_leader, new_parts)
+
+    def _produce_once(self, leader: int, parts: dict[int, list]) -> None:
         partition_data = []
         for pid, msgs in parts.items():
             batch = _record_batch(msgs, self._compression)
@@ -300,13 +320,7 @@ class KafkaProducer:
                                         int(self._timeout * 1000)) + topic_data
         conn = self._leader_conn(leader)
         expect = self._acks != 0
-        try:
-            r = conn.request(API_PRODUCE, 3, body, expect_response=expect)
-        except (OSError, ConnectionError):
-            self._leader_conns.pop(leader, None)
-            self._refresh_metadata()
-            conn = self._leader_conn(leader)
-            r = conn.request(API_PRODUCE, 3, body, expect_response=expect)
+        r = conn.request(API_PRODUCE, 3, body, expect_response=expect)
         if self._acks:
             n_topics = r.i32()
             for _ in range(n_topics):

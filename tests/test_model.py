@@ -176,6 +176,25 @@ class TestAccumulate:
         assert int(a["n_events"]) == 2
         assert np.array_equal(a["events"][1], b["events"][1])
 
+    def test_network_events_render_after_wrap(self):
+        """n_events is a ring cursor, not a count: after a wrap the cursor is
+        small while all slots hold real events. Rendering must scan every
+        occupied slot (reference pkg/model/record.go:129-131)."""
+        from netobserv_tpu.datapath.fetcher import EvictedFlows
+        from netobserv_tpu.flow.map_tracer import _attach_features
+
+        events = np.zeros(1, dtype=binfmt.FLOW_EVENT_DTYPE)
+        events[0] = make_event()
+        nev = np.zeros(1, dtype=binfmt.NEVENTS_REC_DTYPE)
+        cap = nev[0]["events"].shape[0]
+        for j in range(cap):
+            nev[0]["events"][j] = [j + 1] * 8
+            nev[0]["packets"][j] = 1
+        nev[0]["n_events"] = 1  # cursor wrapped past the end
+        recs = records_from_events(events, clock=MonotonicClock())
+        _attach_features(recs, EvictedFlows(events, nevents=nev))
+        assert len(recs[0].features.network_events) == cap
+
     def test_percpu_merge(self):
         vals = np.zeros(4, dtype=binfmt.EXTRA_REC_DTYPE)
         vals["rtt_ns"] = [10, 40, 20, 30]
