@@ -24,7 +24,7 @@ from __future__ import annotations
 
 from netobserv_tpu.datapath.asm import (
     Asm, BPF_B, BPF_DW, BPF_H, BPF_W, HELPER_KTIME_GET_NS, HELPER_MAP_LOOKUP,
-    HELPER_MAP_UPDATE, R0, R1, R2, R3, R4, R6, R7, R8, R9, R10,
+    HELPER_MAP_UPDATE, R0, R1, R2, R3, R4, R5, R6, R7, R8, R9, R10,
 )
 
 # __sk_buff field offsets
@@ -151,9 +151,15 @@ def build_flow_program(map_fd: int, direction: int = 0,
     a.call(HELPER_MAP_LOOKUP)
     a.jmp_imm(0x15, R0, 0, "miss")
 
-    # hit: bytes += skb->len (atomic), packets += 1 (atomic), last_seen = now,
-    # flags |= this packet's flags (read-modify-write; benign race: bits only
-    # accumulate, a lost update costs one OR)
+    # hit: multi-interface dedup (reference bpf/flows.c:100-110) — only the
+    # interface that FIRST saw the flow counts bytes/packets; any other
+    # interface updates last_seen/flags and the observed-interface list
+    a.ldx(BPF_W, R4, R6, SKB_IFINDEX)
+    a.ldx(BPF_W, R3, R0, ST_IFINDEX)
+    a.jmp_reg(0x5D, R3, R4, "hit_other")        # not the first-seen intf
+    # counting path: bytes += skb->len (atomic), packets += 1 (atomic),
+    # last_seen = now, flags |= packet flags (read-modify-write; benign race:
+    # bits only accumulate, a lost update costs one OR)
     a.ldx(BPF_W, R3, R6, SKB_LEN)
     a.atomic_add(BPF_DW, R0, R3, ST_BYTES)
     a.mov_imm(R4, 1)
@@ -163,6 +169,38 @@ def build_flow_program(map_fd: int, direction: int = 0,
     a.ldx(BPF_DW, R4, R10, FLAGS_SPILL)
     a.alu_reg(0x4F, R3, R4)                     # r3 |= packet flags
     a.stx(BPF_H, R0, R3, ST_FLAGS)
+    a.jmp("out")
+
+    a.label("hit_other")
+    # secondary interface: span/flags only — never re-count traffic
+    a.stx(BPF_DW, R0, R9, ST_LAST)
+    a.ldx(BPF_H, R3, R0, ST_FLAGS)
+    a.ldx(BPF_DW, R5, R10, FLAGS_SPILL)
+    a.alu_reg(0x4F, R3, R5)
+    a.stx(BPF_H, R0, R3, ST_FLAGS)
+    # (ifindex, direction) dedup scan over the observed slots (r4 = ifindex;
+    # direction is a build-time constant, so it compares as an immediate)
+    n_obs = binfmt.FLOW_STATS_DTYPE["observed_intf"].shape[0]
+    for i in range(n_obs):
+        a.ldx(BPF_W, R3, R0, ST_OBSIF + 4 * i)
+        a.jmp_reg(0x5D, R3, R4, f"obs_next_{i}")  # different intf: keep going
+        a.ldx(BPF_B, R3, R0, ST_OBSDIR + i)
+        a.jmp_imm(0x15, R3, direction, "out")     # same (intf, dir): recorded
+        a.label(f"obs_next_{i}")
+    # append (lock-free; a racing append can lose one slot — benign)
+    a.ldx(BPF_B, R3, R0, ST_NOBS)
+    a.jmp_imm(0x35, R3, n_obs, "out")           # array full: drop observation
+    a.mov_reg(R5, R3)
+    a.alu_imm(0x67, R5, 2)                      # n << 2
+    a.mov_reg(R7, R0)
+    a.alu_reg(0x0F, R7, R5)
+    a.stx(BPF_W, R7, R4, ST_OBSIF)              # observed_intf[n] = ifindex
+    a.mov_reg(R7, R0)
+    a.alu_reg(0x0F, R7, R3)
+    a.mov_imm(R5, direction)
+    a.stx(BPF_B, R7, R5, ST_OBSDIR)             # observed_direction[n] = dir
+    a.alu_imm(0x07, R3, 1)
+    a.stx(BPF_B, R0, R3, ST_NOBS)
     a.jmp("out")
 
     a.label("miss")

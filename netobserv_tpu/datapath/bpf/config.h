@@ -45,8 +45,10 @@ volatile const __u8 cfg_quic_mode = 0; /* 0 off, 1 port-443, 2 any udp */
 volatile const __u8 cfg_enable_ringbuf_fallback = 0;
 volatile const __u8 cfg_enable_pca = 0;
 
-/* per-CPU "did the TC path sample this packet?" flag keeping aux hooks
- * consistent with the sampling decision */
+/* set when any flow-filter rule carries a per-rule sampling override: the
+ * sampling gate must then run AFTER filter evaluation (which may rewrite the
+ * rate); when clear, sampling gates at the very top, before parsing
+ * (reference: has_filter_sampling, bpf/flows.c:160-206) */
 volatile const __u8 cfg_has_sampling = 0;
 
 #endif /* NO_CONFIG_H */

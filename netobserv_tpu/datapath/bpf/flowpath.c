@@ -38,50 +38,72 @@ NO_INLINE int no_sampled(__u32 sampling) {
     return bpf_get_prandom_u32() % sampling == 0;
 }
 
-/* merge one packet into an existing map entry (under its spin lock) */
-NO_INLINE void no_update_flow(struct no_flow_stats *s,
-                              const struct no_pkt *pkt, __u32 if_index,
-                              __u8 direction, __u32 sampling,
-                              const struct no_tls_meta *tls, __u32 len) {
+/* merge one packet into an existing map entry (under its spin lock).
+ * Returns 1 when the observed-interface array overflowed (counted by the
+ * caller, outside the lock). */
+NO_INLINE int no_update_flow(struct no_flow_stats *s,
+                             const struct no_pkt *pkt, __u32 if_index,
+                             __u8 direction, __u32 sampling,
+                             const struct no_tls_meta *tls, __u32 len) {
+    int overflow = 0;
     bpf_spin_lock(&s->lock);
-    if (s->first_seen_ns == 0 || pkt->ts_ns < s->first_seen_ns)
-        s->first_seen_ns = pkt->ts_ns;
-    if (pkt->ts_ns > s->last_seen_ns)
-        s->last_seen_ns = pkt->ts_ns;
-    s->bytes += len;
-    s->packets += 1;
-    s->tcp_flags |= pkt->tcp_flags;
-    s->sampling = sampling;
-    if (s->dscp == 0)
-        s->dscp = pkt->dscp;
-    /* multi-interface dedup: remember every (ifindex, direction) that saw
-     * this flow, bounded at NO_MAX_OBSERVED_INTERFACES */
-    __u8 n = s->n_observed_intf;
-    __u8 seen = 0;
-    #pragma unroll
-    for (int i = 0; i < NO_MAX_OBSERVED_INTERFACES; i++) {
-        if (i < n && s->observed_intf[i] == if_index &&
-            s->observed_direction[i] == direction)
-            seen = 1;
-    }
-    if (!seen) {
-        if (n < NO_MAX_OBSERVED_INTERFACES) {
-            s->observed_intf[n] = if_index;
-            s->observed_direction[n] = direction;
-            s->n_observed_intf = n + 1;
+    if (s->if_index_first == if_index) {
+        /* count bytes/packets only from the first-seen interface, so a flow
+         * crossing veth+bridge+phys is not double-counted (reference:
+         * update_existing_flow, bpf/flows.c:100-110) */
+        if (s->first_seen_ns == 0 || pkt->ts_ns < s->first_seen_ns)
+            s->first_seen_ns = pkt->ts_ns;
+        if (pkt->ts_ns > s->last_seen_ns)
+            s->last_seen_ns = pkt->ts_ns;
+        s->bytes += len;
+        s->packets += 1;
+        s->tcp_flags |= pkt->tcp_flags;
+        s->sampling = sampling;
+        if (pkt->dscp)
+            s->dscp = pkt->dscp;
+        if (tls) {
+            if (tls->version && s->ssl_version != tls->version) {
+                if (s->ssl_version == 0)
+                    s->ssl_version = tls->version;
+                else
+                    /* client/server hellos disagree on version
+                     * (reference: bpf/flows.c:111-118) */
+                    s->misc_flags |= NO_MISC_SSL_MISMATCH;
+            }
+            /* cipher_suite/key_share only ever parse out of a ServerHello
+             * (tls.h), matching the reference's SERVER_HELLO gate */
+            if (tls->cipher_suite)
+                s->tls_cipher_suite = tls->cipher_suite;
+            if (tls->key_share)
+                s->tls_key_share = tls->key_share;
+            s->tls_types |= tls->types_seen;
         }
-        /* overflow counted outside the lock */
-    }
-    if (tls) {
-        if (tls->version)
-            s->ssl_version = tls->version;
-        if (tls->cipher_suite)
-            s->tls_cipher_suite = tls->cipher_suite;
-        if (tls->key_share)
-            s->tls_key_share = tls->key_share;
-        s->tls_types |= tls->types_seen;
+    } else if (if_index != 0) {
+        /* secondary interface: extend the time span and flags, remember the
+         * (ifindex, direction) observation — but never re-count traffic */
+        if (pkt->ts_ns > s->last_seen_ns)
+            s->last_seen_ns = pkt->ts_ns;
+        s->tcp_flags |= pkt->tcp_flags;
+        __u8 n = s->n_observed_intf;
+        __u8 seen = 0;
+        #pragma unroll
+        for (int i = 0; i < NO_MAX_OBSERVED_INTERFACES; i++) {
+            if (i < n && s->observed_intf[i] == if_index &&
+                s->observed_direction[i] == direction)
+                seen = 1;
+        }
+        if (!seen) {
+            if (n < NO_MAX_OBSERVED_INTERFACES) {
+                s->observed_intf[n] = if_index;
+                s->observed_direction[n] = direction;
+                s->n_observed_intf = n + 1;
+            } else {
+                overflow = 1;
+            }
+        }
     }
     bpf_spin_unlock(&s->lock);
+    return overflow;
 }
 
 NO_INLINE void no_init_stats(struct no_flow_stats *s, const struct no_pkt *pkt,
@@ -129,7 +151,17 @@ NO_INLINE void no_ringbuf_fallback(const struct no_pkt *pkt, __u32 if_index,
 }
 
 NO_INLINE int no_flow_monitor(struct __sk_buff *skb, __u8 direction) {
-    __u32 sampling = cfg_sampling;
+    __u32 sampling = 0;
+    if (!cfg_has_sampling) {
+        /* no filter rule carries a sampling override: gate at the earliest
+         * point, before any parsing (reference: bpf/flows.c:160-171) */
+        if (!no_sampled(cfg_sampling)) {
+            no_set_do_sampling(0);
+            return TC_ACT_OK;
+        }
+        sampling = cfg_sampling;
+        no_set_do_sampling(1);
+    }
     struct no_pkt pkt;
     __builtin_memset(&pkt, 0, sizeof(pkt));
 
@@ -137,9 +169,20 @@ NO_INLINE int no_flow_monitor(struct __sk_buff *skb, __u8 direction) {
         return TC_ACT_OK;
     pkt.ts_ns = bpf_ktime_get_ns();
 
-    if (!no_flow_filter(&pkt, direction, 0, &sampling))
-        return TC_ACT_OK;
-    if (!no_sampled(sampling))
+    int skip = !no_flow_filter(&pkt, direction, 0, &sampling);
+    if (cfg_has_sampling) {
+        /* filter evaluation may have rewritten the rate for this flow; gate
+         * now and record the decision for the aux hooks — even for packets
+         * the filter will skip (reference: bpf/flows.c:194-206) */
+        if (sampling == 0)
+            sampling = cfg_sampling;
+        if (!no_sampled(sampling)) {
+            no_set_do_sampling(0);
+            return TC_ACT_OK;
+        }
+        no_set_do_sampling(1);
+    }
+    if (skip)
         return TC_ACT_OK;
 
     struct no_tls_meta tls = {};
@@ -151,8 +194,12 @@ NO_INLINE int no_flow_monitor(struct __sk_buff *skb, __u8 direction) {
     struct no_flow_stats *existing =
         bpf_map_lookup_elem(&aggregated_flows, &pkt.key);
     if (existing) {
-        no_update_flow(existing, &pkt, if_index, direction, sampling, &tls,
-                       skb->len);
+        if (no_update_flow(existing, &pkt, if_index, direction, sampling,
+                           &tls, skb->len) &&
+            pkt.key.proto != 0)
+            /* zero-proto traffic routinely saturates the array; only count
+             * real protocols (reference: bpf/flows.c:133-142) */
+            no_count(NO_CTR_OBSERVED_INTF_MISSED);
     } else {
         struct no_flow_stats fresh;
         no_init_stats(&fresh, &pkt, if_index, direction, sampling, &tls,
@@ -163,8 +210,10 @@ NO_INLINE int no_flow_monitor(struct __sk_buff *skb, __u8 direction) {
             /* another CPU created it between lookup and insert: merge */
             existing = bpf_map_lookup_elem(&aggregated_flows, &pkt.key);
             if (existing) {
-                no_update_flow(existing, &pkt, if_index, direction, sampling,
-                               &tls, skb->len);
+                if (no_update_flow(existing, &pkt, if_index, direction,
+                                   sampling, &tls, skb->len) &&
+                    pkt.key.proto != 0)
+                    no_count(NO_CTR_OBSERVED_INTF_MISSED);
             } else {
                 no_count(NO_CTR_HASHMAP_FAIL_UPDATE_FLOW);
             }

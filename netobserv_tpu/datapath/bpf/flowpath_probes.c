@@ -130,7 +130,7 @@ static __always_inline int key_from_skb(struct sk_buff *skb,
 /* --- TCP RTT (fentry with kprobe fallback section) ----------------------- */
 
 static __always_inline int handle_rtt(struct sock *sk) {
-    if (!cfg_enable_rtt)
+    if (!cfg_enable_rtt || !no_do_sampling())
         return 0;
     struct no_flow_key k = {};
     if (key_from_sock_rx(sk, &k) != 0)
@@ -173,8 +173,12 @@ SEC("tracepoint/skb/kfree_skb")
 int drops_tp(struct kfree_skb_ctx *ctx) {
     if (!cfg_enable_pkt_drops)
         return 0;
-    /* reason <= 2 (NOT_SPECIFIED / NO_SOCKET boundary) is routine teardown */
+    /* reason <= 2 (NOT_SPECIFIED / NO_SOCKET boundary) is routine teardown;
+     * filter it before paying the sampling-gate map lookup — this hook fires
+     * for every freed skb on the host */
     if (ctx->reason <= 2)
+        return 0;
+    if (!no_do_sampling())
         return 0;
     struct no_flow_key k = {};
     __u16 eth_proto = 0, flags = 0;
@@ -211,7 +215,7 @@ int drops_tp(struct kfree_skb_ctx *ctx) {
 SEC("kprobe/psample_sample_packet")
 int BPF_KPROBE(nevents_kprobe, struct psample_group *group,
                struct sk_buff *skb, u32 sample_rate, void *md) {
-    if (!cfg_enable_network_events)
+    if (!cfg_enable_network_events || !no_do_sampling())
         return 0;
     __u32 group_id = BPF_CORE_READ(group, group_num);
     if (group_id != cfg_network_events_group_id) {
@@ -280,7 +284,7 @@ int BPF_KPROBE(nevents_kprobe, struct psample_group *group,
 SEC("kprobe/nf_nat_manip_pkt")
 int BPF_KPROBE(xlat_kprobe, struct sk_buff *skb, struct nf_conn *ct,
                int mtype, int dir) {
-    if (!cfg_enable_pkt_translation)
+    if (!cfg_enable_pkt_translation || !no_do_sampling())
         return 0;
     struct no_flow_key k = {};
     __u16 eth_proto = 0;
@@ -312,7 +316,7 @@ int BPF_KPROBE(xlat_kprobe, struct sk_buff *skb, struct nf_conn *ct,
 /* --- IPsec (xfrm entry/return probe pairs) ------------------------------- */
 
 static __always_inline int ipsec_entry(struct sk_buff *skb, void *map) {
-    if (!cfg_enable_ipsec)
+    if (!cfg_enable_ipsec || !no_do_sampling())
         return 0;
     struct no_flow_key k = {};
     __u16 eth_proto = 0;
@@ -324,7 +328,7 @@ static __always_inline int ipsec_entry(struct sk_buff *skb, void *map) {
 }
 
 static __always_inline int ipsec_return(int ret, void *map) {
-    if (!cfg_enable_ipsec)
+    if (!cfg_enable_ipsec || !no_do_sampling())
         return 0;
     __u64 id = bpf_get_current_pid_tgid();
     struct no_flow_key *k = bpf_map_lookup_elem(map, &id);

@@ -1,8 +1,9 @@
 /*
  * maps.h — all datapath maps.
  *
- * Same 17-map surface as the reference (bpf/maps_definition.h), declared in
- * this project's style. Sizes marked "resized at load" are declared at their
+ * The reference's 17-map surface (bpf/maps_definition.h) plus `sampling_gate`
+ * (this design's per-CPU replacement for the reference's `do_sampling` .bss
+ * global), declared in this project's style. Sizes marked "resized at load" are declared at their
  * maximum; the loader shrinks them according to enabled features and
  * CACHE_MAX_FLOWS before load (the reference does the same,
  * pkg/tracer/tracer.go:117-135). All maps are pinned by name so an external
@@ -13,6 +14,7 @@
 
 #include "helpers.h"
 #include "records.h"
+#include "config.h" /* no_do_sampling() reads cfg_has_sampling/cfg_sampling */
 
 #define NO_PIN_BY_NAME 1
 
@@ -99,5 +101,32 @@ DEF_MAP(ipsec_egress_inflight, BPF_MAP_TYPE_HASH, __u64, struct no_flow_key,
 
 /* OpenSSL uprobe plaintext events (sized for 16KB * 1000/s * 5s window) */
 DEF_RINGBUF(ssl_events, 1 << 27);
+
+/* per-CPU record of the TC path's most recent sampling decision; the aux
+ * hooks (rtt/drops/nevents/xlat/ipsec) gate on it so per-flow features are
+ * only collected for sampled flows (reference: `static u8 do_sampling`,
+ * bpf/utils.h:9 — a per-CPU map instead of a .bss global avoids that
+ * global's cross-CPU races and loads through raw bpf(2) without .bss
+ * relocation support) */
+DEF_MAP(sampling_gate, BPF_MAP_TYPE_PERCPU_ARRAY, __u32, __u8, 1);
+
+NO_INLINE void no_set_do_sampling(__u8 v) {
+    __u32 k = 0;
+    __u8 *g = bpf_map_lookup_elem(&sampling_gate, &k);
+    if (g)
+        *g = v;
+}
+
+NO_INLINE __u8 no_do_sampling(void) {
+    /* sampling disabled: every packet is sampled — short-circuit so aux
+     * hooks on CPUs the TC path never ran on (RPS steering, cold start)
+     * are not suppressed by the zero-initialised gate; the verifier prunes
+     * this to a constant (volatile const) */
+    if (!cfg_has_sampling && cfg_sampling <= 1)
+        return 1;
+    __u32 k = 0;
+    __u8 *g = bpf_map_lookup_elem(&sampling_gate, &k);
+    return g ? *g : 0;
+}
 
 #endif /* NO_MAPS_H */

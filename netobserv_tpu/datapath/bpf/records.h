@@ -38,6 +38,9 @@ typedef int32_t __s32;
 #define NO_MAX_PAYLOAD_SIZE 256
 #define NO_MAX_SSL_DATA (16 * 1024)
 
+/* no_flow_stats.misc_flags bits (reference: bpf/types.h:75) */
+#define NO_MISC_SSL_MISMATCH 0x01
+
 /* Flow identity: 5-tuple plus ICMP discriminator. IPv4 addresses are stored
  * v4-in-v6 mapped (::ffff/96, RFC 4038). 40 bytes. */
 struct no_flow_key {

@@ -44,7 +44,7 @@ class _Columns(ctypes.Structure):
 _lib: Optional[ctypes.CDLL] = None
 
 
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 
 def _find_lib() -> Optional[ctypes.CDLL]:
@@ -71,7 +71,12 @@ def build_native(force: bool = False) -> bool:
     out = _LIB_PATHS[0]
     os.makedirs(os.path.dirname(out), exist_ok=True)
     if os.path.exists(out) and not force:
-        return True
+        # a stale build from an older ABI must be rebuilt, not kept
+        try:
+            if ctypes.CDLL(out).fp_abi_version() == _ABI_VERSION:
+                return True
+        except (OSError, AttributeError):
+            pass
     src = os.path.join(_NATIVE_DIR, "flowpack.cc")
     try:
         subprocess.run(
@@ -140,6 +145,12 @@ _MERGE_FNS = {
     "drops": ("fp_merge_drops", binfmt.DROPS_REC_DTYPE,
               accumulate.accumulate_drops),
     "dns": ("fp_merge_dns", binfmt.DNS_REC_DTYPE, accumulate.accumulate_dns),
+    "nevents": ("fp_merge_nevents", binfmt.NEVENTS_REC_DTYPE,
+                accumulate.accumulate_network_events),
+    "xlat": ("fp_merge_xlat", binfmt.XLAT_REC_DTYPE,
+             accumulate.accumulate_xlat),
+    "quic": ("fp_merge_quic", binfmt.QUIC_REC_DTYPE,
+             accumulate.accumulate_quic),
 }
 
 

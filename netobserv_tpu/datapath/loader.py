@@ -58,11 +58,16 @@ class KernelFetcher:
             "kernel loader attach path lands with the native evictor")
 
 
-# (map name, value dtype, per-CPU?) — feature maps the bpfman fetcher drains
+# (map name, value dtype, EvictedFlows attr) — ALL per-CPU feature maps the
+# fetcher drains at eviction (reference merges every enabled feature map,
+# pkg/tracer/tracer.go:1057-1110, incl. quic_flows at :1098-1110)
 _FEATURE_MAPS = [
     ("flows_extra", binfmt.EXTRA_REC_DTYPE, "extra"),
     ("flows_dns", binfmt.DNS_REC_DTYPE, "dns"),
     ("flows_drops", binfmt.DROPS_REC_DTYPE, "drops"),
+    ("flows_nevents", binfmt.NEVENTS_REC_DTYPE, "nevents"),
+    ("flows_xlat", binfmt.XLAT_REC_DTYPE, "xlat"),
+    ("flows_quic", binfmt.QUIC_REC_DTYPE, "quic"),
 ]
 
 

@@ -23,4 +23,5 @@ MAPS = [
     "ipsec_ingress_inflight",
     "ipsec_egress_inflight",
     "ssl_events",
+    "sampling_gate",
 ]

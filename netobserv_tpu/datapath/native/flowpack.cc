@@ -110,7 +110,14 @@ void fp_merge_stats(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
             out.if_index_first = s->if_index_first;
             out.direction_first = s->direction_first;
         }
-        if (s->ssl_version) out.ssl_version = s->ssl_version;
+        // ssl_version: first non-zero wins; a conflicting later version sets
+        // the mismatch flag (mirrors accumulate_base / kernel entry rule)
+        if (s->ssl_version) {
+            if (out.ssl_version == 0)
+                out.ssl_version = s->ssl_version;
+            else if (out.ssl_version != s->ssl_version)
+                out.misc_flags |= NO_MISC_SSL_MISMATCH;
+        }
         if (s->tls_cipher_suite) out.tls_cipher_suite = s->tls_cipher_suite;
         if (s->tls_key_share) out.tls_key_share = s->tls_key_share;
         out.tls_types |= s->tls_types;
@@ -194,6 +201,90 @@ void fp_merge_dns(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
     std::memcpy(out_buf, &out, sizeof(out));
 }
 
+// Merge per-CPU partials of the network-events record: dedup-append into a
+// wrapping ring of NO_MAX_NETWORK_EVENTS slots (n_events is the ring CURSOR,
+// not a count — renderers scan slots keyed on packets[i] != 0). Mirrors
+// model/accumulate.py accumulate_network_events.
+void fp_merge_nevents(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
+    struct no_nevents_rec out;
+    std::memcpy(&out, values, sizeof(out));
+    const struct no_nevents_rec *v =
+        reinterpret_cast<const struct no_nevents_rec *>(values);
+    for (size_t c = 1; c < n_cpu; c++) {
+        const struct no_nevents_rec *s = &v[c];
+        merge_times(&out.first_seen_ns, &out.last_seen_ns,
+                    s->first_seen_ns, s->last_seen_ns);
+        uint8_t idx = out.n_events % NO_MAX_NETWORK_EVENTS;
+        for (int j = 0; j < NO_MAX_NETWORK_EVENTS; j++) {
+            if (s->packets[j] == 0)
+                continue;
+            bool dup = false;
+            for (int i = 0; i < NO_MAX_NETWORK_EVENTS; i++) {
+                if (std::memcmp(out.events[i], s->events[j],
+                                NO_MAX_EVENT_MD) == 0) {
+                    dup = true;
+                    break;
+                }
+            }
+            if (!dup) {
+                std::memcpy(out.events[idx], s->events[j], NO_MAX_EVENT_MD);
+                out.bytes[idx] = sat_add16(out.bytes[idx], s->bytes[j]);
+                out.packets[idx] = sat_add16(out.packets[idx], s->packets[j]);
+                idx = (idx + 1) % NO_MAX_NETWORK_EVENTS;
+            }
+        }
+        out.n_events = idx;
+    }
+    std::memcpy(out_buf, &out, sizeof(out));
+}
+
+// Merge per-CPU partials of the NAT-translation record: a complete
+// (both-endpoints) observation replaces. Mirrors accumulate_xlat.
+void fp_merge_xlat(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
+    struct no_xlat_rec out;
+    std::memcpy(&out, values, sizeof(out));
+    const struct no_xlat_rec *v =
+        reinterpret_cast<const struct no_xlat_rec *>(values);
+    for (size_t c = 1; c < n_cpu; c++) {
+        const struct no_xlat_rec *s = &v[c];
+        merge_times(&out.first_seen_ns, &out.last_seen_ns,
+                    s->first_seen_ns, s->last_seen_ns);
+        bool src_set = false, dst_set = false;
+        for (int i = 0; i < NO_IP_LEN; i++) {
+            if (s->src_ip[i]) src_set = true;
+            if (s->dst_ip[i]) dst_set = true;
+        }
+        if (src_set && dst_set) {
+            std::memcpy(out.src_ip, s->src_ip, NO_IP_LEN);
+            std::memcpy(out.dst_ip, s->dst_ip, NO_IP_LEN);
+            out.src_port = s->src_port;
+            out.dst_port = s->dst_port;
+            out.zone_id = s->zone_id;
+        }
+    }
+    std::memcpy(out_buf, &out, sizeof(out));
+}
+
+// Merge per-CPU partials of the QUIC record: max version wins, header-seen
+// flags accumulate. Mirrors accumulate_quic.
+void fp_merge_quic(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
+    struct no_quic_rec out;
+    std::memcpy(&out, values, sizeof(out));
+    const struct no_quic_rec *v =
+        reinterpret_cast<const struct no_quic_rec *>(values);
+    for (size_t c = 1; c < n_cpu; c++) {
+        const struct no_quic_rec *s = &v[c];
+        merge_times(&out.first_seen_ns, &out.last_seen_ns,
+                    s->first_seen_ns, s->last_seen_ns);
+        if (s->version > out.version) out.version = s->version;
+        if (s->seen_long_hdr > out.seen_long_hdr)
+            out.seen_long_hdr = s->seen_long_hdr;
+        if (s->seen_short_hdr > out.seen_short_hdr)
+            out.seen_short_hdr = s->seen_short_hdr;
+    }
+    std::memcpy(out_buf, &out, sizeof(out));
+}
+
 // crc32c (Castagnoli) — slice-by-8; used by the Kafka record-batch encoder.
 static uint32_t crc32c_table[8][256];
 static bool crc32c_ready = false;
@@ -238,6 +329,6 @@ uint32_t fp_crc32c(const uint8_t *data, size_t n) {
     return crc ^ 0xFFFFFFFFu;
 }
 
-uint32_t fp_abi_version(void) { return 2; }
+uint32_t fp_abi_version(void) { return 3; }
 
 }  // extern "C"

@@ -17,6 +17,9 @@ from __future__ import annotations
 import numpy as np
 
 U16_MAX = np.uint64(0xFFFF)
+
+# no_flow_stats.misc_flags bits (records.h NO_MISC_SSL_MISMATCH)
+MISC_SSL_MISMATCH = 0x01
 U32_MAX = np.uint64(0xFFFF_FFFF)
 U64_MAX = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
 
@@ -61,7 +64,15 @@ def accumulate_base(dst, src) -> None:
     if dst_was_empty:
         dst["if_index_first"] = src["if_index_first"]
         dst["direction_first"] = src["direction_first"]
-    for fld in ("ssl_version", "tls_cipher_suite", "tls_key_share"):
+    # ssl_version: first non-zero observation wins; a conflicting later
+    # version raises the mismatch flag instead of overwriting (same rule the
+    # kernel applies at entry time, reference bpf/flows.c:111-118)
+    if int(src["ssl_version"]) != 0:
+        if int(dst["ssl_version"]) == 0:
+            dst["ssl_version"] = src["ssl_version"]
+        elif int(dst["ssl_version"]) != int(src["ssl_version"]):
+            dst["misc_flags"] = int(dst["misc_flags"]) | MISC_SSL_MISMATCH
+    for fld in ("tls_cipher_suite", "tls_key_share"):
         if int(src[fld]) != 0:
             dst[fld] = src[fld]
     dst["tls_types"] = int(dst["tls_types"]) | int(src["tls_types"])

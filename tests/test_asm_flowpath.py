@@ -103,6 +103,67 @@ def test_kernel_flow_capture_and_eviction(veth):
         fetcher.close()
 
 
+@pytest.fixture
+def veth_bridge():
+    """nf0 enslaved to a bridge with the host IP on the bridge: every egress
+    datagram traverses br-nf (egress) AND nf0 (egress) — the classic
+    veth+bridge double-counting topology."""
+    _run("ip", "link", "add", "nf0", "type", "veth", "peer", "name", "nf1")
+    subprocess.run(["ip", "netns", "add", NS], check=True)
+    try:
+        _run("ip", "link", "set", "nf1", "netns", NS)
+        _run("ip", "link", "add", "br-nf", "type", "bridge")
+        _run("ip", "link", "set", "nf0", "master", "br-nf")
+        _run("ip", "addr", "add", "10.198.0.1/24", "dev", "br-nf")
+        _run("ip", "link", "set", "br-nf", "up")
+        _run("ip", "link", "set", "nf0", "up")
+        _run("ip", "netns", "exec", NS, "ip", "addr", "add",
+             "10.198.0.2/24", "dev", "nf1")
+        _run("ip", "netns", "exec", NS, "ip", "link", "set", "nf1", "up")
+        peer_mac = _run("ip", "netns", "exec", NS, "cat",
+                        "/sys/class/net/nf1/address").stdout.strip()
+        _run("ip", "neigh", "replace", "10.198.0.2", "lladdr", peer_mac,
+             "dev", "br-nf", "nud", "permanent")
+        yield ("br-nf", "nf0")
+    finally:
+        subprocess.run(["ip", "link", "del", "nf0"], capture_output=True)
+        subprocess.run(["ip", "link", "del", "br-nf"], capture_output=True)
+        subprocess.run(["ip", "netns", "del", NS], capture_output=True)
+
+
+def test_multi_interface_no_double_count(veth_bridge):
+    """A flow observed by two egress hooks (bridge + enslaved veth) must be
+    counted exactly once, from its first-seen interface, with the second
+    interface recorded in observed_intf (reference bpf/flows.c:100-110)."""
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    br, veth_if = veth_bridge
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024)
+    try:
+        fetcher.attach(1, br, "egress")
+        fetcher.attach(2, veth_if, "egress")
+        _send_udp(n=8, size=120)
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        flows = {}
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            flows[(int(k["src_port"]), int(k["dst_port"]),
+                   int(k["proto"]))] = evicted.events["stats"][i]
+        assert (44444, 5353, 17) in flows, f"flows seen: {list(flows)}"
+        st = flows[(44444, 5353, 17)]
+        # both hooks saw all 8 packets; the dedup gate must count them once
+        assert int(st["packets"]) == 8, "multi-interface double counting"
+        assert int(st["bytes"]) == 8 * 162
+        assert int(st["n_observed_intf"]) == 2
+        obs = {int(st["observed_intf"][j])
+               for j in range(int(st["n_observed_intf"]))}
+        assert int(st["if_index_first"]) in obs
+        assert len(obs) == 2
+    finally:
+        fetcher.close()
+
+
 def test_full_agent_over_kernel_datapath(veth):
     from netobserv_tpu.agent import FlowsAgent
     from netobserv_tpu.config import load_config

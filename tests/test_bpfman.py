@@ -118,6 +118,120 @@ def test_bpfman_fetcher_drains_real_kernel_maps(pinned_maps):
     fetcher.close()
 
 
+def test_bpfman_drains_all_six_feature_maps(pinned_maps):
+    """Every per-CPU feature map (extra/dns/drops/nevents/xlat/quic) is
+    drained, per-CPU-merged, and lands on the enriched Record (reference
+    merges all feature maps at eviction, pkg/tracer/tracer.go:1057-1110)."""
+    from netobserv_tpu.datapath.fetcher import EvictedFlows  # noqa: F401
+    from netobserv_tpu.datapath.loader import _FEATURE_MAPS, BpfmanFetcher
+    from netobserv_tpu.flow.map_tracer import _attach_features
+    from netobserv_tpu.model.record import MonotonicClock, records_from_events
+
+    n_cpus = sb.n_possible_cpus()
+    extra_pins = {}
+    try:
+        for name, dtype, attr in _FEATURE_MAPS:
+            if name in pinned_maps or name in extra_pins:
+                continue
+            m = sb.BpfMap.create(BPF_MAP_TYPE_PERCPU_HASH,
+                                 binfmt.FLOW_KEY_DTYPE.itemsize,
+                                 dtype.itemsize, 1024, attr.encode())
+            m.n_cpus = n_cpus
+            m.pin(os.path.join(PIN_DIR, name))
+            extra_pins[name] = m
+
+        key = make_key(4004)
+        pinned_maps["aggregated_flows"].update(
+            key.tobytes(), make_stats(999, 2).tobytes())
+
+        def percpu(dtype, fill):
+            vals = np.zeros(n_cpus, dtype=dtype)
+            fill(vals)
+            return vals.tobytes()
+
+        def fill_dns(v):
+            v[0]["latency_ns"] = 3_000_000
+            v[0]["dns_id"] = 77
+            v[0]["name"] = b"\x07example\x03org\x00"  # wire qname format
+            if n_cpus > 1:
+                v[1]["latency_ns"] = 9_000_000  # max across CPUs must win
+
+        def fill_drops(v):
+            v[0]["bytes"] = 100
+            v[0]["packets"] = 1
+            v[0]["latest_cause"] = 5
+            if n_cpus > 1:
+                v[1]["bytes"] = 50
+                v[1]["packets"] = 2
+
+        def fill_nevents(v):
+            v[0]["events"][0] = [7] * 8
+            v[0]["packets"][0] = 1
+            v[0]["n_events"] = 1
+            if n_cpus > 1:  # distinct cookie on another CPU: both render
+                v[1]["events"][0] = [8] * 8
+                v[1]["packets"][0] = 1
+                v[1]["n_events"] = 1
+
+        def fill_xlat(v):
+            v[0]["src_ip"][10:12] = 0xFF
+            v[0]["src_ip"][12:] = [192, 168, 9, 9]
+            v[0]["dst_ip"][10:12] = 0xFF
+            v[0]["dst_ip"][12:] = [10, 0, 0, 1]
+            v[0]["src_port"] = 30000
+            v[0]["dst_port"] = 443
+            v[0]["zone_id"] = 4
+
+        def fill_quic(v):
+            v[0]["version"] = 1
+            v[0]["seen_long_hdr"] = 1
+            if n_cpus > 1:
+                v[1]["seen_short_hdr"] = 1
+
+        def fill_extra(v):
+            v[0]["rtt_ns"] = 5_000_000
+
+        fills = {"flows_dns": (binfmt.DNS_REC_DTYPE, fill_dns),
+                 "flows_drops": (binfmt.DROPS_REC_DTYPE, fill_drops),
+                 "flows_nevents": (binfmt.NEVENTS_REC_DTYPE, fill_nevents),
+                 "flows_xlat": (binfmt.XLAT_REC_DTYPE, fill_xlat),
+                 "flows_quic": (binfmt.QUIC_REC_DTYPE, fill_quic),
+                 "flows_extra": (binfmt.EXTRA_REC_DTYPE, fill_extra)}
+        all_maps = {**pinned_maps, **extra_pins}
+        for name, (dtype, fill) in fills.items():
+            all_maps[name].update(key.tobytes(), percpu(dtype, fill))
+
+        fetcher = BpfmanFetcher(PIN_DIR)
+        assert len(fetcher._features) == 6, "not all feature maps opened"
+        evicted = fetcher.lookup_and_delete()
+        assert len(evicted) == 1
+        # drain results, per-CPU merged
+        assert int(evicted.extra[0]["rtt_ns"]) == 5_000_000
+        assert int(evicted.dns[0]["latency_ns"]) == (
+            9_000_000 if n_cpus > 1 else 3_000_000)
+        assert int(evicted.drops[0]["bytes"]) == (150 if n_cpus > 1 else 100)
+        assert int(evicted.drops[0]["packets"]) == (3 if n_cpus > 1 else 1)
+        assert int(evicted.xlat[0]["zone_id"]) == 4
+        assert int(evicted.quic[0]["version"]) == 1
+        assert bool(evicted.quic[0]["seen_long_hdr"])
+        n_cookies = 2 if n_cpus > 1 else 1
+        assert np.count_nonzero(evicted.nevents[0]["packets"]) == n_cookies
+        # enriched Record carries every feature
+        recs = records_from_events(evicted.events, clock=MonotonicClock())
+        _attach_features(recs, evicted)
+        f = recs[0].features
+        assert f.dns_name == "example.org"
+        assert f.rtt_ns == 5_000_000
+        assert f.drop_latest_cause == 5
+        assert f.xlat_zone_id == 4
+        assert f.quic_version == 1
+        assert len(f.network_events) == n_cookies
+        fetcher.close()
+    finally:
+        for m in extra_pins.values():
+            m.close()
+
+
 def test_bpfman_full_agent_pipeline(pinned_maps):
     from netobserv_tpu.agent import FlowsAgent
     from netobserv_tpu.config import load_config

@@ -46,7 +46,8 @@ class TestPack:
 
 
 class TestMergePercpu:
-    @pytest.mark.parametrize("kind", ["stats", "extra", "drops", "dns"])
+    @pytest.mark.parametrize(
+        "kind", ["stats", "extra", "drops", "dns", "nevents", "xlat", "quic"])
     def test_native_matches_python(self, native, kind):
         rng = np.random.default_rng(3)
         dtype = flowpack._MERGE_FNS[kind][1]
@@ -60,6 +61,8 @@ class TestMergePercpu:
                 vals[i]["packets"] = int(rng.integers(0, 1000))
                 vals[i]["tcp_flags"] = int(rng.integers(0, 0xFFF))
                 vals[i]["dscp"] = int(rng.integers(0, 64))
+                vals[i]["ssl_version"] = int(
+                    rng.choice([0, 0x0303, 0x0304]))
             elif kind == "extra":
                 vals[i]["rtt_ns"] = int(rng.integers(0, 10**8))
                 vals[i]["ipsec_ret"] = int(rng.integers(-2, 3))
@@ -73,9 +76,46 @@ class TestMergePercpu:
                 vals[i]["latency_ns"] = int(rng.integers(0, 10**7))
                 vals[i]["dns_id"] = int(rng.integers(0, 2**16))
                 vals[i]["dns_flags"] = int(rng.integers(0, 2**16))
+            elif kind == "nevents":
+                n_ev = int(rng.integers(0, 5))
+                for j in range(n_ev):
+                    vals[i]["events"][j] = rng.integers(
+                        1, 255, size=8, dtype=np.uint8)
+                    vals[i]["bytes"][j] = int(rng.integers(1, 2000))
+                    vals[i]["packets"][j] = int(rng.integers(1, 10))
+                vals[i]["n_events"] = n_ev
+            elif kind == "xlat":
+                if rng.integers(0, 2):
+                    vals[i]["src_ip"] = rng.integers(
+                        1, 255, size=16, dtype=np.uint8)
+                    vals[i]["dst_ip"] = rng.integers(
+                        1, 255, size=16, dtype=np.uint8)
+                    vals[i]["src_port"] = int(rng.integers(1, 2**16))
+                    vals[i]["dst_port"] = int(rng.integers(1, 2**16))
+                    vals[i]["zone_id"] = int(rng.integers(0, 2**16))
+            elif kind == "quic":
+                vals[i]["version"] = int(rng.integers(0, 3))
+                vals[i]["seen_long_hdr"] = int(rng.integers(0, 2))
+                vals[i]["seen_short_hdr"] = int(rng.integers(0, 2))
         a = flowpack.merge_percpu(kind, vals, use_native=True)
         b = flowpack.merge_percpu(kind, vals, use_native=False)
         assert a.tobytes() == b.tobytes(), kind
+
+    def test_nevents_ring_wrap_equivalence(self, native):
+        """Cursor wrap with duplicates: both implementations must agree."""
+        cap = binfmt.NEVENTS_REC_DTYPE["events"].shape[0]
+        vals = np.zeros(2, dtype=binfmt.NEVENTS_REC_DTYPE)
+        for j in range(cap):
+            vals[0]["events"][j] = [j + 1] * 8
+            vals[0]["packets"][j] = 1
+        vals[0]["n_events"] = 1  # wrapped cursor
+        vals[1]["events"][0] = [1] * 8   # dup of slot 0
+        vals[1]["events"][1] = [99] * 8  # fresh
+        vals[1]["packets"][:2] = 1
+        vals[1]["n_events"] = 2
+        a = flowpack.merge_percpu("nevents", vals, use_native=True)
+        b = flowpack.merge_percpu("nevents", vals, use_native=False)
+        assert a.tobytes() == b.tobytes()
 
     def test_stats_saturating_and_dedup(self, native):
         vals = np.zeros(2, dtype=binfmt.FLOW_STATS_DTYPE)

@@ -176,6 +176,22 @@ class TestAccumulate:
         assert int(a["n_events"]) == 2
         assert np.array_equal(a["events"][1], b["events"][1])
 
+    def test_ssl_version_first_wins_and_mismatch_flag(self):
+        a = np.zeros(1, dtype=binfmt.FLOW_STATS_DTYPE)[0]
+        b = np.zeros(1, dtype=binfmt.FLOW_STATS_DTYPE)[0]
+        a["ssl_version"], b["ssl_version"] = 0x0303, 0x0304
+        acc.accumulate_base(a, b)
+        assert int(a["ssl_version"]) == 0x0303  # first observation kept
+        assert int(a["misc_flags"]) & acc.MISC_SSL_MISMATCH
+        # agreeing versions: no flag
+        c = np.zeros(1, dtype=binfmt.FLOW_STATS_DTYPE)[0]
+        d = np.zeros(1, dtype=binfmt.FLOW_STATS_DTYPE)[0]
+        d["ssl_version"] = 0x0303
+        acc.accumulate_base(c, d)
+        acc.accumulate_base(c, d)
+        assert int(c["ssl_version"]) == 0x0303
+        assert not int(c["misc_flags"]) & acc.MISC_SSL_MISMATCH
+
     def test_network_events_render_after_wrap(self):
         """n_events is a ring cursor, not a count: after a wrap the cursor is
         small while all slots hold real events. Rendering must scan every
