@@ -152,28 +152,52 @@ def host_path_rate(seconds: float = 3.0) -> float:
     return n / (time.perf_counter() - t0)
 
 
-def _device_watchdog(timeout_s: float = 240.0) -> str:
-    """Probe backend initialization in a SUBPROCESS; fall back to CPU when the
-    accelerator doesn't come up in time (the axon tunnel, when unhealthy,
-    hangs jax.devices() for ~25 minutes before erroring — a silent driver
-    timeout would lose the benchmark entirely). The probe child is left
-    running on timeout (killing a claim mid-flight wedges the tunnel harder);
-    this parent process then initializes CPU-only from scratch."""
+def _device_watchdog(timeout_s: float | None = None,
+                     attempts: int | None = None) -> str:
+    """Probe backend initialization in a SUBPROCESS with claim retries; fall
+    back to CPU only when every attempt fails (the axon tunnel, when
+    unhealthy, either errors with UNAVAILABLE after minutes or hangs
+    jax.devices() for ~25 minutes — a silent driver timeout would lose the
+    benchmark entirely). Probe children are never killed (killing a claim
+    mid-flight wedges the tunnel harder); a hung probe is left to die on its
+    own and this parent initializes CPU-only from scratch.
+
+    Env knobs: BENCH_TPU_PROBE_TIMEOUT (s/attempt, default 300),
+    BENCH_TPU_PROBE_ATTEMPTS (default 2), BENCH_TPU_RETRY_SLEEP (default 60).
+    """
+    import os
     import subprocess
 
-    probe = subprocess.Popen(
-        [sys.executable, "-c",
-         "import jax; print(jax.devices()[0].platform, flush=True)"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
-    try:
-        out, _ = probe.communicate(timeout=timeout_s)
-        platform = (out or "").strip()
-        if platform:
-            return platform
-        reason = "probe exited without a device"
-    except subprocess.TimeoutExpired:
-        reason = f"init still hung after {timeout_s:.0f}s"
-        # deliberately NOT killed; it errors out on its own eventually
+    timeout_s = timeout_s or float(os.environ.get(
+        "BENCH_TPU_PROBE_TIMEOUT", "300"))
+    attempts = attempts or int(os.environ.get(
+        "BENCH_TPU_PROBE_ATTEMPTS", "2"))
+    retry_sleep = float(os.environ.get("BENCH_TPU_RETRY_SLEEP", "60"))
+    reason = "no attempts made"
+    for i in range(attempts):
+        probe = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform, flush=True)"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        try:
+            out, _ = probe.communicate(timeout=timeout_s)
+            platform = (out or "").strip()
+            if platform == "cpu":
+                # CPU-only machine: that IS the device; no retries apply
+                return platform
+            if platform:
+                return platform
+            reason = f"claim attempt {i + 1}/{attempts} errored"
+            if i + 1 < attempts:
+                print(f"accelerator {reason}; retrying in "
+                      f"{retry_sleep:.0f}s", file=sys.stderr)
+                time.sleep(retry_sleep)
+        except subprocess.TimeoutExpired:
+            # deliberately NOT killed; a stacked second claim behind a hung
+            # one only worsens the wedge — stop probing entirely
+            reason = (f"claim attempt {i + 1} still hung after "
+                      f"{timeout_s:.0f}s")
+            break
     print(f"accelerator unavailable ({reason}); benchmarking on CPU",
           file=sys.stderr)
     import jax

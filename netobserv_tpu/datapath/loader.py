@@ -312,11 +312,13 @@ class MinimalKernelFetcher(BpfmanFetcher):
     needs_iface_discovery = True
     _PIN_PREFIX = "/sys/fs/bpf/netobserv_minflow_"
 
-    def __init__(self, cache_max_flows: int = 5000):
+    def __init__(self, cache_max_flows: int = 5000,
+                 attach_mode: str = "tcx"):
         from netobserv_tpu.datapath import asm_flowpath
 
         self._init_empty_maps()
         self._sweep_stale_pins()
+        self._mode = attach_mode
         BPF_MAP_TYPE_HASH = 1
         self._agg = syscall_bpf.BpfMap.create(
             BPF_MAP_TYPE_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
@@ -333,8 +335,8 @@ class MinimalKernelFetcher(BpfmanFetcher):
             syscall_bpf.obj_pin(fd, pin)
             self._prog_fds[name] = fd
             self._pins[name] = pin
-        # if_index -> (if_name, set of attached directions)
-        self._attached: dict[int, tuple[str, set[str]]] = {}
+        # if_index -> (if_name, direction -> live Attachment)
+        self._attached: dict[int, tuple[str, dict]] = {}
 
     def _init_empty_maps(self) -> None:
         """The inherited eviction path expects these BpfmanFetcher fields."""
@@ -363,35 +365,41 @@ class MinimalKernelFetcher(BpfmanFetcher):
 
         if os.geteuid() != 0:
             raise RuntimeError("kernel datapath requires root/CAP_BPF")
-        if shutil.which("tc") is None:
+        if cfg.tc_attach_mode != "tcx" and shutil.which("tc") is None:
             raise RuntimeError("tc (iproute2) not found; cannot attach")
-        return cls(cache_max_flows=cfg.cache_max_flows)
+        return cls(cache_max_flows=cfg.cache_max_flows,
+                   attach_mode=cfg.tc_attach_mode)
 
     def attach(self, if_index: int, if_name: str, direction: str) -> None:
         from netobserv_tpu.datapath import tc_attach
 
         wanted = (["ingress", "egress"] if direction == "both"
                   else [direction])
-        name, done = self._attached.setdefault(if_index, (if_name, set()))
-        if not done:
-            # fresh interface: drop any stale clsact state from prior runs
-            tc_attach.remove_clsact(if_name)
+        name, done = self._attached.setdefault(if_index, (if_name, {}))
+
+        def stale_cleanup():
+            # first legacy attach on this interface: drop stale clsact state
+            # from prior runs (reference removeTCFilters, tracer.go:542-566);
+            # never run when TCX succeeded — it would destroy third-party
+            # clsact filters for nothing
+            if not any(a.kind == "tc" for a in done.values()):
+                tc_attach.remove_clsact(if_name)
+
         for d in wanted:
             if d in done:
                 continue  # idempotent across listener retries
-            tc_attach.attach_pinned(if_name, d, self._pins[d])
-            done.add(d)
+            done[d] = tc_attach.attach_mode(
+                self._prog_fds[d], self._pins[d], if_name, if_index, d,
+                mode=self._mode, pre_legacy=stale_cleanup)
 
     def detach(self, if_index: int, if_name: str) -> None:
-        from netobserv_tpu.datapath import tc_attach
-
         entry = self._attached.pop(if_index, None)
         if entry is None:
             return
         name, done = entry
-        for d in done:
+        for d, att in done.items():
             try:
-                tc_attach.detach(name, d)
+                att.detach()
             except Exception as exc:
                 log.debug("detach %s %s failed: %s", name, d, exc)
 
@@ -399,10 +407,12 @@ class MinimalKernelFetcher(BpfmanFetcher):
         from netobserv_tpu.datapath import tc_attach
 
         for if_index in list(self._attached):
-            name, _dirs = self._attached[if_index]
+            name, dirs = self._attached[if_index]
+            legacy = any(att.kind == "tc" for att in dirs.values())
             try:
                 self.detach(if_index, name)
-                tc_attach.remove_clsact(name)
+                if legacy:
+                    tc_attach.remove_clsact(name)
             except Exception as exc:
                 log.debug("cleanup of %s failed: %s", name, exc)
         for fd in self._prog_fds.values():

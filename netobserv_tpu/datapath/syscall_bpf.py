@@ -296,6 +296,99 @@ def obj_pin(fd: int, path: str) -> None:
     _bpf(BPF_OBJ_PIN, attr)
 
 
+# --- TCX links (kernel >= 6.6) -------------------------------------------
+
+BPF_LINK_CREATE = 28
+BPF_LINK_DETACH = 34
+BPF_TCX_INGRESS = 46
+BPF_TCX_EGRESS = 47
+
+
+def link_create_tcx(prog_fd: int, if_index: int, direction: str) -> int:
+    """Attach a SCHED_CLS program to an interface's TCX hook via
+    BPF_LINK_CREATE; returns the bpf_link fd — closing it detaches (reference
+    analog: cilium/ebpf link.AttachTCX used at tracer.go:454-459). Raises
+    OSError(ENOTSUP/EINVAL) on pre-6.6 kernels, letting callers fall back to
+    legacy TC; OSError(EEXIST) when mprog rejects a duplicate attachment."""
+    attach_type = BPF_TCX_INGRESS if direction == "ingress" else BPF_TCX_EGRESS
+    # union bpf_attr link_create: prog_fd, target_ifindex, attach_type, flags
+    # + zeroed tcx { relative_fd/id, expected_revision } tail (= default
+    # anchor position, no revision check)
+    attr = struct.pack("<IIII", prog_fd, if_index, attach_type, 0)
+    attr += b"\x00" * 16
+    return _bpf(BPF_LINK_CREATE, attr)
+
+
+def link_detach(link_fd: int) -> None:
+    """Explicit BPF_LINK_DETACH (the link fd alone also detaches on close)."""
+    attr = struct.pack("<I", link_fd)
+    _bpf(BPF_LINK_DETACH, attr)
+
+
+BPF_LINK_GET_FD_BY_ID = 30
+BPF_LINK_GET_NEXT_ID = 31
+BPF_LINK_TYPE_TCX = 11
+
+
+def prog_id_of(prog_fd: int) -> int:
+    """Kernel-assigned program id (bpf_prog_info.id)."""
+    info = ctypes.create_string_buffer(256)
+    attr = struct.pack("<IIQ", prog_fd, len(info), ctypes.addressof(info))
+    _bpf(BPF_OBJ_GET_INFO_BY_FD, attr)
+    return struct.unpack_from("<I", info.raw, 4)[0]
+
+
+def link_info(link_fd: int) -> tuple[int, int, int, int, int]:
+    """(link_type, link_id, prog_id, tcx_ifindex, tcx_attach_type) — the tcx
+    fields are only meaningful when link_type == BPF_LINK_TYPE_TCX."""
+    info = ctypes.create_string_buffer(256)
+    attr = struct.pack("<IIQ", link_fd, len(info), ctypes.addressof(info))
+    _bpf(BPF_OBJ_GET_INFO_BY_FD, attr)
+    ltype, lid, pid = struct.unpack_from("<III", info.raw, 0)
+    ifindex, attach_type = struct.unpack_from("<II", info.raw, 16)
+    return ltype, lid, pid, ifindex, attach_type
+
+
+def iter_link_ids():
+    """Yield every bpf_link id on the system (CAP_BPF required)."""
+    cur = 0
+    while True:
+        attr = bytearray(struct.pack("<III", cur, 0, 0))
+        try:
+            _bpf_inout(BPF_LINK_GET_NEXT_ID, attr)
+        except OSError as exc:
+            if exc.errno == errno.ENOENT:
+                return
+            raise
+        cur = struct.unpack_from("<I", attr, 4)[0]
+        yield cur
+
+
+def find_tcx_link(if_index: int, direction: str,
+                  prog_id: Optional[int] = None) -> Optional[int]:
+    """Open the existing TCX link on (if_index, direction), optionally
+    requiring it to carry a specific program — the adoption path when
+    link_create returns EEXIST (reference: link.QueryPrograms + NewFromID,
+    tracer.go:464-480). Returns a link fd or None."""
+    want = BPF_TCX_INGRESS if direction == "ingress" else BPF_TCX_EGRESS
+    for lid in iter_link_ids():
+        attr = struct.pack("<I", lid)
+        try:
+            fd = _bpf(BPF_LINK_GET_FD_BY_ID, attr)
+        except OSError:
+            continue
+        try:
+            ltype, _lid, pid, ifx, atype = link_info(fd)
+        except OSError:
+            os.close(fd)
+            continue  # unrelated link whose info query fails; keep scanning
+        if (ltype == BPF_LINK_TYPE_TCX and ifx == if_index and atype == want
+                and (prog_id is None or pid == prog_id)):
+            return fd
+        os.close(fd)
+    return None
+
+
 RINGBUF_BUSY_BIT = 0x80000000
 RINGBUF_DISCARD_BIT = 0x40000000
 _RB_HDR_SIZE = 8

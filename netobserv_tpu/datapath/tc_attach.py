@@ -1,24 +1,104 @@
-"""TC/TCX attachment of pinned BPF programs.
+"""TC/TCX attachment of BPF programs.
 
-Reference analog: the attach half of `pkg/tracer/tracer.go` (TCX links with
-legacy TC qdisc/filter fallback, stale cleanup). Programs arrive pinned on
-bpffs (loaded by this process via syscall_bpf.prog_load, by the cmake-built
-object through libbpf, or by an external manager); attachment drives the
-iproute2 `tc` binary — the netlink encoding is deferred until the full
-self-managed loader lands (the CLI path covers both clsact setup and filter
-lifecycle and is what operators can replay by hand).
+Reference analog: the attach half of `pkg/tracer/tracer.go:431-598` — TCX
+bpf_link attachment (with EEXIST adoption of an existing link) and a legacy
+TC clsact/filter path, selected by TC_ATTACH_MODE (tcx | tc | any, reference
+`pkg/agent/interfaces_listener.go:104-113`):
+
+- **tcx**: BPF_LINK_CREATE on the interface's TCX hook (kernel >= 6.6) via
+  raw bpf(2) — link-fd lifetime IS the attachment; no qdisc involved; other
+  TCX programs on the hook keep running (mprog chain).
+- **tc**: clsact qdisc + filter through the iproute2 `tc` binary (the path
+  operators can replay by hand), with stale-filter cleanup between runs.
+- **any**: try tcx, fall back to tc on kernels without TCX.
 """
 
 from __future__ import annotations
 
+import errno
 import logging
+import os
 import subprocess
+from dataclasses import dataclass
 
 log = logging.getLogger("netobserv_tpu.datapath.tc")
 
 
 class TcError(RuntimeError):
     pass
+
+
+@dataclass
+class Attachment:
+    """One live attachment; `kind` is "tcx" (link_fd valid) or "tc"."""
+
+    kind: str
+    if_name: str
+    if_index: int
+    direction: str
+    link_fd: int = -1
+    priority: int = 0
+
+    def detach(self) -> None:
+        if self.kind == "tcx":
+            try:
+                os.close(self.link_fd)  # closing the bpf_link detaches
+            except OSError:
+                pass
+        else:
+            detach(self.if_name, self.direction, self.priority)
+
+
+def attach_tcx(prog_fd: int, if_name: str, if_index: int,
+               direction: str) -> Attachment:
+    """TCX bpf_link attach with EEXIST adoption (reference
+    tracer.go:454-488)."""
+    from netobserv_tpu.datapath import syscall_bpf
+
+    try:
+        fd = syscall_bpf.link_create_tcx(prog_fd, if_index, direction)
+        log.info("TCX link attached to %s %s (link fd %d)", if_name,
+                 direction, fd)
+        return Attachment("tcx", if_name, if_index, direction, link_fd=fd)
+    except OSError as exc:
+        if exc.errno != errno.EEXIST:
+            raise
+        # this exact program is already in the hook's mprog chain (previous
+        # instance / listener retry): adopt the existing link
+        pid = syscall_bpf.prog_id_of(prog_fd)
+        fd = syscall_bpf.find_tcx_link(if_index, direction, prog_id=pid)
+        if fd is None:
+            raise TcError(
+                f"TCX attach to {if_name} {direction} returned EEXIST but "
+                "no matching link found to adopt") from exc
+        log.info("adopted existing TCX link on %s %s (link fd %d)", if_name,
+                 direction, fd)
+        return Attachment("tcx", if_name, if_index, direction, link_fd=fd)
+
+
+def attach_mode(prog_fd: int, pin_path: str, if_name: str, if_index: int,
+                direction: str, mode: str = "tcx", priority: int = 1,
+                pre_legacy=None) -> Attachment:
+    """Attach per TC_ATTACH_MODE: tcx | tc | any (try tcx, fall back).
+
+    `pre_legacy` (optional callable) runs immediately before a legacy tc
+    attach — the hook for once-per-interface stale clsact cleanup. It is NOT
+    invoked when the TCX path succeeds, so third-party clsact state survives
+    on TCX-capable kernels."""
+    if mode not in ("tcx", "tc", "any"):
+        raise ValueError(f"unknown TC_ATTACH_MODE {mode!r}")
+    if mode in ("tcx", "any"):
+        try:
+            return attach_tcx(prog_fd, if_name, if_index, direction)
+        except OSError as exc:
+            if mode == "tcx":
+                raise
+            log.info("TCX unavailable on %s (%s); falling back to legacy tc",
+                     if_name, exc)
+    if pre_legacy is not None:
+        pre_legacy()
+    attach_pinned(if_name, direction, pin_path, priority=priority)
+    return Attachment("tc", if_name, if_index, direction, priority=priority)
 
 
 def _tc(*args: str) -> str:

@@ -52,6 +52,10 @@ def veth():
         subprocess.run(["ip", "netns", "del", NS], capture_output=True)
 
 
+def _ifindex(name):
+    return int(open(f"/sys/class/net/{name}/ifindex").read())
+
+
 def _send_udp(n=8, size=120, dport=5353, pace_s=0.02):
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     s.bind(("10.198.0.1", 44444))
@@ -67,7 +71,7 @@ def test_kernel_flow_capture_and_eviction(veth):
 
     fetcher = MinimalKernelFetcher(cache_max_flows=1024)
     try:
-        fetcher.attach(1, veth, "egress")
+        fetcher.attach(_ifindex(veth), veth, "egress")
         _send_udp(n=8, size=120)
         time.sleep(0.3)
         evicted = fetcher.lookup_and_delete()
@@ -99,6 +103,49 @@ def test_kernel_flow_capture_and_eviction(veth):
                      if int(ev2.events["key"][i]["proto"]) == 6]
         assert tcp_flows, "TCP flow not captured"
         assert int(tcp_flows[0]["tcp_flags"]) & 0x02  # SYN observed
+    finally:
+        fetcher.close()
+
+
+@pytest.mark.parametrize("mode", ["tcx", "tc", "any"])
+def test_attach_modes_capture(veth, mode):
+    """All three TC_ATTACH_MODE values capture traffic; tcx/any produce a
+    bpf_link, tc a legacy filter (reference interfaces_listener.go:104-113)."""
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, attach_mode=mode)
+    try:
+        idx = _ifindex(veth)
+        fetcher.attach(idx, veth, "egress")
+        att = fetcher._attached[idx][1]["egress"]
+        if mode == "any":
+            assert att.kind in ("tcx", "tc")  # fallback is legal pre-6.6
+        else:
+            assert att.kind == mode
+        _send_udp(n=4, size=100, dport=5301)
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        ports = {int(evicted.events["key"][i]["dst_port"])
+                 for i in range(len(evicted))}
+        assert 5301 in ports, f"mode {mode}: flow not captured"
+    finally:
+        fetcher.close()
+
+
+def test_tcx_adopt_on_eexist(veth):
+    """Re-attaching the same program to an occupied TCX hook returns EEXIST;
+    the attacher must adopt the existing link (reference tracer.go:462-488)."""
+    from netobserv_tpu.datapath import tc_attach
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, attach_mode="tcx")
+    try:
+        idx = _ifindex(veth)
+        fetcher.attach(idx, veth, "egress")
+        att2 = tc_attach.attach_tcx(
+            fetcher._prog_fds["egress"], veth, idx, "egress")
+        assert att2.kind == "tcx" and att2.link_fd >= 0
+        att2.detach()
     finally:
         fetcher.close()
 
@@ -140,8 +187,8 @@ def test_multi_interface_no_double_count(veth_bridge):
     br, veth_if = veth_bridge
     fetcher = MinimalKernelFetcher(cache_max_flows=1024)
     try:
-        fetcher.attach(1, br, "egress")
-        fetcher.attach(2, veth_if, "egress")
+        fetcher.attach(_ifindex(br), br, "egress")
+        fetcher.attach(_ifindex(veth_if), veth_if, "egress")
         _send_udp(n=8, size=120)
         time.sleep(0.3)
         evicted = fetcher.lookup_and_delete()
