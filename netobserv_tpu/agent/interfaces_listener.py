@@ -48,7 +48,7 @@ class InterfaceListener:
         self._registerer = Registerer(cfg.preferred_interface_for_mac_prefix)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.attached: set[int] = set()
+        self.attached: set[tuple[str, int]] = set()
 
     def start(self) -> None:
         set_interface_namer(self._registerer.name_for)
@@ -81,8 +81,9 @@ class InterfaceListener:
                 self._attach_with_retry(iface)
             else:
                 try:
-                    self._fetcher.detach(iface.index, iface.name)
-                    self.attached.discard(iface.index)
+                    self._fetcher.detach(iface.index, iface.name,
+                                         netns=iface.netns)
+                    self.attached.discard((iface.netns, iface.index))
                 except Exception as exc:
                     log.debug("detach %s failed: %s", iface.name, exc)
 
@@ -93,9 +94,10 @@ class InterfaceListener:
                 return
             try:
                 self._fetcher.attach(iface.index, iface.name,
-                                     self._cfg.direction)
-                self.attached.add(iface.index)
-                log.info("attached to %s (index %d)", iface.name, iface.index)
+                                     self._cfg.direction, netns=iface.netns)
+                self.attached.add((iface.netns, iface.index))
+                log.info("attached to %s (index %d, netns %r)", iface.name,
+                         iface.index, iface.netns)
                 return
             except DoNotRetryError as exc:
                 log.warning("attach %s failed permanently: %s",

@@ -72,9 +72,11 @@ class FlowFetcher(Protocol):
         DeleteMapsStaleEntries, `pkg/tracer/tracer.go:1188-1216`.)"""
         ...
 
-    def attach(self, if_index: int, if_name: str, direction: str) -> None: ...
+    def attach(self, if_index: int, if_name: str, direction: str,
+               netns: str = "") -> None: ...
 
-    def detach(self, if_index: int, if_name: str) -> None: ...
+    def detach(self, if_index: int, if_name: str,
+               netns: str = "") -> None: ...
 
     def close(self) -> None: ...
 
@@ -142,11 +144,14 @@ class FakeFetcher:
         self.purged_calls = getattr(self, "purged_calls", 0) + 1
         return 0
 
-    def attach(self, if_index: int, if_name: str, direction: str) -> None:
-        self.attached[if_index] = if_name
+    def attach(self, if_index: int, if_name: str, direction: str,
+               netns: str = "") -> None:
+        # keyed like the real fetchers: ifindex values repeat across netns
+        self.attached[(netns, if_index) if netns else if_index] = if_name
 
-    def detach(self, if_index: int, if_name: str) -> None:
-        self.attached.pop(if_index, None)
+    def detach(self, if_index: int, if_name: str,
+               netns: str = "") -> None:
+        self.attached.pop((netns, if_index) if netns else if_index, None)
 
     def close(self) -> None:
         self.closed = True

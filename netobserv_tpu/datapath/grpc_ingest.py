@@ -110,10 +110,12 @@ class GrpcIngestFetcher:
     def purge_stale(self, older_than_s: float) -> int:
         return 0
 
-    def attach(self, if_index: int, if_name: str, direction: str) -> None:
+    def attach(self, if_index: int, if_name: str, direction: str,
+               netns: str = "") -> None:
         pass
 
-    def detach(self, if_index: int, if_name: str) -> None:
+    def detach(self, if_index: int, if_name: str,
+               netns: str = "") -> None:
         pass
 
     def close(self) -> None:

@@ -277,10 +277,12 @@ class BpfmanFetcher:
             log.debug("purged %d stale DNS correlations", purged)
         return purged
 
-    def attach(self, if_index: int, if_name: str, direction: str) -> None:
+    def attach(self, if_index: int, if_name: str, direction: str,
+               netns: str = "") -> None:
         pass  # programs are attached by the external manager
 
-    def detach(self, if_index: int, if_name: str) -> None:
+    def detach(self, if_index: int, if_name: str,
+               netns: str = "") -> None:
         pass
 
     def close(self) -> None:
@@ -370,12 +372,19 @@ class MinimalKernelFetcher(BpfmanFetcher):
         return cls(cache_max_flows=cfg.cache_max_flows,
                    attach_mode=cfg.tc_attach_mode)
 
-    def attach(self, if_index: int, if_name: str, direction: str) -> None:
+    def attach(self, if_index: int, if_name: str, direction: str,
+               netns: str = "") -> None:
+        """Attach inside `netns` when named: the calling thread enters the
+        namespace for the attach syscalls (ifindex resolves there; TCX links
+        and tc subprocesses bind to it) and restores itself after (reference:
+        interfaces_listener.go:272-298 netns-scoped attach)."""
         from netobserv_tpu.datapath import tc_attach
+        from netobserv_tpu.ifaces.netns import netns_context
 
         wanted = (["ingress", "egress"] if direction == "both"
                   else [direction])
-        name, done = self._attached.setdefault(if_index, (if_name, {}))
+        name, done = self._attached.setdefault(
+            (netns, if_index), (if_name, {}))
 
         def stale_cleanup():
             # first legacy attach on this interface: drop stale clsact state
@@ -385,34 +394,62 @@ class MinimalKernelFetcher(BpfmanFetcher):
             if not any(a.kind == "tc" for a in done.values()):
                 tc_attach.remove_clsact(if_name)
 
-        for d in wanted:
-            if d in done:
-                continue  # idempotent across listener retries
-            done[d] = tc_attach.attach_mode(
-                self._prog_fds[d], self._pins[d], if_name, if_index, d,
-                mode=self._mode, pre_legacy=stale_cleanup)
+        with netns_context(netns):
+            for d in wanted:
+                if d in done:
+                    continue  # idempotent across listener retries
+                done[d] = tc_attach.attach_mode(
+                    self._prog_fds[d], self._pins[d], if_name, if_index, d,
+                    mode=self._mode, pre_legacy=stale_cleanup)
 
-    def detach(self, if_index: int, if_name: str) -> None:
-        entry = self._attached.pop(if_index, None)
+    def detach(self, if_index: int, if_name: str,
+               netns: str = "") -> None:
+        from netobserv_tpu.ifaces.netns import netns_context
+
+        entry = self._attached.pop((netns, if_index), None)
         if entry is None:
             return
         name, done = entry
-        for d, att in done.items():
-            try:
-                att.detach()
-            except Exception as exc:
-                log.debug("detach %s %s failed: %s", name, d, exc)
+        # TCX link closes are namespace-agnostic (fd-bound); legacy tc CLI
+        # detaches must run inside the namespace. Enter it separately so a
+        # failed entry/exit never re-runs detach on already-closed link fds.
+        ctx = netns_context(netns)
+        entered = True
+        try:
+            ctx.__enter__()
+        except OSError as exc:
+            entered = False
+            log.debug("cannot enter netns %r to detach %s (%s); tc filters "
+                      "die with the namespace", netns, name, exc)
+        try:
+            for d, att in done.items():
+                if att.kind == "tc" and not entered:
+                    continue  # tc CLI would hit the wrong namespace
+                try:
+                    att.detach()
+                except Exception as exc:
+                    log.debug("detach %s %s failed: %s", name, d, exc)
+        finally:
+            if entered:
+                try:
+                    ctx.__exit__(None, None, None)
+                except OSError as exc:  # pragma: no cover - setns restore
+                    log.warning("failed to restore netns after detach: %s",
+                                exc)
 
     def close(self) -> None:
         from netobserv_tpu.datapath import tc_attach
+        from netobserv_tpu.ifaces.netns import netns_context
 
-        for if_index in list(self._attached):
-            name, dirs = self._attached[if_index]
+        for key in list(self._attached):
+            netns, if_index = key
+            name, dirs = self._attached[key]
             legacy = any(att.kind == "tc" for att in dirs.values())
             try:
-                self.detach(if_index, name)
+                self.detach(if_index, name, netns=netns)
                 if legacy:
-                    tc_attach.remove_clsact(name)
+                    with netns_context(netns):
+                        tc_attach.remove_clsact(name)
             except Exception as exc:
                 log.debug("cleanup of %s failed: %s", name, exc)
         for fd in self._prog_fds.values():

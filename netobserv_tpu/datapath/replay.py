@@ -79,10 +79,12 @@ class SyntheticFetcher:
     def purge_stale(self, older_than_s: float) -> int:
         return 0
 
-    def attach(self, if_index: int, if_name: str, direction: str) -> None:
+    def attach(self, if_index: int, if_name: str, direction: str,
+               netns: str = "") -> None:
         self.attached[if_index] = if_name
 
-    def detach(self, if_index: int, if_name: str) -> None:
+    def detach(self, if_index: int, if_name: str,
+               netns: str = "") -> None:
         self.attached.pop(if_index, None)
 
     def close(self) -> None:
@@ -207,10 +209,12 @@ class PcapReplayFetcher:
     def purge_stale(self, older_than_s: float) -> int:
         return 0
 
-    def attach(self, if_index: int, if_name: str, direction: str) -> None:
+    def attach(self, if_index: int, if_name: str, direction: str,
+               netns: str = "") -> None:
         self.attached[if_index] = if_name
 
-    def detach(self, if_index: int, if_name: str) -> None:
+    def detach(self, if_index: int, if_name: str,
+               netns: str = "") -> None:
         self.attached.pop(if_index, None)
 
     def close(self) -> None:

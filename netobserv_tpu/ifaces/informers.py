@@ -97,14 +97,18 @@ class Poller(_InformerBase):
 
 
 class Watcher(_InformerBase):
-    """netlink link-event subscription with an initial dump; also notices new
-    network namespaces appearing under /var/run/netns (LISTEN_INTERFACES=watch).
+    """netlink link-event subscription with an initial dump; namespaces
+    appearing under /var/run/netns are ENTERED (setns): their links are
+    enumerated and a per-namespace netlink subscription keeps following them
+    (LISTEN_INTERFACES=watch; reference pkg/ifaces/watcher.go:57-271).
     """
 
     def __init__(self, netns_dir: str = NETNS_DIR, **kw):
         super().__init__(**kw)
         self._netns_dir = netns_dir
-        self._known_netns: set[str] = set()
+        # netns name -> its subscription socket (None when entry failed —
+        # e.g. no CAP_SYS_ADMIN — and only the namespace's existence is known)
+        self._netns_socks: dict[str, Optional[object]] = {}
 
     def _loop(self) -> None:
         try:
@@ -116,36 +120,79 @@ class Watcher(_InformerBase):
             return
         try:
             self._emit_current(netlink.dump_links())
+            self._check_netns()
             while not self._stop.is_set():
                 for link in netlink.read_link_events(sock):
-                    self._handle_event(link)
+                    self._handle_event(link, "")
+                for name, ns_sock in list(self._netns_socks.items()):
+                    if ns_sock is None:
+                        continue
+                    try:
+                        for link in netlink.read_link_events(ns_sock):
+                            self._handle_event(link, name)
+                    except OSError:
+                        pass
                 self._check_netns()
         finally:
             sock.close()
+            for ns_sock in self._netns_socks.values():
+                if ns_sock is not None:
+                    ns_sock.close()
 
-    def _handle_event(self, link: netlink.LinkInfo) -> None:
-        key = ("", link.index)
+    def _handle_event(self, link: netlink.LinkInfo, netns: str) -> None:
+        key = (netns, link.index)
         if link.change_type == netlink.RTM_DELLINK or not link.up:
             iface = self._known.pop(key, None)
             if iface is not None:
                 self.events.put(Event(EventType.REMOVED, iface))
         else:
-            iface = Interface(link.index, link.name, link.mac, "")
+            iface = Interface(link.index, link.name, link.mac, netns)
             if key not in self._known:
                 self._known[key] = iface
                 self.events.put(Event(EventType.ADDED, iface))
 
     def _check_netns(self) -> None:
-        """Lightweight namespace discovery: list /var/run/netns for additions.
-        (Entering the namespace to enumerate its links needs setns/CAP_SYS_ADMIN
-        and lands with the kernel loader.)"""
+        """Follow /var/run/netns: enter each new namespace to enumerate its
+        links and subscribe to its events; on namespace removal, emit REMOVED
+        for its interfaces and drop the subscription."""
+        from netobserv_tpu.ifaces import netns as nsmod
+
         try:
             names = set(os.listdir(self._netns_dir))
         except OSError:
-            return
-        for name in names - self._known_netns:
-            log.info("new network namespace observed: %s", name)
-        self._known_netns = names
+            names = set()
+        for name in names - set(self._netns_socks):
+            try:
+                ns_sock = nsmod.subscribe_links_in(name, self._netns_dir)
+            except OSError as exc:
+                # cannot enter (e.g. no CAP_SYS_ADMIN): permanent — remember
+                # the namespace so this doesn't retry/log every iteration
+                log.warning("cannot enter netns %s (%s); observing only",
+                            name, exc)
+                self._netns_socks[name] = None
+                continue
+            try:
+                links = nsmod.links_in(name, self._netns_dir)
+            except OSError as exc:
+                # transient (namespace raced away / netlink error): drop the
+                # socket and leave the name unknown so the next cycle retries
+                log.debug("netns %s link dump failed (%s); will retry",
+                          name, exc)
+                ns_sock.close()
+                continue
+            # drain events with a short poll so the watcher loop's cadence
+            # stays driven by the default-namespace socket
+            ns_sock.settimeout(0.01)
+            self._emit_current(links, netns=name)
+            log.info("watching network namespace %s (%d links)", name,
+                     len(links))
+            self._netns_socks[name] = ns_sock
+        for name in set(self._netns_socks) - names:
+            ns_sock = self._netns_socks.pop(name)
+            if ns_sock is not None:
+                ns_sock.close()
+            self._emit_current([], netns=name)
+            log.info("network namespace %s removed", name)
 
     def _poll_fallback(self) -> None:
         while not self._stop.is_set():

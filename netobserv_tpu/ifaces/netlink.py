@@ -143,7 +143,9 @@ def dump_addrs() -> list[tuple[int, bytes]]:
 def subscribe_links() -> socket.socket:
     """Socket subscribed to link add/remove events (RTMGRP_LINK)."""
     sock = socket.socket(socket.AF_NETLINK, socket.SOCK_RAW, NETLINK_ROUTE)
-    sock.bind((os.getpid() & 0x7FFFFFFF, RTMGRP_LINK))
+    # port id 0: the kernel assigns a unique id, so several subscription
+    # sockets (one per watched namespace) can coexist in one process
+    sock.bind((0, RTMGRP_LINK))
     sock.settimeout(0.5)
     return sock
 

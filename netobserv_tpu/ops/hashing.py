@@ -13,13 +13,17 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
-_M5 = jnp.uint32(5)
-_N1 = jnp.uint32(0xE6546B64)
-_F1 = jnp.uint32(0x85EBCA6B)
-_F2 = jnp.uint32(0xC2B2AE35)
+# numpy scalars, NOT jnp: module-level jnp constants would initialize the XLA
+# backend at import time, which breaks jax.distributed.initialize() for any
+# process that imports this package before multi-host bootstrap
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+_M5 = np.uint32(5)
+_N1 = np.uint32(0xE6546B64)
+_F1 = np.uint32(0x85EBCA6B)
+_F2 = np.uint32(0xC2B2AE35)
 
 
 def _rotl32(x: jax.Array, r: int) -> jax.Array:

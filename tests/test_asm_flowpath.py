@@ -117,7 +117,7 @@ def test_attach_modes_capture(veth, mode):
     try:
         idx = _ifindex(veth)
         fetcher.attach(idx, veth, "egress")
-        att = fetcher._attached[idx][1]["egress"]
+        att = fetcher._attached[("", idx)][1]["egress"]
         if mode == "any":
             assert att.kind in ("tcx", "tc")  # fallback is legal pre-6.6
         else:
@@ -148,6 +148,60 @@ def test_tcx_adopt_on_eexist(veth):
         att2.detach()
     finally:
         fetcher.close()
+
+
+def test_netns_attach_and_capture(veth):
+    """Attach to an interface INSIDE a named network namespace (the listener
+    thread setns-enters it for the attach syscalls) and capture traffic
+    arriving there (reference watcher.go netns handling +
+    interfaces_listener.go:272-298)."""
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, attach_mode="tcx")
+    try:
+        out = _run("ip", "netns", "exec", NS, "cat",
+                   "/sys/class/net/nf1/ifindex")
+        idx = int(out.stdout)
+        fetcher.attach(idx, "nf1", "ingress", netns=NS)
+        att = fetcher._attached[(NS, idx)][1]["ingress"]
+        assert att.kind == "tcx"
+        _send_udp(n=6, size=90, dport=5302)
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        flows = {int(evicted.events["key"][i]["dst_port"]):
+                 evicted.events["stats"][i] for i in range(len(evicted))}
+        assert 5302 in flows, f"ports seen: {sorted(flows)}"
+        st = flows[5302]
+        assert int(st["packets"]) == 6
+        assert int(st["direction_first"]) == 0  # the ingress instance fired
+        fetcher.detach(idx, "nf1", netns=NS)
+    finally:
+        fetcher.close()
+
+
+def test_watcher_discovers_netns_interfaces(veth):
+    """The Watcher enters namespaces under /var/run/netns and emits ADDED
+    events for their links, tagged with the namespace name."""
+    from netobserv_tpu.ifaces.informers import EventType, Watcher
+
+    w = Watcher()
+    events = w.subscribe()
+    try:
+        seen = {}
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                ev = events.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if ev.type == EventType.ADDED and ev.interface.netns == NS:
+                seen[ev.interface.name] = ev.interface
+                if "nf1" in seen:
+                    break
+        assert "nf1" in seen, f"netns interfaces seen: {sorted(seen)}"
+        assert seen["nf1"].index > 0
+    finally:
+        w.stop()
 
 
 @pytest.fixture

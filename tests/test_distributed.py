@@ -1,0 +1,48 @@
+"""Multi-host bootstrap test: two REAL processes wired by jax.distributed
+(gloo CPU collectives), running the sharded sketch ingest + window merge over
+a mesh that spans both processes (parallel/distributed.py +
+parallel/merge.py). The closest CPU analog of a 2-host TPU pod slice."""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+WORKER = Path(__file__).with_name("distributed_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed_ingest_and_merge():
+    port = _free_port()
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = []
+    for pid in range(2):
+        env = dict(env_base,
+                   SKETCH_COORDINATOR=f"127.0.0.1:{port}",
+                   SKETCH_NUM_PROCESSES="2",
+                   SKETCH_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(WORKER)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:  # a hung worker must not outlive the test
+            if p.returncode is None:
+                p.kill()
+                p.wait()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert "DIST_OK" in out, f"process {pid} missing DIST_OK:\n{out}"

@@ -132,11 +132,11 @@ class TestListener:
         calls = []
         orig = fake.attach
 
-        def flaky(idx, name, direction):
+        def flaky(idx, name, direction, netns=""):
             calls.append(name)
             if len(calls) < 3:
                 raise OSError("transient")
-            orig(idx, name, direction)
+            orig(idx, name, direction, netns=netns)
 
         fake.attach = flaky
         listener = self._run(
@@ -155,7 +155,7 @@ class TestListener:
         fake = FakeFetcher()
         calls = []
 
-        def always_fail(idx, name, direction):
+        def always_fail(idx, name, direction, netns=""):
             calls.append(name)
             raise DoNotRetryError("unsupported kernel")
 
