@@ -57,6 +57,13 @@ class FlowsAgent:
         if cfg.enable_udn_mapping:
             from netobserv_tpu.ifaces.udn import UdnMapper
             udn_mapper = UdnMapper()
+        self._ovn_decoder = None
+        if cfg.enable_network_events_monitoring:
+            # install the OVN sample decoder (ovsdb-backed when the OVN
+            # socket exists, static otherwise; reference agent.go:136-147)
+            from netobserv_tpu.utils import ovn_decoder
+            self._ovn_decoder = ovn_decoder.make_decoder(cfg)
+            ovn_decoder.set_decoder(self._ovn_decoder)
         self.map_tracer = MapTracer(
             fetcher, self._evicted_q,
             active_timeout_s=cfg.cache_active_timeout, agent_ip=agent_ip,
@@ -174,6 +181,11 @@ class FlowsAgent:
         self.limiter.stop()
         self.terminal.stop()
         self.fetcher.close()
+        if self._ovn_decoder is not None:
+            from netobserv_tpu.utils import ovn_decoder
+            self._ovn_decoder.close()
+            ovn_decoder.set_decoder(None)  # drop this agent's global install
+            self._ovn_decoder = None
         self._set_status(Status.STOPPED)
 
 

@@ -7,23 +7,12 @@ exporter and by the Kafka JSON option.
 
 from __future__ import annotations
 
+from netobserv_tpu.exporter.flp_tables import (
+    dns_rcode_to_str, pkt_drop_cause_to_str, tcp_state_to_str,
+)
 from netobserv_tpu.model.flow import ip_from_16
 from netobserv_tpu.model.record import Record
 from netobserv_tpu.model import tls_types
-
-# drop-cause/state naming subsets (full tables in the reference's decode layer)
-TCP_STATES = {
-    1: "TCP_ESTABLISHED", 2: "TCP_SYN_SENT", 3: "TCP_SYN_RECV",
-    4: "TCP_FIN_WAIT1", 5: "TCP_FIN_WAIT2", 6: "TCP_TIME_WAIT",
-    7: "TCP_CLOSE", 8: "TCP_CLOSE_WAIT", 9: "TCP_LAST_ACK",
-    10: "TCP_LISTEN", 11: "TCP_CLOSING", 12: "TCP_NEW_SYN_RECV",
-}
-
-DNS_RCODES = {
-    0: "NoError", 1: "FormErr", 2: "ServFail", 3: "NXDomain", 4: "NotImp",
-    5: "Refused", 6: "YXDomain", 7: "YXRRSet", 8: "NXRRSet", 9: "NotAuth",
-    10: "NotZone",
-}
 
 
 def _mac(raw: bytes) -> str:
@@ -69,15 +58,14 @@ def record_to_map(r: Record) -> dict:
         out["PktDropBytes"] = f.drop_bytes
         out["PktDropPackets"] = f.drop_packets
         out["PktDropLatestFlags"] = f.drop_latest_flags
-        out["PktDropLatestState"] = TCP_STATES.get(
-            f.drop_latest_state, str(f.drop_latest_state))
-        out["PktDropLatestDropCause"] = f.drop_latest_cause
+        out["PktDropLatestState"] = tcp_state_to_str(f.drop_latest_state)
+        out["PktDropLatestDropCause"] = pkt_drop_cause_to_str(
+            f.drop_latest_cause)
     if f.dns_id or f.dns_latency_ns or f.dns_errno:
         out["DnsId"] = f.dns_id
         out["DnsFlags"] = f.dns_flags
         out["DnsErrno"] = f.dns_errno
-        out["DnsFlagsResponseCode"] = DNS_RCODES.get(
-            f.dns_flags & 0xF, str(f.dns_flags & 0xF))
+        out["DnsFlagsResponseCode"] = dns_rcode_to_str(f.dns_flags & 0xF)
         if f.dns_latency_ns:
             out["DnsLatencyMs"] = f.dns_latency_ns // 1_000_000
         if f.dns_name:
@@ -85,8 +73,8 @@ def record_to_map(r: Record) -> dict:
     if f.rtt_ns:
         out["TimeFlowRttNs"] = f.rtt_ns
     if f.network_events:
-        from netobserv_tpu.utils.networkevents import decode_cookie
-        out["NetworkEvents"] = [decode_cookie(ev) for ev in f.network_events]
+        from netobserv_tpu.utils.ovn_decoder import decode_event
+        out["NetworkEvents"] = [decode_event(ev) for ev in f.network_events]
     if f.xlat_src_ip:
         out["XlatSrcAddr"] = ip_from_16(f.xlat_src_ip)
         out["XlatDstAddr"] = ip_from_16(f.xlat_dst_ip)

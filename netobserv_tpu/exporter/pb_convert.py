@@ -78,10 +78,10 @@ def record_to_pb(r: Record) -> flow_pb2.Record:
         pb.dns_name = f.dns_name
     if f.rtt_ns:
         pb.time_flow_rtt.FromNanoseconds(f.rtt_ns)
-    from netobserv_tpu.utils.networkevents import decode_cookie
+    from netobserv_tpu.utils.ovn_decoder import decode_event
     for ev in f.network_events:
         ne = pb.network_events_metadata.add()
-        for key, val in decode_cookie(ev).items():
+        for key, val in decode_event(ev).items():
             ne.events[key] = val
     if f.xlat_src_ip:
         _set_ip(pb.xlat.src_addr, f.xlat_src_ip)

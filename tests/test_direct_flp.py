@@ -77,3 +77,82 @@ parameters:
 """
     out = _run(cfg, [make_record(nbytes=777)])
     assert out[0] == {"octets": 777, "packets": 7}
+
+
+# ---------------------------------------------------------------------------
+# string-table parity vs the reference decode layer (parsed from its source)
+# ---------------------------------------------------------------------------
+
+import os
+import re
+
+import pytest
+
+from netobserv_tpu.exporter import flp_tables
+
+_REF_DECODE = "/root/reference/pkg/decode/decode_protobuf.go"
+_REF_NEVENTS = "/root/reference/pkg/utils/networkevents/network_events.go"
+
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(_REF_DECODE), reason="reference source unavailable")
+
+
+def _parse_switch_cases(src: str, func: str) -> dict:
+    """Extract {case-expression: string} from a Go switch-based mapper."""
+    body = src.split(f"func {func}(")[1]
+    body = body.split("\nfunc ")[0]
+    return dict(re.findall(r'case ([^:]+):\s*\n\s*return "([^"]+)"', body))
+
+
+@needs_reference
+def test_tcp_state_table_matches_reference():
+    src = open(_REF_DECODE).read()
+    cases = _parse_switch_cases(src, "TCPStateToStr")
+    expected = {int(k): v for k, v in cases.items()}
+    assert flp_tables.TCP_STATES == expected
+    assert flp_tables.tcp_state_to_str(99) == "TCP_INVALID_STATE"
+
+
+@needs_reference
+def test_dns_rcode_table_matches_reference():
+    src = open(_REF_DECODE).read()
+    cases = _parse_switch_cases(src, "DNSRcodeToStr")
+    expected = {int(k): v for k, v in cases.items()}
+    assert flp_tables.DNS_RCODES == expected
+    assert flp_tables.dns_rcode_to_str(30) == "UnDefined"
+
+
+@needs_reference
+def test_drop_cause_table_matches_reference():
+    src = open(_REF_DECODE).read()
+    cases = _parse_switch_cases(src, "PktDropCauseToStr")
+    expected = {}
+    for expr, name in cases.items():
+        base, _, off = expr.partition("+")
+        base = base.strip()
+        off = int(off.strip())
+        if base == "skbDropReasonSubSysCore":
+            expected[flp_tables.SKB_DROP_SUBSYS_CORE + off] = name
+        elif base == "skbDropReasonSubSysOpenVSwitch":
+            expected[flp_tables.SKB_DROP_SUBSYS_OVS + off] = name
+        else:
+            raise AssertionError(f"unknown subsystem {base}")
+    assert flp_tables.DROP_CAUSES == expected
+    for code, name in expected.items():
+        assert flp_tables.pkt_drop_cause_to_str(code) == name
+    assert flp_tables.pkt_drop_cause_to_str(12345678) == \
+        "SKB_DROP_UNKNOWN_CAUSE"
+
+
+@needs_reference
+def test_ovn_event_causes_match_reference():
+    src = open(_REF_NEVENTS).read()
+    block = src.split("causes = []string{")[1].split("}")[0]
+    expected = re.findall(r'"([^"]+)"', block)
+    assert flp_tables.OVN_EVENT_CAUSES == expected
+    shift = int(re.search(
+        r"customDropReasonSubSysOVNEvents = \(1 << (\d+)\)", src).group(1))
+    assert flp_tables.OVN_EVENTS_SUBSYS == 1 << shift
+    # the injected names render with the NetworkEvent_ prefix
+    assert flp_tables.pkt_drop_cause_to_str(
+        flp_tables.OVN_EVENTS_SUBSYS + 4) == "NetworkEvent_NetworkPolicy"

@@ -22,3 +22,106 @@ def test_allow_egress():
     assert out["Type"] == "lb"
     assert out["Direction"] == "egress"
     assert not is_drop_event(cookie)
+
+
+# ---------------------------------------------------------------------------
+# pluggable OVN sample decoders (utils/ovn_decoder.py)
+# ---------------------------------------------------------------------------
+
+import json
+import os
+import socket
+import socketserver
+import tempfile
+import threading
+
+from netobserv_tpu.utils import ovn_decoder
+
+
+def make_cookie(action=1, actor=0, direction=1, obj_id=7):
+    return bytes([1, action, actor, direction]) + obj_id.to_bytes(4, "little")
+
+
+class _FakeOvsdb(socketserver.ThreadingUnixStreamServer):
+    """Minimal OVSDB JSON-RPC fake: answers `transact` select on ACL."""
+
+    daemon_threads = True  # handler blocks in recv; don't join it on close
+    rows = {7: {"name": "allow-dns", "action": "drop", "direction": "egress",
+                "external_ids": ["map", [["k8s.ovn.org/namespace", "prod"]]]}}
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            buf = b""
+            dec = json.JSONDecoder()
+            while True:
+                try:
+                    chunk = self.request.recv(65536)
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                try:
+                    obj, end = dec.raw_decode(buf.decode())
+                except ValueError:
+                    continue
+                buf = buf[end:]
+                sel = obj["params"][1]
+                obj_id = sel["where"][0][2]
+                row = _FakeOvsdb.rows.get(obj_id)
+                result = [{"rows": [row] if row else []}]
+                self.request.sendall(json.dumps(
+                    {"id": obj["id"], "result": result,
+                     "error": None}).encode())
+
+
+def test_ovsdb_decoder_enriches_from_socket():
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "ovnnb.sock")
+    srv = _FakeOvsdb(path, _FakeOvsdb.Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        dec = ovn_decoder.OvsdbSampleDecoder(sock_path=path)
+        out = dec.decode(make_cookie(obj_id=7))
+        assert out["Name"] == "allow-dns"
+        assert out["Action"] == "drop"
+        assert out["Namespace"] == "prod"
+        assert out["Feature"] == "acl"
+        # unknown id: static fields survive untouched
+        out2 = dec.decode(make_cookie(obj_id=99))
+        assert out2["Name"] == "99"
+        # cache: kill the server; the known id still resolves
+        srv.shutdown()
+        srv.server_close()
+        out3 = dec.decode(make_cookie(obj_id=7))
+        assert out3["Name"] == "allow-dns"
+        dec.close()
+    finally:
+        try:
+            srv.shutdown()
+        except Exception:
+            pass
+
+
+def test_ovsdb_decoder_degrades_without_socket():
+    dec = ovn_decoder.OvsdbSampleDecoder(sock_path="/nonexistent/ovn.sock")
+    out = dec.decode(make_cookie(obj_id=3))
+    assert out["Name"] == "3"  # static decode survived the socket failure
+    assert out["Action"] == "drop"
+
+
+def test_active_decoder_is_pluggable():
+    class Custom:
+        def decode(self, cookie):
+            return {"Message": "custom"}
+
+        def close(self):
+            pass
+
+    try:
+        ovn_decoder.set_decoder(Custom())
+        assert ovn_decoder.decode_event(b"\x01\x01")["Message"] == "custom"
+    finally:
+        ovn_decoder.set_decoder(None)
+    assert "Message" not in ovn_decoder.decode_event(make_cookie())
