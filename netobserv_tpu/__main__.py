@@ -46,8 +46,10 @@ def main() -> int:
                 from netobserv_tpu.datapath.replay import PcapPacketFetcher
                 pkt_fetcher = PcapPacketFetcher(mode[5:])
             else:
-                from netobserv_tpu.datapath.loader import KernelFetcher
-                pkt_fetcher = KernelFetcher.load(cfg)
+                # self-managed kernel capture: hand-assembled PCA program,
+                # verifier-loaded, no compiler required
+                from netobserv_tpu.datapath.loader import MinimalPacketFetcher
+                pkt_fetcher = MinimalPacketFetcher.load(cfg)
             agent = PacketsAgent(cfg, pkt_fetcher)
         else:
             agent = FlowsAgent.from_config(cfg)

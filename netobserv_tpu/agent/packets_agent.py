@@ -58,11 +58,21 @@ class PacketsAgent:
                                  timeout_s=min(cfg.cache_active_timeout, 0.5))
         self._stop = threading.Event()
         self._export_thread: Optional[threading.Thread] = None
+        # kernel-backed packet fetchers attach per-interface like the flow
+        # datapath; replay/fake fetchers skip discovery
+        self.iface_listener = None
+        if getattr(fetcher, "needs_iface_discovery", False):
+            from netobserv_tpu.agent.interfaces_listener import (
+                InterfaceListener,
+            )
+            self.iface_listener = InterfaceListener(cfg, fetcher)
 
     def run(self, stop: Optional[threading.Event] = None) -> None:
         self._export_thread = threading.Thread(
             target=self._export_loop, name="packet-export", daemon=True)
         self._export_thread.start()
+        if self.iface_listener is not None:
+            self.iface_listener.start()
         self.buffer.start()
         self.tracer.start()
         self._active_stop = stop = stop or self._stop
@@ -76,6 +86,8 @@ class PacketsAgent:
             active.set()
 
     def shutdown(self) -> None:
+        if self.iface_listener is not None:
+            self.iface_listener.stop()
         self.tracer.stop()
         self.buffer.stop()
         self._stop.set()

@@ -300,77 +300,13 @@ class BpfmanFetcher:
             dns.close()
 
 
-class MinimalKernelFetcher(BpfmanFetcher):
-    """Self-managed kernel datapath from the hand-assembled minimal flow
-    program (datapath/asm_flowpath.py): creates the aggregation map, loads one
-    program per direction through the live verifier, attaches/detaches
-    interfaces via TC, and evicts with the same syscall drain as bpfman mode.
-
-    The full-featured path (all trackers, filters, sampling) still requires
-    the clang-built object; this fetcher provides real IPv4 TCP/UDP flow
-    capture wherever the agent has CAP_BPF+CAP_NET_ADMIN and no compiler.
-    """
-
-    needs_iface_discovery = True
-    _PIN_PREFIX = "/sys/fs/bpf/netobserv_minflow_"
-
-    def __init__(self, cache_max_flows: int = 5000,
-                 attach_mode: str = "tcx"):
-        from netobserv_tpu.datapath import asm_flowpath
-
-        self._init_empty_maps()
-        self._sweep_stale_pins()
-        self._mode = attach_mode
-        BPF_MAP_TYPE_HASH = 1
-        self._agg = syscall_bpf.BpfMap.create(
-            BPF_MAP_TYPE_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
-            binfmt.FLOW_STATS_DTYPE.itemsize, cache_max_flows, b"agg_flows")
-        # one program instance per direction so direction_first is correct
-        self._prog_fds: dict[str, int] = {}
-        self._pins: dict[str, str] = {}
-        for name, code in (("ingress", 0), ("egress", 1)):
-            fd = syscall_bpf.prog_load(
-                asm_flowpath.build_flow_program(self._agg.fd, direction=code))
-            pin = f"{self._PIN_PREFIX}{os.getpid()}_{name}"
-            if os.path.exists(pin):
-                os.unlink(pin)
-            syscall_bpf.obj_pin(fd, pin)
-            self._prog_fds[name] = fd
-            self._pins[name] = pin
-        # if_index -> (if_name, direction -> live Attachment)
-        self._attached: dict[int, tuple[str, dict]] = {}
-
-    def _init_empty_maps(self) -> None:
-        """The inherited eviction path expects these BpfmanFetcher fields."""
-        self._n_cpus = syscall_bpf.n_possible_cpus()
-        self._base = ""
-        self._features = {}
-        self._counters = None
-        self._ringbuf = None
-        self._ssl_rb = None
-
-    def _sweep_stale_pins(self) -> None:
-        """Unpin leftovers from crashed runs (their TC filters die with the
-        clsact qdisc, which attach() resets per interface)."""
-        import glob
-
-        for path in glob.glob(self._PIN_PREFIX + "*"):
-            try:
-                os.unlink(path)
-                log.info("removed stale program pin %s", path)
-            except OSError:
-                pass
-
-    @classmethod
-    def load(cls, cfg: AgentConfig) -> "MinimalKernelFetcher":
-        import shutil
-
-        if os.geteuid() != 0:
-            raise RuntimeError("kernel datapath requires root/CAP_BPF")
-        if cfg.tc_attach_mode != "tcx" and shutil.which("tc") is None:
-            raise RuntimeError("tc (iproute2) not found; cannot attach")
-        return cls(cache_max_flows=cfg.cache_max_flows,
-                   attach_mode=cfg.tc_attach_mode)
+class _SelfManagedAttach:
+    """TC/TCX attach lifecycle shared by the self-managed fetchers (flow +
+    PCA): per-direction pinned programs, tcx/tc/any mode dispatch, netns
+    entry, stale legacy cleanup, and full detach on close. Users provide
+    `self._prog_fds`/`self._pins` (direction -> fd / pin path) and
+    `self._mode`; `self._attached` maps (netns, if_index) -> (name, dir ->
+    Attachment)."""
 
     def attach(self, if_index: int, if_name: str, direction: str,
                netns: str = "") -> None:
@@ -437,7 +373,20 @@ class MinimalKernelFetcher(BpfmanFetcher):
                     log.warning("failed to restore netns after detach: %s",
                                 exc)
 
-    def close(self) -> None:
+    def _sweep_stale_pins(self) -> None:
+        """Unpin leftovers from crashed runs (their TC filters die with the
+        clsact qdisc, which attach() resets per interface; TCX links die with
+        their fds at process exit — only the pins linger)."""
+        import glob
+
+        for path in glob.glob(self._PIN_PREFIX + "*"):
+            try:
+                os.unlink(path)
+                log.info("removed stale program pin %s", path)
+            except OSError:
+                pass
+
+    def _teardown_attachments(self) -> None:
         from netobserv_tpu.datapath import tc_attach
         from netobserv_tpu.ifaces.netns import netns_context
 
@@ -452,12 +401,132 @@ class MinimalKernelFetcher(BpfmanFetcher):
                         tc_attach.remove_clsact(name)
             except Exception as exc:
                 log.debug("cleanup of %s failed: %s", name, exc)
-        for fd in self._prog_fds.values():
+        for fd in set(self._prog_fds.values()):
             try:
                 os.close(fd)
             except OSError:
                 pass
-        for pin in self._pins.values():
+        for pin in set(self._pins.values()):
             if os.path.exists(pin):
                 os.unlink(pin)
+
+
+class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
+    """Self-managed kernel datapath from the hand-assembled minimal flow
+    program (datapath/asm_flowpath.py): creates the aggregation map, loads one
+    program per direction through the live verifier, attaches/detaches
+    interfaces via TC, and evicts with the same syscall drain as bpfman mode.
+
+    The full-featured path (all trackers, filters, sampling) still requires
+    the clang-built object; this fetcher provides real IPv4 TCP/UDP flow
+    capture wherever the agent has CAP_BPF+CAP_NET_ADMIN and no compiler.
+    """
+
+    needs_iface_discovery = True
+    _PIN_PREFIX = "/sys/fs/bpf/netobserv_minflow_"
+
+    def __init__(self, cache_max_flows: int = 5000,
+                 attach_mode: str = "tcx"):
+        from netobserv_tpu.datapath import asm_flowpath
+
+        self._init_empty_maps()
+        self._sweep_stale_pins()
+        self._mode = attach_mode
+        BPF_MAP_TYPE_HASH = 1
+        self._agg = syscall_bpf.BpfMap.create(
+            BPF_MAP_TYPE_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
+            binfmt.FLOW_STATS_DTYPE.itemsize, cache_max_flows, b"agg_flows")
+        # one program instance per direction so direction_first is correct
+        self._prog_fds: dict[str, int] = {}
+        self._pins: dict[str, str] = {}
+        for name, code in (("ingress", 0), ("egress", 1)):
+            fd = syscall_bpf.prog_load(
+                asm_flowpath.build_flow_program(self._agg.fd, direction=code))
+            pin = f"{self._PIN_PREFIX}{os.getpid()}_{name}"
+            if os.path.exists(pin):
+                os.unlink(pin)
+            syscall_bpf.obj_pin(fd, pin)
+            self._prog_fds[name] = fd
+            self._pins[name] = pin
+        # (netns, if_index) -> (if_name, direction -> live Attachment)
+        self._attached: dict[tuple[str, int], tuple[str, dict]] = {}
+
+    def _init_empty_maps(self) -> None:
+        """The inherited eviction path expects these BpfmanFetcher fields."""
+        self._n_cpus = syscall_bpf.n_possible_cpus()
+        self._base = ""
+        self._features = {}
+        self._counters = None
+        self._ringbuf = None
+        self._ssl_rb = None
+
+    @classmethod
+    def load(cls, cfg: AgentConfig) -> "MinimalKernelFetcher":
+        import shutil
+
+        if os.geteuid() != 0:
+            raise RuntimeError("kernel datapath requires root/CAP_BPF")
+        if cfg.tc_attach_mode != "tcx" and shutil.which("tc") is None:
+            raise RuntimeError("tc (iproute2) not found; cannot attach")
+        return cls(cache_max_flows=cfg.cache_max_flows,
+                   attach_mode=cfg.tc_attach_mode)
+
+    def close(self) -> None:
+        self._teardown_attachments()
         self._agg.close()
+
+
+class MinimalPacketFetcher(_SelfManagedAttach):
+    """Self-managed PCA datapath from the hand-assembled capture program
+    (datapath/asm_pca.py): creates the packet_records ring buffer, loads the
+    program through the live verifier, attaches via TCX/tc, and serves raw
+    `no_packet_event` records to PerfTracer through the mmap ring reader —
+    the compiler-free analog of the reference's PCA fetcher
+    (pkg/tracer/tracer.go:1552-2076)."""
+
+    needs_iface_discovery = True
+    _PIN_PREFIX = "/sys/fs/bpf/netobserv_minpca_"
+
+    def __init__(self, ring_bytes: int = 1 << 21, attach_mode: str = "tcx",
+                 sampling: int = 0):
+        from netobserv_tpu.datapath import asm_pca
+
+        self._mode = attach_mode
+        self._sweep_stale_pins()
+        BPF_MAP_TYPE_RINGBUF = 27
+        self._rb_map = syscall_bpf.BpfMap.create(
+            BPF_MAP_TYPE_RINGBUF, 0, 0, ring_bytes, b"pkt_records")
+        fd = syscall_bpf.prog_load(
+            asm_pca.build_pca_program(self._rb_map.fd, sampling=sampling),
+            name=b"netobserv_pca")
+        pin = f"{self._PIN_PREFIX}{os.getpid()}"
+        if os.path.exists(pin):
+            os.unlink(pin)
+        syscall_bpf.obj_pin(fd, pin)
+        # one program serves both hooks (the record carries no direction)
+        self._prog_fds = {"ingress": fd, "egress": fd}
+        self._pins = {"ingress": pin, "egress": pin}
+        self._attached: dict[tuple[str, int], tuple[str, dict]] = {}
+        self._reader = syscall_bpf.RingBufReader(self._rb_map)
+
+    @classmethod
+    def load(cls, cfg: AgentConfig) -> "MinimalPacketFetcher":
+        import shutil
+
+        if os.geteuid() != 0:
+            raise RuntimeError("kernel datapath requires root/CAP_BPF")
+        if cfg.tc_attach_mode != "tcx" and shutil.which("tc") is None:
+            raise RuntimeError("tc (iproute2) not found; cannot attach")
+        if cfg.flow_filter_rules:
+            log.warning("FLOW_FILTER_RULES are not applied by the "
+                        "hand-assembled PCA program (clang-built pca.h "
+                        "required for in-kernel packet filtering)")
+        return cls(attach_mode=cfg.tc_attach_mode, sampling=cfg.sampling)
+
+    def read_packet(self, timeout_s: float):
+        return self._reader.read(timeout_s)
+
+    def close(self) -> None:
+        self._teardown_attachments()
+        self._reader.close()
+        self._rb_map.close()
