@@ -64,6 +64,18 @@ class FlowsAgent:
             from netobserv_tpu.utils import ovn_decoder
             self._ovn_decoder = ovn_decoder.make_decoder(cfg)
             ovn_decoder.set_decoder(self._ovn_decoder)
+        columnar = getattr(exporter, "supports_columnar", False)
+        self.ssl_correlator = None
+        if cfg.enable_openssl_tracking and hasattr(fetcher, "read_ssl"):
+            if columnar:
+                # _attach_features never runs on the columnar fast path, so
+                # credits would accumulate forever and never export
+                log.warning("SSL plaintext correlation is a no-op on the "
+                            "columnar fast path (records are never "
+                            "materialized)")
+            else:
+                from netobserv_tpu.flow.ssl_correlator import SSLCorrelator
+                self.ssl_correlator = SSLCorrelator()
         self.map_tracer = MapTracer(
             fetcher, self._evicted_q,
             active_timeout_s=cfg.cache_active_timeout, agent_ip=agent_ip,
@@ -71,9 +83,10 @@ class FlowsAgent:
             stale_purge_s=cfg.stale_entries_evict_timeout,
             # columnar fast path: exporters that consume raw evictions skip
             # per-record Python object materialization entirely
-            columnar=getattr(exporter, "supports_columnar", False),
+            columnar=columnar,
             udn_mapper=udn_mapper,
-            force_gc=cfg.force_garbage_collection)
+            force_gc=cfg.force_garbage_collection,
+            ssl_correlator=self.ssl_correlator)
         self.limiter = CapacityLimiter(
             self._evicted_q, self._export_q, metrics=self.metrics)
         self.terminal = QueueExporter(
@@ -83,11 +96,16 @@ class FlowsAgent:
         if cfg.enable_openssl_tracking and hasattr(fetcher, "read_ssl"):
             from netobserv_tpu.flow.ssl_tracer import SSLTracer
 
-            def _ssl_log(event):
-                log.debug("ssl %s pid=%d %dB", "write" if event.direction
-                          else "read", event.pid, len(event.data))
+            def _ssl_handle(event):
+                if self.ssl_correlator is not None:
+                    credited = self.ssl_correlator.observe(event)
+                else:
+                    credited = 0
+                log.debug("ssl %s pid=%d %dB -> %d flow keys credited",
+                          "write" if event.direction else "read", event.pid,
+                          len(event.data), credited)
 
-            self.ssl_tracer = SSLTracer(fetcher, _ssl_log)
+            self.ssl_tracer = SSLTracer(fetcher, _ssl_handle)
 
         self.rb_tracer: Optional[RingBufTracer] = None
         self.accounter: Optional[Accounter] = None
@@ -100,7 +118,8 @@ class FlowsAgent:
                 self._rb_q, self._evicted_q,
                 max_entries=cfg.cache_max_flows,
                 evict_timeout_s=cfg.cache_active_timeout,
-                agent_ip=agent_ip, metrics=self.metrics)
+                agent_ip=agent_ip, metrics=self.metrics,
+                ssl_correlator=self.ssl_correlator)
 
         if cfg.sampling:
             self.metrics.sampling_rate.set(cfg.sampling)

@@ -94,6 +94,9 @@ def record_to_map(r: Record) -> dict:
             out["TlsTypes"] = tls_types.tls_types_names(r.tls_types)
         if r.ssl_mismatch:
             out["TlsMismatch"] = True
+    if f.ssl_plaintext_events:
+        out["SslPlaintextEvents"] = f.ssl_plaintext_events
+        out["SslPlaintextBytes"] = f.ssl_plaintext_bytes
     if f.quic_version or f.quic_seen_long_hdr or f.quic_seen_short_hdr:
         out["QuicVersion"] = f.quic_version
         out["QuicLongHdr"] = f.quic_seen_long_hdr

@@ -27,7 +27,8 @@ class Accounter:
     def __init__(self, inp: "queue.Queue[np.void]",
                  out: "queue.Queue[list[Record]]",
                  max_entries: int = 5000, evict_timeout_s: float = 5.0,
-                 agent_ip: str = "", metrics=None):
+                 agent_ip: str = "", metrics=None, ssl_correlator=None):
+        self._ssl_correlator = ssl_correlator
         self._in = inp
         self._out = out
         self._max = max_entries
@@ -82,6 +83,12 @@ class Accounter:
         records = records_from_events(
             events, clock=self._clock, agent_ip=self._agent_ip,
             namer=interface_namer())
+        if self._ssl_correlator is not None:
+            # ringbuf-fallback flows must not lose their plaintext credits
+            for rec in records:
+                n_ev, n_bytes = self._ssl_correlator.take(rec.key)
+                rec.features.ssl_plaintext_events = n_ev
+                rec.features.ssl_plaintext_bytes = n_bytes
         if self._metrics is not None:
             self._metrics.observe_eviction("accounter", len(records), 0.0)
         try:

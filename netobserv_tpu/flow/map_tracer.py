@@ -30,7 +30,7 @@ class MapTracer:
                  namer: Optional[InterfaceNamer] = None,
                  metrics=None, stale_purge_s: float = 5.0,
                  columnar: bool = False, udn_mapper=None,
-                 force_gc: bool = False):
+                 force_gc: bool = False, ssl_correlator=None):
         self._fetcher = fetcher
         self._out = out
         self._timeout = active_timeout_s
@@ -43,6 +43,9 @@ class MapTracer:
         # objects) for exporters that consume columns directly (tpu-sketch)
         self._columnar = columnar
         self._udn_mapper = udn_mapper  # ifaces.udn.UdnMapper when enabled
+        # flow/ssl_correlator.SSLCorrelator when OpenSSL tracking is on:
+        # enrichment consumes its per-flow plaintext counters
+        self._ssl_correlator = ssl_correlator
         if columnar and udn_mapper is not None:
             log.warning("UDN mapping is a no-op on the columnar fast path "
                         "(records are never materialized)")
@@ -116,7 +119,7 @@ class MapTracer:
         records = records_from_events(
             evicted.events, clock=self._clock, agent_ip=self._agent_ip,
             namer=namer)
-        _attach_features(records, evicted)
+        _attach_features(records, evicted, ssl_correlator=self._ssl_correlator)
         if self._udn_mapper is not None:
             for rec in records:
                 rec.udn = self._udn_mapper.udn_for(rec.interface)
@@ -133,10 +136,15 @@ class MapTracer:
                         len(records))
 
 
-def _attach_features(records: list[Record], evicted) -> None:
+def _attach_features(records: list[Record], evicted,
+                     ssl_correlator=None) -> None:
     """Copy per-feature arrays onto the enriched records (already merged)."""
     for i, rec in enumerate(records):
         f = rec.features
+        if ssl_correlator is not None:
+            n_ev, n_bytes = ssl_correlator.take(rec.key)
+            f.ssl_plaintext_events = n_ev
+            f.ssl_plaintext_bytes = n_bytes
         if evicted.dns is not None and i < len(evicted.dns):
             d = evicted.dns[i]
             f.dns_id = int(d["dns_id"])

@@ -161,3 +161,8 @@ class FlowFeatures:
     quic_version: int = 0
     quic_seen_long_hdr: bool = False
     quic_seen_short_hdr: bool = False
+    # OpenSSL-uprobe plaintext<->flow correlation (userspace, procfs-based;
+    # goes beyond the reference, which only logs/counts SSL events —
+    # tracer_ringbuf.go:136-190)
+    ssl_plaintext_events: int = 0
+    ssl_plaintext_bytes: int = 0

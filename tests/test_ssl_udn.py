@@ -116,3 +116,112 @@ class TestUdn:
             assert batch[0].udn == "tenant-x"  # iface "1" mapped
         finally:
             tracer.stop()
+
+
+# ---------------------------------------------------------------------------
+# SSL plaintext <-> flow correlation (flow/ssl_correlator.py)
+# ---------------------------------------------------------------------------
+
+import os
+import socket
+
+from netobserv_tpu.flow.ssl_correlator import SSLCorrelator, procfs_resolver
+from netobserv_tpu.model.flow import ip_to_16 as _ip16
+from netobserv_tpu.flow.ssl_tracer import decode_ssl_event as _dec
+from netobserv_tpu.model.flow import FlowKey, ip_to_16
+
+
+class TestSSLCorrelator:
+    def test_credit_and_take(self):
+        laddr, raddr = ip_to_16("10.1.1.1"), ip_to_16("10.2.2.2")
+
+        def resolver(pid):
+            assert pid == 1234
+            return [(laddr, 40000, raddr, 443)]
+
+        corr = SSLCorrelator(resolver=resolver)
+        ev = _dec(make_ssl_event(b"secret-plaintext", pid=1234))
+        assert corr.observe(ev) == 2  # both orientations credited
+        egress = FlowKey(laddr, raddr, 40000, 443, 6)
+        n, b = corr.take(egress)
+        assert n == 1 and b == len(b"secret-plaintext")
+        # consumed: second take is empty
+        assert corr.take(egress) == (0, 0)
+        # the reverse orientation was credited independently
+        ingress = FlowKey(raddr, laddr, 443, 40000, 6)
+        assert corr.take(ingress) == (1, len(b"secret-plaintext"))
+
+    def test_procfs_resolver_finds_own_socket(self):
+        """REAL procfs: a live localhost TCP pair owned by this process must
+        resolve to its 5-tuple."""
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        cli = socket.socket()
+        cli.connect(srv.getsockname())
+        conn, _ = srv.accept()
+        try:
+            port = cli.getsockname()[1]
+            tuples = procfs_resolver(os.getpid())
+            locals_ = {(lp, rp) for _l, lp, _r, rp in tuples}
+            assert (port, srv.getsockname()[1]) in locals_, tuples
+            match = next(t for t in tuples
+                         if t[1] == port and t[3] == srv.getsockname()[1])
+            assert match[0] == ip_to_16("127.0.0.1")
+            assert match[2] == ip_to_16("127.0.0.1")
+        finally:
+            conn.close()
+            cli.close()
+            srv.close()
+
+    def test_agent_pipeline_correlates_injected_events(self):
+        """e2e with injected SSL events: the exported Record carries the
+        plaintext counters for the matching flow."""
+        from netobserv_tpu.agent import FlowsAgent
+        from netobserv_tpu.config import load_config
+        from netobserv_tpu.datapath.fetcher import FakeFetcher
+        from tests.test_model import make_event
+        from tests.test_pipeline import CollectExporter
+
+        laddr, raddr = ip_to_16("10.9.0.1"), ip_to_16("10.9.0.2")
+        cfg = load_config(environ={
+            "EXPORT": "stdout", "CACHE_ACTIVE_TIMEOUT": "100ms",
+            "ENABLE_OPENSSL_TRACKING": "true"})
+        fake = FakeFetcher()
+        out = CollectExporter()
+        agent = FlowsAgent(cfg, fake, out)
+        assert agent.ssl_correlator is not None
+        # injectable resolver: pid 555 owns the flow's socket
+        agent.ssl_correlator._resolver = lambda pid: (
+            [(laddr, 51000, raddr, 443)] if pid == 555 else [])
+        stop = threading.Event()
+        t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+        t.start()
+        try:
+            for _ in range(3):
+                fake.inject_ssl(make_ssl_event(b"0123456789", pid=555))
+            deadline = time.monotonic() + 3
+            while (time.monotonic() < deadline
+                   and agent.ssl_correlator.pending() == 0):
+                time.sleep(0.02)
+            assert agent.ssl_correlator.pending() > 0
+            ev = np.zeros(1, dtype=binfmt.FLOW_EVENT_DTYPE)
+            ev[0] = make_event(src="10.9.0.1", dst="10.9.0.2", sport=51000,
+                               dport=443, proto=6, nbytes=5000, pkts=4)
+            fake.inject_events(ev)
+            got = None
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and got is None:
+                try:
+                    batch = out.batches.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                for r in batch:
+                    if r.key.src_port == 51000:
+                        got = r
+            assert got is not None, "correlated flow never exported"
+            assert got.features.ssl_plaintext_events == 3
+            assert got.features.ssl_plaintext_bytes == 30
+        finally:
+            stop.set()
+            t.join(timeout=5)
