@@ -65,8 +65,10 @@ class FlowsAgent:
             self._ovn_decoder = ovn_decoder.make_decoder(cfg)
             ovn_decoder.set_decoder(self._ovn_decoder)
         columnar = getattr(exporter, "supports_columnar", False)
+        ssl_tracking = (cfg.enable_openssl_tracking
+                        and hasattr(fetcher, "read_ssl"))
         self.ssl_correlator = None
-        if cfg.enable_openssl_tracking and hasattr(fetcher, "read_ssl"):
+        if ssl_tracking:
             if columnar:
                 # _attach_features never runs on the columnar fast path, so
                 # credits would accumulate forever and never export
@@ -93,7 +95,7 @@ class FlowsAgent:
             exporter, self._export_q, metrics=self.metrics)
 
         self.ssl_tracer = None
-        if cfg.enable_openssl_tracking and hasattr(fetcher, "read_ssl"):
+        if ssl_tracking:
             from netobserv_tpu.flow.ssl_tracer import SSLTracer
 
             def _ssl_handle(event):

@@ -18,7 +18,7 @@ from netobserv_tpu.datapath.fetcher import FlowFetcher
 from netobserv_tpu.ifaces import (
     Event, EventType, InterfaceFilter, Poller, Registerer, Watcher,
 )
-from netobserv_tpu.model.record import set_interface_namer
+from netobserv_tpu.model.record import interface_namer, set_interface_namer
 
 log = logging.getLogger("netobserv_tpu.agent.ifaces")
 
@@ -63,6 +63,12 @@ class InterfaceListener:
         self._informer.stop()
         if self._thread:
             self._thread.join(timeout=2.0)
+        # drop the global namer hook: it closes over this listener's
+        # registerer, which stops updating now (and would leak stale names
+        # into any later agent instance in the same process)
+        from netobserv_tpu.model.record import default_namer
+        if interface_namer() == self._registerer.name_for:
+            set_interface_namer(default_namer)
 
     def _loop(self, events: "queue.Queue[Event]") -> None:
         while not self._stop.is_set():

@@ -23,7 +23,9 @@ BPF_JMP_CALL, BPF_EXIT = 0x85, 0x95
 
 HELPER_MAP_LOOKUP = 1
 HELPER_MAP_UPDATE = 2
+HELPER_MAP_DELETE = 3
 HELPER_KTIME_GET_NS = 5
+HELPER_RINGBUF_OUTPUT = 130
 
 
 def encode(opcode: int, dst: int = 0, src: int = 0, off: int = 0,

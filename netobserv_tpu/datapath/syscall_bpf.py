@@ -269,25 +269,41 @@ def packet_counter_prog(map_fd: int) -> bytes:
 def prog_load(insns: bytes, prog_type: int = BPF_PROG_TYPE_SCHED_CLS,
               license_: bytes = b"GPL", name: bytes = b"netobserv") -> int:
     """BPF_PROG_LOAD; returns the program fd (raises OSError with the
-    verifier log on rejection)."""
+    verifier log on rejection).
+
+    libbpf's two-phase strategy: first load with no log (a verbose log for a
+    program of any size overflows fixed buffers — the kernel then fails the
+    load with ENOSPC even when the program is valid); only on rejection retry
+    at log_level=1 with a large buffer to harvest the actual error."""
     n_insns = len(insns) // 8
     insn_buf = ctypes.create_string_buffer(insns, len(insns))
     lic_buf = ctypes.create_string_buffer(license_ + b"\x00")
-    log_buf = ctypes.create_string_buffer(65536)
-    attr = struct.pack(
-        "<IIQQIIQI",
-        prog_type, n_insns, ctypes.addressof(insn_buf),
-        ctypes.addressof(lic_buf),
-        2, len(log_buf), ctypes.addressof(log_buf),  # log_level/size/buf
-        0)  # kern_version
-    attr += struct.pack("<I", 0)  # prog_flags
-    attr += name[:15].ljust(16, b"\x00")
-    try:
+
+    def attempt(log_level: int, log_buf) -> int:
+        attr = struct.pack(
+            "<IIQQIIQI",
+            prog_type, n_insns, ctypes.addressof(insn_buf),
+            ctypes.addressof(lic_buf),
+            log_level, len(log_buf) if log_buf is not None else 0,
+            ctypes.addressof(log_buf) if log_buf is not None else 0,
+            0)  # kern_version
+        attr += struct.pack("<I", 0)  # prog_flags
+        attr += name[:15].ljust(16, b"\x00")
         return _bpf(BPF_PROG_LOAD, attr)
-    except OSError as exc:
-        log_txt = log_buf.value.decode(errors="replace").strip()
-        raise OSError(exc.errno,
-                      f"{exc.strerror}; verifier log:\n{log_txt}") from exc
+
+    try:
+        return attempt(0, None)
+    except OSError:
+        log_buf = ctypes.create_string_buffer(1 << 23)
+        try:
+            # reproduce with the error log enabled (fd is equally valid if
+            # the rejection somehow doesn't reproduce)
+            return attempt(1, log_buf)
+        except OSError as exc2:
+            log_txt = log_buf.value.decode(errors="replace").strip()
+            raise OSError(exc2.errno,
+                          f"{exc2.strerror}; verifier log:\n{log_txt}") \
+                from exc2
 
 
 def obj_pin(fd: int, path: str) -> None:

@@ -24,3 +24,16 @@ maybe_force_cpu()
 import jax  # noqa: E402
 
 assert jax.devices()[0].platform == "cpu"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_interface_namer():
+    """Isolate the process-global interfaceNamer hook: an agent test that
+    starts a live InterfaceListener must not leak its registerer's names
+    into later tests (e.g. resolving ifindex 1 -> 'lo')."""
+    yield
+    from netobserv_tpu.model import record
+
+    record.set_interface_namer(record.default_namer)

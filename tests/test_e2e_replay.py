@@ -5,6 +5,7 @@ the reference's e2e basic suite (per-packet byte accounting of ICMP flows)."""
 
 import json
 import os
+import selectors
 import struct
 import subprocess
 import sys
@@ -63,13 +64,42 @@ def exported_flows(tmp_path_factory):
     build_pcap(pcap)
     env = dict(os.environ, DATAPATH=f"pcap:{pcap}", EXPORT="stdout",
                CACHE_ACTIVE_TIMEOUT="100ms", LOG_LEVEL="warning")
+    errfile = open(tmp / "agent.stderr", "w+")
+    env["LOG_LEVEL"] = "debug"
     proc = subprocess.Popen(
         [sys.executable, "-m", "netobserv_tpu"], cwd=str(REPO), env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
-    time.sleep(2.5)
+        stdout=subprocess.PIPE, stderr=errfile)
+    # Poll exported lines until all 8 replayed packets are accounted for (or a
+    # generous deadline passes) — a fixed sleep flakes under full-suite load.
+    # Read the raw fd non-blocking: a buffered text reader would strand lines
+    # between its internal buffer and select().
+    os.set_blocking(proc.stdout.fileno(), False)
+    buf, deadline = b"", time.monotonic() + 90
+
+    def packets(raw: bytes) -> int:
+        # only parse COMPLETE lines — a non-blocking read can end mid-line
+        raw = raw[:raw.rfind(b"\n") + 1]
+        return sum(json.loads(l).get("Packets", 0)
+                   for l in raw.splitlines() if l.strip())
+
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    while time.monotonic() < deadline and packets(buf) < 8:
+        if sel.select(timeout=0.5):
+            chunk = proc.stdout.read()
+            if chunk:
+                buf += chunk
+    sel.close()
     proc.terminate()
     out, _ = proc.communicate(timeout=10)
-    return [json.loads(line) for line in out.splitlines()]
+    buf += out or b""
+    flows = [json.loads(l) for l in buf.splitlines() if l.strip()]
+    if packets(buf) < 8:  # surface the agent's own view of the stall
+        errfile.seek(0)
+        print("=== agent stderr (stalled replay) ===")
+        print("".join(errfile.readlines()[-40:]))
+    errfile.close()
+    return flows
 
 
 def agg(flows, **match):
