@@ -65,6 +65,10 @@ def _dr(field: str) -> int:
     return binfmt.DNS_REC_DTYPE.fields[field][1]
 
 
+def _xr(field: str) -> int:
+    return binfmt.EXTRA_REC_DTYPE.fields[field][1]
+
+
 ST_FIRST = _st("first_seen_ns")
 ST_LAST = _st("last_seen_ns")
 ST_BYTES = _st("bytes")
@@ -127,7 +131,7 @@ class _Flow:
 
     def __init__(self, map_fd: int, direction: int, sampling: int,
                  ringbuf_fd, counters_fd, dns_inflight_fd, flows_dns_fd,
-                 dns_port: int):
+                 dns_port: int, rtt_inflight_fd=None, flows_extra_fd=None):
         self.a = Asm()
         self.map_fd = map_fd
         self.direction = direction
@@ -137,12 +141,15 @@ class _Flow:
         self.dns_inflight_fd = dns_inflight_fd
         self.flows_dns_fd = flows_dns_fd
         self.dns_port = dns_port
+        self.rtt_inflight_fd = rtt_inflight_fd
+        self.flows_extra_fd = flows_extra_fd
         self._ctr_n = 0
 
     # --- helpers -----------------------------------------------------------
     def count(self, ctr: int) -> None:
         """Bump global_counters[ctr] (per-CPU slot; non-atomic is exact).
-        Clobbers r0-r3; no-op when the counters map isn't wired."""
+        Clobbers r0-r5 (embedded helper call); no-op when the counters map
+        isn't wired."""
         if self.counters_fd is None:
             return
         a = self.a
@@ -248,6 +255,42 @@ class _Flow:
         a.ldx(BPF_B, R4, R10, KEY + KY_PROTO)
         a.stx(BPF_B, R10, R4, CORR + CK_PROTO)
 
+    def stamp(self, fd: int) -> None:
+        """rtt/dns shared half: record NOW in `fd` under the REVERSED tuple
+        (the reply's own tuple will produce this key). Falls through with the
+        update result in r0 for callers that count failures."""
+        a = self.a
+        self.corr_key(reverse=True)
+        a.ld_map_fd(R1, fd)
+        a.mov_reg(R2, R10)
+        a.alu_imm(0x07, R2, CORR)
+        a.mov_reg(R3, R10)
+        a.alu_imm(0x07, R3, NOW)
+        a.mov_imm(R4, 0)                        # BPF_ANY
+        a.call(HELPER_MAP_UPDATE)
+
+    def measure(self, fd: int, done: str, tag: str) -> None:
+        """rtt/dns shared half: correlate the reply's own tuple against the
+        stamp in `fd`, leave (NOW - stamp) in the LAT slot when the clocks
+        agree, delete the stamp, and fall through to `done`."""
+        a = self.a
+        self.corr_key(reverse=False)
+        a.ld_map_fd(R1, fd)
+        a.mov_reg(R2, R10)
+        a.alu_imm(0x07, R2, CORR)
+        a.call(HELPER_MAP_LOOKUP)
+        a.jmp_imm(0x15, R0, 0, done)
+        a.ldx(BPF_DW, R3, R0, 0)                # stamp_ns
+        a.ldx(BPF_DW, R4, R10, NOW)
+        a.jmp_reg(0xBD, R4, R3, f"{tag}_del")   # now <= stamp: clock skew
+        a.alu_reg(0x1F, R4, R3)                 # r4 = now - stamp
+        a.stx(BPF_DW, R10, R4, LAT)
+        a.label(f"{tag}_del")
+        a.ld_map_fd(R1, fd)
+        a.mov_reg(R2, R10)
+        a.alu_imm(0x07, R2, CORR)
+        a.call(HELPER_MAP_DELETE)
+
     def build(self) -> bytes:
         a = self.a
         a.mov_reg(R6, R1)                       # r6 = ctx
@@ -331,39 +374,39 @@ class _Flow:
         # --- DNS correlation (stack-only; before the flow upsert) ----------
         if self.dns_inflight_fd is not None:
             a.ldx(BPF_W, R3, R10, DNSMETA + 4)
-            a.jmp_imm(0x15, R3, 0, "flow_upsert")
+            a.jmp_imm(0x15, R3, 0, "rtt_chk")
             a.ldx(BPF_H, R3, R10, DNSMETA + 2)
             a.jmp_imm(0x45, R3, DNS_QR_BIT, "dns_resp")   # JSET: response
             # query: stash timestamp under the reversed tuple
-            self.corr_key(reverse=True)
-            a.ld_map_fd(R1, self.dns_inflight_fd)
-            a.mov_reg(R2, R10)
-            a.alu_imm(0x07, R2, CORR)
-            a.mov_reg(R3, R10)
-            a.alu_imm(0x07, R3, NOW)
-            a.mov_imm(R4, 0)                    # BPF_ANY
-            a.call(HELPER_MAP_UPDATE)
-            a.jmp_imm(0x15, R0, 0, "flow_upsert")
+            self.stamp(self.dns_inflight_fd)
+            a.jmp_imm(0x15, R0, 0, "rtt_chk")
             self.count(CTR_FAIL_UPDATE_DNS)
-            a.jmp("flow_upsert")
+            a.jmp("rtt_chk")
             # response: correlate to the stashed query and compute latency
             a.label("dns_resp")
-            self.corr_key(reverse=False)
-            a.ld_map_fd(R1, self.dns_inflight_fd)
-            a.mov_reg(R2, R10)
-            a.alu_imm(0x07, R2, CORR)
-            a.call(HELPER_MAP_LOOKUP)
-            a.jmp_imm(0x15, R0, 0, "flow_upsert")
-            a.ldx(BPF_DW, R3, R0, 0)            # sent_ns
-            a.ldx(BPF_DW, R4, R10, NOW)
-            a.jmp_reg(0xBD, R4, R3, "dns_del")  # now <= sent: no latency
-            a.alu_reg(0x1F, R4, R3)             # r4 = now - sent
-            a.stx(BPF_DW, R10, R4, LAT)
-            a.label("dns_del")
-            a.ld_map_fd(R1, self.dns_inflight_fd)
-            a.mov_reg(R2, R10)
-            a.alu_imm(0x07, R2, CORR)
-            a.call(HELPER_MAP_DELETE)
+            self.measure(self.dns_inflight_fd, done="rtt_chk", tag="dns")
+
+        # --- TCP handshake RTT (SYN -> SYN|ACK correlation) ----------------
+        # The clang path measures smoothed RTT from fentry:tcp_rcv_established
+        # (flowpath_probes.c); without BTF the assembler measures the
+        # handshake RTT instead: a pure SYN stamps rtt_inflight under the
+        # reversed tuple (the corr key builder zero-pads dns_id for TCP) and
+        # the returning SYN|ACK's own tuple correlates to a latency. DNS (UDP)
+        # and RTT (TCP) are per-packet exclusive, so CORR/LAT slots are shared.
+        a.label("rtt_chk")
+        if self.rtt_inflight_fd is not None:
+            a.ldx(BPF_B, R3, R10, KEY + KY_PROTO)
+            a.jmp_imm(0x55, R3, 6, "flow_upsert")
+            a.ldx(BPF_DW, R3, R10, SPILL)
+            a.jmp_imm(0x45, R3, 0x02, "rtt_syn_any")      # SYN bit set?
+            a.jmp("flow_upsert")
+            a.label("rtt_syn_any")
+            a.jmp_imm(0x45, R3, 0x10, "rtt_synack")       # ACK too?
+            # pure SYN: stamp the reversed tuple (dns_id stays zero for TCP)
+            self.stamp(self.rtt_inflight_fd)
+            a.jmp("flow_upsert")
+            a.label("rtt_synack")
+            self.measure(self.rtt_inflight_fd, done="flow_upsert", tag="rtt")
 
         # --- flow upsert ---------------------------------------------------
         a.label("flow_upsert")
@@ -496,7 +539,7 @@ class _Flow:
         a.label("dns_rec")
         if self.flows_dns_fd is not None:
             a.ldx(BPF_W, R3, R10, DNSMETA + 4)
-            a.jmp_imm(0x15, R3, 0, "out")
+            a.jmp_imm(0x15, R3, 0, "extra_rec")
             a.ld_map_fd(R1, self.flows_dns_fd)
             a.mov_reg(R2, R10)
             a.alu_imm(0x07, R2, KEY)
@@ -522,7 +565,7 @@ class _Flow:
             a.ldx(BPF_DW, R4, R10, LAT)
             a.jmp_reg(0x3D, R3, R4, "out")      # existing >= new: keep
             a.stx(BPF_DW, R0, R4, _dr("latency_ns"))
-            a.jmp("out")
+            a.jmp("out")                        # (dns packet: no rtt rec)
             a.label("dnsrec_miss")
             for off in range(DNSREC, DNSREC + DNSREC_SIZE, 8):
                 a.st_imm(BPF_DW, R10, off, 0)
@@ -546,6 +589,47 @@ class _Flow:
             a.call(HELPER_MAP_UPDATE)
             a.jmp_imm(0x15, R0, 0, "out")
             self.count(CTR_FAIL_UPDATE_DNS)
+            a.jmp("out")
+
+        # --- RTT feature record (flows_extra; additional_metrics_t twin) ---
+        a.label("extra_rec")
+        if self.flows_extra_fd is not None:
+            a.ldx(BPF_B, R3, R10, KEY + KY_PROTO)
+            a.jmp_imm(0x55, R3, 6, "out")
+            a.ldx(BPF_DW, R3, R10, LAT)         # measured handshake rtt
+            a.jmp_imm(0x15, R3, 0, "out")
+            a.ld_map_fd(R1, self.flows_extra_fd)
+            a.mov_reg(R2, R10)
+            a.alu_imm(0x07, R2, KEY)
+            a.call(HELPER_MAP_LOOKUP)
+            a.jmp_imm(0x15, R0, 0, "xrec_miss")
+            a.ldx(BPF_DW, R4, R10, NOW)
+            a.stx(BPF_DW, R0, R4, _xr("last_seen_ns"))
+            a.ldx(BPF_DW, R3, R0, _xr("rtt_ns"))
+            a.ldx(BPF_DW, R4, R10, LAT)
+            a.jmp_reg(0x3D, R3, R4, "out")      # existing >= new: keep
+            a.stx(BPF_DW, R0, R4, _xr("rtt_ns"))
+            a.jmp("out")
+            a.label("xrec_miss")
+            # build in the DNSREC scratch (32B needed, 64B slot, same align)
+            for off in range(DNSREC, DNSREC + 32, 8):
+                a.st_imm(BPF_DW, R10, off, 0)
+            a.ldx(BPF_DW, R4, R10, NOW)
+            a.stx(BPF_DW, R10, R4, DNSREC + _xr("first_seen_ns"))
+            a.stx(BPF_DW, R10, R4, DNSREC + _xr("last_seen_ns"))
+            a.ldx(BPF_DW, R4, R10, LAT)
+            a.stx(BPF_DW, R10, R4, DNSREC + _xr("rtt_ns"))
+            a.ldx(BPF_H, R4, R10, VAL + ST_ETH)
+            a.stx(BPF_H, R10, R4, DNSREC + _xr("eth_protocol"))
+            a.ld_map_fd(R1, self.flows_extra_fd)
+            a.mov_reg(R2, R10)
+            a.alu_imm(0x07, R2, KEY)
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, DNSREC)
+            a.mov_imm(R4, 0)                    # BPF_ANY
+            a.call(HELPER_MAP_UPDATE)
+            a.jmp_imm(0x15, R0, 0, "out")
+            self.count(CTR_FAIL_UPDATE_FLOW)
 
         a.label("out")
         a.mov_imm(R0, 0)                        # TC_ACT_OK
@@ -558,10 +642,13 @@ def build_flow_program(map_fd: int, direction: int = 0, sampling: int = 0,
                        counters_fd: int | None = None,
                        dns_inflight_fd: int | None = None,
                        flows_dns_fd: int | None = None,
-                       dns_port: int = 53) -> bytes:
+                       dns_port: int = 53,
+                       rtt_inflight_fd: int | None = None,
+                       flows_extra_fd: int | None = None) -> bytes:
     """Assemble one per-direction flow program. Optional map fds gate the
     corresponding feature blocks, mirroring the C datapath's loader-rewritten
     `cfg_enable_*` constants (a feature whose map isn't wired costs zero
     instructions)."""
     return _Flow(map_fd, direction, sampling, ringbuf_fd, counters_fd,
-                 dns_inflight_fd, flows_dns_fd, dns_port).build()
+                 dns_inflight_fd, flows_dns_fd, dns_port,
+                 rtt_inflight_fd, flows_extra_fd).build()

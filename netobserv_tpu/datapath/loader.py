@@ -38,13 +38,13 @@ class KernelFetcher:
     """Self-managed kernel datapath entry point (reference analog:
     `pkg/tracer/tracer.go:92-273` NewFlowFetcher).
 
-    Two provisioning paths, picked automatically:
-    - a clang-built CO-RE object (datapath/native/CMakeLists.txt DATAPATH_BPF)
-      loaded via libbpf when both the object and libbpf.so are present —
-      the full-featured datapath (all trackers/filters);
-    - otherwise the in-tree assembler datapath (`MinimalKernelFetcher`):
-      verifier-loaded IPv4/IPv6 flows, DNS tracking, ringbuf fallback,
-      counters, sampling — no compiler or libbpf required.
+    Always provisions the in-tree assembler datapath
+    (`MinimalKernelFetcher`): verifier-loaded IPv4/IPv6 flows, DNS tracking,
+    handshake RTT, ringbuf fallback, counters, sampling — no compiler or
+    libbpf required. A clang-built CO-RE object
+    (datapath/native/CMakeLists.txt DATAPATH_BPF) adds the remaining
+    trackers/filters; its libbpf load path is not wired yet, so a present
+    object only changes the log line, never the behavior.
     """
 
     needs_iface_discovery = True  # the agent starts an InterfaceListener
@@ -54,15 +54,10 @@ class KernelFetcher:
         if os.geteuid() != 0:
             raise RuntimeError("kernel datapath requires root/CAP_BPF")
         if os.path.exists(_OBJ_PATH):
-            if ctypes.util.find_library("bpf"):
-                raise RuntimeError(
-                    "clang-built object present but the libbpf load path is "
-                    "not wired in this build; remove the object to use the "
-                    "assembler datapath")
-            log.warning("clang-built object %s present but libbpf.so is "
-                        "missing; falling back to the assembler datapath "
-                        "(install libbpf for the full-featured object)",
-                        _OBJ_PATH)
+            log.warning("clang-built object %s present but its libbpf load "
+                        "path is not wired in this build; using the "
+                        "assembler datapath (filters/TLS/QUIC/probe trackers "
+                        "inactive)", _OBJ_PATH)
         else:
             log.info("no clang-built BPF object (%s); using the in-tree "
                      "assembler datapath", _OBJ_PATH)
@@ -269,23 +264,26 @@ class BpfmanFetcher:
                     key_size=self.DNS_CORR_KEY_SIZE, value_size=8)
             except (OSError, ValueError):
                 self._dns_inflight = None
-        if self._dns_inflight is None:
-            return 0
         import struct as _struct
 
         deadline = time.clock_gettime_ns(time.CLOCK_MONOTONIC) - int(
             older_than_s * 1e9)
         purged = 0
-        for key in self._dns_inflight.keys():
-            raw = self._dns_inflight.lookup(key)
-            if raw is None:
+        # both correlation maps hold a u64 monotonic stamp per 40-byte key
+        for corr in (self._dns_inflight,
+                     getattr(self, "_rtt_inflight", None)):
+            if corr is None:
                 continue
-            (sent_ns,) = _struct.unpack_from("<Q", raw, 0)
-            if sent_ns < deadline:
-                if self._dns_inflight.delete(key):
-                    purged += 1
+            for key in corr.keys():
+                raw = corr.lookup(key)
+                if raw is None:
+                    continue
+                (sent_ns,) = _struct.unpack_from("<Q", raw, 0)
+                if sent_ns < deadline:
+                    if corr.delete(key):
+                        purged += 1
         if purged:
-            log.debug("purged %d stale DNS correlations", purged)
+            log.debug("purged %d stale correlations (dns/rtt)", purged)
         return purged
 
     def attach(self, if_index: int, if_name: str, direction: str,
@@ -448,6 +446,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
     def __init__(self, cache_max_flows: int = 5000,
                  attach_mode: str = "tcx", sampling: int = 0,
                  enable_dns: bool = False, dns_port: int = 53,
+                 enable_rtt: bool = False,
                  enable_ringbuf_fallback: bool = True,
                  ringbuf_bytes: int = 1 << 17):
         from netobserv_tpu.datapath import asm_flowpath
@@ -474,6 +473,18 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             dns_rec.n_cpus = self._n_cpus
             self._features["dns"] = (dns_rec, binfmt.DNS_REC_DTYPE)
             dns_q_fd, dns_rec_fd = self._dns_inflight.fd, dns_rec.fd
+        rtt_q_fd = rtt_rec_fd = None
+        if enable_rtt:
+            self._rtt_inflight = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_HASH, self.DNS_CORR_KEY_SIZE, 8,
+                max(cache_max_flows, 1024), b"rtt_inflight")
+            extra_rec = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_PERCPU_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
+                binfmt.EXTRA_REC_DTYPE.itemsize, cache_max_flows,
+                b"flows_extra")
+            extra_rec.n_cpus = self._n_cpus
+            self._features["extra"] = (extra_rec, binfmt.EXTRA_REC_DTYPE)
+            rtt_q_fd, rtt_rec_fd = self._rtt_inflight.fd, extra_rec.fd
         rb_fd = None
         if enable_ringbuf_fallback:
             self._rb_map = syscall_bpf.BpfMap.create(
@@ -490,7 +501,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                     self._agg.fd, direction=code, sampling=sampling,
                     ringbuf_fd=rb_fd, counters_fd=self._counters.fd,
                     dns_inflight_fd=dns_q_fd, flows_dns_fd=dns_rec_fd,
-                    dns_port=dns_port))
+                    dns_port=dns_port, rtt_inflight_fd=rtt_q_fd,
+                    flows_extra_fd=rtt_rec_fd))
             pin = f"{self._PIN_PREFIX}{os.getpid()}_{name}"
             if os.path.exists(pin):
                 os.unlink(pin)
@@ -509,6 +521,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         self._ringbuf = None
         self._ssl_rb = None
         self._dns_inflight = None
+        self._rtt_inflight = None
         self._rb_map = None
 
     @classmethod
@@ -523,6 +536,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                    attach_mode=cfg.tc_attach_mode, sampling=cfg.sampling,
                    enable_dns=cfg.enable_dns_tracking,
                    dns_port=cfg.dns_tracking_port,
+                   enable_rtt=cfg.enable_rtt,
                    enable_ringbuf_fallback=cfg.enable_flows_ringbuf_fallback)
 
     def close(self) -> None:
@@ -536,6 +550,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             self._rb_map.close()
         if self._dns_inflight is not None:
             self._dns_inflight.close()
+        if self._rtt_inflight is not None:
+            self._rtt_inflight.close()
         for fmap, _dtype in self._features.values():
             fmap.close()
 

@@ -115,8 +115,17 @@ class IPFIXExporter(Exporter):
     MAX_TCP_PAYLOAD = 32768
 
     def export_batch(self, records: list[Record]) -> None:
-        v4 = [r for r in records if r.key.src_ip[:12] == IP4_IN_6_PREFIX]
-        v6 = [r for r in records if r.key.src_ip[:12] != IP4_IN_6_PREFIX]
+        # The v4 template can only hold records whose BOTH addresses are
+        # v4-mapped; anything else (either address native-v6, or the datapath
+        # tagged the frame 0x86DD) must use the v6 template — classifying on
+        # src alone would let a mixed record truncate its dst address.
+        def is_v6(r: Record) -> bool:
+            return (r.eth_protocol == 0x86DD
+                    or r.key.src_ip[:12] != IP4_IN_6_PREFIX
+                    or r.key.dst_ip[:12] != IP4_IN_6_PREFIX)
+
+        v4 = [r for r in records if not is_v6(r)]
+        v6 = [r for r in records if is_v6(r)]
         limit = (self.MAX_UDP_PAYLOAD if self._transport == "udp"
                  else self.MAX_TCP_PAYLOAD)
         pending: list[tuple[int, bool, list[Record]]] = []

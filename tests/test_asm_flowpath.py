@@ -422,6 +422,122 @@ def test_dns_latency_tracking(veth):
         fetcher.close()
 
 
+def test_handshake_rtt_tracking(veth):
+    """A real TCP handshake across the veth yields a measured RTT in the
+    flows_extra feature map: the pure SYN stamps rtt_inflight, the returning
+    SYN|ACK correlates (the assembler's handshake analog of the clang path's
+    fentry:tcp_rcv_established smoothed RTT)."""
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    listener = subprocess.Popen(
+        ["ip", "netns", "exec", NS, sys.executable, "-c",
+         "import socket;"
+         "s=socket.socket();s.bind(('10.198.0.2',5390));s.listen(1);"
+         "c,_=s.accept();import time;time.sleep(1)"])
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_rtt=True)
+    try:
+        fetcher.attach(_ifindex(veth), veth, "both")
+        c = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:  # wait out the listener's startup
+            try:
+                c = socket.socket()
+                c.settimeout(3)
+                c.connect(("10.198.0.2", 5390))
+                break
+            except OSError:
+                c.close()
+                c = None
+                time.sleep(0.2)
+        assert c is not None, "listener never came up"
+        cport = c.getsockname()[1]
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        c.close()
+        assert evicted.extra is not None, "flows_extra never drained"
+        hit = None
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            # rtt lands on the SYN|ACK's flow (server -> client); refused
+            # earlier attempts leave rtt-less RST flows on other client ports
+            if (int(k["src_port"]) == 5390 and int(k["proto"]) == 6
+                    and int(k["dst_port"]) == cport):
+                hit = evicted.extra[i]
+        assert hit is not None, "server-side flow missing"
+        rtt = int(hit["rtt_ns"])
+        assert 0 < rtt < 1_000_000_000, f"rtt {rtt}ns"
+        # the completed handshake's stamp was consumed (earlier refused
+        # connect attempts may leave their own stamps; purge_stale owns those)
+        import struct as _s
+        v4 = lambda ip: b"\0" * 10 + b"\xff\xff" + socket.inet_aton(ip)
+        corr = _s.pack("<HH", 5390, cport) + v4("10.198.0.2") + \
+            v4("10.198.0.1") + _s.pack("<HBB", 0, 6, 0)
+        assert fetcher._rtt_inflight.lookup(corr) is None
+        # stale stamps from the refused attempts are purged by deadline 0
+        fetcher.purge_stale(0)
+        assert fetcher._rtt_inflight.keys() == []
+    finally:
+        listener.kill()
+        listener.wait()
+        fetcher.close()
+
+
+def test_agent_exports_dns_latency(veth):
+    """Full agent over the kernel datapath with ENABLE_DNS_TRACKING: the
+    drained flows_dns feature must surface as DnsLatencyMs on the exported
+    record (MapTracer._attach_features -> Record.features)."""
+    from netobserv_tpu.agent import FlowsAgent
+    from netobserv_tpu.config import load_config
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+    from tests.test_pipeline import CollectExporter
+
+    cfg = load_config(environ={
+        "EXPORT": "stdout", "CACHE_ACTIVE_TIMEOUT": "200ms",
+        "INTERFACES": "nf0", "DIRECTION": "both",
+        "ENABLE_DNS_TRACKING": "true"})
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_dns=True)
+    out = CollectExporter()
+    agent = FlowsAgent(cfg, fetcher, out)
+    stop = threading.Event()
+    t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not any(
+                "ingress" in dirs for _n, dirs in fetcher._attached.values()):
+            time.sleep(0.05)
+        dns_id = 0x1234
+        q = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        q.bind(("10.198.0.1", 40456))
+        q.sendto(_dns_payload(dns_id, response=False), ("10.198.0.2", 53))
+        time.sleep(0.1)
+        resp = _dns_payload(dns_id, response=True)
+        _run("ip", "netns", "exec", NS, sys.executable, "-c",
+             "import socket;"
+             "s=socket.socket(socket.AF_INET,socket.SOCK_DGRAM);"
+             "s.bind(('10.198.0.2',53));"
+             f"s.sendto(bytes.fromhex('{resp.hex()}'),('10.198.0.1',40456))")
+        q.close()
+        got = None
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline and got is None:
+            try:
+                batch = out.batches.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            for r in batch:
+                if (r.key.src_port == 53 and r.key.dst_port == 40456
+                        and r.features is not None
+                        and r.features.dns_latency_ns > 0):
+                    got = r
+        assert got is not None, "DNS-enriched record never exported"
+        assert got.features.dns_id == dns_id
+        assert "DnsLatencyMs" in got.to_json_obj()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
 def test_map_full_ringbuf_fallback_and_counters(veth):
     """When aggregated_flows can't take a new flow, the whole event ships
     through the direct_flows ring buffer with errno_fallback set, and the

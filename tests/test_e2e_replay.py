@@ -63,9 +63,9 @@ def exported_flows(tmp_path_factory):
     pcap = str(tmp / "traffic.pcap")
     build_pcap(pcap)
     env = dict(os.environ, DATAPATH=f"pcap:{pcap}", EXPORT="stdout",
-               CACHE_ACTIVE_TIMEOUT="100ms", LOG_LEVEL="warning")
+               CACHE_ACTIVE_TIMEOUT="100ms",
+               LOG_LEVEL="debug")  # feeds the stall diagnostics below
     errfile = open(tmp / "agent.stderr", "w+")
-    env["LOG_LEVEL"] = "debug"
     proc = subprocess.Popen(
         [sys.executable, "-m", "netobserv_tpu"], cwd=str(REPO), env=env,
         stdout=subprocess.PIPE, stderr=errfile)

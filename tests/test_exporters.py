@@ -160,6 +160,36 @@ class TestIPFIX:
         exp.close()
         rx.close()
 
+    def test_template_classification_mixed_and_etype(self):
+        """A mixed record (v4-mapped src, native-v6 dst) must use the v6
+        template — classifying on src alone would truncate the dst; when the
+        datapath recorded an ethertype, it wins over the prefix check."""
+        import socket
+
+        from netobserv_tpu.exporter.ipfix import IPFIXExporter, TEMPLATE_V6
+        rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx.bind(("127.0.0.1", 0))
+        rx.settimeout(3)
+        port = rx.getsockname()[1]
+        exp = IPFIXExporter("127.0.0.1", port, transport="udp")
+        mixed = make_record(dst="2001:db8::77")          # v4 src, v6 dst
+        tagged = make_record()                           # v4 addrs...
+        tagged.eth_protocol = 0x86DD                     # ...but v6 etype
+        exp.export_batch([mixed, tagged])
+        seen = set()
+        msg, _ = rx.recvfrom(65535)  # both records ride the one v6 chunk
+        off = 16
+        while off < len(msg):
+            sid, slen = struct.unpack(">HH", msg[off:off + 4])
+            seen.add(sid)
+            off += slen
+        assert TEMPLATE_V6 in seen
+        # nothing landed in the v4 template: only template/data-v6 sets
+        from netobserv_tpu.exporter.ipfix import TEMPLATE_V4
+        assert TEMPLATE_V4 not in seen
+        exp.close()
+        rx.close()
+
     def test_udp_large_batch_splits_into_datagrams(self):
         import socket
 
