@@ -27,11 +27,17 @@ in, the moral equivalent of the C datapath's `volatile const` config):
 - optional 1/N sampling baked in at build time (the loader rebuilds per
   config)
 
+Also covered: the in-kernel flow-filter gate (filter.h twin — LPM rule
+lookup with the full predicate set, src-first/dst-retry, peer-CIDR check,
+accept/reject/no-match counters) and handshake RTT (SYN→SYN|ACK correlation
+into per-CPU flows_extra records).
+
 Deliberate limits vs flowpath.c: no IP options / v6 extension headers
-(packets with them fall back to untracked), no in-kernel flow filter, no
-TLS/QUIC inline trackers, racy (non-spin-locked) last_seen/flags — all
-bounded-loss or enrichment-only behaviors. Validated by the live verifier
-and end-to-end veth traffic tests (tests/test_asm_flowpath.py).
+(packets with them fall back to untracked), no TLS/QUIC inline trackers, no
+per-rule sampling overrides (sampling is baked at build time), racy
+(non-spin-locked) last_seen/flags — all bounded-loss or enrichment-only
+behaviors. Validated by the live verifier and end-to-end veth traffic tests
+(tests/test_asm_flowpath.py).
 """
 
 from __future__ import annotations
@@ -113,6 +119,8 @@ NOW = SPILL - 8           # -264: bpf_ktime_get_ns()
 DNSMETA = NOW - 8         # -272: dns id (u16 @+0), flags (u16 @+2), seen (@+4)
 LAT = DNSMETA - 8         # -280: dns latency (u64)
 CTRKEY = LAT - 8          # -288: global-counter index (u32)
+FKEY = CTRKEY - 24        # -312: no_filter_key (u32 prefix_len + 16B ip)
+FACT = FKEY - 8           # -320: matched rule's action, saved across lookups
 
 # no_dns_corr_key field offsets (bpf/maps.h struct no_dns_corr_key)
 CK_SPORT, CK_DPORT, CK_SRC_IP, CK_DST_IP, CK_ID, CK_PROTO = 0, 2, 4, 20, 36, 38
@@ -123,7 +131,14 @@ DNS_QR_BIT = 0x8000
 CTR_FAIL_UPDATE_FLOW = 0
 CTR_FAIL_CREATE_FLOW = 1
 CTR_FAIL_UPDATE_DNS = 2
+CTR_FILTER_REJECT = 3
+CTR_FILTER_ACCEPT = 4
+CTR_FILTER_NOMATCH = 5
 CTR_OBSERVED_INTF_MISSED = 12
+
+
+def _fr(field: str) -> int:
+    return binfmt.FILTER_RULE_DTYPE.fields[field][1]
 
 
 class _Flow:
@@ -131,7 +146,8 @@ class _Flow:
 
     def __init__(self, map_fd: int, direction: int, sampling: int,
                  ringbuf_fd, counters_fd, dns_inflight_fd, flows_dns_fd,
-                 dns_port: int, rtt_inflight_fd=None, flows_extra_fd=None):
+                 dns_port: int, rtt_inflight_fd=None, flows_extra_fd=None,
+                 filter_rules_fd=None, filter_peers_fd=None):
         self.a = Asm()
         self.map_fd = map_fd
         self.direction = direction
@@ -143,6 +159,8 @@ class _Flow:
         self.dns_port = dns_port
         self.rtt_inflight_fd = rtt_inflight_fd
         self.flows_extra_fd = flows_extra_fd
+        self.filter_rules_fd = filter_rules_fd
+        self.filter_peers_fd = filter_peers_fd
         self._ctr_n = 0
 
     # --- helpers -----------------------------------------------------------
@@ -186,6 +204,15 @@ class _Flow:
         a.label(f"tcp_{v}")
         self.bounds(l4 + 14, f"ports_{v}")      # flags byte at l4+13
         a.ldx(BPF_B, R3, R7, l4 + 13)
+        # classify composite flags exactly like parse.h:93-102 — the
+        # synthetic SYN_ACK/FIN_ACK/RST_ACK bits feed both the accumulated
+        # stats flags and the filter gate's tcp_flags predicate
+        for combo, bit in ((0x12, 0x100), (0x11, 0x200), (0x14, 0x400)):
+            a.mov_reg(R4, R3)
+            a.alu_imm(0x57, R4, combo)
+            a.jmp_imm(0x55, R4, combo, f"cls_{v}_{bit:x}")
+            a.alu_imm(0x47, R3, bit)
+            a.label(f"cls_{v}_{bit:x}")
         a.stx(BPF_DW, R10, R3, SPILL)
         a.jmp(f"ports_{v}")
 
@@ -291,6 +318,142 @@ class _Flow:
         a.alu_imm(0x07, R2, CORR)
         a.call(HELPER_MAP_DELETE)
 
+    def filter_key(self, ip_off: int) -> None:
+        """Build no_filter_key at FKEY (prefix_len=128 + one key address)."""
+        a = self.a
+        a.st_imm(BPF_W, R10, FKEY, 128)
+        for i in range(0, 16, 4):
+            a.ldx(BPF_W, R3, R10, KEY + ip_off + i)
+            a.stx(BPF_W, R10, R3, FKEY + 4 + i)
+
+    def port_pred(self, port_off: int, base: str, fail: str, tag: str) -> None:
+        """One side's port predicate vs the rule in r0 (filter.h
+        no_port_pred_ok): range [start,end] when set, then 1-2 exact ports
+        when set. `base` in {dport, sport}."""
+        a = self.a
+        a.ldx(BPF_H, R9, R10, KEY + port_off)
+        a.ldx(BPF_H, R3, R0, _fr(f"{base}_start"))
+        a.ldx(BPF_H, R4, R0, _fr(f"{base}_end"))
+        a.mov_reg(R5, R3)
+        a.alu_reg(0x4F, R5, R4)
+        a.jmp_imm(0x15, R5, 0, f"{tag}_norange")
+        a.jmp_reg(0xAD, R9, R3, fail)           # port < start
+        a.jmp_reg(0x2D, R9, R4, fail)           # port > end
+        a.label(f"{tag}_norange")
+        a.ldx(BPF_H, R3, R0, _fr(f"{base}1"))
+        a.ldx(BPF_H, R4, R0, _fr(f"{base}2"))
+        a.mov_reg(R5, R3)
+        a.alu_reg(0x4F, R5, R4)
+        a.jmp_imm(0x15, R5, 0, f"{tag}_ok")
+        a.jmp_reg(0x1D, R9, R3, f"{tag}_ok")    # == p1
+        a.jmp_reg(0x5D, R9, R4, fail)           # != p2 either
+        a.label(f"{tag}_ok")
+
+    def filter_side(self, side: str, keyed_ip: int, peer_ip: int,
+                    fail: str) -> None:
+        """One evaluation of filter.h's no_filter_try: LPM rule lookup on
+        `keyed_ip`, all predicates, optional peer-CIDR check, then verdict.
+        Jumps to `fail` when this side produced no usable match (-1 in C)."""
+        a = self.a
+        t = f"flt_{side}"
+        self.filter_key(keyed_ip)
+        a.ld_map_fd(R1, self.filter_rules_fd)
+        a.mov_reg(R2, R10)
+        a.alu_imm(0x07, R2, FKEY)
+        a.call(HELPER_MAP_LOOKUP)
+        a.jmp_imm(0x15, R0, 0, fail)
+        # r0 = rule; predicates (no helper calls until the peer check)
+        a.ldx(BPF_B, R3, R0, _fr("proto"))
+        a.jmp_imm(0x15, R3, 0, f"{t}_proto_ok")
+        a.ldx(BPF_B, R4, R10, KEY + KY_PROTO)
+        a.jmp_reg(0x5D, R3, R4, fail)
+        a.label(f"{t}_proto_ok")
+        a.ldx(BPF_B, R3, R0, _fr("direction"))
+        a.jmp_imm(0x15, R3, 255, f"{t}_dir_ok")
+        a.jmp_imm(0x55, R3, self.direction, fail)
+        a.label(f"{t}_dir_ok")
+        self.port_pred(KY_DPORT, "dport", fail, f"{t}_dp")
+        self.port_pred(KY_SPORT, "sport", fail, f"{t}_sp")
+        # either-direction range: sp in [start,end] OR dp in [start,end]
+        a.ldx(BPF_H, R3, R0, _fr("port_start"))
+        a.ldx(BPF_H, R4, R0, _fr("port_end"))
+        a.mov_reg(R5, R3)
+        a.alu_reg(0x4F, R5, R4)
+        a.jmp_imm(0x15, R5, 0, f"{t}_norange")
+        a.ldx(BPF_H, R9, R10, KEY + KY_SPORT)
+        a.jmp_reg(0xAD, R9, R3, f"{t}_try_dp")  # sp < start
+        a.jmp_reg(0xBD, R9, R4, f"{t}_range_ok")  # sp <= end
+        a.label(f"{t}_try_dp")
+        a.ldx(BPF_H, R9, R10, KEY + KY_DPORT)
+        a.jmp_reg(0xAD, R9, R3, fail)
+        a.jmp_reg(0x2D, R9, R4, fail)
+        a.label(f"{t}_range_ok")
+        a.label(f"{t}_norange")
+        # either-direction exact ports: any of sp/dp == p1/p2
+        a.ldx(BPF_H, R3, R0, _fr("port1"))
+        a.ldx(BPF_H, R4, R0, _fr("port2"))
+        a.mov_reg(R5, R3)
+        a.alu_reg(0x4F, R5, R4)
+        a.jmp_imm(0x15, R5, 0, f"{t}_ports_ok")
+        a.ldx(BPF_H, R9, R10, KEY + KY_SPORT)
+        a.jmp_reg(0x1D, R9, R3, f"{t}_ports_ok")
+        a.jmp_reg(0x1D, R9, R4, f"{t}_ports_ok")
+        a.ldx(BPF_H, R9, R10, KEY + KY_DPORT)
+        a.jmp_reg(0x1D, R9, R3, f"{t}_ports_ok")
+        a.jmp_reg(0x5D, R9, R4, fail)
+        a.label(f"{t}_ports_ok")
+        a.ldx(BPF_B, R3, R0, _fr("icmp_type"))
+        a.jmp_imm(0x15, R3, 0, f"{t}_it_ok")
+        a.ldx(BPF_B, R4, R10, KEY + KY_ICMP_TYPE)
+        a.jmp_reg(0x5D, R3, R4, fail)
+        a.label(f"{t}_it_ok")
+        a.ldx(BPF_B, R3, R0, _fr("icmp_code"))
+        a.jmp_imm(0x15, R3, 0, f"{t}_ic_ok")
+        a.ldx(BPF_B, R4, R10, KEY + KY_ICMP_CODE)
+        a.jmp_reg(0x5D, R3, R4, fail)
+        a.label(f"{t}_ic_ok")
+        a.ldx(BPF_H, R3, R0, _fr("tcp_flags"))
+        a.jmp_imm(0x15, R3, 0, f"{t}_tf_ok")
+        a.ldx(BPF_DW, R4, R10, SPILL)
+        a.alu_reg(0x5F, R4, R3)                 # r4 &= rule flags
+        a.jmp_imm(0x15, R4, 0, fail)
+        a.label(f"{t}_tf_ok")
+        a.ldx(BPF_B, R3, R0, _fr("want_drops"))
+        a.jmp_imm(0x55, R3, 0, fail)            # TC path is never drops
+        # predicates hold; save the verdict before any further lookup
+        a.ldx(BPF_B, R3, R0, _fr("action"))
+        a.stx(BPF_DW, R10, R3, FACT)
+        a.ldx(BPF_B, R3, R0, _fr("peer_cidr_check"))
+        a.jmp_imm(0x15, R3, 0, f"{t}_verdict")
+        self.filter_key(peer_ip)
+        a.ld_map_fd(R1, self.filter_peers_fd)
+        a.mov_reg(R2, R10)
+        a.alu_imm(0x07, R2, FKEY)
+        a.call(HELPER_MAP_LOOKUP)
+        a.jmp_imm(0x15, R0, 0, fail)            # peer outside CIDR: retry
+        a.label(f"{t}_verdict")
+        a.ldx(BPF_DW, R3, R10, FACT)
+        a.jmp_imm(0x15, R3, 1, "flt_reject")    # NO_FILTER_REJECT
+        self.count(CTR_FILTER_ACCEPT)
+        a.jmp("flt_done")
+
+    def filter_block(self) -> None:
+        """filter.h no_flow_filter: source CIDR first, dst CIDR retry, then
+        reject-on-no-match. Divergence from the C path: `sample_override` is
+        ignored (sampling is baked at build time in assembler mode — the
+        loader warns when rules carry one)."""
+        a = self.a
+        self.filter_side("src", KY_SRC_IP, KY_DST_IP, fail="flt_dst")
+        a.label("flt_dst")
+        self.filter_side("dst", KY_DST_IP, KY_SRC_IP, fail="flt_nomatch")
+        a.label("flt_nomatch")
+        self.count(CTR_FILTER_NOMATCH)
+        a.jmp("out")            # rules configured but none matched
+        a.label("flt_reject")
+        self.count(CTR_FILTER_REJECT)
+        a.jmp("out")
+        a.label("flt_done")
+
     def build(self) -> bytes:
         a = self.a
         a.mov_reg(R6, R1)                       # r6 = ctx
@@ -370,6 +533,10 @@ class _Flow:
         self.parse_l4(l4=54, v="v6", icmp_proto=58)
 
         a.label("key_done")
+
+        # --- flow filter gate (filter.h twin; before trackers/upsert) ------
+        if self.filter_rules_fd is not None:
+            self.filter_block()
 
         # --- DNS correlation (stack-only; before the flow upsert) ----------
         if self.dns_inflight_fd is not None:
@@ -644,11 +811,14 @@ def build_flow_program(map_fd: int, direction: int = 0, sampling: int = 0,
                        flows_dns_fd: int | None = None,
                        dns_port: int = 53,
                        rtt_inflight_fd: int | None = None,
-                       flows_extra_fd: int | None = None) -> bytes:
+                       flows_extra_fd: int | None = None,
+                       filter_rules_fd: int | None = None,
+                       filter_peers_fd: int | None = None) -> bytes:
     """Assemble one per-direction flow program. Optional map fds gate the
     corresponding feature blocks, mirroring the C datapath's loader-rewritten
     `cfg_enable_*` constants (a feature whose map isn't wired costs zero
     instructions)."""
     return _Flow(map_fd, direction, sampling, ringbuf_fd, counters_fd,
                  dns_inflight_fd, flows_dns_fd, dns_port,
-                 rtt_inflight_fd, flows_extra_fd).build()
+                 rtt_inflight_fd, flows_extra_fd,
+                 filter_rules_fd, filter_peers_fd).build()

@@ -13,8 +13,6 @@ Two modes (reference analog: `pkg/tracer/tracer.go`):
 
 from __future__ import annotations
 
-import ctypes
-import ctypes.util
 import logging
 import os
 import time
@@ -56,8 +54,9 @@ class KernelFetcher:
         if os.path.exists(_OBJ_PATH):
             log.warning("clang-built object %s present but its libbpf load "
                         "path is not wired in this build; using the "
-                        "assembler datapath (filters/TLS/QUIC/probe trackers "
-                        "inactive)", _OBJ_PATH)
+                        "assembler datapath (TLS/QUIC inline trackers and "
+                        "probe-based features inactive; flows/DNS/RTT/"
+                        "filters active)", _OBJ_PATH)
         else:
             log.info("no clang-built BPF object (%s); using the in-tree "
                      "assembler datapath", _OBJ_PATH)
@@ -439,14 +438,17 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
     _PIN_PREFIX = "/sys/fs/bpf/netobserv_minflow_"
 
     BPF_MAP_TYPE_HASH = 1
+    BPF_MAP_TYPE_LPM_TRIE = 11
     BPF_MAP_TYPE_PERCPU_HASH = 5
     BPF_MAP_TYPE_PERCPU_ARRAY = 6
     BPF_MAP_TYPE_RINGBUF = 27
+    BPF_F_NO_PREALLOC = 1
 
     def __init__(self, cache_max_flows: int = 5000,
                  attach_mode: str = "tcx", sampling: int = 0,
                  enable_dns: bool = False, dns_port: int = 53,
                  enable_rtt: bool = False,
+                 enable_filters: bool = False,
                  enable_ringbuf_fallback: bool = True,
                  ringbuf_bytes: int = 1 << 17):
         from netobserv_tpu.datapath import asm_flowpath
@@ -485,6 +487,21 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             extra_rec.n_cpus = self._n_cpus
             self._features["extra"] = (extra_rec, binfmt.EXTRA_REC_DTYPE)
             rtt_q_fd, rtt_rec_fd = self._rtt_inflight.fd, extra_rec.fd
+        flt_rules_fd = flt_peers_fd = None
+        if enable_filters:
+            from netobserv_tpu.datapath import filter_compile
+
+            self._filter_rules = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_LPM_TRIE, filter_compile.FILTER_KEY_SIZE,
+                filter_compile.FILTER_RULE_SIZE,
+                filter_compile.MAX_FILTER_RULES, b"filter_rules",
+                flags=self.BPF_F_NO_PREALLOC)
+            self._filter_peers = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_LPM_TRIE, filter_compile.FILTER_KEY_SIZE,
+                1, filter_compile.MAX_FILTER_RULES, b"filter_peers",
+                flags=self.BPF_F_NO_PREALLOC)
+            flt_rules_fd = self._filter_rules.fd
+            flt_peers_fd = self._filter_peers.fd
         rb_fd = None
         if enable_ringbuf_fallback:
             self._rb_map = syscall_bpf.BpfMap.create(
@@ -502,7 +519,9 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                     ringbuf_fd=rb_fd, counters_fd=self._counters.fd,
                     dns_inflight_fd=dns_q_fd, flows_dns_fd=dns_rec_fd,
                     dns_port=dns_port, rtt_inflight_fd=rtt_q_fd,
-                    flows_extra_fd=rtt_rec_fd))
+                    flows_extra_fd=rtt_rec_fd,
+                    filter_rules_fd=flt_rules_fd,
+                    filter_peers_fd=flt_peers_fd))
             pin = f"{self._PIN_PREFIX}{os.getpid()}_{name}"
             if os.path.exists(pin):
                 os.unlink(pin)
@@ -523,6 +542,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         self._dns_inflight = None
         self._rtt_inflight = None
         self._rb_map = None
+        self._filter_rules = None
+        self._filter_peers = None
 
     @classmethod
     def load(cls, cfg: AgentConfig) -> "MinimalKernelFetcher":
@@ -532,12 +553,38 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             raise RuntimeError("kernel datapath requires root/CAP_BPF")
         if cfg.tc_attach_mode != "tcx" and shutil.which("tc") is None:
             raise RuntimeError("tc (iproute2) not found; cannot attach")
+        if cfg.flow_filter_rules and any(
+                getattr(r, "sample", 0) for r in cfg.parsed_filter_rules()):
+            log.warning("filter sample overrides are ignored by the "
+                        "assembler datapath (sampling is baked at load time; "
+                        "the clang object supports per-rule overrides)")
         return cls(cache_max_flows=cfg.cache_max_flows,
                    attach_mode=cfg.tc_attach_mode, sampling=cfg.sampling,
                    enable_dns=cfg.enable_dns_tracking,
                    dns_port=cfg.dns_tracking_port,
                    enable_rtt=cfg.enable_rtt,
+                   enable_filters=bool(cfg.flow_filter_rules),
                    enable_ringbuf_fallback=cfg.enable_flows_ringbuf_fallback)
+
+    def program_filters(self, rules) -> int:
+        """Compile FLOW_FILTER_RULES into this fetcher's own LPM tries (the
+        bpfman override programs pinned tries instead). The kernel-side gate
+        is active because the programs were built with the trie fds wired."""
+        from netobserv_tpu.datapath import filter_compile
+
+        if self._filter_rules is None:
+            if rules:
+                log.warning("filter maps not provisioned (enable_filters "
+                            "was off at load); FLOW_FILTER_RULES ignored")
+            return 0
+        compiled = filter_compile.compile_filters(rules)
+        for key, value in compiled.rules:
+            self._filter_rules.update(key, value)
+        for key, value in compiled.peers:
+            self._filter_peers.update(key, value)
+        log.info("programmed %d filter rules (+%d peer CIDRs) into the "
+                 "kernel gate", len(compiled.rules), len(compiled.peers))
+        return len(compiled.rules)
 
     def close(self) -> None:
         self._teardown_attachments()
@@ -552,6 +599,10 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             self._dns_inflight.close()
         if self._rtt_inflight is not None:
             self._rtt_inflight.close()
+        if self._filter_rules is not None:
+            self._filter_rules.close()
+        if self._filter_peers is not None:
+            self._filter_peers.close()
         for fmap, _dtype in self._features.values():
             fmap.close()
 

@@ -316,7 +316,8 @@ def _parse_packet(pkt: bytes):
     if proto in (6, 17) and len(l4) >= 4:  # TCP/UDP ports
         key["src_port"], key["dst_port"] = struct.unpack(">HH", l4[:4])
         if proto == 6 and len(l4) >= 14:
-            flags = l4[13]
+            from netobserv_tpu.model.flow import classify_tcp_flags
+            flags = classify_tcp_flags(l4[13])
     elif proto in (1, 58) and len(l4) >= 2:  # ICMP type/code
         key["icmp_type"], key["icmp_code"] = l4[0], l4[1]
     # L2 frame length (IP total + ethernet header) — the same accounting as

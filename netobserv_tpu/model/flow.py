@@ -41,6 +41,20 @@ class TcpFlags(enum.IntFlag):
     RST_ACK = 0x400
 
 
+def classify_tcp_flags(raw: int) -> int:
+    """Raw TCP flags byte -> datapath flag encoding with the synthetic
+    composite bits (single source for every userspace packet parser; kernel
+    twins: parse.h no_classify_tcp_flags, asm_flowpath tcp branch)."""
+    flags = raw
+    if raw & (TcpFlags.SYN | TcpFlags.ACK) == (TcpFlags.SYN | TcpFlags.ACK):
+        flags |= TcpFlags.SYN_ACK
+    if raw & (TcpFlags.FIN | TcpFlags.ACK) == (TcpFlags.FIN | TcpFlags.ACK):
+        flags |= TcpFlags.FIN_ACK
+    if raw & (TcpFlags.RST | TcpFlags.ACK) == (TcpFlags.RST | TcpFlags.ACK):
+        flags |= TcpFlags.RST_ACK
+    return int(flags)
+
+
 class GlobalCounter(enum.IntEnum):
     """Keys of the datapath's per-CPU global counter array.
 

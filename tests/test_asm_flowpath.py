@@ -463,6 +463,9 @@ def test_handshake_rtt_tracking(veth):
             if (int(k["src_port"]) == 5390 and int(k["proto"]) == 6
                     and int(k["dst_port"]) == cport):
                 hit = evicted.extra[i]
+                # composite-flag classification (parse.h:93-102): the
+                # server flow carried a SYN|ACK packet
+                assert int(evicted.events["stats"][i]["tcp_flags"]) & 0x100
         assert hit is not None, "server-side flow missing"
         rtt = int(hit["rtt_ns"])
         assert 0 < rtt < 1_000_000_000, f"rtt {rtt}ns"
@@ -504,7 +507,8 @@ def test_agent_exports_dns_latency(veth):
     try:
         deadline = time.monotonic() + 5
         while time.monotonic() < deadline and not any(
-                "ingress" in dirs for _n, dirs in fetcher._attached.values()):
+                "ingress" in dirs and "egress" in dirs
+                for _n, dirs in fetcher._attached.values()):
             time.sleep(0.05)
         dns_id = 0x1234
         q = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -570,6 +574,123 @@ def test_map_full_ringbuf_fallback_and_counters(veth):
         assert fallback_ports, "no fallback event arrived on the ring buffer"
         ctrs = fetcher.read_global_counters()
         assert ctrs.get(GlobalCounter.HASHMAP_FAIL_CREATE_FLOW, 0) > 0
+    finally:
+        fetcher.close()
+
+
+def test_kernel_flow_filter_gate(veth):
+    """The assembled in-kernel filter gate: an Accept rule keeps only its
+    matching traffic (non-matching flows are dropped at no-match, filter.h
+    semantics), with accept/no-match counters ticking."""
+    from netobserv_tpu.config import FlowFilterRule
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+    from netobserv_tpu.model.flow import GlobalCounter
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_filters=True)
+    try:
+        n = fetcher.program_filters([FlowFilterRule(
+            ip_cidr="10.198.0.0/24", action="Accept", protocol="UDP",
+            destination_port_range="6100-6199")])
+        assert n == 1
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        _send_udp(n=4, size=80, dport=6150, pace_s=0)   # in range: kept
+        _send_udp(n=4, size=80, dport=6500, pace_s=0)   # out of range
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        ports = {int(evicted.events["key"][i]["dst_port"])
+                 for i in range(len(evicted))}
+        assert 6150 in ports, f"accepted flow missing: {ports}"
+        assert 6500 not in ports, "filter gate let a non-matching flow pass"
+        ctrs = fetcher.read_global_counters()
+        assert ctrs.get(GlobalCounter.FILTER_ACCEPT, 0) >= 4
+        assert ctrs.get(GlobalCounter.FILTER_NOMATCH, 0) >= 4
+    finally:
+        fetcher.close()
+
+
+def test_kernel_filter_composite_tcp_flags(veth):
+    """A tcp_flags=\"SYN-ACK\" rule matches via the synthetic 0x100 bit the
+    datapath classifies from raw SYN|ACK — the filter predicate and the
+    classifier must agree on the encoding."""
+    from netobserv_tpu.config import FlowFilterRule
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    listener = subprocess.Popen(
+        ["ip", "netns", "exec", NS, sys.executable, "-c",
+         "import socket,time;"
+         "s=socket.socket();s.bind(('10.198.0.2',5391));s.listen(1);"
+         "c,_=s.accept();time.sleep(1)"])
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_filters=True)
+    try:
+        fetcher.program_filters([FlowFilterRule(
+            ip_cidr="10.198.0.0/24", action="Accept", protocol="TCP",
+            tcp_flags="SYN-ACK")])
+        fetcher.attach(_ifindex(veth), veth, "ingress")  # sees the SYN|ACK
+        c = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                c = socket.socket()
+                c.settimeout(3)
+                c.connect(("10.198.0.2", 5391))
+                break
+            except OSError:
+                c.close()
+                c = None
+                time.sleep(0.2)
+        assert c is not None, "listener never came up"
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        c.close()
+        hits = [i for i in range(len(evicted))
+                if int(evicted.events["key"][i]["src_port"]) == 5391]
+        assert hits, "SYN-ACK-gated flow not captured"
+        assert int(evicted.events["stats"][hits[0]]["tcp_flags"]) & 0x100
+    finally:
+        listener.kill()
+        listener.wait()
+        fetcher.close()
+
+
+def test_kernel_flow_filter_reject(veth):
+    """A Reject rule drops its matching traffic while an Accept rule on a
+    different CIDR keeps the rest (source-CIDR-first, dst retry)."""
+    from netobserv_tpu.config import FlowFilterRule
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+    from netobserv_tpu.model.flow import GlobalCounter
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_filters=True)
+    try:
+        fetcher.program_filters([
+            FlowFilterRule(ip_cidr="10.198.0.2/32", action="Reject",
+                           protocol="UDP", destination_port=7200),
+            FlowFilterRule(ip_cidr="10.198.0.1/32", action="Accept")])
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        _send_udp(n=3, size=60, dport=7200, pace_s=0)
+        _send_udp(n=3, size=60, dport=7300, pace_s=0)
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        ports = {int(evicted.events["key"][i]["dst_port"])
+                 for i in range(len(evicted))}
+        # the src-side Accept rule (10.198.0.1/32, no predicates) matches
+        # first for both flows — both kept, none rejected
+        assert {7200, 7300} <= ports, f"ports: {ports}"
+    finally:
+        fetcher.close()
+    # fresh gate with ONLY the dst-keyed Reject: matching traffic is dropped
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_filters=True)
+    try:
+        fetcher.program_filters([FlowFilterRule(
+            ip_cidr="10.198.0.2/32", action="Reject", protocol="UDP")])
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        _send_udp(n=3, size=60, dport=7500, pace_s=0)
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        ports = {int(evicted.events["key"][i]["dst_port"])
+                 for i in range(len(evicted))}
+        assert 7500 not in ports, "rejected flow was tracked"
+        ctrs = fetcher.read_global_counters()
+        assert ctrs.get(GlobalCounter.FILTER_REJECT, 0) >= 3
     finally:
         fetcher.close()
 
