@@ -449,14 +449,31 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                  enable_dns: bool = False, dns_port: int = 53,
                  enable_rtt: bool = False,
                  enable_filters: bool = False,
+                 enable_openssl: bool = False, libssl_path: str = "",
                  enable_ringbuf_fallback: bool = True,
-                 ringbuf_bytes: int = 1 << 17):
-        from netobserv_tpu.datapath import asm_flowpath
-        from netobserv_tpu.model.flow import GlobalCounter
-
+                 ringbuf_bytes: int = 1 << 17,
+                 # maps.h DEF_RINGBUF(ssl_events, 1<<27): 16KB * 1000/s * 5s
+                 ssl_ring_bytes: int = 1 << 27):
         self._init_empty_maps()
         self._sweep_stale_pins()
         self._mode = attach_mode
+        try:
+            self._provision(
+                cache_max_flows, sampling, enable_dns, dns_port, enable_rtt,
+                enable_filters, enable_openssl, libssl_path,
+                enable_ringbuf_fallback, ringbuf_bytes, ssl_ring_bytes)
+        except Exception:
+            # a half-provisioned fetcher must not leak map/prog fds (a
+            # supervisor retrying construction would exhaust fds)
+            self.close()
+            raise
+
+    def _provision(self, cache_max_flows, sampling, enable_dns, dns_port,
+                   enable_rtt, enable_filters, enable_openssl, libssl_path,
+                   enable_ringbuf_fallback, ringbuf_bytes, ssl_ring_bytes):
+        from netobserv_tpu.datapath import asm_flowpath
+        from netobserv_tpu.model.flow import GlobalCounter
+
         self._agg = syscall_bpf.BpfMap.create(
             self.BPF_MAP_TYPE_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
             binfmt.FLOW_STATS_DTYPE.itemsize, cache_max_flows, b"agg_flows")
@@ -509,6 +526,27 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 b"direct_flows")
             self._ringbuf = syscall_bpf.RingBufReader(self._rb_map)
             rb_fd = self._rb_map.fd
+        if enable_openssl:
+            from netobserv_tpu.datapath import asm_ssl, uprobe
+
+            path = libssl_path or uprobe.find_libssl()
+            if path is None:
+                raise RuntimeError("ENABLE_OPENSSL_TRACKING: no libssl.so "
+                                   "found (set the library path explicitly)")
+            self._ssl_map = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_RINGBUF, 0, 0, ssl_ring_bytes,
+                b"ssl_events")
+            ssl_prog = syscall_bpf.prog_load(
+                asm_ssl.build_ssl_write_program(self._ssl_map.fd),
+                prog_type=syscall_bpf.BPF_PROG_TYPE_KPROBE,
+                name=b"ssl_write")
+            try:
+                self._ssl_uprobe = uprobe.UprobeAttachment(
+                    ssl_prog, path, uprobe.elf_func_offset(path, "SSL_write"))
+            finally:
+                os.close(ssl_prog)  # the perf event holds its own reference
+            self._ssl_rb = syscall_bpf.RingBufReader(self._ssl_map)
+            log.info("OpenSSL plaintext tracer attached: uprobe on %s", path)
         # one program instance per direction so direction_first is correct
         self._prog_fds: dict[str, int] = {}
         self._pins: dict[str, str] = {}
@@ -532,13 +570,21 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         self._attached: dict[tuple[str, int], tuple[str, dict]] = {}
 
     def _init_empty_maps(self) -> None:
-        """The inherited eviction path expects these BpfmanFetcher fields."""
+        """The inherited eviction path expects these BpfmanFetcher fields;
+        everything close() touches is initialized here so a failed
+        _provision can clean up safely."""
         self._n_cpus = syscall_bpf.n_possible_cpus()
         self._base = ""
         self._features = {}
+        self._agg = None
+        self._prog_fds = {}
+        self._pins = {}
+        self._attached = {}
         self._counters = None
         self._ringbuf = None
         self._ssl_rb = None
+        self._ssl_map = None
+        self._ssl_uprobe = None
         self._dns_inflight = None
         self._rtt_inflight = None
         self._rb_map = None
@@ -564,6 +610,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                    dns_port=cfg.dns_tracking_port,
                    enable_rtt=cfg.enable_rtt,
                    enable_filters=bool(cfg.flow_filter_rules),
+                   enable_openssl=cfg.enable_openssl_tracking,
                    enable_ringbuf_fallback=cfg.enable_flows_ringbuf_fallback)
 
     def program_filters(self, rules) -> int:
@@ -588,7 +635,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
 
     def close(self) -> None:
         self._teardown_attachments()
-        self._agg.close()
+        if self._agg is not None:
+            self._agg.close()
         if self._counters is not None:
             self._counters.close()
         if self._ringbuf is not None:
@@ -603,6 +651,12 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             self._filter_rules.close()
         if self._filter_peers is not None:
             self._filter_peers.close()
+        if self._ssl_uprobe is not None:
+            self._ssl_uprobe.close()
+        if self._ssl_rb is not None:
+            self._ssl_rb.close()
+        if self._ssl_map is not None:
+            self._ssl_map.close()
         for fmap, _dtype in self._features.values():
             fmap.close()
 

@@ -17,6 +17,7 @@ import numpy as np
 
 from netobserv_tpu.datapath.fetcher import EvictedFlows
 from netobserv_tpu.model import binfmt
+from netobserv_tpu.model.flow import classify_tcp_flags
 from netobserv_tpu.model.flow import GlobalCounter, ip_to_16
 
 
@@ -316,7 +317,6 @@ def _parse_packet(pkt: bytes):
     if proto in (6, 17) and len(l4) >= 4:  # TCP/UDP ports
         key["src_port"], key["dst_port"] = struct.unpack(">HH", l4[:4])
         if proto == 6 and len(l4) >= 14:
-            from netobserv_tpu.model.flow import classify_tcp_flags
             flags = classify_tcp_flags(l4[13])
     elif proto in (1, 58) and len(l4) >= 2:  # ICMP type/code
         key["icmp_type"], key["icmp_code"] = l4[0], l4[1]

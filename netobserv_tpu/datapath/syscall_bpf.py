@@ -231,6 +231,7 @@ class BpfMap:
 # program load: raw instruction assembly + BPF_PROG_LOAD
 # ---------------------------------------------------------------------------
 BPF_PROG_LOAD = 5
+BPF_PROG_TYPE_KPROBE = 2
 BPF_PROG_TYPE_SCHED_CLS = 3
 
 

@@ -1,0 +1,144 @@
+"""Uprobe attachment via perf_event_open(2) — no libbpf required.
+
+Powers the self-managed OpenSSL plaintext tracer: resolve the target
+function's file offset from the library's ELF symbol tables, open a uprobe
+perf event on (path, offset), then bind a BPF_PROG_TYPE_KPROBE program to it
+(PERF_EVENT_IOC_SET_BPF + ENABLE). Reference analog: the cilium/ebpf
+link.Uprobe path used by pkg/tracer for SSL_write (tracer.go OpenSSL attach);
+the mechanism here is the same one libbpf uses internally.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import os
+import struct
+
+_libc = ctypes.CDLL(None, use_errno=True)
+# syscall number and the pt_regs argument offsets (asm_ssl.py) are per-arch;
+# only x86_64 is wired — other architectures must fail loudly, not call an
+# unrelated syscall with a pointer argument
+_PERF_EVENT_OPEN_BY_ARCH = {"x86_64": 298}
+
+
+def _perf_event_open_nr() -> int:
+    import platform
+
+    machine = platform.machine()
+    try:
+        return _PERF_EVENT_OPEN_BY_ARCH[machine]
+    except KeyError:
+        raise RuntimeError(
+            f"uprobe attach not wired for architecture {machine!r} "
+            "(x86_64 only: syscall number + pt_regs offsets)") from None
+
+PERF_FLAG_FD_CLOEXEC = 1 << 3
+PERF_EVENT_IOC_ENABLE = 0x2400
+PERF_EVENT_IOC_SET_BPF = 0x40042408
+
+SHT_SYMTAB, SHT_DYNSYM = 2, 11
+PT_LOAD = 1
+
+
+def elf_func_offset(path: str, symbol: str) -> int:
+    """File offset of `symbol` in the ELF at `path` (st_value translated
+    through the containing PT_LOAD segment — libbpf's elf_find_func_offset)."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if data[:4] != b"\x7fELF" or data[4] != 2:
+        raise ValueError(f"{path}: not a 64-bit ELF")
+    (e_phoff,) = struct.unpack_from("<Q", data, 0x20)
+    (e_shoff,) = struct.unpack_from("<Q", data, 0x28)
+    e_phentsize, e_phnum = struct.unpack_from("<HH", data, 0x36)
+    e_shentsize, e_shnum = struct.unpack_from("<HH", data, 0x3A)
+
+    sections = []
+    for i in range(e_shnum):
+        off = e_shoff + i * e_shentsize
+        (_name, stype, _flags, _addr, offset, size, link, _info, _align,
+         entsize) = struct.unpack_from("<IIQQQQIIQQ", data, off)
+        sections.append((stype, offset, size, link, entsize))
+
+    vaddr = None
+    for stype, offset, size, link, entsize in sections:
+        if stype not in (SHT_SYMTAB, SHT_DYNSYM) or not entsize:
+            continue
+        _t, str_off, str_size, _l, _e = sections[link]
+        for j in range(size // entsize):
+            st = offset + j * entsize
+            st_name, st_info = struct.unpack_from("<IB", data, st)
+            (st_value,) = struct.unpack_from("<Q", data, st + 8)
+            if not st_value or (st_info & 0xF) != 2:  # STT_FUNC
+                continue
+            end = data.index(b"\x00", str_off + st_name)
+            if data[str_off + st_name:end].decode() == symbol:
+                vaddr = st_value
+                break
+        if vaddr is not None:
+            break
+    if vaddr is None:
+        raise LookupError(f"{symbol} not found in {path}")
+
+    for i in range(e_phnum):
+        off = e_phoff + i * e_phentsize
+        p_type, _pf = struct.unpack_from("<II", data, off)
+        p_offset, p_vaddr, _paddr, p_filesz = struct.unpack_from(
+            "<QQQQ", data, off + 8)
+        if p_type == PT_LOAD and p_vaddr <= vaddr < p_vaddr + p_filesz:
+            return vaddr - p_vaddr + p_offset
+    raise LookupError(f"{symbol}: vaddr {vaddr:#x} outside any PT_LOAD")
+
+
+def uprobe_pmu_type() -> int:
+    with open("/sys/bus/event_source/devices/uprobe/type") as fh:
+        return int(fh.read())
+
+
+class UprobeAttachment:
+    """One live uprobe: the perf event fd keeps the probe alive; closing it
+    detaches. The path buffer must outlive perf_event_open, so it is held."""
+
+    def __init__(self, prog_fd: int, binary_path: str, file_offset: int):
+        self._path_buf = ctypes.create_string_buffer(
+            os.fsencode(binary_path) + b"\x00")
+        # struct perf_event_attr (zero-padded to 128B, size=VER5=112):
+        # type@0, size@4, config@8, sample_period@16, config1@56, config2@64
+        attr = bytearray(128)
+        struct.pack_into("<II", attr, 0, uprobe_pmu_type(), 112)
+        struct.pack_into("<Q", attr, 56, ctypes.addressof(self._path_buf))
+        struct.pack_into("<Q", attr, 64, file_offset)
+        buf = (ctypes.c_char * len(attr)).from_buffer(attr)
+        fd = _libc.syscall(_perf_event_open_nr(), buf, -1, 0, -1,
+                           PERF_FLAG_FD_CLOEXEC)
+        if fd < 0:
+            err = ctypes.get_errno()
+            raise OSError(err, f"perf_event_open(uprobe {binary_path}"
+                               f"+{file_offset:#x}): {os.strerror(err)}")
+        self.fd = fd
+        try:
+            fcntl.ioctl(fd, PERF_EVENT_IOC_SET_BPF, prog_fd)
+            fcntl.ioctl(fd, PERF_EVENT_IOC_ENABLE, 0)
+        except OSError:
+            os.close(fd)
+            raise
+
+    def close(self) -> None:
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+
+
+def find_libssl() -> str | None:
+    """Locate the OpenSSL shared library the way the dynamic linker would."""
+    candidates = []
+    for libdir in ("/usr/lib/x86_64-linux-gnu", "/usr/lib64", "/usr/lib",
+                   "/lib/x86_64-linux-gnu", "/lib64"):
+        try:
+            for name in sorted(os.listdir(libdir)):
+                if name.startswith("libssl.so"):
+                    candidates.append(os.path.join(libdir, name))
+        except OSError:
+            continue
+    return candidates[0] if candidates else None

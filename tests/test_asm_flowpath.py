@@ -695,6 +695,55 @@ def test_kernel_flow_filter_reject(veth):
         fetcher.close()
 
 
+def test_openssl_uprobe_plaintext_capture():
+    """REAL OpenSSL uprobe: the assembled SSL_write probe (attached via
+    perf_event_open on the live libssl) captures this process's plaintext
+    through the ssl_events ring buffer (flowpath_probes.c:380-399 twin)."""
+    import ctypes
+
+    import numpy as np
+
+    from netobserv_tpu.datapath import uprobe
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+    from netobserv_tpu.model import binfmt
+
+    path = uprobe.find_libssl()
+    if path is None:
+        pytest.skip("no libssl on this host")
+    fetcher = MinimalKernelFetcher(cache_max_flows=64, enable_openssl=True,
+                                   enable_ringbuf_fallback=False)
+    try:
+        lib = ctypes.CDLL(path)
+        lib.TLS_method.restype = ctypes.c_void_p
+        lib.SSL_CTX_new.restype = ctypes.c_void_p
+        lib.SSL_CTX_new.argtypes = [ctypes.c_void_p]
+        lib.SSL_new.restype = ctypes.c_void_p
+        lib.SSL_new.argtypes = [ctypes.c_void_p]
+        lib.SSL_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int]
+        s = lib.SSL_new(lib.SSL_CTX_new(lib.TLS_method()))
+        payload = b"credit card 4111-1111"
+        # the uprobe fires at function ENTRY; no handshake needed
+        lib.SSL_write(s, payload, len(payload))
+        got = None
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and got is None:
+            raw = fetcher.read_ssl(0.3)
+            if raw is None:
+                continue
+            ev = np.frombuffer(raw, dtype=binfmt.SSL_EVENT_DTYPE)[0]
+            data = bytes(ev["data"][:int(ev["data_len"])])
+            if data == payload:
+                got = ev
+        assert got is not None, "plaintext event never arrived"
+        assert int(got["ssl_type"]) == 1  # write direction
+        assert int(got["pid_tgid"]) >> 32 == os.getpid()
+        assert int(got["data_len"]) == len(payload)
+        assert int(got["timestamp_ns"]) > 0
+    finally:
+        fetcher.close()
+
+
 @pytest.fixture
 def veth_bridge():
     """nf0 enslaved to a bridge with the host IP on the bridge: every egress
