@@ -75,6 +75,10 @@ def _xr(field: str) -> int:
     return binfmt.EXTRA_REC_DTYPE.fields[field][1]
 
 
+def _qr(field: str) -> int:
+    return binfmt.QUIC_REC_DTYPE.fields[field][1]
+
+
 ST_FIRST = _st("first_seen_ns")
 ST_LAST = _st("last_seen_ns")
 ST_BYTES = _st("bytes")
@@ -121,6 +125,10 @@ LAT = DNSMETA - 8         # -280: dns latency (u64)
 CTRKEY = LAT - 8          # -288: global-counter index (u32)
 FKEY = CTRKEY - 24        # -312: no_filter_key (u32 prefix_len + 16B ip)
 FACT = FKEY - 8           # -320: matched rule's action, saved across lookups
+QMETA = FACT - 8          # -328: quic seen (u8 @+0), is_long (@+1), ver (@+4)
+TLSBUF = QMETA - 16       # -344: TLS header bytes via bpf_skb_load_bytes
+
+HELPER_SKB_LOAD_BYTES = 26
 
 # no_dns_corr_key field offsets (bpf/maps.h struct no_dns_corr_key)
 CK_SPORT, CK_DPORT, CK_SRC_IP, CK_DST_IP, CK_ID, CK_PROTO = 0, 2, 4, 20, 36, 38
@@ -147,7 +155,9 @@ class _Flow:
     def __init__(self, map_fd: int, direction: int, sampling: int,
                  ringbuf_fd, counters_fd, dns_inflight_fd, flows_dns_fd,
                  dns_port: int, rtt_inflight_fd=None, flows_extra_fd=None,
-                 filter_rules_fd=None, filter_peers_fd=None):
+                 filter_rules_fd=None, filter_peers_fd=None,
+                 flows_quic_fd=None, quic_mode: int = 0,
+                 enable_tls: bool = False):
         self.a = Asm()
         self.map_fd = map_fd
         self.direction = direction
@@ -161,6 +171,9 @@ class _Flow:
         self.flows_extra_fd = flows_extra_fd
         self.filter_rules_fd = filter_rules_fd
         self.filter_peers_fd = filter_peers_fd
+        self.flows_quic_fd = flows_quic_fd
+        self.quic_mode = quic_mode
+        self.enable_tls = enable_tls
         self._ctr_n = 0
 
     # --- helpers -----------------------------------------------------------
@@ -214,6 +227,8 @@ class _Flow:
             a.alu_imm(0x47, R3, bit)
             a.label(f"cls_{v}_{bit:x}")
         a.stx(BPF_DW, R10, R3, SPILL)
+        if self.enable_tls:
+            self.parse_tls(l4, v)
         a.jmp(f"ports_{v}")
 
         a.label(f"icmp_{v}")
@@ -233,15 +248,16 @@ class _Flow:
         a.ldx(BPF_H, R3, R7, l4 + 2)
         a.endian_be(R3, 16)
         a.stx(BPF_H, R10, R3, KEY + KY_DPORT)
+        done = f"udp_trk_done_{v}"
         if self.dns_inflight_fd is not None:
             # DNS header parse (UDP on the DNS port only)
-            a.jmp_imm(0x55, R9, 17, "key_done")     # TCP DNS: untracked
+            a.jmp_imm(0x55, R9, 17, "key_done")     # TCP: no UDP trackers
             a.ldx(BPF_H, R3, R10, KEY + KY_SPORT)
             a.jmp_imm(0x15, R3, self.dns_port, f"dns_hdr_{v}")
             a.ldx(BPF_H, R3, R10, KEY + KY_DPORT)
-            a.jmp_imm(0x55, R3, self.dns_port, "key_done")
+            a.jmp_imm(0x55, R3, self.dns_port, f"dns_done_{v}")
             a.label(f"dns_hdr_{v}")
-            self.bounds(l4 + 8 + 12, "key_done")    # full no_dns_hdr
+            self.bounds(l4 + 8 + 12, f"dns_done_{v}")   # full no_dns_hdr
             a.ldx(BPF_H, R3, R7, l4 + 8)            # transaction id
             a.endian_be(R3, 16)
             a.stx(BPF_H, R10, R3, DNSMETA)
@@ -249,7 +265,138 @@ class _Flow:
             a.endian_be(R3, 16)
             a.stx(BPF_H, R10, R3, DNSMETA + 2)
             a.st_imm(BPF_W, R10, DNSMETA + 4, 1)    # header seen
+            a.label(f"dns_done_{v}")
+        if self.flows_quic_fd is not None and self.quic_mode:
+            # QUIC invariants (quic.h / RFC 8999): fixed bit, long-header
+            # version, short-header established marker. Reads go through
+            # bpf_skb_load_bytes — UDP GSO payload lives in page frags where
+            # packet-pointer bounds stop at the linear headers.
+            a.jmp_imm(0x55, R9, 17, "key_done")     # UDP only
+            if self.quic_mode == 1:                 # only UDP/443
+                a.ldx(BPF_H, R3, R10, KEY + KY_SPORT)
+                a.jmp_imm(0x15, R3, 443, f"quic_port_ok_{v}")
+                a.ldx(BPF_H, R3, R10, KEY + KY_DPORT)
+                a.jmp_imm(0x55, R3, 443, done)
+                a.label(f"quic_port_ok_{v}")
+            a.mov_reg(R1, R6)
+            a.mov_imm(R2, l4 + 8)                   # UDP payload offset
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, TLSBUF)
+            a.mov_imm(R4, 5)                        # first byte + version
+            a.call(HELPER_SKB_LOAD_BYTES)
+            a.jmp_imm(0x55, R0, 0, done)            # payload too short
+            a.ldx(BPF_B, R3, R10, TLSBUF)
+            a.jmp_imm(0x45, R3, 0x40, f"quic_fixed_{v}")  # fixed bit set?
+            a.jmp(done)
+            a.label(f"quic_fixed_{v}")
+            a.jmp_imm(0x45, R3, 0x80, f"quic_long_{v}")   # long header?
+            a.st_imm(BPF_B, R10, QMETA, 1)          # short: established
+            a.jmp(done)
+            a.label(f"quic_long_{v}")
+            a.mov_imm(R4, 0)                        # version: 4 BE bytes
+            for i in range(4):
+                a.alu_imm(0x67, R4, 8)
+                a.ldx(BPF_B, R3, R10, TLSBUF + 1 + i)
+                a.alu_reg(0x4F, R4, R3)
+            a.jmp_imm(0x15, R4, 0, done)            # version negotiation
+            a.stx(BPF_W, R10, R4, QMETA + 4)
+            a.st_imm(BPF_B, R10, QMETA, 1)
+            a.st_imm(BPF_B, R10, QMETA + 1, 1)      # long header seen
+        a.label(done)
         a.jmp("key_done")
+
+    def parse_tls(self, l4: int, v: str) -> None:
+        """Passive TLS metadata from the TCP payload (tls.h subset): record
+        -type bitmap, ClientHello/ServerHello legacy version, ServerHello
+        cipher suite. Stored into the stack stats (VAL) — the miss path
+        inserts them as-built; the hit path merges them (version-mismatch
+        flagging included). Skipped vs tls.h: the ServerHello extension walk
+        (TLS 1.3 supported_versions + key_share stay clang-object-only).
+
+        Reads go through bpf_skb_load_bytes, NOT direct packet pointers:
+        locally-generated TCP payload usually lives in skb page frags, where
+        data_end covers only the linear headers and pointer-based reads see
+        nothing (the classic non-linear-skb trap).
+
+        Runs inside the TCP branch with r9 = proto(6); r9 is used as scratch
+        and restored on every exit path."""
+        a = self.a
+        t = f"tls_{v}"
+        done = f"{t}_done"
+
+        def load_bytes(off_reg_setup, dst_off: int, n: int) -> None:
+            """bpf_skb_load_bytes(skb, r2=offset, r3=stack+dst_off, r4=n);
+            jumps to `done` on failure (offset beyond the packet)."""
+            a.mov_reg(R1, R6)
+            off_reg_setup()                     # materialize r2 = offset
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, TLSBUF + dst_off)
+            a.mov_imm(R4, n)
+            a.call(HELPER_SKB_LOAD_BYTES)
+            a.jmp_imm(0x55, R0, 0, done)
+
+        # payload offset = l4 + doff; doff byte is always in the linear area
+        a.ldx(BPF_B, R4, R7, l4 + 12)
+        a.alu_imm(0x57, R4, 0xF0)
+        a.alu_imm(0x77, R4, 2)
+        a.jmp_imm(0xA5, R4, 20, done)           # doff < 20: not TCP
+        a.mov_reg(R9, R4)
+        a.alu_imm(0x07, R9, l4)                 # r9 = payload offset (kept)
+        # payload-less segments (pure ACKs — the majority) skip the helper
+        a.ldx(BPF_W, R3, R6, SKB_LEN)
+        a.jmp_reg(0xBD, R3, R9, done)           # skb->len <= payload off
+
+        # record header(5) + hs type(1) + len(3) + hello version(2) = 11
+        load_bytes(lambda: a.mov_reg(R2, R9), 0, 11)
+        a.ldx(BPF_B, R3, R10, TLSBUF + 1)       # record version hi byte
+        a.jmp_imm(0x55, R3, 0x03, done)         # not SSL3.x: not TLS
+        a.ldx(BPF_B, R3, R10, TLSBUF)           # record type
+        for rec_type, bit in ((20, 0x01), (21, 0x02), (22, 0x04),
+                              (23, 0x08), (24, 0x10)):
+            a.jmp_imm(0x15, R3, rec_type, f"{t}_bit_{bit:x}")
+        a.jmp(done)                             # unknown record type
+        for rec_type, bit in ((20, 0x01), (21, 0x02), (22, 0x04),
+                              (23, 0x08), (24, 0x10)):
+            a.label(f"{t}_bit_{bit:x}")
+            a.ldx(BPF_B, R3, R10, VAL + _st("tls_types"))
+            a.alu_imm(0x47, R3, bit)
+            a.stx(BPF_B, R10, R3, VAL + _st("tls_types"))
+            if rec_type == 22:
+                a.jmp(f"{t}_hs")                # handshake: parse the hello
+            else:
+                a.jmp(done)
+        a.label(f"{t}_hs")
+        a.ldx(BPF_B, R5, R10, TLSBUF + 5)       # handshake type
+        a.jmp_imm(0x15, R5, 1, f"{t}_hello")    # ClientHello
+        a.jmp_imm(0x55, R5, 2, done)            # not ServerHello either
+        a.label(f"{t}_hello")
+        a.ldx(BPF_B, R3, R10, TLSBUF + 9)       # legacy hello version (BE)
+        a.alu_imm(0x67, R3, 8)
+        a.ldx(BPF_B, R4, R10, TLSBUF + 10)
+        a.alu_reg(0x4F, R3, R4)
+        a.jmp_imm(0x15, R3, 0, f"{t}_sh")
+        a.ldx(BPF_H, R4, R10, VAL + _st("ssl_version"))
+        a.jmp_imm(0x55, R4, 0, f"{t}_sh")       # first hello version wins
+        a.stx(BPF_H, R10, R3, VAL + _st("ssl_version"))
+        a.label(f"{t}_sh")
+        a.jmp_imm(0x55, R5, 2, done)            # cipher: ServerHello only
+        # session id length at payload+43 (5 rec + 4 hs + 2 ver + 32 random)
+        load_bytes(lambda: (a.mov_reg(R2, R9), a.alu_imm(0x07, R2, 43)),
+                   11, 1)
+        a.ldx(BPF_B, R5, R10, TLSBUF + 11)
+        a.jmp_imm(0x25, R5, 32, done)           # sid_len > 32: implausible
+        a.alu_imm(0x07, R5, 44)                 # cipher offset delta
+        # cipher suite at payload + 44 + sid_len
+        load_bytes(lambda: (a.mov_reg(R2, R9), a.alu_reg(0x0F, R2, R5)),
+                   12, 2)
+        a.ldx(BPF_B, R3, R10, TLSBUF + 12)
+        a.alu_imm(0x67, R3, 8)
+        a.ldx(BPF_B, R4, R10, TLSBUF + 13)
+        a.alu_reg(0x4F, R3, R4)
+        a.stx(BPF_H, R10, R3, VAL + _st("tls_cipher_suite"))
+        a.label(done)
+        a.mov_imm(R9, 6)                        # restore proto for the
+        # shared ports/tracker gates downstream
 
     def copy_ip16(self, pkt_off: int, key_off: int) -> None:
         """Copy a 16-byte address from the packet to the key (word chunks:
@@ -477,6 +624,7 @@ class _Flow:
         a.st_imm(BPF_DW, R10, SPILL, 0)
         a.st_imm(BPF_DW, R10, DNSMETA, 0)
         a.st_imm(BPF_DW, R10, LAT, 0)
+        a.st_imm(BPF_DW, R10, QMETA, 0)
 
         # MACs: frame dst at 0..5, src at 6..11 (stats carry the packet's)
         a.ldx(BPF_W, R3, R7, 6)
@@ -606,6 +754,29 @@ class _Flow:
         if self.sampling > 1:
             a.mov_imm(R3, self.sampling)
             a.stx(BPF_W, R0, R3, ST_SAMPLING)
+        if self.enable_tls:
+            # TLS merge on the counting path (flowpath.c:64-80): first
+            # version wins; a later conflicting hello sets the mismatch flag
+            a.ldx(BPF_H, R3, R10, VAL + _st("ssl_version"))
+            a.jmp_imm(0x15, R3, 0, "tlsm_ciph")
+            a.ldx(BPF_H, R4, R0, _st("ssl_version"))
+            a.jmp_imm(0x15, R4, 0, "tlsm_store")
+            a.jmp_reg(0x1D, R4, R3, "tlsm_ciph")    # same version: ok
+            a.ldx(BPF_B, R5, R0, _st("misc_flags"))
+            a.alu_imm(0x47, R5, 0x01)               # NO_MISC_SSL_MISMATCH
+            a.stx(BPF_B, R0, R5, _st("misc_flags"))
+            a.jmp("tlsm_ciph")
+            a.label("tlsm_store")
+            a.stx(BPF_H, R0, R3, _st("ssl_version"))
+            a.label("tlsm_ciph")
+            a.ldx(BPF_H, R3, R10, VAL + _st("tls_cipher_suite"))
+            a.jmp_imm(0x15, R3, 0, "tlsm_types")
+            a.stx(BPF_H, R0, R3, _st("tls_cipher_suite"))
+            a.label("tlsm_types")
+            a.ldx(BPF_B, R3, R10, VAL + _st("tls_types"))
+            a.ldx(BPF_B, R4, R0, _st("tls_types"))
+            a.alu_reg(0x4F, R3, R4)
+            a.stx(BPF_B, R0, R3, _st("tls_types"))
         # dscp: latest nonzero wins (flowpath.c:62-63)
         a.ldx(BPF_B, R3, R10, VAL + ST_DSCP)
         a.jmp_imm(0x15, R3, 0, "dns_rec")
@@ -730,9 +901,9 @@ class _Flow:
             # latency: max of observed (dns.h:116-117)
             a.ldx(BPF_DW, R3, R0, _dr("latency_ns"))
             a.ldx(BPF_DW, R4, R10, LAT)
-            a.jmp_reg(0x3D, R3, R4, "out")      # existing >= new: keep
+            a.jmp_reg(0x3D, R3, R4, "extra_rec")  # existing >= new: keep
             a.stx(BPF_DW, R0, R4, _dr("latency_ns"))
-            a.jmp("out")                        # (dns packet: no rtt rec)
+            a.jmp("extra_rec")
             a.label("dnsrec_miss")
             for off in range(DNSREC, DNSREC + DNSREC_SIZE, 8):
                 a.st_imm(BPF_DW, R10, off, 0)
@@ -754,17 +925,17 @@ class _Flow:
             a.alu_imm(0x07, R3, DNSREC)
             a.mov_imm(R4, 0)                    # BPF_ANY
             a.call(HELPER_MAP_UPDATE)
-            a.jmp_imm(0x15, R0, 0, "out")
+            a.jmp_imm(0x15, R0, 0, "extra_rec")
             self.count(CTR_FAIL_UPDATE_DNS)
-            a.jmp("out")
+            a.jmp("extra_rec")
 
         # --- RTT feature record (flows_extra; additional_metrics_t twin) ---
         a.label("extra_rec")
         if self.flows_extra_fd is not None:
             a.ldx(BPF_B, R3, R10, KEY + KY_PROTO)
-            a.jmp_imm(0x55, R3, 6, "out")
+            a.jmp_imm(0x55, R3, 6, "quic_rec")
             a.ldx(BPF_DW, R3, R10, LAT)         # measured handshake rtt
-            a.jmp_imm(0x15, R3, 0, "out")
+            a.jmp_imm(0x15, R3, 0, "quic_rec")
             a.ld_map_fd(R1, self.flows_extra_fd)
             a.mov_reg(R2, R10)
             a.alu_imm(0x07, R2, KEY)
@@ -774,9 +945,9 @@ class _Flow:
             a.stx(BPF_DW, R0, R4, _xr("last_seen_ns"))
             a.ldx(BPF_DW, R3, R0, _xr("rtt_ns"))
             a.ldx(BPF_DW, R4, R10, LAT)
-            a.jmp_reg(0x3D, R3, R4, "out")      # existing >= new: keep
+            a.jmp_reg(0x3D, R3, R4, "quic_rec")  # existing >= new: keep
             a.stx(BPF_DW, R0, R4, _xr("rtt_ns"))
-            a.jmp("out")
+            a.jmp("quic_rec")
             a.label("xrec_miss")
             # build in the DNSREC scratch (32B needed, 64B slot, same align)
             for off in range(DNSREC, DNSREC + 32, 8):
@@ -795,8 +966,64 @@ class _Flow:
             a.alu_imm(0x07, R3, DNSREC)
             a.mov_imm(R4, 0)                    # BPF_ANY
             a.call(HELPER_MAP_UPDATE)
-            a.jmp_imm(0x15, R0, 0, "out")
+            a.jmp_imm(0x15, R0, 0, "quic_rec")
             self.count(CTR_FAIL_UPDATE_FLOW)
+
+        # --- QUIC feature record (flows_quic; quic.h twin) -----------------
+        a.label("quic_rec")
+        if self.flows_quic_fd is not None and self.quic_mode:
+            a.ldx(BPF_B, R3, R10, QMETA)        # quic invariants seen?
+            a.jmp_imm(0x15, R3, 0, "out")
+            a.ld_map_fd(R1, self.flows_quic_fd)
+            a.mov_reg(R2, R10)
+            a.alu_imm(0x07, R2, KEY)
+            a.call(HELPER_MAP_LOOKUP)
+            a.jmp_imm(0x15, R0, 0, "qrec_miss")
+            # NOTE: like quic.h:42-50, the hit path does not backfill
+            # first_seen/eth into a fresh per-CPU slot (another CPU created
+            # the entry); consumers read only version/header flags
+            a.ldx(BPF_DW, R4, R10, NOW)
+            a.stx(BPF_DW, R0, R4, _qr("last_seen_ns"))
+            a.ldx(BPF_W, R3, R0, _qr("version"))
+            a.ldx(BPF_W, R4, R10, QMETA + 4)
+            a.jmp_reg(0x3D, R3, R4, "qrec_hdr")  # existing >= new: keep
+            a.stx(BPF_W, R0, R4, _qr("version"))
+            a.label("qrec_hdr")
+            a.ldx(BPF_B, R3, R10, QMETA + 1)
+            a.jmp_imm(0x15, R3, 0, "qrec_short")
+            a.mov_imm(R4, 1)
+            a.stx(BPF_B, R0, R4, _qr("seen_long_hdr"))
+            a.jmp("out")
+            a.label("qrec_short")
+            a.mov_imm(R4, 1)
+            a.stx(BPF_B, R0, R4, _qr("seen_short_hdr"))
+            a.jmp("out")
+            a.label("qrec_miss")
+            for off in range(DNSREC, DNSREC + 24, 8):
+                a.st_imm(BPF_DW, R10, off, 0)
+            a.ldx(BPF_DW, R4, R10, NOW)
+            a.stx(BPF_DW, R10, R4, DNSREC + _qr("first_seen_ns"))
+            a.stx(BPF_DW, R10, R4, DNSREC + _qr("last_seen_ns"))
+            a.ldx(BPF_W, R4, R10, QMETA + 4)
+            a.stx(BPF_W, R10, R4, DNSREC + _qr("version"))
+            a.ldx(BPF_H, R4, R10, VAL + ST_ETH)
+            a.stx(BPF_H, R10, R4, DNSREC + _qr("eth_protocol"))
+            a.ldx(BPF_B, R3, R10, QMETA + 1)
+            a.jmp_imm(0x15, R3, 0, "qrec_fr_short")
+            a.mov_imm(R4, 1)
+            a.stx(BPF_B, R10, R4, DNSREC + _qr("seen_long_hdr"))
+            a.jmp("qrec_write")
+            a.label("qrec_fr_short")
+            a.mov_imm(R4, 1)
+            a.stx(BPF_B, R10, R4, DNSREC + _qr("seen_short_hdr"))
+            a.label("qrec_write")
+            a.ld_map_fd(R1, self.flows_quic_fd)
+            a.mov_reg(R2, R10)
+            a.alu_imm(0x07, R2, KEY)
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, DNSREC)
+            a.mov_imm(R4, 0)                    # BPF_ANY
+            a.call(HELPER_MAP_UPDATE)
 
         a.label("out")
         a.mov_imm(R0, 0)                        # TC_ACT_OK
@@ -813,7 +1040,10 @@ def build_flow_program(map_fd: int, direction: int = 0, sampling: int = 0,
                        rtt_inflight_fd: int | None = None,
                        flows_extra_fd: int | None = None,
                        filter_rules_fd: int | None = None,
-                       filter_peers_fd: int | None = None) -> bytes:
+                       filter_peers_fd: int | None = None,
+                       flows_quic_fd: int | None = None,
+                       quic_mode: int = 0,
+                       enable_tls: bool = False) -> bytes:
     """Assemble one per-direction flow program. Optional map fds gate the
     corresponding feature blocks, mirroring the C datapath's loader-rewritten
     `cfg_enable_*` constants (a feature whose map isn't wired costs zero
@@ -821,4 +1051,5 @@ def build_flow_program(map_fd: int, direction: int = 0, sampling: int = 0,
     return _Flow(map_fd, direction, sampling, ringbuf_fd, counters_fd,
                  dns_inflight_fd, flows_dns_fd, dns_port,
                  rtt_inflight_fd, flows_extra_fd,
-                 filter_rules_fd, filter_peers_fd).build()
+                 filter_rules_fd, filter_peers_fd,
+                 flows_quic_fd, quic_mode, enable_tls).build()

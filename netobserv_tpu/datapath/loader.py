@@ -448,7 +448,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                  attach_mode: str = "tcx", sampling: int = 0,
                  enable_dns: bool = False, dns_port: int = 53,
                  enable_rtt: bool = False,
-                 enable_filters: bool = False,
+                 enable_filters: bool = False, quic_mode: int = 0,
+                 enable_tls: bool = False,
                  enable_openssl: bool = False, libssl_path: str = "",
                  enable_ringbuf_fallback: bool = True,
                  ringbuf_bytes: int = 1 << 17,
@@ -460,8 +461,9 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         try:
             self._provision(
                 cache_max_flows, sampling, enable_dns, dns_port, enable_rtt,
-                enable_filters, enable_openssl, libssl_path,
-                enable_ringbuf_fallback, ringbuf_bytes, ssl_ring_bytes)
+                enable_filters, quic_mode, enable_tls, enable_openssl,
+                libssl_path, enable_ringbuf_fallback, ringbuf_bytes,
+                ssl_ring_bytes)
         except Exception:
             # a half-provisioned fetcher must not leak map/prog fds (a
             # supervisor retrying construction would exhaust fds)
@@ -469,8 +471,9 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             raise
 
     def _provision(self, cache_max_flows, sampling, enable_dns, dns_port,
-                   enable_rtt, enable_filters, enable_openssl, libssl_path,
-                   enable_ringbuf_fallback, ringbuf_bytes, ssl_ring_bytes):
+                   enable_rtt, enable_filters, quic_mode, enable_tls,
+                   enable_openssl, libssl_path, enable_ringbuf_fallback,
+                   ringbuf_bytes, ssl_ring_bytes):
         from netobserv_tpu.datapath import asm_flowpath
         from netobserv_tpu.model.flow import GlobalCounter
 
@@ -504,6 +507,15 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             extra_rec.n_cpus = self._n_cpus
             self._features["extra"] = (extra_rec, binfmt.EXTRA_REC_DTYPE)
             rtt_q_fd, rtt_rec_fd = self._rtt_inflight.fd, extra_rec.fd
+        quic_fd = None
+        if quic_mode:
+            quic_rec = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_PERCPU_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
+                binfmt.QUIC_REC_DTYPE.itemsize, cache_max_flows,
+                b"flows_quic")
+            quic_rec.n_cpus = self._n_cpus
+            self._features["quic"] = (quic_rec, binfmt.QUIC_REC_DTYPE)
+            quic_fd = quic_rec.fd
         flt_rules_fd = flt_peers_fd = None
         if enable_filters:
             from netobserv_tpu.datapath import filter_compile
@@ -529,10 +541,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         if enable_openssl:
             from netobserv_tpu.datapath import asm_ssl, uprobe
 
-            path = libssl_path or uprobe.find_libssl()
-            if path is None:
-                raise RuntimeError("ENABLE_OPENSSL_TRACKING: no libssl.so "
-                                   "found (set the library path explicitly)")
+            path, sym_off = uprobe.resolve_ssl_library(libssl_path)
             self._ssl_map = syscall_bpf.BpfMap.create(
                 self.BPF_MAP_TYPE_RINGBUF, 0, 0, ssl_ring_bytes,
                 b"ssl_events")
@@ -542,7 +551,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 name=b"ssl_write")
             try:
                 self._ssl_uprobe = uprobe.UprobeAttachment(
-                    ssl_prog, path, uprobe.elf_func_offset(path, "SSL_write"))
+                    ssl_prog, path, sym_off)
             finally:
                 os.close(ssl_prog)  # the perf event holds its own reference
             self._ssl_rb = syscall_bpf.RingBufReader(self._ssl_map)
@@ -559,7 +568,9 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                     dns_port=dns_port, rtt_inflight_fd=rtt_q_fd,
                     flows_extra_fd=rtt_rec_fd,
                     filter_rules_fd=flt_rules_fd,
-                    filter_peers_fd=flt_peers_fd))
+                    filter_peers_fd=flt_peers_fd,
+                    flows_quic_fd=quic_fd, quic_mode=quic_mode,
+                    enable_tls=enable_tls))
             pin = f"{self._PIN_PREFIX}{os.getpid()}_{name}"
             if os.path.exists(pin):
                 os.unlink(pin)
@@ -610,7 +621,10 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                    dns_port=cfg.dns_tracking_port,
                    enable_rtt=cfg.enable_rtt,
                    enable_filters=bool(cfg.flow_filter_rules),
+                   quic_mode=cfg.quic_tracking_mode,
+                   enable_tls=cfg.enable_tls_tracking,
                    enable_openssl=cfg.enable_openssl_tracking,
+                   libssl_path=cfg.openssl_path,
                    enable_ringbuf_fallback=cfg.enable_flows_ringbuf_fallback)
 
     def program_filters(self, rules) -> int:

@@ -131,14 +131,39 @@ class UprobeAttachment:
 
 
 def find_libssl() -> str | None:
-    """Locate the OpenSSL shared library the way the dynamic linker would."""
+    """Locate the OpenSSL shared library, preferring the newest ABI version
+    (a leftover libssl.so.1.1 next to libssl.so.3 must not win — processes
+    load the current SONAME) and real versioned files over dev symlinks."""
     candidates = []
     for libdir in ("/usr/lib/x86_64-linux-gnu", "/usr/lib64", "/usr/lib",
                    "/lib/x86_64-linux-gnu", "/lib64"):
         try:
-            for name in sorted(os.listdir(libdir)):
+            for name in os.listdir(libdir):
                 if name.startswith("libssl.so"):
-                    candidates.append(os.path.join(libdir, name))
+                    suffix = name[len("libssl.so"):].lstrip(".")
+                    version = tuple(
+                        int(p) for p in suffix.split(".") if p.isdigit())
+                    candidates.append((version, os.path.join(libdir, name)))
         except OSError:
             continue
-    return candidates[0] if candidates else None
+    return max(candidates)[1] if candidates else None
+
+
+def resolve_ssl_library(preferred: str = "") -> tuple[str, int]:
+    """(path, SSL_write file offset): the configured path when it carries
+    the symbol (OPENSSL_PATH may point at a vendored library), else the
+    system libssl."""
+    if preferred:
+        try:
+            return preferred, elf_func_offset(preferred, "SSL_write")
+        except (OSError, ValueError, LookupError) as exc:
+            import logging
+
+            logging.getLogger("netobserv_tpu.datapath.uprobe").warning(
+                "OPENSSL_PATH %s unusable for the SSL_write uprobe (%s); "
+                "falling back to the system libssl", preferred, exc)
+    path = find_libssl()
+    if path is None:
+        raise RuntimeError("no libssl.so found (set OPENSSL_PATH to the "
+                           "library your workload loads)")
+    return path, elf_func_offset(path, "SSL_write")

@@ -695,6 +695,118 @@ def test_kernel_flow_filter_reject(veth):
         fetcher.close()
 
 
+def _client_hello(ver=0x0303):
+    import struct as _s
+    hs = b"\x01" + (2 + 32 + 1).to_bytes(3, "big") + _s.pack(">H", ver) + \
+        b"\x00" * 32 + b"\x00"
+    return b"\x16\x03\x01" + _s.pack(">H", len(hs)) + hs
+
+
+def _server_hello(ver=0x0303, cipher=0x1301):
+    import struct as _s
+    body = _s.pack(">H", ver) + b"\x00" * 32 + b"\x00" + \
+        _s.pack(">H", cipher) + b"\x00" + _s.pack(">H", 0)
+    hs = b"\x02" + len(body).to_bytes(3, "big") + body
+    return b"\x16\x03\x03" + _s.pack(">H", len(hs)) + hs
+
+
+def test_tls_passive_tracking(veth):
+    """Crafted TLS hellos over a live TCP connection: the datapath records
+    the hello version, the ServerHello cipher suite, and the record-type
+    bitmap inline in the flow stats (tls.h subset twin)."""
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    listener = subprocess.Popen(
+        ["ip", "netns", "exec", NS, sys.executable, "-c",
+         "import socket,sys;"
+         "s=socket.socket();s.bind(('10.198.0.2',5443));s.listen(1);"
+         "c,_=s.accept();c.recv(512);"
+         f"c.sendall(bytes.fromhex('{_server_hello().hex()}'));"
+         "import time;time.sleep(1)"])
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_tls=True)
+    try:
+        fetcher.attach(_ifindex(veth), veth, "both")
+        c = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                c = socket.socket()
+                c.settimeout(3)
+                c.connect(("10.198.0.2", 5443))
+                break
+            except OSError:
+                c.close()
+                c = None
+                time.sleep(0.2)
+        assert c is not None, "listener never came up"
+        cport = c.getsockname()[1]
+        c.sendall(_client_hello(ver=0x0303))
+        c.recv(512)                       # the crafted ServerHello
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        c.close()
+        stats = {}
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            if int(k["proto"]) == 6 and cport in (
+                    int(k["src_port"]), int(k["dst_port"])):
+                stats[int(k["src_port"])] = evicted.events["stats"][i]
+        cli = stats.get(cport)            # client -> server flow
+        srv = stats.get(5443)             # server -> client flow
+        assert cli is not None and srv is not None, f"flows: {list(stats)}"
+        assert int(cli["ssl_version"]) == 0x0303   # ClientHello version
+        assert int(cli["tls_types"]) & 0x04        # handshake record seen
+        assert int(srv["ssl_version"]) == 0x0303   # ServerHello version
+        assert int(srv["tls_cipher_suite"]) == 0x1301
+        assert int(srv["misc_flags"]) == 0         # no version mismatch
+    finally:
+        listener.kill()
+        listener.wait()
+        fetcher.close()
+
+
+def test_quic_tracking(veth):
+    """Crafted QUIC packets (RFC 8999 invariants) across the veth: a long
+    header records the version, a short header marks the connection
+    established — drained from flows_quic (quic.h twin)."""
+    import struct as _s
+
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, quic_mode=2)
+    try:
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("10.198.0.1", 46464))
+        # long header: fixed bit + long bit, version 1 (QUIC v1)
+        long_hdr = bytes([0xC3]) + _s.pack(">I", 1) + b"\x00" * 20
+        # short header: fixed bit only
+        short_hdr = bytes([0x43]) + b"\x00" * 24
+        s.sendto(long_hdr, ("10.198.0.2", 8443))
+        s.sendto(short_hdr, ("10.198.0.2", 8443))
+        # version-negotiation (version 0) must NOT be recorded
+        s.sendto(bytes([0xC3]) + b"\x00" * 24, ("10.198.0.2", 8444))
+        s.close()
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        assert evicted.quic is not None, "flows_quic never drained"
+        recs = {}
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            recs[int(k["dst_port"])] = evicted.quic[i]
+        q = recs.get(8443)
+        assert q is not None
+        assert int(q["version"]) == 1
+        assert int(q["seen_long_hdr"]) == 1
+        assert int(q["seen_short_hdr"]) == 1
+        # the negotiation-only flow has no QUIC record (version 0 skipped)
+        if 8444 in recs:
+            assert int(recs[8444]["version"]) == 0
+            assert int(recs[8444]["seen_long_hdr"]) == 0
+    finally:
+        fetcher.close()
+
+
 def test_openssl_uprobe_plaintext_capture():
     """REAL OpenSSL uprobe: the assembled SSL_write probe (attached via
     perf_event_open on the live libssl) captures this process's plaintext
