@@ -157,7 +157,7 @@ class _Flow:
                  dns_port: int, rtt_inflight_fd=None, flows_extra_fd=None,
                  filter_rules_fd=None, filter_peers_fd=None,
                  flows_quic_fd=None, quic_mode: int = 0,
-                 enable_tls: bool = False):
+                 enable_tls: bool = False, sampling_gate_fd=None):
         self.a = Asm()
         self.map_fd = map_fd
         self.direction = direction
@@ -174,7 +174,23 @@ class _Flow:
         self.flows_quic_fd = flows_quic_fd
         self.quic_mode = quic_mode
         self.enable_tls = enable_tls
+        self.sampling_gate_fd = sampling_gate_fd
         self._ctr_n = 0
+
+    def set_gate(self, value: int) -> None:
+        """Record the per-CPU sampling decision for the aux kprobes
+        (sampling_gate map; the C datapath's no_set_do_sampling twin).
+        Clobbers r0-r3."""
+        a = self.a
+        lbl = f"gate_done_{value}"
+        a.st_imm(BPF_W, R10, CTRKEY, 0)
+        a.ld_map_fd(R1, self.sampling_gate_fd)
+        a.mov_reg(R2, R10)
+        a.alu_imm(0x07, R2, CTRKEY)
+        a.call(HELPER_MAP_LOOKUP)
+        a.jmp_imm(0x15, R0, 0, lbl)
+        a.st_imm(BPF_B, R0, 0, value)
+        a.label(lbl)
 
     # --- helpers -----------------------------------------------------------
     def count(self, ctr: int) -> None:
@@ -609,7 +625,16 @@ class _Flow:
             # 1/N gate, baked in at build time (loader-rewritten-const analog)
             a.call(HELPER_PRANDOM_U32)
             a.alu_imm(0x97, R0, self.sampling)  # r0 %= N (ALU64 MOD K)
-            a.jmp_imm(0x55, R0, 0, "out")       # not the sampled 1/N: out
+            if self.sampling_gate_fd is not None:
+                a.jmp_imm(0x55, R0, 0, "unsampled")
+                self.set_gate(1)
+                a.jmp("sampled")
+                a.label("unsampled")
+                self.set_gate(0)
+                a.jmp("out")
+                a.label("sampled")
+            else:
+                a.jmp_imm(0x55, R0, 0, "out")   # not the sampled 1/N: out
 
         a.call(HELPER_KTIME_GET_NS)
         a.stx(BPF_DW, R10, R0, NOW)
@@ -1043,7 +1068,8 @@ def build_flow_program(map_fd: int, direction: int = 0, sampling: int = 0,
                        filter_peers_fd: int | None = None,
                        flows_quic_fd: int | None = None,
                        quic_mode: int = 0,
-                       enable_tls: bool = False) -> bytes:
+                       enable_tls: bool = False,
+                       sampling_gate_fd: int | None = None) -> bytes:
     """Assemble one per-direction flow program. Optional map fds gate the
     corresponding feature blocks, mirroring the C datapath's loader-rewritten
     `cfg_enable_*` constants (a feature whose map isn't wired costs zero
@@ -1052,4 +1078,5 @@ def build_flow_program(map_fd: int, direction: int = 0, sampling: int = 0,
                  dns_inflight_fd, flows_dns_fd, dns_port,
                  rtt_inflight_fd, flows_extra_fd,
                  filter_rules_fd, filter_peers_fd,
-                 flows_quic_fd, quic_mode, enable_tls).build()
+                 flows_quic_fd, quic_mode, enable_tls,
+                 sampling_gate_fd).build()

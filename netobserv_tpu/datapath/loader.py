@@ -447,7 +447,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
     def __init__(self, cache_max_flows: int = 5000,
                  attach_mode: str = "tcx", sampling: int = 0,
                  enable_dns: bool = False, dns_port: int = 53,
-                 enable_rtt: bool = False,
+                 enable_rtt: bool = False, enable_pkt_drops: bool = False,
                  enable_filters: bool = False, quic_mode: int = 0,
                  enable_tls: bool = False,
                  enable_openssl: bool = False, libssl_path: str = "",
@@ -461,9 +461,9 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         try:
             self._provision(
                 cache_max_flows, sampling, enable_dns, dns_port, enable_rtt,
-                enable_filters, quic_mode, enable_tls, enable_openssl,
-                libssl_path, enable_ringbuf_fallback, ringbuf_bytes,
-                ssl_ring_bytes)
+                enable_pkt_drops, enable_filters, quic_mode, enable_tls,
+                enable_openssl, libssl_path, enable_ringbuf_fallback,
+                ringbuf_bytes, ssl_ring_bytes)
         except Exception:
             # a half-provisioned fetcher must not leak map/prog fds (a
             # supervisor retrying construction would exhaust fds)
@@ -471,9 +471,9 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             raise
 
     def _provision(self, cache_max_flows, sampling, enable_dns, dns_port,
-                   enable_rtt, enable_filters, quic_mode, enable_tls,
-                   enable_openssl, libssl_path, enable_ringbuf_fallback,
-                   ringbuf_bytes, ssl_ring_bytes):
+                   enable_rtt, enable_pkt_drops, enable_filters, quic_mode,
+                   enable_tls, enable_openssl, libssl_path,
+                   enable_ringbuf_fallback, ringbuf_bytes, ssl_ring_bytes):
         from netobserv_tpu.datapath import asm_flowpath
         from netobserv_tpu.model.flow import GlobalCounter
 
@@ -507,6 +507,47 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             extra_rec.n_cpus = self._n_cpus
             self._features["extra"] = (extra_rec, binfmt.EXTRA_REC_DTYPE)
             rtt_q_fd, rtt_rec_fd = self._rtt_inflight.fd, extra_rec.fd
+        # per-CPU sampling gate: only needed when sampling can skip packets
+        # AND a kprobe consumes the decision (reference do_sampling pattern)
+        self._gate_map = None
+        want_probes = enable_rtt or enable_pkt_drops
+        if sampling > 1 and want_probes:
+            self._gate_map = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_PERCPU_ARRAY, 4, 1, 1, b"sampling_gate")
+        gate_fd = self._gate_map.fd if self._gate_map else None
+        if enable_rtt:
+            # smoothed-RTT tracepoint (tcp/tcp_probe) alongside the TC
+            # handshake RTT: both max-merge into flows_extra (handle_rtt)
+            from netobserv_tpu.datapath import asm_probes, uprobe
+
+            self._attach_tracepoint(
+                asm_probes.build_rtt_tracepoint_program(
+                    uprobe.tracepoint_fields("tcp", "tcp_probe"),
+                    self._features["extra"][0].fd, gate_fd),
+                "tcp", "tcp_probe", b"rtt_srtt")
+            log.info("smoothed-RTT tracepoint attached (tcp/tcp_probe)")
+        if enable_pkt_drops:
+            from netobserv_tpu.datapath import asm_probes, btf, uprobe
+
+            if not btf.available():
+                raise RuntimeError("ENABLE_PKT_DROPS needs "
+                                   "/sys/kernel/btf/vmlinux to walk the "
+                                   "dropped skb's headers")
+            drops_rec = syscall_bpf.BpfMap.create(
+                self.BPF_MAP_TYPE_PERCPU_HASH,
+                binfmt.FLOW_KEY_DTYPE.itemsize,
+                binfmt.DROPS_REC_DTYPE.itemsize, cache_max_flows,
+                b"flows_drops")
+            drops_rec.n_cpus = self._n_cpus
+            self._features["drops"] = (drops_rec, binfmt.DROPS_REC_DTYPE)
+            self._attach_tracepoint(
+                asm_probes.build_drops_program(
+                    btf.kernel_btf(), drops_rec.fd,
+                    uprobe.tracepoint_fields("skb", "kfree_skb"),
+                    sampling_gate_fd=gate_fd),
+                "skb", "kfree_skb", b"pkt_drops")
+            log.info("packet-drop tracepoint attached (skb/kfree_skb, "
+                     "BTF-resolved skb offsets)")
         quic_fd = None
         if quic_mode:
             quic_rec = syscall_bpf.BpfMap.create(
@@ -570,7 +611,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                     filter_rules_fd=flt_rules_fd,
                     filter_peers_fd=flt_peers_fd,
                     flows_quic_fd=quic_fd, quic_mode=quic_mode,
-                    enable_tls=enable_tls))
+                    enable_tls=enable_tls, sampling_gate_fd=gate_fd))
             pin = f"{self._PIN_PREFIX}{os.getpid()}_{name}"
             if os.path.exists(pin):
                 os.unlink(pin)
@@ -596,6 +637,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         self._ssl_rb = None
         self._ssl_map = None
         self._ssl_uprobe = None
+        self._kprobes = []
+        self._gate_map = None
         self._dns_inflight = None
         self._rtt_inflight = None
         self._rb_map = None
@@ -620,12 +663,28 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                    enable_dns=cfg.enable_dns_tracking,
                    dns_port=cfg.dns_tracking_port,
                    enable_rtt=cfg.enable_rtt,
+                   enable_pkt_drops=cfg.enable_pkt_drops,
                    enable_filters=bool(cfg.flow_filter_rules),
                    quic_mode=cfg.quic_tracking_mode,
                    enable_tls=cfg.enable_tls_tracking,
                    enable_openssl=cfg.enable_openssl_tracking,
                    libssl_path=cfg.openssl_path,
                    enable_ringbuf_fallback=cfg.enable_flows_ringbuf_fallback)
+
+    def _attach_tracepoint(self, prog_bytes: bytes, category: str,
+                           name: str, prog_name: bytes) -> None:
+        """Load a tracepoint program and bind it to its perf event; the
+        live attachment owns the program (the prog fd is dropped)."""
+        from netobserv_tpu.datapath import uprobe
+
+        prog = syscall_bpf.prog_load(
+            prog_bytes, prog_type=syscall_bpf.BPF_PROG_TYPE_TRACEPOINT,
+            name=prog_name)
+        try:
+            self._kprobes.append(
+                uprobe.TracepointAttachment(prog, category, name))
+        finally:
+            os.close(prog)
 
     def program_filters(self, rules) -> int:
         """Compile FLOW_FILTER_RULES into this fetcher's own LPM tries (the
@@ -671,6 +730,10 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             self._ssl_rb.close()
         if self._ssl_map is not None:
             self._ssl_map.close()
+        for kp in self._kprobes:
+            kp.close()
+        if self._gate_map is not None:
+            self._gate_map.close()
         for fmap, _dtype in self._features.values():
             fmap.close()
 

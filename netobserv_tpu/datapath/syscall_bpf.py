@@ -233,6 +233,7 @@ class BpfMap:
 BPF_PROG_LOAD = 5
 BPF_PROG_TYPE_KPROBE = 2
 BPF_PROG_TYPE_SCHED_CLS = 3
+BPF_PROG_TYPE_TRACEPOINT = 5
 
 
 def insn(opcode: int, dst: int = 0, src: int = 0, off: int = 0,

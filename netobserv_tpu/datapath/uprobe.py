@@ -95,26 +95,21 @@ def uprobe_pmu_type() -> int:
         return int(fh.read())
 
 
-class UprobeAttachment:
-    """One live uprobe: the perf event fd keeps the probe alive; closing it
-    detaches. The path buffer must outlive perf_event_open, so it is held."""
+class _PerfAttachment:
+    """A BPF program bound to a perf event; the event fd keeps the probe
+    alive (closing detaches). Subclasses fill the perf_event_attr:
+    struct perf_event_attr (zero-padded to 128B, size=VER5=112):
+    type@0, size@4, config@8, sample_period@16, config1@56, config2@64."""
 
-    def __init__(self, prog_fd: int, binary_path: str, file_offset: int):
-        self._path_buf = ctypes.create_string_buffer(
-            os.fsencode(binary_path) + b"\x00")
-        # struct perf_event_attr (zero-padded to 128B, size=VER5=112):
-        # type@0, size@4, config@8, sample_period@16, config1@56, config2@64
-        attr = bytearray(128)
-        struct.pack_into("<II", attr, 0, uprobe_pmu_type(), 112)
-        struct.pack_into("<Q", attr, 56, ctypes.addressof(self._path_buf))
-        struct.pack_into("<Q", attr, 64, file_offset)
+    def _open_and_bind(self, attr: bytearray, prog_fd: int,
+                       desc: str) -> None:
         buf = (ctypes.c_char * len(attr)).from_buffer(attr)
         fd = _libc.syscall(_perf_event_open_nr(), buf, -1, 0, -1,
                            PERF_FLAG_FD_CLOEXEC)
         if fd < 0:
             err = ctypes.get_errno()
-            raise OSError(err, f"perf_event_open(uprobe {binary_path}"
-                               f"+{file_offset:#x}): {os.strerror(err)}")
+            raise OSError(err,
+                          f"perf_event_open({desc}): {os.strerror(err)}")
         self.fd = fd
         try:
             fcntl.ioctl(fd, PERF_EVENT_IOC_SET_BPF, prog_fd)
@@ -128,6 +123,75 @@ class UprobeAttachment:
             os.close(self.fd)
         except OSError:
             pass
+
+
+class UprobeAttachment(_PerfAttachment):
+    """One live uprobe on (binary, file offset). The path buffer must
+    outlive perf_event_open, so it is held."""
+
+    def __init__(self, prog_fd: int, binary_path: str, file_offset: int):
+        self._path_buf = ctypes.create_string_buffer(
+            os.fsencode(binary_path) + b"\x00")
+        attr = bytearray(128)
+        struct.pack_into("<II", attr, 0, uprobe_pmu_type(), 112)
+        struct.pack_into("<Q", attr, 56, ctypes.addressof(self._path_buf))
+        struct.pack_into("<Q", attr, 64, file_offset)
+        self._open_and_bind(attr, prog_fd,
+                            f"uprobe {binary_path}+{file_offset:#x}")
+
+
+PERF_TYPE_TRACEPOINT = 2
+_TRACEFS = "/sys/kernel/tracing"
+
+
+def ensure_tracefs() -> str:
+    """Mount tracefs if absent (root; the image leaves it unmounted)."""
+    if not os.path.isdir(os.path.join(_TRACEFS, "events")):
+        import subprocess
+
+        subprocess.run(["mount", "-t", "tracefs", "tracefs", _TRACEFS],
+                       capture_output=True)
+    if not os.path.isdir(os.path.join(_TRACEFS, "events")):
+        raise RuntimeError("tracefs unavailable (mount tracefs "
+                           f"{_TRACEFS})")
+    return _TRACEFS
+
+
+def tracepoint_id(category: str, name: str) -> int:
+    with open(f"{ensure_tracefs()}/events/{category}/{name}/id") as fh:
+        return int(fh.read())
+
+
+def tracepoint_fields(category: str, name: str) -> dict[str, int]:
+    """field name -> byte offset in the tracepoint context, parsed from the
+    live format file — layouts shift between kernel versions (6.18 inserted
+    rx_sk into skb/kfree_skb), so offsets must never be hardcoded."""
+    import re
+
+    out: dict[str, int] = {}
+    path = f"{ensure_tracefs()}/events/{category}/{name}/format"
+    with open(path) as fh:
+        for line in fh:
+            # array dims may be symbolic on older kernels:
+            # "__u8 saddr[sizeof(struct sockaddr_in6)]"
+            m = re.search(
+                r"field:[^;]*?(\w+)(?:\[[^\]]*\])?;\s*offset:(\d+);", line)
+            if m:
+                out[m.group(1)] = int(m.group(2))
+    return out
+
+
+class TracepointAttachment(_PerfAttachment):
+    """A BPF_PROG_TYPE_TRACEPOINT program bound to a perf tracepoint event
+    (PERF_TYPE_TRACEPOINT, config = event id) — the attach mechanism behind
+    the reference's tracepoint sections (SEC(\"tracepoint/skb/kfree_skb\"))."""
+
+    def __init__(self, prog_fd: int, category: str, name: str):
+        attr = bytearray(128)
+        struct.pack_into("<II", attr, 0, PERF_TYPE_TRACEPOINT, 112)
+        struct.pack_into("<Q", attr, 8, tracepoint_id(category, name))
+        struct.pack_into("<Q", attr, 16, 1)  # sample_period (required != 0)
+        self._open_and_bind(attr, prog_fd, f"tracepoint {category}/{name}")
 
 
 def find_libssl() -> str | None:

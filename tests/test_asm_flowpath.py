@@ -807,6 +807,118 @@ def test_quic_tracking(veth):
         fetcher.close()
 
 
+def test_btf_struct_offsets():
+    """The BTF reader resolves the struct members the probe programs bake in
+    (sanity relations on the known sock_common prefix layout)."""
+    from netobserv_tpu.datapath import btf
+
+    if not btf.available():
+        pytest.skip("no /sys/kernel/btf/vmlinux")
+    b = btf.kernel_btf()
+    # skc_daddr/skc_rcv_saddr open sock_common (skc_addrpair overlay)
+    assert b.offset_of("sock", "__sk_common.skc_daddr") == 0
+    assert b.offset_of("sock", "__sk_common.skc_rcv_saddr") == 4
+    assert b.offset_of("sock", "__sk_common.skc_dport") == 12
+    assert b.offset_of("sock", "__sk_common.skc_num") == 14
+    # nested anonymous union resolution (in6_u)
+    v6 = b.offset_of("sock", "__sk_common.skc_v6_daddr.in6_u.u6_addr8")
+    assert v6 > 16
+    assert b.offset_of("sk_buff", "len") > 0
+    assert b.offset_of("tcp_sock", "srtt_us") > 500  # deep in the struct
+    with pytest.raises(LookupError):
+        b.offset_of("sock", "no_such_member")
+
+
+def test_drops_tracking():
+    """REAL packet-drop tracking: the assembled skb/kfree_skb tracepoint
+    program (BTF-resolved skb offsets) records a UDP receive-buffer
+    overflow with its cause, keyed by the dropped packet's flow
+    (flowpath_probes.c drops_tp twin)."""
+    from netobserv_tpu.datapath import btf
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    if not btf.available():
+        pytest.skip("no /sys/kernel/btf/vmlinux")
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024,
+                                   enable_pkt_drops=True)
+    try:
+        rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 2048)
+        rx.bind(("127.0.0.1", 0))
+        port = rx.getsockname()[1]
+        tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for _ in range(300):  # overwhelm the 2KB receive buffer
+            tx.sendto(b"x" * 1200, ("127.0.0.1", port))
+        tx.close()
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        rx.close()
+        assert evicted.drops is not None, "flows_drops never drained"
+        hit = None
+        for i in range(len(evicted)):
+            if int(evicted.events["key"][i]["dst_port"]) == port:
+                hit = evicted.drops[i]
+        assert hit is not None, "dropped flow missing"
+        assert int(hit["packets"]) > 0
+        assert int(hit["latest_cause"]) == 6  # SKB_DROP_REASON_SOCKET_RCVBUFF
+        assert int(hit["eth_protocol"]) == 0x0800
+    finally:
+        fetcher.close()
+
+
+def test_smoothed_rtt_tracepoint(veth):
+    """The tcp/tcp_probe tracepoint program records the kernel's smoothed
+    RTT for established connections — alongside (and max-merged with) the
+    TC handshake RTT (flowpath_probes.c handle_rtt analog)."""
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    listener = subprocess.Popen(
+        ["ip", "netns", "exec", NS, sys.executable, "-c",
+         "import socket;"
+         "s=socket.socket();s.bind(('10.198.0.2',5393));s.listen(1);"
+         "c,_=s.accept();\n"
+         "for _ in range(5):\n"
+         "    d=c.recv(16);c.sendall(b'pong')\n"])
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_rtt=True)
+    try:
+        c = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                c = socket.socket()
+                c.settimeout(3)
+                c.connect(("10.198.0.2", 5393))
+                break
+            except OSError:
+                c.close()
+                c = None
+                time.sleep(0.2)
+        assert c is not None, "listener never came up"
+        for _ in range(5):  # round trips mature the srtt estimate
+            c.sendall(b"ping")
+            c.recv(16)
+        time.sleep(0.2)
+        evicted = fetcher.lookup_and_delete()
+        cport = c.getsockname()[1]
+        c.close()
+        assert evicted.extra is not None
+        hit = None
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            # this process receives pongs: receive-path key is
+            # remote(server) -> local(client)
+            if (int(k["src_port"]) == 5393
+                    and int(k["dst_port"]) == cport):
+                hit = evicted.extra[i]
+        assert hit is not None, "rtt record missing"
+        rtt = int(hit["rtt_ns"])
+        assert 0 < rtt < 1_000_000_000, f"srtt {rtt}ns"
+    finally:
+        listener.kill()
+        listener.wait()
+        fetcher.close()
+
+
 def test_openssl_uprobe_plaintext_capture():
     """REAL OpenSSL uprobe: the assembled SSL_write probe (attached via
     perf_event_open on the live libssl) captures this process's plaintext
