@@ -58,6 +58,8 @@ class PacketsAgent:
                                  timeout_s=min(cfg.cache_active_timeout, 0.5))
         self._stop = threading.Event()
         self._export_thread: Optional[threading.Thread] = None
+        if cfg.flow_filter_rules and hasattr(fetcher, "program_filters"):
+            fetcher.program_filters(cfg.parsed_filter_rules())
         # kernel-backed packet fetchers attach per-interface like the flow
         # datapath; replay/fake fetchers skip discovery
         self.iface_listener = None

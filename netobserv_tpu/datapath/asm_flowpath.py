@@ -618,6 +618,21 @@ class _Flow:
         a.label("flt_done")
 
     def build(self) -> bytes:
+        """entry/parse/filter head + the flow-aggregation tail."""
+        self.emit_head()
+        self.emit_tail()
+        a = self.a
+        a.label("out")
+        a.mov_imm(R0, 0)                        # TC_ACT_OK
+        a.exit()
+        return a.assemble()
+
+    def emit_head(self) -> None:
+        """Everything up to a built+filtered flow key: sampling gate, parse
+        (key/MACs/DSCP/flags + enabled tracker header parses), and the flow
+        -filter gate. Falls through with the key at KEY and per-packet
+        tracker metadata on the stack; unparseable/filtered packets jumped
+        to \"out\" (the caller emits that label)."""
         a = self.a
         a.mov_reg(R6, R1)                       # r6 = ctx
 
@@ -711,6 +726,9 @@ class _Flow:
         if self.filter_rules_fd is not None:
             self.filter_block()
 
+    def emit_tail(self) -> None:
+        """Flow aggregation: correlations, upsert, feature records."""
+        a = self.a
         # --- DNS correlation (stack-only; before the flow upsert) ----------
         if self.dns_inflight_fd is not None:
             a.ldx(BPF_W, R3, R10, DNSMETA + 4)
@@ -1049,11 +1067,6 @@ class _Flow:
             a.alu_imm(0x07, R3, DNSREC)
             a.mov_imm(R4, 0)                    # BPF_ANY
             a.call(HELPER_MAP_UPDATE)
-
-        a.label("out")
-        a.mov_imm(R0, 0)                        # TC_ACT_OK
-        a.exit()
-        return a.assemble()
 
 
 def build_flow_program(map_fd: int, direction: int = 0, sampling: int = 0,

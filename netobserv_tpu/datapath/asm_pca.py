@@ -38,10 +38,36 @@ _OFF_PAYLOAD = _REC.fields["payload"][1]
 SNAP = binfmt.MAX_PAYLOAD_SIZE
 
 
-def build_pca_program(ringbuf_fd: int, sampling: int = 0) -> bytes:
+def build_pca_program(ringbuf_fd: int, sampling: int = 0,
+                      direction: int = 0,
+                      filter_rules_fd: int | None = None,
+                      filter_peers_fd: int | None = None,
+                      counters_fd: int | None = None) -> bytes:
     """One program serves both directions (the record carries no direction;
     reference parity — `no_packet_event` has if_index/len/timestamp only).
-    `sampling` > 1 bakes in a 1/N gate, the loader-rewritten-const analog."""
+    `sampling` > 1 bakes in a 1/N gate, the loader-rewritten-const analog.
+
+    With filter trie fds wired, the program front-loads the flow datapath's
+    shared parse + filter gate (asm_flowpath emit_head): only packets an
+    Accept rule matches are captured — the pca.h in-kernel filtering
+    behavior, previously clang-object-only."""
+    if filter_rules_fd is not None:
+        from netobserv_tpu.datapath.asm_flowpath import _Flow
+
+        # direction matters here: filter rules carry a direction predicate,
+        # so the loader builds one program per hook when filtering
+        emitter = _Flow(map_fd=0, direction=direction, sampling=sampling,
+                        ringbuf_fd=None, counters_fd=counters_fd,
+                        dns_inflight_fd=None, flows_dns_fd=None, dns_port=53,
+                        filter_rules_fd=filter_rules_fd,
+                        filter_peers_fd=filter_peers_fd)
+        emitter.emit_head()              # parse + filter; drops go to "out"
+        _emit_capture(emitter.a, ringbuf_fd)
+        a = emitter.a
+        a.label("out")
+        a.mov_imm(R0, 0)
+        a.exit()
+        return a.assemble()
     a = Asm()
     a.mov_reg(R6, R1)                        # r6 = ctx
 
@@ -50,6 +76,16 @@ def build_pca_program(ringbuf_fd: int, sampling: int = 0) -> bytes:
         a.alu_imm(0x97, R0, sampling)        # r0 %= N (ALU64 MOD K)
         a.jmp_imm(0x55, R0, 0, "out")        # not the sampled 1/N: out
 
+    _emit_capture(a, ringbuf_fd)
+    a.label("out")
+    a.mov_imm(R0, 0)                         # TC_ACT_OK
+    a.exit()
+    return a.assemble()
+
+
+def _emit_capture(a: Asm, ringbuf_fd: int) -> None:
+    """Reserve + fill + submit one no_packet_event (falls through to the
+    caller's \"out\" label; needs only r6 = ctx live)."""
     a.ld_map_fd(R1, ringbuf_fd)
     a.mov_imm(R2, _REC.itemsize)
     a.mov_imm(R3, 0)
@@ -92,8 +128,3 @@ def build_pca_program(ringbuf_fd: int, sampling: int = 0) -> bytes:
     a.mov_reg(R1, R7)
     a.mov_imm(R2, 0)
     a.call(HELPER_RINGBUF_DISCARD)
-
-    a.label("out")
-    a.mov_imm(R0, 0)                         # TC_ACT_OK
-    a.exit()
-    return a.assemble()

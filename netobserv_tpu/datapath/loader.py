@@ -308,6 +308,38 @@ class BpfmanFetcher:
             dns.close()
 
 
+BPF_MAP_TYPE_LPM_TRIE = 11
+BPF_F_NO_PREALLOC = 1
+
+
+def _create_filter_tries():
+    """(filter_rules, filter_peers) LPM tries — shared by the flow and PCA
+    self-managed fetchers."""
+    from netobserv_tpu.datapath import filter_compile
+
+    rules = syscall_bpf.BpfMap.create(
+        BPF_MAP_TYPE_LPM_TRIE, filter_compile.FILTER_KEY_SIZE,
+        filter_compile.FILTER_RULE_SIZE, filter_compile.MAX_FILTER_RULES,
+        b"filter_rules", flags=BPF_F_NO_PREALLOC)
+    peers = syscall_bpf.BpfMap.create(
+        BPF_MAP_TYPE_LPM_TRIE, filter_compile.FILTER_KEY_SIZE, 1,
+        filter_compile.MAX_FILTER_RULES, b"filter_peers",
+        flags=BPF_F_NO_PREALLOC)
+    return rules, peers
+
+
+def _program_filter_tries(rules_map, peers_map, rules) -> int:
+    """Compile FLOW_FILTER_RULES into live LPM tries; returns rules written."""
+    from netobserv_tpu.datapath import filter_compile
+
+    compiled = filter_compile.compile_filters(rules)
+    for key, value in compiled.rules:
+        rules_map.update(key, value)
+    for key, value in compiled.peers:
+        peers_map.update(key, value)
+    return len(compiled.rules)
+
+
 class _SelfManagedAttach:
     """TC/TCX attach lifecycle shared by the self-managed fetchers (flow +
     PCA): per-direction pinned programs, tcx/tc/any mode dispatch, netns
@@ -517,15 +549,21 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         gate_fd = self._gate_map.fd if self._gate_map else None
         if enable_rtt:
             # smoothed-RTT tracepoint (tcp/tcp_probe) alongside the TC
-            # handshake RTT: both max-merge into flows_extra (handle_rtt)
+            # handshake RTT: both max-merge into flows_extra (handle_rtt).
+            # Best-effort: a locked-down tracefs must not take down the
+            # still-functional handshake-RTT path.
             from netobserv_tpu.datapath import asm_probes, uprobe
 
-            self._attach_tracepoint(
-                asm_probes.build_rtt_tracepoint_program(
-                    uprobe.tracepoint_fields("tcp", "tcp_probe"),
-                    self._features["extra"][0].fd, gate_fd),
-                "tcp", "tcp_probe", b"rtt_srtt")
-            log.info("smoothed-RTT tracepoint attached (tcp/tcp_probe)")
+            try:
+                self._attach_tracepoint(
+                    asm_probes.build_rtt_tracepoint_program(
+                        uprobe.tracepoint_fields("tcp", "tcp_probe"),
+                        self._features["extra"][0].fd, gate_fd),
+                    "tcp", "tcp_probe", b"rtt_srtt")
+                log.info("smoothed-RTT tracepoint attached (tcp/tcp_probe)")
+            except (OSError, RuntimeError, KeyError) as exc:
+                log.warning("smoothed-RTT tracepoint unavailable (%s); "
+                            "handshake RTT only", exc)
         if enable_pkt_drops:
             from netobserv_tpu.datapath import asm_probes, btf, uprobe
 
@@ -559,17 +597,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             quic_fd = quic_rec.fd
         flt_rules_fd = flt_peers_fd = None
         if enable_filters:
-            from netobserv_tpu.datapath import filter_compile
-
-            self._filter_rules = syscall_bpf.BpfMap.create(
-                self.BPF_MAP_TYPE_LPM_TRIE, filter_compile.FILTER_KEY_SIZE,
-                filter_compile.FILTER_RULE_SIZE,
-                filter_compile.MAX_FILTER_RULES, b"filter_rules",
-                flags=self.BPF_F_NO_PREALLOC)
-            self._filter_peers = syscall_bpf.BpfMap.create(
-                self.BPF_MAP_TYPE_LPM_TRIE, filter_compile.FILTER_KEY_SIZE,
-                1, filter_compile.MAX_FILTER_RULES, b"filter_peers",
-                flags=self.BPF_F_NO_PREALLOC)
+            self._filter_rules, self._filter_peers = _create_filter_tries()
             flt_rules_fd = self._filter_rules.fd
             flt_peers_fd = self._filter_peers.fd
         rb_fd = None
@@ -697,14 +725,10 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 log.warning("filter maps not provisioned (enable_filters "
                             "was off at load); FLOW_FILTER_RULES ignored")
             return 0
-        compiled = filter_compile.compile_filters(rules)
-        for key, value in compiled.rules:
-            self._filter_rules.update(key, value)
-        for key, value in compiled.peers:
-            self._filter_peers.update(key, value)
-        log.info("programmed %d filter rules (+%d peer CIDRs) into the "
-                 "kernel gate", len(compiled.rules), len(compiled.peers))
-        return len(compiled.rules)
+        n = _program_filter_tries(self._filter_rules, self._filter_peers,
+                                  rules)
+        log.info("programmed %d filter rules into the kernel gate", n)
+        return n
 
     def close(self) -> None:
         self._teardown_attachments()
@@ -750,25 +774,59 @@ class MinimalPacketFetcher(_SelfManagedAttach):
     _PIN_PREFIX = "/sys/fs/bpf/netobserv_minpca_"
 
     def __init__(self, ring_bytes: int = 1 << 21, attach_mode: str = "tcx",
-                 sampling: int = 0):
-        from netobserv_tpu.datapath import asm_pca
-
+                 sampling: int = 0, enable_filters: bool = False):
         self._mode = attach_mode
         self._sweep_stale_pins()
+        self._filter_rules = self._filter_peers = None
+        self._rb_map = None
+        self._reader = None
+        self._prog_fds = {}
+        self._pins = {}
+        self._attached: dict[tuple[str, int], tuple[str, dict]] = {}
+        try:
+            self._provision(ring_bytes, sampling, enable_filters)
+        except Exception:
+            self.close()  # a half-provisioned fetcher must not leak fds
+            raise
+
+    def _provision(self, ring_bytes, sampling, enable_filters) -> None:
+        from netobserv_tpu.datapath import asm_pca
+
         BPF_MAP_TYPE_RINGBUF = 27
+        flt_rules_fd = flt_peers_fd = None
+        if enable_filters:
+            self._filter_rules, self._filter_peers = _create_filter_tries()
+            flt_rules_fd = self._filter_rules.fd
+            flt_peers_fd = self._filter_peers.fd
         self._rb_map = syscall_bpf.BpfMap.create(
             BPF_MAP_TYPE_RINGBUF, 0, 0, ring_bytes, b"pkt_records")
-        fd = syscall_bpf.prog_load(
-            asm_pca.build_pca_program(self._rb_map.fd, sampling=sampling),
-            name=b"netobserv_pca")
-        pin = f"{self._PIN_PREFIX}{os.getpid()}"
-        if os.path.exists(pin):
-            os.unlink(pin)
-        syscall_bpf.obj_pin(fd, pin)
-        # one program serves both hooks (the record carries no direction)
-        self._prog_fds = {"ingress": fd, "egress": fd}
-        self._pins = {"ingress": pin, "egress": pin}
-        self._attached: dict[tuple[str, int], tuple[str, dict]] = {}
+        if enable_filters:
+            # filters evaluate a direction predicate, so each hook needs its
+            # own program instance (like the flow datapath)
+            for name, code in (("ingress", 0), ("egress", 1)):
+                fd = syscall_bpf.prog_load(
+                    asm_pca.build_pca_program(
+                        self._rb_map.fd, sampling=sampling, direction=code,
+                        filter_rules_fd=flt_rules_fd,
+                        filter_peers_fd=flt_peers_fd),
+                    name=b"netobserv_pca")
+                pin = f"{self._PIN_PREFIX}{os.getpid()}_{name}"
+                if os.path.exists(pin):
+                    os.unlink(pin)
+                syscall_bpf.obj_pin(fd, pin)
+                self._prog_fds[name] = fd
+                self._pins[name] = pin
+        else:
+            # one program serves both hooks (the record carries no direction)
+            fd = syscall_bpf.prog_load(
+                asm_pca.build_pca_program(self._rb_map.fd, sampling=sampling),
+                name=b"netobserv_pca")
+            pin = f"{self._PIN_PREFIX}{os.getpid()}"
+            if os.path.exists(pin):
+                os.unlink(pin)
+            syscall_bpf.obj_pin(fd, pin)
+            self._prog_fds = {"ingress": fd, "egress": fd}
+            self._pins = {"ingress": pin, "egress": pin}
         self._reader = syscall_bpf.RingBufReader(self._rb_map)
 
     @classmethod
@@ -779,16 +837,30 @@ class MinimalPacketFetcher(_SelfManagedAttach):
             raise RuntimeError("kernel datapath requires root/CAP_BPF")
         if cfg.tc_attach_mode != "tcx" and shutil.which("tc") is None:
             raise RuntimeError("tc (iproute2) not found; cannot attach")
-        if cfg.flow_filter_rules:
-            log.warning("FLOW_FILTER_RULES are not applied by the "
-                        "hand-assembled PCA program (clang-built pca.h "
-                        "required for in-kernel packet filtering)")
-        return cls(attach_mode=cfg.tc_attach_mode, sampling=cfg.sampling)
+        return cls(attach_mode=cfg.tc_attach_mode, sampling=cfg.sampling,
+                   enable_filters=bool(cfg.flow_filter_rules))
+
+    def program_filters(self, rules) -> int:
+        """Same kernel-gate programming as the flow fetcher: captured
+        packets are the ones an Accept rule matches (pca.h parity)."""
+        if self._filter_rules is None:
+            if rules:
+                log.warning("PCA filter maps not provisioned; "
+                            "FLOW_FILTER_RULES ignored")
+            return 0
+        return _program_filter_tries(self._filter_rules, self._filter_peers,
+                                     rules)
 
     def read_packet(self, timeout_s: float):
         return self._reader.read(timeout_s)
 
     def close(self) -> None:
         self._teardown_attachments()
-        self._reader.close()
-        self._rb_map.close()
+        if self._reader is not None:
+            self._reader.close()
+        if self._rb_map is not None:
+            self._rb_map.close()
+        if self._filter_rules is not None:
+            self._filter_rules.close()
+        if self._filter_peers is not None:
+            self._filter_peers.close()

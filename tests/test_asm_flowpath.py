@@ -265,6 +265,43 @@ def test_pca_kernel_capture_to_parseable_pcap(veth, tmp_path):
         fetcher.close()
 
 
+def test_pca_in_kernel_filter(veth):
+    """PCA with FLOW_FILTER_RULES: the capture program front-loads the
+    shared parse+filter gate, so only Accept-matched packets reach the ring
+    (pca.h in-kernel filtering parity, previously clang-only)."""
+    import numpy as np
+
+    from netobserv_tpu.config import FlowFilterRule
+    from netobserv_tpu.datapath.loader import MinimalPacketFetcher
+    from netobserv_tpu.model import binfmt
+
+    fetcher = MinimalPacketFetcher(enable_filters=True)
+    try:
+        # a direction-bearing rule: the egress program instance must
+        # evaluate it with its own baked direction
+        n = fetcher.program_filters([FlowFilterRule(
+            ip_cidr="10.198.0.0/24", action="Accept", protocol="UDP",
+            direction="Egress", destination_port=7801)])
+        assert n == 1
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        _send_udp(n=4, size=48, dport=7801, pace_s=0)   # matched: captured
+        _send_udp(n=4, size=48, dport=7802, pace_s=0)   # unmatched: dropped
+        seen = set()
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline:
+            raw = fetcher.read_packet(0.3)
+            if raw is None:
+                continue
+            ev = np.frombuffer(raw, dtype=binfmt.PACKET_EVENT_DTYPE)[0]
+            payload = ev["payload"][:int(ev["pkt_len"])].tobytes()
+            if payload[23:24] == b"\x11":
+                seen.add(int.from_bytes(payload[36:38], "big"))
+        assert 7801 in seen, f"accepted packets not captured: {seen}"
+        assert 7802 not in seen, "filter gate let unmatched packets through"
+    finally:
+        fetcher.close()
+
+
 def test_pca_full_agent_over_kernel(veth):
     """PacketsAgent end-to-end on the real kernel: live netlink discovery
     attaches the assembled PCA program, captured packets flow through
@@ -966,6 +1003,85 @@ def test_openssl_uprobe_plaintext_capture():
         assert int(got["timestamp_ns"]) > 0
     finally:
         fetcher.close()
+
+
+def test_full_feature_agent_integration(veth):
+    """Kitchen sink: the full agent over a fetcher with EVERY assembler
+    feature enabled (DNS + RTT + drops + TLS + QUIC + filters off to keep
+    all flows + ringbuf + counters + SSL uprobe) — exported records carry
+    the per-feature enrichments simultaneously."""
+    from netobserv_tpu.agent import FlowsAgent
+    from netobserv_tpu.config import load_config
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+    from tests.test_pipeline import CollectExporter
+
+    cfg = load_config(environ={
+        "EXPORT": "stdout", "CACHE_ACTIVE_TIMEOUT": "200ms",
+        "INTERFACES": "nf0", "DIRECTION": "both",
+        "ENABLE_DNS_TRACKING": "true", "ENABLE_RTT": "true",
+        "ENABLE_PKT_DROPS": "true", "ENABLE_TLS_TRACKING": "true",
+        "QUIC_TRACKING_MODE": "2"})
+    fetcher = MinimalKernelFetcher(
+        cache_max_flows=1024, enable_dns=True, enable_rtt=True,
+        enable_pkt_drops=True, enable_tls=True, quic_mode=2)
+    out = CollectExporter()
+    agent = FlowsAgent(cfg, fetcher, out)
+    stop = threading.Event()
+    t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not any(
+                "ingress" in d and "egress" in d
+                for _n, d in fetcher._attached.values()):
+            time.sleep(0.05)
+        # DNS query/response pair
+        dns_id = 0x4242
+        q = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        q.bind(("10.198.0.1", 40987))
+        q.sendto(_dns_payload(dns_id, response=False), ("10.198.0.2", 53))
+        time.sleep(0.1)
+        _run("ip", "netns", "exec", NS, sys.executable, "-c",
+             "import socket;"
+             "s=socket.socket(socket.AF_INET,socket.SOCK_DGRAM);"
+             "s.bind(('10.198.0.2',53));"
+             f"s.sendto(bytes.fromhex("
+             f"'{_dns_payload(dns_id, response=True).hex()}'),"
+             "('10.198.0.1',40987))")
+        q.close()
+        # QUIC long header
+        qs = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        qs.bind(("10.198.0.1", 40988))
+        qs.sendto(bytes([0xC3]) + (1).to_bytes(4, "big") + b"\x00" * 20,
+                  ("10.198.0.2", 8443))
+        qs.close()
+        got_dns = got_quic = None
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and not (got_dns and got_quic):
+            try:
+                batch = out.batches.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            for r in batch:
+                f = r.features
+                if f is None:
+                    continue
+                if r.key.src_port == 53 and f.dns_latency_ns > 0:
+                    got_dns = r
+                if r.key.dst_port == 8443 and f.quic_version == 1:
+                    got_quic = r
+        assert got_dns is not None, "DNS enrichment missing"
+        assert got_dns.features.dns_id == dns_id
+        assert got_quic is not None, "QUIC enrichment missing"
+        assert got_quic.features.quic_seen_long_hdr
+        # the FLP field mapping surfaces the enrichment downstream
+        from netobserv_tpu.exporter.flp_map import record_to_map
+        flp = record_to_map(got_quic)
+        assert flp["QuicVersion"] == 1 and flp["QuicLongHdr"]
+        assert record_to_map(got_dns)["DnsId"] == dns_id
+    finally:
+        stop.set()
+        t.join(timeout=5)
 
 
 @pytest.fixture
