@@ -281,6 +281,10 @@ class _Flow:
             a.endian_be(R3, 16)
             a.stx(BPF_H, R10, R3, DNSMETA + 2)
             a.st_imm(BPF_W, R10, DNSMETA + 4, 1)    # header seen
+            # qname starts after the 12-byte header; the offset is per-IP
+            # -version, so stash it for the common dns_rec block (TLSBUF+8:
+            # QUIC's 5-byte scratch and TLS's TCP-only use never collide)
+            a.st_imm(BPF_W, R10, TLSBUF + 8, l4 + 8 + 12)
             a.label(f"dns_done_{v}")
         if self.flows_quic_fd is not None and self.quic_mode:
             # QUIC invariants (quic.h / RFC 8999): fixed bit, long-header
@@ -961,6 +965,24 @@ class _Flow:
             a.stx(BPF_H, R10, R4, DNSREC + _dr("dns_flags"))
             a.ldx(BPF_H, R4, R10, VAL + ST_ETH)
             a.stx(BPF_H, R10, R4, DNSREC + _dr("eth_protocol"))
+            # qname: copy min(32, remaining payload) raw label bytes into
+            # the record (dns.h no_dns_copy_name analog; decode_qname stops
+            # at the terminating NUL, so trailing qtype bytes are inert).
+            # bpf_skb_load_bytes reads frag-resident payload too.
+            a.ldx(BPF_W, R5, R10, TLSBUF + 8)   # qname packet offset
+            a.ldx(BPF_W, R4, R6, SKB_LEN)
+            a.jmp_reg(0xBD, R4, R5, "dnsname_done")  # no bytes past header
+            a.alu_reg(0x1F, R4, R5)             # r4 = available bytes
+            name_max = binfmt.DNS_REC_DTYPE["name"].itemsize
+            a.jmp_imm(0xB5, R4, name_max, "dnsname_len_ok")
+            a.mov_imm(R4, name_max)
+            a.label("dnsname_len_ok")
+            a.mov_reg(R1, R6)
+            a.mov_reg(R2, R5)
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, DNSREC + _dr("name"))
+            a.call(HELPER_SKB_LOAD_BYTES)       # failure leaves zeros
+            a.label("dnsname_done")
             a.ld_map_fd(R1, self.flows_dns_fd)
             a.mov_reg(R2, R10)
             a.alu_imm(0x07, R2, KEY)

@@ -451,6 +451,8 @@ def test_dns_latency_tracking(veth):
         assert hit is not None, "response flow missing"
         assert int(hit["dns_id"]) == dns_id
         assert int(hit["dns_flags"]) & 0x8000  # QR bit: response seen
+        from netobserv_tpu.utils.dnsnames import decode_qname
+        assert decode_qname(bytes(hit["name"])) == "example.com"
         lat = int(hit["latency_ns"])
         assert 50_000_000 < lat < 5_000_000_000, f"latency {lat}ns"
         # the inflight correlation entry was consumed
