@@ -253,24 +253,25 @@ class BpfmanFetcher:
     DNS_CORR_KEY_SIZE = 40
 
     def purge_stale(self, older_than_s: float) -> int:
-        """Drop unanswered DNS correlations older than the deadline
+        """Drop unanswered DNS/RTT correlations older than the deadline
         (reference: DeleteMapsStaleEntries, `tracer.go:1188-1216`). Lazily
-        opens the pinned dns_inflight map; returns the purge count."""
-        if not hasattr(self, "_dns_inflight"):
-            try:
-                self._dns_inflight = syscall_bpf.BpfMap.open_pinned(
-                    os.path.join(self._base, "dns_inflight"),
-                    key_size=self.DNS_CORR_KEY_SIZE, value_size=8)
-            except (OSError, ValueError):
-                self._dns_inflight = None
+        opens the pinned correlation maps; returns the purge count."""
+        for attr, pin in (("_dns_inflight", "dns_inflight"),
+                          ("_rtt_inflight", "rtt_inflight")):
+            if not hasattr(self, attr):
+                try:
+                    setattr(self, attr, syscall_bpf.BpfMap.open_pinned(
+                        os.path.join(self._base, pin),
+                        key_size=self.DNS_CORR_KEY_SIZE, value_size=8))
+                except (OSError, ValueError):
+                    setattr(self, attr, None)
         import struct as _struct
 
         deadline = time.clock_gettime_ns(time.CLOCK_MONOTONIC) - int(
             older_than_s * 1e9)
         purged = 0
         # both correlation maps hold a u64 monotonic stamp per 40-byte key
-        for corr in (self._dns_inflight,
-                     getattr(self, "_rtt_inflight", None)):
+        for corr in (self._dns_inflight, self._rtt_inflight):
             if corr is None:
                 continue
             for key in corr.keys():
@@ -303,9 +304,10 @@ class BpfmanFetcher:
             self._ringbuf.close()
         if self._ssl_rb is not None:
             self._ssl_rb.close()
-        dns = getattr(self, "_dns_inflight", None)
-        if dns is not None:
-            dns.close()
+        for attr in ("_dns_inflight", "_rtt_inflight"):
+            corr = getattr(self, attr, None)
+            if corr is not None:
+                corr.close()
 
 
 BPF_MAP_TYPE_LPM_TRIE = 11

@@ -1,0 +1,146 @@
+"""Concurrency stress: the systematic race-check analog of the reference's
+`-race` CI runs (SURVEY §5.2). Python's runtime can't instrument data races
+the way TSan does, so this hammers the real pipeline from many threads and
+asserts the invariants that races would break: record conservation (nothing
+lost below the lossy-stage floor, nothing duplicated), monotonic window
+accounting, and a clean shutdown with no stuck threads or swallowed
+exceptions."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from netobserv_tpu.datapath.fetcher import FakeFetcher
+from tests.test_pipeline import CollectExporter, make_agent, make_events
+
+N_INJECTORS = 4
+BURSTS_PER_INJECTOR = 30
+EVENTS_PER_BURST = 64
+
+
+def test_concurrent_injection_conserves_records():
+    """Many threads inject eviction batches while the agent drains, flushes,
+    and exports; every injected flow key must come out exactly once (the
+    injected keys are all distinct, so dedup/duplication both surface as a
+    count mismatch)."""
+    fake = FakeFetcher()
+    out = CollectExporter()
+    agent = make_agent(fake, out, CACHE_ACTIVE_TIMEOUT="50ms",
+                       BUFFERS_LENGTH="256")
+    stop = threading.Event()
+    t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+    t.start()
+    errors: list[BaseException] = []
+    total = N_INJECTORS * BURSTS_PER_INJECTOR * EVENTS_PER_BURST
+
+    def injector(tid: int):
+        try:
+            for burst in range(BURSTS_PER_INJECTOR):
+                # distinct src_port space per thread so keys never collide
+                ev = make_events(EVENTS_PER_BURST,
+                                 sport0=10_000 + tid * 4096
+                                 + burst * EVENTS_PER_BURST)
+                fake.inject_events(ev)
+                if burst % 7 == 0:
+                    time.sleep(0.002)  # jitter the interleaving
+        except BaseException as exc:  # pragma: no cover - the assertion
+            errors.append(exc)
+
+    threads = [threading.Thread(target=injector, args=(i,), daemon=True)
+               for i in range(N_INJECTORS)]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+            assert not th.is_alive(), "injector wedged"
+        assert not errors, errors
+        got = []
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and len(got) < total:
+            try:
+                got.extend(out.batches.get(timeout=0.5))
+            except queue.Empty:
+                continue
+        keys = [(r.key.src_port, r.key.src) for r in got]
+        assert len(got) == total, f"lost {total - len(got)} records"
+        assert len(set(keys)) == total, "duplicated records"
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        assert not t.is_alive(), "agent failed to stop under load"
+
+
+def test_concurrent_flush_and_inject():
+    """Flush broadcasts racing steady-state evictions must neither deadlock
+    nor drop the in-flight batches (MapTracer Flush path)."""
+    fake = FakeFetcher()
+    out = CollectExporter()
+    agent = make_agent(fake, out, CACHE_ACTIVE_TIMEOUT="100ms")
+    stop = threading.Event()
+    t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        n_bursts = 20
+        for i in range(n_bursts):
+            fake.inject_events(make_events(32, sport0=30_000 + i * 64))
+            agent.map_tracer.flush()
+        total = n_bursts * 32
+        got = []
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and len(got) < total:
+            try:
+                got.extend(out.batches.get(timeout=0.5))
+            except queue.Empty:
+                continue
+        assert len(got) == total, f"flush raced away {total - len(got)}"
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+@pytest.mark.parametrize("n_threads", [8])
+def test_sketch_ingest_thread_safety(n_threads):
+    """Concurrent jitted sketch ingests on the same process must not corrupt
+    device state (JAX dispatch is thread-safe; the framework's window
+    accounting on top must be too)."""
+    import numpy as np
+
+    from netobserv_tpu.sketch import state as sk
+
+    cfg = sk.SketchConfig(cm_width=4096, topk=128)
+    states = [sk.init_state(cfg) for _ in range(n_threads)]
+    ingest = sk.make_ingest_fn(donate=False)
+    rng = np.random.default_rng(7)
+    batches = []
+    for i in range(n_threads):
+        keys = rng.integers(0, 2**32, (256, 10), dtype=np.uint32)
+        batches.append({
+            "keys": keys,
+            "bytes": np.full(256, 100.0, np.float32),
+            "packets": np.ones(256, np.int32),
+            "rtt_us": np.zeros(256, np.int32),
+            "dns_latency_us": np.zeros(256, np.int32),
+            "valid": np.ones(256, np.bool_),
+        })
+    errors = []
+
+    def worker(i):
+        try:
+            for _ in range(10):
+                states[i] = ingest(states[i], batches[i])
+        except BaseException as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert not errors, errors
+    for i in range(n_threads):
+        # each state folded exactly 10x its batch: records == 2560
+        assert int(states[i].total_records) == 2560
