@@ -89,7 +89,7 @@ class IPFIXExporter(Exporter):
         self._obs_domain = obs_domain
         self._seq = 0
         self._template_refresh = template_refresh_s
-        self._last_template = 0.0
+        self._last_template = float("-inf")
         self._sock: socket.socket | None = None
         self._connect()
 
@@ -102,7 +102,7 @@ class IPFIXExporter(Exporter):
             self._sock.connect(self._addr)
         else:
             self._sock = socket.create_connection(self._addr, timeout=10)
-        self._last_template = 0.0  # (re)send templates on next message
+        self._last_template = float("-inf")  # (re)send templates on next message
 
     def _message(self, sets: bytes) -> bytes:
         hdr = struct.pack(

@@ -20,6 +20,14 @@ BURSTS_PER_INJECTOR = 30
 EVENTS_PER_BURST = 64
 
 
+def _limiter_dropped(agent) -> int:
+    """Records intentionally shed by the CapacityLimiter (the pipeline's one
+    designated lossy stage) — counted, so conservation can include them."""
+    v = agent.metrics.registry.get_sample_value(
+        "ebpf_agent_dropped_flows_total", {"source": "limiter"})
+    return int(v or 0)
+
+
 def test_concurrent_injection_conserves_records():
     """Many threads inject eviction batches while the agent drains, flushes,
     and exports; every injected flow key must come out exactly once (the
@@ -59,14 +67,22 @@ def test_concurrent_injection_conserves_records():
         assert not errors, errors
         got = []
         deadline = time.monotonic() + 20
-        while time.monotonic() < deadline and len(got) < total:
+        while (time.monotonic() < deadline
+               and len(got) + _limiter_dropped(agent) < total):
             try:
                 got.extend(out.batches.get(timeout=0.5))
             except queue.Empty:
                 continue
+        # Conservation: every record is either exported or counted as shed by
+        # the limiter (which is allowed to drop under host load — this suite
+        # shares a loaded machine). Silent loss anywhere else is a race.
+        dropped = _limiter_dropped(agent)
         keys = [(r.key.src_port, r.key.src) for r in got]
-        assert len(got) == total, f"lost {total - len(got)} records"
-        assert len(set(keys)) == total, "duplicated records"
+        assert len(got) + dropped == total, (
+            f"lost {total - len(got) - dropped} records "
+            f"(exported {len(got)}, limiter dropped {dropped})")
+        assert len(set(keys)) == len(got), "duplicated records"
+        assert got, "limiter shed everything — nothing exported"
     finally:
         stop.set()
         t.join(timeout=10)
@@ -90,15 +106,20 @@ def test_concurrent_flush_and_inject():
         total = n_bursts * 32
         got = []
         deadline = time.monotonic() + 20
-        while time.monotonic() < deadline and len(got) < total:
+        while (time.monotonic() < deadline
+               and len(got) + _limiter_dropped(agent) < total):
             try:
                 got.extend(out.batches.get(timeout=0.5))
             except queue.Empty:
                 continue
-        assert len(got) == total, f"flush raced away {total - len(got)}"
+        dropped = _limiter_dropped(agent)
+        assert len(got) + dropped == total, (
+            f"flush raced away {total - len(got) - dropped}")
+        assert got, "limiter shed everything — nothing exported"
     finally:
         stop.set()
         t.join(timeout=10)
+        assert not t.is_alive(), "agent failed to stop after flush storm"
 
 
 @pytest.mark.parametrize("n_threads", [8])
@@ -140,6 +161,7 @@ def test_sketch_ingest_thread_safety(n_threads):
         th.start()
     for th in threads:
         th.join(timeout=60)
+        assert not th.is_alive(), "sketch worker wedged"
     assert not errors, errors
     for i in range(n_threads):
         # each state folded exactly 10x its batch: records == 2560
