@@ -33,8 +33,7 @@ accept/reject/no-match counters) and handshake RTT (SYN→SYN|ACK correlation
 into per-CPU flows_extra records).
 
 Deliberate limits vs flowpath.c: no IP options / v6 extension headers
-(packets with them fall back to untracked), no TLS/QUIC inline trackers, no
-per-rule sampling overrides (sampling is baked at build time), racy
+(packets with them fall back to untracked), no TLS/QUIC inline trackers, racy
 (non-spin-locked) last_seen/flags — all bounded-loss or enrichment-only
 behaviors. Validated by the live verifier and end-to-end veth traffic tests
 (tests/test_asm_flowpath.py).
@@ -127,6 +126,8 @@ FKEY = CTRKEY - 24        # -312: no_filter_key (u32 prefix_len + 16B ip)
 FACT = FKEY - 8           # -320: matched rule's action, saved across lookups
 QMETA = FACT - 8          # -328: quic seen (u8 @+0), is_long (@+1), ver (@+4)
 TLSBUF = QMETA - 16       # -344: TLS header bytes via bpf_skb_load_bytes
+FSAMP = TLSBUF - 8        # -352: matched rule's sample override (u32)
+FSKIP = FSAMP - 8         # -360: filter verdict says drop (reject/no-match)
 
 HELPER_SKB_LOAD_BYTES = 26
 
@@ -157,7 +158,8 @@ class _Flow:
                  dns_port: int, rtt_inflight_fd=None, flows_extra_fd=None,
                  filter_rules_fd=None, filter_peers_fd=None,
                  flows_quic_fd=None, quic_mode: int = 0,
-                 enable_tls: bool = False, sampling_gate_fd=None):
+                 enable_tls: bool = False, sampling_gate_fd=None,
+                 has_filter_sampling: bool = False):
         self.a = Asm()
         self.map_fd = map_fd
         self.direction = direction
@@ -175,6 +177,11 @@ class _Flow:
         self.quic_mode = quic_mode
         self.enable_tls = enable_tls
         self.sampling_gate_fd = sampling_gate_fd
+        # reference has_filter_sampling (flows.c:160-208): when any filter
+        # rule carries a sample override, the 1/N gate moves to after the
+        # filter so the matched rule's rate can replace the global one
+        self.has_filter_sampling = (has_filter_sampling
+                                    and filter_rules_fd is not None)
         self._ctr_n = 0
 
     def set_gate(self, value: int) -> None:
@@ -182,7 +189,8 @@ class _Flow:
         (sampling_gate map; the C datapath's no_set_do_sampling twin).
         Clobbers r0-r3."""
         a = self.a
-        lbl = f"gate_done_{value}"
+        self._gate_n = getattr(self, "_gate_n", 0) + 1
+        lbl = f"gate_done_{value}_{self._gate_n}"
         a.st_imm(BPF_W, R10, CTRKEY, 0)
         a.ld_map_fd(R1, self.sampling_gate_fd)
         a.mov_reg(R2, R10)
@@ -523,6 +531,11 @@ class _Flow:
         Jumps to `fail` when this side produced no usable match (-1 in C)."""
         a = self.a
         t = f"flt_{side}"
+        if self.has_filter_sampling:
+            # reset per-side: a predicates-pass match that then fails the
+            # peer-CIDR check must not leak its sample_override into the
+            # retry/no-match sampling decision
+            a.st_imm(BPF_DW, R10, FSAMP, 0)
         self.filter_key(keyed_ip)
         a.ld_map_fd(R1, self.filter_rules_fd)
         a.mov_reg(R2, R10)
@@ -590,6 +603,9 @@ class _Flow:
         # predicates hold; save the verdict before any further lookup
         a.ldx(BPF_B, R3, R0, _fr("action"))
         a.stx(BPF_DW, R10, R3, FACT)
+        if self.has_filter_sampling:
+            a.ldx(BPF_W, R3, R0, _fr("sample_override"))
+            a.stx(BPF_W, R10, R3, FSAMP)
         a.ldx(BPF_B, R3, R0, _fr("peer_cidr_check"))
         a.jmp_imm(0x15, R3, 0, f"{t}_verdict")
         self.filter_key(peer_ip)
@@ -606,20 +622,54 @@ class _Flow:
 
     def filter_block(self) -> None:
         """filter.h no_flow_filter: source CIDR first, dst CIDR retry, then
-        reject-on-no-match. Divergence from the C path: `sample_override` is
-        ignored (sampling is baked at build time in assembler mode — the
-        loader warns when rules carry one)."""
+        reject-on-no-match. With has_filter_sampling, the 1/N gate runs here
+        instead of at entry, using the matched rule's `sample_override` (else
+        the global rate) — and, matching the reference, the aux-probe gate is
+        set from that decision even for packets the verdict then drops."""
         a = self.a
         self.filter_side("src", KY_SRC_IP, KY_DST_IP, fail="flt_dst")
         a.label("flt_dst")
         self.filter_side("dst", KY_DST_IP, KY_SRC_IP, fail="flt_nomatch")
         a.label("flt_nomatch")
         self.count(CTR_FILTER_NOMATCH)
-        a.jmp("out")            # rules configured but none matched
+        if self.has_filter_sampling:
+            a.st_imm(BPF_DW, R10, FSKIP, 1)
+            a.jmp("flt_sample")
+        else:
+            a.jmp("out")        # rules configured but none matched
         a.label("flt_reject")
         self.count(CTR_FILTER_REJECT)
-        a.jmp("out")
+        if self.has_filter_sampling:
+            a.st_imm(BPF_DW, R10, FSKIP, 1)
+            a.jmp("flt_sample")
+        else:
+            a.jmp("out")
         a.label("flt_done")
+        if self.has_filter_sampling:
+            a.label("flt_sample")
+            # effective rate: the matched rule's override, else the global
+            a.ldx(BPF_W, R9, R10, FSAMP)
+            a.jmp_imm(0x55, R9, 0, "fs_have")
+            a.mov_imm(R9, self.sampling)
+            a.label("fs_have")
+            a.stx(BPF_W, R10, R9, VAL + ST_SAMPLING)
+            a.jmp_imm(0x25, R9, 1, "fs_gate")   # JGT: rate > 1 -> 1/N
+            if self.sampling_gate_fd is not None:
+                self.set_gate(1)
+            a.jmp("fs_skipchk")
+            a.label("fs_gate")
+            a.call(HELPER_PRANDOM_U32)
+            a.alu_reg(0x9F, R0, R9)             # r0 %= rate (ALU64 MOD X)
+            a.jmp_imm(0x15, R0, 0, "fs_sampled")
+            if self.sampling_gate_fd is not None:
+                self.set_gate(0)
+            a.jmp("out")                        # not the sampled 1/N
+            a.label("fs_sampled")
+            if self.sampling_gate_fd is not None:
+                self.set_gate(1)
+            a.label("fs_skipchk")
+            a.ldx(BPF_DW, R3, R10, FSKIP)
+            a.jmp_imm(0x55, R3, 0, "out")       # verdict said drop
 
     def build(self) -> bytes:
         """entry/parse/filter head + the flow-aggregation tail."""
@@ -640,7 +690,7 @@ class _Flow:
         a = self.a
         a.mov_reg(R6, R1)                       # r6 = ctx
 
-        if self.sampling > 1:
+        if self.sampling > 1 and not self.has_filter_sampling:
             # 1/N gate, baked in at build time (loader-rewritten-const analog)
             a.call(HELPER_PRANDOM_U32)
             a.alu_imm(0x97, R0, self.sampling)  # r0 %= N (ALU64 MOD K)
@@ -728,6 +778,8 @@ class _Flow:
 
         # --- flow filter gate (filter.h twin; before trackers/upsert) ------
         if self.filter_rules_fd is not None:
+            if self.has_filter_sampling:
+                a.st_imm(BPF_DW, R10, FSKIP, 0)
             self.filter_block()
 
     def emit_tail(self) -> None:
@@ -798,7 +850,11 @@ class _Flow:
         a.ldx(BPF_DW, R4, R10, SPILL)
         a.alu_reg(0x4F, R3, R4)                 # r3 |= packet flags
         a.stx(BPF_H, R0, R3, ST_FLAGS)
-        if self.sampling > 1:
+        if self.has_filter_sampling:
+            # latest effective rate wins (stored by flt_sample on the stack)
+            a.ldx(BPF_W, R3, R10, VAL + ST_SAMPLING)
+            a.stx(BPF_W, R0, R3, ST_SAMPLING)
+        elif self.sampling > 1:
             a.mov_imm(R3, self.sampling)
             a.stx(BPF_W, R0, R3, ST_SAMPLING)
         if self.enable_tls:
@@ -883,7 +939,9 @@ class _Flow:
         a.ldx(BPF_W, R4, R6, SKB_IFINDEX)
         a.stx(BPF_W, R10, R4, VAL + ST_IFINDEX)
         a.st_imm(BPF_B, R10, VAL + ST_DIR, self.direction)
-        a.st_imm(BPF_W, R10, VAL + ST_SAMPLING, self.sampling)
+        if not self.has_filter_sampling:
+            # (with filter sampling, flt_sample already stored the rate)
+            a.st_imm(BPF_W, R10, VAL + ST_SAMPLING, self.sampling)
         a.st_imm(BPF_B, R10, VAL + ST_NOBS, 1)
         a.st_imm(BPF_B, R10, VAL + ST_OBSDIR, self.direction)
         a.stx(BPF_W, R10, R4, VAL + ST_OBSIF)   # observed_intf[0]
@@ -1104,7 +1162,8 @@ def build_flow_program(map_fd: int, direction: int = 0, sampling: int = 0,
                        flows_quic_fd: int | None = None,
                        quic_mode: int = 0,
                        enable_tls: bool = False,
-                       sampling_gate_fd: int | None = None) -> bytes:
+                       sampling_gate_fd: int | None = None,
+                       has_filter_sampling: bool = False) -> bytes:
     """Assemble one per-direction flow program. Optional map fds gate the
     corresponding feature blocks, mirroring the C datapath's loader-rewritten
     `cfg_enable_*` constants (a feature whose map isn't wired costs zero
@@ -1114,4 +1173,4 @@ def build_flow_program(map_fd: int, direction: int = 0, sampling: int = 0,
                  rtt_inflight_fd, flows_extra_fd,
                  filter_rules_fd, filter_peers_fd,
                  flows_quic_fd, quic_mode, enable_tls,
-                 sampling_gate_fd).build()
+                 sampling_gate_fd, has_filter_sampling).build()

@@ -483,6 +483,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                  enable_dns: bool = False, dns_port: int = 53,
                  enable_rtt: bool = False, enable_pkt_drops: bool = False,
                  enable_filters: bool = False, quic_mode: int = 0,
+                 has_filter_sampling: bool = False,
                  enable_tls: bool = False,
                  enable_openssl: bool = False, libssl_path: str = "",
                  enable_ringbuf_fallback: bool = True,
@@ -493,6 +494,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         self._sweep_stale_pins()
         self._mode = attach_mode
         try:
+            self._has_filter_sampling = (has_filter_sampling
+                                         and enable_filters)
             self._provision(
                 cache_max_flows, sampling, enable_dns, dns_port, enable_rtt,
                 enable_pkt_drops, enable_filters, quic_mode, enable_tls,
@@ -545,7 +548,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         # AND a kprobe consumes the decision (reference do_sampling pattern)
         self._gate_map = None
         want_probes = enable_rtt or enable_pkt_drops
-        if sampling > 1 and want_probes:
+        if (sampling > 1 or self._has_filter_sampling) and want_probes:
             self._gate_map = syscall_bpf.BpfMap.create(
                 self.BPF_MAP_TYPE_PERCPU_ARRAY, 4, 1, 1, b"sampling_gate")
         gate_fd = self._gate_map.fd if self._gate_map else None
@@ -641,7 +644,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                     filter_rules_fd=flt_rules_fd,
                     filter_peers_fd=flt_peers_fd,
                     flows_quic_fd=quic_fd, quic_mode=quic_mode,
-                    enable_tls=enable_tls, sampling_gate_fd=gate_fd))
+                    enable_tls=enable_tls, sampling_gate_fd=gate_fd,
+                    has_filter_sampling=self._has_filter_sampling))
             pin = f"{self._PIN_PREFIX}{os.getpid()}_{name}"
             if os.path.exists(pin):
                 os.unlink(pin)
@@ -683,11 +687,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             raise RuntimeError("kernel datapath requires root/CAP_BPF")
         if cfg.tc_attach_mode != "tcx" and shutil.which("tc") is None:
             raise RuntimeError("tc (iproute2) not found; cannot attach")
-        if cfg.flow_filter_rules and any(
-                getattr(r, "sample", 0) for r in cfg.parsed_filter_rules()):
-            log.warning("filter sample overrides are ignored by the "
-                        "assembler datapath (sampling is baked at load time; "
-                        "the clang object supports per-rule overrides)")
+        has_filter_sampling = bool(cfg.flow_filter_rules) and any(
+            getattr(r, "sample", 0) for r in cfg.parsed_filter_rules())
         return cls(cache_max_flows=cfg.cache_max_flows,
                    attach_mode=cfg.tc_attach_mode, sampling=cfg.sampling,
                    enable_dns=cfg.enable_dns_tracking,
@@ -695,6 +696,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                    enable_rtt=cfg.enable_rtt,
                    enable_pkt_drops=cfg.enable_pkt_drops,
                    enable_filters=bool(cfg.flow_filter_rules),
+                   has_filter_sampling=has_filter_sampling,
                    quic_mode=cfg.quic_tracking_mode,
                    enable_tls=cfg.enable_tls_tracking,
                    enable_openssl=cfg.enable_openssl_tracking,
@@ -727,6 +729,11 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 log.warning("filter maps not provisioned (enable_filters "
                             "was off at load); FLOW_FILTER_RULES ignored")
             return 0
+        if (any(getattr(r, "sample", 0) for r in rules)
+                and not getattr(self, "_has_filter_sampling", False)):
+            log.warning("rules carry sample overrides but the programs were "
+                        "built without has_filter_sampling; overrides will "
+                        "not take effect (reload with the flag)")
         n = _program_filter_tries(self._filter_rules, self._filter_peers,
                                   rules)
         log.info("programmed %d filter rules into the kernel gate", n)

@@ -734,6 +734,49 @@ def test_kernel_flow_filter_reject(veth):
         fetcher.close()
 
 
+def test_kernel_filter_sample_override(veth):
+    """Per-rule sampling overrides (reference flows_filter.h:87-91 +
+    flows.c:160-208 has_filter_sampling): the 1/N gate moves after the
+    filter, a matched rule's sample rate replaces the global one, and the
+    record carries the effective rate. Rule A (dst-keyed, sample=1) keeps
+    its traffic unconditionally; rule B (src-keyed, sample=900000)
+    statistically drops all of its 6 packets (P[any pass] ~ 7e-6)."""
+    from netobserv_tpu.config import FlowFilterRule
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+    from netobserv_tpu.model.flow import GlobalCounter
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_filters=True,
+                                   sampling=0, has_filter_sampling=True)
+    try:
+        fetcher.program_filters([
+            FlowFilterRule(ip_cidr="10.198.0.2/32", action="Accept",
+                           protocol="UDP", destination_port=6700, sample=1),
+            FlowFilterRule(ip_cidr="10.198.0.1/32", action="Accept",
+                           protocol="UDP", destination_port=6800,
+                           sample=900_000)])
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        # dport 6700: src-side rule B fails its port predicate, dst retry
+        # matches rule A -> sample=1 -> always kept
+        _send_udp(n=4, size=90, dport=6700, pace_s=0)
+        # dport 6800: src-side rule B matches -> sample=900000 -> dropped
+        _send_udp(n=6, size=90, dport=6800, pace_s=0)
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        by_port = {int(evicted.events["key"][i]["dst_port"]): i
+                   for i in range(len(evicted))}
+        assert 6700 in by_port, f"override sample=1 flow missing: {by_port}"
+        assert 6800 not in by_port, "sample=900000 flow was not sampled out"
+        ev = evicted.events[by_port[6700]]
+        assert int(ev["stats"]["sampling"]) == 1, ev["stats"]["sampling"]
+        assert int(ev["stats"]["packets"]) == 4
+        # both flows' packets passed the filter verdict (accept counted
+        # before the sampling gate, reference ordering)
+        ctrs = fetcher.read_global_counters()
+        assert ctrs.get(GlobalCounter.FILTER_ACCEPT, 0) >= 10
+    finally:
+        fetcher.close()
+
+
 def _client_hello(ver=0x0303):
     import struct as _s
     hs = b"\x01" + (2 + 32 + 1).to_bytes(3, "big") + _s.pack(">H", ver) + \
