@@ -128,6 +128,14 @@ QMETA = FACT - 8          # -328: quic seen (u8 @+0), is_long (@+1), ver (@+4)
 TLSBUF = QMETA - 16       # -344: TLS header bytes via bpf_skb_load_bytes
 FSAMP = TLSBUF - 8        # -352: matched rule's sample override (u32)
 FSKIP = FSAMP - 8         # -360: filter verdict says drop (reject/no-match)
+CURSOR = FSKIP - 8        # -368: TLS extension-walk packet cursor
+EXTREM = CURSOR - 8       # -376: remaining bytes in the extension list/ext
+BESTV = EXTREM - 8        # -384: best supported_version seen (CH scan)
+KNOWNF = BESTV - 8        # -392: best version is a known one (CH scan)
+
+# extension-walk bound: the reference walks up to 30 extensions
+# (tls_tracker.h); 16 covers real-world hellos at half the unrolled size
+TLS_MAX_EXTS = 16
 
 HELPER_SKB_LOAD_BYTES = 26
 
@@ -334,12 +342,15 @@ class _Flow:
         a.jmp("key_done")
 
     def parse_tls(self, l4: int, v: str) -> None:
-        """Passive TLS metadata from the TCP payload (tls.h subset): record
-        -type bitmap, ClientHello/ServerHello legacy version, ServerHello
-        cipher suite. Stored into the stack stats (VAL) — the miss path
-        inserts them as-built; the hit path merges them (version-mismatch
-        flagging included). Skipped vs tls.h: the ServerHello extension walk
-        (TLS 1.3 supported_versions + key_share stay clang-object-only).
+        """Passive TLS metadata from the TCP payload (tls.h twin): record
+        -type bitmap, ClientHello/ServerHello hello version — including the
+        TLS 1.3 extension walk (reference tls_tracker.h:60-210): the CH
+        supported_versions list is scanned with known-over-unknown-then-
+        higher preference, the SH yields the selected version and the
+        key-share group — plus the ServerHello cipher suite. Stored into the
+        stack stats (VAL) — the miss path inserts them as-built; the hit
+        path merges them (version-mismatch flagging included). The unrolled
+        walk visits up to TLS_MAX_EXTS extensions (reference: 30).
 
         Reads go through bpf_skb_load_bytes, NOT direct packet pointers:
         locally-generated TCP payload usually lives in skb page frags, where
@@ -406,22 +417,168 @@ class _Flow:
         a.ldx(BPF_H, R4, R10, VAL + _st("ssl_version"))
         a.jmp_imm(0x55, R4, 0, f"{t}_sh")       # first hello version wins
         a.stx(BPF_H, R10, R3, VAL + _st("ssl_version"))
+        def cur_load(delta: int, dst_off: int, n: int) -> None:
+            """bpf_skb_load_bytes at CURSOR+delta into TLSBUF+dst_off."""
+            a.mov_reg(R1, R6)
+            a.ldx(BPF_DW, R2, R10, CURSOR)
+            if delta:
+                a.alu_imm(0x07, R2, delta)
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, TLSBUF + dst_off)
+            a.mov_imm(R4, n)
+            a.call(HELPER_SKB_LOAD_BYTES)
+            a.jmp_imm(0x55, R0, 0, done)
+
+        def ntohs_at(off: int, dst: int) -> None:
+            """dst = big-endian u16 at TLSBUF+off (byte loads: no bswap)."""
+            a.ldx(BPF_B, dst, R10, off)
+            a.alu_imm(0x67, dst, 8)
+            a.ldx(BPF_B, R4, R10, off + 1)
+            a.alu_reg(0x4F, dst, R4)
+
+        def ext_hdr_and_type() -> None:
+            """Read the 4B extension header at CURSOR; r3=type, r4=len."""
+            cur_load(0, 0, 4)
+            ntohs_at(TLSBUF, R3)
+            a.mov_reg(R5, R3)                   # keep type; r4 next
+            ntohs_at(TLSBUF + 2, R3)
+            a.mov_reg(R4, R3)                   # r4 = len
+            a.mov_reg(R3, R5)                   # r3 = type
+
+        def ext_advance(i: int, walk: str, end: str) -> None:
+            """CURSOR/EXTREM += one extension; jump to `end` when the list
+            is exhausted; fall through to the next iteration label."""
+            a.label(f"{t}_{walk}_{i}_adv")
+            ntohs_at(TLSBUF + 2, R3)            # re-derive len (regs free)
+            a.mov_reg(R4, R3)
+            a.alu_imm(0x07, R4, 4)              # step = 4 + len
+            a.ldx(BPF_DW, R3, R10, EXTREM)
+            a.jmp_reg(0x3D, R4, R3, end)        # step >= remaining: done
+            a.alu_reg(0x1F, R3, R4)             # remaining -= step
+            a.stx(BPF_DW, R10, R3, EXTREM)
+            a.ldx(BPF_DW, R3, R10, CURSOR)
+            a.alu_reg(0x0F, R3, R4)
+            a.stx(BPF_DW, R10, R3, CURSOR)
+
         a.label(f"{t}_sh")
-        a.jmp_imm(0x55, R5, 2, done)            # cipher: ServerHello only
+        a.jmp_imm(0x15, R5, 2, f"{t}_srv")      # ServerHello: cipher + exts
+        # --- ClientHello: 1.2 vs 1.3 via supported_versions (tls.h twin) ---
+        a.ldx(BPF_H, R3, R10, VAL + _st("ssl_version"))
+        a.jmp_imm(0x55, R3, 0x0303, done)       # only 0x0303 is ambiguous
+        a.mov_reg(R3, R9)
+        a.alu_imm(0x07, R3, 43)                 # session-id length byte
+        a.stx(BPF_DW, R10, R3, CURSOR)
+        cur_load(0, 0, 1)
+        a.ldx(BPF_B, R3, R10, TLSBUF)
+        a.alu_imm(0x07, R3, 1)
+        a.ldx(BPF_DW, R4, R10, CURSOR)
+        a.alu_reg(0x0F, R4, R3)
+        a.stx(BPF_DW, R10, R4, CURSOR)          # += 1 + sid_len
+        cur_load(0, 0, 2)                       # cipher-suites list length
+        ntohs_at(TLSBUF, R3)
+        a.alu_imm(0x07, R3, 2)
+        a.ldx(BPF_DW, R4, R10, CURSOR)
+        a.alu_reg(0x0F, R4, R3)
+        a.stx(BPF_DW, R10, R4, CURSOR)          # += 2 + cipher_len
+        cur_load(0, 0, 1)                       # compression list length
+        a.ldx(BPF_B, R3, R10, TLSBUF)
+        a.alu_imm(0x07, R3, 1)
+        a.ldx(BPF_DW, R4, R10, CURSOR)
+        a.alu_reg(0x0F, R4, R3)
+        a.stx(BPF_DW, R10, R4, CURSOR)          # += 1 + compr_len
+        cur_load(0, 0, 2)                       # extensions total length
+        ntohs_at(TLSBUF, R3)
+        a.stx(BPF_DW, R10, R3, EXTREM)
+        a.ldx(BPF_DW, R4, R10, CURSOR)
+        a.alu_imm(0x07, R4, 2)
+        a.stx(BPF_DW, R10, R4, CURSOR)          # -> first extension header
+        a.st_imm(BPF_DW, R10, BESTV, 0)
+        a.st_imm(BPF_DW, R10, KNOWNF, 0)
+        for i in range(TLS_MAX_EXTS):
+            a.label(f"{t}_che_{i}")
+            a.ldx(BPF_DW, R3, R10, EXTREM)
+            a.jmp_imm(0xA5, R3, 4, done)        # no room for a header
+            ext_hdr_and_type()
+            a.jmp_imm(0x15, R3, 0x002B, f"{t}_chsv")
+            ext_advance(i, "che", done)
+        a.jmp(done)
+        # supported_versions list: <=5 versions, favor known then higher
+        # (IS_KNOWN_VERSION_EXT semantics, tls_tracker.h:112-120)
+        a.label(f"{t}_chsv")
+        a.stx(BPF_DW, R10, R4, EXTREM)          # reuse: bytes in this ext
+        for j in range(5):
+            a.label(f"{t}_chv_{j}")
+            a.ldx(BPF_DW, R3, R10, EXTREM)
+            a.jmp_imm(0xA5, R3, 3 + 2 * j, f"{t}_chv_end")
+            cur_load(4 + 1 + 2 * j, 4, 2)       # skip hdr(4) + list len(1)
+            ntohs_at(TLSBUF + 4, R3)
+            a.mov_imm(R4, 0)
+            a.jmp_imm(0xA5, R3, 0x0300, f"{t}_chv{j}_k")
+            a.jmp_imm(0x25, R3, 0x0304, f"{t}_chv{j}_k")
+            a.mov_imm(R4, 1)                    # 0x0300..0x0304: known
+            a.label(f"{t}_chv{j}_k")
+            nxt = f"{t}_chv_{j + 1}" if j < 4 else f"{t}_chv_end"
+            a.ldx(BPF_DW, R5, R10, KNOWNF)
+            a.jmp_reg(0x1D, R5, R4, f"{t}_chv{j}_same")
+            a.jmp_imm(0x15, R4, 1, f"{t}_chv{j}_take")  # known beats unknown
+            a.jmp(nxt)
+            a.label(f"{t}_chv{j}_same")
+            a.ldx(BPF_DW, R5, R10, BESTV)
+            a.jmp_reg(0xBD, R3, R5, nxt)        # JLE: not higher -> skip
+            a.label(f"{t}_chv{j}_take")
+            a.stx(BPF_DW, R10, R3, BESTV)
+            a.stx(BPF_DW, R10, R4, KNOWNF)
+        a.label(f"{t}_chv_end")
+        a.ldx(BPF_DW, R3, R10, BESTV)
+        a.jmp_imm(0x15, R3, 0, done)            # empty list: keep legacy
+        a.stx(BPF_H, R10, R3, VAL + _st("ssl_version"))
+        a.jmp(done)
+
+        # --- ServerHello: cipher suite, then supported_versions/key_share --
+        a.label(f"{t}_srv")
         # session id length at payload+43 (5 rec + 4 hs + 2 ver + 32 random)
         load_bytes(lambda: (a.mov_reg(R2, R9), a.alu_imm(0x07, R2, 43)),
                    11, 1)
         a.ldx(BPF_B, R5, R10, TLSBUF + 11)
         a.jmp_imm(0x25, R5, 32, done)           # sid_len > 32: implausible
-        a.alu_imm(0x07, R5, 44)                 # cipher offset delta
-        # cipher suite at payload + 44 + sid_len
-        load_bytes(lambda: (a.mov_reg(R2, R9), a.alu_reg(0x0F, R2, R5)),
-                   12, 2)
-        a.ldx(BPF_B, R3, R10, TLSBUF + 12)
-        a.alu_imm(0x67, R3, 8)
-        a.ldx(BPF_B, R4, R10, TLSBUF + 13)
-        a.alu_reg(0x4F, R3, R4)
+        # CURSOR -> cipher suite (payload + 44 + sid_len); r1-r5 die at
+        # every helper call, so the offset lives on the stack from here on
+        a.mov_reg(R3, R9)
+        a.alu_reg(0x0F, R3, R5)
+        a.alu_imm(0x07, R3, 44)
+        a.stx(BPF_DW, R10, R3, CURSOR)
+        cur_load(0, 12, 2)
+        ntohs_at(TLSBUF + 12, R3)
         a.stx(BPF_H, R10, R3, VAL + _st("tls_cipher_suite"))
+        a.ldx(BPF_H, R3, R10, VAL + _st("ssl_version"))
+        a.jmp_imm(0x55, R3, 0x0303, done)       # exts only disambiguate 1.3
+        # layout after cipher: compression(1) + exts_len(2) + extensions
+        cur_load(3, 0, 2)
+        ntohs_at(TLSBUF, R3)
+        a.stx(BPF_DW, R10, R3, EXTREM)
+        a.ldx(BPF_DW, R4, R10, CURSOR)
+        a.alu_imm(0x07, R4, 5)
+        a.stx(BPF_DW, R10, R4, CURSOR)          # first extension header
+        for i in range(TLS_MAX_EXTS):
+            a.label(f"{t}_she_{i}")
+            a.ldx(BPF_DW, R3, R10, EXTREM)
+            a.jmp_imm(0xA5, R3, 4, done)
+            ext_hdr_and_type()
+            a.jmp_imm(0x15, R3, 0x002B, f"{t}_she_{i}_sv")
+            a.jmp_imm(0x15, R3, 0x0033, f"{t}_she_{i}_ks")
+            a.jmp(f"{t}_she_{i}_adv")
+            a.label(f"{t}_she_{i}_sv")          # the selected 1.3 version
+            a.jmp_imm(0xA5, R4, 2, f"{t}_she_{i}_adv")
+            cur_load(4, 4, 2)
+            ntohs_at(TLSBUF + 4, R3)
+            a.stx(BPF_H, R10, R3, VAL + _st("ssl_version"))
+            a.jmp(f"{t}_she_{i}_adv")
+            a.label(f"{t}_she_{i}_ks")          # key-share group
+            a.jmp_imm(0xA5, R4, 2, f"{t}_she_{i}_adv")
+            cur_load(4, 4, 2)
+            ntohs_at(TLSBUF + 4, R3)
+            a.stx(BPF_H, R10, R3, VAL + _st("tls_key_share"))
+            ext_advance(i, "she", done)
         a.label(done)
         a.mov_imm(R9, 6)                        # restore proto for the
         # shared ports/tracker gates downstream
@@ -873,8 +1030,12 @@ class _Flow:
             a.stx(BPF_H, R0, R3, _st("ssl_version"))
             a.label("tlsm_ciph")
             a.ldx(BPF_H, R3, R10, VAL + _st("tls_cipher_suite"))
-            a.jmp_imm(0x15, R3, 0, "tlsm_types")
+            a.jmp_imm(0x15, R3, 0, "tlsm_ks")
             a.stx(BPF_H, R0, R3, _st("tls_cipher_suite"))
+            a.label("tlsm_ks")
+            a.ldx(BPF_H, R3, R10, VAL + _st("tls_key_share"))
+            a.jmp_imm(0x15, R3, 0, "tlsm_types")
+            a.stx(BPF_H, R0, R3, _st("tls_key_share"))
             a.label("tlsm_types")
             a.ldx(BPF_B, R3, R10, VAL + _st("tls_types"))
             a.ldx(BPF_B, R4, R0, _st("tls_types"))

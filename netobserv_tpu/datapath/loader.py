@@ -514,6 +514,11 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         from netobserv_tpu.datapath import asm_flowpath
         from netobserv_tpu.model.flow import GlobalCounter
 
+        log.info("assembler datapath features: dns=%s rtt=%s drops=%s "
+                 "filters=%s quic=%d tls=%s openssl=%s sampling=%d "
+                 "filter_sampling=%s", enable_dns, enable_rtt,
+                 enable_pkt_drops, enable_filters, quic_mode, enable_tls,
+                 enable_openssl, sampling, self._has_filter_sampling)
         self._agg = syscall_bpf.BpfMap.create(
             self.BPF_MAP_TYPE_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
             binfmt.FLOW_STATS_DTYPE.itemsize, cache_max_flows, b"agg_flows")

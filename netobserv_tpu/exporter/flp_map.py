@@ -86,14 +86,15 @@ def record_to_map(r: Record) -> dict:
         out["IPSecStatus"] = "success" if f.ipsec_encrypted else "failure"
     if r.ssl_version:
         out["TlsVersion"] = tls_types.tls_version_name(r.ssl_version)
-        if r.tls_cipher_suite:
-            out["TlsCipher"] = tls_types.cipher_suite_name(r.tls_cipher_suite)
-        if r.tls_key_share:
-            out["TlsKeyShare"] = tls_types.key_share_name(r.tls_key_share)
-        if r.tls_types:
-            out["TlsTypes"] = tls_types.tls_types_names(r.tls_types)
-        if r.ssl_mismatch:
-            out["TlsMismatch"] = True
+    if r.tls_cipher_suite:
+        out["TlsCipher"] = tls_types.cipher_suite_name(r.tls_cipher_suite)
+    if r.tls_key_share:
+        out["TlsKeyShare"] = tls_types.key_share_name(r.tls_key_share)
+    if r.tls_types:
+        # set for any TLS record type, hello or not (mid-connection attach)
+        out["TlsTypes"] = tls_types.tls_types_names(r.tls_types)
+    if r.ssl_mismatch:
+        out["TlsMismatch"] = True
     if f.ssl_plaintext_events:
         out["SslPlaintextEvents"] = f.ssl_plaintext_events
         out["SslPlaintextBytes"] = f.ssl_plaintext_bytes

@@ -136,6 +136,36 @@ class Record:
                        XlatDstAddr=ip_from_16(f.xlat_dst_ip),
                        XlatSrcPort=f.xlat_src_port, XlatDstPort=f.xlat_dst_port,
                        XlatZoneId=f.xlat_zone_id)
+        if f.ipsec_encrypted or f.ipsec_encrypted_ret:
+            obj.update(IPSecRet=f.ipsec_encrypted_ret,
+                       IPSecStatus="success" if f.ipsec_encrypted
+                       else "failure")
+        if (self.ssl_version or self.tls_types or self.tls_cipher_suite
+                or self.tls_key_share):
+            # tls_types/cipher can be set without a hello version (e.g. the
+            # agent attached mid-connection and saw only ApplicationData)
+            from netobserv_tpu.model import tls_types as _tt
+            if self.ssl_version:
+                obj["TlsVersion"] = _tt.tls_version_name(self.ssl_version)
+            if self.tls_cipher_suite:
+                obj["TlsCipher"] = _tt.cipher_suite_name(self.tls_cipher_suite)
+            if self.tls_key_share:
+                obj["TlsKeyShare"] = _tt.key_share_name(self.tls_key_share)
+            if self.tls_types:
+                obj["TlsTypes"] = _tt.tls_types_names(self.tls_types)
+            if self.ssl_mismatch:
+                obj["TlsMismatch"] = True
+        if f.ssl_plaintext_events:
+            obj.update(SslPlaintextEvents=f.ssl_plaintext_events,
+                       SslPlaintextBytes=f.ssl_plaintext_bytes)
+        if f.quic_version or f.quic_seen_long_hdr or f.quic_seen_short_hdr:
+            obj.update(QuicVersion=f.quic_version,
+                       QuicLongHdr=f.quic_seen_long_hdr,
+                       QuicShortHdr=f.quic_seen_short_hdr)
+        if f.network_events:
+            from netobserv_tpu.utils.ovn_decoder import decode_event
+            obj["NetworkEvents"] = [decode_event(ev)
+                                    for ev in f.network_events]
         return obj
 
 

@@ -847,6 +847,93 @@ def test_tls_passive_tracking(veth):
         fetcher.close()
 
 
+def _ext(etype, data):
+    import struct as _s
+    return _s.pack(">HH", etype, len(data)) + data
+
+
+def _client_hello13():
+    """TLS 1.3 ClientHello: legacy 0x0303, supported_versions after a filler
+    extension, list mixing a GREASE value with 0x0304/0x0303."""
+    import struct as _s
+    exts = _ext(0x0000, b"\x00" * 6)             # filler ext to walk over
+    exts += _ext(0x002B,
+                 b"\x06" + _s.pack(">HHH", 0x7F1C, 0x0304, 0x0303))
+    body = _s.pack(">H", 0x0303) + b"\x00" * 32 + b"\x00"
+    body += _s.pack(">H", 2) + _s.pack(">H", 0x1301)   # cipher-suite list
+    body += b"\x01\x00"                                # compression list
+    body += _s.pack(">H", len(exts)) + exts
+    hs = b"\x01" + len(body).to_bytes(3, "big") + body
+    return b"\x16\x03\x01" + _s.pack(">H", len(hs)) + hs
+
+
+def _server_hello13():
+    """TLS 1.3 ServerHello: key_share (x25519) then supported_versions."""
+    import struct as _s
+    ks = _s.pack(">HH", 0x001D, 2) + b"\x00\x01"
+    exts = _ext(0x0033, ks) + _ext(0x002B, _s.pack(">H", 0x0304))
+    body = _s.pack(">H", 0x0303) + b"\x00" * 32 + b"\x00"
+    body += _s.pack(">H", 0x1302) + b"\x00"            # cipher + compression
+    body += _s.pack(">H", len(exts)) + exts
+    hs = b"\x02" + len(body).to_bytes(3, "big") + body
+    return b"\x16\x03\x03" + _s.pack(">H", len(hs)) + hs
+
+
+def test_tls13_extension_walk(veth):
+    """TLS 1.3 discrimination (tls.h extension walk, now in the assembler):
+    the ClientHello's supported_versions list is scanned with known-over-
+    unknown preference (GREASE 0x7f1c loses to 0x0304), and the ServerHello
+    yields the selected version plus the key-share group."""
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    listener = subprocess.Popen(
+        ["ip", "netns", "exec", NS, sys.executable, "-c",
+         "import socket,sys;"
+         "s=socket.socket();s.bind(('10.198.0.2',5444));s.listen(1);"
+         "c,_=s.accept();c.recv(512);"
+         f"c.sendall(bytes.fromhex('{_server_hello13().hex()}'));"
+         "import time;time.sleep(1)"])
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_tls=True)
+    try:
+        fetcher.attach(_ifindex(veth), veth, "both")
+        c = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                c = socket.socket()
+                c.settimeout(3)
+                c.connect(("10.198.0.2", 5444))
+                break
+            except OSError:
+                c.close()
+                c = None
+                time.sleep(0.2)
+        assert c is not None, "listener never came up"
+        cport = c.getsockname()[1]
+        c.sendall(_client_hello13())
+        c.recv(512)
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        c.close()
+        stats = {}
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            if int(k["proto"]) == 6 and cport in (
+                    int(k["src_port"]), int(k["dst_port"])):
+                stats[int(k["src_port"])] = evicted.events["stats"][i]
+        cli = stats.get(cport)
+        srv = stats.get(5444)
+        assert cli is not None and srv is not None, f"flows: {list(stats)}"
+        assert int(cli["ssl_version"]) == 0x0304, hex(int(cli["ssl_version"]))
+        assert int(srv["ssl_version"]) == 0x0304, hex(int(srv["ssl_version"]))
+        assert int(srv["tls_cipher_suite"]) == 0x1302
+        assert int(srv["tls_key_share"]) == 0x001D
+    finally:
+        listener.kill()
+        listener.wait()
+        fetcher.close()
+
+
 def test_quic_tracking(veth):
     """Crafted QUIC packets (RFC 8999 invariants) across the veth: a long
     header records the version, a short header marks the connection

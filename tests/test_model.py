@@ -238,6 +238,40 @@ class TestRecord:
         assert obj["Bytes"] == 1500
         assert obj["AgentIP"] == "1.2.3.4"
 
+    def test_json_feature_fields(self):
+        """The stdout JSON surface must carry every tracker's enrichment
+        (this went missing for TLS/QUIC/IPsec/SSL/nevents once: a kernel
+        datapath feature is only done when it reaches the export)."""
+        events = np.zeros(1, dtype=binfmt.FLOW_EVENT_DTYPE)
+        events[0] = make_event()
+        events[0]["stats"]["ssl_version"] = 0x0304
+        events[0]["stats"]["tls_cipher_suite"] = 0x1301
+        events[0]["stats"]["tls_key_share"] = 0x001D
+        events[0]["stats"]["tls_types"] = 0x04
+        r = records_from_events(events, agent_ip="1.2.3.4")[0]
+        r.features.quic_version = 0x00000001
+        r.features.quic_seen_long_hdr = True
+        r.features.ipsec_encrypted = True
+        r.features.ssl_plaintext_events = 2
+        r.features.ssl_plaintext_bytes = 77
+        obj = r.to_json_obj()
+        assert obj["TlsVersion"] == "TLS1.3"
+        assert obj["TlsCipher"]
+        assert obj["TlsKeyShare"] == "x25519"
+        assert obj["TlsTypes"] == ["Handshake"]
+        assert obj["QuicVersion"] == 1 and obj["QuicLongHdr"] is True
+        assert obj["IPSecStatus"] == "success"
+        assert obj["SslPlaintextEvents"] == 2
+        assert obj["SslPlaintextBytes"] == 77
+        # record types survive without a hello version (mid-connection
+        # attach sees only ApplicationData; the bitmap must still export)
+        r.ssl_version = 0
+        r.tls_cipher_suite = 0
+        r.tls_key_share = 0
+        obj = r.to_json_obj()
+        assert "TlsVersion" not in obj
+        assert obj["TlsTypes"] == ["Handshake"]
+
     def test_normalized_key_symmetric(self):
         k1 = FlowKey.make("10.0.0.1", "10.0.0.2", 10, 20, 6)
         k2 = FlowKey.make("10.0.0.2", "10.0.0.1", 20, 10, 6)
