@@ -18,7 +18,10 @@ def build_exporter(cfg, metrics=None):
         return StdoutJSONExporter(metrics=metrics)
     if cfg.export == c.EXPORT_DIRECT_FLP:
         from netobserv_tpu.exporter.direct_flp import DirectFLPExporter
-        return DirectFLPExporter(flp_config=cfg.flp_config)
+        return DirectFLPExporter(
+            flp_config=cfg.flp_config,
+            # encode/prom metrics surface on the agent's /metrics server
+            prom_registry=metrics.registry if metrics is not None else None)
     if cfg.export == c.EXPORT_TPU_SKETCH:
         return TpuSketchExporter.from_config(cfg, metrics=metrics)
     if cfg.export == c.EXPORT_GRPC:

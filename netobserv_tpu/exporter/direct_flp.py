@@ -10,8 +10,16 @@ Supported stage subset (the shapes the reference's smoke-test configs use):
   `keep_entry_if_doesnt_exist`, `keep_entry_if_equal`, `keep_entry_if_not_equal`
 - `transform` / type `generic`: `policy: replace_keys` with `rules` [{input,
   output}] field renaming
-- `write` / type `stdout` (default when no pipeline is configured) or `ipfix`/
-  `grpc` terminal re-export
+- `transform` / type `network` (FLP transform_network.go subset): rules
+  `add_subnet`, `add_service`, `add_subnet_label`, `decode_tcp_flags`,
+  `reinterpret_direction`; `add_location`/`add_kubernetes*` need external
+  databases and are warned-and-skipped
+- `encode` / type `prom` (FLP encode_prom.go subset): counter/gauge/
+  histogram metrics with labels and equal/not_equal/presence/absence/
+  match_regex filters, registered on the exporter's `prom_registry`
+  (served by the agent's metrics server when one is running)
+- `write` / type `stdout` (default when no pipeline is configured) or type
+  `loki` (push-API JSON streams with label promotion and tenant header)
 """
 
 from __future__ import annotations
@@ -60,6 +68,200 @@ def _build_filter(params: dict) -> Stage:
     return stage
 
 
+# FLP utils/tcp_flags.go table (incl. the synthetic combination bits)
+_TCP_FLAG_NAMES = [
+    (1, "FIN"), (2, "SYN"), (4, "RST"), (8, "PSH"), (16, "ACK"), (32, "URG"),
+    (64, "ECE"), (128, "CWR"), (256, "SYN_ACK"), (512, "FIN_ACK"),
+    (1024, "RST_ACK"),
+]
+
+_PROTO_NAMES = {6: "tcp", 17: "udp", 132: "sctp"}
+
+
+def _build_network(params: dict) -> Stage:
+    """FLP `transform network` subset (transform_network.go:64-160)."""
+    import ipaddress
+    import socket as _socket
+
+    rules = params.get("rules", [])
+    subnet_labels = []
+    for lbl in params.get("subnetLabels", []):
+        nets = [ipaddress.ip_network(c) for c in lbl.get("cidrs", [])]
+        subnet_labels.append((lbl.get("name", ""), nets))
+    dir_info = params.get("directionInfo", {})
+    svc_cache: dict = {}
+
+    def service_name(port, proto) -> str:
+        key = (port, proto)
+        if key not in svc_cache:
+            name = ""
+            try:
+                pnum = int(proto)
+                pname = _PROTO_NAMES.get(pnum, "")
+            except (TypeError, ValueError):
+                pname = str(proto).lower()
+            try:
+                name = _socket.getservbyport(int(port), pname) if pname \
+                    else _socket.getservbyport(int(port))
+            except (OSError, OverflowError, ValueError):
+                name = ""
+            svc_cache[key] = name
+        return svc_cache[key]
+
+    def stage(entry: dict) -> Optional[dict]:
+        for rule in rules:
+            rtype = rule.get("type")
+            if rtype == "add_subnet":
+                r = rule.get("add_subnet", rule)
+                ip = entry.get(r.get("input"))
+                if isinstance(ip, str):
+                    mask = str(r.get("parameters",
+                                     r.get("subnet_mask", "/24")))
+                    if not mask.startswith("/"):
+                        mask = "/" + mask
+                    try:
+                        net = ipaddress.ip_network(ip + mask, strict=False)
+                        entry[r.get("output")] = str(net)
+                    except ValueError:
+                        pass
+            elif rtype == "add_service":
+                r = rule.get("add_service", rule)
+                port = entry.get(r.get("input"))
+                proto = entry.get(r.get("protocol"))
+                if port is not None:
+                    name = service_name(port, proto)
+                    if name:
+                        entry[r.get("output")] = name
+            elif rtype == "add_subnet_label":
+                r = rule.get("add_subnet_label", rule)
+                ip = entry.get(r.get("input"))
+                if isinstance(ip, str):
+                    try:
+                        addr = ipaddress.ip_address(ip)
+                    except ValueError:
+                        continue
+                    for name, nets in subnet_labels:
+                        if any(addr in n for n in nets):
+                            entry[r.get("output")] = name
+                            break
+            elif rtype == "decode_tcp_flags":
+                r = rule.get("decode_tcp_flags", rule)
+                flags = entry.get(r.get("input"))
+                if flags is not None:
+                    try:
+                        bits = int(flags)
+                    except (TypeError, ValueError):
+                        continue
+                    names = [n for v, n in _TCP_FLAG_NAMES if bits & v]
+                    if names or r.get("output") == r.get("input"):
+                        entry[r.get("output")] = names
+            elif rtype == "reinterpret_direction":
+                # transform_network_direction.go: per-node direction from
+                # the reporter's viewpoint (0 ingress / 1 egress / 2 inner)
+                fd_field = dir_info.get("flowDirectionField")
+                if not fd_field:
+                    continue
+                if dir_info.get("ifDirectionField") and fd_field in entry:
+                    entry[dir_info["ifDirectionField"]] = entry[fd_field]
+                reporter = entry.get(dir_info.get("reporterIPField"))
+                src = entry.get(dir_info.get("srcHostField"))
+                dst = entry.get(dir_info.get("dstHostField"))
+                if not reporter:
+                    continue
+                if src != dst:
+                    if src == reporter:
+                        entry[fd_field] = 1     # egress
+                    elif dst == reporter:
+                        entry[fd_field] = 0     # ingress
+                elif src:
+                    entry[fd_field] = 2         # inner
+            else:
+                log.warning("transform.network rule %r unsupported; skipped",
+                            rtype)
+        return entry
+
+    return stage
+
+
+def _build_prom(params: dict, registry) -> Stage:
+    """FLP `encode prom` subset (encode_prom.go): declarative metrics from
+    the entry stream, registered on `registry`. Entries pass through."""
+    import re
+
+    from prometheus_client import Counter, Gauge, Histogram
+
+    prefix = params.get("prefix", "")
+    metrics = []
+    for item in params.get("metrics", []):
+        name = prefix + item.get("name", "")
+        labels = list(item.get("labels", []))
+        mtype = item.get("type", "counter")
+        kw = {"registry": registry}
+        if mtype == "counter":
+            m = Counter(name, name, labels, **kw)
+        elif mtype == "gauge":
+            m = Gauge(name, name, labels, **kw)
+        elif mtype in ("histogram", "agg_histogram"):
+            buckets = item.get("buckets") or Histogram.DEFAULT_BUCKETS
+            m = Histogram(name, name, labels, buckets=buckets, **kw)
+        else:
+            log.warning("prom metric type %r unsupported; skipped", mtype)
+            continue
+        filters = []
+        for f in item.get("filters", []):
+            ftype = f.get("type", "equal")
+            key, value = f.get("key"), f.get("value")
+            if ftype in ("match_regex", "not_match_regex"):
+                value = re.compile(str(value))
+            elif ftype in ("equal", "not_equal"):
+                value = str(value)
+            filters.append((ftype, key, value))
+        metrics.append((m, mtype, item.get("valueKey", ""), labels, filters))
+
+    def matches(entry: dict, filters) -> bool:
+        for ftype, key, value in filters:
+            present = key in entry
+            ev = str(entry.get(key)) if present else ""
+            if ftype == "equal" and ev != value:
+                return False
+            if ftype == "not_equal" and ev == value:
+                return False
+            if ftype == "presence" and not present:
+                return False
+            if ftype == "absence" and present:
+                return False
+            if ftype == "match_regex" and not value.search(ev):
+                return False
+            if ftype == "not_match_regex" and value.search(ev):
+                return False
+        return True
+
+    def stage(entry: dict) -> Optional[dict]:
+        for m, mtype, value_key, labels, filters in metrics:
+            if not matches(entry, filters):
+                continue
+            if value_key:
+                if value_key not in entry:
+                    continue            # FLP skips on a missing value key
+                try:
+                    v = float(entry[value_key] or 0)
+                except (TypeError, ValueError):
+                    continue
+            else:
+                v = 1.0
+            series = m.labels(*[str(entry.get(lb, "")) for lb in labels]) \
+                if labels else m
+            if mtype == "counter":
+                series.inc(v)
+            elif mtype == "gauge":
+                series.set(v)
+            else:
+                series.observe(v)
+        return entry
+
+    return stage
+
+
 def _build_generic(params: dict) -> Stage:
     rules = params.get("rules", [])
     policy = params.get("policy", "replace_keys")
@@ -78,9 +280,15 @@ def _build_generic(params: dict) -> Stage:
 class DirectFLPExporter(Exporter):
     name = "direct-flp"
 
-    def __init__(self, flp_config: str = "", stream=None):
+    def __init__(self, flp_config: str = "", stream=None, prom_registry=None):
+        from prometheus_client import CollectorRegistry
+
         self._stream = stream if stream is not None else sys.stdout
         self._stages: list[Stage] = []
+        # encode/prom metrics land here; the agent passes its own registry so
+        # they surface on the existing /metrics server
+        self.prom_registry = (prom_registry if prom_registry is not None
+                              else CollectorRegistry())
         if flp_config.strip():
             self._build(yaml.safe_load(flp_config))
 
@@ -96,16 +304,31 @@ class DirectFLPExporter(Exporter):
                     self._stages.append(_build_filter(t.get("filter", {})))
                 elif ttype == "generic":
                     self._stages.append(_build_generic(t.get("generic", {})))
+                elif ttype == "network":
+                    self._stages.append(_build_network(t.get("network", {})))
                 else:
                     log.warning("unsupported transform type %r ignored", ttype)
+            elif "encode" in p:
+                e = p["encode"]
+                if e.get("type") == "prom":
+                    self._stages.append(
+                        _build_prom(e.get("prom", {}), self.prom_registry))
+                else:
+                    log.warning("unsupported encode type %r ignored",
+                                e.get("type"))
             elif "write" in p:
                 wtype = p["write"].get("type", "stdout")
-                if wtype != "stdout":
+                if wtype == "loki":
+                    self._writer = _LokiWriter(p["write"].get("loki", {}))
+                elif wtype != "stdout":
                     log.warning("write type %r unsupported; using stdout", wtype)
             elif "ingest" in p or not p:
                 continue
 
+    _writer = None  # non-stdout terminal (e.g. _LokiWriter)
+
     def export_batch(self, records: list[Record]) -> None:
+        out = []
         for r in records:
             entry: Optional[dict] = record_to_map(r)
             for stage in self._stages:
@@ -113,6 +336,65 @@ class DirectFLPExporter(Exporter):
                 if entry is None:
                     break
             if entry is not None:
-                self._stream.write(
-                    json.dumps(entry, separators=(",", ":")) + "\n")
+                out.append(entry)
+        if self._writer is not None:
+            self._writer.push(out)
+            return
+        for entry in out:
+            self._stream.write(json.dumps(entry, separators=(",", ":")) + "\n")
         self._stream.flush()
+
+
+class _LokiWriter:
+    """FLP `write loki` subset (api/write_loki.go): push the entry stream to
+    Loki's /loki/api/v1/push as JSON streams. Entries are grouped by their
+    label set per batch; the agent's batching replaces batchWait/batchSize
+    timers (one push per exported batch). Push failures are logged and
+    dropped — an unreachable Loki must not wedge the eviction loop."""
+
+    def __init__(self, params: dict):
+        self.url = params.get("url", "http://localhost:3100").rstrip("/")
+        self.tenant = params.get("tenantID", "")
+        self.labels = list(params.get("labels", []))
+        self.static_labels = dict(params.get("staticLabels", {}))
+        self.ts_label = params.get("timestampLabel", "TimeFlowEndMs")
+        # FLP timestampScale, e.g. "1s" / "1ms" -> ns multiplier
+        scale = params.get("timestampScale", "1ms")
+        self.ts_ns_mult = {"1s": 10**9, "1ms": 10**6, "1us": 10**3,
+                           "1ns": 1}.get(scale, 10**6)
+
+    def push(self, entries: list[dict]) -> None:
+        import http.client
+        import time as _time
+        import urllib.error
+        import urllib.request
+
+        if not entries:
+            return
+        streams: dict[tuple, list] = {}
+        for e in entries:
+            lbl = dict(self.static_labels)
+            for k in self.labels:
+                if k in e:
+                    lbl[k] = str(e[k])
+            try:
+                ts = int(int(e.get(self.ts_label, 0)) * self.ts_ns_mult) \
+                    or _time.time_ns()
+            except (TypeError, ValueError):
+                ts = _time.time_ns()
+            streams.setdefault(tuple(sorted(lbl.items())), []).append(
+                [str(ts), json.dumps(e, separators=(",", ":"))])
+        body = json.dumps({"streams": [
+            {"stream": dict(k), "values": v} for k, v in streams.items()
+        ]}).encode()
+        req = urllib.request.Request(
+            self.url + "/loki/api/v1/push", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        if self.tenant:
+            req.add_header("X-Scope-OrgID", self.tenant)
+        try:
+            urllib.request.urlopen(req, timeout=10).read()
+        except (urllib.error.URLError, OSError,
+                http.client.HTTPException) as exc:
+            log.warning("loki push failed (%d entries dropped): %s",
+                        len(entries), exc)
