@@ -32,10 +32,13 @@ lookup with the full predicate set, src-first/dst-retry, peer-CIDR check,
 accept/reject/no-match counters) and handshake RTT (SYN→SYN|ACK correlation
 into per-CPU flows_extra records).
 
-Deliberate limits vs flowpath.c: no IP options / v6 extension headers
-(packets with them fall back to untracked), no TLS/QUIC inline trackers, racy
-(non-spin-locked) last_seen/flags — all bounded-loss or enrichment-only
-behaviors. Validated by the live verifier and end-to-end veth traffic tests
+Beyond flowpath.c/the reference: IPv4-options packets key their real ports
+(fill_iphdr assumes ihl=5 and mis-reads them, utils.h:113-118) and IPv6
+flows behind extension headers key the real transport via a bounded chain
+walk (fill_ip6hdr keys the first next-header). Deliberate limits: racy
+(non-spin-locked) last_seen/flags, and the per-packet trackers (TCP flags,
+DNS/TLS/QUIC) stay on the constant-offset fast path — slow-path flows are
+keyed and counted but not feature-enriched. Validated by the live verifier and end-to-end veth traffic tests
 (tests/test_asm_flowpath.py).
 """
 
@@ -243,8 +246,11 @@ class _Flow:
         a = self.a
         a.jmp_imm(0x15, R9, 6, f"tcp_{v}")
         a.jmp_imm(0x15, R9, 17, f"udp_{v}")
+        a.jmp_imm(0x15, R9, 132, f"ports_{v}")  # SCTP: same port offsets
         a.jmp_imm(0x15, R9, icmp_proto, f"icmp_{v}")
-        a.jmp("out")                            # other protocols: untracked
+        # other protocols: keyed on addresses+proto, no ports (the
+        # reference's fill_l4info default — GRE/ESP/... flows still count)
+        a.jmp("key_done")
 
         a.label(f"tcp_{v}")
         self.bounds(l4 + 14, f"ports_{v}")      # flags byte at l4+13
@@ -583,6 +589,52 @@ class _Flow:
         a.mov_imm(R9, 6)                        # restore proto for the
         # shared ports/tracker gates downstream
 
+    def slow_l4(self, v: str, icmp_proto: int) -> None:
+        """L4 key fields at a DYNAMIC offset (stack slot CURSOR) via
+        bpf_skb_load_bytes — used by the IPv4-options and IPv6-extension
+        slow paths, where the L4 offset isn't a verifier-visible constant.
+        Ports/ICMP only; per-packet trackers (flags/DNS/TLS/QUIC) stay on
+        the constant-offset fast path. r9 = final transport protocol.
+        Truncated packets keep the address+proto key (reference behavior:
+        fill_l4info leaves ports zero when the header doesn't fit)."""
+        a = self.a
+        t = f"slow_{v}"
+
+        def load_at_cursor(n: int) -> None:
+            a.mov_reg(R1, R6)
+            a.ldx(BPF_DW, R2, R10, CURSOR)
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, TLSBUF)
+            a.mov_imm(R4, n)
+            a.call(HELPER_SKB_LOAD_BYTES)
+            a.jmp_imm(0x55, R0, 0, "key_done")
+
+        a.jmp_imm(0x15, R9, 6, f"{t}_p")
+        a.jmp_imm(0x15, R9, 17, f"{t}_p")
+        a.jmp_imm(0x15, R9, 132, f"{t}_p")
+        a.jmp_imm(0x15, R9, icmp_proto, f"{t}_i")
+        a.jmp("key_done")
+        a.label(f"{t}_p")
+        load_at_cursor(4)
+        a.ldx(BPF_B, R3, R10, TLSBUF)
+        a.alu_imm(0x67, R3, 8)
+        a.ldx(BPF_B, R4, R10, TLSBUF + 1)
+        a.alu_reg(0x4F, R3, R4)
+        a.stx(BPF_H, R10, R3, KEY + KY_SPORT)
+        a.ldx(BPF_B, R3, R10, TLSBUF + 2)
+        a.alu_imm(0x67, R3, 8)
+        a.ldx(BPF_B, R4, R10, TLSBUF + 3)
+        a.alu_reg(0x4F, R3, R4)
+        a.stx(BPF_H, R10, R3, KEY + KY_DPORT)
+        a.jmp("key_done")
+        a.label(f"{t}_i")
+        load_at_cursor(2)
+        a.ldx(BPF_B, R3, R10, TLSBUF)
+        a.stx(BPF_B, R10, R3, KEY + KY_ICMP_TYPE)
+        a.ldx(BPF_B, R3, R10, TLSBUF + 1)
+        a.stx(BPF_B, R10, R3, KEY + KY_ICMP_CODE)
+        a.jmp("key_done")
+
     def copy_ip16(self, pkt_off: int, key_off: int) -> None:
         """Copy a 16-byte address from the packet to the key (word chunks:
         stack DW stores would be misaligned at these offsets)."""
@@ -895,21 +947,49 @@ class _Flow:
         # --- IPv4 ---------------------------------------------------------
         a.label("v4")
         self.bounds(38, "out")                  # eth+ip20+l4 first 4 bytes
+
+        def v4_l3() -> None:
+            """DSCP/proto/addresses — all within the fixed 20-byte header."""
+            a.ldx(BPF_B, R3, R7, 15)            # TOS -> dscp
+            a.alu_imm(0x77, R3, 2)
+            a.stx(BPF_B, R10, R3, VAL + ST_DSCP)
+            a.ldx(BPF_B, R9, R7, 23)            # protocol
+            a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
+            # v4-mapped addresses: ::ffff prefix + 4 address bytes
+            a.st_imm(BPF_H, R10, KEY + KY_SRC_IP + 10, 0xFFFF)
+            a.ldx(BPF_W, R3, R7, 26)            # saddr (BE bytes as-is)
+            a.stx(BPF_W, R10, R3, KEY + KY_SRC_IP + 12)
+            a.st_imm(BPF_H, R10, KEY + KY_DST_IP + 10, 0xFFFF)
+            a.ldx(BPF_W, R3, R7, 30)            # daddr
+            a.stx(BPF_W, R10, R3, KEY + KY_DST_IP + 12)
+            a.st_imm(BPF_H, R10, VAL + ST_ETH, 0x0800)
+            # non-first fragments carry no L4 header: keep the addrs+proto
+            # key, never read payload bytes as ports (the reference doesn't
+            # check frag_off and mis-keys these). LE halfword view of the
+            # BE flags/fragment-offset field: 0xFF1F covers the 13 offset
+            # bits and excludes MF/DF, so first fragments still parse ports
+            a.ldx(BPF_H, R3, R7, 20)
+            a.alu_imm(0x57, R3, 0xFF1F)
+            a.jmp_imm(0x55, R3, 0, "key_done")
+
         a.ldx(BPF_B, R3, R7, 14)                # version/ihl
-        a.jmp_imm(0x55, R3, 0x45, "out")        # options: untracked (minimal)
-        a.ldx(BPF_B, R3, R7, 15)                # TOS -> dscp
-        a.alu_imm(0x77, R3, 2)
-        a.stx(BPF_B, R10, R3, VAL + ST_DSCP)
-        a.ldx(BPF_B, R9, R7, 23)                # protocol
-        a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
-        # v4-mapped addresses: ::ffff prefix + 4 address bytes
-        a.st_imm(BPF_H, R10, KEY + KY_SRC_IP + 10, 0xFFFF)
-        a.ldx(BPF_W, R3, R7, 26)                # saddr (BE bytes as-is)
-        a.stx(BPF_W, R10, R3, KEY + KY_SRC_IP + 12)
-        a.st_imm(BPF_H, R10, KEY + KY_DST_IP + 10, 0xFFFF)
-        a.ldx(BPF_W, R3, R7, 30)                # daddr
-        a.stx(BPF_W, R10, R3, KEY + KY_DST_IP + 12)
-        a.st_imm(BPF_H, R10, VAL + ST_ETH, 0x0800)
+        a.jmp_imm(0x15, R3, 0x45, "v4_std")
+        # IP options present: the reference mis-parses these (fill_iphdr
+        # assumes ihl=5, utils.h:113-118); here the L4 offset is computed
+        # from ihl and the ports read via bpf_skb_load_bytes
+        a.mov_reg(R4, R3)
+        a.alu_imm(0x77, R4, 4)
+        a.jmp_imm(0x55, R4, 4, "out")           # not IPv4: drop
+        a.alu_imm(0x57, R3, 0x0F)
+        a.jmp_imm(0xA5, R3, 5, "out")           # ihl < 5: malformed
+        a.alu_imm(0x27, R3, 4)
+        a.alu_imm(0x07, R3, 14)
+        a.stx(BPF_DW, R10, R3, CURSOR)          # dynamic L4 offset
+        v4_l3()
+        self.slow_l4("v4", icmp_proto=1)
+
+        a.label("v4_std")
+        v4_l3()
         self.parse_l4(l4=34, v="v4", icmp_proto=1)
 
         # --- IPv6 ---------------------------------------------------------
@@ -924,12 +1004,66 @@ class _Flow:
         a.alu_imm(0x77, R4, 6)
         a.alu_reg(0x4F, R3, R4)
         a.stx(BPF_B, R10, R3, VAL + ST_DSCP)
-        a.ldx(BPF_B, R9, R7, 20)                # next header
-        a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
         self.copy_ip16(22, KEY + KY_SRC_IP)
         self.copy_ip16(38, KEY + KY_DST_IP)
         a.st_imm(BPF_H, R10, VAL + ST_ETH, 0x86DD)
+        a.ldx(BPF_B, R9, R7, 20)                # next header
+        a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
+        _V6_EXT = (0, 43, 44, 60)               # hop/routing/frag/dst-opts
+        for h in _V6_EXT:
+            a.jmp_imm(0x15, R9, h, "v6_ext")
         self.parse_l4(l4=54, v="v6", icmp_proto=58)
+
+        # extension-header chain walk (the reference skips this entirely —
+        # utils.h:133-148 keys such flows on the FIRST next-header with no
+        # ports; here a bounded walk finds the real transport). Each header
+        # is [next-header, hdr-ext-len] with size 8 + len*8 bytes, except
+        # the fragment header which is a fixed 8.
+        a.label("v6_ext")
+        a.st_imm(BPF_DW, R10, CURSOR, 54)
+        for step in range(4):
+            a.label(f"v6x_{step}")
+            a.mov_reg(R1, R6)
+            a.ldx(BPF_DW, R2, R10, CURSOR)
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, TLSBUF)
+            a.mov_imm(R4, 4)    # [nh, len, frag-off hi, frag-off lo]
+            a.call(HELPER_SKB_LOAD_BYTES)
+            # truncated chain: keyed on the last seen next-header, no ports
+            a.jmp_imm(0x55, R0, 0, "key_done")
+            # size of the CURRENT header (its type is in the flow key slot)
+            a.ldx(BPF_B, R3, R10, KEY + KY_PROTO)
+            a.ldx(BPF_B, R4, R10, TLSBUF + 1)   # hdr-ext-len
+            a.jmp_imm(0x55, R3, 44, f"v6x_{step}_var")
+            a.mov_imm(R4, 0)                    # fragment: fixed 8 bytes
+            # non-first fragment (13-bit offset != 0): no L4 header in this
+            # packet — key on addrs + the fragment's next-header, portless
+            a.ldx(BPF_B, R3, R10, TLSBUF + 2)
+            a.alu_imm(0x67, R3, 8)
+            a.ldx(BPF_B, R5, R10, TLSBUF + 3)
+            a.alu_reg(0x4F, R3, R5)
+            a.alu_imm(0x57, R3, 0xFFF8)
+            a.jmp_imm(0x15, R3, 0, f"v6x_{step}_var")
+            a.ldx(BPF_B, R3, R10, TLSBUF)
+            a.stx(BPF_B, R10, R3, KEY + KY_PROTO)
+            a.jmp("key_done")
+            a.label(f"v6x_{step}_var")
+            a.alu_imm(0x27, R4, 8)
+            a.alu_imm(0x07, R4, 8)
+            a.ldx(BPF_DW, R5, R10, CURSOR)
+            a.alu_reg(0x0F, R5, R4)
+            a.stx(BPF_DW, R10, R5, CURSOR)
+            a.ldx(BPF_B, R9, R10, TLSBUF)       # chain's next-header
+            a.stx(BPF_B, R10, R9, KEY + KY_PROTO)
+            if step < 3:
+                nxt = f"v6x_{step + 1}"
+                for h in _V6_EXT:
+                    a.jmp_imm(0x15, R9, h, nxt)
+                a.jmp("v6x_done")
+                # the jeqs above fall through to the next iteration only via
+                # `nxt`; non-extension headers exit the walk
+        a.label("v6x_done")
+        self.slow_l4("v6", icmp_proto=58)
 
         a.label("key_done")
 

@@ -68,12 +68,11 @@ def _build_filter(params: dict) -> Stage:
     return stage
 
 
-# FLP utils/tcp_flags.go table (incl. the synthetic combination bits)
-_TCP_FLAG_NAMES = [
-    (1, "FIN"), (2, "SYN"), (4, "RST"), (8, "PSH"), (16, "ACK"), (32, "URG"),
-    (64, "ECE"), (128, "CWR"), (256, "SYN_ACK"), (512, "FIN_ACK"),
-    (1024, "RST_ACK"),
-]
+from netobserv_tpu.model.flow import TcpFlags
+
+# FLP utils/tcp_flags.go table (incl. the synthetic combination bits) —
+# derived from the model enum so the mapping cannot drift
+_TCP_FLAG_NAMES = [(f.value, f.name) for f in TcpFlags]
 
 _PROTO_NAMES = {6: "tcp", 17: "udp", 132: "sctp"}
 

@@ -847,6 +847,98 @@ def test_tls_passive_tracking(veth):
         fetcher.close()
 
 
+def test_kernel_l3_parse_completeness(veth):
+    """Beyond-reference parse coverage: IPv4-options packets key their REAL
+    ports (the reference assumes ihl=5 and reads ports from inside the
+    options block, utils.h:113-118), SCTP ports parse (fast path), unknown
+    transports still count keyed on addresses+proto (fill_l4info default),
+    and an IPv6 flow behind a destination-options extension header keys the
+    real transport (the reference keys the extension type, no ports)."""
+    import struct
+
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    _run("ip", "addr", "add", "fd00:198::1/64", "dev", veth, "nodad")
+    _run("ip", "netns", "exec", NS, "ip", "addr", "add", "fd00:198::2/64",
+         "dev", "nf1", "nodad")
+    time.sleep(0.3)
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024)
+    try:
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        # --- IPv4 with options (ihl=6: one 4-byte NOP/NOP/NOP/EOL block)
+        udp = struct.pack(">HHHH", 7777, 8888, 8 + 4, 0) + b"opts"
+        ver_ihl, tot = 0x46, 24 + len(udp)
+        iph = struct.pack(">BBHHHBBH4s4s", ver_ihl, 0, tot, 0, 0, 64, 17, 0,
+                          socket.inet_aton("10.198.0.1"),
+                          socket.inet_aton("10.198.0.2")) + b"\x01\x01\x01\x00"
+        raw = socket.socket(socket.AF_INET, socket.SOCK_RAW,
+                            socket.IPPROTO_RAW)
+        for _ in range(3):
+            raw.sendto(iph + udp, ("10.198.0.2", 0))
+        raw.close()
+        # --- SCTP (proto 132): kernel fills the ip header, ihl=5 fast path
+        sctp = socket.socket(socket.AF_INET, socket.SOCK_RAW, 132)
+        sctp.sendto(struct.pack(">HHII", 5060, 5061, 0, 0),
+                    ("10.198.0.2", 0))
+        sctp.close()
+        # --- unknown transport (GRE, proto 47): keyed, portless
+        gre = socket.socket(socket.AF_INET, socket.SOCK_RAW, 47)
+        gre.sendto(b"\x00" * 8, ("10.198.0.2", 0))
+        gre.close()
+        # --- IPv6 + destination-options extension header, then UDP
+        s6 = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+        s6.bind(("fd00:198::1", 7979))
+        # [nh placeholder, len=0, PadN(4)] — kernel rewrites the next-header
+        dstopts = bytes([0, 0, 1, 2, 0, 0, 1, 0])
+        s6.sendmsg([b"v6ext"],
+                   [(socket.IPPROTO_IPV6, socket.IPV6_DSTOPTS, dstopts)],
+                   0, ("fd00:198::2", 8989))
+        s6.close()
+        # --- fragmented datagrams (both families): the first fragment keys
+        # real ports, the tails key addrs+proto with NO ports — never
+        # payload bytes misread as ports (the reference checks no frag
+        # offsets and mis-keys tails into garbage-port flows)
+        f4 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        IP_MTU_DISCOVER, IP_PMTUDISC_DONT = 10, 0   # not in the socket mod
+        f4.setsockopt(socket.IPPROTO_IP, IP_MTU_DISCOVER, IP_PMTUDISC_DONT)
+        f4.bind(("10.198.0.1", 7070))
+        f4.sendto(b"4" * 3000, ("10.198.0.2", 7071))
+        f4.close()
+        f6 = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+        f6.bind(("fd00:198::1", 7072))
+        f6.sendto(b"6" * 3000, ("fd00:198::2", 7073))
+        f6.close()
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        flows = {}
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            s = evicted.events["stats"][i]
+            flows[(int(s["eth_protocol"]), int(k["proto"]),
+                   int(k["src_port"]), int(k["dst_port"]))] = s
+        v4e, v6e = 0x0800, 0x86DD
+        assert (v4e, 17, 7777, 8888) in flows, f"v4-options: {list(flows)}"
+        assert int(flows[(v4e, 17, 7777, 8888)]["packets"]) == 3
+        assert (v4e, 132, 5060, 5061) in flows, f"sctp: {list(flows)}"
+        assert (v4e, 47, 0, 0) in flows, f"unknown-proto: {list(flows)}"
+        assert (v6e, 17, 7979, 8989) in flows, f"v6-ext: {list(flows)}"
+        # fragmentation: first fragments keyed with ports...
+        assert (v4e, 17, 7070, 7071) in flows, f"v4 first-frag: {list(flows)}"
+        assert (v6e, 17, 7072, 7073) in flows, f"v6 first-frag: {list(flows)}"
+        assert int(flows[(v4e, 17, 7070, 7071)]["packets"]) == 1
+        # ...tails keyed portless on the real transport — and no flow with
+        # garbage ports exists (any port outside the ones we sent)
+        assert (v4e, 17, 0, 0) in flows, f"v4 frag tails: {list(flows)}"
+        assert (v6e, 17, 0, 0) in flows, f"v6 frag tails: {list(flows)}"
+        sent_ports = {0, 7777, 8888, 5060, 5061, 7979, 8989, 7070, 7071,
+                      7072, 7073}
+        garbage = [f for f in flows
+                   if f[2] not in sent_ports or f[3] not in sent_ports]
+        assert not garbage, f"garbage-port flows from fragments: {garbage}"
+    finally:
+        fetcher.close()
+
+
 def _ext(etype, data):
     import struct as _s
     return _s.pack(">HH", etype, len(data)) + data
