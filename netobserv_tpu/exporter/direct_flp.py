@@ -14,6 +14,11 @@ Supported stage subset (the shapes the reference's smoke-test configs use):
   `add_subnet`, `add_service`, `add_subnet_label`, `decode_tcp_flags`,
   `reinterpret_direction`; `add_location`/`add_kubernetes*` need external
   databases and are warned-and-skipped
+- `extract` / type `conntrack` (FLP api/conntrack.go subset): canonical
+  bidirectional connection hashing, per-direction (splitAB) sum/count/min/
+  max/first/last aggregates, newConnection/flowLog/heartbeat/endConnection
+  records with FIN-driven and timeout-driven teardown (timers ride the
+  batch cadence)
 - `encode` / type `prom` (FLP encode_prom.go subset): counter/gauge/
   histogram metrics with labels and equal/not_equal/presence/absence/
   match_regex filters, registered on the exporter's `prom_registry`
@@ -33,6 +38,7 @@ import yaml
 
 from netobserv_tpu.exporter.base import Exporter
 from netobserv_tpu.exporter.flp_map import record_to_map
+from netobserv_tpu.model.flow import TcpFlags
 from netobserv_tpu.model.record import Record
 
 log = logging.getLogger("netobserv_tpu.exporter.direct_flp")
@@ -67,8 +73,6 @@ def _build_filter(params: dict) -> Stage:
 
     return stage
 
-
-from netobserv_tpu.model.flow import TcpFlags
 
 # FLP utils/tcp_flags.go table (incl. the synthetic combination bits) —
 # derived from the model enum so the mapping cannot drift
@@ -261,6 +265,202 @@ def _build_prom(params: dict, registry) -> Stage:
     return stage
 
 
+class _ConnTrack:
+    """FLP `extract conntrack` subset (api/conntrack.go): stitches
+    unidirectional flow logs into connection records keyed by a canonical
+    (bidirectional when fieldGroupARef/BRef are set) hash. Emits the
+    configured record types: newConnection, flowLog, heartbeat,
+    endConnection (timeout-, terminating- and FIN-driven). Timer semantics
+    ride the exporter's batch cadence: sweeps run per exported batch, not on
+    a wall-clock goroutine like FLP's."""
+
+    def __init__(self, params: dict):
+        kd = params.get("keyDefinition", {})
+        self.groups = {g.get("name"): list(g.get("fields", []))
+                       for g in kd.get("fieldGroups", [])}
+        h = kd.get("hash", {})
+        self.refs = [self.groups.get(r, []) for r in
+                     h.get("fieldGroupRefs", [])]
+        self.group_a = self.groups.get(h.get("fieldGroupARef"), [])
+        self.group_b = self.groups.get(h.get("fieldGroupBRef"), [])
+        self.bidi = bool(self.group_a and self.group_b)
+        self.out_types = set(params.get("outputRecordTypes", ["flowLog"]))
+        self.out_fields = [
+            (f.get("name"), f.get("operation", "count"),
+             bool(f.get("splitAB")), f.get("input") or f.get("name"))
+            for f in params.get("outputFields", [])]
+        sched = (params.get("scheduling") or [{}])[0]
+        self.end_timeout = _duration_s(sched.get("endConnectionTimeout"), 10)
+        self.term_timeout = _duration_s(sched.get("terminatingTimeout"), 5)
+        self.heartbeat_s = _duration_s(sched.get("heartbeatInterval"), 30)
+        # FLP default (api/conntrack.go doc): 100k; 0 stays unlimited
+        self.max_tracked = int(
+            params.get("maxConnectionsTracked", 100_000))
+        tf = params.get("tcpFlags", {})
+        self.flags_field = tf.get("fieldName", "")
+        self.detect_end = bool(tf.get("detectEndConnection"))
+        self.swap_ab = bool(tf.get("swapAB"))
+        self.conns: dict = {}
+        self._hash_n = 0
+        self._overflow = 0
+
+    def _vals(self, entry: dict, fields) -> tuple:
+        return tuple(str(entry.get(f, "")) for f in fields)
+
+    def _key(self, entry: dict):
+        ref_vals = tuple(self._vals(entry, g) for g in self.refs)
+        if not self.bidi:
+            return (ref_vals,), True
+        a, b = self._vals(entry, self.group_a), self._vals(entry, self.group_b)
+        return (ref_vals, tuple(sorted((a, b)))), True
+
+    def _agg_init(self) -> dict:
+        agg = {}
+        for name, op, split, _ in self.out_fields:
+            for suffix in (("_AB", "_BA") if split else ("",)):
+                agg[name + suffix] = 0 if op in ("sum", "count") else None
+        return agg
+
+    def _agg_update(self, agg: dict, entry: dict, is_ab: bool) -> None:
+        for name, op, split, inp in self.out_fields:
+            k = name + (("_AB" if is_ab else "_BA") if split else "")
+            if op == "count":
+                agg[k] = (agg[k] or 0) + 1
+                continue
+            if inp not in entry:
+                continue
+            try:
+                v = float(entry[inp])
+            except (TypeError, ValueError):
+                continue
+            cur = agg[k]
+            if op == "sum":
+                agg[k] = (cur or 0) + v
+            elif op == "min":
+                agg[k] = v if cur is None else min(cur, v)
+            elif op == "max":
+                agg[k] = v if cur is None else max(cur, v)
+            elif op == "first":
+                agg[k] = v if cur is None else cur
+            elif op == "last":
+                agg[k] = v
+
+    def _conn_record(self, conn: dict, rtype: str) -> dict:
+        rec = dict(conn["key_fields"])
+        for k, v in conn["agg"].items():
+            if v is not None:
+                rec[k] = v
+        rec["_RecordType"] = rtype
+        rec["_HashId"] = conn["hash_id"]
+        return rec
+
+    def __call__(self, entry: dict):
+        import time as _time
+
+        now = _time.monotonic()
+        out = []
+        key, _ = self._key(entry)
+        conn = self.conns.get(key)
+        flags = 0
+        if self.flags_field:
+            try:
+                flags = int(entry.get(self.flags_field, 0) or 0)
+            except (TypeError, ValueError):
+                flags = 0
+        if conn is None:
+            if not self.max_tracked or len(self.conns) < self.max_tracked:
+                a = self._vals(entry, self.group_a) if self.bidi else ()
+                key_fields = {f: entry.get(f)
+                              for g in self.groups.values() for f in g}
+                # swapAB: a first flow log carrying SYN_ACK was sent by the
+                # server — orient the connection from the client instead,
+                # and swap the A/B field values on the connection record
+                # (FLP swaps the field groups pairwise by position)
+                if self.bidi and self.swap_ab and flags & 0x100:
+                    a = self._vals(entry, self.group_b)
+                    for fa, fb in zip(self.group_a, self.group_b):
+                        key_fields[fa], key_fields[fb] = \
+                            entry.get(fb), entry.get(fa)
+                self._hash_n += 1
+                conn = {"a": a, "agg": self._agg_init(),
+                        "key_fields": key_fields,
+                        "hash_id": f"{self._hash_n:08x}",
+                        "last_update": now, "last_report": now,
+                        "fin_seen_at": None, "new": True}
+                self.conns[key] = conn
+            else:
+                self._overflow += 1
+        if conn is not None:
+            is_ab = (not self.bidi
+                     or self._vals(entry, self.group_a) == conn["a"])
+            self._agg_update(conn["agg"], entry, is_ab)
+            conn["last_update"] = now
+            if self.detect_end and flags & 0x201:       # FIN or FIN_ACK
+                conn["fin_seen_at"] = conn["fin_seen_at"] or now
+            if conn.pop("new", False) and \
+                    "newConnection" in self.out_types:
+                out.append(self._conn_record(conn, "newConnection"))
+        if "flowLog" in self.out_types:
+            fl = dict(entry)
+            fl["_RecordType"] = "flowLog"
+            if conn is not None:
+                fl["_HashId"] = conn["hash_id"]
+            out.append(fl)
+        return out
+
+    def sweep(self) -> list:
+        """Timer pass, run once per exported batch: heartbeats and
+        connection teardown (idle timeout / FIN + terminating timeout)."""
+        import time as _time
+
+        now = _time.monotonic()
+        out = []
+        if self._overflow:
+            log.warning("conntrack: store full (%d); %d flow logs passed "
+                        "through untracked since the last sweep",
+                        self.max_tracked, self._overflow)
+            self._overflow = 0
+        for key in list(self.conns):
+            conn = self.conns[key]
+            ended = (now - conn["last_update"] >= self.end_timeout
+                     or (conn["fin_seen_at"] is not None
+                         and now - conn["fin_seen_at"] >= self.term_timeout))
+            if ended:
+                if "endConnection" in self.out_types:
+                    out.append(self._conn_record(conn, "endConnection"))
+                del self.conns[key]
+            elif (now - conn["last_report"] >= self.heartbeat_s
+                    and "heartbeat" in self.out_types):
+                out.append(self._conn_record(conn, "heartbeat"))
+                conn["last_report"] = now
+        return out
+
+    def flush(self) -> list:
+        """Shutdown: every live connection emits its endConnection."""
+        out = []
+        if "endConnection" in self.out_types:
+            out = [self._conn_record(c, "endConnection")
+                   for c in self.conns.values()]
+        self.conns.clear()
+        return out
+
+
+def _duration_s(v, default: float) -> float:
+    """Parse an FLP duration ('30s', '2m', '500ms', number) to seconds."""
+    if v is None or v == "":
+        return float(default)
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    for suffix, mult in (("ms", 1e-3), ("s", 1.0), ("m", 60.0), ("h", 3600.0)):
+        if s.endswith(suffix) and s[:-len(suffix)].replace(".", "").isdigit():
+            return float(s[:-len(suffix)]) * mult
+    try:
+        return float(s)
+    except ValueError:
+        return float(default)
+
+
 def _build_generic(params: dict) -> Stage:
     rules = params.get("rules", [])
     policy = params.get("policy", "replace_keys")
@@ -307,6 +507,13 @@ class DirectFLPExporter(Exporter):
                     self._stages.append(_build_network(t.get("network", {})))
                 else:
                     log.warning("unsupported transform type %r ignored", ttype)
+            elif "extract" in p:
+                x = p["extract"]
+                if x.get("type") == "conntrack":
+                    self._stages.append(_ConnTrack(x.get("conntrack", {})))
+                else:
+                    log.warning("unsupported extract type %r ignored",
+                                x.get("type"))
             elif "encode" in p:
                 e = p["encode"]
                 if e.get("type") == "prom":
@@ -327,21 +534,49 @@ class DirectFLPExporter(Exporter):
     _writer = None  # non-stdout terminal (e.g. _LokiWriter)
 
     def export_batch(self, records: list[Record]) -> None:
-        out = []
-        for r in records:
-            entry: Optional[dict] = record_to_map(r)
-            for stage in self._stages:
-                entry = stage(entry)
-                if entry is None:
-                    break
-            if entry is not None:
-                out.append(entry)
+        entries: list[dict] = [record_to_map(r) for r in records]
+        self._emit(self._run_stages(entries))
+
+    def _run_stages(self, entries: list[dict], stages=None) -> list[dict]:
+        for stage in (self._stages if stages is None else stages):
+            nxt: list[dict] = []
+            for entry in entries:
+                res = stage(entry)
+                if res is None:
+                    continue
+                nxt.extend(res) if isinstance(res, list) else nxt.append(res)
+            # stateful stages (conntrack) produce timer records per batch
+            sweep = getattr(stage, "sweep", None)
+            if sweep is not None:
+                nxt.extend(sweep())
+            entries = nxt
+        return entries
+
+    def _emit(self, out: list[dict]) -> None:
         if self._writer is not None:
             self._writer.push(out)
             return
         for entry in out:
             self._stream.write(json.dumps(entry, separators=(",", ":")) + "\n")
         self._stream.flush()
+
+    def close(self) -> None:
+        """Drain stateful stages: live connections emit endConnection
+        through the remainder of the pipeline before shutdown. Never raises
+        — a failed final emit must not abort agent shutdown (the fetcher
+        teardown runs after this)."""
+        for i, stage in enumerate(self._stages):
+            flush = getattr(stage, "flush", None)
+            if flush is None:
+                continue
+            try:
+                pending = flush()
+                if pending:
+                    self._emit(self._run_stages(
+                        pending, stages=self._stages[i + 1:]))
+            except Exception as exc:
+                log.warning("shutdown flush failed (%s); remaining "
+                            "connection records dropped", exc)
 
 
 class _LokiWriter:

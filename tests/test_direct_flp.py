@@ -191,6 +191,108 @@ parameters:
     assert g("flp_bytes_hist_bucket", {"le": "100.0"}) == 1
 
 
+CT_CFG = """
+pipeline: [{name: ct}, {name: w, follows: ct}]
+parameters:
+  - name: ct
+    extract:
+      type: conntrack
+      conntrack:
+        keyDefinition:
+          fieldGroups:
+            - {name: src, fields: [SrcAddr, SrcPort]}
+            - {name: dst, fields: [DstAddr, DstPort]}
+            - {name: common, fields: [Proto]}
+          hash:
+            fieldGroupRefs: [common]
+            fieldGroupARef: src
+            fieldGroupBRef: dst
+        outputRecordTypes: [newConnection, flowLog, endConnection]
+        outputFields:
+          - {name: Bytes, operation: sum, splitAB: true}
+          - {name: Packets, operation: sum}
+          - {name: numFlowLogs, operation: count}
+        scheduling:
+          - {endConnectionTimeout: 60s, terminatingTimeout: 100ms,
+             heartbeatInterval: 300s}
+        tcpFlags: {fieldName: Flags, detectEndConnection: true}
+  - name: w
+    write: {type: stdout}
+"""
+
+
+def test_extract_conntrack_bidirectional():
+    """FLP extract/conntrack subset: A->B and B->A flow logs stitch into ONE
+    connection (canonical bidirectional hash); aggregates split by
+    direction; a FIN ends the connection after terminatingTimeout."""
+    import time
+
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=CT_CFG, stream=buf)
+    ab = make_record(nbytes=1000)                     # 10.1.1.1 -> 10.2.2.2
+    ba = make_record(src="10.2.2.2", dst="10.1.1.1", sport=443, dport=1111,
+                     nbytes=300)
+    ba.key = type(ba.key).make("10.2.2.2", "10.1.1.1", 443, 1111, 6)
+    exp.export_batch([ab])
+    exp.export_batch([ba])
+    out = [json.loads(l) for l in buf.getvalue().splitlines()]
+    types = [e["_RecordType"] for e in out]
+    assert types == ["newConnection", "flowLog", "flowLog"], types
+    new = out[0]
+    assert new["SrcAddr"] == "10.1.1.1" and new["DstAddr"] == "10.2.2.2"
+    hash_id = new["_HashId"]
+    assert all(e["_HashId"] == hash_id for e in out), "split connection"
+    # FIN from the B side ends the connection after terminatingTimeout
+    fin = make_record(src="10.2.2.2", dst="10.1.1.1", sport=443, dport=1111,
+                      nbytes=60)
+    fin.key = type(fin.key).make("10.2.2.2", "10.1.1.1", 443, 1111, 6)
+    fin.tcp_flags = 0x211                             # FIN|ACK|FIN_ACK
+    exp.export_batch([fin])
+    time.sleep(0.15)
+    exp.export_batch([])                              # timer sweep
+    out = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert out[-1]["_RecordType"] == "endConnection", out[-1]
+    end = out[-1]
+    assert end["Bytes_AB"] == 1000 and end["Bytes_BA"] == 360
+    assert end["Packets"] == 21                       # 3 logs x 7 packets
+    assert end["numFlowLogs"] == 3
+    assert end["_HashId"] == hash_id
+
+
+def test_extract_conntrack_swap_ab():
+    """swapAB: when the first observed flow log is the server's SYN_ACK, the
+    connection is oriented from the client — including the record's field
+    values, so Src/Dst and the _AB aggregates agree."""
+    buf = io.StringIO()
+    cfg = CT_CFG.replace("tcpFlags: {fieldName: Flags, detectEndConnection: true}",
+                         "tcpFlags: {fieldName: Flags, swapAB: true}")
+    exp = DirectFLPExporter(flp_config=cfg, stream=buf)
+    synack = make_record(src="10.2.2.2", dst="10.1.1.1", sport=443,
+                         dport=1111, nbytes=60)
+    synack.key = type(synack.key).make("10.2.2.2", "10.1.1.1", 443, 1111, 6)
+    synack.tcp_flags = 0x112                          # SYN|ACK|SYN_ACK
+    client = make_record(nbytes=500)                  # 10.1.1.1:1111 -> 443
+    exp.export_batch([synack, client])
+    out = [json.loads(l) for l in buf.getvalue().splitlines()]
+    new = [e for e in out if e["_RecordType"] == "newConnection"][0]
+    assert new["SrcAddr"] == "10.1.1.1" and new["SrcPort"] == 1111
+    assert new["DstAddr"] == "10.2.2.2" and new["DstPort"] == 443
+    exp.close()
+    end = [json.loads(l) for l in buf.getvalue().splitlines()
+           if json.loads(l)["_RecordType"] == "endConnection"][0]
+    assert end["Bytes_AB"] == 500 and end["Bytes_BA"] == 60
+
+
+def test_extract_conntrack_close_flushes():
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=CT_CFG, stream=buf)
+    exp.export_batch([make_record()])
+    exp.close()
+    out = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert out[-1]["_RecordType"] == "endConnection"
+    assert out[-1]["numFlowLogs"] == 1
+
+
 def test_write_loki():
     """FLP write_loki subset: entries stream to a live HTTP endpoint in the
     Loki push shape, grouped by label set, with tenant header — verified
