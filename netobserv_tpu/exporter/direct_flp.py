@@ -19,6 +19,11 @@ Supported stage subset (the shapes the reference's smoke-test configs use):
   max/first/last aggregates, newConnection/flowLog/heartbeat/endConnection
   records with FIN-driven and timeout-driven teardown (timers ride the
   batch cadence)
+- `extract` / type `aggregates` (api/extract_aggregate.go subset): group-by
+  sum/min/max/avg/count/raw_values with running totals + per-cycle recent_*
+  values and group expiry; replaces the stream like FLP's Extract
+- `extract` / type `timebased` (api/extract_timebased.go subset): sliding-
+  window top-K over indexKeys by sum/min/max/avg/count/last/diff
 - `encode` / type `prom` (FLP encode_prom.go subset): counter/gauge/
   histogram metrics with labels and equal/not_equal/presence/absence/
   match_regex filters, registered on the exporter's `prom_registry`
@@ -32,6 +37,7 @@ from __future__ import annotations
 import json
 import logging
 import sys
+import time as _time
 from typing import Callable, Optional
 
 import yaml
@@ -310,9 +316,9 @@ class _ConnTrack:
     def _key(self, entry: dict):
         ref_vals = tuple(self._vals(entry, g) for g in self.refs)
         if not self.bidi:
-            return (ref_vals,), True
+            return (ref_vals,)
         a, b = self._vals(entry, self.group_a), self._vals(entry, self.group_b)
-        return (ref_vals, tuple(sorted((a, b)))), True
+        return (ref_vals, tuple(sorted((a, b))))
 
     def _agg_init(self) -> dict:
         agg = {}
@@ -355,11 +361,9 @@ class _ConnTrack:
         return rec
 
     def __call__(self, entry: dict):
-        import time as _time
-
         now = _time.monotonic()
         out = []
-        key, _ = self._key(entry)
+        key = self._key(entry)
         conn = self.conns.get(key)
         flags = 0
         if self.flags_field:
@@ -411,8 +415,6 @@ class _ConnTrack:
     def sweep(self) -> list:
         """Timer pass, run once per exported batch: heartbeats and
         connection teardown (idle timeout / FIN + terminating timeout)."""
-        import time as _time
-
         now = _time.monotonic()
         out = []
         if self._overflow:
@@ -445,19 +447,186 @@ class _ConnTrack:
         return out
 
 
+class _Aggregates:
+    """FLP `extract aggregates` subset (api/extract_aggregate.go): group-by
+    aggregation over the flow-log stream. Like FLP's Extract, the stage
+    REPLACES the stream: flow logs are absorbed and one record per active
+    (definition, group) is emitted per exported batch, carrying running
+    totals plus recent_* values that reset each cycle; idle groups expire
+    after expiryTime (default 2m)."""
+
+    def __init__(self, params: dict):
+        default_expiry = _duration_s(params.get("defaultExpiryTime"), 120)
+        self.defs = []
+        for d in params.get("rules", params.get("aggregates", [])):
+            self.defs.append({
+                "name": d.get("name", ""),
+                "by": list(d.get("groupByKeys", [])),
+                "op": d.get("operationType", "count"),
+                "key": d.get("operationKey", ""),
+                "expiry": _duration_s(d.get("expiryTime"), default_expiry),
+                "groups": {},
+            })
+
+    def __call__(self, entry: dict):
+        now = _time.monotonic()
+        for d in self.defs:
+            gv = tuple(str(entry.get(k, "")) for k in d["by"])
+            g = d["groups"].get(gv)
+            if g is None:
+                g = d["groups"][gv] = {
+                    "total_value": 0.0, "total_count": 0, "recent_count": 0,
+                    "recent_op": None, "recent_raw": [], "last": now}
+            g["last"] = now
+            g["total_count"] += 1
+            g["recent_count"] += 1
+            v = 1.0
+            if d["op"] != "count":
+                if d["key"] not in entry:
+                    continue            # missing input: count only
+                try:
+                    v = float(entry[d["key"]] or 0)
+                except (TypeError, ValueError):
+                    continue
+            op, cur = d["op"], g["recent_op"]
+            if op in ("sum", "count"):
+                g["total_value"] += v if op == "sum" else 1
+                g["recent_op"] = (cur or 0) + (v if op == "sum" else 1)
+            elif op == "min":
+                g["total_value"] = v if g["total_count"] == 1 else \
+                    min(g["total_value"], v)
+                g["recent_op"] = v if cur is None else min(cur, v)
+            elif op == "max":
+                g["total_value"] = max(g["total_value"], v)
+                g["recent_op"] = v if cur is None else max(cur, v)
+            elif op == "avg":
+                g["total_value"] += (v - g["total_value"]) / g["total_count"]
+                g["recent_op"] = ((cur or 0) * (g["recent_count"] - 1) + v) \
+                    / g["recent_count"]
+            elif op == "raw_values":
+                g["recent_raw"].append(v)
+        return None                              # extract replaces the stream
+
+    def sweep(self) -> list:
+        now = _time.monotonic()
+        out = []
+        for d in self.defs:
+            for gv in list(d["groups"]):
+                g = d["groups"][gv]
+                if now - g["last"] >= d["expiry"]:
+                    del d["groups"][gv]
+                    continue
+                rec = {
+                    "name": d["name"], "operation_type": d["op"],
+                    "operation_key": d["key"], "by": ",".join(d["by"]),
+                    "aggregate": ",".join(gv),
+                    "total_value": g["total_value"],
+                    "total_count": g["total_count"],
+                    "recent_raw_values": list(g["recent_raw"]),
+                    "recent_op_value": g["recent_op"] or 0,
+                    "recent_count": g["recent_count"],
+                    "_".join(d["by"]): ",".join(gv),
+                }
+                for k, v in zip(d["by"], gv):
+                    rec[k] = v
+                out.append(rec)
+                g["recent_count"] = 0
+                g["recent_op"] = None
+                g["recent_raw"] = []
+        return out
+
+
+class _Timebased:
+    """FLP `extract timebased` subset (api/extract_timebased.go): per-rule
+    sliding-window (timeInterval) top-K over indexKeys by an operation on
+    operationKey. Absorbs flow logs; emits one record per reported index
+    value per exported batch."""
+
+    def __init__(self, params: dict):
+        self.rules = []
+        for r in params.get("rules", []):
+            keys = list(r.get("indexKeys", []))
+            if not keys and r.get("indexKey"):
+                keys = [r["indexKey"]]
+            self.rules.append({
+                "name": r.get("name", ""), "keys": keys,
+                "op": r.get("operationType", "sum"),
+                "key": r.get("operationKey", ""),
+                "topk": int(r.get("topK", 0)),
+                "window": _duration_s(r.get("timeInterval"), 10),
+                "series": {},                    # index tuple -> [(ts, v)]
+            })
+
+    def __call__(self, entry: dict):
+        now = _time.monotonic()
+        for r in self.rules:
+            if r["key"] not in entry:
+                continue                # missing input: no data point
+            idx = tuple(str(entry.get(k, "")) for k in r["keys"])
+            try:
+                v = float(entry[r["key"]] or 0)
+            except (TypeError, ValueError):
+                continue
+            r["series"].setdefault(idx, []).append((now, v))
+        return None
+
+    def sweep(self) -> list:
+        now = _time.monotonic()
+        out = []
+        for r in self.rules:
+            results = []
+            for idx in list(r["series"]):
+                pts = [(t, v) for t, v in r["series"][idx]
+                       if now - t < r["window"]]
+                if not pts:
+                    del r["series"][idx]
+                    continue
+                r["series"][idx] = pts
+                vals = [v for _, v in pts]
+                op = r["op"]
+                if op == "sum":
+                    res = sum(vals)
+                elif op == "min":
+                    res = min(vals)
+                elif op == "max":
+                    res = max(vals)
+                elif op == "avg":
+                    res = sum(vals) / len(vals)
+                elif op == "count":
+                    res = float(len(vals))
+                elif op == "last":
+                    res = vals[-1]
+                elif op == "diff":
+                    res = vals[-1] - vals[0]
+                else:
+                    continue
+                results.append((res, idx))
+            results.sort(key=lambda x: x[0], reverse=True)
+            if r["topk"]:
+                results = results[:r["topk"]]
+            for res, idx in results:
+                rec = {"name": r["name"],
+                       "index_key": ",".join(r["keys"]),
+                       "operation": r["op"], r["key"]: res}
+                for k, v in zip(r["keys"], idx):
+                    rec[k] = v
+                out.append(rec)
+        return out
+
+
 def _duration_s(v, default: float) -> float:
-    """Parse an FLP duration ('30s', '2m', '500ms', number) to seconds."""
+    """Parse an FLP/Go duration ('30s', '1m30s', '500ms', number) to
+    seconds; malformed values warn and fall back to the default."""
+    from netobserv_tpu.config import parse_duration
+
     if v is None or v == "":
         return float(default)
     if isinstance(v, (int, float)):
         return float(v)
-    s = str(v).strip()
-    for suffix, mult in (("ms", 1e-3), ("s", 1.0), ("m", 60.0), ("h", 3600.0)):
-        if s.endswith(suffix) and s[:-len(suffix)].replace(".", "").isdigit():
-            return float(s[:-len(suffix)]) * mult
     try:
-        return float(s)
+        return parse_duration(str(v))
     except ValueError:
+        log.warning("invalid duration %r; using default %ss", v, default)
         return float(default)
 
 
@@ -511,6 +680,10 @@ class DirectFLPExporter(Exporter):
                 x = p["extract"]
                 if x.get("type") == "conntrack":
                     self._stages.append(_ConnTrack(x.get("conntrack", {})))
+                elif x.get("type") == "aggregates":
+                    self._stages.append(_Aggregates(x.get("aggregates", {})))
+                elif x.get("type") == "timebased":
+                    self._stages.append(_Timebased(x.get("timebased", {})))
                 else:
                     log.warning("unsupported extract type %r ignored",
                                 x.get("type"))
@@ -599,7 +772,6 @@ class _LokiWriter:
 
     def push(self, entries: list[dict]) -> None:
         import http.client
-        import time as _time
         import urllib.error
         import urllib.request
 

@@ -293,6 +293,78 @@ def test_extract_conntrack_close_flushes():
     assert out[-1]["numFlowLogs"] == 1
 
 
+def test_extract_aggregates():
+    """FLP extract/aggregates subset: group-by running totals with
+    recent_* per-cycle values, replacing the flow-log stream."""
+    cfg = """
+pipeline: [{name: agg}, {name: w, follows: agg}]
+parameters:
+  - name: agg
+    extract:
+      type: aggregates
+      aggregates:
+        rules:
+          - name: bytes_by_proto
+            groupByKeys: [Proto]
+            operationType: sum
+            operationKey: Bytes
+  - name: w
+    write: {type: stdout}
+"""
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=cfg, stream=buf)
+    exp.export_batch([make_record(proto=6, nbytes=100),
+                      make_record(proto=6, nbytes=50),
+                      make_record(proto=17, nbytes=7)])
+    exp.export_batch([make_record(proto=6, nbytes=25)])
+    out = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert all(e["name"] == "bytes_by_proto" for e in out)
+    tcp1 = [e for e in out if e["Proto"] == "6"][0]
+    assert tcp1["total_value"] == 150 and tcp1["total_count"] == 2
+    assert tcp1["recent_op_value"] == 150
+    tcp2 = [e for e in out if e["Proto"] == "6"][1]
+    assert tcp2["total_value"] == 175 and tcp2["total_count"] == 3
+    assert tcp2["recent_op_value"] == 25      # recent_* reset per cycle
+    udp = [e for e in out if e["Proto"] == "17"][0]
+    assert udp["total_value"] == 7 and udp["aggregate"] == "17"
+
+
+def test_extract_timebased_topk():
+    """FLP extract/timebased subset: sliding-window top-K by sum."""
+    cfg = """
+pipeline: [{name: tb}, {name: w, follows: tb}]
+parameters:
+  - name: tb
+    extract:
+      type: timebased
+      timebased:
+        rules:
+          - name: top_senders
+            indexKeys: [SrcAddr]
+            operationType: sum
+            operationKey: Bytes
+            topK: 2
+            timeInterval: 10s
+  - name: w
+    write: {type: stdout}
+"""
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=cfg, stream=buf)
+    recs = []
+    for src, nbytes in (("10.0.0.1", 100), ("10.0.0.2", 900),
+                        ("10.0.0.3", 500), ("10.0.0.2", 50)):
+        r = make_record(src=src, nbytes=nbytes)
+        r.key = type(r.key).make(src, "10.2.2.2", 1111, 443, 6)
+        recs.append(r)
+    exp.export_batch(recs)
+    out = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert len(out) == 2                       # topK=2
+    assert out[0]["SrcAddr"] == "10.0.0.2" and out[0]["Bytes"] == 950
+    assert out[1]["SrcAddr"] == "10.0.0.3" and out[1]["Bytes"] == 500
+    assert out[0]["name"] == "top_senders"
+    assert out[0]["operation"] == "sum"
+
+
 def test_write_loki():
     """FLP write_loki subset: entries stream to a live HTTP endpoint in the
     Loki push shape, grouped by label set, with tenant header — verified
