@@ -75,3 +75,31 @@ def test_filter_rules_parse():
     assert r.destination_port == 443
     assert r.sample == 10
     assert cfg.parse_filter_rules("") == []
+
+
+def test_env_surface_covers_reference():
+    """Every env knob the reference agent exposes (env tags in
+    pkg/config/config.go) must exist here under the same name — a user
+    switching agents keeps their environment verbatim. Parsed from the
+    reference source like the flp_tables parity tests."""
+    import os
+    import re
+
+    import pytest
+
+    ref_path = "/root/reference/pkg/config/config.go"
+    if not os.path.exists(ref_path):
+        pytest.skip("reference source unavailable")
+    import pathlib
+
+    ref_src = pathlib.Path(ref_path).read_text()
+    ref_keys = set(re.findall(r'env:"([A-Z0-9_]+)"', ref_src))
+    assert len(ref_keys) > 50, "reference parse broke"
+    import inspect
+
+    from netobserv_tpu import config as cfgmod
+
+    ours = set(re.findall(r'_env\("([A-Z0-9_]+)"',
+                          inspect.getsource(cfgmod)))
+    missing = ref_keys - ours
+    assert not missing, f"reference env keys without an equivalent: {missing}"
