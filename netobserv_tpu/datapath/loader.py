@@ -36,13 +36,12 @@ class KernelFetcher:
     """Self-managed kernel datapath entry point (reference analog:
     `pkg/tracer/tracer.go:92-273` NewFlowFetcher).
 
-    Always provisions the in-tree assembler datapath
-    (`MinimalKernelFetcher`): verifier-loaded IPv4/IPv6 flows, DNS tracking,
-    handshake RTT, ringbuf fallback, counters, sampling — no compiler or
-    libbpf required. A clang-built CO-RE object
-    (datapath/native/CMakeLists.txt DATAPATH_BPF) adds the remaining
-    trackers/filters; its libbpf load path is not wired yet, so a present
-    object only changes the log line, never the behavior.
+    When the CI-built CO-RE object (datapath/native/CMakeLists.txt
+    DATAPATH_BPF) is present and libbpf is available, loads the FULL C
+    datapath through `LibbpfKernelFetcher` (every inline tracker from
+    flowpath.c). Otherwise provisions the in-tree assembler datapath
+    (`MinimalKernelFetcher`) — verifier-loaded IPv4/IPv6 flows, DNS, RTT,
+    drops, filters, TLS/QUIC, sampling — which needs no compiler or libbpf.
     """
 
     needs_iface_discovery = True  # the agent starts an InterfaceListener
@@ -52,11 +51,22 @@ class KernelFetcher:
         if os.geteuid() != 0:
             raise RuntimeError("kernel datapath requires root/CAP_BPF")
         if os.path.exists(_OBJ_PATH):
-            log.warning("clang-built object %s present but its libbpf load "
-                        "path is not wired in this build; using the "
-                        "assembler datapath (TLS/QUIC inline trackers and "
-                        "probe-based features inactive; flows/DNS/RTT/"
-                        "filters active)", _OBJ_PATH)
+            from netobserv_tpu.datapath import libbpf as lb
+
+            if lb.available():
+                try:
+                    fetcher = LibbpfKernelFetcher(cfg, _OBJ_PATH)
+                    log.info("loaded the clang-built CO-RE datapath %s via "
+                             "libbpf (full C feature set)", _OBJ_PATH)
+                    return fetcher
+                except Exception as exc:
+                    log.warning("clang object %s failed to load (%s); "
+                                "falling back to the assembler datapath",
+                                _OBJ_PATH, exc)
+            else:
+                log.warning("clang object %s present but libbpf is not "
+                            "available; using the assembler datapath",
+                            _OBJ_PATH)
         else:
             log.info("no clang-built BPF object (%s); using the in-tree "
                      "assembler datapath", _OBJ_PATH)
@@ -428,6 +438,30 @@ class _SelfManagedAttach:
             except OSError:
                 pass
 
+    def _init_empty_maps(self) -> None:
+        """The inherited eviction path expects these BpfmanFetcher fields;
+        everything close() touches is initialized here so a failed
+        _provision can clean up safely."""
+        self._n_cpus = syscall_bpf.n_possible_cpus()
+        self._base = ""
+        self._features = {}
+        self._agg = None
+        self._prog_fds = {}
+        self._pins = {}
+        self._attached = {}
+        self._counters = None
+        self._ringbuf = None
+        self._ssl_rb = None
+        self._ssl_map = None
+        self._ssl_uprobe = None
+        self._kprobes = []
+        self._gate_map = None
+        self._dns_inflight = None
+        self._rtt_inflight = None
+        self._rb_map = None
+        self._filter_rules = None
+        self._filter_peers = None
+
     def _teardown_attachments(self) -> None:
         from netobserv_tpu.datapath import tc_attach
         from netobserv_tpu.ifaces.netns import netns_context
@@ -660,30 +694,6 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         # (netns, if_index) -> (if_name, direction -> live Attachment)
         self._attached: dict[tuple[str, int], tuple[str, dict]] = {}
 
-    def _init_empty_maps(self) -> None:
-        """The inherited eviction path expects these BpfmanFetcher fields;
-        everything close() touches is initialized here so a failed
-        _provision can clean up safely."""
-        self._n_cpus = syscall_bpf.n_possible_cpus()
-        self._base = ""
-        self._features = {}
-        self._agg = None
-        self._prog_fds = {}
-        self._pins = {}
-        self._attached = {}
-        self._counters = None
-        self._ringbuf = None
-        self._ssl_rb = None
-        self._ssl_map = None
-        self._ssl_uprobe = None
-        self._kprobes = []
-        self._gate_map = None
-        self._dns_inflight = None
-        self._rtt_inflight = None
-        self._rb_map = None
-        self._filter_rules = None
-        self._filter_peers = None
-
     @classmethod
     def load(cls, cfg: AgentConfig) -> "MinimalKernelFetcher":
         import shutil
@@ -878,3 +888,200 @@ class MinimalPacketFetcher(_SelfManagedAttach):
             self._filter_rules.close()
         if self._filter_peers is not None:
             self._filter_peers.close()
+
+
+class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
+    """Full C datapath: loads the CI-built CO-RE object (flowpath.c — every
+    inline tracker) through the system libbpf, with the reference's load
+    lifecycle (`pkg/tracer/tracer.go:92-273`): map resize per config,
+    pinning strip, `volatile const` rewrite from the parsed env config,
+    capability-based program pruning, verifier load, per-direction TCX/TC
+    attach, and the shared per-CPU drain at eviction.
+
+    The lifecycle machinery is kernel-proven in this image against a real
+    clang CO-RE artifact (tests/test_libbpf_loader.py); the object itself
+    is produced where clang exists (CI `make bpf`)."""
+
+    needs_iface_discovery = True
+    _PIN_PREFIX = "/sys/fs/bpf/netobserv_cobj_"
+
+    def __init__(self, cfg: AgentConfig, obj_path: str = _OBJ_PATH):
+        self._init_empty_maps()
+        self._sweep_stale_pins()
+        self._mode = cfg.tc_attach_mode
+        self._obj = None
+        try:
+            self._provision_object(cfg, obj_path)
+        except Exception:
+            self.close()
+            raise
+
+    def _provision_object(self, cfg: AgentConfig, obj_path: str) -> None:
+        from netobserv_tpu.datapath import libbpf as lb
+
+        obj = lb.BpfObject(obj_path)
+        self._obj = obj
+        cache = cfg.cache_max_flows
+        resize = {"aggregated_flows": cache, "flows_dns": cache,
+                  "flows_drops": cache, "flows_nevents": cache,
+                  "flows_xlat": cache, "flows_extra": cache,
+                  "flows_quic": cache, "dns_inflight": max(cache, 1024),
+                  "direct_flows": 1 << 17, "ssl_events": 1 << 20,
+                  "packet_records": 1 << 17}
+        for m in obj.maps():
+            m.disable_pinning()
+            want = resize.get(m.name)
+            if want:
+                m.set_max_entries(want)
+        # layout contract: the object's maps must match the binfmt dtypes
+        # byte-for-byte or the drain would mis-decode (records.h <-> binfmt
+        # is machine-checked in tests; this guards a stale/foreign object)
+        agg_h = obj.map("aggregated_flows")
+        if agg_h is None:
+            raise RuntimeError("object lacks aggregated_flows")
+        if (agg_h.key_size != binfmt.FLOW_KEY_DTYPE.itemsize
+                or agg_h.value_size != binfmt.FLOW_STATS_DTYPE.itemsize):
+            raise RuntimeError(
+                f"object layout mismatch: aggregated_flows "
+                f"{agg_h.key_size}/{agg_h.value_size} != binfmt "
+                f"{binfmt.FLOW_KEY_DTYPE.itemsize}/"
+                f"{binfmt.FLOW_STATS_DTYPE.itemsize} — rebuild the object "
+                "against this tree's records.h")
+        for name, dtype, _attr in _FEATURE_MAPS:
+            h = obj.map(name)
+            if h is not None and h.value_size != dtype.itemsize:
+                raise RuntimeError(
+                    f"object layout mismatch: {name} value {h.value_size} "
+                    f"!= {dtype.itemsize}")
+        # volatile const rewrite (config.h <- AgentConfig), offsets from the
+        # object's symbol table — missing knobs (older object) just warn
+        knobs = {
+            "cfg_sampling": cfg.sampling,
+            "cfg_trace_messages": int(cfg.log_level.lower() in
+                                      ("debug", "trace")),
+            "cfg_enable_rtt": int(cfg.enable_rtt),
+            "cfg_enable_dns_tracking": int(cfg.enable_dns_tracking),
+            "cfg_dns_port": cfg.dns_tracking_port,
+            "cfg_enable_pkt_drops": int(cfg.enable_pkt_drops),
+            "cfg_enable_flow_filtering": int(bool(cfg.flow_filter_rules)),
+            "cfg_enable_tls_tracking": int(cfg.enable_tls_tracking),
+            "cfg_quic_mode": cfg.quic_tracking_mode,
+            "cfg_enable_ringbuf_fallback":
+                int(cfg.enable_flows_ringbuf_fallback),
+            "cfg_enable_ipsec": int(cfg.enable_ipsec_tracking),
+            "cfg_enable_network_events":
+                int(cfg.enable_network_events_monitoring),
+            "cfg_network_events_group_id":
+                cfg.network_events_monitoring_group_id,
+            "cfg_enable_pkt_translation": int(cfg.enable_pkt_translation),
+        }
+        syms = lb.rodata_symbols(obj_path)
+        patches = {}
+        for name, val in knobs.items():
+            if name in syms:
+                off, size = syms[name]
+                patches[off] = (size, int(val))
+            else:
+                log.debug("const %s absent in %s", name, obj_path)
+        if "cfg_has_sampling" in syms and cfg.flow_filter_rules:
+            # per-rule sampling moves the 1/N gate after the filter
+            # (config.h:52, flowpath.c:155-180)
+            off, size = syms["cfg_has_sampling"]
+            patches[off] = (size, int(any(
+                getattr(r, "sample", 0) for r in cfg.parsed_filter_rules())))
+        if patches:
+            obj.patch_rodata(patches)
+        # program pruning (reference kernelSpecificLoadAndAssign,
+        # tracer.go:1219): keep the flow tc/tcx entry points; PCA programs
+        # belong to the packets agent; kprobe/fentry hooks need kernel
+        # support this image lacks (no kprobes, no ftrace trampolines)
+        use_tcx = self._mode != "tc"
+        entry_names = {"ingress": ("tcx_ingress_flow" if use_tcx
+                                   else "tc_ingress_flow"),
+                       "egress": ("tcx_egress_flow" if use_tcx
+                                  else "tc_egress_flow")}
+        for pname in entry_names.values():
+            if obj.program(pname) is None:
+                raise RuntimeError(f"object lacks program {pname}")
+        wanted_progs = set(entry_names.values())
+        for p in obj.programs():
+            if p.name not in wanted_progs:
+                # incl. the unselected tc/tcx variant: tcx/ sections carry
+                # expected_attach_type the pre-TCX kernels tc mode targets
+                # would reject at BPF_PROG_LOAD
+                p.set_autoload(False)
+            elif p.name.startswith("tc_"):
+                p.set_type(3)                   # plain "tc_*" sections
+        obj.load()
+
+        def wrap(name: str, n_cpus: int = 1):
+            h = obj.map(name)
+            if h is None:
+                return None
+            bm = syscall_bpf.BpfMap(os.dup(h.fd), h.key_size, h.value_size,
+                                    h.max_entries)
+            bm.n_cpus = n_cpus
+            return bm
+
+        ncpu = self._n_cpus
+        self._agg = wrap("aggregated_flows")
+        self._counters = wrap("global_counters", ncpu)
+        for name, dtype, attr in _FEATURE_MAPS:
+            bm = wrap(name, ncpu)
+            if bm is not None:
+                self._features[attr] = (bm, dtype)
+        self._dns_inflight = wrap("dns_inflight")
+        self._filter_rules = wrap("filter_rules")
+        self._filter_peers = wrap("filter_peers")
+        if cfg.enable_flows_ringbuf_fallback:
+            self._rb_map = wrap("direct_flows")
+            if self._rb_map is not None:
+                self._ringbuf = syscall_bpf.RingBufReader(self._rb_map)
+        # per-direction entry points; tcx variants for tcx/any, tc for tc
+        for d, pname in entry_names.items():
+            ph = obj.program(pname)
+            if ph is None or ph.fd <= 0:
+                raise RuntimeError(f"object lacks program {pname}")
+            fd = os.dup(ph.fd)
+            pin = f"{self._PIN_PREFIX}{os.getpid()}_{d}"
+            if os.path.exists(pin):
+                os.unlink(pin)
+            syscall_bpf.obj_pin(fd, pin)
+            self._prog_fds[d] = fd
+            self._pins[d] = pin
+
+    def program_filters(self, rules) -> int:
+        if self._filter_rules is None:
+            if rules:
+                log.warning("object has no filter maps; rules ignored")
+            return 0
+        return _program_filter_tries(self._filter_rules, self._filter_peers,
+                                     rules)
+
+    def close(self) -> None:
+        self._teardown_attachments()
+        if self._ringbuf is not None:
+            self._ringbuf.close()
+            self._ringbuf = None
+        for bm in [self._agg, self._counters, self._dns_inflight,
+                   self._filter_rules, self._filter_peers, self._rb_map]:
+            if bm is not None:
+                bm.close()
+        for bm, _dtype in self._features.values():
+            bm.close()
+        self._features = {}
+        for fd in self._prog_fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._prog_fds = {}
+        for pin in self._pins.values():
+            try:
+                os.unlink(pin)
+            except OSError:
+                pass
+        self._pins = {}
+        if self._obj is not None:
+            self._obj.close()
+            self._obj = None
