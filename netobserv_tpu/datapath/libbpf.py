@@ -63,6 +63,10 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.bpf_object__next_map.argtypes = [p, p]
     lib.bpf_object__next_program.restype = p
     lib.bpf_object__next_program.argtypes = [p, p]
+    lib.bpf_object__find_map_by_name.restype = p
+    lib.bpf_object__find_map_by_name.argtypes = [p, ctypes.c_char_p]
+    lib.bpf_object__find_program_by_name.restype = p
+    lib.bpf_object__find_program_by_name.argtypes = [p, ctypes.c_char_p]
     lib.bpf_map__name.restype = ctypes.c_char_p
     lib.bpf_map__name.argtypes = [p]
     lib.bpf_map__fd.argtypes = [p]
@@ -270,16 +274,14 @@ class BpfObject:
             yield BpfProgHandle(self._lib, cur)
 
     def map(self, name: str) -> Optional[BpfMapHandle]:
-        for m in self.maps():
-            if m.name == name:
-                return m
-        return None
+        ptr = self._lib.bpf_object__find_map_by_name(self._obj,
+                                                     name.encode())
+        return BpfMapHandle(self._lib, ptr) if ptr else None
 
     def program(self, name: str) -> Optional[BpfProgHandle]:
-        for pr in self.programs():
-            if pr.name == name:
-                return pr
-        return None
+        ptr = self._lib.bpf_object__find_program_by_name(self._obj,
+                                                         name.encode())
+        return BpfProgHandle(self._lib, ptr) if ptr else None
 
     def patch_rodata(self, values: dict) -> int:
         """Rewrite `volatile const` knobs in the .rodata map image before
