@@ -48,8 +48,9 @@ def main() -> int:
             else:
                 # self-managed kernel capture: hand-assembled PCA program,
                 # verifier-loaded, no compiler required
-                from netobserv_tpu.datapath.loader import MinimalPacketFetcher
-                pkt_fetcher = MinimalPacketFetcher.load(cfg)
+                from netobserv_tpu.datapath.loader import \
+                    load_packet_fetcher
+                pkt_fetcher = load_packet_fetcher(cfg)
             agent = PacketsAgent(cfg, pkt_fetcher)
         else:
             agent = FlowsAgent.from_config(cfg)

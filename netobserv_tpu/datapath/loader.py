@@ -890,6 +890,97 @@ class MinimalPacketFetcher(_SelfManagedAttach):
             self._filter_peers.close()
 
 
+
+def _libbpf_open_and_load(obj_path: str, resize: dict, knobs: dict,
+                          entry_names: dict, type_fix_prefix: str = "tc_"):
+    """Shared clang-object lifecycle (both fetcher twins): open, pinning
+    strip, map resize, volatile-const patch (ELF-symtab offsets), entry-
+    point check, prune everything but the selected entries, verifier load.
+    Returns the loaded BpfObject."""
+    from netobserv_tpu.datapath import libbpf as lb
+
+    obj = lb.BpfObject(obj_path)
+    try:
+        for m in obj.maps():
+            m.disable_pinning()
+            want = resize.get(m.name)
+            if want:
+                m.set_max_entries(want)
+        syms = lb.rodata_symbols(obj_path)
+        patches = {}
+        for name, val in knobs.items():
+            if name in syms:
+                off, size = syms[name]
+                patches[off] = (size, int(val))
+            else:
+                log.debug("const %s absent in %s", name, obj_path)
+        if patches:
+            obj.patch_rodata(patches)
+        for pname in entry_names.values():
+            if obj.program(pname) is None:
+                raise RuntimeError(f"object lacks program {pname}")
+        wanted = set(entry_names.values())
+        for p in obj.programs():
+            if p.name not in wanted:
+                # incl. the unselected tc/tcx variant: tcx/ sections carry
+                # expected_attach_type the pre-TCX kernels tc mode targets
+                # would reject at BPF_PROG_LOAD
+                p.set_autoload(False)
+            elif p.name.startswith(type_fix_prefix):
+                p.set_type(3)                   # plain "tc_*" sections
+        obj.load()
+        return obj
+    except Exception:
+        obj.close()
+        raise
+
+
+def _libbpf_default_resize(cache: int) -> dict:
+    """Every oversized map in maps.h must shrink BEFORE load — libbpf
+    creates ALL object maps regardless of program autoload, and the
+    declared 1<<24-entry preallocated per-CPU hashes would ENOMEM."""
+    return {"aggregated_flows": cache, "flows_dns": cache,
+            "flows_drops": cache, "flows_nevents": cache,
+            "flows_xlat": cache, "flows_extra": cache,
+            "flows_quic": cache, "dns_inflight": max(cache, 1024),
+            "direct_flows": 1 << 17, "ssl_events": 1 << 20,
+            "packet_records": 1 << 17}
+
+
+def _libbpf_pin_entries(obj, entry_names: dict, prefix: str):
+    """(prog_fds, pins): dup per-direction entry fds and pin them (the
+    legacy tc attach path needs a pinned program path)."""
+    prog_fds, pins = {}, {}
+    for d, pname in entry_names.items():
+        fd = os.dup(obj.program(pname).fd)
+        pin = f"{prefix}{os.getpid()}_{d}"
+        if os.path.exists(pin):
+            os.unlink(pin)
+        syscall_bpf.obj_pin(fd, pin)
+        prog_fds[d] = fd
+        pins[d] = pin
+    return prog_fds, pins
+
+
+def _libbpf_release(self) -> None:
+    """Shared teardown for the libbpf fetchers' fds/pins/object."""
+    for fd in self._prog_fds.values():
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+    self._prog_fds = {}
+    for pin in self._pins.values():
+        try:
+            os.unlink(pin)
+        except OSError:
+            pass
+    self._pins = {}
+    if self._obj is not None:
+        self._obj.close()
+        self._obj = None
+
+
 class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
     """Full C datapath: loads the CI-built CO-RE object (flowpath.c — every
     inline tracker) through the system libbpf, with the reference's load
@@ -917,44 +1008,11 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             raise
 
     def _provision_object(self, cfg: AgentConfig, obj_path: str) -> None:
-        from netobserv_tpu.datapath import libbpf as lb
-
-        obj = lb.BpfObject(obj_path)
-        self._obj = obj
-        cache = cfg.cache_max_flows
-        resize = {"aggregated_flows": cache, "flows_dns": cache,
-                  "flows_drops": cache, "flows_nevents": cache,
-                  "flows_xlat": cache, "flows_extra": cache,
-                  "flows_quic": cache, "dns_inflight": max(cache, 1024),
-                  "direct_flows": 1 << 17, "ssl_events": 1 << 20,
-                  "packet_records": 1 << 17}
-        for m in obj.maps():
-            m.disable_pinning()
-            want = resize.get(m.name)
-            if want:
-                m.set_max_entries(want)
-        # layout contract: the object's maps must match the binfmt dtypes
-        # byte-for-byte or the drain would mis-decode (records.h <-> binfmt
-        # is machine-checked in tests; this guards a stale/foreign object)
-        agg_h = obj.map("aggregated_flows")
-        if agg_h is None:
-            raise RuntimeError("object lacks aggregated_flows")
-        if (agg_h.key_size != binfmt.FLOW_KEY_DTYPE.itemsize
-                or agg_h.value_size != binfmt.FLOW_STATS_DTYPE.itemsize):
-            raise RuntimeError(
-                f"object layout mismatch: aggregated_flows "
-                f"{agg_h.key_size}/{agg_h.value_size} != binfmt "
-                f"{binfmt.FLOW_KEY_DTYPE.itemsize}/"
-                f"{binfmt.FLOW_STATS_DTYPE.itemsize} — rebuild the object "
-                "against this tree's records.h")
-        for name, dtype, _attr in _FEATURE_MAPS:
-            h = obj.map(name)
-            if h is not None and h.value_size != dtype.itemsize:
-                raise RuntimeError(
-                    f"object layout mismatch: {name} value {h.value_size} "
-                    f"!= {dtype.itemsize}")
-        # volatile const rewrite (config.h <- AgentConfig), offsets from the
-        # object's symbol table — missing knobs (older object) just warn
+        use_tcx = self._mode != "tc"
+        entry_names = {"ingress": ("tcx_ingress_flow" if use_tcx
+                                   else "tc_ingress_flow"),
+                       "egress": ("tcx_egress_flow" if use_tcx
+                                  else "tc_egress_flow")}
         knobs = {
             "cfg_sampling": cfg.sampling,
             "cfg_trace_messages": int(cfg.log_level.lower() in
@@ -975,44 +1033,35 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 cfg.network_events_monitoring_group_id,
             "cfg_enable_pkt_translation": int(cfg.enable_pkt_translation),
         }
-        syms = lb.rodata_symbols(obj_path)
-        patches = {}
-        for name, val in knobs.items():
-            if name in syms:
-                off, size = syms[name]
-                patches[off] = (size, int(val))
-            else:
-                log.debug("const %s absent in %s", name, obj_path)
-        if "cfg_has_sampling" in syms and cfg.flow_filter_rules:
+        if cfg.flow_filter_rules:
             # per-rule sampling moves the 1/N gate after the filter
             # (config.h:52, flowpath.c:155-180)
-            off, size = syms["cfg_has_sampling"]
-            patches[off] = (size, int(any(
-                getattr(r, "sample", 0) for r in cfg.parsed_filter_rules())))
-        if patches:
-            obj.patch_rodata(patches)
-        # program pruning (reference kernelSpecificLoadAndAssign,
-        # tracer.go:1219): keep the flow tc/tcx entry points; PCA programs
-        # belong to the packets agent; kprobe/fentry hooks need kernel
-        # support this image lacks (no kprobes, no ftrace trampolines)
-        use_tcx = self._mode != "tc"
-        entry_names = {"ingress": ("tcx_ingress_flow" if use_tcx
-                                   else "tc_ingress_flow"),
-                       "egress": ("tcx_egress_flow" if use_tcx
-                                  else "tc_egress_flow")}
-        for pname in entry_names.values():
-            if obj.program(pname) is None:
-                raise RuntimeError(f"object lacks program {pname}")
-        wanted_progs = set(entry_names.values())
-        for p in obj.programs():
-            if p.name not in wanted_progs:
-                # incl. the unselected tc/tcx variant: tcx/ sections carry
-                # expected_attach_type the pre-TCX kernels tc mode targets
-                # would reject at BPF_PROG_LOAD
-                p.set_autoload(False)
-            elif p.name.startswith("tc_"):
-                p.set_type(3)                   # plain "tc_*" sections
-        obj.load()
+            knobs["cfg_has_sampling"] = int(any(
+                getattr(r, "sample", 0) for r in cfg.parsed_filter_rules()))
+        obj = _libbpf_open_and_load(
+            obj_path, _libbpf_default_resize(cfg.cache_max_flows), knobs,
+            entry_names)
+        self._obj = obj
+        # layout contract: the object's maps must match the binfmt dtypes
+        # byte-for-byte or the drain would mis-decode (records.h <-> binfmt
+        # is machine-checked in tests; this guards a stale/foreign object)
+        agg_h = obj.map("aggregated_flows")
+        if agg_h is None:
+            raise RuntimeError("object lacks aggregated_flows")
+        if (agg_h.key_size != binfmt.FLOW_KEY_DTYPE.itemsize
+                or agg_h.value_size != binfmt.FLOW_STATS_DTYPE.itemsize):
+            raise RuntimeError(
+                f"object layout mismatch: aggregated_flows "
+                f"{agg_h.key_size}/{agg_h.value_size} != binfmt "
+                f"{binfmt.FLOW_KEY_DTYPE.itemsize}/"
+                f"{binfmt.FLOW_STATS_DTYPE.itemsize} — rebuild the object "
+                "against this tree's records.h")
+        for name, dtype, _attr in _FEATURE_MAPS:
+            h = obj.map(name)
+            if h is not None and h.value_size != dtype.itemsize:
+                raise RuntimeError(
+                    f"object layout mismatch: {name} value {h.value_size} "
+                    f"!= {dtype.itemsize}")
 
         def wrap(name: str, n_cpus: int = 1):
             h = obj.map(name)
@@ -1037,18 +1086,8 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             self._rb_map = wrap("direct_flows")
             if self._rb_map is not None:
                 self._ringbuf = syscall_bpf.RingBufReader(self._rb_map)
-        # per-direction entry points; tcx variants for tcx/any, tc for tc
-        for d, pname in entry_names.items():
-            ph = obj.program(pname)
-            if ph is None or ph.fd <= 0:
-                raise RuntimeError(f"object lacks program {pname}")
-            fd = os.dup(ph.fd)
-            pin = f"{self._PIN_PREFIX}{os.getpid()}_{d}"
-            if os.path.exists(pin):
-                os.unlink(pin)
-            syscall_bpf.obj_pin(fd, pin)
-            self._prog_fds[d] = fd
-            self._pins[d] = pin
+        self._prog_fds, self._pins = _libbpf_pin_entries(
+            obj, entry_names, self._PIN_PREFIX)
 
     def program_filters(self, rules) -> int:
         if self._filter_rules is None:
@@ -1070,18 +1109,108 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         for bm, _dtype in self._features.values():
             bm.close()
         self._features = {}
-        for fd in self._prog_fds.values():
-            try:
-                os.close(fd)
-            except OSError:
-                pass
+        _libbpf_release(self)
+
+
+class LibbpfPacketFetcher(_SelfManagedAttach):
+    """PCA twin of LibbpfKernelFetcher (reference PacketFetcher,
+    tracer.go:1552-2076): loads the CI-built object with cfg_enable_pca
+    patched on, autoloads only the PCA entry points, and serves raw
+    packet_records to the packets agent through the mmap ring reader."""
+
+    needs_iface_discovery = True
+    _PIN_PREFIX = "/sys/fs/bpf/netobserv_cpca_"
+
+    def __init__(self, cfg: AgentConfig, obj_path: str = _OBJ_PATH,
+                 ring_bytes: int = 1 << 21):
+        self._mode = cfg.tc_attach_mode
+        self._sweep_stale_pins()
+        self._filter_rules = self._filter_peers = None
+        self._rb_map = None
+        self._reader = None
+        self._obj = None
         self._prog_fds = {}
-        for pin in self._pins.values():
-            try:
-                os.unlink(pin)
-            except OSError:
-                pass
         self._pins = {}
-        if self._obj is not None:
-            self._obj.close()
-            self._obj = None
+        self._attached = {}
+        try:
+            self._provision_object(cfg, obj_path, ring_bytes)
+        except Exception:
+            self.close()
+            raise
+
+    def _provision_object(self, cfg, obj_path, ring_bytes) -> None:
+        use_tcx = self._mode != "tc"
+        entry_names = {"ingress": ("tcx_pca_ingress" if use_tcx
+                                   else "tc_pca_ingress"),
+                       "egress": ("tcx_pca_egress" if use_tcx
+                                  else "tc_pca_egress")}
+        # the flow maps still get created at load (libbpf creates every
+        # object map regardless of autoload) — shrink them all
+        resize = _libbpf_default_resize(cache=512)
+        resize["packet_records"] = ring_bytes
+        knobs = {"cfg_enable_pca": 1, "cfg_sampling": cfg.sampling,
+                 "cfg_enable_flow_filtering":
+                     int(bool(cfg.flow_filter_rules))}
+        obj = _libbpf_open_and_load(obj_path, resize, knobs, entry_names)
+        self._obj = obj
+        rb = obj.map("packet_records")
+        if rb is None:
+            raise RuntimeError("object lacks packet_records")
+        self._rb_map = syscall_bpf.BpfMap(os.dup(rb.fd), 0, 0)
+        self._reader = syscall_bpf.RingBufReader(self._rb_map)
+        fr, fp = obj.map("filter_rules"), obj.map("filter_peers")
+        if fr is not None and fp is not None:
+            self._filter_rules = syscall_bpf.BpfMap(
+                os.dup(fr.fd), fr.key_size, fr.value_size)
+            self._filter_peers = syscall_bpf.BpfMap(
+                os.dup(fp.fd), fp.key_size, fp.value_size)
+        self._prog_fds, self._pins = _libbpf_pin_entries(
+            obj, entry_names, self._PIN_PREFIX)
+
+    def program_filters(self, rules) -> int:
+        if self._filter_rules is None:
+            if rules:
+                log.warning("object has no filter maps; rules ignored")
+            return 0
+        return _program_filter_tries(self._filter_rules, self._filter_peers,
+                                     rules)
+
+    def read_packet(self, timeout_s: float):
+        return self._reader.read(timeout_s)
+
+    def close(self) -> None:
+        self._teardown_attachments()
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+        for bm in (self._rb_map, self._filter_rules, self._filter_peers):
+            if bm is not None:
+                bm.close()
+        self._rb_map = self._filter_rules = self._filter_peers = None
+        _libbpf_release(self)
+
+
+def load_packet_fetcher(cfg: AgentConfig):
+    """PCA fetcher dispatch, mirroring KernelFetcher.load: the CI-built
+    clang object when present+loadable, else the assembler PCA program."""
+    if os.geteuid() != 0:
+        raise RuntimeError("kernel datapath requires root/CAP_BPF")
+    if os.path.exists(_OBJ_PATH):
+        from netobserv_tpu.datapath import libbpf as lb
+
+        if lb.available():
+            try:
+                fetcher = LibbpfPacketFetcher(cfg, _OBJ_PATH)
+                log.info("loaded the clang-built PCA datapath via libbpf")
+                return fetcher
+            except Exception as exc:
+                log.warning("clang PCA object failed to load (%s); using "
+                            "the assembler PCA program", exc)
+        else:
+            log.warning("clang object %s present but libbpf is not "
+                        "available; using the assembler PCA program",
+                        _OBJ_PATH)
+    else:
+        log.info("no clang-built BPF object (%s); using the assembler "
+                 "PCA program", _OBJ_PATH)
+    return MinimalPacketFetcher.load(cfg)

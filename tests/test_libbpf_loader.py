@@ -79,10 +79,12 @@ def _prepare_ref_object(obj):
         elif m.max_entries > 4096 and not m.name.startswith("."):
             m.set_max_entries(4096)
     tc_prog = None
+    kept = dropped = 0
     for p in obj.programs():
         if p.section.startswith("classifier/"):
             # bpf2go legacy section names: libbpf can't infer the type
             p.set_type(3)                       # SCHED_CLS
+            kept += 1
             if p.name == "tc_ingress_flow_parse":
                 tc_prog = p
         else:
@@ -90,7 +92,8 @@ def _prepare_ref_object(obj):
             # kprobes or fentry trampolines — the reference prunes the
             # same way (kernelSpecificLoadAndAssign, tracer.go:1219)
             p.set_autoload(False)
-    assert tc_prog is not None
+            dropped += 1
+    assert tc_prog is not None and kept >= 2 and dropped >= 1
     return tc_prog
 
 
@@ -225,5 +228,38 @@ def test_own_object_full_fetcher(veth):
         ev = evicted.events[ports[4545]]
         assert int(ev["stats"]["packets"]) == 5
         assert int(ev["stats"]["bytes"]) == 5 * (100 + 8 + 20 + 14)
+    finally:
+        fetcher.close()
+
+
+@needs_kernel
+def test_own_object_pca_fetcher(veth):
+    """PCA twin on OUR CI-built object: cfg_enable_pca patched on, only the
+    PCA entry points loaded, live packets stream through packet_records.
+    Skipped without the object (CI builds it)."""
+    from netobserv_tpu.config import load_config
+    from netobserv_tpu.datapath import loader as ldr
+
+    if not os.path.exists(ldr._OBJ_PATH):
+        pytest.skip("no CI-built flowpath.bpf.o in this environment")
+    cfg = load_config(environ={
+        "EXPORT": "grpc", "ENABLE_PCA": "true", "TARGET_HOST": "x",
+        "TARGET_PORT": "1"})
+    fetcher = ldr.LibbpfPacketFetcher(cfg)
+    try:
+        idx = int(open(f"/sys/class/net/{veth}/ifindex").read())
+        fetcher.attach(idx, veth, "egress")
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("10.199.0.1", 42424))
+        for _ in range(3):
+            s.sendto(b"p" * 60, ("10.199.0.2", 4646))
+        s.close()
+        got = []
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(got) < 3:
+            rec = fetcher.read_packet(0.5)
+            if rec is not None:
+                got.append(rec)
+        assert got, "no packets captured by the clang PCA datapath"
     finally:
         fetcher.close()
