@@ -88,6 +88,10 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.bpf_program__autoload.argtypes = [p]
     lib.bpf_program__autoload.restype = ctypes.c_bool
     lib.bpf_program__fd.argtypes = [p]
+    lib.bpf_program__attach.restype = p
+    lib.bpf_program__attach.argtypes = [p]
+    lib.bpf_link__destroy.argtypes = [p]
+    lib.bpf_map__reuse_fd.argtypes = [p, ctypes.c_int]
 
 
 class _Elf:
@@ -191,6 +195,14 @@ class BpfMapHandle:
     def disable_pinning(self) -> None:
         self._lib.bpf_map__set_pin_path(self._ptr, None)
 
+    def reuse_fd(self, fd: int) -> None:
+        """Share another object's already-created map instead of creating
+        a new one at load (cross-object map sharing: the probes object
+        writes into the flow object's feature maps)."""
+        rc = self._lib.bpf_map__reuse_fd(self._ptr, fd)
+        if rc:
+            raise OSError(-rc, f"reuse_fd({self.name})")
+
     def initial_value(self) -> Optional[memoryview]:
         """Writable view of a .rodata/.data/.bss map's initial contents;
         None for ordinary maps. Patch before load() to rewrite `volatile
@@ -239,6 +251,28 @@ class BpfProgHandle:
         rc = self._lib.bpf_program__set_type(self._ptr, prog_type)
         if rc:
             raise OSError(-rc, f"set_type({self.name}, {prog_type})")
+
+    def attach(self) -> "BpfLink":
+        """libbpf auto-attach by section type (tracepoint/kprobe/fentry
+        ...). Raises OSError on failure."""
+        ctypes.set_errno(0)
+        ptr = self._lib.bpf_program__attach(self._ptr)
+        if not ptr:
+            raise OSError(ctypes.get_errno() or 22,
+                          f"bpf_program__attach({self.name})")
+        return BpfLink(self._lib, ptr)
+
+
+class BpfLink:
+    """An attached program's link; destroy() detaches."""
+
+    def __init__(self, lib, ptr):
+        self._lib, self._ptr = lib, ptr
+
+    def destroy(self) -> None:
+        if self._ptr:
+            self._lib.bpf_link__destroy(self._ptr)
+            self._ptr = None
 
 
 class BpfObject:

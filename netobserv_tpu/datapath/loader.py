@@ -1088,6 +1088,108 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 self._ringbuf = syscall_bpf.RingBufReader(self._rb_map)
         self._prog_fds, self._pins = _libbpf_pin_entries(
             obj, entry_names, self._PIN_PREFIX)
+        self._probe_links = []
+        self._probes_obj = None
+        probes_path = os.path.join(os.path.dirname(obj_path),
+                                   "flowpath_probes.bpf.o")
+        if os.path.exists(probes_path):
+            try:
+                self._load_probes(cfg, probes_path, knobs)
+            except Exception as exc:
+                log.warning("probes object %s unusable (%s); probe-based "
+                            "features degrade to the inline trackers",
+                            probes_path, exc)
+
+    # SEC-prefix -> (config gate, capability) for the aux hook programs
+    # (reference attach ladder, tracer.go:184-273)
+    @staticmethod
+    def _probe_wanted(cfg, section: str, allow_fentry: bool,
+                      have_kprobes: bool, have_tracepoints: bool) -> bool:
+        if section.startswith("tracepoint/skb/kfree_skb"):
+            return cfg.enable_pkt_drops and have_tracepoints
+        if section.startswith("fentry/tcp_rcv"):
+            return cfg.enable_rtt and allow_fentry
+        if section.startswith("kprobe/tcp_rcv"):
+            # kprobe fallback only when fentry is off the table
+            return cfg.enable_rtt and have_kprobes and not allow_fentry
+        if section.startswith("kprobe/psample"):
+            return cfg.enable_network_events_monitoring and have_kprobes
+        if section.startswith("kprobe/nf_nat"):
+            return cfg.enable_pkt_translation and have_kprobes
+        if section.startswith(("kprobe/xfrm", "kretprobe/xfrm")):
+            return cfg.enable_ipsec_tracking and have_kprobes
+        return False                            # uprobe/...: asm path owns it
+
+    def _load_probes(self, cfg, probes_path: str, knobs: dict) -> None:
+        """Load the aux-hook object, sharing the flow object's maps
+        (bpf_map__reuse_fd) so probe records land in the maps the drain
+        reads. fentry needs trampoline support libbpf only reveals at load
+        — ladder: try with fentry, retry without (reference fentry->kprobe
+        fallback, tracer.go:203-222)."""
+        from netobserv_tpu.datapath import libbpf as lb
+
+        have_tracepoints = any(os.path.isdir(p) for p in (
+            "/sys/kernel/tracing/events",
+            "/sys/kernel/debug/tracing/events"))
+        have_kprobes = (os.path.isdir("/sys/bus/event_source/devices/kprobe")
+                        or any(os.path.exists(p) for p in (
+                            "/sys/kernel/tracing/kprobe_events",
+                            "/sys/kernel/debug/tracing/kprobe_events")))
+        syms = lb.rodata_symbols(probes_path)
+        last_exc: Exception | None = None
+        for allow_fentry in (True, False):
+            pobj = lb.BpfObject(probes_path)
+            try:
+                wanted_any = False
+                for p in pobj.programs():
+                    want = self._probe_wanted(cfg, p.section, allow_fentry,
+                                              have_kprobes, have_tracepoints)
+                    if not want:
+                        p.set_autoload(False)
+                    wanted_any = wanted_any or want
+                if not wanted_any:
+                    pobj.close()
+                    log.info("no probe hooks wanted/attachable on this "
+                             "kernel; skipping %s", probes_path)
+                    return
+                for m in pobj.maps():
+                    m.disable_pinning()
+                    # internal maps are named '<8-char-obj-prefix>.rodata'
+                    # etc. — never share those: the probes object needs its
+                    # OWN patched consts, not the flow object's image
+                    if "." in m.name:
+                        continue
+                    shared = self._obj.map(m.name)
+                    if shared is not None:
+                        m.reuse_fd(shared.fd)
+                patches = {}
+                for name, val in knobs.items():
+                    if name in syms:
+                        off, size = syms[name]
+                        patches[off] = (size, int(val))
+                if patches:
+                    pobj.patch_rodata(patches)
+                pobj.load()
+                links = []
+                for p in pobj.programs():
+                    if not p.autoload:
+                        continue
+                    try:
+                        links.append(p.attach())
+                        log.info("probe attached: %s", p.section)
+                    except OSError as exc:
+                        log.warning("probe %s attach failed: %s",
+                                    p.section, exc)
+                self._probes_obj = pobj
+                self._probe_links = links
+                return
+            except OSError as exc:
+                pobj.close()
+                last_exc = exc
+                if allow_fentry:
+                    log.debug("probes load with fentry failed (%s); "
+                              "retrying with the kprobe fallback", exc)
+        raise last_exc if last_exc else RuntimeError("probes load failed")
 
     def program_filters(self, rules) -> int:
         if self._filter_rules is None:
@@ -1099,6 +1201,13 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
 
     def close(self) -> None:
         self._teardown_attachments()
+        for link in getattr(self, "_probe_links", []):
+            link.destroy()
+        self._probe_links = []
+        pobj = getattr(self, "_probes_obj", None)
+        if pobj is not None:
+            pobj.close()
+            self._probes_obj = None
         if self._ringbuf is not None:
             self._ringbuf.close()
             self._ringbuf = None

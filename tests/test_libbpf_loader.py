@@ -210,7 +210,10 @@ def test_own_object_full_fetcher(veth):
         pytest.skip("no CI-built flowpath.bpf.o in this environment")
     cfg = load_config(environ={
         "EXPORT": "stdout", "ENABLE_DNS_TRACKING": "true",
-        "ENABLE_TLS_TRACKING": "true", "CACHE_MAX_FLOWS": "2048"})
+        "ENABLE_TLS_TRACKING": "true", "ENABLE_PKT_DROPS": "true",
+        "CACHE_MAX_FLOWS": "2048"})
+    # ENABLE_PKT_DROPS exercises the probes-object ladder when CI built
+    # flowpath_probes.bpf.o next to the main object (absent: warn + degrade)
     fetcher = ldr.LibbpfKernelFetcher(cfg)
     try:
         idx = int(open(f"/sys/class/net/{veth}/ifindex").read())
@@ -263,3 +266,118 @@ def test_own_object_pca_fetcher(veth):
         assert got, "no packets captured by the clang PCA datapath"
     finally:
         fetcher.close()
+
+
+@needs_ref_obj
+def test_tracepoint_probe_attach_and_drops(veth):
+    """The probe-attach machinery (libbpf auto-attach by section) proven on
+    a real tracepoint program: ONLY the reference object's kfree_skb
+    tracepoint is loaded, its do_sampling gate is forced on, and a UDP
+    receive-buffer overflow on live traffic lands drop records in the
+    per-CPU aggregated_flows_pkt_drop map. This is the lifecycle
+    LibbpfKernelFetcher uses for the CI-built probes object."""
+    with libbpf.BpfObject(REF_OBJ) as obj:
+        for m in obj.maps():
+            m.disable_pinning()
+            if m.name == "aggregated_flows":
+                m.set_max_entries(1024)
+            elif m.type == 27 and m.max_entries > (1 << 16):
+                m.set_max_entries(1 << 16)
+            elif m.max_entries > 4096 and not m.name.startswith("."):
+                m.set_max_entries(4096)
+        tp = None
+        for p in obj.programs():
+            if p.name == "kfree_skb":
+                assert p.type == 5              # TRACEPOINT
+                tp = p
+            else:
+                p.set_autoload(False)
+        assert tp is not None
+        obj.load()
+        # force the do_sampling gate (a .bss global the TC program normally
+        # sets per packet): read-modify-write the one-element .bss array
+        elf = libbpf._Elf(REF_OBJ)
+        bss_syms = elf.symbols_in(".bss")
+        assert "do_sampling" in bss_syms, bss_syms
+        off, size = bss_syms["do_sampling"]
+        bss = next(m for m in obj.maps() if m.name.endswith(".bss"))
+        bm = syscall_bpf.BpfMap(bss.fd, bss.key_size, bss.value_size)
+        val = bytearray(bm.lookup(b"\x00\x00\x00\x00"))
+        val[off:off + size] = (1).to_bytes(size, "little")
+        bm.update(b"\x00\x00\x00\x00", bytes(val))
+        link = tp.attach()
+        try:
+            # drop generator: flood a 1-packet-deep UDP receive buffer
+            # from ACROSS the veth (the probe skips skb_iif 0/loopback)
+            rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            rx.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1)
+            rx.bind(("10.199.0.1", 48484))
+            _run("ip", "netns", "exec", NS, "python3", "-c",
+                 "import socket\n"
+                 "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
+                 "s.bind(('10.199.0.2', 0))\n"
+                 "for _ in range(300):\n"
+                 "    s.sendto(b'd' * 1200, ('10.199.0.1', 48484))\n")
+            rx.close()
+            time.sleep(0.3)
+            drops = obj.map("aggregated_flows_pkt_drop")
+            dm = syscall_bpf.BpfMap(drops.fd, drops.key_size,
+                                    drops.value_size)
+            assert dm.keys(), "no drop records from the tracepoint probe"
+        finally:
+            link.destroy()
+
+
+@needs_ref_obj
+def test_cross_object_map_sharing(veth):
+    """bpf_map__reuse_fd across objects — the mechanism the probes object
+    uses to write into the flow object's maps. Object A owns the maps;
+    object B's kfree_skb tracepoint is loaded with its maps reused from A;
+    live drops land in A's aggregated_flows_pkt_drop."""
+    with libbpf.BpfObject(REF_OBJ) as obj_a:
+        _prepare_ref_object(obj_a)
+        obj_a.load()
+        with libbpf.BpfObject(REF_OBJ) as obj_b:
+            tp = None
+            for p in obj_b.programs():
+                if p.name == "kfree_skb":
+                    tp = p
+                else:
+                    p.set_autoload(False)
+            for m in obj_b.maps():
+                m.disable_pinning()
+                # internal maps ('<prefix>.rodata'/'.bss') stay per-object
+                if "." in m.name:
+                    continue
+                shared = obj_a.map(m.name)
+                if shared is not None:
+                    m.reuse_fd(shared.fd)
+            obj_b.load()
+            # force B's OWN do_sampling gate (internal maps not shared)
+            elf = libbpf._Elf(REF_OBJ)
+            off, size = elf.symbols_in(".bss")["do_sampling"]
+            bss = next(m for m in obj_b.maps() if m.name.endswith(".bss"))
+            bm = syscall_bpf.BpfMap(bss.fd, bss.key_size, bss.value_size)
+            val = bytearray(bm.lookup(b"\x00\x00\x00\x00"))
+            val[off:off + size] = (1).to_bytes(size, "little")
+            bm.update(b"\x00\x00\x00\x00", bytes(val))
+            link = tp.attach()
+            try:
+                rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                rx.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1)
+                rx.bind(("10.199.0.1", 48485))
+                _run("ip", "netns", "exec", NS, "python3", "-c",
+                     "import socket\n"
+                     "s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)\n"
+                     "s.bind(('10.199.0.2', 0))\n"
+                     "for _ in range(300):\n"
+                     "    s.sendto(b'd' * 1200, ('10.199.0.1', 48485))\n")
+                rx.close()
+                time.sleep(0.3)
+                # the drops must be visible through OBJECT A's map handle
+                drops_a = obj_a.map("aggregated_flows_pkt_drop")
+                dm = syscall_bpf.BpfMap(drops_a.fd, drops_a.key_size,
+                                        drops_a.value_size)
+                assert dm.keys(), "drops not visible via the shared map"
+            finally:
+                link.destroy()
