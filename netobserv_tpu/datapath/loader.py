@@ -48,29 +48,9 @@ class KernelFetcher:
 
     @classmethod
     def load(cls, cfg: AgentConfig):
-        if os.geteuid() != 0:
-            raise RuntimeError("kernel datapath requires root/CAP_BPF")
-        if os.path.exists(_OBJ_PATH):
-            from netobserv_tpu.datapath import libbpf as lb
-
-            if lb.available():
-                try:
-                    fetcher = LibbpfKernelFetcher(cfg, _OBJ_PATH)
-                    log.info("loaded the clang-built CO-RE datapath %s via "
-                             "libbpf (full C feature set)", _OBJ_PATH)
-                    return fetcher
-                except Exception as exc:
-                    log.warning("clang object %s failed to load (%s); "
-                                "falling back to the assembler datapath",
-                                _OBJ_PATH, exc)
-            else:
-                log.warning("clang object %s present but libbpf is not "
-                            "available; using the assembler datapath",
-                            _OBJ_PATH)
-        else:
-            log.info("no clang-built BPF object (%s); using the in-tree "
-                     "assembler datapath", _OBJ_PATH)
-        return MinimalKernelFetcher.load(cfg)
+        return _load_clang_or_fallback(
+            cfg, lambda c: LibbpfKernelFetcher(c, _OBJ_PATH),
+            MinimalKernelFetcher.load, "datapath")
 
 
 # (map name, value dtype, EvictedFlows attr) — ALL per-CPU feature maps the
@@ -892,7 +872,7 @@ class MinimalPacketFetcher(_SelfManagedAttach):
 
 
 def _libbpf_open_and_load(obj_path: str, resize: dict, knobs: dict,
-                          entry_names: dict, type_fix_prefix: str = "tc_"):
+                          entry_names: dict):
     """Shared clang-object lifecycle (both fetcher twins): open, pinning
     strip, map resize, volatile-const patch (ELF-symtab offsets), entry-
     point check, prune everything but the selected entries, verifier load.
@@ -922,12 +902,14 @@ def _libbpf_open_and_load(obj_path: str, resize: dict, knobs: dict,
         wanted = set(entry_names.values())
         for p in obj.programs():
             if p.name not in wanted:
-                # incl. the unselected tc/tcx variant: tcx/ sections carry
-                # expected_attach_type the pre-TCX kernels tc mode targets
-                # would reject at BPF_PROG_LOAD
                 p.set_autoload(False)
-            elif p.name.startswith(type_fix_prefix):
-                p.set_type(3)                   # plain "tc_*" sections
+            else:
+                # force SCHED_CLS on EVERY entry: this tree's "tc_*"
+                # sections are custom, and "tcx/..." sec_defs only exist in
+                # libbpf >= 1.3 (v1.1 leaves them UNSPEC and load fails);
+                # plain SCHED_CLS attaches through both the TCX link and
+                # legacy tc paths, exactly like the assembler programs
+                p.set_type(3)
         obj.load()
         return obj
     except Exception:
@@ -1302,6 +1284,16 @@ class LibbpfPacketFetcher(_SelfManagedAttach):
 def load_packet_fetcher(cfg: AgentConfig):
     """PCA fetcher dispatch, mirroring KernelFetcher.load: the CI-built
     clang object when present+loadable, else the assembler PCA program."""
+    return _load_clang_or_fallback(
+        cfg, lambda c: LibbpfPacketFetcher(c, _OBJ_PATH),
+        MinimalPacketFetcher.load, "PCA datapath")
+
+
+def _load_clang_or_fallback(cfg: AgentConfig, clang_ctor, fallback,
+                            noun: str):
+    """Shared dispatch ladder: clang object via libbpf when present and
+    loadable, else the assembler implementation, with one log line per
+    branch so a degraded start is always explained."""
     if os.geteuid() != 0:
         raise RuntimeError("kernel datapath requires root/CAP_BPF")
     if os.path.exists(_OBJ_PATH):
@@ -1309,17 +1301,18 @@ def load_packet_fetcher(cfg: AgentConfig):
 
         if lb.available():
             try:
-                fetcher = LibbpfPacketFetcher(cfg, _OBJ_PATH)
-                log.info("loaded the clang-built PCA datapath via libbpf")
+                fetcher = clang_ctor(cfg)
+                log.info("loaded the clang-built %s %s via libbpf",
+                         noun, _OBJ_PATH)
                 return fetcher
             except Exception as exc:
-                log.warning("clang PCA object failed to load (%s); using "
-                            "the assembler PCA program", exc)
+                log.warning("clang %s failed to load (%s); falling back "
+                            "to the assembler implementation", noun, exc)
         else:
             log.warning("clang object %s present but libbpf is not "
-                        "available; using the assembler PCA program",
-                        _OBJ_PATH)
+                        "available; using the assembler %s",
+                        _OBJ_PATH, noun)
     else:
-        log.info("no clang-built BPF object (%s); using the assembler "
-                 "PCA program", _OBJ_PATH)
-    return MinimalPacketFetcher.load(cfg)
+        log.info("no clang-built BPF object (%s); using the assembler %s",
+                 _OBJ_PATH, noun)
+    return fallback(cfg)

@@ -475,32 +475,37 @@ class _Aggregates:
             g = d["groups"].get(gv)
             if g is None:
                 g = d["groups"][gv] = {
-                    "total_value": 0.0, "total_count": 0, "recent_count": 0,
+                    "total_value": None, "total_count": 0, "recent_count": 0,
                     "recent_op": None, "recent_raw": [], "last": now}
             g["last"] = now
-            g["total_count"] += 1
-            g["recent_count"] += 1
             v = 1.0
             if d["op"] != "count":
+                # an entry without the operation key contributes nothing —
+                # not even to the counts, or min/avg skew toward 0 (FLP
+                # skips the whole entry on a missing value key)
                 if d["key"] not in entry:
-                    continue            # missing input: count only
+                    continue
                 try:
                     v = float(entry[d["key"]] or 0)
                 except (TypeError, ValueError):
                     continue
+            g["total_count"] += 1
+            g["recent_count"] += 1
             op, cur = d["op"], g["recent_op"]
+            tot = g["total_value"]
             if op in ("sum", "count"):
-                g["total_value"] += v if op == "sum" else 1
-                g["recent_op"] = (cur or 0) + (v if op == "sum" else 1)
+                inc = v if op == "sum" else 1
+                g["total_value"] = (tot or 0) + inc
+                g["recent_op"] = (cur or 0) + inc
             elif op == "min":
-                g["total_value"] = v if g["total_count"] == 1 else \
-                    min(g["total_value"], v)
+                g["total_value"] = v if tot is None else min(tot, v)
                 g["recent_op"] = v if cur is None else min(cur, v)
             elif op == "max":
-                g["total_value"] = max(g["total_value"], v)
+                g["total_value"] = v if tot is None else max(tot, v)
                 g["recent_op"] = v if cur is None else max(cur, v)
             elif op == "avg":
-                g["total_value"] += (v - g["total_value"]) / g["total_count"]
+                g["total_value"] = (tot or 0.0) + \
+                    (v - (tot or 0.0)) / g["total_count"]
                 g["recent_op"] = ((cur or 0) * (g["recent_count"] - 1) + v) \
                     / g["recent_count"]
             elif op == "raw_values":
@@ -520,7 +525,7 @@ class _Aggregates:
                     "name": d["name"], "operation_type": d["op"],
                     "operation_key": d["key"], "by": ",".join(d["by"]),
                     "aggregate": ",".join(gv),
-                    "total_value": g["total_value"],
+                    "total_value": g["total_value"] or 0,
                     "total_count": g["total_count"],
                     "recent_raw_values": list(g["recent_raw"]),
                     "recent_op_value": g["recent_op"] or 0,

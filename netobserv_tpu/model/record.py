@@ -95,7 +95,10 @@ class Record:
     ssl_mismatch: bool = False
 
     def to_json_obj(self) -> dict:
-        """Stable JSON shape for the stdout/direct exporter."""
+        """Stable JSON shape for the stdout exporter. Field NAMES follow the
+        FLP GenericMap naming (exporter/flp_map.py) so consumers can switch
+        exporters without remapping; this surface keeps raw numeric values
+        where flp_map decodes strings (drop causes, TCP states)."""
         f = self.features
         obj = {
             "SrcAddr": self.key.src,
@@ -135,7 +138,7 @@ class Record:
             obj.update(XlatSrcAddr=ip_from_16(f.xlat_src_ip),
                        XlatDstAddr=ip_from_16(f.xlat_dst_ip),
                        XlatSrcPort=f.xlat_src_port, XlatDstPort=f.xlat_dst_port,
-                       XlatZoneId=f.xlat_zone_id)
+                       ZoneId=f.xlat_zone_id)
         if f.ipsec_encrypted or f.ipsec_encrypted_ret:
             obj.update(IPSecRet=f.ipsec_encrypted_ret,
                        IPSecStatus="success" if f.ipsec_encrypted

@@ -512,3 +512,36 @@ def test_ovn_event_causes_match_reference():
     # the injected names render with the NetworkEvent_ prefix
     assert flp_tables.pkt_drop_cause_to_str(
         flp_tables.OVN_EVENTS_SUBSYS + 4) == "NetworkEvent_NetworkPolicy"
+
+
+def test_extract_aggregates_missing_key_does_not_skew():
+    """Entries lacking the operation key contribute NOTHING — min must not
+    lock to the 0.0 initializer and avg must not dilute toward 0."""
+    cfg = """
+pipeline: [{name: agg}, {name: w, follows: agg}]
+parameters:
+  - name: agg
+    extract:
+      type: aggregates
+      aggregates:
+        rules:
+          - {name: min_rtt, groupByKeys: [Proto], operationType: min,
+             operationKey: TimeFlowRttNs}
+          - {name: avg_rtt, groupByKeys: [Proto], operationType: avg,
+             operationKey: TimeFlowRttNs}
+  - name: w
+    write: {type: stdout}
+"""
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=cfg, stream=buf)
+    no_rtt = make_record(proto=6, with_features=False)   # no TimeFlowRttNs
+    r10 = make_record(proto=6)
+    r10.features.rtt_ns = 10
+    r20 = make_record(proto=6)
+    r20.features.rtt_ns = 20
+    exp.export_batch([no_rtt, r10, r20])
+    out = {e["name"]: e for e in
+           (json.loads(l) for l in buf.getvalue().splitlines())}
+    assert out["min_rtt"]["total_value"] == 10     # not 0.0
+    assert out["avg_rtt"]["total_value"] == 15     # not diluted by no_rtt
+    assert out["min_rtt"]["total_count"] == 2      # keyless entry uncounted

@@ -381,3 +381,73 @@ def test_cross_object_map_sharing(veth):
                 assert dm.keys(), "drops not visible via the shared map"
             finally:
                 link.destroy()
+
+
+def _minimal_bpf_elf(section: str) -> bytes:
+    """A minimal relocatable BPF ELF: one `return 0` program in `section`
+    plus a GPL license — enough for libbpf to open and load it."""
+    import struct as s
+
+    insns = bytes.fromhex("b700000000000000") + \
+        bytes.fromhex("9500000000000000")          # mov r0,0; exit
+    lic = b"GPL\x00"
+    names = [b"", section.encode(), b"license", b".symtab", b".strtab",
+             b"prog_main"]
+    strtab = b"\x00"
+    offs = {}
+    for n in names[1:]:
+        offs[n] = len(strtab)
+        strtab += n + b"\x00"
+    # symbols: null + prog function (section 1, global func, size 16)
+    sym_null = b"\x00" * 24
+    sym_prog = s.pack("<IBBHQQ", offs[b"prog_main"], (1 << 4) | 2, 0, 1,
+                      0, len(insns))
+    symtab = sym_null + sym_prog
+    ehsize, shentsize = 64, 64
+    bodies = [insns, lic, symtab, strtab]        # sections 1..4
+    off = ehsize
+    layout = []
+    for b in bodies:
+        layout.append((off, len(b)))
+        off += len(b)
+    shoff = (off + 7) & ~7
+    # sh: name, type, flags, addr, offset, size, link, info, align, entsize
+    sh = [s.pack("<IIQQQQIIQQ", 0, 0, 0, 0, 0, 0, 0, 0, 0, 0)]
+    sh.append(s.pack("<IIQQQQIIQQ", offs[section.encode()], 1, 0x6, 0,
+                     layout[0][0], layout[0][1], 0, 0, 8, 0))
+    sh.append(s.pack("<IIQQQQIIQQ", offs[b"license"], 1, 0x3, 0,
+                     layout[1][0], layout[1][1], 0, 0, 1, 0))
+    sh.append(s.pack("<IIQQQQIIQQ", offs[b".symtab"], 2, 0, 0,
+                     layout[2][0], layout[2][1], 4, 1, 8, 24))
+    sh.append(s.pack("<IIQQQQIIQQ", offs[b".strtab"], 3, 0, 0,
+                     layout[3][0], layout[3][1], 0, 0, 1, 0))
+    ehdr = s.pack("<4sBBBBB7xHHIQQQIHHHHHH", b"\x7fELF", 2, 1, 1, 0, 0,
+                  1, 247, 1, 0, 0, shoff, 0, ehsize, 0, 0,
+                  shentsize, len(sh), 4)
+    body = b"".join(bodies)
+    pad = b"\x00" * (shoff - ehsize - len(body))
+    return ehdr + body + pad + b"".join(sh)
+
+
+@needs_kernel
+def test_tcx_section_needs_explicit_type(tmp_path):
+    """Regression for the silent clang-path failure on libbpf <= 1.2: a
+    program in a \"tcx/ingress\" section is left UNSPEC by this image's
+    libbpf 1.1 (tcx sec_defs arrived in 1.3) and load fails; the loader
+    must force SCHED_CLS on every entry program — after set_type(3) the
+    same object passes the verifier."""
+    path = tmp_path / "tcx.bpf.o"
+    path.write_bytes(_minimal_bpf_elf("tcx/ingress"))
+    with libbpf.BpfObject(str(path)) as obj:
+        prog = obj.program("prog_main")
+        assert prog is not None
+        if prog.type == 0:                       # libbpf <= 1.2 behavior
+            with pytest.raises(OSError):
+                obj.load()
+        else:
+            pytest.skip("libbpf recognizes tcx sections here")
+    with libbpf.BpfObject(str(path)) as obj:
+        prog = obj.program("prog_main")
+        prog.set_type(3)                         # what the loader now does
+        obj.load()
+        assert prog.fd > 0
