@@ -154,13 +154,18 @@ NO_INLINE int no_flow_monitor(struct __sk_buff *skb, __u8 direction) {
     __u32 sampling = 0;
     if (!cfg_has_sampling) {
         /* no filter rule carries a sampling override: gate at the earliest
-         * point, before any parsing (reference: bpf/flows.c:160-171) */
-        if (!no_sampled(cfg_sampling)) {
-            no_set_do_sampling(0);
-            return TC_ACT_OK;
+         * point, before any parsing (reference: bpf/flows.c:160-171).
+         * Skip the gate write entirely when sampling is off — the reader
+         * (no_do_sampling) short-circuits that case, so the store would be
+         * pure per-packet overhead the verifier can't prune */
+        if (cfg_sampling > 1) {
+            if (!no_sampled(cfg_sampling)) {
+                no_set_do_sampling(0);
+                return TC_ACT_OK;
+            }
+            sampling = cfg_sampling;
+            no_set_do_sampling(1);
         }
-        sampling = cfg_sampling;
-        no_set_do_sampling(1);
     }
     struct no_pkt pkt;
     __builtin_memset(&pkt, 0, sizeof(pkt));

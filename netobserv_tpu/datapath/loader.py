@@ -674,6 +674,12 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         # (netns, if_index) -> (if_name, direction -> live Attachment)
         self._attached: dict[tuple[str, int], tuple[str, dict]] = {}
 
+    _UNSUPPORTED_FEATURES = (
+        ("enable_network_events_monitoring", "network events (psample)"),
+        ("enable_pkt_translation", "packet translation (nf_nat)"),
+        ("enable_ipsec_tracking", "IPsec (xfrm)"),
+    )
+
     @classmethod
     def load(cls, cfg: AgentConfig) -> "MinimalKernelFetcher":
         import shutil
@@ -682,6 +688,13 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             raise RuntimeError("kernel datapath requires root/CAP_BPF")
         if cfg.tc_attach_mode != "tcx" and shutil.which("tc") is None:
             raise RuntimeError("tc (iproute2) not found; cannot attach")
+        wanted = [label for attr, label in cls._UNSUPPORTED_FEATURES
+                  if getattr(cfg, attr)]
+        if wanted:
+            log.warning("enabled features need kprobe/fentry hooks the "
+                        "assembler datapath cannot provide: %s — they will "
+                        "produce no data (build the clang probes object or "
+                        "use bpfman mode)", ", ".join(wanted))
         has_filter_sampling = bool(cfg.flow_filter_rules) and any(
             getattr(r, "sample", 0) for r in cfg.parsed_filter_rules())
         return cls(cache_max_flows=cfg.cache_max_flows,

@@ -111,14 +111,21 @@ class SSLCorrelator:
     def observe(self, event) -> int:
         """Credit one SSLEvent to the pid's flows; returns flows credited."""
         now = time.monotonic()
-        cached = self._pid_cache.get(event.pid)
+        with self._lock:
+            cached = self._pid_cache.get(event.pid)
         if cached is not None and now - cached[0] < self._ttl:
             tuples = cached[1]
         else:
             tuples = self._resolver(event.pid)
-            self._pid_cache[event.pid] = (now, tuples)
-            if len(self._pid_cache) > 1024:
-                self._pid_cache.clear()
+            with self._lock:
+                if len(self._pid_cache) >= 1024:
+                    # evict the oldest half BEFORE inserting, so the entry
+                    # just resolved survives (clearing after insert made the
+                    # cache useless exactly at >1024 active pids)
+                    from itertools import islice
+                    for stale in list(islice(self._pid_cache, 512)):
+                        del self._pid_cache[stale]
+                self._pid_cache[event.pid] = (now, tuples)
         credited = 0
         with self._lock:
             if len(self._counters) >= self._max_keys:

@@ -165,11 +165,19 @@ class Watcher(_InformerBase):
             try:
                 ns_sock = nsmod.subscribe_links_in(name, self._netns_dir)
             except OSError as exc:
-                # cannot enter (e.g. no CAP_SYS_ADMIN): permanent — remember
-                # the namespace so this doesn't retry/log every iteration
-                log.warning("cannot enter netns %s (%s); observing only",
-                            name, exc)
-                self._netns_socks[name] = None
+                import errno as _errno
+
+                if exc.errno in (_errno.EPERM, _errno.EACCES):
+                    # cannot enter (no CAP_SYS_ADMIN): permanent — remember
+                    # the namespace so this doesn't retry/log every cycle
+                    log.warning("cannot enter netns %s (%s); observing only",
+                                name, exc)
+                    self._netns_socks[name] = None
+                else:
+                    # transient (fd pressure, netns racing away): leave the
+                    # name unknown so the next cycle retries
+                    log.debug("netns %s subscribe failed (%s); will retry",
+                              name, exc)
                 continue
             try:
                 links = nsmod.links_in(name, self._netns_dir)
