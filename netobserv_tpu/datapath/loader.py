@@ -1096,17 +1096,19 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                             probes_path, exc)
 
     # SEC-prefix -> (config gate, capability) for the aux hook programs
-    # (reference attach ladder, tracer.go:184-273)
+    # (reference attach ladder, tracer.go:184-273). rtt_tier selects the RTT
+    # hook flavor: "fentry" -> "kprobe" (trampoline unusable) -> "none"
+    # (both RTT twins rejected; every OTHER wanted probe still loads).
     @staticmethod
-    def _probe_wanted(cfg, section: str, allow_fentry: bool,
+    def _probe_wanted(cfg, section: str, rtt_tier: str,
                       have_kprobes: bool, have_tracepoints: bool) -> bool:
         if section.startswith("tracepoint/skb/kfree_skb"):
             return cfg.enable_pkt_drops and have_tracepoints
         if section.startswith("fentry/tcp_rcv"):
-            return cfg.enable_rtt and allow_fentry
+            return cfg.enable_rtt and rtt_tier == "fentry"
         if section.startswith("kprobe/tcp_rcv"):
             # kprobe fallback only when fentry is off the table
-            return cfg.enable_rtt and have_kprobes and not allow_fentry
+            return cfg.enable_rtt and have_kprobes and rtt_tier == "kprobe"
         if section.startswith("kprobe/psample"):
             return cfg.enable_network_events_monitoring and have_kprobes
         if section.startswith("kprobe/nf_nat"):
@@ -1132,12 +1134,16 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                             "/sys/kernel/debug/tracing/kprobe_events")))
         syms = lb.rodata_symbols(probes_path)
         last_exc: Exception | None = None
-        for allow_fentry in (True, False):
+        rtt_ladder = ["fentry"]
+        if have_kprobes:
+            rtt_ladder.append("kprobe")
+        rtt_ladder.append("none")
+        for rtt_tier in rtt_ladder:
             pobj = lb.BpfObject(probes_path)
             try:
                 wanted_any = False
                 for p in pobj.programs():
-                    want = self._probe_wanted(cfg, p.section, allow_fentry,
+                    want = self._probe_wanted(cfg, p.section, rtt_tier,
                                               have_kprobes, have_tracepoints)
                     if not want:
                         p.set_autoload(False)
@@ -1147,6 +1153,7 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                     log.info("no probe hooks wanted/attachable on this "
                              "kernel; skipping %s", probes_path)
                     return
+                resize = _libbpf_default_resize(cfg.cache_max_flows)
                 for m in pobj.maps():
                     m.disable_pinning()
                     # internal maps are named '<8-char-obj-prefix>.rodata'
@@ -1157,6 +1164,12 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                     shared = self._obj.map(m.name)
                     if shared is not None:
                         m.reuse_fd(shared.fd)
+                    elif m.name in resize:
+                        # unshared probes-only maps get the same pre-load
+                        # shrink the flow object does: libbpf creates every
+                        # object map at its declared size regardless of
+                        # program autoload, and maps.h declares 1<<24-scale
+                        m.set_max_entries(resize[m.name])
                 patches = {}
                 for name, val in knobs.items():
                     if name in syms:
@@ -1166,24 +1179,48 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                     pobj.patch_rodata(patches)
                 pobj.load()
                 links = []
-                for p in pobj.programs():
-                    if not p.autoload:
-                        continue
+                fentry_attach_failed = False
+                # fentry first: if its trampoline is rejected at ATTACH we
+                # rerun the whole ladder, so don't attach anything else
+                # before that verdict is in
+                progs = sorted((p for p in pobj.programs() if p.autoload),
+                               key=lambda p:
+                               not p.section.startswith("fentry/"))
+                for p in progs:
                     try:
                         links.append(p.attach())
                         log.info("probe attached: %s", p.section)
                     except OSError as exc:
+                        if (rtt_tier == "fentry"
+                                and p.section.startswith("fentry/")):
+                            # some kernels accept the fentry program at load
+                            # but reject the trampoline at ATTACH; the
+                            # reference falls back to the kprobe twin there
+                            # too (tracer.go:203-222), so rerun the ladder
+                            fentry_attach_failed = True
+                            log.warning(
+                                "fentry probe %s attach failed (%s); %s",
+                                p.section, exc,
+                                "retrying with the kprobe fallback"
+                                if have_kprobes else
+                                "no kprobe support here — RTT probe dropped")
+                            break
                         log.warning("probe %s attach failed: %s",
                                     p.section, exc)
+                if fentry_attach_failed:
+                    for link in links:
+                        link.destroy()
+                    pobj.close()
+                    continue
                 self._probes_obj = pobj
                 self._probe_links = links
                 return
             except OSError as exc:
                 pobj.close()
                 last_exc = exc
-                if allow_fentry:
-                    log.debug("probes load with fentry failed (%s); "
-                              "retrying with the kprobe fallback", exc)
+                if rtt_tier != "none":
+                    log.debug("probes load at RTT tier %r failed (%s); "
+                              "laddering down", rtt_tier, exc)
         raise last_exc if last_exc else RuntimeError("probes load failed")
 
     def program_filters(self, rules) -> int:

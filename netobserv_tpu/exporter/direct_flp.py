@@ -192,30 +192,72 @@ def _build_network(params: dict) -> Stage:
     return stage
 
 
-def _build_prom(params: dict, registry) -> Stage:
+def _build_prom(params: dict, registry,
+                seen_names: set | None = None) -> Stage:
     """FLP `encode prom` subset (encode_prom.go): declarative metrics from
-    the entry stream, registered on `registry`. Entries pass through."""
+    the entry stream, registered on `registry`. Entries pass through.
+    `seen_names` spans every prom stage of ONE exporter build: a name
+    declared by an earlier stage is a same-config duplicate (skip — binding
+    two stages to one collector double-counts), while a name alive in the
+    registry but NOT in seen_names is a rebuild survivor (adopt)."""
     import re
 
     from prometheus_client import Counter, Gauge, Histogram
 
     prefix = params.get("prefix", "")
     metrics = []
+    if seen_names is None:
+        seen_names = set()
+    cls_for = {"counter": Counter, "gauge": Gauge,
+               "histogram": Histogram, "agg_histogram": Histogram}
     for item in params.get("metrics", []):
         name = prefix + item.get("name", "")
         labels = list(item.get("labels", []))
         mtype = item.get("type", "counter")
-        kw = {"registry": registry}
-        if mtype == "counter":
-            m = Counter(name, name, labels, **kw)
-        elif mtype == "gauge":
-            m = Gauge(name, name, labels, **kw)
-        elif mtype in ("histogram", "agg_histogram"):
-            buckets = item.get("buckets") or Histogram.DEFAULT_BUCKETS
-            m = Histogram(name, name, labels, buckets=buckets, **kw)
-        else:
+        if mtype not in cls_for:
             log.warning("prom metric type %r unsupported; skipped", mtype)
             continue
+        if name in seen_names:
+            # two entries sharing a name within ONE config: binding both to
+            # the same collector would double-count, so the first wins
+            log.warning("prom metric %r declared twice; second skipped", name)
+            continue
+        kw = {"registry": registry}
+        try:
+            if mtype in ("histogram", "agg_histogram"):
+                buckets = item.get("buckets") or Histogram.DEFAULT_BUCKETS
+                m = Histogram(name, name, labels, buckets=buckets, **kw)
+            else:
+                m = cls_for[mtype](name, name, labels, **kw)
+        except ValueError as exc:
+            # already registered = an exporter REBUILD against the shared
+            # agent registry (restart-in-place): adopt the live collector so
+            # the new stage keeps updating it — skipping would freeze the
+            # series forever; an incompatible survivor degrades to warn+skip
+            # like every other unsupported-config case (never abort startup)
+            existing = getattr(registry, "_names_to_collectors", {}).get(name)
+            compatible = (isinstance(existing, cls_for[mtype])
+                          and list(getattr(existing, "_labelnames", ()))
+                          == labels)
+            if compatible and isinstance(existing, Histogram):
+                # bucket edits across a restart-in-place must not be
+                # silently ignored — stale boundaries would misbin forever.
+                # Mirror prometheus_client's normalization: +inf is only
+                # appended when the declared list doesn't already end in it
+                want = [float(b) for b in (item.get("buckets")
+                                           or Histogram.DEFAULT_BUCKETS)]
+                if not want or want[-1] != float("inf"):
+                    want.append(float("inf"))
+                have = list(getattr(existing, "_upper_bounds", ()))
+                compatible = want == have
+            if compatible:
+                m = existing
+                log.info("prom metric %r reused from registry", name)
+            else:
+                log.warning("prom metric %r not registered (%s); skipped",
+                            name, exc)
+                continue
+        seen_names.add(name)
         filters = []
         for f in item.get("filters", []):
             ftype = f.get("type", "equal")
@@ -662,6 +704,7 @@ class DirectFLPExporter(Exporter):
         # they surface on the existing /metrics server
         self.prom_registry = (prom_registry if prom_registry is not None
                               else CollectorRegistry())
+        self._prom_names: set[str] = set()
         if flp_config.strip():
             self._build(yaml.safe_load(flp_config))
 
@@ -696,7 +739,8 @@ class DirectFLPExporter(Exporter):
                 e = p["encode"]
                 if e.get("type") == "prom":
                     self._stages.append(
-                        _build_prom(e.get("prom", {}), self.prom_registry))
+                        _build_prom(e.get("prom", {}), self.prom_registry,
+                                    self._prom_names))
                 else:
                     log.warning("unsupported encode type %r ignored",
                                 e.get("type"))
@@ -764,6 +808,15 @@ class _LokiWriter:
     timers (one push per exported batch). Push failures are logged and
     dropped — an unreachable Loki must not wedge the eviction loop."""
 
+    #: after this many consecutive failures, pushes are skipped until
+    #: BACKOFF_S elapses — a dead Loki must not throttle the export queue
+    #: to one TIMEOUT_S-blocked batch per drain. TIMEOUT_S stays above
+    #: burst/compaction ingest latency so a merely SLOW Loki doesn't trip
+    #: the breaker (a blip costs consecutive failures, not data loss).
+    FAIL_THRESHOLD = 3
+    BACKOFF_S = 30.0
+    TIMEOUT_S = 5.0
+
     def __init__(self, params: dict):
         self.url = params.get("url", "http://localhost:3100").rstrip("/")
         self.tenant = params.get("tenantID", "")
@@ -774,6 +827,9 @@ class _LokiWriter:
         scale = params.get("timestampScale", "1ms")
         self.ts_ns_mult = {"1s": 10**9, "1ms": 10**6, "1us": 10**3,
                            "1ns": 1}.get(scale, 10**6)
+        self._consec_failures = 0
+        self._backoff_until = 0.0
+        self._backoff_dropped = 0
 
     def push(self, entries: list[dict]) -> None:
         import http.client
@@ -781,6 +837,12 @@ class _LokiWriter:
         import urllib.request
 
         if not entries:
+            return
+        if (self._consec_failures >= self.FAIL_THRESHOLD
+                and _time.monotonic() < self._backoff_until):
+            # tallied, not silent: the drop volume is reported on the next
+            # dial (warning either way), so operators see what backoff cost
+            self._backoff_dropped += len(entries)
             return
         streams: dict[tuple, list] = {}
         for e in entries:
@@ -804,8 +866,18 @@ class _LokiWriter:
         if self.tenant:
             req.add_header("X-Scope-OrgID", self.tenant)
         try:
-            urllib.request.urlopen(req, timeout=10).read()
+            urllib.request.urlopen(req, timeout=self.TIMEOUT_S).read()
+            self._consec_failures = 0
+            if self._backoff_dropped:
+                log.warning("loki recovered; %d entries were dropped during "
+                            "backoff", self._backoff_dropped)
+                self._backoff_dropped = 0
         except (urllib.error.URLError, OSError,
                 http.client.HTTPException) as exc:
-            log.warning("loki push failed (%d entries dropped): %s",
-                        len(entries), exc)
+            self._consec_failures += 1
+            if self._consec_failures >= self.FAIL_THRESHOLD:
+                self._backoff_until = _time.monotonic() + self.BACKOFF_S
+            log.warning("loki push failed (%d entries dropped, %d more "
+                        "during backoff): %s",
+                        len(entries), self._backoff_dropped, exc)
+            self._backoff_dropped = 0

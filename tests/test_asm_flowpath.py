@@ -30,6 +30,9 @@ def _run(*cmd):
 
 @pytest.fixture
 def veth():
+    # self-healing: clear leftovers from an aborted prior run first
+    subprocess.run(["ip", "link", "del", "nf0"], capture_output=True)
+    subprocess.run(["ip", "netns", "del", NS], capture_output=True)
     _run("ip", "link", "add", "nf0", "type", "veth", "peer", "name", "nf1")
     subprocess.run(["ip", "netns", "add", NS], check=True)
     try:
@@ -1313,6 +1316,9 @@ def veth_bridge():
     """nf0 enslaved to a bridge with the host IP on the bridge: every egress
     datagram traverses br-nf (egress) AND nf0 (egress) — the classic
     veth+bridge double-counting topology."""
+    subprocess.run(["ip", "link", "del", "nf0"], capture_output=True)
+    subprocess.run(["ip", "link", "del", "br-nf"], capture_output=True)
+    subprocess.run(["ip", "netns", "del", NS], capture_output=True)
     _run("ip", "link", "add", "nf0", "type", "veth", "peer", "name", "nf1")
     subprocess.run(["ip", "netns", "add", NS], check=True)
     try:

@@ -191,6 +191,108 @@ parameters:
     assert g("flp_bytes_hist_bucket", {"le": "100.0"}) == 1
 
 
+def test_encode_prom_duplicate_metric_skipped():
+    """A duplicate metric name (two pipeline entries sharing one, or an
+    exporter rebuild against the same registry) must warn+skip like every
+    other unsupported-config case, not abort agent startup."""
+    import prometheus_client
+
+    cfg = """
+pipeline: [{name: e}, {name: w, follows: e}]
+parameters:
+  - name: e
+    encode:
+      type: prom
+      prom:
+        metrics:
+          - {name: dup_total, type: counter}
+          - {name: dup_total, type: counter}
+          - {name: ok_total, type: counter}
+  - name: w
+    write: {type: stdout}
+"""
+    reg = prometheus_client.CollectorRegistry()
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=cfg, stream=buf, prom_registry=reg)
+    exp.export_batch([make_record()])
+    # same-config duplicate: first definition wins, no double counting
+    assert reg.get_sample_value("dup_total") == 1
+    assert reg.get_sample_value("ok_total") == 1
+    # a rebuild against the SAME registry (agent restart-in-place) adopts the
+    # live collectors — the series keep moving instead of freezing
+    exp2 = DirectFLPExporter(flp_config=cfg, stream=buf, prom_registry=reg)
+    exp2.export_batch([make_record()])
+    assert reg.get_sample_value("dup_total") == 2
+    assert reg.get_sample_value("ok_total") == 2
+
+
+def test_encode_prom_cross_stage_duplicate_not_double_counted():
+    """Two prom ENCODE STAGES in one config sharing a metric name: the
+    second stage must skip (not adopt) the collector, or every entry
+    flowing through both stages would count twice."""
+    import prometheus_client
+
+    cfg = """
+pipeline: [{name: e1}, {name: e2, follows: e1}, {name: w, follows: e2}]
+parameters:
+  - name: e1
+    encode:
+      type: prom
+      prom:
+        metrics: [{name: xs_total, type: counter}]
+  - name: e2
+    encode:
+      type: prom
+      prom:
+        metrics: [{name: xs_total, type: counter}]
+  - name: w
+    write: {type: stdout}
+"""
+    reg = prometheus_client.CollectorRegistry()
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=cfg, stream=buf, prom_registry=reg)
+    exp.export_batch([make_record()])
+    assert reg.get_sample_value("xs_total") == 1
+
+
+def test_encode_prom_rebuild_with_changed_buckets_skips():
+    """A restart-in-place that CHANGES a histogram's buckets must not adopt
+    the stale collector (observations would misbin forever) — incompatible
+    survivors degrade to warn+skip."""
+    import prometheus_client
+
+    def cfg(buckets):
+        return f"""
+pipeline: [{{name: e}}, {{name: w, follows: e}}]
+parameters:
+  - name: e
+    encode:
+      type: prom
+      prom:
+        metrics:
+          - {{name: h_bytes, type: histogram, valueKey: Bytes,
+              buckets: {buckets}}}
+  - name: w
+    write: {{type: stdout}}
+"""
+    reg = prometheus_client.CollectorRegistry()
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=cfg([100, 10000]), stream=buf,
+                            prom_registry=reg)
+    exp.export_batch([make_record(nbytes=500)])
+    assert reg.get_sample_value("h_bytes_bucket", {"le": "10000.0"}) == 1
+    # same buckets -> adopted, keeps counting
+    exp2 = DirectFLPExporter(flp_config=cfg([100, 10000]), stream=buf,
+                             prom_registry=reg)
+    exp2.export_batch([make_record(nbytes=500)])
+    assert reg.get_sample_value("h_bytes_bucket", {"le": "10000.0"}) == 2
+    # changed buckets -> skipped, stale series frozen rather than misbinned
+    exp3 = DirectFLPExporter(flp_config=cfg([1, 2]), stream=buf,
+                             prom_registry=reg)
+    exp3.export_batch([make_record(nbytes=500)])
+    assert reg.get_sample_value("h_bytes_bucket", {"le": "10000.0"}) == 2
+
+
 CT_CFG = """
 pipeline: [{name: ct}, {name: w, follows: ct}]
 parameters:
@@ -433,6 +535,36 @@ parameters:
 """
     exp = DirectFLPExporter(flp_config=cfg)
     exp.export_batch([make_record()])          # must not raise
+
+
+def test_write_loki_backoff_after_consecutive_failures(monkeypatch):
+    """An unreachable Loki must not throttle the export queue: after
+    FAIL_THRESHOLD consecutive failures the writer skips pushes (no network
+    attempt at all) until the backoff window elapses."""
+    from netobserv_tpu.exporter import direct_flp as dflp
+
+    w = dflp._LokiWriter({"url": "http://127.0.0.1:1"})
+    attempts = {"n": 0}
+
+    import urllib.request
+
+    def counting_urlopen(req, timeout=None):
+        attempts["n"] += 1
+        assert timeout is not None and timeout <= 5, \
+            "per-batch POST timeout must stay short"
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(urllib.request, "urlopen", counting_urlopen)
+    for _ in range(w.FAIL_THRESHOLD):
+        w.push([{"SrcAddr": "10.0.0.1"}])
+    assert attempts["n"] == w.FAIL_THRESHOLD
+    # now inside the backoff window: pushes are dropped without dialing
+    w.push([{"SrcAddr": "10.0.0.1"}])
+    assert attempts["n"] == w.FAIL_THRESHOLD
+    # window elapses -> the writer dials again
+    w._backoff_until = 0.0
+    w.push([{"SrcAddr": "10.0.0.1"}])
+    assert attempts["n"] == w.FAIL_THRESHOLD + 1
 
 
 # ---------------------------------------------------------------------------

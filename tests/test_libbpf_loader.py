@@ -451,3 +451,204 @@ def test_tcx_section_needs_explicit_type(tmp_path):
         prog.set_type(3)                         # what the loader now does
         obj.load()
         assert prog.fd > 0
+
+
+# ---------------------------------------------------------------------------
+# probes-object ladder logic (unit, faked libbpf — no kernel needed)
+# ---------------------------------------------------------------------------
+
+class _FakeProbeProg:
+    def __init__(self, section, fail_attach=False):
+        self.section = section
+        self.name = section.replace("/", "_")
+        self.autoload = True
+        self.fail_attach = fail_attach
+        self.attached = False
+        self.link = None
+
+    def set_autoload(self, v):
+        self.autoload = v
+
+    def attach(self):
+        if self.fail_attach:
+            raise OSError(524, "trampoline attach rejected")
+        self.attached = True
+        self.link = _FakeLink()
+        return self.link
+
+
+class _FakeLink:
+    def __init__(self):
+        self.destroyed = False
+
+    def destroy(self):
+        self.destroyed = True
+
+
+class _FakeProbeMap:
+    def __init__(self, name):
+        self.name = name
+        self.reused_fd = None
+        self.max_entries = 1 << 24
+
+    def disable_pinning(self):
+        pass
+
+    def reuse_fd(self, fd):
+        self.reused_fd = fd
+
+    def set_max_entries(self, n):
+        self.max_entries = n
+
+
+class _FakeProbeObj:
+    """Stands in for libbpf.BpfObject in the _load_probes ladder."""
+    instances: list = []
+    sections = ("fentry/tcp_rcv_established", "kprobe/tcp_rcv_established")
+    fail_attach_sections = ("fentry/tcp_rcv_established",)
+    #: load() raises if any autoloaded program's section starts with one
+    #: of these (simulates a verifier rejection of that flavor)
+    fail_load_sections: tuple = ()
+
+    def __init__(self, path):
+        self._progs = [
+            _FakeProbeProg(s, fail_attach=s in self.fail_attach_sections)
+            for s in self.sections]
+        self._maps = [_FakeProbeMap("flows_extra"),
+                      _FakeProbeMap("flows_xlat"),
+                      _FakeProbeMap("probes_.rodata")]
+        self.loaded = self.closed = False
+        _FakeProbeObj.instances.append(self)
+
+    def programs(self):
+        return self._progs
+
+    def maps(self):
+        return self._maps
+
+    def patch_rodata(self, patches):
+        pass
+
+    def load(self):
+        for p in self._progs:
+            if p.autoload and p.section.startswith(self.fail_load_sections):
+                raise OSError(22, f"verifier rejected {p.section}")
+        self.loaded = True
+
+    def close(self):
+        self.closed = True
+
+
+def _fake_probe_env(monkeypatch, cfg_overrides=None):
+    """Monkeypatched _load_probes harness: faked libbpf + forced-on kernel
+    capability probes (this image has no kprobe support)."""
+    from types import SimpleNamespace
+
+    from netobserv_tpu.datapath import loader as loader_mod
+
+    _FakeProbeObj.instances = []
+    monkeypatch.setattr(libbpf, "BpfObject", _FakeProbeObj)
+    monkeypatch.setattr(libbpf, "rodata_symbols", lambda p: {})
+    monkeypatch.setattr(os.path, "isdir", lambda p: True)
+    monkeypatch.setattr(os.path, "exists", lambda p: True)
+    shared = {"flows_extra": SimpleNamespace(fd=42)}
+    fake_self = SimpleNamespace(
+        _probe_wanted=loader_mod.LibbpfKernelFetcher._probe_wanted,
+        _obj=SimpleNamespace(map=lambda name: shared.get(name)),
+    )
+    cfg = SimpleNamespace(
+        enable_rtt=True, enable_pkt_drops=False,
+        enable_network_events_monitoring=False,
+        enable_pkt_translation=False, enable_ipsec_tracking=False,
+        cache_max_flows=777)
+    for k, v in (cfg_overrides or {}).items():
+        setattr(cfg, k, v)
+    return loader_mod, fake_self, cfg
+
+
+def test_probes_fentry_attach_failure_reruns_ladder(monkeypatch, tmp_path):
+    """Advisor (round 2, medium): a fentry program that LOADS but fails at
+    ATTACH must tear down and rerun the ladder so the kprobe twin attaches —
+    the reference falls back at attach time too (tracer.go:203-222). Also
+    covers the probes-only map resize pass."""
+    monkeypatch.setattr(_FakeProbeObj, "fail_load_sections", ())
+    loader_mod, fake_self, cfg = _fake_probe_env(monkeypatch)
+    loader_mod.LibbpfKernelFetcher._load_probes(
+        fake_self, cfg, str(tmp_path / "probes.bpf.o"), {})
+
+    assert len(_FakeProbeObj.instances) == 2
+    first, second = _FakeProbeObj.instances
+    # pass 1: fentry attach blew up -> torn down, no lingering state
+    assert first.closed
+    # pass 2: kprobe twin wanted, attached, object kept alive
+    assert not second.closed
+    kprobe = next(p for p in second.programs()
+                  if p.section.startswith("kprobe/"))
+    fentry = next(p for p in second.programs()
+                  if p.section.startswith("fentry/"))
+    assert kprobe.attached and not fentry.autoload
+    assert fake_self._probes_obj is second
+    assert len(fake_self._probe_links) == 1
+    # probes-only (unshared) maps got the pre-load shrink; shared ones the fd
+    for inst in (first, second):
+        by_name = {m.name: m for m in inst.maps()}
+        assert by_name["flows_extra"].reused_fd == 42
+        assert by_name["flows_xlat"].max_entries == 777
+        assert by_name["probes_.rodata"].reused_fd is None
+
+
+def test_probes_ladder_keeps_other_probes_when_both_rtt_tiers_fail(
+        monkeypatch, tmp_path):
+    """The ladder's bottom tier: fentry attach fails AND the kprobe twin is
+    rejected by the verifier — the other wanted probes (here the kfree_skb
+    tracepoint) must still end up attached instead of all probe features
+    degrading; only RTT is lost."""
+    monkeypatch.setattr(
+        _FakeProbeObj, "sections",
+        ("tracepoint/skb/kfree_skb", "fentry/tcp_rcv_established",
+         "kprobe/tcp_rcv_established"))
+    monkeypatch.setattr(_FakeProbeObj, "fail_load_sections", ("kprobe/",))
+    loader_mod, fake_self, cfg = _fake_probe_env(
+        monkeypatch, {"enable_pkt_drops": True})
+    loader_mod.LibbpfKernelFetcher._load_probes(
+        fake_self, cfg, str(tmp_path / "probes.bpf.o"), {})
+
+    # fentry tier (attach fail) -> kprobe tier (load fail) -> none tier (ok)
+    assert len(_FakeProbeObj.instances) == 3
+    final = _FakeProbeObj.instances[-1]
+    assert not final.closed and final.loaded
+    by_sec = {p.section: p for p in final.programs()}
+    assert by_sec["tracepoint/skb/kfree_skb"].attached
+    assert not by_sec["fentry/tcp_rcv_established"].autoload
+    assert not by_sec["kprobe/tcp_rcv_established"].autoload
+    assert len(fake_self._probe_links) == 1
+    # no link from the torn-down passes survives
+    for inst in _FakeProbeObj.instances[:-1]:
+        for p in inst.programs():
+            assert p.link is None or p.link.destroyed
+
+
+def test_probes_fentry_first_attach_order(monkeypatch, tmp_path):
+    """The fentry verdict comes before any other attach: a rerun must not
+    tear down links that other probes already established (the rerun's
+    teardown is then provably only fentry's own links)."""
+    order = []
+    real_attach = _FakeProbeProg.attach
+
+    def tracking_attach(self):
+        order.append(self.section)
+        return real_attach(self)
+
+    monkeypatch.setattr(_FakeProbeProg, "attach", tracking_attach)
+    monkeypatch.setattr(
+        _FakeProbeObj, "sections",
+        ("tracepoint/skb/kfree_skb", "fentry/tcp_rcv_established",
+         "kprobe/tcp_rcv_established"))
+    monkeypatch.setattr(_FakeProbeObj, "fail_attach_sections", ())
+    monkeypatch.setattr(_FakeProbeObj, "fail_load_sections", ())
+    loader_mod, fake_self, cfg = _fake_probe_env(
+        monkeypatch, {"enable_pkt_drops": True})
+    loader_mod.LibbpfKernelFetcher._load_probes(
+        fake_self, cfg, str(tmp_path / "probes.bpf.o"), {})
+    assert order[0] == "fentry/tcp_rcv_established"
+    assert len(fake_self._probe_links) == 2
