@@ -44,7 +44,10 @@ class _Columns(ctypes.Structure):
 _lib: Optional[ctypes.CDLL] = None
 
 
-_ABI_VERSION = 3
+_ABI_VERSION = 4
+
+#: dense TPU-feed row width (words); layout documented in flowpack.cc
+DENSE_WORDS = 16
 
 
 def _find_lib() -> Optional[ctypes.CDLL]:
@@ -135,6 +138,64 @@ def pack_events(events_raw: bytes | np.ndarray,
     overlay_features(b, n, extra=extra, dns=dns, drops=drops)
     b.valid[:n] = True
     return b
+
+
+def pack_dense(events_raw: bytes | np.ndarray,
+               batch_size: Optional[int] = None,
+               extra: Optional[np.ndarray] = None,
+               dns: Optional[np.ndarray] = None,
+               out: Optional[np.ndarray] = None,
+               use_native: Optional[bool] = None) -> np.ndarray:
+    """Raw flow-event buffer -> one (batch_size, DENSE_WORDS) u32 array, the
+    single-transfer TPU feed (row layout documented in flowpack.cc; unpacked
+    on-device by sketch.state.dense_to_arrays). Pass a preallocated `out` to
+    skip the per-batch allocation — the tail rows are zeroed either way, so a
+    reused buffer never leaks stale rows into the padding."""
+    if isinstance(events_raw, np.ndarray):
+        events = np.ascontiguousarray(events_raw, dtype=binfmt.FLOW_EVENT_DTYPE)
+    else:
+        events = binfmt.decode_flow_events(events_raw)
+    n = len(events)
+    batch_size = batch_size or max(n, 1)
+    if n > batch_size:
+        raise ValueError(f"{n} events exceed batch size {batch_size}")
+    if out is None:
+        out = np.empty((batch_size, DENSE_WORDS), dtype=np.uint32)
+    elif (out.shape != (batch_size, DENSE_WORDS)
+          or out.dtype != np.uint32 or not out.flags.c_contiguous):
+        raise ValueError("out must be C-contiguous (batch_size, 16) uint32")
+    def fit(arr, dtype):
+        # contiguous, exactly n rows (zero-padded) — the native loop indexes
+        # row i for every i < n, so a short array must never reach it
+        if arr is None or not len(arr):
+            return None
+        a = np.ascontiguousarray(arr[:n], dtype=dtype)
+        if len(a) < n:
+            a = np.concatenate([a, np.zeros(n - len(a), dtype)])
+        return np.ascontiguousarray(a)
+
+    ex = fit(extra, binfmt.EXTRA_REC_DTYPE)
+    dn = fit(dns, binfmt.DNS_REC_DTYPE)
+    if use_native is None:
+        use_native = native_available()
+    if use_native and native_available():
+        _lib.fp_pack_dense(
+            ctypes.c_void_p(events.ctypes.data), ctypes.c_size_t(n),
+            ctypes.c_void_p(ex.ctypes.data if ex is not None else None),
+            ctypes.c_void_p(dn.ctypes.data if dn is not None else None),
+            ctypes.c_void_p(out.ctypes.data), ctypes.c_size_t(batch_size))
+        return out
+    out[n:] = 0
+    if n:
+        stats = events["stats"]
+        out[:n, :10] = pack_key_words(events["key"])
+        out[:n, 10] = stats["bytes"].astype(np.float32).view(np.uint32)
+        out[:n, 11] = stats["packets"]
+        out[:n, 12] = ex["rtt_ns"] // 1000 if ex is not None else 0
+        out[:n, 13] = dn["latency_ns"] // 1000 if dn is not None else 0
+        out[:n, 14] = 1
+        out[:n, 15] = stats["sampling"]
+    return out
 
 
 _MERGE_FNS = {

@@ -539,7 +539,6 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         self._counters = syscall_bpf.BpfMap.create(
             self.BPF_MAP_TYPE_PERCPU_ARRAY, 4, 8, int(GlobalCounter.MAX),
             b"global_counters")
-        self._counters.n_cpus = self._n_cpus
         dns_q_fd = dns_rec_fd = None
         if enable_dns:
             self._dns_inflight = syscall_bpf.BpfMap.create(
@@ -548,7 +547,6 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             dns_rec = syscall_bpf.BpfMap.create(
                 self.BPF_MAP_TYPE_PERCPU_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
                 binfmt.DNS_REC_DTYPE.itemsize, cache_max_flows, b"flows_dns")
-            dns_rec.n_cpus = self._n_cpus
             self._features["dns"] = (dns_rec, binfmt.DNS_REC_DTYPE)
             dns_q_fd, dns_rec_fd = self._dns_inflight.fd, dns_rec.fd
         rtt_q_fd = rtt_rec_fd = None
@@ -560,7 +558,6 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 self.BPF_MAP_TYPE_PERCPU_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
                 binfmt.EXTRA_REC_DTYPE.itemsize, cache_max_flows,
                 b"flows_extra")
-            extra_rec.n_cpus = self._n_cpus
             self._features["extra"] = (extra_rec, binfmt.EXTRA_REC_DTYPE)
             rtt_q_fd, rtt_rec_fd = self._rtt_inflight.fd, extra_rec.fd
         # per-CPU sampling gate: only needed when sampling can skip packets
@@ -600,7 +597,6 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 binfmt.FLOW_KEY_DTYPE.itemsize,
                 binfmt.DROPS_REC_DTYPE.itemsize, cache_max_flows,
                 b"flows_drops")
-            drops_rec.n_cpus = self._n_cpus
             self._features["drops"] = (drops_rec, binfmt.DROPS_REC_DTYPE)
             self._attach_tracepoint(
                 asm_probes.build_drops_program(
@@ -616,7 +612,6 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 self.BPF_MAP_TYPE_PERCPU_HASH, binfmt.FLOW_KEY_DTYPE.itemsize,
                 binfmt.QUIC_REC_DTYPE.itemsize, cache_max_flows,
                 b"flows_quic")
-            quic_rec.n_cpus = self._n_cpus
             self._features["quic"] = (quic_rec, binfmt.QUIC_REC_DTYPE)
             quic_fd = quic_rec.fd
         flt_rules_fd = flt_peers_fd = None
@@ -1062,9 +1057,10 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
             h = obj.map(name)
             if h is None:
                 return None
-            bm = syscall_bpf.BpfMap(os.dup(h.fd), h.key_size, h.value_size,
-                                    h.max_entries)
-            bm.n_cpus = n_cpus
+            bm = syscall_bpf.BpfMap(
+                os.dup(h.fd), h.key_size, h.value_size, h.max_entries,
+                n_cpus=n_cpus,
+                percpu=h.type in syscall_bpf.PERCPU_MAP_TYPES)
             return bm
 
         ncpu = self._n_cpus

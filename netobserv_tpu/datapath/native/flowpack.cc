@@ -63,6 +63,52 @@ size_t fp_pack(const uint8_t *events, size_t n, struct fp_columns *out) {
     return n;
 }
 
+// Dense TPU feed: one (batch_size, FP_DENSE_WORDS) u32 row-major array per
+// batch instead of six column arrays — a single host->device transfer on a
+// tunneled/PCIe link instead of six round trips, and a single pass over the
+// raw event bytes (no intermediate FlowBatch, no Python copies). Row layout
+// (must match flowpack.py pack_dense/DENSE_WORDS and the device-side unpack
+// in sketch/state.py dense_to_arrays):
+//   words 0..9   packed key words (same packing as fp_pack)
+//   word  10     bytes as float32 bitcast (sketch planes are f32)
+//   word  11     packets
+//   word  12     rtt_us        (from the extra record, else 0)
+//   word  13     dns_latency_us (from the dns record, else 0)
+//   word  14     valid flag (1 for live rows; padding rows are all-zero)
+//   word  15     sampling
+#define FP_DENSE_WORDS 16
+
+void fp_pack_dense(const uint8_t *events, size_t n,
+                   const uint8_t *extra, const uint8_t *dns,
+                   uint32_t *out, size_t batch_size) {
+    const struct no_flow_event *ev =
+        reinterpret_cast<const struct no_flow_event *>(events);
+    const struct no_extra_rec *ex =
+        reinterpret_cast<const struct no_extra_rec *>(extra);
+    const struct no_dns_rec *dn =
+        reinterpret_cast<const struct no_dns_rec *>(dns);
+    for (size_t i = 0; i < n; i++) {
+        const struct no_flow_key *k = &ev[i].key;
+        const struct no_flow_stats *s = &ev[i].stats;
+        uint32_t *row = out + i * FP_DENSE_WORDS;
+        std::memcpy(row, k->src_ip, 16);      // words 0..3
+        std::memcpy(row + 4, k->dst_ip, 16);  // words 4..7
+        row[8] = (static_cast<uint32_t>(k->src_port) << 16) | k->dst_port;
+        row[9] = (static_cast<uint32_t>(k->proto) << 16) |
+                 (static_cast<uint32_t>(k->icmp_type) << 8) | k->icmp_code;
+        float b = static_cast<float>(s->bytes);
+        std::memcpy(&row[10], &b, 4);
+        row[11] = s->packets;
+        row[12] = ex ? static_cast<uint32_t>(ex[i].rtt_ns / 1000) : 0;
+        row[13] = dn ? static_cast<uint32_t>(dn[i].latency_ns / 1000) : 0;
+        row[14] = 1;
+        row[15] = s->sampling;
+    }
+    if (n < batch_size)
+        std::memset(out + n * FP_DENSE_WORDS, 0,
+                    (batch_size - n) * FP_DENSE_WORDS * sizeof(uint32_t));
+}
+
 static inline void merge_times(uint64_t *dfirst, uint64_t *dlast,
                                uint64_t sfirst, uint64_t slast) {
     if (*dfirst == 0 || (sfirst != 0 && sfirst < *dfirst))
@@ -329,6 +375,6 @@ uint32_t fp_crc32c(const uint8_t *data, size_t n) {
     return crc ^ 0xFFFFFFFFu;
 }
 
-uint32_t fp_abi_version(void) { return 3; }
+uint32_t fp_abi_version(void) { return 4; }
 
 }  // extern "C"

@@ -12,10 +12,13 @@ from __future__ import annotations
 import ctypes
 import ctypes.util
 import errno
+import logging
 import os
 import platform
 import struct
 from typing import Optional
+
+log = logging.getLogger("netobserv_tpu.datapath.syscall_bpf")
 
 # syscall numbers for bpf(2)
 _SYSCALL_TABLE = {
@@ -41,6 +44,16 @@ BPF_OBJ_PIN = 6
 BPF_OBJ_GET = 7
 BPF_MAP_LOOKUP_AND_DELETE_ELEM = 21
 BPF_OBJ_GET_INFO_BY_FD = 15
+BPF_MAP_LOOKUP_AND_DELETE_BATCH = 25  # only the delete variant is used here
+
+# per-CPU map types (kernel enum bpf_map_type): values cross the syscall
+# boundary at round_up(value_size, 8) per possible CPU
+PERCPU_MAP_TYPES = frozenset({5, 6, 21})  # PERCPU_HASH/PERCPU_ARRAY/LRU_PERCPU
+
+# kernel-internal "operation not supported" — what BPF_DO_BATCH returns when
+# the map type has no batch ops; distinct from errno.ENOTSUP (95) and has no
+# errno.h name, so spell it out
+ENOTSUPP_KERNEL = 524
 
 BPF_ANY = 0
 BPF_NOEXIST = 1
@@ -70,13 +83,20 @@ class BpfMap:
     """One open BPF map fd with typed key/value byte access."""
 
     def __init__(self, fd: int, key_size: int, value_size: int,
-                 max_entries: int = 0, n_cpus: int = 1):
+                 max_entries: int = 0, n_cpus: int = 1,
+                 percpu: bool = False):
         self.fd = fd
         self.key_size = key_size
         self.value_size = value_size
         self.max_entries = max_entries
-        self.n_cpus = n_cpus  # >1 for per-CPU maps (value is per-cpu array)
+        self.n_cpus = n_cpus  # per-CPU maps: values are per-cpu arrays
+        # per-CPU-ness must come from the map TYPE, not n_cpus>1: on a
+        # 1-CPU machine a per-CPU map still crosses the syscall boundary at
+        # the kernel's round_up(value_size, 8) stride
+        self.percpu = percpu
         self._no_lookup_and_delete = False  # latched capability probe
+        self._no_batch_ops = False          # latched (kernels < 5.6)
+        self._batch_bufs = None             # cached drain_batched buffers
 
     # --- constructors ---
     @classmethod
@@ -90,7 +110,13 @@ class BpfMap:
         attr += b"\x00" * 4  # numa_node
         attr += name[:15].ljust(16, b"\x00")
         fd = _bpf(BPF_MAP_CREATE, attr)
-        return cls(fd, key_size, value_size, max_entries)
+        percpu = map_type in PERCPU_MAP_TYPES
+        return cls(fd, key_size, value_size, max_entries,
+                   # per-CPU buffers must span every possible CPU from the
+                   # start — waiting for call sites to set n_cpus is how
+                   # value-buffer overruns happen
+                   n_cpus=n_possible_cpus() if percpu else 1,
+                   percpu=percpu)
 
     def pin(self, path: str) -> None:
         pathbuf = ctypes.create_string_buffer(path.encode() + b"\x00")
@@ -110,7 +136,7 @@ class BpfMap:
 
     @classmethod
     def open_pinned(cls, path: str, key_size: int, value_size: int,
-                    n_cpus: int = 1) -> "BpfMap":
+                    n_cpus: Optional[int] = None) -> "BpfMap":
         pathbuf = path.encode() + b"\x00"
         str_ptr = ctypes.create_string_buffer(pathbuf)
         attr = struct.pack("<Q", ctypes.addressof(str_ptr))
@@ -124,21 +150,46 @@ class BpfMap:
                 f"pinned map {path} layout mismatch: kernel has "
                 f"key={real_key}/value={real_value}, expected "
                 f"key={key_size}/value={value_size} (datapath version skew?)")
-        return cls(fd, key_size, value_size, _max_entries, n_cpus=n_cpus)
+        percpu = _mtype in PERCPU_MAP_TYPES
+        if n_cpus is None:
+            # per-CPU buffers must span every possible CPU from the start;
+            # relying on callers to pass n_cpus is how overruns happen
+            n_cpus = n_possible_cpus() if percpu else 1
+        return cls(fd, key_size, value_size, _max_entries, n_cpus=n_cpus,
+                   percpu=percpu)
 
     # --- element ops ---
+    # Per-CPU maps: the kernel transfers round_up(value_size, 8) bytes per
+    # CPU (kernel/bpf/syscall.c bpf_map_value_size) in BOTH directions —
+    # buffers must use the padded stride or copy_to_user overruns them for
+    # any non-8-aligned value struct. The public API keeps the unpadded
+    # value_size * n_cpus concatenation.
+    @property
+    def _pad_vs(self) -> int:
+        return ((self.value_size + 7) & ~7) if self.percpu \
+            else self.value_size
+
+    def _unpad_value(self, raw: bytes) -> bytes:
+        pad = self._pad_vs
+        if pad == self.value_size:
+            return raw[:self.value_size * self.n_cpus]
+        return b"".join(raw[c * pad:c * pad + self.value_size]
+                        for c in range(self.n_cpus))
+
     def _ptr_attr(self, key: bytes, value_buf=None, flags: int = 0) -> tuple:
         kbuf = ctypes.create_string_buffer(key, self.key_size)
-        vsize = self.value_size * self.n_cpus
         vbuf = value_buf if value_buf is not None else \
-            ctypes.create_string_buffer(vsize)
+            ctypes.create_string_buffer(self._pad_vs * self.n_cpus)
         attr = struct.pack("<IxxxxQQQ", self.fd, ctypes.addressof(kbuf),
                            ctypes.addressof(vbuf), flags)
         return attr, kbuf, vbuf
 
     def update(self, key: bytes, value: bytes, flags: int = BPF_ANY) -> None:
-        vsize = self.value_size * self.n_cpus
-        vbuf = ctypes.create_string_buffer(value, vsize)
+        pad, vs = self._pad_vs, self.value_size
+        if pad != vs and len(value) == vs * self.n_cpus:
+            value = b"".join(value[c * vs:(c + 1) * vs].ljust(pad, b"\x00")
+                             for c in range(self.n_cpus))
+        vbuf = ctypes.create_string_buffer(value, pad * self.n_cpus)
         attr, _k, _v = self._ptr_attr(key, vbuf, flags)
         _bpf(BPF_MAP_UPDATE_ELEM, attr)
 
@@ -150,7 +201,7 @@ class BpfMap:
             if exc.errno == errno.ENOENT:
                 return None
             raise
-        return vbuf.raw
+        return self._unpad_value(vbuf.raw)
 
     def lookup_and_delete(self, key: bytes) -> Optional[bytes]:
         attr, _k, vbuf = self._ptr_attr(key)
@@ -163,7 +214,7 @@ class BpfMap:
                 raise NotImplementedError(
                     "LOOKUP_AND_DELETE unsupported for this map/kernel") from exc
             raise
-        return vbuf.raw
+        return self._unpad_value(vbuf.raw)
 
     def delete(self, key: bytes) -> bool:
         kbuf = ctypes.create_string_buffer(key, self.key_size)
@@ -199,11 +250,97 @@ class BpfMap:
             key = self.next_key(key)
         return out
 
+    def drain_batched(self,
+                      chunk: int = 2048) -> Optional[list[tuple[bytes, bytes]]]:
+        """Bulk eviction via BPF_MAP_LOOKUP_AND_DELETE_BATCH: one syscall per
+        `chunk` entries instead of two per entry — the batched analog of the
+        reference's per-key eviction loop (`tracer.go:1022-1054`) and the
+        host-path seam its own benchmarks call hot. Returns None (latched)
+        when the kernel or map type doesn't support batch ops (< 5.6)."""
+        if self._no_batch_ops:
+            return None
+        # values cross at the padded per-CPU stride (see element ops above);
+        # returned values are re-packed to the unpadded concatenation
+        pad_vs = self._pad_vs
+        vstride = pad_vs * self.n_cpus
+        # no point sizing rounds past the map itself; buffers are cached on
+        # the object so steady-state eviction ticks don't re-zero hundreds
+        # of KB per drain
+        if self.max_entries:
+            chunk = min(chunk, self.max_entries)
+        out: list[tuple[bytes, bytes]] = []
+        # the batch token is opaque (u32 bucket cursor for hash maps); size
+        # it generously and let the kernel use what it needs
+        tok_a = ctypes.create_string_buffer(max(self.key_size, 8))
+        tok_b = ctypes.create_string_buffer(max(self.key_size, 8))
+        cached = self._batch_bufs
+        if cached is not None and cached[0] >= chunk:
+            _cap, kbuf, vbuf = cached  # reuse storage; keep caller's chunk
+        else:
+            kbuf = ctypes.create_string_buffer(self.key_size * chunk)
+            vbuf = ctypes.create_string_buffer(vstride * chunk)
+            self._batch_bufs = (chunk, kbuf, vbuf)
+        first = True
+        while True:
+            attr = bytearray(struct.pack(
+                "<QQQQIIQQ",
+                0 if first else ctypes.addressof(tok_a),
+                ctypes.addressof(tok_b),
+                ctypes.addressof(kbuf), ctypes.addressof(vbuf),
+                chunk, self.fd, 0, 0))
+            done = False
+            try:
+                _bpf_inout(BPF_MAP_LOOKUP_AND_DELETE_BATCH, attr)
+            except OSError as exc:
+                if exc.errno == errno.ENOENT:
+                    done = True          # iterated to the end; count is valid
+                elif exc.errno == errno.ENOSPC:
+                    # a single bucket holds more entries than `chunk`
+                    chunk *= 2
+                    kbuf = ctypes.create_string_buffer(self.key_size * chunk)
+                    vbuf = ctypes.create_string_buffer(vstride * chunk)
+                    self._batch_bufs = (chunk, kbuf, vbuf)
+                    continue
+                elif (first and not out
+                      and exc.errno in (errno.EINVAL, errno.EPERM,
+                                        errno.ENOTSUP, ENOTSUPP_KERNEL)):
+                    self._no_batch_ops = True
+                    return None
+                elif out:
+                    # entries in `out` are already DELETED from the kernel
+                    # map; raising would lose them for good (the per-key
+                    # idiom loses at most one). Return the partial drain —
+                    # the remainder is picked up next eviction tick.
+                    log.warning(
+                        "batched drain aborted mid-iteration after %d "
+                        "entries: %s (returning partial result)",
+                        len(out), exc)
+                    return out
+                else:
+                    raise
+            count = struct.unpack_from("<I", attr, 32)[0]
+            # one bounded copy per round (count entries), not the whole
+            # chunk-sized buffer
+            kraw = kbuf[:count * self.key_size]
+            vraw = vbuf[:count * vstride]
+            for i in range(count):
+                out.append(
+                    (kraw[i * self.key_size:(i + 1) * self.key_size],
+                     self._unpad_value(vraw[i * vstride:(i + 1) * vstride])))
+            if done or count == 0:
+                return out
+            ctypes.memmove(tok_a, tok_b, len(tok_b))
+            first = False
+
     def drain(self) -> list[tuple[bytes, bytes]]:
-        """Two-phase eviction: iterate keys, then lookup-and-delete each
-        (falling back to lookup+delete on old kernels, latched after the
-        first failure) — the reference's eviction idiom
+        """Eviction: batched lookup-and-delete when the kernel supports it,
+        else the two-phase per-key idiom (iterate keys, then lookup-and-
+        delete each, falling back to lookup+delete on old kernels, latched
+        after the first failure) — the reference's eviction loop
         (`tracer.go:1022-1054`, legacy `tracer_legacy.go:11-35`)."""
+        batched = self.drain_batched()
+        if batched is not None:
+            return batched
         out = []
         for key in self.keys():
             if self._no_lookup_and_delete:

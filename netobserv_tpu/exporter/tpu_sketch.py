@@ -23,8 +23,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from netobserv_tpu.datapath import flowpack
 from netobserv_tpu.exporter.base import Exporter
+from netobserv_tpu.sketch import staging
 from netobserv_tpu.model.columnar import FlowBatch, unpack_key_words
 from netobserv_tpu.model.flow import ip_from_16
 from netobserv_tpu.model.record import Record
@@ -174,13 +174,26 @@ class TpuSketchExporter(Exporter):
             self._pm = pmerge
             self._state = pmerge.init_dist_state(self._cfg, self._mesh)
             self._ingest = pmerge.make_sharded_ingest_fn(self._mesh, self._cfg)
+            ingest_dense = pmerge.make_sharded_ingest_fn(
+                self._mesh, self._cfg, dense=True, with_token=True)
+            dense_put = lambda buf: pmerge.shard_dense(  # noqa: E731
+                self._mesh, buf)
             self._roll = pmerge.make_merge_fn(self._mesh, self._cfg,
                                               decay_factor=decay_factor)
         else:
             self._ndata = 1
             self._state = sk.init_state(self._cfg)
             self._ingest = sk.make_ingest_fn(use_pallas=self._cfg.use_pallas)
+            ingest_dense = sk.make_ingest_dense_fn(
+                use_pallas=self._cfg.use_pallas, with_token=True)
+            dense_put = None
             self._roll = sk.make_roll_fn(self._cfg, decay_factor=decay_factor)
+        # dense host staging ring: packs the next batch while the previous
+        # transfers/ingests are in flight; its slot-reuse tokens also bound
+        # the async dispatch queue to the ring depth, so sustained overload
+        # backpressures the eviction loop (see sketch/staging.py)
+        self._ring = staging.DenseStagingRing(self._batch_size, ingest_dense,
+                                              put=dense_put)
         # restore prior sketch state if a checkpoint exists
         if self._ckpt is not None and self._ckpt.latest_step() is not None:
             self._state = self._ckpt.restore(self._state)
@@ -248,12 +261,13 @@ class TpuSketchExporter(Exporter):
         if not self._pending_ev:
             return
         events = np.concatenate([e.events for e in self._pending_ev])
+        # drops are not concatenated: the sketches never consume them (the
+        # dense feed carries exactly what the ingest reads — flowpack.cc
+        # layout), and this exporter is terminal for evictions
         extra = self._concat_feature(self._pending_ev, "extra",
                                      binfmt.EXTRA_REC_DTYPE)
         dns = self._concat_feature(self._pending_ev, "dns",
                                    binfmt.DNS_REC_DTYPE)
-        drops = self._concat_feature(self._pending_ev, "drops",
-                                     binfmt.DROPS_REC_DTYPE)
         bs = self._batch_size
 
         def sl(col, lo, hi):
@@ -262,31 +276,27 @@ class TpuSketchExporter(Exporter):
         off = 0
         while len(events) - off >= bs:
             self._fold_events(events[off:off + bs], sl(extra, off, off + bs),
-                              sl(dns, off, off + bs), sl(drops, off, off + bs))
+                              sl(dns, off, off + bs))
             off += bs
         rest = len(events) - off
         if rest and final:
             self._fold_events(events[off:], sl(extra, off, None),
-                              sl(dns, off, None), sl(drops, off, None))
+                              sl(dns, off, None))
             rest = 0
         if rest:
             self._pending_ev = [EvictedFlows(
                 events[off:], extra=sl(extra, off, None),
-                dns=sl(dns, off, None), drops=sl(drops, off, None))]
+                dns=sl(dns, off, None))]
             self._pending_ev_n = rest
         else:
             self._pending_ev = []
             self._pending_ev_n = 0
 
-    def _fold_events(self, events, extra, dns, drops) -> None:
+    def _fold_events(self, events, extra, dns) -> None:
         t0 = time.perf_counter()
         n = len(events)
-        batch = flowpack.pack_events(events, batch_size=self._batch_size,
-                                     extra=extra, dns=dns, drops=drops)
-        arrays = self._sk.batch_to_device(batch)
-        if self._distributed:
-            arrays = self._pm.shard_batch(self._mesh, arrays)
-        self._state = self._ingest(self._state, arrays)
+        self._state = self._ring.fold(self._state, events, extra=extra,
+                                      dns=dns)
         if self._metrics is not None:
             self._metrics.sketch_batches_total.inc()
             self._metrics.sketch_records_total.inc(n)

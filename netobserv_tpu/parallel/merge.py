@@ -84,31 +84,54 @@ def shard_batch(mesh: Mesh, arrays: dict[str, np.ndarray]) -> dict[str, jax.Arra
 
 
 def make_sharded_ingest_fn(mesh: Mesh, cfg: sk.SketchConfig,
-                           donate: bool = True) -> Callable:
-    """Jitted `(dist_state, batch_arrays) -> dist_state` over the mesh."""
+                           donate: bool = True,
+                           dense: bool = False,
+                           with_token: bool = False) -> Callable:
+    """Jitted `(dist_state, batch) -> dist_state` over the mesh.
+
+    `dense=False`: batch is the six-array dict. `dense=True`: batch is one
+    (B, 16) u32 flowpack dense array (row-sharded over the data axis, ONE
+    transfer per batch); each shard unpacks its rows locally — the unpack is
+    elementwise, so sharding it adds no collectives.
+
+    `with_token=True` (dense only) returns `(dist_state, token)`, the
+    slot-reuse guard for `sketch.staging.DenseStagingRing` (see
+    `sketch.state.make_ingest_dense_fn`)."""
+    if with_token and not dense:
+        raise ValueError("with_token requires dense=True")
     nsk = mesh.shape[SKETCH_AXIS]
     template = sk.init_state(cfg)
     specs = _state_specs(template)
 
-    def local_step(pstate: sk.SketchState, arrays: dict) -> sk.SketchState:
+    def local_step(pstate: sk.SketchState, batch):
         s = jax.tree.map(lambda x: x[0], pstate)  # drop the data-axis dim
+        arrays = sk.dense_to_arrays(batch) if dense else batch
         s = sk.ingest(s, arrays,
                       sketch_axis=SKETCH_AXIS if nsk > 1 else None,
                       sketch_shards=nsk,
                       # width-sharded sketches keep the masked-scatter path;
                       # the Pallas fold applies to whole-width replicas
                       use_pallas=cfg.use_pallas and nsk == 1)
-        return jax.tree.map(lambda x: x[None], s)
+        out = jax.tree.map(lambda x: x[None], s)
+        return (out, batch[:1, 0]) if with_token else out
 
+    batch_specs = (P(DATA_AXIS) if dense else
+                   _batch_specs({"keys": 0, "bytes": 0, "packets": 0,
+                                 "rtt_us": 0, "dns_latency_us": 0,
+                                 "valid": 0, "sampling": 0}))
     shmapped = jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(specs, _batch_specs({"keys": 0, "bytes": 0, "packets": 0,
-                                       "rtt_us": 0, "dns_latency_us": 0,
-                                       "valid": 0})),
-        out_specs=specs,
+        in_specs=(specs, batch_specs),
+        out_specs=(specs, P(DATA_AXIS)) if with_token else specs,
         check_vma=False,
     )
     return jax.jit(shmapped, donate_argnums=(0,) if donate else ())
+
+
+def shard_dense(mesh: Mesh, dense: np.ndarray) -> jax.Array:
+    """Place a flowpack dense batch onto the mesh, rows split over the data
+    axis, replicated over the sketch axis."""
+    return jax.device_put(dense, NamedSharding(mesh, P(DATA_AXIS)))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,73 @@
+"""Host->device staging ring for the dense flow feed.
+
+A small ring of preallocated host buffers lets eviction batch i+1 be packed
+(`flowpack.pack_dense`, single C++ pass) while batch i's host->device
+transfer and ingest are still in flight — the host-path pipelining that
+closes the seam the reference names as its own hot spot
+(`pkg/model/record_bench_test.go:10-14`).
+
+Slot-reuse safety: a slot is repacked only after the *ingest* that consumed
+it has finished, guarded by a token output of the jitted ingest (a tiny
+slice of the dense input; it becomes ready only when the whole executable
+has run). Blocking on the `device_put` result instead is NOT sufficient: on
+backends that zero-copy aligned host arrays (the CPU backend), the put
+result is "ready" immediately while the async-dispatched ingest may still be
+reading the aliased host memory.
+
+Depth: 2 slots stall the pipeline on tunneled links; 4 reach ~82% of the
+pack+put ceiling (measured on the axon chip, see PARITY.md).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from netobserv_tpu.datapath import flowpack
+
+
+class DenseStagingRing:
+    """Reusable host buffers + in-flight tokens for the dense ingest path.
+
+    `ingest` must be a token-returning jitted fn — built with
+    `sketch.state.make_ingest_dense_fn(with_token=True)` or
+    `parallel.merge.make_sharded_ingest_fn(dense=True, with_token=True)` —
+    i.e. `(state, dense) -> (state, token)`. `put` places a packed host
+    buffer on device(s); defaults to `jax.device_put` (single device).
+    """
+
+    def __init__(self, batch_size: int, ingest: Callable,
+                 put: Optional[Callable] = None, n_slots: int = 4):
+        import jax
+
+        self.batch_size = batch_size
+        self._ingest = ingest
+        self._put = put or jax.device_put
+        self._bufs = [np.empty((batch_size, flowpack.DENSE_WORDS), np.uint32)
+                      for _ in range(n_slots)]
+        self._tokens: list = [None] * n_slots
+        self._slot = 0
+
+    def fold(self, state, events, extra=None, dns=None):
+        """Pack `events` into the next free slot, ship it, ingest it; returns
+        the new sketch state (async — not blocked on)."""
+        import jax
+
+        slot = self._slot
+        tok = self._tokens[slot]
+        if tok is not None:
+            jax.block_until_ready(tok)  # slot's last consumer has finished
+        buf = flowpack.pack_dense(events, batch_size=self.batch_size,
+                                  extra=extra, dns=dns, out=self._bufs[slot])
+        state, self._tokens[slot] = self._ingest(state, self._put(buf))
+        self._slot = (slot + 1) % len(self._bufs)
+        return state
+
+    def drain(self) -> None:
+        """Block until every in-flight batch has been fully ingested (host
+        buffers are then free; used before checkpoint/window close)."""
+        import jax
+
+        for tok in self._tokens:
+            if tok is not None:
+                jax.block_until_ready(tok)

@@ -107,6 +107,24 @@ def batch_to_device(batch: FlowBatch) -> dict[str, np.ndarray]:
         "rtt_us": batch.rtt_us.astype(np.int32),
         "dns_latency_us": batch.dns_latency_us.astype(np.int32),
         "valid": batch.valid.astype(np.bool_),
+        "sampling": batch.sampling.astype(np.int32),
+    }
+
+
+def dense_to_arrays(dense: jax.Array) -> dict[str, jax.Array]:
+    """Device-side unpack of the flowpack dense feed — one (B, 16) u32 array
+    per batch means ONE host->device transfer instead of six (the transfer
+    link, not compute, bounds the host path on tunneled/PCIe chips). Row
+    layout is pinned in flowpack.cc fp_pack_dense; traceable under jit, and
+    XLA fuses the slices/bitcasts into the consuming scatter."""
+    return {
+        "keys": dense[:, :KEY_WORDS],
+        "bytes": jax.lax.bitcast_convert_type(dense[:, 10], jnp.float32),
+        "packets": dense[:, 11].astype(jnp.int32),
+        "rtt_us": dense[:, 12].astype(jnp.int32),
+        "dns_latency_us": dense[:, 13].astype(jnp.int32),
+        "valid": dense[:, 14] != 0,
+        "sampling": dense[:, 15].astype(jnp.int32),
     }
 
 
@@ -128,6 +146,15 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     valid = arrays["valid"]
     bytes_f = arrays["bytes"]
     pkts = arrays["packets"]
+    samp = arrays.get("sampling")
+    if samp is not None:
+        # de-bias sampled traffic: a 1-in-N sampled flow record stands for N
+        # flows' worth of volume (reference scales at the collector via the
+        # exported Sampling field; sketches must fold the scaled estimate or
+        # heavy-hitter/volume numbers undercount). 0 = unsampled.
+        factor = jnp.maximum(samp, 1)
+        bytes_f = bytes_f * factor.astype(jnp.float32)
+        pkts = pkts * factor
 
     h1, h2 = hashing.base_hashes(words)
     src_h1, src_h2 = hashing.base_hashes(words[:, 0:4], seed=0x0517)
@@ -182,6 +209,25 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
 def make_ingest_fn(donate: bool = True, use_pallas: bool = False):
     """Jitted ingest; donates the state buffers so updates are in-place on HBM."""
     fn = lambda s, a: ingest(s, a, use_pallas=use_pallas)  # noqa: E731
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
+def make_ingest_dense_fn(donate: bool = True, use_pallas: bool = False,
+                         with_token: bool = False):
+    """Jitted `(state, dense (B,16)u32) -> state` — the single-transfer host
+    feed path (see dense_to_arrays / flowpack.pack_dense).
+
+    `with_token=True` returns `(state, token)` where token is a tiny slice of
+    the dense input: it becomes ready only once the whole ingest executable
+    has finished reading the (possibly host-aliased) input buffer — the
+    slot-reuse guard for `sketch.staging.DenseStagingRing`."""
+    if with_token:
+        def fn(s, d):
+            return ingest(s, dense_to_arrays(d),
+                          use_pallas=use_pallas), d[0, :1]
+    else:
+        fn = lambda s, d: ingest(s, dense_to_arrays(d),  # noqa: E731
+                                 use_pallas=use_pallas)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 

@@ -59,6 +59,7 @@ def main() -> None:
         "packets": rng.integers(1, 10, n).astype(np.int32),
         "rtt_us": rng.integers(0, 5_000, n).astype(np.int32),
         "dns_latency_us": rng.integers(0, 100, n).astype(np.int32),
+        "sampling": np.zeros(n, np.int32),
         "valid": np.ones(n, np.bool_),
     }
     dist = ingest_fn(dist, pmerge.shard_batch(mesh, arrays))

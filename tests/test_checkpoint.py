@@ -22,6 +22,7 @@ def test_roundtrip_single_device(tmp_path):
         "packets": jnp.ones(16, jnp.int32),
         "rtt_us": jnp.zeros(16, jnp.int32),
         "dns_latency_us": jnp.zeros(16, jnp.int32),
+        "sampling": jnp.zeros(16, jnp.int32),
         "valid": jnp.ones(16, jnp.bool_),
     }
     s = sk.ingest(s, arrays)
@@ -43,6 +44,7 @@ def test_roundtrip_distributed(tmp_path):
         "packets": np.ones(64, np.int32),
         "rtt_us": np.zeros(64, np.int32),
         "dns_latency_us": np.zeros(64, np.int32),
+        "sampling": np.zeros(64, np.int32),
         "valid": np.ones(64, np.bool_),
     }
     ingest_fn = pmerge.make_sharded_ingest_fn(mesh, CFG, donate=False)

@@ -45,6 +45,98 @@ class TestPack:
         assert batch.n_valid == 0
 
 
+class TestPackDense:
+    def _extra_dns(self, n):
+        extra = np.zeros(n, dtype=binfmt.EXTRA_REC_DTYPE)
+        extra["rtt_ns"] = np.arange(n, dtype=np.uint64) * 123_000
+        dns = np.zeros(n, dtype=binfmt.DNS_REC_DTYPE)
+        dns["latency_ns"] = np.arange(n, dtype=np.uint64) * 77_000
+        return extra, dns
+
+    def test_native_matches_numpy(self, native):
+        events = _events()
+        extra, dns = self._extra_dns(len(events))
+        a = flowpack.pack_dense(events, batch_size=32, extra=extra, dns=dns,
+                                use_native=True)
+        b = flowpack.pack_dense(events, batch_size=32, extra=extra, dns=dns,
+                                use_native=False)
+        np.testing.assert_array_equal(a, b)
+
+    def test_matches_column_path(self, native):
+        """The dense rows must carry exactly what batch_to_device exposes —
+        the single shared definition the ingest consumes either way."""
+        from netobserv_tpu.sketch import state as sk
+
+        events = _events()
+        extra, dns = self._extra_dns(len(events))
+        dense = flowpack.pack_dense(events, batch_size=32, extra=extra,
+                                    dns=dns)
+        batch = flowpack.pack_events(events, batch_size=32, extra=extra,
+                                     dns=dns)
+        arrays = sk.batch_to_device(batch)
+        np.testing.assert_array_equal(dense[:, :10], arrays["keys"])
+        np.testing.assert_array_equal(dense[:, 10].view(np.float32),
+                                      arrays["bytes"])
+        np.testing.assert_array_equal(dense[:, 11].astype(np.int32),
+                                      arrays["packets"])
+        np.testing.assert_array_equal(dense[:, 12].astype(np.int32),
+                                      arrays["rtt_us"])
+        np.testing.assert_array_equal(dense[:, 13].astype(np.int32),
+                                      arrays["dns_latency_us"])
+        np.testing.assert_array_equal(dense[:, 14] != 0, arrays["valid"])
+        np.testing.assert_array_equal(dense[:, 15].astype(np.int32),
+                                      arrays["sampling"])
+
+    def test_reused_out_buffer_zeroes_padding(self, native):
+        """A preallocated out buffer is fully overwritten: stale rows from a
+        bigger previous batch must never survive as phantom valid rows."""
+        out = np.full((32, flowpack.DENSE_WORDS), 0xAB, np.uint32)
+        flowpack.pack_dense(_events(20), batch_size=32, out=out)
+        assert out[20:, 14].sum() == 0          # padding invalid
+        assert (out[20:] == 0).all()
+        dense2 = flowpack.pack_dense(_events(3), batch_size=32, out=out)
+        assert dense2 is out
+        assert (out[3:] == 0).all()
+
+    def test_short_feature_arrays_padded(self, native):
+        """extra/dns arrays shorter than the event count must not OOB-read
+        (native) or broadcast-fail (numpy): missing tail rows read as 0."""
+        events = _events(8)
+        extra, dns = self._extra_dns(3)
+        for un in (True, False):
+            dense = flowpack.pack_dense(events, batch_size=8, extra=extra,
+                                        dns=dns, use_native=un)
+            assert (dense[3:, 12] == 0).all() and (dense[3:, 13] == 0).all()
+            assert dense[2, 12] == 2 * 123 and dense[2, 13] == 2 * 77
+
+    def test_empty(self, native):
+        dense = flowpack.pack_dense(b"", batch_size=4)
+        assert (dense == 0).all()
+
+    def test_ingest_dense_equals_dict_ingest(self, native):
+        """Folding the dense feed must produce bit-identical sketch state to
+        the six-array dict path (same ingest, different transport)."""
+        import jax
+
+        from netobserv_tpu.sketch import state as sk
+
+        events = _events(17)
+        extra, dns = self._extra_dns(17)
+        cfg = sk.SketchConfig(cm_width=1 << 10, topk=64)
+        batch = flowpack.pack_events(events, batch_size=32, extra=extra,
+                                     dns=dns)
+        arrays = sk.batch_to_device(batch)
+        s_dict = jax.jit(sk.ingest)(sk.init_state(cfg), arrays)
+        dense = flowpack.pack_dense(events, batch_size=32, extra=extra,
+                                    dns=dns)
+        s_dense = sk.make_ingest_dense_fn(donate=False)(
+            sk.init_state(cfg), dense)
+        for name in sk.SketchState._fields:
+            da, db = getattr(s_dict, name), getattr(s_dense, name)
+            jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), da, db)
+
+
 class TestMergePercpu:
     @pytest.mark.parametrize(
         "kind", ["stats", "extra", "drops", "dns", "nevents", "xlat", "quic"])
@@ -130,3 +222,73 @@ class TestMergePercpu:
         out = flowpack.merge_percpu("stats", vals, use_native=True)
         assert int(out["bytes"]) == 2**64 - 1  # saturated
         assert int(out["n_observed_intf"]) == 2  # 3 deduped, 9 appended
+
+
+class TestStagingRing:
+    def test_ring_matches_sequential_ingest(self, native):
+        """Folding batches through the 4-slot staging ring (buffer reuse +
+        async dispatch) must produce the same state as sequential dict-path
+        ingest — slot reuse must never let a later batch overwrite rows an
+        in-flight ingest still needs."""
+        import jax
+
+        from netobserv_tpu.sketch import state as sk
+        from netobserv_tpu.sketch.staging import DenseStagingRing
+
+        cfg = sk.SketchConfig(cm_width=1 << 10, topk=64)
+        batches = []
+        for s in range(11):
+            ev = _events(32)
+            ev["key"]["src_port"] = 2000 + 37 * s + np.arange(32)
+            batches.append(ev)
+
+        ring = DenseStagingRing(
+            32, sk.make_ingest_dense_fn(donate=False, with_token=True))
+        s_ring = sk.init_state(cfg)
+        for ev in batches:
+            s_ring = ring.fold(s_ring, ev)
+        ring.drain()
+
+        ingest = jax.jit(sk.ingest)
+        s_ref = sk.init_state(cfg)
+        for ev in batches:
+            arrays = sk.batch_to_device(
+                flowpack.pack_events(ev, batch_size=32))
+            s_ref = ingest(s_ref, arrays)
+
+        for name in sk.SketchState._fields:
+            jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+                getattr(s_ring, name), getattr(s_ref, name))
+
+
+class TestSamplingDebias:
+    def test_sampled_volume_scaled(self):
+        """A 1-in-N sampled flow must fold as N flows' worth of bytes/packets
+        (reference semantics: the Sampling field scales collector-side
+        estimates); unsampled (0) and 1:1 fold unscaled."""
+        import jax
+        import jax.numpy as jnp
+
+        from netobserv_tpu.sketch import state as sk
+
+        cfg = sk.SketchConfig(cm_width=1 << 10, topk=16)
+        base = {
+            "keys": np.arange(80, dtype=np.uint32).reshape(8, 10),
+            "bytes": np.full(8, 100.0, np.float32),
+            "packets": np.full(8, 3, np.int32),
+            "rtt_us": np.zeros(8, np.int32),
+            "dns_latency_us": np.zeros(8, np.int32),
+            "valid": np.ones(8, np.bool_),
+        }
+        ingest = jax.jit(sk.ingest)
+        s0 = ingest(sk.init_state(cfg),
+                    {**base, "sampling": np.zeros(8, np.int32)})
+        s1 = ingest(sk.init_state(cfg),
+                    {**base, "sampling": np.full(8, 4, np.int32)})
+        assert float(s1.total_bytes) == 4 * float(s0.total_bytes)
+        assert float(s1.total_records) == float(s0.total_records)  # observed
+        np.testing.assert_array_equal(np.asarray(s1.cm_bytes.counts),
+                                      4 * np.asarray(s0.cm_bytes.counts))
+        np.testing.assert_array_equal(np.asarray(s1.cm_pkts.counts),
+                                      4 * np.asarray(s0.cm_pkts.counts))

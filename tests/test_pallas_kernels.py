@@ -65,6 +65,7 @@ def test_full_ingest_pallas_matches_default():
         "packets": jnp.ones(512, jnp.int32),
         "rtt_us": jnp.zeros(512, jnp.int32),
         "dns_latency_us": jnp.zeros(512, jnp.int32),
+        "sampling": jnp.zeros(512, jnp.int32),
         "valid": jnp.ones(512, jnp.bool_),
     }
     import jax

@@ -28,6 +28,7 @@ def make_arrays(n, rng, n_distinct=200):
         "packets": rng.integers(1, 10, n).astype(np.int32),
         "rtt_us": rng.integers(0, 5_000, n).astype(np.int32),
         "dns_latency_us": rng.integers(0, 100, n).astype(np.int32),
+        "sampling": np.zeros(n, np.int32),
         "valid": np.ones(n, np.bool_),
     }
 
@@ -87,6 +88,44 @@ def test_sharded_matches_single_device(mesh_shape):
         assert got_counts[k] == pytest.approx(ref_counts[k], rel=1e-5)
 
 
+def arrays_to_dense(arrays):
+    """Inverse transport: the same batch in flowpack's (B,16)u32 dense form."""
+    from netobserv_tpu.datapath.flowpack import DENSE_WORDS
+
+    n = len(arrays["valid"])
+    dense = np.zeros((n, DENSE_WORDS), np.uint32)
+    dense[:, :KW] = arrays["keys"]
+    dense[:, 10] = arrays["bytes"].view(np.uint32)
+    dense[:, 11] = arrays["packets"]
+    dense[:, 12] = arrays["rtt_us"]
+    dense[:, 13] = arrays["dns_latency_us"]
+    dense[:, 14] = arrays["valid"]
+    return dense
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
+def test_sharded_dense_matches_dict_transport(mesh_shape):
+    """The dense (single-transfer) sharded ingest must produce the same
+    distributed state as the six-array dict transport — same ingest math,
+    different wire format."""
+    ndata, nsk = mesh_shape
+    if ndata * nsk > len(jax.devices()):
+        pytest.skip("not enough devices")
+    rng = np.random.default_rng(7)
+    arrays = make_arrays(ndata * 128, rng, n_distinct=24)
+
+    mesh = make_mesh(MeshSpec(data=ndata, sketch=nsk))
+    ingest_dict = pmerge.make_sharded_ingest_fn(mesh, CFG, donate=False)
+    ingest_dense = pmerge.make_sharded_ingest_fn(mesh, CFG, donate=False,
+                                                 dense=True)
+    d1 = ingest_dict(pmerge.init_dist_state(CFG, mesh),
+                     pmerge.shard_batch(mesh, arrays))
+    d2 = ingest_dense(pmerge.init_dist_state(CFG, mesh),
+                      pmerge.shard_dense(mesh, arrays_to_dense(arrays)))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), d1, d2)
+
+
 def test_topk_recall_skewed():
     """On zipf-skewed traffic (the realistic heavy-hitter regime) the merged
     distributed table recalls the true global top keys."""
@@ -101,6 +140,7 @@ def test_topk_recall_skewed():
         "packets": np.ones(n, np.int32),
         "rtt_us": np.zeros(n, np.int32),
         "dns_latency_us": np.zeros(n, np.int32),
+        "sampling": np.zeros(n, np.int32),
         "valid": np.ones(n, np.bool_),
     }
     exact: dict[int, float] = {}
@@ -156,3 +196,56 @@ def test_ddos_alarm_travels_through_merge():
     dist = ingest_fn(dist, pmerge.shard_batch(mesh, attack))
     dist, report = merge_fn(dist)
     assert bool((report.ddos_z > 6.0).any())
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
+def test_staging_ring_sharded_dense_token(mesh_shape):
+    """The production distributed exporter combination — DenseStagingRing +
+    sharded dense ingest with reuse tokens + shard_dense placement — must
+    match the dict-transport sharded ingest across multiple folds (slot reuse
+    under async dispatch included)."""
+    from netobserv_tpu.datapath import flowpack
+    from netobserv_tpu.model import binfmt
+    from netobserv_tpu.sketch.staging import DenseStagingRing
+
+    ndata, nsk = mesh_shape
+    if ndata * nsk > len(jax.devices()):
+        pytest.skip("not enough devices")
+    rng = np.random.default_rng(11)
+    bs = ndata * 64
+
+    def random_batch(n):
+        ev = np.zeros(n, dtype=binfmt.FLOW_EVENT_DTYPE)
+        ev["key"]["src_ip"] = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+        ev["key"]["dst_ip"] = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+        ev["key"]["src_port"] = rng.integers(1, 1 << 16, n)
+        ev["key"]["dst_port"] = rng.integers(1, 1 << 16, n)
+        ev["key"]["proto"] = rng.integers(0, 256, n)
+        ev["stats"]["bytes"] = rng.integers(1, 10_000, n)
+        ev["stats"]["packets"] = rng.integers(1, 10, n)
+        extra = np.zeros(n, dtype=binfmt.EXTRA_REC_DTYPE)
+        extra["rtt_ns"] = rng.integers(0, 5_000, n, dtype=np.uint64) * 1000
+        dns = np.zeros(n, dtype=binfmt.DNS_REC_DTYPE)
+        dns["latency_ns"] = rng.integers(0, 100, n, dtype=np.uint64) * 1000
+        return ev, extra, dns
+
+    batches = [random_batch(bs) for _ in range(9)]
+
+    mesh = make_mesh(MeshSpec(data=ndata, sketch=nsk))
+    ingest_tok = pmerge.make_sharded_ingest_fn(mesh, CFG, donate=False,
+                                               dense=True, with_token=True)
+    ring = DenseStagingRing(bs, ingest_tok,
+                            put=lambda buf: pmerge.shard_dense(mesh, buf))
+    s_ring = pmerge.init_dist_state(CFG, mesh)
+    for ev, extra, dns in batches:
+        s_ring = ring.fold(s_ring, ev, extra=extra, dns=dns)
+    ring.drain()
+
+    ingest_dict = pmerge.make_sharded_ingest_fn(mesh, CFG, donate=False)
+    s_ref = pmerge.init_dist_state(CFG, mesh)
+    for ev, extra, dns in batches:
+        batch = flowpack.pack_events(ev, batch_size=bs, extra=extra, dns=dns)
+        arrays = sk.batch_to_device(batch)
+        s_ref = ingest_dict(s_ref, pmerge.shard_batch(mesh, arrays))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), s_ring, s_ref)

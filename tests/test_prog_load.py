@@ -7,6 +7,7 @@ whole load/attach/count path against the live kernel with zero compilers
 involved. Skipped without CAP_BPF/CAP_NET_ADMIN.
 """
 
+import errno
 import os
 import shutil
 import struct
@@ -99,3 +100,133 @@ def test_count_real_packets_over_veth(veth_pair):
         counter.close()
         if os.path.exists(pin):
             os.unlink(pin)
+
+
+class TestDrainBatched:
+    """Batched eviction (BPF_MAP_LOOKUP_AND_DELETE_BATCH) against the live
+    kernel, plus the capability-probe fallbacks for kernels/maps without
+    batch ops."""
+
+    def _filled_hash(self, n=300):
+        m = sb.BpfMap.create(1, 4, 8, 1024, b"dr")  # BPF_MAP_TYPE_HASH
+        for i in range(n):
+            m.update(struct.pack("<I", i), struct.pack("<Q", i * 7))
+        return m
+
+    def test_batched_drain_evicts_all(self):
+        m = self._filled_hash()
+        try:
+            got = m.drain()
+            assert not m._no_batch_ops  # this kernel has batch ops
+            assert len(got) == 300
+            pairs = {struct.unpack("<I", k)[0]: struct.unpack("<Q", v)[0]
+                     for k, v in got}
+            assert pairs == {i: i * 7 for i in range(300)}
+            assert m.keys() == []  # drained == deleted
+        finally:
+            m.close()
+
+    def test_small_chunk_multiple_rounds(self):
+        m = self._filled_hash()
+        try:
+            got = m.drain_batched(chunk=16)
+            assert got is not None and len(got) == 300
+            assert m.keys() == []
+        finally:
+            m.close()
+
+    def test_enotsupp_524_latches_and_falls_back(self, monkeypatch):
+        """A map type without batch ops makes BPF_DO_BATCH return the
+        kernel-internal ENOTSUPP (524, not errno.ENOTSUP=95); drain() must
+        latch the incapability and fall back to the per-key idiom instead of
+        propagating OSError out of the eviction loop."""
+        m = self._filled_hash(50)
+        try:
+            def deny(cmd, attr):
+                raise OSError(sb.ENOTSUPP_KERNEL, "Unknown error 524")
+            monkeypatch.setattr(sb, "_bpf_inout", deny)
+            got = m.drain()
+            assert m._no_batch_ops        # latched: no retry per eviction
+            assert len(got) == 50         # per-key fallback still evicted all
+            assert m.keys() == []
+        finally:
+            m.close()
+
+    def test_batched_drain_percpu(self):
+        """Per-CPU hash maps drain through the batch op too; values come back
+        in the same value_size*n_cpus concatenation as the per-key path."""
+        ncpu = sb.n_possible_cpus()
+        m = sb.BpfMap.create(5, 4, 8, 256, b"drp")  # BPF_MAP_TYPE_PERCPU_HASH
+        try:
+            for i in range(40):
+                val = b"".join(struct.pack("<Q", i * 100 + c)
+                               for c in range(ncpu))
+                m.update(struct.pack("<I", i), val)
+            got = m.drain()
+            assert not m._no_batch_ops
+            assert len(got) == 40
+            for k, v in got:
+                i = struct.unpack("<I", k)[0]
+                assert len(v) == 8 * ncpu
+                per_cpu = [struct.unpack_from("<Q", v, c * 8)[0]
+                           for c in range(ncpu)]
+                assert per_cpu == [i * 100 + c for c in range(ncpu)]
+            assert m.keys() == []
+        finally:
+            m.close()
+
+    def test_percpu_unaligned_value_roundtrip(self, monkeypatch):
+        """Non-8-aligned per-CPU values cross the syscall boundary at the
+        kernel's round_up(value_size, 8) stride; the API must still speak the
+        unpadded value_size*n_cpus concatenation on BOTH the batched and the
+        per-key fallback paths (sizing buffers at the raw stride would be a
+        heap overrun)."""
+        ncpu = sb.n_possible_cpus()
+        for deny_batch in (False, True):
+            m = sb.BpfMap.create(5, 4, 12, 64, b"dru")  # 12B percpu values
+            try:
+                if deny_batch:
+                    monkeypatch.setattr(
+                        sb, "_bpf_inout",
+                        lambda cmd, attr: (_ for _ in ()).throw(
+                            OSError(sb.ENOTSUPP_KERNEL, "no batch ops")))
+                vals = {}
+                for i in range(20):
+                    val = b"".join(struct.pack("<QI", i * 100 + c, i)
+                                   for c in range(ncpu))
+                    m.update(struct.pack("<I", i), val)
+                    vals[i] = val
+                # single lookup round-trips unpadded
+                got_one = m.lookup(struct.pack("<I", 7))
+                assert got_one == vals[7]
+                got = m.drain()
+                assert m._no_batch_ops == deny_batch
+                assert len(got) == 20
+                for k, v in got:
+                    assert v == vals[struct.unpack("<I", k)[0]]
+                assert m.keys() == []
+            finally:
+                monkeypatch.undo()
+                m.close()
+
+    def test_mid_iteration_error_returns_partial(self, monkeypatch):
+        """Entries already deleted by earlier rounds must be RETURNED when a
+        later round fails (e.g. kernel ENOMEM) — raising would silently lose
+        evicted flows."""
+        m = self._filled_hash(200)
+        real = sb._bpf_inout
+        calls = {"n": 0}
+
+        def flaky(cmd, attr):
+            calls["n"] += 1
+            if calls["n"] >= 3:
+                raise OSError(errno.ENOMEM, "kernel copy buffer alloc failed")
+            return real(cmd, attr)
+
+        monkeypatch.setattr(sb, "_bpf_inout", flaky)
+        got = m.drain_batched(chunk=16)
+        assert got is not None and 16 <= len(got) < 200
+        assert not m._no_batch_ops      # transient error, capability intact
+        # the remainder is still in the map for the next eviction tick
+        assert len(m.keys()) == 200 - len(got)
+        m.close()

@@ -149,6 +149,7 @@ def test_sketch_ingest_thread_safety(n_threads):
             "packets": np.ones(256, np.int32),
             "rtt_us": np.zeros(256, np.int32),
             "dns_latency_us": np.zeros(256, np.int32),
+            "sampling": np.zeros(256, np.int32),
             "valid": np.ones(256, np.bool_),
         })
     errors = []
