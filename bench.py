@@ -127,13 +127,20 @@ def host_path_rate(seconds: float = 3.0) -> float:
     from netobserv_tpu.datapath import flowpack
     from netobserv_tpu.datapath.replay import SyntheticFetcher
     from netobserv_tpu.sketch import state as sk
-    from netobserv_tpu.sketch.staging import DenseStagingRing
+    from netobserv_tpu.sketch.staging import DenseStagingRing, default_spill_cap
 
     flowpack.build_native()
     cfg = sk.SketchConfig()
     state = sk.init_state(cfg)
+    # production single-chip configuration: v4-compact feed + dense fallback
+    spill_cap = default_spill_cap(BATCH)
     ring = DenseStagingRing(
-        BATCH, sk.make_ingest_dense_fn(donate=True, with_token=True))
+        BATCH,
+        sk.make_ingest_compact_fn(BATCH, spill_cap, donate=True,
+                                  with_token=True),
+        spill_cap=spill_cap,
+        ingest_fallback=sk.make_ingest_dense_fn(donate=True,
+                                                with_token=True))
     fetcher = SyntheticFetcher(flows_per_eviction=BATCH, n_distinct=N_DISTINCT)
     # pre-generate evictions and concatenate into FULL batches, the way the
     # exporter accumulates them (padding only at window close); the load

@@ -77,8 +77,7 @@ class InterfaceListener:
             except queue.Empty:
                 continue
             self._registerer.observe(event)
-            if self._metrics is not None:
-                self._metrics.count_interface_event(event.type.value)
+            self._count_attach(event.type.value, event.interface, 0)
             iface = event.interface
             if event.type == EventType.ADDED:
                 if not self._filter.allowed(iface):
@@ -93,6 +92,17 @@ class InterfaceListener:
                 except Exception as exc:
                     log.debug("detach %s failed: %s", iface.name, exc)
 
+    def _count_attach(self, kind: str, iface, attempt: int) -> None:
+        # reference counts attach_tc/attach_tcx/attach_fail with the attempt
+        # number (interfaces_listener.go:192-247); level gates cardinality,
+        # so the mac string is only built when trace level will expose it
+        if self._metrics is not None:
+            mac = (":".join(f"{b:02x}" for b in iface.mac)
+                   if self._metrics.level == "trace" else "")
+            self._metrics.count_interface_event(
+                kind, ifname=iface.name, ifindex=iface.index,
+                netns=iface.netns, mac=mac, retries=attempt)
+
     def _attach_with_retry(self, iface) -> None:
         retries = max(self._cfg.tc_attach_retries, 1)
         for attempt in range(1, retries + 1):
@@ -102,14 +112,17 @@ class InterfaceListener:
                 self._fetcher.attach(iface.index, iface.name,
                                      self._cfg.direction, netns=iface.netns)
                 self.attached.add((iface.netns, iface.index))
+                self._count_attach("attach", iface, attempt)
                 log.info("attached to %s (index %d, netns %r)", iface.name,
                          iface.index, iface.netns)
                 return
             except DoNotRetryError as exc:
+                self._count_attach("attach_fail", iface, attempt)
                 log.warning("attach %s failed permanently: %s",
                             iface.name, exc)
                 return
             except Exception as exc:
+                self._count_attach("attach_fail", iface, attempt)
                 log.warning("attach %s failed (attempt %d/%d): %s",
                             iface.name, attempt, retries, exc)
                 time.sleep(_RETRY_BACKOFF_S * attempt)

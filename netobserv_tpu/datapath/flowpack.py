@@ -44,10 +44,19 @@ class _Columns(ctypes.Structure):
 _lib: Optional[ctypes.CDLL] = None
 
 
-_ABI_VERSION = 4
+_ABI_VERSION = 5
 
 #: dense TPU-feed row width (words); layout documented in flowpack.cc
 DENSE_WORDS = 16
+#: compact (v4) TPU-feed row width; layout documented in flowpack.cc
+COMPACT_WORDS = 9
+#: bytes 8..11 of a v4-in-v6 mapped address as a LE u32
+_V4_PREFIX_WORD2 = 0xFFFF0000
+
+
+def compact_buf_len(batch_size: int, spill_cap: int) -> int:
+    """Flat word count of a compact feed buffer: compact lane + spill lane."""
+    return batch_size * COMPACT_WORDS + spill_cap * DENSE_WORDS
 
 
 def _find_lib() -> Optional[ctypes.CDLL]:
@@ -140,6 +149,17 @@ def pack_events(events_raw: bytes | np.ndarray,
     return b
 
 
+def _fit_rows(arr, n, dtype):
+    """Contiguous, exactly n rows (zero-padded) — the native pack loops index
+    row i for every i < n, so a short array must never reach them."""
+    if arr is None or not len(arr):
+        return None
+    a = np.ascontiguousarray(arr[:n], dtype=dtype)
+    if len(a) < n:
+        a = np.concatenate([a, np.zeros(n - len(a), dtype)])
+    return np.ascontiguousarray(a)
+
+
 def pack_dense(events_raw: bytes | np.ndarray,
                batch_size: Optional[int] = None,
                extra: Optional[np.ndarray] = None,
@@ -164,18 +184,8 @@ def pack_dense(events_raw: bytes | np.ndarray,
     elif (out.shape != (batch_size, DENSE_WORDS)
           or out.dtype != np.uint32 or not out.flags.c_contiguous):
         raise ValueError("out must be C-contiguous (batch_size, 16) uint32")
-    def fit(arr, dtype):
-        # contiguous, exactly n rows (zero-padded) — the native loop indexes
-        # row i for every i < n, so a short array must never reach it
-        if arr is None or not len(arr):
-            return None
-        a = np.ascontiguousarray(arr[:n], dtype=dtype)
-        if len(a) < n:
-            a = np.concatenate([a, np.zeros(n - len(a), dtype)])
-        return np.ascontiguousarray(a)
-
-    ex = fit(extra, binfmt.EXTRA_REC_DTYPE)
-    dn = fit(dns, binfmt.DNS_REC_DTYPE)
+    ex = _fit_rows(extra, n, binfmt.EXTRA_REC_DTYPE)
+    dn = _fit_rows(dns, n, binfmt.DNS_REC_DTYPE)
     if use_native is None:
         use_native = native_available()
     if use_native and native_available():
@@ -195,6 +205,90 @@ def pack_dense(events_raw: bytes | np.ndarray,
         out[:n, 13] = dn["latency_ns"] // 1000 if dn is not None else 0
         out[:n, 14] = 1
         out[:n, 15] = stats["sampling"]
+    return out
+
+
+def pack_compact(events_raw: bytes | np.ndarray,
+                 batch_size: int,
+                 spill_cap: int,
+                 extra: Optional[np.ndarray] = None,
+                 dns: Optional[np.ndarray] = None,
+                 out: Optional[np.ndarray] = None,
+                 use_native: Optional[bool] = None) -> Optional[np.ndarray]:
+    """Raw flow-event buffer -> ONE flat u32 buffer
+    `[batch_size*9 compact v4 rows | spill_cap*16 dense rows]` — the
+    low-bytes-per-record TPU feed for v4-dominant traffic (the transfer
+    link, not compute, bounds the host path; a v4 key needs 4 words, not
+    10). Non-v4 flows go to the spill lane; returns None when they exceed
+    `spill_cap` (caller falls back to pack_dense for that batch). Layout is
+    pinned in flowpack.cc fp_pack_compact; device unpack is
+    sketch.state.compact_to_arrays."""
+    if isinstance(events_raw, np.ndarray):
+        events = np.ascontiguousarray(events_raw, dtype=binfmt.FLOW_EVENT_DTYPE)
+    else:
+        events = binfmt.decode_flow_events(events_raw)
+    n = len(events)
+    if n > batch_size:
+        raise ValueError(f"{n} events exceed batch size {batch_size}")
+    total = compact_buf_len(batch_size, spill_cap)
+    if out is None:
+        out = np.empty(total, dtype=np.uint32)
+    elif (out.shape != (total,) or out.dtype != np.uint32
+          or not out.flags.c_contiguous):
+        raise ValueError(f"out must be C-contiguous ({total},) uint32")
+
+    ex = _fit_rows(extra, n, binfmt.EXTRA_REC_DTYPE)
+    dn = _fit_rows(dns, n, binfmt.DNS_REC_DTYPE)
+    if use_native is None:
+        use_native = native_available()
+    if use_native and native_available():
+        _lib.fp_pack_compact.restype = ctypes.c_int
+        ns = _lib.fp_pack_compact(
+            ctypes.c_void_p(events.ctypes.data), ctypes.c_size_t(n),
+            ctypes.c_void_p(ex.ctypes.data if ex is not None else None),
+            ctypes.c_void_p(dn.ctypes.data if dn is not None else None),
+            ctypes.c_void_p(out.ctypes.data), ctypes.c_size_t(batch_size),
+            ctypes.c_size_t(spill_cap))
+        return None if ns < 0 else out
+    # numpy twin (layout oracle for the native path)
+    comp = out[:batch_size * COMPACT_WORDS].reshape(batch_size, COMPACT_WORDS)
+    spill = out[batch_size * COMPACT_WORDS:].reshape(spill_cap, DENSE_WORDS)
+    comp[:] = 0
+    spill[:] = 0
+    if not n:
+        return out
+    kw = pack_key_words(events["key"])
+    stats = events["stats"]
+    is4 = ((kw[:, 0] == 0) & (kw[:, 1] == 0)
+           & (kw[:, 2] == _V4_PREFIX_WORD2)
+           & (kw[:, 4] == 0) & (kw[:, 5] == 0)
+           & (kw[:, 6] == _V4_PREFIX_WORD2))
+    n_sp = int((~is4).sum())
+    if n_sp > spill_cap:
+        return None
+    rtt = (ex["rtt_ns"] // 1000).astype(np.uint32) if ex is not None \
+        else np.zeros(n, np.uint32)
+    dlat = (dn["latency_ns"] // 1000).astype(np.uint32) if dn is not None \
+        else np.zeros(n, np.uint32)
+    c = comp[:int(is4.sum())]
+    c[:, 0] = kw[is4, 3]
+    c[:, 1] = kw[is4, 7]
+    c[:, 2] = kw[is4, 8]
+    c[:, 3] = kw[is4, 9] | np.uint32(0x80000000)
+    c[:, 4] = stats["bytes"][is4].astype(np.float32).view(np.uint32)
+    c[:, 5] = stats["packets"][is4]
+    c[:, 6] = rtt[is4]
+    c[:, 7] = dlat[is4]
+    c[:, 8] = stats["sampling"][is4]
+    if n_sp:
+        s = spill[:n_sp]
+        s[:, :10] = kw[~is4]
+        s[:, 10] = stats["bytes"][~is4].astype(np.float32).view(np.uint32)
+        s[:, 11] = stats["packets"][~is4]
+        s[:, 12] = rtt[~is4]
+        s[:, 13] = dlat[~is4]
+        s[:, 14] = 1
+        s[:, 15] = stats["sampling"][~is4]
     return out
 
 

@@ -109,6 +109,87 @@ void fp_pack_dense(const uint8_t *events, size_t n,
                     (batch_size - n) * FP_DENSE_WORDS * sizeof(uint32_t));
 }
 
+// Compact TPU feed: the host->device link (not compute) bounds the host
+// path, so shrink bytes/record. IPv4 flows (v4-in-v6 mapped keys, RFC 4038
+// — the common case) collapse their 10 key words to 4; non-v4 rows spill to
+// a small full-width (FP_DENSE_WORDS) side lane. One flat buffer:
+//   [batch_size * 9 compact words | spill_cap * 16 dense words]
+// Compact row (must match sketch/state.py compact_to_arrays):
+//   w0 src_v4 (key word 3)   w1 dst_v4 (key word 7)   w2 ports (src<<16|dst)
+//   w3 bit31 = valid, low 24 = proto<<16|icmp_type<<8|icmp_code
+//   w4 bytes f32 bitcast     w5 packets     w6 rtt_us     w7 dns_latency_us
+//   w8 sampling
+// Returns the number of spill rows used, or -1 if spill_cap would overflow
+// (caller falls back to the full dense pack for that batch).
+#define FP_COMPACT_WORDS 9
+#define FP_V4_PREFIX_WORD2 0xffff0000u  // bytes 8..11 of a mapped address
+
+static inline bool is_v4_mapped(const uint8_t *ip16) {
+    uint32_t w0, w1, w2;
+    std::memcpy(&w0, ip16, 4);
+    std::memcpy(&w1, ip16 + 4, 4);
+    std::memcpy(&w2, ip16 + 8, 4);
+    return w0 == 0 && w1 == 0 && w2 == FP_V4_PREFIX_WORD2;
+}
+
+int fp_pack_compact(const uint8_t *events, size_t n,
+                    const uint8_t *extra, const uint8_t *dns,
+                    uint32_t *out, size_t batch_size, size_t spill_cap) {
+    const struct no_flow_event *ev =
+        reinterpret_cast<const struct no_flow_event *>(events);
+    const struct no_extra_rec *ex =
+        reinterpret_cast<const struct no_extra_rec *>(extra);
+    const struct no_dns_rec *dn =
+        reinterpret_cast<const struct no_dns_rec *>(dns);
+    uint32_t *spill = out + batch_size * FP_COMPACT_WORDS;
+    size_t nc = 0, ns = 0;
+    for (size_t i = 0; i < n; i++) {
+        const struct no_flow_key *k = &ev[i].key;
+        const struct no_flow_stats *s = &ev[i].stats;
+        uint32_t rtt = ex ? static_cast<uint32_t>(ex[i].rtt_ns / 1000) : 0;
+        uint32_t dlat = dn ? static_cast<uint32_t>(dn[i].latency_ns / 1000) : 0;
+        if (is_v4_mapped(k->src_ip) && is_v4_mapped(k->dst_ip)) {
+            uint32_t *row = out + nc * FP_COMPACT_WORDS;
+            std::memcpy(&row[0], k->src_ip + 12, 4);
+            std::memcpy(&row[1], k->dst_ip + 12, 4);
+            row[2] = (static_cast<uint32_t>(k->src_port) << 16) | k->dst_port;
+            row[3] = 0x80000000u | (static_cast<uint32_t>(k->proto) << 16) |
+                     (static_cast<uint32_t>(k->icmp_type) << 8) | k->icmp_code;
+            float b = static_cast<float>(s->bytes);
+            std::memcpy(&row[4], &b, 4);
+            row[5] = s->packets;
+            row[6] = rtt;
+            row[7] = dlat;
+            row[8] = s->sampling;
+            nc++;
+        } else {
+            if (ns >= spill_cap)
+                return -1;
+            uint32_t *row = spill + ns * FP_DENSE_WORDS;
+            std::memcpy(row, k->src_ip, 16);
+            std::memcpy(row + 4, k->dst_ip, 16);
+            row[8] = (static_cast<uint32_t>(k->src_port) << 16) | k->dst_port;
+            row[9] = (static_cast<uint32_t>(k->proto) << 16) |
+                     (static_cast<uint32_t>(k->icmp_type) << 8) | k->icmp_code;
+            float b = static_cast<float>(s->bytes);
+            std::memcpy(&row[10], &b, 4);
+            row[11] = s->packets;
+            row[12] = rtt;
+            row[13] = dlat;
+            row[14] = 1;
+            row[15] = s->sampling;
+            ns++;
+        }
+    }
+    if (nc < batch_size)
+        std::memset(out + nc * FP_COMPACT_WORDS, 0,
+                    (batch_size - nc) * FP_COMPACT_WORDS * sizeof(uint32_t));
+    if (ns < spill_cap)
+        std::memset(spill + ns * FP_DENSE_WORDS, 0,
+                    (spill_cap - ns) * FP_DENSE_WORDS * sizeof(uint32_t));
+    return static_cast<int>(ns);
+}
+
 static inline void merge_times(uint64_t *dfirst, uint64_t *dlast,
                                uint64_t sfirst, uint64_t slast) {
     if (*dfirst == 0 || (sfirst != 0 && sfirst < *dfirst))
@@ -375,6 +456,6 @@ uint32_t fp_crc32c(const uint8_t *data, size_t n) {
     return crc ^ 0xFFFFFFFFu;
 }
 
-uint32_t fp_abi_version(void) { return 4; }
+uint32_t fp_abi_version(void) { return 5; }
 
 }  // extern "C"

@@ -4,11 +4,23 @@ Mirrors the reference's registry (`pkg/metrics/metrics.go:66-162`): eviction
 counters/sizes, dropped flows, ringbuf events, kernel global counters, buffer
 gauges, interface events, eviction-latency histogram, sampling gauge, errors by
 severity — all behind a configurable prefix and verbosity level.
+
+METRICS_LEVEL controls interface-event cardinality exactly like the
+reference's `newInterfaceEventsCounter` (`pkg/metrics/metrics.go:337-368`):
+
+- ``info``  — only the event ``type`` label is populated
+- ``debug`` — ``type`` + attach ``retries``
+- ``trace`` (spelled ``trace!`` in the reference, accepted here too: the
+  bang warns the cardinality is unbounded) — full per-interface series
+  (``ifname``/``ifindex``/``netns``/``mac``) that SELF-EXPIRE after
+  ``trace_ttl_s`` via a janitor thread, bounding steady-state cardinality.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from dataclasses import dataclass
 
 from prometheus_client import (
@@ -26,6 +38,14 @@ LEVELS = ("info", "debug", "trace")
 class MetricsSettings:
     prefix: str = "ebpf_agent_"
     level: str = "info"
+    trace_ttl_s: float = 300.0  # trace-level series lifetime (reference: 5min)
+
+    def normalized_level(self) -> str:
+        lvl = self.level.rstrip("!").lower()  # reference spells trace "trace!"
+        if lvl not in LEVELS:
+            raise ValueError(
+                f"invalid METRICS_LEVEL {self.level!r} (one of {LEVELS})")
+        return lvl
 
 
 class Metrics:
@@ -34,7 +54,12 @@ class Metrics:
     def __init__(self, settings: MetricsSettings = MetricsSettings(),
                  registry: CollectorRegistry | None = None):
         self.settings = settings
+        self.level = settings.normalized_level()
         self.registry = registry if registry is not None else CollectorRegistry()
+        # per-series LATEST deadline — an increment refreshes the TTL
+        self._trace_expiry: dict[tuple[str, ...], float] = {}
+        self._trace_lock = threading.Lock()
+        self._trace_janitor: threading.Thread | None = None
         p = settings.prefix
 
         self.evictions_total = Counter(
@@ -71,7 +96,8 @@ class Metrics:
             registry=self.registry)
         self.interface_events_total = Counter(
             p + "interface_events_total", "Interface attach/detach events",
-            ["type"], registry=self.registry)
+            ["type", "ifname", "ifindex", "netns", "mac", "retries"],
+            registry=self.registry)
         self.sampling_rate = Gauge(
             p + "sampling_rate", "Configured sampling (1/N; 0=all)",
             registry=self.registry)
@@ -124,5 +150,60 @@ class Metrics:
     def count_error(self, component: str, severity: str = "error") -> None:
         self.errors_total.labels(component, severity).inc()
 
-    def count_interface_event(self, kind: str) -> None:
-        self.interface_events_total.labels(kind).inc()
+    def count_interface_event(self, kind: str, ifname: str = "",
+                              ifindex: int = 0, netns: str = "",
+                              mac: str = "", retries: int = 0) -> None:
+        """Level-gated cardinality, mirroring the reference's
+        `newInterfaceEventsCounter` (`pkg/metrics/metrics.go:337-368`):
+        info = type only; debug = + retries; trace = full per-interface
+        series that self-expire after `trace_ttl_s`."""
+        if self.level == "info":
+            self.interface_events_total.labels(kind, "", "", "", "", "").inc()
+        elif self.level == "debug":
+            self.interface_events_total.labels(
+                kind, "", "", "", "", str(retries)).inc()
+        else:
+            labels = (kind, ifname, str(ifindex), netns, mac, str(retries))
+            # refresh the deadline BEFORE incrementing: the janitor re-checks
+            # deadlines under the lock at removal time, so an increment can
+            # never be swallowed by a concurrent expiry
+            self._schedule_trace_expiry(labels)
+            self.interface_events_total.labels(*labels).inc()
+
+    def _schedule_trace_expiry(self, labels: tuple[str, ...]) -> None:
+        """Trace-level series have unbounded cardinality (one per interface
+        identity); a single janitor thread removes each series trace_ttl_s
+        after its LAST increment — re-incrementing refreshes the deadline
+        (reference: per-series 5-minute goroutine)."""
+        deadline = time.monotonic() + self.settings.trace_ttl_s
+        with self._trace_lock:
+            self._trace_expiry[labels] = deadline
+            if self._trace_janitor is None:
+                self._trace_janitor = threading.Thread(
+                    target=self._trace_janitor_loop, name="metrics-trace-ttl",
+                    daemon=True)
+                self._trace_janitor.start()
+
+    def _trace_janitor_loop(self) -> None:
+        while True:
+            with self._trace_lock:
+                now = time.monotonic()
+                due = [l for l, d in self._trace_expiry.items() if d <= now]
+                for labels in due:
+                    del self._trace_expiry[labels]
+            for labels in due:
+                with self._trace_lock:
+                    if labels in self._trace_expiry:
+                        continue  # refreshed since collection — keep it
+                    try:
+                        self.interface_events_total.remove(*labels)
+                    except KeyError:
+                        pass  # raced with registry-level removal
+            with self._trace_lock:
+                if not self._trace_expiry:
+                    # nothing left to expire: exit so an idle Metrics (and
+                    # its registry) can be GC'd; the next trace increment
+                    # restarts the janitor
+                    self._trace_janitor = None
+                    return
+            time.sleep(min(self.settings.trace_ttl_s / 4, 5.0))

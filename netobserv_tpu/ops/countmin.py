@@ -103,45 +103,52 @@ def merge(a: CountMin, b: CountMin) -> CountMin:
 
 
 # ---------------------------------------------------------------------------
-# Width-sharded variants: the [d, W] counter array is split column-wise across
-# the `sketch` mesh axis (model-parallel sketches — SURVEY.md §2.3 mapping).
-# Each device owns counts[:, j*w_local:(j+1)*w_local]; updates mask out-of-shard
-# indices, queries psum masked partial gathers over the axis.
+# Width-sharded variants: the [d, W] counter array is split across the
+# `sketch` mesh axis by KEY OWNERSHIP (model-parallel sketches — SURVEY.md
+# §2.3 mapping). An independent hash assigns every key to one shard; the
+# owner folds the key's ENTIRE depth into its local [d, W/nsk] subtable.
+# Owner-locality is the point: a shard can point-query its own keys with NO
+# collective — which is what lets the steady-state ingest (top-K candidate
+# scoring, sketch/state.py) run collective-free on 2D meshes. The psum query
+# exists only for the window-roll merge. Per-key error matches an unsharded
+# width-W sketch: each shard holds ~1/nsk of the keys in 1/nsk of the
+# columns, so counter load (keys per column) is unchanged.
 # ---------------------------------------------------------------------------
+
+def owner_shard(h1: jax.Array, h2: jax.Array, n_shards: int) -> jax.Array:
+    """Which sketch shard owns each key — an independent hash of the 64-bit
+    key identity (decorrelated from the column hashes)."""
+    return (hashing.fmix32(h1 ^ (h2 * jnp.uint32(0x9E3779B1)))
+            % jnp.uint32(n_shards)).astype(jnp.int32)
+
 
 def update_sharded(cm_local: CountMin, h1: jax.Array, h2: jax.Array,
                    values: jax.Array, valid: jax.Array,
                    axis_name: str, n_shards: int) -> CountMin:
-    """Fold a batch into a width-sharded sketch (call inside shard_map)."""
-    d, w_local = cm_local.counts.shape
-    w_global = w_local * n_shards
+    """Fold a batch into an owner-sharded sketch (call inside shard_map):
+    each shard accumulates only the keys it owns, at full depth."""
     shard = jax.lax.axis_index(axis_name).astype(jnp.int32)
-    idx = hashing.row_indices(h1, h2, d, w_global).astype(jnp.int32)  # [d, B]
-    local_idx = idx - shard * w_local
-    in_shard = (local_idx >= 0) & (local_idx < w_local)
-    vals = jnp.where(valid, values, 0).astype(cm_local.counts.dtype)
-    vals = jnp.where(in_shard, vals[None, :], 0)
-    rows = jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32)[:, None], idx.shape)
-    new = cm_local.counts.at[rows, jnp.clip(local_idx, 0, w_local - 1)].add(
-        vals, mode="drop", unique_indices=False)
-    return CountMin(counts=new)
+    mine = valid & (owner_shard(h1, h2, n_shards) == shard)
+    return update(cm_local, h1, h2, values, mine)
+
+
+def query_sharded_local(cm_local: CountMin, h1: jax.Array, h2: jax.Array,
+                        axis_name: str, n_shards: int) -> jax.Array:
+    """Collective-free point query: complete estimates for keys THIS shard
+    owns, -1 (dead) for everyone else's. The steady-state scoring primitive."""
+    shard = jax.lax.axis_index(axis_name).astype(jnp.int32)
+    mine = owner_shard(h1, h2, n_shards) == shard
+    return jnp.where(mine, query(cm_local, h1, h2), -1.0)
 
 
 def query_sharded(cm_local: CountMin, h1: jax.Array, h2: jax.Array,
                   axis_name: str, n_shards: int) -> jax.Array:
-    """Point query against a width-sharded sketch (call inside shard_map)."""
-    d, w_local = cm_local.counts.shape
-    w_global = w_local * n_shards
+    """Exact point query against an owner-sharded sketch (one psum; used at
+    window roll, never on the per-batch path)."""
     shard = jax.lax.axis_index(axis_name).astype(jnp.int32)
-    idx = hashing.row_indices(h1, h2, d, w_global).astype(jnp.int32)
-    local_idx = idx - shard * w_local
-    in_shard = (local_idx >= 0) & (local_idx < w_local)
-    rows = jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32)[:, None], idx.shape)
-    part = jnp.where(in_shard,
-                     cm_local.counts[rows, jnp.clip(local_idx, 0, w_local - 1)],
-                     0)
-    ests = jax.lax.psum(part, axis_name)  # exactly one shard owns each index
-    return jnp.min(ests, axis=0)
+    mine = owner_shard(h1, h2, n_shards) == shard
+    part = jnp.where(mine, query(cm_local, h1, h2), 0.0)
+    return jax.lax.psum(part, axis_name)  # exactly one shard owns each key
 
 
 def total(cm: CountMin) -> jax.Array:

@@ -37,12 +37,15 @@ from netobserv_tpu.sketch import state as sk
 
 def _state_specs(state: sk.SketchState) -> sk.SketchState:
     """PartitionSpec tree for the distributed state (leading data axis added;
-    Count-Min width additionally split over the sketch axis)."""
+    Count-Min width additionally split over the sketch axis; the top-K table
+    carries a SECOND leading sketch-axis dim — owner-sharded scoring makes
+    each sketch shard's table a distinct key set, not a replica)."""
     d = P(DATA_AXIS)
+    h = P(DATA_AXIS, SKETCH_AXIS)
     return sk.SketchState(
         cm_bytes=countmin.CountMin(counts=P(DATA_AXIS, None, SKETCH_AXIS)),
         cm_pkts=countmin.CountMin(counts=P(DATA_AXIS, None, SKETCH_AXIS)),
-        heavy=topk.TopK(words=d, h1=d, h2=d, counts=d, valid=d),
+        heavy=topk.TopK(words=h, h1=h, h2=h, counts=h, valid=h),
         hll_src=hll.HLL(regs=d),
         hll_per_dst=hll.PerDstHLL(regs=d),
         hist_rtt=quantile.LogHist(counts=d),
@@ -52,6 +55,19 @@ def _state_specs(state: sk.SketchState) -> sk.SketchState:
     )
 
 
+def _drop_lead(pstate: sk.SketchState) -> sk.SketchState:
+    """Local (inside-shard_map) view: drop the data-axis dim everywhere and
+    the extra sketch-axis dim on the top-K table."""
+    s = jax.tree.map(lambda x: x[0], pstate)
+    return s._replace(heavy=jax.tree.map(lambda x: x[0], s.heavy))
+
+
+def _add_lead(s: sk.SketchState) -> sk.SketchState:
+    """Inverse of _drop_lead."""
+    out = jax.tree.map(lambda x: x[None], s)
+    return out._replace(heavy=jax.tree.map(lambda x: x[None], out.heavy))
+
+
 def _batch_specs(arrays: dict) -> dict:
     return {k: P(DATA_AXIS) for k in arrays}
 
@@ -59,11 +75,16 @@ def _batch_specs(arrays: dict) -> dict:
 def init_dist_state(cfg: sk.SketchConfig, mesh: Mesh) -> sk.SketchState:
     """Per-device partial sketch state, zeros, laid out across the mesh."""
     ndata = mesh.shape[DATA_AXIS]
+    nsk = mesh.shape[SKETCH_AXIS]
     template = sk.init_state(cfg)
     specs = _state_specs(template)
 
     def place(leaf, spec):
-        arr = np.zeros((ndata,) + leaf.shape, dtype=leaf.dtype)
+        # top-K leaves (spec P(data, sketch)) carry a SECOND lead dim: one
+        # distinct owner-sharded table per (data, sketch) device
+        lead = (ndata, nsk) if (len(spec) >= 2 and spec[1] == SKETCH_AXIS) \
+            else (ndata,)
+        arr = np.zeros(lead + leaf.shape, dtype=leaf.dtype)
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     return jax.tree.map(place, template, specs)
@@ -104,16 +125,18 @@ def make_sharded_ingest_fn(mesh: Mesh, cfg: sk.SketchConfig,
     specs = _state_specs(template)
 
     def local_step(pstate: sk.SketchState, batch):
-        s = jax.tree.map(lambda x: x[0], pstate)  # drop the data-axis dim
+        s = _drop_lead(pstate)
         arrays = sk.dense_to_arrays(batch) if dense else batch
         s = sk.ingest(s, arrays,
                       sketch_axis=SKETCH_AXIS if nsk > 1 else None,
                       sketch_shards=nsk,
-                      # width-sharded sketches keep the masked-scatter path;
+                      # owner-sharded sketches keep the masked-scatter path;
                       # the Pallas fold applies to whole-width replicas
                       use_pallas=cfg.use_pallas and nsk == 1)
-        out = jax.tree.map(lambda x: x[None], s)
-        return (out, batch[:1, 0]) if with_token else out
+        out = _add_lead(s)
+        if with_token:
+            return out, (batch[:1] if batch.ndim == 1 else batch[:1, 0])
+        return out
 
     batch_specs = (P(DATA_AXIS) if dense else
                    _batch_specs({"keys": 0, "bytes": 0, "packets": 0,
@@ -130,7 +153,9 @@ def make_sharded_ingest_fn(mesh: Mesh, cfg: sk.SketchConfig,
 
 def shard_dense(mesh: Mesh, dense: np.ndarray) -> jax.Array:
     """Place a flowpack dense batch onto the mesh, rows split over the data
-    axis, replicated over the sketch axis."""
+    axis, replicated over the sketch axis. Accepts (B, 16) rows or the flat
+    (B*16,) form the staging ring ships (a contiguous flat split lands on
+    row boundaries because B divides evenly over the data axis)."""
     return jax.device_put(dense, NamedSharding(mesh, P(DATA_AXIS)))
 
 
@@ -144,12 +169,19 @@ def merge_states(s: sk.SketchState, nsk: int) -> sk.SketchState:
     arrays here are local slices without the data-axis dim)."""
     cm_b = countmin.CountMin(jax.lax.psum(s.cm_bytes.counts, DATA_AXIS))
     cm_p = countmin.CountMin(jax.lax.psum(s.cm_pkts.counts, DATA_AXIS))
+
+    def gather(x):
+        # owner-sharded tables hold DISJOINT key sets per sketch shard, so
+        # the candidate pool must be gathered over BOTH mesh axes
+        x = jax.lax.all_gather(x, DATA_AXIS, axis=0, tiled=True)
+        if nsk > 1:
+            x = jax.lax.all_gather(x, SKETCH_AXIS, axis=0, tiled=True)
+        return x
+
     stacked = topk.TopK(
-        words=jax.lax.all_gather(s.heavy.words, DATA_AXIS, axis=0, tiled=True),
-        h1=jax.lax.all_gather(s.heavy.h1, DATA_AXIS, axis=0, tiled=True),
-        h2=jax.lax.all_gather(s.heavy.h2, DATA_AXIS, axis=0, tiled=True),
-        counts=jax.lax.all_gather(s.heavy.counts, DATA_AXIS, axis=0, tiled=True),
-        valid=jax.lax.all_gather(s.heavy.valid, DATA_AXIS, axis=0, tiled=True),
+        words=gather(s.heavy.words), h1=gather(s.heavy.h1),
+        h2=gather(s.heavy.h2), counts=gather(s.heavy.counts),
+        valid=gather(s.heavy.valid),
     )
     if nsk > 1:
         qfn = lambda a, b: countmin.query_sharded(  # noqa: E731
@@ -193,7 +225,7 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
     )
 
     def local_roll(pstate: sk.SketchState):
-        s = jax.tree.map(lambda x: x[0], pstate)
+        s = _drop_lead(pstate)
         merged = merge_states(s, nsk)
         ddos_state, z = ewma.roll(merged.ddos, cfg.ewma_alpha)
         gamma = quantile.gamma_for(merged.hist_rtt.n_buckets)
@@ -225,7 +257,7 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
             )
         else:
             new = s._replace(ddos=ddos_state, window=s.window + 1)
-        return jax.tree.map(lambda x: x[None], new), report
+        return _add_lead(new), report
 
     shmapped = jax.shard_map(
         local_roll, mesh=mesh, in_specs=(specs,),

@@ -26,6 +26,13 @@ import numpy as np
 from netobserv_tpu.datapath import flowpack
 
 
+def default_spill_cap(batch_size: int) -> int:
+    """Production spill-lane sizing for the compact feed: 1/8 of the batch
+    (v6-heavy batches beyond it fall back to the dense feed). Bench and the
+    exporter share this so the measured configuration is the shipped one."""
+    return max(batch_size // 8, 64)
+
+
 class DenseStagingRing:
     """Reusable host buffers + in-flight tokens for the dense ingest path.
 
@@ -34,17 +41,35 @@ class DenseStagingRing:
     `parallel.merge.make_sharded_ingest_fn(dense=True, with_token=True)` —
     i.e. `(state, dense) -> (state, token)`. `put` places a packed host
     buffer on device(s); defaults to `jax.device_put` (single device).
+
+    Compact mode (`spill_cap` set, single-device only): slots hold the flat
+    v4-compact feed (`flowpack.pack_compact`, ~40% of the dense bytes —
+    the transfer link is the host path's bottleneck) and `ingest` must be a
+    `make_ingest_compact_fn(with_token=True)` jit. Batches whose non-v4
+    flows overflow the spill lane fall back to the dense feed through
+    `ingest_fallback` (a `make_ingest_dense_fn(with_token=True)` jit) —
+    same math, bigger transfer, synchronously drained (rare path).
     """
 
     def __init__(self, batch_size: int, ingest: Callable,
-                 put: Optional[Callable] = None, n_slots: int = 4):
+                 put: Optional[Callable] = None, n_slots: int = 4,
+                 spill_cap: Optional[int] = None,
+                 ingest_fallback: Optional[Callable] = None):
         import jax
 
         self.batch_size = batch_size
+        self.spill_cap = spill_cap
         self._ingest = ingest
+        self._ingest_fallback = ingest_fallback
         self._put = put or jax.device_put
-        self._bufs = [np.empty((batch_size, flowpack.DENSE_WORDS), np.uint32)
-                      for _ in range(n_slots)]
+        if spill_cap is not None:
+            shape: tuple = (flowpack.compact_buf_len(batch_size, spill_cap),)
+            if ingest_fallback is None:
+                raise ValueError("compact mode needs ingest_fallback")
+        else:
+            shape = (batch_size, flowpack.DENSE_WORDS)
+        self._bufs = [np.empty(shape, np.uint32) for _ in range(n_slots)]
+        self._dense_buf: Optional[np.ndarray] = None  # lazy fallback buffer
         self._tokens: list = [None] * n_slots
         self._slot = 0
 
@@ -57,10 +82,37 @@ class DenseStagingRing:
         tok = self._tokens[slot]
         if tok is not None:
             jax.block_until_ready(tok)  # slot's last consumer has finished
+        if self.spill_cap is not None:
+            buf = flowpack.pack_compact(
+                events, batch_size=self.batch_size, spill_cap=self.spill_cap,
+                extra=extra, dns=dns, out=self._bufs[slot])
+            if buf is None:
+                return self._fold_dense_fallback(state, events, extra, dns)
+            state, self._tokens[slot] = self._ingest(state, self._put(buf))
+            self._slot = (slot + 1) % len(self._bufs)
+            return state
         buf = flowpack.pack_dense(events, batch_size=self.batch_size,
                                   extra=extra, dns=dns, out=self._bufs[slot])
-        state, self._tokens[slot] = self._ingest(state, self._put(buf))
+        # ship FLAT: a (B*16,) transfer dodges device-layout padding of the
+        # 16-wide minor dim (the ingest jit reshapes back, fused, free)
+        state, self._tokens[slot] = self._ingest(
+            state, self._put(buf.reshape(-1)))
         self._slot = (slot + 1) % len(self._bufs)
+        return state
+
+    def _fold_dense_fallback(self, state, events, extra, dns):
+        """Non-v4 flows exceeded the spill lane: ship this batch full-width.
+        Synchronous (the shared dense buffer has no slot ring), and rare —
+        only v6-dominant traffic takes it repeatedly, at dense-path speed."""
+        import jax
+
+        if self._dense_buf is None:
+            self._dense_buf = np.empty(
+                (self.batch_size, flowpack.DENSE_WORDS), np.uint32)
+        buf = flowpack.pack_dense(events, batch_size=self.batch_size,
+                                  extra=extra, dns=dns, out=self._dense_buf)
+        state, tok = self._ingest_fallback(state, self._put(buf.reshape(-1)))
+        jax.block_until_ready(tok)
         return state
 
     def drain(self) -> None:

@@ -111,12 +111,20 @@ def batch_to_device(batch: FlowBatch) -> dict[str, np.ndarray]:
     }
 
 
+DENSE_WORDS = 16  # row width; must equal flowpack.DENSE_WORDS (layout twin)
+
+
 def dense_to_arrays(dense: jax.Array) -> dict[str, jax.Array]:
-    """Device-side unpack of the flowpack dense feed — one (B, 16) u32 array
-    per batch means ONE host->device transfer instead of six (the transfer
-    link, not compute, bounds the host path on tunneled/PCIe chips). Row
-    layout is pinned in flowpack.cc fp_pack_dense; traceable under jit, and
-    XLA fuses the slices/bitcasts into the consuming scatter."""
+    """Device-side unpack of the flowpack dense feed — one host->device
+    transfer per batch instead of six (the transfer link, not compute, bounds
+    the host path on tunneled/PCIe chips). Accepts the batch either as
+    (B, 16) rows or FLAT (B*16,) — flat is how the staging ring ships it:
+    a 1-D transfer avoids the device tiling pad a 16-wide minor dimension
+    suffers (measured 1.5-8x transfer inflation on the axon chip), and the
+    reshape here fuses into the ingest executable. Row layout is pinned in
+    flowpack.cc fp_pack_dense."""
+    if dense.ndim == 1:
+        dense = dense.reshape(-1, DENSE_WORDS)
     return {
         "keys": dense[:, :KEY_WORDS],
         "bytes": jax.lax.bitcast_convert_type(dense[:, 10], jnp.float32),
@@ -137,10 +145,13 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     arrays are width-sharded across that axis: updates mask out-of-shard
     columns, queries psum partial gathers (model-parallel sketches).
 
-    Note: width-sharded mode pays two small psums per batch (top-K candidate
-    scoring) over the sketch axis — ~d*B floats, e.g. 128KB at d=4/B=8192,
-    negligible on ICI. The data axis stays collective-free until window roll.
-    A future refinement could defer table re-scoring entirely to the merge.
+    Width-sharded (2D mesh) steady state performs NO collectives at all: the
+    Count-Min is sharded by KEY OWNERSHIP (`countmin.owner_shard`), so each
+    sketch shard folds and point-queries its own keys entirely locally
+    (`query_sharded_local`) and keeps a top-K table of just its keys. The
+    one psum-backed exact query (`query_sharded`) runs only inside the
+    window-roll merge, which gathers per-shard tables and re-scores against
+    the globally merged sketch (`parallel.merge.merge_states`).
     """
     words = arrays["keys"]
     valid = arrays["valid"]
@@ -173,15 +184,21 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
             cm_b, cm_p = countmin.update_two(
                 state.cm_bytes, state.cm_pkts, h1, h2, bytes_f, pkts, valid)
         query_fn = None
+        heavy = topk.update(state.heavy, cm_b, words, h1, h2, valid,
+                            query_fn=None, salt=state.window)
     else:
         cm_b = countmin.update_sharded(state.cm_bytes, h1, h2, bytes_f, valid,
                                        sketch_axis, sketch_shards)
         cm_p = countmin.update_sharded(state.cm_pkts, h1, h2, pkts, valid,
                                        sketch_axis, sketch_shards)
-        query_fn = lambda a, b: countmin.query_sharded(  # noqa: E731
-            cm_b, a, b, sketch_axis, sketch_shards)
-    heavy = topk.update(state.heavy, cm_b, words, h1, h2, valid,
-                        query_fn=query_fn, salt=state.window)
+        # collective-free scoring: this shard fully owns its keys' counters,
+        # so its table tracks exactly the keys it owns (the merge gathers
+        # tables across the sketch axis and re-scores globally)
+        heavy = topk.update(
+            state.heavy, cm_b, words, h1, h2, valid,
+            query_fn=lambda a, b: countmin.query_sharded_local(
+                cm_b, a, b, sketch_axis, sketch_shards),
+            salt=state.window)
     if (use_pallas and sketch_axis is None
             and state.hll_src.regs.shape[0] % 512 == 0):
         from netobserv_tpu.ops.pallas import hll_kernel
@@ -212,6 +229,52 @@ def make_ingest_fn(donate: bool = True, use_pallas: bool = False):
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
+COMPACT_WORDS = 9  # must equal flowpack.COMPACT_WORDS (layout twin)
+_V4_PREFIX_WORD2 = 0xFFFF0000  # bytes 8..11 of a v4-in-v6 mapped address
+
+
+def compact_to_arrays(flat: jax.Array, batch_size: int,
+                      spill_cap: int) -> dict[str, jax.Array]:
+    """Device-side unpack of the flowpack COMPACT feed (flat
+    `[batch_size*9 v4 rows | spill_cap*16 dense rows]`, layout pinned in
+    flowpack.cc fp_pack_compact). Reconstructs full 10-word v4-mapped keys
+    from the 4-word compact form and concatenates the spill lane, yielding
+    one (batch_size + spill_cap)-row array dict for the ordinary ingest —
+    the row widening happens in HBM where bandwidth is ~free; the transfer
+    link only ever saw ~40% of the dense feed's bytes."""
+    c = flat[:batch_size * COMPACT_WORDS].reshape(batch_size, COMPACT_WORDS)
+    spill = dense_to_arrays(
+        flat[batch_size * COMPACT_WORDS:].reshape(spill_cap, DENSE_WORDS))
+    zeros = jnp.zeros((batch_size,), jnp.uint32)
+    prefix = jnp.full((batch_size,), _V4_PREFIX_WORD2, jnp.uint32)
+    keys = jnp.stack(
+        [zeros, zeros, prefix, c[:, 0],
+         zeros, zeros, prefix, c[:, 1],
+         c[:, 2], c[:, 3] & jnp.uint32(0x00FFFFFF)], axis=1)
+    comp = {
+        "keys": keys,
+        "bytes": jax.lax.bitcast_convert_type(c[:, 4], jnp.float32),
+        "packets": c[:, 5].astype(jnp.int32),
+        "rtt_us": c[:, 6].astype(jnp.int32),
+        "dns_latency_us": c[:, 7].astype(jnp.int32),
+        "valid": (c[:, 3] & jnp.uint32(0x80000000)) != 0,
+        "sampling": c[:, 8].astype(jnp.int32),
+    }
+    return {k: jnp.concatenate([comp[k], spill[k]], axis=0) for k in comp}
+
+
+def make_ingest_compact_fn(batch_size: int, spill_cap: int,
+                           donate: bool = True, use_pallas: bool = False,
+                           with_token: bool = False):
+    """Jitted `(state, flat compact feed) -> state` (see compact_to_arrays /
+    flowpack.pack_compact). `with_token` as in make_ingest_dense_fn."""
+    def fn(s, flat):
+        arrays = compact_to_arrays(flat, batch_size, spill_cap)
+        s = ingest(s, arrays, use_pallas=use_pallas)
+        return (s, flat[:1]) if with_token else s
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+
 def make_ingest_dense_fn(donate: bool = True, use_pallas: bool = False,
                          with_token: bool = False):
     """Jitted `(state, dense (B,16)u32) -> state` — the single-transfer host
@@ -224,7 +287,7 @@ def make_ingest_dense_fn(donate: bool = True, use_pallas: bool = False,
     if with_token:
         def fn(s, d):
             return ingest(s, dense_to_arrays(d),
-                          use_pallas=use_pallas), d[0, :1]
+                          use_pallas=use_pallas), d.reshape(-1)[:1]
     else:
         fn = lambda s, d: ingest(s, dense_to_arrays(d),  # noqa: E731
                                  use_pallas=use_pallas)

@@ -1,0 +1,202 @@
+"""Accuracy sweep: sketch outputs vs the exact oracle across traffic shapes.
+
+Covers BASELINE.json configs 2-4:
+
+- config 2 — Count-Min + top-K heavy hitters (recall@100 and F1 vs the exact
+  per-key byte aggregation), swept over zipf skew x CM width x K x window
+  mode (reset vs decay);
+- config 3 — HLL distinct-source cardinality, single-device and merged over
+  a 4-way data mesh;
+- config 4 — RTT/DNS log-histogram quantiles vs exact numpy quantiles.
+
+Run `python scripts/accuracy_sweep.py` to (re)generate docs/accuracy.md.
+tests/test_accuracy_sweep.py runs a reduced grid with hard guards at the
+BASELINE bound (<1% heavy-hitter recall loss).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from netobserv_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from netobserv_tpu.sketch import state as sk  # noqa: E402
+
+BATCH = 4096
+N_BATCHES = 24
+N_DISTINCT = 20_000
+RECALL_AT = 100
+
+
+def make_traffic(zipf_s: float, seed: int, n_batches: int = N_BATCHES):
+    """Zipf-skewed batches + the exact per-key byte totals."""
+    rng = np.random.default_rng(seed)
+    universe = rng.integers(0, 2**32, (N_DISTINCT, 10), dtype=np.uint32)
+    batches = []
+    exact = np.zeros(N_DISTINCT, np.float64)
+    rtt_all = []
+    for _ in range(n_batches):
+        ranks = np.minimum(rng.zipf(zipf_s, BATCH) - 1, N_DISTINCT - 1)
+        byts = rng.integers(64, 9000, BATCH).astype(np.float32)
+        rtt = rng.lognormal(9.0, 1.2, BATCH).astype(np.int32)  # ~µs scale
+        np.add.at(exact, ranks, byts.astype(np.float64))
+        rtt_all.append(rtt)
+        batches.append({
+            "keys": universe[ranks],
+            "bytes": byts,
+            "packets": np.ones(BATCH, np.int32),
+            "rtt_us": rtt,
+            "dns_latency_us": np.maximum(rtt // 7, 1).astype(np.int32),
+            "sampling": np.zeros(BATCH, np.int32),
+            "valid": np.ones(BATCH, np.bool_),
+        })
+    distinct_true = int((exact > 0).sum())
+    return universe, batches, exact, distinct_true, np.concatenate(rtt_all)
+
+
+def heavy_metrics(report_heavy, universe, exact, k_eval=RECALL_AT):
+    true_top = np.argsort(-exact)[:k_eval]
+    got = {tuple(w) for w, v in zip(np.asarray(report_heavy.words),
+                                    np.asarray(report_heavy.valid)) if v}
+    hits = sum(tuple(universe[t]) in got for t in true_top)
+    recall = hits / k_eval
+    # F1 of the reported set vs the true top-|reported| set
+    n_rep = max(len(got), 1)
+    true_set = {tuple(universe[t]) for t in np.argsort(-exact)[:n_rep]}
+    tp = len(got & true_set)
+    prec = tp / n_rep
+    rec = tp / max(len(true_set), 1)
+    f1 = 2 * prec * rec / max(prec + rec, 1e-9)
+    return recall, f1
+
+
+def run_case(zipf_s: float, width: int, k: int, mode: str, seed: int = 0):
+    universe, batches, exact, distinct_true, rtt_all = make_traffic(
+        zipf_s, seed)
+    cfg = sk.SketchConfig(cm_width=width, topk=k)
+    state = sk.init_state(cfg)
+    ingest = jax.jit(sk.ingest)
+    if mode == "reset":
+        for arrays in batches:
+            state = ingest(state, {k2: jnp.asarray(v)
+                                   for k2, v in arrays.items()})
+        state, report = sk.roll_window(state, cfg)
+    else:  # decay: roll (decay 0.8) every 8 batches; oracle decays likewise
+        for i, arrays in enumerate(batches):
+            if i and i % 8 == 0:
+                state = sk.decay_state(state, 0.8)
+            state = ingest(state, {k2: jnp.asarray(v)
+                                   for k2, v in arrays.items()})
+        # exact decayed-mass oracle from the same stream (same seed)
+        rng = np.random.default_rng(seed)
+        universe2 = rng.integers(0, 2**32, (N_DISTINCT, 10), dtype=np.uint32)
+        assert (universe2 == universe).all()
+        decayed = np.zeros(N_DISTINCT, np.float64)
+        seg_seen = np.zeros(N_DISTINCT, np.bool_)
+        for i in range(N_BATCHES):
+            ranks = np.minimum(rng.zipf(zipf_s, BATCH) - 1, N_DISTINCT - 1)
+            byts = rng.integers(64, 9000, BATCH).astype(np.float32)
+            rng.lognormal(9.0, 1.2, BATCH)
+            if i and i % 8 == 0:
+                decayed *= 0.8
+                seg_seen[:] = False  # HLL registers reset at decay
+            np.add.at(decayed, ranks, byts.astype(np.float64))
+            seg_seen[ranks] = True
+        exact = decayed
+        distinct_true = int(seg_seen.sum())  # distinct since last reset
+        state, report = sk.roll_window(state, cfg)
+    recall, f1 = heavy_metrics(report.heavy, universe, exact)
+    hll_err = abs(float(report.distinct_src) - distinct_true) / distinct_true
+    # config 4: quantiles vs exact (reset-mode rtt stream only)
+    q_err = None
+    if mode == "reset":
+        qs = np.asarray(report.rtt_quantiles_us)
+        truth = np.quantile(rtt_all, sk.QS)
+        q_err = float(np.max(np.abs(qs - truth) / truth))
+    return recall, f1, hll_err, q_err
+
+
+def run_mesh_hll_case(zipf_s: float, seed: int = 0):
+    """Config 3: distinct-src over a 4-way data mesh, merged over the mesh."""
+    from netobserv_tpu.parallel import MeshSpec, make_mesh, merge as pmerge
+
+    ndata = 4
+    if ndata > len(jax.devices()):
+        return None
+    universe, batches, exact, distinct_true, _ = make_traffic(zipf_s, seed)
+    cfg = sk.SketchConfig(cm_width=1 << 14, topk=256)
+    mesh = make_mesh(MeshSpec(data=ndata, sketch=1))
+    dist = pmerge.init_dist_state(cfg, mesh)
+    ingest_fn = pmerge.make_sharded_ingest_fn(mesh, cfg, donate=False)
+    merge_fn = pmerge.make_merge_fn(mesh, cfg)
+    for arrays in batches:
+        n = (len(arrays["valid"]) // ndata) * ndata
+        dist = ingest_fn(dist, pmerge.shard_batch(
+            mesh, {k: v[:n] for k, v in arrays.items()}))
+    _, report = merge_fn(dist)
+    return abs(float(report.distinct_src) - distinct_true) / distinct_true
+
+
+def main() -> None:
+    rows = []
+    for zipf_s in (1.1, 1.2, 1.5, 2.0):
+        for width in (1 << 12, 1 << 14, 1 << 16):
+            for k in (256, 1024):
+                for mode in ("reset", "decay"):
+                    r, f1, he, qe = run_case(zipf_s, width, k, mode)
+                    rows.append((zipf_s, width, k, mode, r, f1, he, qe))
+                    print(f"s={zipf_s} w={width} K={k} {mode}: "
+                          f"recall={r:.3f} f1={f1:.3f} hll={he:.4f} "
+                          f"q={qe if qe is None else round(qe, 4)}",
+                          file=sys.stderr)
+    mesh_rows = []
+    for zipf_s in (1.2, 1.5):
+        e = run_mesh_hll_case(zipf_s)
+        if e is not None:
+            mesh_rows.append((zipf_s, e))
+
+    out = os.path.join(os.path.dirname(__file__), "..", "docs", "accuracy.md")
+    with open(out, "w") as fh:
+        fh.write(
+            "# Accuracy sweep — sketches vs the exact oracle\n\n"
+            "Generated by `python scripts/accuracy_sweep.py` "
+            f"({N_BATCHES} batches x {BATCH} zipf records, {N_DISTINCT} "
+            "distinct keys; guards enforced by tests/test_accuracy_sweep.py)."
+            "\n\nBASELINE bound: <1% heavy-hitter recall loss vs exact "
+            "aggregation (BASELINE.json configs 2-4).\n\n"
+            "## Config 2: heavy hitters (recall@100 / F1) + config 4 "
+            "(max quantile rel. err)\n\n"
+            "| zipf s | CM width | K | window | recall@100 | F1 | "
+            "HLL err | RTT quantile err |\n|---|---|---|---|---|---|---|---|\n")
+        for zipf_s, width, k, mode, r, f1, he, qe in rows:
+            fh.write(f"| {zipf_s} | {width} | {k} | {mode} | {r:.3f} | "
+                     f"{f1:.3f} | {he:.4f} | "
+                     f"{'—' if qe is None else f'{qe:.4f}'} |\n")
+        fh.write("\n## Config 3: distinct-src HLL, merged over a 4-way "
+                 "data mesh\n\n| zipf s | HLL rel. err |\n|---|---|\n")
+        for zipf_s, e in mesh_rows:
+            fh.write(f"| {zipf_s} | {e:.4f} |\n")
+        fh.write(
+            "\nNotes: recall is vs the true top-100 keys by byte volume; "
+            "F1 compares the full reported table against the equal-size "
+            "true set, so small-width tables score lower on near-uniform "
+            "(s=1.1) traffic where the 'heavy' set is ill-defined. The "
+            "decay-mode oracle applies the same geometric decay to the "
+            "exact counts. HLL error at the default precision (2^14 "
+            "registers) has sigma ~0.8%.\n")
+    print(f"wrote {os.path.normpath(out)}")
+
+
+if __name__ == "__main__":
+    main()

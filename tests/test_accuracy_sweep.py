@@ -1,0 +1,32 @@
+"""Accuracy guards at the BASELINE bound (<1% heavy-hitter recall loss) on a
+reduced grid of the sweep in scripts/accuracy_sweep.py; the full table lives
+in docs/accuracy.md (BASELINE.json configs 2-4)."""
+
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from scripts.accuracy_sweep import run_case, run_mesh_hll_case
+
+
+@pytest.mark.parametrize("zipf_s,width,k,mode", [
+    (1.2, 1 << 14, 1024, "reset"),
+    (1.5, 1 << 14, 1024, "reset"),
+    (2.0, 1 << 12, 256, "reset"),
+    (1.2, 1 << 14, 1024, "decay"),
+])
+def test_heavy_hitter_recall_bound(zipf_s, width, k, mode):
+    recall, f1, hll_err, q_err = run_case(zipf_s, width, k, mode)
+    assert recall >= 0.99, f"recall {recall} breaches the <1% loss bound"
+    assert f1 >= 0.9, f"F1 {f1}"
+    assert hll_err < 0.03, f"HLL err {hll_err}"
+    if q_err is not None:
+        # log-histogram resolution bound (~2% relative) + sampling noise
+        assert q_err < 0.05, f"quantile err {q_err}"
+
+
+def test_merged_mesh_hll_bound():
+    err = run_mesh_hll_case(1.2)
+    if err is None:
+        pytest.skip("needs 4 devices")
+    assert err < 0.03, f"merged HLL err {err}"

@@ -292,3 +292,95 @@ class TestSamplingDebias:
                                       4 * np.asarray(s0.cm_bytes.counts))
         np.testing.assert_array_equal(np.asarray(s1.cm_pkts.counts),
                                       4 * np.asarray(s0.cm_pkts.counts))
+
+
+def _mixed_events(n=24, n_v6=5):
+    """Events with v4-mapped keys, the last n_v6 rows genuine v6."""
+    events = _events(n)
+    for i in range(n - n_v6, n):
+        events[i]["key"]["src_ip"] = np.arange(16, dtype=np.uint8) + i
+        events[i]["key"]["dst_ip"] = np.arange(16, dtype=np.uint8) * 2 + i
+    return events
+
+
+class TestPackCompact:
+    def test_native_matches_numpy(self, native):
+        events = _mixed_events()
+        extra = np.zeros(len(events), dtype=binfmt.EXTRA_REC_DTYPE)
+        extra["rtt_ns"] = np.arange(len(events), dtype=np.uint64) * 9_000
+        a = flowpack.pack_compact(events, batch_size=32, spill_cap=8,
+                                  extra=extra, use_native=True)
+        b = flowpack.pack_compact(events, batch_size=32, spill_cap=8,
+                                  extra=extra, use_native=False)
+        np.testing.assert_array_equal(a, b)
+
+    def test_overflow_returns_none(self, native):
+        events = _mixed_events(24, n_v6=10)
+        for un in (True, False):
+            assert flowpack.pack_compact(events, batch_size=32, spill_cap=4,
+                                         use_native=un) is None
+
+    def test_ingest_compact_equals_dense(self, native):
+        """The compact transport must fold to bit-identical sketch state as
+        the dense transport — v4 key reconstruction included."""
+        import jax
+
+        from netobserv_tpu.sketch import state as sk
+
+        events = _mixed_events()
+        cfg = sk.SketchConfig(cm_width=1 << 10, topk=64)
+        dense = flowpack.pack_dense(events, batch_size=37)
+        s_dense = sk.make_ingest_dense_fn(donate=False)(
+            sk.init_state(cfg), dense)
+        comp = flowpack.pack_compact(events, batch_size=37, spill_cap=5)
+        s_comp = sk.make_ingest_compact_fn(37, 5, donate=False)(
+            sk.init_state(cfg), comp)
+        # the lanes permute row order, so compare order-insensitive state:
+        # every sketch is row-order invariant (sums/maxes over the batch)
+        for name in ("cm_bytes", "cm_pkts", "hll_src", "hll_per_dst",
+                     "hist_rtt", "hist_dns", "ddos", "total_records",
+                     "total_bytes"):
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-6),
+                getattr(s_dense, name), getattr(s_comp, name))
+
+    def test_ring_compact_with_fallback(self, native):
+        """The compact staging ring (with overflow batches taking the dense
+        fallback) must agree with sequential dense ingest on the linear
+        (row-order-invariant) sketches."""
+        import jax
+
+        from netobserv_tpu.sketch import state as sk
+        from netobserv_tpu.sketch.staging import DenseStagingRing
+
+        cfg = sk.SketchConfig(cm_width=1 << 10, topk=64)
+        batches = []
+        for i in range(9):
+            # batch 4 overflows the spill lane -> dense fallback
+            ev = _mixed_events(24, n_v6=10 if i == 4 else 3)
+            ev["key"]["src_port"] = 3000 + 41 * i + np.arange(24)
+            batches.append(ev)
+        spill = 4
+        ring = DenseStagingRing(
+            32, sk.make_ingest_compact_fn(32, spill, donate=False,
+                                          with_token=True),
+            spill_cap=spill,
+            ingest_fallback=sk.make_ingest_dense_fn(donate=False,
+                                                    with_token=True))
+        s_ring = sk.init_state(cfg)
+        for ev in batches:
+            s_ring = ring.fold(s_ring, ev)
+        ring.drain()
+
+        ingest = jax.jit(sk.ingest)
+        s_ref = sk.init_state(cfg)
+        for ev in batches:
+            s_ref = ingest(s_ref, sk.batch_to_device(
+                flowpack.pack_events(ev, batch_size=32)))
+        for name in ("cm_bytes", "cm_pkts", "hll_src", "hll_per_dst",
+                     "total_records", "total_bytes"):
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-6),
+                getattr(s_ring, name), getattr(s_ref, name))
