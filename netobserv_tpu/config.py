@@ -226,6 +226,11 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
 
     # --- direct-FLP ---
     flp_config: str = field(default="", **_env("FLP_CONFIG"))
+    #: JSON file mapping IP -> Kubernetes metadata for add_kubernetes rules
+    #: (the file-backed KubeDataSource; a live informer can be injected)
+    flp_kube_map: str = field(default="", **_env("FLP_KUBE_MAP"))
+    #: ip2location-layout range CSV for add_location rules
+    flp_location_db: str = field(default="", **_env("FLP_LOCATION_DB"))
 
     # --- deprecated aliases (reference: `config.go:298-323`) ---
     flows_target_host: str = field(default="", **_env("FLOWS_TARGET_HOST"))

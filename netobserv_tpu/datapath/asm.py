@@ -87,6 +87,16 @@ class Asm:
     def atomic_add(self, size: int, dst: int, src: int, off: int) -> None:
         self._emit(encode(0xC3 | size, dst, src, off))
 
+    def atomic_or(self, size: int, dst: int, src: int, off: int) -> None:
+        """*(dst+off) |= src, atomically (BPF_ATOMIC imm=BPF_OR, kernel
+        5.12+) — lock-free accumulation of flag bits across CPUs."""
+        self._emit(encode(0xC3 | size, dst, src, off, 0x40))
+
+    def atomic_fetch_add(self, size: int, dst: int, src: int, off: int) -> None:
+        """src = fetch_add(*(dst+off), src) (BPF_ATOMIC imm=BPF_ADD|FETCH,
+        kernel 5.12+) — reserves unique slots/sequence numbers across CPUs."""
+        self._emit(encode(0xC3 | size, dst, src, off, 0x01))
+
     def ld_map_fd(self, dst: int, map_fd: int) -> None:
         self._emit(encode_ld_map_fd(dst, map_fd)[:8])
         self._emit(encode_ld_map_fd(dst, map_fd)[8:])

@@ -35,10 +35,20 @@ into per-CPU flows_extra records).
 Beyond flowpath.c/the reference: IPv4-options packets key their real ports
 (fill_iphdr assumes ihl=5 and mis-reads them, utils.h:113-118) and IPv6
 flows behind extension headers key the real transport via a bounded chain
-walk (fill_ip6hdr keys the first next-header). Deliberate limits: racy
-(non-spin-locked) last_seen/flags, and the per-packet trackers (TCP flags,
-DNS/TLS/QUIC) stay on the constant-offset fast path — slow-path flows are
-keyed and counted but not feature-enriched. Validated by the live verifier and end-to-end veth traffic tests
+walk (fill_ip6hdr keys the first next-header).
+
+Concurrency (the C twin spin-locks, flowpath.c:44-107; spin locks need
+BTF-described map values this path doesn't have, so it is LOCK-FREE with
+the same guarantees): bytes/packets via atomic adds, tcp_flags via an
+atomic OR on the containing aligned word (no lost bits), observed-intf
+appends via atomic fetch-add slot reservation (no lost/torn entries; the
+counter saturates near capacity instead of wrapping). The one plain store
+is last_seen — racing writers both store ~now, correct to a packet's skew.
+Residual benign race: the SAME new interface appending twice under a race
+(dedup'd again at read-out). Per-packet trackers (TCP flags, DNS/TLS/QUIC)
+stay on the constant-offset fast path — slow-path flows are keyed and
+counted but not feature-enriched. Validated by the live verifier,
+end-to-end veth traffic tests, and a cross-CPU stress test
 (tests/test_asm_flowpath.py).
 """
 
@@ -92,6 +102,17 @@ ST_NOBS = _st("n_observed_intf")
 ST_OBSDIR = _st("observed_direction")
 ST_OBSIF = _st("observed_intf")
 ST_FLAGS = _st("tcp_flags")
+# atomic-OR staging: tcp_flags is the HIGH u16 of the 4-aligned word that
+# starts at eth_protocol, so `atomic_or(word, flags << 16)` accumulates flag
+# bits across CPUs without touching eth_protocol (which is only ever
+# rewritten with the same value)
+assert ST_FLAGS == ST_ETH + 2 and ST_ETH % 4 == 0
+# slot-reservation staging: n_observed_intf is byte 3 of the 4-aligned word
+# at direction_first, so `atomic_fetch_add(word, 1<<24)` hands each CPU an
+# exclusive observed-list slot (the counter wraps at 256, like the C twin's
+# u1); the addend's low 24 bits are zero, so the other three bytes
+# (direction_first/errno_fallback/dscp) are preserved
+assert _st("n_observed_intf") == ST_DIR + 3 and ST_DIR % 4 == 0
 ST_SRC_MAC = _st("src_mac")
 ST_DST_MAC = _st("dst_mac")
 ST_SAMPLING = _st("sampling")
@@ -232,6 +253,19 @@ class _Flow:
         a.stx(BPF_DW, R0, R3, 0)
         a.label(lbl)
 
+    def classify_tcp_flags(self, tag: str) -> None:
+        """Fold the synthetic composite bits into the raw flags byte in r3 —
+        SYN_ACK/FIN_ACK/RST_ACK exactly like parse.h:93-102; feeds both the
+        accumulated stats flags and the filter gate's tcp_flags predicate.
+        Shared by the fast (constant-offset) and slow (cursor) parses."""
+        a = self.a
+        for combo, bit in ((0x12, 0x100), (0x11, 0x200), (0x14, 0x400)):
+            a.mov_reg(R4, R3)
+            a.alu_imm(0x57, R4, combo)
+            a.jmp_imm(0x55, R4, combo, f"cls_{tag}_{bit:x}")
+            a.alu_imm(0x47, R3, bit)
+            a.label(f"cls_{tag}_{bit:x}")
+
     def bounds(self, need: int, fail: str) -> None:
         """if data + need > data_end goto fail (r7=data, r8=data_end)."""
         a = self.a
@@ -255,15 +289,7 @@ class _Flow:
         a.label(f"tcp_{v}")
         self.bounds(l4 + 14, f"ports_{v}")      # flags byte at l4+13
         a.ldx(BPF_B, R3, R7, l4 + 13)
-        # classify composite flags exactly like parse.h:93-102 — the
-        # synthetic SYN_ACK/FIN_ACK/RST_ACK bits feed both the accumulated
-        # stats flags and the filter gate's tcp_flags predicate
-        for combo, bit in ((0x12, 0x100), (0x11, 0x200), (0x14, 0x400)):
-            a.mov_reg(R4, R3)
-            a.alu_imm(0x57, R4, combo)
-            a.jmp_imm(0x55, R4, combo, f"cls_{v}_{bit:x}")
-            a.alu_imm(0x47, R3, bit)
-            a.label(f"cls_{v}_{bit:x}")
+        self.classify_tcp_flags(v)
         a.stx(BPF_DW, R10, R3, SPILL)
         if self.enable_tls:
             self.parse_tls(l4, v)
@@ -593,8 +619,10 @@ class _Flow:
         """L4 key fields at a DYNAMIC offset (stack slot CURSOR) via
         bpf_skb_load_bytes — used by the IPv4-options and IPv6-extension
         slow paths, where the L4 offset isn't a verifier-visible constant.
-        Ports/ICMP only; per-packet trackers (flags/DNS/TLS/QUIC) stay on
-        the constant-offset fast path. r9 = final transport protocol.
+        Ports/ICMP + TCP FLAGS (into SPILL, so flag accumulation, the
+        filter's tcp_flags predicate, and handshake-RTT stamping all work
+        for slow-path TCP flows too); payload trackers (DNS/TLS/QUIC) stay
+        on the constant-offset fast path. r9 = final transport protocol.
         Truncated packets keep the address+proto key (reference behavior:
         fill_l4info leaves ports zero when the header doesn't fit)."""
         a = self.a
@@ -609,23 +637,35 @@ class _Flow:
             a.call(HELPER_SKB_LOAD_BYTES)
             a.jmp_imm(0x55, R0, 0, "key_done")
 
-        a.jmp_imm(0x15, R9, 6, f"{t}_p")
+        def ports_from_tlsbuf() -> None:
+            a.ldx(BPF_B, R3, R10, TLSBUF)
+            a.alu_imm(0x67, R3, 8)
+            a.ldx(BPF_B, R4, R10, TLSBUF + 1)
+            a.alu_reg(0x4F, R3, R4)
+            a.stx(BPF_H, R10, R3, KEY + KY_SPORT)
+            a.ldx(BPF_B, R3, R10, TLSBUF + 2)
+            a.alu_imm(0x67, R3, 8)
+            a.ldx(BPF_B, R4, R10, TLSBUF + 3)
+            a.alu_reg(0x4F, R3, R4)
+            a.stx(BPF_H, R10, R3, KEY + KY_DPORT)
+
+        a.jmp_imm(0x15, R9, 6, f"{t}_t")
         a.jmp_imm(0x15, R9, 17, f"{t}_p")
         a.jmp_imm(0x15, R9, 132, f"{t}_p")
         a.jmp_imm(0x15, R9, icmp_proto, f"{t}_i")
         a.jmp("key_done")
+        a.label(f"{t}_t")
+        # TCP: ports + the flags byte (tcphdr+13), composite-classified
+        # exactly like the fast path
+        load_at_cursor(14)
+        ports_from_tlsbuf()
+        a.ldx(BPF_B, R3, R10, TLSBUF + 13)
+        self.classify_tcp_flags(t)
+        a.stx(BPF_DW, R10, R3, SPILL)
+        a.jmp("key_done")
         a.label(f"{t}_p")
         load_at_cursor(4)
-        a.ldx(BPF_B, R3, R10, TLSBUF)
-        a.alu_imm(0x67, R3, 8)
-        a.ldx(BPF_B, R4, R10, TLSBUF + 1)
-        a.alu_reg(0x4F, R3, R4)
-        a.stx(BPF_H, R10, R3, KEY + KY_SPORT)
-        a.ldx(BPF_B, R3, R10, TLSBUF + 2)
-        a.alu_imm(0x67, R3, 8)
-        a.ldx(BPF_B, R4, R10, TLSBUF + 3)
-        a.alu_reg(0x4F, R3, R4)
-        a.stx(BPF_H, R10, R3, KEY + KY_DPORT)
+        ports_from_tlsbuf()
         a.jmp("key_done")
         a.label(f"{t}_i")
         load_at_cursor(2)
@@ -1129,18 +1169,20 @@ class _Flow:
         a.ldx(BPF_W, R3, R0, ST_IFINDEX)
         a.jmp_reg(0x5D, R3, R4, "hit_other")    # not the first-seen intf
         # counting path: bytes += skb->len (atomic), packets += 1 (atomic),
-        # last_seen = now, flags |= packet flags (read-modify-write; benign
-        # race: bits only accumulate, a lost update costs one OR)
+        # last_seen = now (plain store: racing writers both store ~now, so
+        # the field is correct to within one packet's skew — the one update
+        # the C twin's spin lock covers that stays lock-free here, since
+        # spin locks need BTF-described map values the assembler path
+        # doesn't have), flags |= packet flags (ATOMIC or: no lost bits)
         a.ldx(BPF_W, R3, R6, SKB_LEN)
         a.atomic_add(BPF_DW, R0, R3, ST_BYTES)
         a.mov_imm(R4, 1)
         a.atomic_add(BPF_W, R0, R4, ST_PACKETS)
         a.ldx(BPF_DW, R3, R10, NOW)
-        a.stx(BPF_DW, R0, R3, ST_LAST)          # benign race (lock-free)
-        a.ldx(BPF_H, R3, R0, ST_FLAGS)
-        a.ldx(BPF_DW, R4, R10, SPILL)
-        a.alu_reg(0x4F, R3, R4)                 # r3 |= packet flags
-        a.stx(BPF_H, R0, R3, ST_FLAGS)
+        a.stx(BPF_DW, R0, R3, ST_LAST)
+        a.ldx(BPF_DW, R3, R10, SPILL)
+        a.alu_imm(0x67, R3, 16)                 # flags -> high u16 of word
+        a.atomic_or(BPF_W, R0, R3, ST_ETH)
         if self.has_filter_sampling:
             # latest effective rate wins (stored by flt_sample on the stack)
             a.ldx(BPF_W, R3, R10, VAL + ST_SAMPLING)
@@ -1185,10 +1227,9 @@ class _Flow:
         # secondary interface: span/flags only — never re-count traffic
         a.ldx(BPF_DW, R3, R10, NOW)
         a.stx(BPF_DW, R0, R3, ST_LAST)
-        a.ldx(BPF_H, R3, R0, ST_FLAGS)
-        a.ldx(BPF_DW, R5, R10, SPILL)
-        a.alu_reg(0x4F, R3, R5)
-        a.stx(BPF_H, R0, R3, ST_FLAGS)
+        a.ldx(BPF_DW, R3, R10, SPILL)
+        a.alu_imm(0x67, R3, 16)                 # flags -> high u16 of word
+        a.atomic_or(BPF_W, R0, R3, ST_ETH)
         # (ifindex, direction) dedup scan over the observed slots (r4 =
         # ifindex; direction is a build-time constant -> immediate compare)
         n_obs = binfmt.FLOW_STATS_DTYPE["observed_intf"].shape[0]
@@ -1198,8 +1239,16 @@ class _Flow:
             a.ldx(BPF_B, R3, R0, ST_OBSDIR + i)
             a.jmp_imm(0x15, R3, self.direction, "dns_rec")  # recorded
             a.label(f"obs_next_{i}")
-        # append (lock-free; a racing append can lose one slot — benign)
-        a.ldx(BPF_B, R3, R0, ST_NOBS)
+        # append via slot RESERVATION: fetch-add (1<<24) on the aligned word
+        # holding n_observed_intf hands this CPU an exclusive slot index, so
+        # concurrent appends can neither lose a slot nor tear each other's
+        # entries. Readers tolerate the two residual artifacts: a reserved-
+        # but-not-yet-written slot reads as ifindex 0 (skipped at read-out,
+        # record.py), and a racing append of the SAME new interface may
+        # duplicate it (dedup'd at read-out, record.py)
+        a.mov_imm(R3, 1 << 24)
+        a.atomic_fetch_add(BPF_W, R0, R3, ST_DIR)  # r3 = old word
+        a.alu_imm(0x77, R3, 24)                 # r3 = old n (0..255)
         a.jmp_imm(0x35, R3, n_obs, "obs_full")
         a.mov_reg(R5, R3)
         a.alu_imm(0x67, R5, 2)                  # n << 2
@@ -1210,10 +1259,13 @@ class _Flow:
         a.alu_reg(0x0F, R7, R3)
         a.mov_imm(R5, self.direction)
         a.stx(BPF_B, R7, R5, ST_OBSDIR)         # observed_direction[n] = dir
-        a.alu_imm(0x07, R3, 1)
-        a.stx(BPF_B, R0, R3, ST_NOBS)
         a.jmp("dns_rec")
         a.label("obs_full")
+        # undo the reservation so the counter SATURATES near capacity (at
+        # most +n_cpus transient) instead of wrapping at 256 and handing
+        # out in-use slots; readers clamp at capacity
+        a.mov_imm(R3, -(1 << 24))
+        a.atomic_add(BPF_W, R0, R3, ST_DIR)
         # overflow: count it, except for zero-proto traffic which routinely
         # saturates the array (reference bpf/flows.c:133-142)
         a.ldx(BPF_B, R3, R10, KEY + KY_PROTO)

@@ -209,6 +209,11 @@ static inline uint16_t sat_add16(uint16_t a, uint16_t b) {
 void fp_merge_stats(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
     struct no_flow_stats out;
     std::memcpy(&out, values, sizeof(out));
+    // the datapath's lock-free slot reservation can leave the counter
+    // TRANSIENTLY above capacity (saturation undo in flight) — clamp before
+    // any indexing
+    if (out.n_observed_intf > NO_MAX_OBSERVED_INTERFACES)
+        out.n_observed_intf = NO_MAX_OBSERVED_INTERFACES;
     const struct no_flow_stats *v =
         reinterpret_cast<const struct no_flow_stats *>(values);
     for (size_t c = 1; c < n_cpu; c++) {
@@ -249,7 +254,10 @@ void fp_merge_stats(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
         if (s->tls_key_share) out.tls_key_share = s->tls_key_share;
         out.tls_types |= s->tls_types;
         out.misc_flags |= s->misc_flags;
-        for (int j = 0; j < s->n_observed_intf; j++) {
+        int ns_obs = s->n_observed_intf > NO_MAX_OBSERVED_INTERFACES
+                         ? NO_MAX_OBSERVED_INTERFACES
+                         : s->n_observed_intf;
+        for (int j = 0; j < ns_obs; j++) {
             bool seen = false;
             for (int i = 0; i < out.n_observed_intf; i++) {
                 if (out.observed_intf[i] == s->observed_intf[j] &&

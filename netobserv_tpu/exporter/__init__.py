@@ -18,10 +18,18 @@ def build_exporter(cfg, metrics=None):
         return StdoutJSONExporter(metrics=metrics)
     if cfg.export == c.EXPORT_DIRECT_FLP:
         from netobserv_tpu.exporter.direct_flp import DirectFLPExporter
+        kube_source = location_db = None
+        if cfg.flp_kube_map:
+            from netobserv_tpu.exporter.flp_enrich import StaticKubeDataSource
+            kube_source = StaticKubeDataSource(path=cfg.flp_kube_map)
+        if cfg.flp_location_db:
+            from netobserv_tpu.exporter.flp_enrich import CsvLocationDB
+            location_db = CsvLocationDB(cfg.flp_location_db)
         return DirectFLPExporter(
             flp_config=cfg.flp_config,
             # encode/prom metrics surface on the agent's /metrics server
-            prom_registry=metrics.registry if metrics is not None else None)
+            prom_registry=metrics.registry if metrics is not None else None,
+            kube_source=kube_source, location_db=location_db)
     if cfg.export == c.EXPORT_TPU_SKETCH:
         return TpuSketchExporter.from_config(cfg, metrics=metrics)
     if cfg.export == c.EXPORT_GRPC:

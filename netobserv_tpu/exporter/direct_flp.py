@@ -12,8 +12,10 @@ Supported stage subset (the shapes the reference's smoke-test configs use):
   output}] field renaming
 - `transform` / type `network` (FLP transform_network.go subset): rules
   `add_subnet`, `add_service`, `add_subnet_label`, `decode_tcp_flags`,
-  `reinterpret_direction`; `add_location`/`add_kubernetes*` need external
-  databases and are warned-and-skipped
+  `reinterpret_direction`, plus `add_kubernetes`/`add_location` backed by
+  PLUGGABLE data sources (exporter.flp_enrich: a file-backed or injected
+  Kubernetes datasource via FLP_KUBE_MAP, an ip2location-layout range CSV
+  via FLP_LOCATION_DB); a rule whose backend isn't configured warns+skips
 - `extract` / type `conntrack` (FLP api/conntrack.go subset): canonical
   bidirectional connection hashing, per-direction (splitAB) sum/count/min/
   max/first/last aggregates, newConnection/flowLog/heartbeat/endConnection
@@ -43,6 +45,9 @@ from typing import Callable, Optional
 import yaml
 
 from netobserv_tpu.exporter.base import Exporter
+from netobserv_tpu.exporter.flp_enrich import (
+    enrich_kubernetes, enrich_location,
+)
 from netobserv_tpu.exporter.flp_map import record_to_map
 from netobserv_tpu.model.flow import TcpFlags
 from netobserv_tpu.model.record import Record
@@ -87,8 +92,11 @@ _TCP_FLAG_NAMES = [(f.value, f.name) for f in TcpFlags]
 _PROTO_NAMES = {6: "tcp", 17: "udp", 132: "sctp"}
 
 
-def _build_network(params: dict) -> Stage:
-    """FLP `transform network` subset (transform_network.go:64-160)."""
+def _build_network(params: dict, kube_source=None, location_db=None) -> Stage:
+    """FLP `transform network` subset (transform_network.go:64-160).
+    `kube_source`/`location_db` are the pluggable enrichment backends
+    (exporter.flp_enrich); without one, the corresponding rule warns and
+    skips (the data must come from outside the process)."""
     import ipaddress
     import socket as _socket
 
@@ -99,6 +107,20 @@ def _build_network(params: dict) -> Stage:
         subnet_labels.append((lbl.get("name", ""), nets))
     dir_info = params.get("directionInfo", {})
     svc_cache: dict = {}
+    # resolve enrichment backends ONCE at build time: a per-record warning
+    # or import in the stage loop would run at export rate
+    if kube_source is None and any(
+            r.get("type") == "add_kubernetes" for r in rules):
+        log.warning("transform.network rule add_kubernetes needs a "
+                    "Kubernetes datasource (set FLP_KUBE_MAP or inject "
+                    "kube_source); rule(s) skipped")
+        rules = [r for r in rules if r.get("type") != "add_kubernetes"]
+    if location_db is None and any(
+            r.get("type") == "add_location" for r in rules):
+        log.warning("transform.network rule add_location needs a GeoIP "
+                    "database (set FLP_LOCATION_DB or inject "
+                    "location_db); rule(s) skipped")
+        rules = [r for r in rules if r.get("type") != "add_location"]
 
     def service_name(port, proto) -> str:
         key = (port, proto)
@@ -184,7 +206,16 @@ def _build_network(params: dict) -> Stage:
                         entry[fd_field] = 0     # ingress
                 elif src:
                     entry[fd_field] = 2         # inner
+            elif rtype == "add_kubernetes":
+                enrich_kubernetes(entry, rule.get("kubernetes", rule),
+                                  kube_source)
+            elif rtype == "add_location":
+                enrich_location(entry, rule.get("add_location", rule),
+                                location_db)
             else:
+                # NB: add_kubernetes_infra (FLP flow-layer classification)
+                # lands here — it is NOT the per-IP metadata rule and stays
+                # unsupported-with-warning
                 log.warning("transform.network rule %r unsupported; skipped",
                             rtype)
         return entry
@@ -695,7 +726,8 @@ def _build_generic(params: dict) -> Stage:
 class DirectFLPExporter(Exporter):
     name = "direct-flp"
 
-    def __init__(self, flp_config: str = "", stream=None, prom_registry=None):
+    def __init__(self, flp_config: str = "", stream=None, prom_registry=None,
+                 kube_source=None, location_db=None):
         from prometheus_client import CollectorRegistry
 
         self._stream = stream if stream is not None else sys.stdout
@@ -705,6 +737,9 @@ class DirectFLPExporter(Exporter):
         self.prom_registry = (prom_registry if prom_registry is not None
                               else CollectorRegistry())
         self._prom_names: set[str] = set()
+        # pluggable enrichment backends (exporter.flp_enrich protocols)
+        self._kube_source = kube_source
+        self._location_db = location_db
         if flp_config.strip():
             self._build(yaml.safe_load(flp_config))
 
@@ -721,7 +756,10 @@ class DirectFLPExporter(Exporter):
                 elif ttype == "generic":
                     self._stages.append(_build_generic(t.get("generic", {})))
                 elif ttype == "network":
-                    self._stages.append(_build_network(t.get("network", {})))
+                    self._stages.append(_build_network(
+                        t.get("network", {}),
+                        kube_source=self._kube_source,
+                        location_db=self._location_db))
                 else:
                     log.warning("unsupported transform type %r ignored", ttype)
             elif "extract" in p:

@@ -77,10 +77,12 @@ def accumulate_base(dst, src) -> None:
             dst[fld] = src[fld]
     dst["tls_types"] = int(dst["tls_types"]) | int(src["tls_types"])
     dst["misc_flags"] = int(dst["misc_flags"]) | int(src["misc_flags"])
-    # observed-interfaces dedup (bounded at MAX_OBSERVED_INTERFACES)
-    n_dst = int(dst["n_observed_intf"])
+    # observed-interfaces dedup (bounded at MAX_OBSERVED_INTERFACES; the
+    # datapath's lock-free slot reservation can leave the counter
+    # transiently above capacity — clamp before indexing)
     cap = len(dst["observed_intf"])
-    for j in range(int(src["n_observed_intf"])):
+    n_dst = min(int(dst["n_observed_intf"]), cap)
+    for j in range(min(int(src["n_observed_intf"]), cap)):
         oi, od = int(src["observed_intf"][j]), int(src["observed_direction"][j])
         seen = any(
             int(dst["observed_intf"][i]) == oi
@@ -178,6 +180,13 @@ def accumulate_quic(dst, src) -> None:
 def merge_percpu(values: np.ndarray, accumulate_fn) -> np.ndarray:
     """Merge per-CPU partial records (shape (n_cpu,) structured) into one."""
     out = values[0].copy()
+    if "n_observed_intf" in (out.dtype.names or ()):
+        # the datapath's lock-free slot reservation can leave the counter
+        # transiently above capacity — clamp exactly like the native twin
+        # (flowpack.cc fp_merge_stats), including the n_cpu==1 fast path
+        cap = len(out["observed_intf"])
+        if int(out["n_observed_intf"]) > cap:
+            out["n_observed_intf"] = cap
     for i in range(1, len(values)):
         accumulate_fn(out, values[i])
     return out

@@ -247,7 +247,15 @@ def records_from_events(
             tls_key_share=shares[i], tls_types=ttypes[i],
             ssl_mismatch=bool(miscs[i] & 0x01),
         )
+        seen_obs = set()
         for j in range(min(n_obs[i], len(obs_if[i]))):
+            # skip slots a racing reservation published but hasn't written
+            # yet (ifindex 0 is never a real interface), and dedup entries a
+            # same-interface append race may have duplicated
+            pair = (int(obs_if[i][j]), int(obs_dir[i][j]))
+            if pair[0] == 0 or pair in seen_obs:
+                continue
+            seen_obs.add(pair)
             rec.dup_list.append((namer(obs_if[i][j], mac), obs_dir[i][j], ""))
         out.append(rec)
     return out

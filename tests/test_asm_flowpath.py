@@ -1425,3 +1425,135 @@ def test_full_agent_over_kernel_datapath(veth):
     finally:
         stop.set()
         t.join(timeout=5)
+
+
+def test_datapath_emits_atomic_concurrency_ops():
+    """The lock-free concurrency contract is enforced at the BYTECODE level
+    (this image has one CPU, so cross-CPU races cannot manifest locally):
+    the hit path must use atomic adds for bytes/packets, an atomic OR for
+    tcp_flags, and an atomic fetch-add for observed-slot reservation — the
+    lock-free equivalents of flowpath.c's spin-locked update."""
+    from netobserv_tpu.datapath.asm_flowpath import build_flow_program
+
+    prog = build_flow_program(map_fd=3)
+    ops = [(prog[i], prog[i + 1] & 0x0F,
+            int.from_bytes(prog[i + 4:i + 8], "little", signed=True))
+           for i in range(0, len(prog), 8)]
+    atomics = [(op, imm) for op, _dst, imm in ops if op in (0xC3, 0xDB)]
+    assert any(op == 0xDB and imm == 0 for op, imm in atomics), \
+        "no 64-bit atomic add (bytes)"
+    assert any(op == 0xC3 and imm == 0 for op, imm in atomics), \
+        "no 32-bit atomic add (packets)"
+    assert any(op == 0xC3 and imm == 0x40 for op, imm in atomics), \
+        "no atomic OR (tcp_flags accumulation)"
+    assert any(op == 0xC3 and imm == 0x01 for op, imm in atomics), \
+        "no atomic fetch-add (observed-slot reservation)"
+
+
+def test_concurrent_same_flow_conservation(veth):
+    """Concurrency stress: several threads hammer the SAME flow key while
+    others churn TCP handshakes; every packet and flag bit must survive
+    (conservation is exact because the counting path is atomic). On
+    multi-CPU kernels (CI) this exercises real cross-CPU races."""
+    import threading
+
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024)
+    try:
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        n_threads, per_thread, size = 4, 400, 64
+        # one shared fixed-src-port socket: every thread hits the SAME key
+        shared = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        shared.bind(("10.198.0.1", 45555))
+
+        def sender():
+            for _ in range(per_thread):
+                shared.sendto(b"q" * size, ("10.198.0.2", 7001))
+
+        def tcp_churn():
+            for _ in range(20):
+                t = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                t.settimeout(0.2)
+                try:
+                    t.connect(("10.198.0.2", 80))
+                except OSError:
+                    pass
+                t.close()
+
+        threads = [threading.Thread(target=sender) for _ in range(n_threads)]
+        threads.append(threading.Thread(target=tcp_churn))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        shared.close()
+        time.sleep(0.3)
+
+        evicted = fetcher.lookup_and_delete()
+        udp = tcp_flags = None
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            st = evicted.events["stats"][i]
+            if (int(k["proto"]), int(k["src_port"]),
+                    int(k["dst_port"])) == (17, 45555, 7001):
+                udp = st
+            elif int(k["proto"]) == 6 and int(k["dst_port"]) == 80:
+                tcp_flags = (tcp_flags or 0) | int(st["tcp_flags"])
+        assert udp is not None, "stress flow not captured"
+        total = n_threads * per_thread
+        # UDP 64B payload: 64 + 8 + 20 + 14 = 106B per frame
+        assert int(udp["packets"]) == total, \
+            f"lost packets: {int(udp['packets'])}/{total}"
+        assert int(udp["bytes"]) == total * 106
+        assert int(udp["n_observed_intf"]) == 1
+        assert tcp_flags is not None and tcp_flags & 0x02  # SYN bits survive
+    finally:
+        fetcher.close()
+
+
+def test_slow_path_tcp_flags_and_rtt_enrichment(veth):
+    """Slow-path (IPv4-options) TCP packets must be flag-enriched: the
+    dynamic-offset parse extracts the flags byte, so flag accumulation sees
+    SYN/FIN bits even behind an options block (the reference mis-parses
+    these entirely, utils.h:113-118)."""
+    import struct
+
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024)
+    try:
+        fetcher.attach(_ifindex(veth), veth, "egress")
+
+        def send_tcp_opts(flags):
+            # IPv4 ihl=6 (4B of NOP/NOP/NOP/EOL options) + minimal TCP hdr
+            tcp = struct.pack(">HHIIBBHHH", 7070, 9090, 1, 0,
+                              5 << 4, flags, 8192, 0, 0)
+            tot = 24 + len(tcp)
+            iph = struct.pack(
+                ">BBHHHBBH4s4s", 0x46, 0, tot, 0, 0, 64, 6, 0,
+                socket.inet_aton("10.198.0.1"),
+                socket.inet_aton("10.198.0.2")) + b"\x01\x01\x01\x00"
+            raw = socket.socket(socket.AF_INET, socket.SOCK_RAW,
+                                socket.IPPROTO_RAW)
+            raw.sendto(iph + tcp, ("10.198.0.2", 0))
+            raw.close()
+
+        send_tcp_opts(0x02)          # SYN
+        send_tcp_opts(0x18)          # PSH|ACK
+        send_tcp_opts(0x01)          # FIN
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        flow = None
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            if (int(k["proto"]), int(k["src_port"]),
+                    int(k["dst_port"])) == (6, 7070, 9090):
+                flow = evicted.events["stats"][i]
+        assert flow is not None, "slow-path TCP flow not captured"
+        assert int(flow["packets"]) == 3
+        fl = int(flow["tcp_flags"])
+        assert fl & 0x02 and fl & 0x18 and fl & 0x01, \
+            f"slow-path flags not enriched: {fl:#x}"
+    finally:
+        fetcher.close()

@@ -677,3 +677,140 @@ parameters:
     assert out["min_rtt"]["total_value"] == 10     # not 0.0
     assert out["avg_rtt"]["total_value"] == 15     # not diluted by no_rtt
     assert out["min_rtt"]["total_count"] == 2      # keyless entry uncounted
+
+
+K8S_LOC_CFG = """
+pipeline:
+  - name: enrich
+  - name: out
+    follows: enrich
+parameters:
+  - name: enrich
+    transform:
+      type: network
+      network:
+        rules:
+          - type: add_kubernetes
+            kubernetes:
+              ipField: SrcAddr
+              output: SrcK8S
+              add_zone: true
+              labels_prefix: SrcK8S_labels
+          - type: add_location
+            add_location:
+              input: DstAddr
+              output: DstLoc
+  - name: out
+    write:
+      type: stdout
+"""
+
+
+def test_add_kubernetes_with_pluggable_informer(tmp_path):
+    """add_kubernetes enriches via the injected datasource with FLP's exact
+    output-key naming (kubernetes/enrich.go:37-87); closes the
+    warned-and-skipped gap against the reference's embedded FLP."""
+    from netobserv_tpu.exporter.flp_enrich import StaticKubeDataSource
+
+    ds = StaticKubeDataSource({
+        "10.1.1.1": {"name": "web-1", "kind": "Pod", "namespace": "prod",
+                     "owner_name": "web", "owner_kind": "Deployment",
+                     "host_ip": "192.0.2.10", "host_name": "node-a",
+                     "zone": "us-east-1a", "labels": {"app": "web"}},
+    })
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=K8S_LOC_CFG, stream=buf,
+                            kube_source=ds)
+    exp.export_batch([make_record()])
+    entry = json.loads(buf.getvalue().splitlines()[0])
+    assert entry["SrcK8S_Namespace"] == "prod"
+    assert entry["SrcK8S_Name"] == "web-1"
+    assert entry["SrcK8S_Type"] == "Pod"
+    assert entry["SrcK8S_OwnerName"] == "web"
+    assert entry["SrcK8S_OwnerType"] == "Deployment"
+    assert entry["SrcK8S_HostIP"] == "192.0.2.10"
+    assert entry["SrcK8S_HostName"] == "node-a"
+    assert entry["SrcK8S_Zone"] == "us-east-1a"
+    assert entry["SrcK8S_labels_app"] == "web"
+
+
+def test_add_kubernetes_json_file_and_unknown_ip(tmp_path):
+    from netobserv_tpu.exporter.flp_enrich import StaticKubeDataSource
+
+    p = tmp_path / "kube.json"
+    p.write_text(json.dumps({
+        "10.9.9.9": {"name": "other", "kind": "Service"}}))
+    ds = StaticKubeDataSource(path=str(p))
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=K8S_LOC_CFG, stream=buf,
+                            kube_source=ds)
+    exp.export_batch([make_record()])  # SrcAddr 10.1.1.1 not in the map
+    entry = json.loads(buf.getvalue().splitlines()[0])
+    assert "SrcK8S_Name" not in entry  # unknown IP: untouched entry
+
+
+def test_add_location_with_csv_db(tmp_path):
+    """add_location resolves through the ip2location-layout range CSV with
+    FLP's exact six output fields (transform_network.go:85-90)."""
+    import ipaddress
+
+    from netobserv_tpu.exporter.flp_enrich import CsvLocationDB
+
+    dst = int(ipaddress.ip_address("10.2.2.2"))
+    p = tmp_path / "loc.csv"
+    p.write_text(
+        f'"{dst - 10}","{dst + 10}","US","United States of America",'
+        '"California","Mountain View","37.405","-122.078"\n'
+        '"3232235520","3232301055","DE","Germany","Berlin","Berlin",'
+        '"52.52","13.40"\n')
+    buf = io.StringIO()
+    exp = DirectFLPExporter(flp_config=K8S_LOC_CFG, stream=buf,
+                            location_db=CsvLocationDB(str(p)))
+    exp.export_batch([make_record()])
+    entry = json.loads(buf.getvalue().splitlines()[0])
+    assert entry["DstLoc_CountryName"] == "US"
+    assert entry["DstLoc_CountryLongName"] == "United States of America"
+    assert entry["DstLoc_RegionName"] == "California"
+    assert entry["DstLoc_CityName"] == "Mountain View"
+    assert entry["DstLoc_Latitude"] == "37.405"
+    assert entry["DstLoc_Longitude"] == "-122.078"
+    # no k8s source injected: the add_kubernetes rule warned and skipped
+    assert "SrcK8S_Name" not in entry
+
+
+def test_enrichment_backends_from_agent_config(tmp_path):
+    """build_exporter wires FLP_KUBE_MAP / FLP_LOCATION_DB into the
+    embedded pipeline."""
+    from netobserv_tpu.config import load_config
+    from netobserv_tpu.exporter import build_exporter
+
+    kube = tmp_path / "kube.json"
+    kube.write_text(json.dumps(
+        {"10.1.1.1": {"name": "pod-x", "kind": "Pod", "namespace": "ns1"}}))
+    cfg = load_config({
+        "EXPORT": "direct-flp",
+        "FLP_CONFIG": K8S_LOC_CFG,
+        "FLP_KUBE_MAP": str(kube),
+    })
+    exp = build_exporter(cfg)
+    exp._stream = buf = io.StringIO()
+    exp.export_batch([make_record()])
+    entry = json.loads(buf.getvalue().splitlines()[0])
+    assert entry["SrcK8S_Name"] == "pod-x" and entry["SrcK8S_Namespace"] == "ns1"
+
+
+def test_location_csv_ipv6_layout_mapped_v4(tmp_path):
+    """ip2location IPv6-layout DBs carry IPv4 as ::ffff-mapped u128 ranges;
+    those must land in the v4 table so plain v4 lookups resolve, and
+    malformed rows must be skipped, never fatal."""
+    from netobserv_tpu.exporter.flp_enrich import CsvLocationDB
+
+    lo = 0xFFFF00000000 + int.from_bytes(bytes([10, 2, 2, 0]), "big")
+    p = tmp_path / "loc6.csv"
+    p.write_text(
+        f'"{lo}","{lo + 255}","US","United States","CA","MV","1","2"\n'
+        '"16777216","n/a","XX","malformed row tolerated","","","",""\n')
+    db = CsvLocationDB(str(p))
+    assert db.lookup("10.2.2.2")["CountryName"] == "US"
+    assert db.lookup("::ffff:10.2.2.2")["CountryName"] == "US"
+    assert db.lookup("10.3.0.1") is None
