@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-cpu bench gen-protobuf native bpf verify-maps lint \
+.PHONY: all test test-cpu bench gen-protobuf native bpf verify-maps lint perftest \
         dryrun smoke clean
 
 all: native gen-protobuf
@@ -47,6 +47,10 @@ dryrun:
 smoke:
 	DATAPATH=synthetic EXPORT=stdout CACHE_ACTIVE_TIMEOUT=300ms \
 	  timeout 3 $(PY) -m netobserv_tpu | head -5 || true
+
+# kernel capture-plane load rig: sendmmsg storm -> parity check (needs root)
+perftest:
+	$(PY) examples/performance/local_perftest.py --packets 1000000 --flows 256
 
 clean:
 	rm -rf netobserv_tpu/datapath/native/build

@@ -1,0 +1,110 @@
+// loadgen — sustained UDP packet storm for capture-plane performance tests.
+//
+// The local analog of the reference's million-packets generator
+// (examples/performance/perftest-millionp.yml): saturates a link with small
+// UDP datagrams across a configurable number of distinct flows (source
+// ports) so the kernel datapath's aggregation, eviction, and counters can
+// be measured against a known ground truth.
+//
+// sendmmsg() ships packets in kernel batches (1024/syscall), reaching
+// ~1M pps/core — two orders of magnitude beyond a Python send loop.
+//
+// Usage: loadgen <dst_ip> <dst_port> <n_packets> <n_flows> [payload_bytes]
+// Prints one JSON line with the achieved rate on exit.
+
+#define _GNU_SOURCE  /* sendmmsg / struct mmsghdr */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+
+#define BATCH 1024
+
+static double now_s(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 5) {
+        fprintf(stderr,
+                "usage: %s <dst_ip> <dst_port> <n_packets> <n_flows> "
+                "[payload_bytes=64]\n", argv[0]);
+        return 2;
+    }
+    const char *dst_ip = argv[1];
+    int dst_port = atoi(argv[2]);
+    long n_packets = atol(argv[3]);
+    int n_flows = atoi(argv[4]);
+    int payload = argc > 5 ? atoi(argv[5]) : 64;
+    if (n_flows < 1 || n_flows > 60000 || payload < 1 || payload > 1400) {
+        fprintf(stderr, "bad n_flows/payload\n");
+        return 2;
+    }
+
+    // one CONNECTED socket per flow (distinct source port): connected UDP
+    // sockets skip per-packet route lookups
+    int *socks = malloc((size_t)n_flows * sizeof(int));
+    struct sockaddr_in dst = {0};
+    dst.sin_family = AF_INET;
+    dst.sin_port = htons((uint16_t)dst_port);
+    if (inet_pton(AF_INET, dst_ip, &dst.sin_addr) != 1) {
+        fprintf(stderr, "bad dst ip\n");
+        return 2;
+    }
+    for (int i = 0; i < n_flows; i++) {
+        socks[i] = socket(AF_INET, SOCK_DGRAM, 0);
+        if (socks[i] < 0 ||
+            connect(socks[i], (struct sockaddr *)&dst, sizeof(dst)) != 0) {
+            perror("socket/connect");
+            return 1;
+        }
+    }
+
+    char *buf = malloc((size_t)payload);
+    memset(buf, 'x', (size_t)payload);
+    struct mmsghdr msgs[BATCH];
+    struct iovec iovs[BATCH];
+    for (int i = 0; i < BATCH; i++) {
+        iovs[i].iov_base = buf;
+        iovs[i].iov_len = (size_t)payload;
+        memset(&msgs[i], 0, sizeof(msgs[i]));
+        msgs[i].msg_hdr.msg_iov = &iovs[i];
+        msgs[i].msg_hdr.msg_iovlen = 1;
+    }
+
+    // batch small enough that every requested flow actually sends: flows
+    // rotate per batch, so a batch bigger than n_packets/n_flows would
+    // starve the tail flows on short runs
+    long per_flow = n_packets / n_flows;
+    int batch = (int)(per_flow < 1 ? 1 : (per_flow > BATCH ? BATCH
+                                                           : per_flow));
+    char *flow_hit = calloc((size_t)n_flows, 1);
+    double t0 = now_s();
+    long sent = 0;
+    int flow = 0;
+    while (sent < n_packets) {
+        int want = (int)(n_packets - sent < batch ? n_packets - sent : batch);
+        int got = sendmmsg(socks[flow], msgs, (unsigned)want, 0);
+        if (got < 0) {
+            perror("sendmmsg");
+            break;
+        }
+        sent += got;
+        if (got > 0)
+            flow_hit[flow] = 1;
+        flow = (flow + 1) % n_flows;
+    }
+    double dt = now_s() - t0;
+    int flows_used = 0;
+    for (int i = 0; i < n_flows; i++)
+        flows_used += flow_hit[i];
+    printf("{\"sent_packets\": %ld, \"flows\": %d, \"payload_bytes\": %d, "
+           "\"seconds\": %.3f, \"pps\": %.0f}\n",
+           sent, flows_used, payload, dt, (double)sent / dt);
+    return sent == n_packets ? 0 : 1;
+}
