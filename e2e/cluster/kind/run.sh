@@ -1,0 +1,88 @@
+#!/usr/bin/env bash
+# Kind cluster e2e: build agent image -> Kind -> Loki + agent DaemonSet +
+# traffic pods -> drive UDP -> assert per-flow byte accounting via LogQL.
+# The reference's exact bar (e2e/cluster/kind.go:208-432,
+# e2e/basic/flow_test.go:62-126), against a REAL kubernetes + REAL Loki.
+set -euo pipefail
+cd "$(dirname "$0")/../../.."
+
+CLUSTER=netobserv-e2e
+N_PKTS=9
+PAYLOAD=100
+
+echo "=== build agent image"
+docker build -t netobserv-tpu-agent:e2e -f e2e/cluster/kind/Dockerfile .
+
+echo "=== kind cluster"
+kind delete cluster --name "$CLUSTER" 2>/dev/null || true
+kind create cluster --name "$CLUSTER" --wait 120s
+kind load docker-image netobserv-tpu-agent:e2e --name "$CLUSTER"
+
+cleanup() { kind delete cluster --name "$CLUSTER" || true; }
+trap cleanup EXIT
+
+echo "=== deploy stack"
+kubectl apply -f e2e/cluster/kind/manifests.yml
+kubectl -n netobserv-e2e wait --for=condition=ready pod -l app=loki \
+  --timeout=180s
+kubectl -n netobserv-e2e rollout status ds/agent --timeout=180s
+kubectl -n netobserv-e2e wait --for=condition=ready pod/server pod/pinger \
+  --timeout=180s
+
+SERVER_IP=$(kubectl -n netobserv-e2e get pod server \
+  -o jsonpath='{.status.podIP}')
+PINGER_IP=$(kubectl -n netobserv-e2e get pod pinger \
+  -o jsonpath='{.status.podIP}')
+echo "pinger=$PINGER_IP server=$SERVER_IP"
+
+echo "=== drive traffic ($N_PKTS x ${PAYLOAD}B UDP)"
+kubectl -n netobserv-e2e exec pinger -- python -c "
+import socket, time
+s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+s.bind(('0.0.0.0', 47000))
+for _ in range($N_PKTS):
+    s.sendto(b'x' * $PAYLOAD, ('$SERVER_IP', 7777))
+    time.sleep(0.1)
+"
+
+echo "=== assert per-flow accounting via LogQL"
+kubectl -n netobserv-e2e port-forward svc/loki 3100:3100 &
+PF_PID=$!
+sleep 3
+python - <<PYEOF
+import json, sys, time, urllib.parse, urllib.request
+
+n_pkts, payload = $N_PKTS, $PAYLOAD
+query = urllib.parse.quote(
+    '{job="netobserv"} | json | SrcAddr="$PINGER_IP" '
+    '| DstAddr="$SERVER_IP"')
+deadline = time.time() + 120
+pkts = bts = 0
+while time.time() < deadline:
+    url = ("http://127.0.0.1:3100/loki/api/v1/query_range?limit=1000"
+           f"&since=10m&query={query}")
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            data = json.load(r)
+    except Exception as exc:
+        print("query retry:", exc)
+        time.sleep(3)
+        continue
+    pkts = bts = 0
+    for stream in data.get("data", {}).get("result", []):
+        for _ts, line in stream.get("values", []):
+            e = json.loads(line)
+            if int(e.get("DstPort", 0)) == 7777:
+                pkts += int(e.get("Packets", 0))
+                bts += int(e.get("Bytes", 0))
+    print(f"seen: {pkts} packets / {bts} bytes")
+    if pkts >= n_pkts:
+        break
+    time.sleep(3)
+expected = n_pkts * (payload + 8 + 20 + 14)
+assert pkts == n_pkts, f"packets {pkts} != {n_pkts}"
+assert bts == expected, f"bytes {bts} != {expected}"
+print(f"PASS: per-flow accounting exact ({pkts} packets, {bts} bytes)")
+PYEOF
+kill $PF_PID || true
+echo "=== cluster e2e OK"

@@ -1,0 +1,122 @@
+"""Minimal in-process Loki: enough of the push + query_range API for the
+cluster e2e harness to assert per-flow byte accounting the way the
+reference asserts against real Loki via LogQL
+(`e2e/cluster/kind.go:208-432`, `e2e/basic/flow_test.go:62-126`).
+
+Supported:
+- POST /loki/api/v1/push        (JSON streams, as _LokiWriter sends)
+- GET  /loki/api/v1/query_range with a LogQL subset:
+      {label="value",label2="v2"} | json | Field="x" | Num>=123
+  (stream-selector equality + json field equality / >= filters)
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_SEL_RE = re.compile(r"^\{([^}]*)\}")
+_FILTER_RE = re.compile(r'\|\s*(\w+)\s*(>=|=)\s*"?([^"|]+?)"?\s*(?=\||$)')
+
+
+class _Store:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.entries: list[tuple[dict, int, dict]] = []  # (labels, ts, body)
+
+    def push(self, payload: dict) -> int:
+        n = 0
+        with self.lock:
+            for stream in payload.get("streams", []):
+                labels = dict(stream.get("stream", {}))
+                for ts, line in stream.get("values", []):
+                    try:
+                        body = json.loads(line)
+                    except json.JSONDecodeError:
+                        body = {"line": line}
+                    self.entries.append((labels, int(ts), body))
+                    n += 1
+        return n
+
+    def query(self, logql: str) -> list[dict]:
+        sel = {}
+        m = _SEL_RE.match(logql.strip())
+        if m and m.group(1).strip():
+            for part in m.group(1).split(","):
+                k, v = part.split("=", 1)
+                sel[k.strip()] = v.strip().strip('"')
+        filters = _FILTER_RE.findall(logql)
+        out = []
+        with self.lock:
+            for labels, _ts, body in self.entries:
+                if any(labels.get(k) != v for k, v in sel.items()):
+                    continue
+                ok = True
+                for fld, op, val in filters:
+                    if fld == "json":
+                        continue
+                    got = body.get(fld)
+                    if op == "=":
+                        ok = ok and str(got) == val
+                    else:  # >=
+                        try:
+                            ok = ok and float(got) >= float(val)
+                        except (TypeError, ValueError):
+                            ok = False
+                if ok:
+                    out.append(body)
+        return out
+
+
+def serve(port: int = 0) -> tuple[ThreadingHTTPServer, int, _Store]:
+    store = _Store()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, code: int, obj: dict) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            if self.path != "/loki/api/v1/push":
+                return self._json(404, {})
+            n = int(self.headers.get("Content-Length", 0))
+            store.push(json.loads(self.rfile.read(n)))
+            self.send_response(204)
+            self.end_headers()
+
+        def do_GET(self):
+            u = urllib.parse.urlparse(self.path)
+            if u.path == "/ready":
+                return self._json(200, {"status": "ready"})
+            if u.path != "/loki/api/v1/query_range":
+                return self._json(404, {})
+            q = urllib.parse.parse_qs(u.query).get("query", [""])[0]
+            hits = store.query(q)
+            self._json(200, {"status": "success", "data": {
+                "resultType": "streams",
+                "result": [{"stream": {}, "values": [
+                    ["0", json.dumps(h)] for h in hits]}]}})
+
+    srv = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    return srv, srv.server_address[1], store
+
+
+if __name__ == "__main__":
+    import sys
+    import time
+
+    _, port, _ = serve(int(sys.argv[1]) if len(sys.argv) > 1 else 3100)
+    print(f"mock loki on :{port}", flush=True)
+    while True:
+        time.sleep(3600)

@@ -1,0 +1,28 @@
+"""Cluster-tier e2e (local two-node fallback): two netns "nodes" each run a
+full agent (kernel datapath + direct-flp + Loki push); per-flow byte
+accounting is asserted back out of Loki via LogQL — the reference's cluster
+bar (`e2e/basic/flow_test.go:62-126`) on a single host. The Kind-backed real
+cluster tier runs in CI (e2e/cluster/kind/, cluster-e2e job)."""
+
+import os
+import shutil
+import sys
+
+import pytest
+
+from netobserv_tpu.datapath import syscall_bpf as sb
+
+pytestmark = pytest.mark.skipif(
+    not (os.geteuid() == 0 and shutil.which("ip")
+         and os.path.ismount("/sys/fs/bpf") and sb.bpf_available()),
+    reason="needs root, iproute2, bpffs")
+
+
+def test_two_node_flow_accounting_via_logql():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from e2e.cluster.local_two_node import main
+
+    out = main()
+    assert out["sent_flow"]["Bytes"] == out["expected_bytes"]
+    assert out["recv_flow"]["Bytes"] == out["expected_bytes"]
+    assert out["sent_flow"]["Packets"] == out["recv_flow"]["Packets"] == 9
