@@ -248,7 +248,10 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_checkpoint_every: int = field(default=0, **_env("SKETCH_CHECKPOINT_EVERY", "0"))
     sketch_mesh_shape: str = field(default="", **_env("SKETCH_MESH_SHAPE"))  # e.g. "2x4"
     sketch_devices: str = field(default="", **_env("SKETCH_DEVICES"))  # "", "cpu", "tpu"
-    sketch_use_pallas: bool = field(default=False, **_env("SKETCH_USE_PALLAS", "false"))
+    #: auto (default) = fused MXU kernels on TPU at widths >= 16K, XLA
+    #: scatter elsewhere; true/false (any bool spelling) force one path
+    sketch_use_pallas: str = field(default="auto",
+                                   **_env("SKETCH_USE_PALLAS", "auto"))
     # window handling: "reset" zeroes sketches each window; "decay" multiplies
     # linear sketches by SKETCH_DECAY_FACTOR instead (sliding-window flavor)
     sketch_window_mode: str = field(default="reset", **_env("SKETCH_WINDOW_MODE", "reset"))

@@ -50,6 +50,77 @@ def _fold_kernel(counts_ref, idx_ref, vals_ref, out_ref, *, depth: int,
     out_ref[...] = acc
 
 
+def _fold2_kernel(counts_ref, idx_ref, vals_ref, out_ref, *, depth: int,
+                  n_chunks: int):
+    """Fused dual-plane fold: the one-hot membership matrix is built ONCE
+    per (depth row, chunk) and contracted with BOTH value planes stacked as
+    a (2, CHUNK_B) LHS — halving the dominant VPU compare cost vs two
+    single-plane passes and doubling MXU row utilization."""
+    j = pl.program_id(0)
+    base = j * TILE_W
+    lanes = base + jax.lax.broadcasted_iota(jnp.int32, (1, TILE_W), 1)
+
+    def chunk_body(i, acc):
+        sl = pl.dslice(i * CHUNK_B, CHUNK_B)
+        vals = vals_ref[:, sl]                       # [2, CHUNK_B]
+        new_rows = []
+        for r in range(depth):  # static unroll over sketch depth
+            idx = idx_ref[r, sl].reshape(CHUNK_B, 1)
+            onehot = (idx == lanes).astype(jnp.float32)  # [CHUNK_B, TILE_W]
+            contrib = jnp.dot(vals, onehot,
+                              preferred_element_type=jnp.float32)  # [2, W]
+            new_rows.append(acc[:, r] + contrib)
+        return jnp.stack(new_rows, axis=1)           # [2, d, TILE_W]
+
+    acc = counts_ref[...]
+    acc = jax.lax.fori_loop(0, n_chunks, chunk_body, acc)
+    out_ref[...] = acc
+
+
+def update_two(cm_a: CountMin, cm_b: CountMin, h1: jax.Array, h2: jax.Array,
+               vals_a: jax.Array, vals_b: jax.Array, valid: jax.Array,
+               interpret: bool | None = None) -> tuple[CountMin, CountMin]:
+    """Fused drop-in for countmin.update_two: both planes (bytes, packets)
+    fold in ONE kernel sharing hash indices and one-hot construction."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    d, w = cm_a.counts.shape
+    assert cm_b.counts.shape == (d, w)
+    assert w % TILE_W == 0, f"width {w} must be a multiple of {TILE_W}"
+    b = h1.shape[0]
+    pad = (-b) % CHUNK_B
+    if pad:
+        h1 = jnp.pad(h1, (0, pad))
+        h2 = jnp.pad(h2, (0, pad), constant_values=1)
+        vals_a = jnp.pad(vals_a, (0, pad))
+        vals_b = jnp.pad(vals_b, (0, pad))
+        valid = jnp.pad(valid, (0, pad))
+    idx = hashing.row_indices(h1, h2, d, w).astype(jnp.int32)  # [d, B]
+    vals = jnp.stack([
+        jnp.where(valid, vals_a, 0).astype(jnp.float32),
+        jnp.where(valid, vals_b, 0).astype(jnp.float32)])      # [2, B]
+    stacked = jnp.stack([cm_a.counts.astype(jnp.float32),
+                         cm_b.counts.astype(jnp.float32)])     # [2, d, w]
+    n_chunks = idx.shape[1] // CHUNK_B
+
+    kernel = functools.partial(_fold2_kernel, depth=d, n_chunks=n_chunks)
+    new_counts = pl.pallas_call(
+        kernel,
+        grid=(w // TILE_W,),
+        in_specs=[
+            pl.BlockSpec((2, d, TILE_W), lambda j: (0, 0, j)),
+            pl.BlockSpec((d, idx.shape[1]), lambda j: (0, 0)),
+            pl.BlockSpec((2, idx.shape[1]), lambda j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, d, TILE_W), lambda j: (0, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((2, d, w), jnp.float32),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(stacked, idx, vals)
+    return (CountMin(counts=new_counts[0].astype(cm_a.counts.dtype)),
+            CountMin(counts=new_counts[1].astype(cm_b.counts.dtype)))
+
+
 def update(cm: CountMin, h1: jax.Array, h2: jax.Array, values: jax.Array,
            valid: jax.Array, interpret: bool | None = None) -> CountMin:
     """Drop-in replacement for countmin.update (float32 sketches).

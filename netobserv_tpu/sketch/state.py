@@ -35,16 +35,24 @@ class SketchConfig(NamedTuple):
     hist_buckets: int = 1024
     ewma_buckets: int = 4096
     ewma_alpha: float = 0.3
-    # Pallas one-hot-matmul Count-Min fold instead of XLA scatter (TPU-only
-    # win; scatter is faster on CPU)
-    use_pallas: bool = False
+    #: None = auto: the fused MXU one-hot kernel on TPU at eligible widths
+    #: (measured faster than the XLA scatter there, docs/tpu_sketch.md);
+    #: the scatter everywhere else, incl. CPU where the kernel interprets
+    use_pallas: bool | None = None
 
     @classmethod
     def from_agent_config(cls, cfg) -> "SketchConfig":
+        raw = str(cfg.sketch_use_pallas).strip().lower()
+        if raw in ("auto", ""):
+            pallas = None
+        else:
+            # accept every spelling the old bool field accepted, so an
+            # explicit opt-out like SKETCH_USE_PALLAS=0/off stays an opt-out
+            pallas = raw in ("1", "true", "yes", "on")
         return cls(cm_depth=cfg.sketch_cm_depth, cm_width=cfg.sketch_cm_width,
                    hll_precision=cfg.sketch_hll_precision, topk=cfg.sketch_topk,
                    ewma_alpha=cfg.sketch_ewma_alpha,
-                   use_pallas=cfg.sketch_use_pallas)
+                   use_pallas=pallas)
 
 
 class SketchState(NamedTuple):
@@ -138,7 +146,7 @@ def dense_to_arrays(dense: jax.Array) -> dict[str, jax.Array]:
 
 def ingest(state: SketchState, arrays: dict[str, jax.Array],
            sketch_axis: str | None = None, sketch_shards: int = 1,
-           use_pallas: bool = False) -> SketchState:
+           use_pallas: bool | None = None) -> SketchState:
     """Fold one batch into all sketches. Pure; jit with donate_argnums=0.
 
     When `sketch_axis` is set (inside shard_map over a 2D mesh), the Count-Min
@@ -153,6 +161,12 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     window-roll merge, which gathers per-shard tables and re-scores against
     the globally merged sketch (`parallel.merge.merge_states`).
     """
+    if use_pallas is None:
+        # auto: the fused kernels (Count-Min fold + HLL) win on TPU at and
+        # above the measured ~16K-width crossover (docs/tpu_sketch.md);
+        # below it — and everywhere off-TPU — the scatter is faster
+        use_pallas = (jax.default_backend() == "tpu"
+                      and state.cm_bytes.width >= 16384)
     words = arrays["keys"]
     valid = arrays["valid"]
     bytes_f = arrays["bytes"]
@@ -176,10 +190,10 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
         # scatter otherwise (static shape check, resolved at trace time)
         if use_pallas and state.cm_bytes.width % 512 == 0:
             from netobserv_tpu.ops.pallas import countmin_kernel
-            cm_b = countmin_kernel.update(state.cm_bytes, h1, h2, bytes_f,
-                                          valid)
-            cm_p = countmin_kernel.update(state.cm_pkts, h1, h2,
-                                          pkts.astype(jnp.float32), valid)
+            # fused: both planes share hash indices AND one-hot construction
+            cm_b, cm_p = countmin_kernel.update_two(
+                state.cm_bytes, state.cm_pkts, h1, h2, bytes_f,
+                pkts.astype(jnp.float32), valid)
         else:
             cm_b, cm_p = countmin.update_two(
                 state.cm_bytes, state.cm_pkts, h1, h2, bytes_f, pkts, valid)
@@ -223,7 +237,8 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     )
 
 
-def make_ingest_fn(donate: bool = True, use_pallas: bool = False):
+def make_ingest_fn(donate: bool = True,
+                   use_pallas: bool | None = None):
     """Jitted ingest; donates the state buffers so updates are in-place on HBM."""
     fn = lambda s, a: ingest(s, a, use_pallas=use_pallas)  # noqa: E731
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
@@ -264,7 +279,8 @@ def compact_to_arrays(flat: jax.Array, batch_size: int,
 
 
 def make_ingest_compact_fn(batch_size: int, spill_cap: int,
-                           donate: bool = True, use_pallas: bool = False,
+                           donate: bool = True,
+                           use_pallas: bool | None = None,
                            with_token: bool = False):
     """Jitted `(state, flat compact feed) -> state` (see compact_to_arrays /
     flowpack.pack_compact). `with_token` as in make_ingest_dense_fn."""
@@ -275,7 +291,8 @@ def make_ingest_compact_fn(batch_size: int, spill_cap: int,
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
-def make_ingest_dense_fn(donate: bool = True, use_pallas: bool = False,
+def make_ingest_dense_fn(donate: bool = True,
+                         use_pallas: bool | None = None,
                          with_token: bool = False):
     """Jitted `(state, dense (B,16)u32) -> state` — the single-transfer host
     feed path (see dense_to_arrays / flowpack.pack_dense).

@@ -93,3 +93,19 @@ def test_pallas_countmin_pads_ragged_batch():
                                  valid, interpret=True)
     np.testing.assert_allclose(np.asarray(got.counts), np.asarray(ref.counts),
                                rtol=1e-6)
+
+
+def test_use_pallas_auto_policy():
+    """auto = TPU AND width >= the measured crossover; every bool spelling
+    the old field accepted still forces its path (an operator's explicit
+    SKETCH_USE_PALLAS=0 opt-out must never flip into Pallas-on)."""
+    from netobserv_tpu.config import load_config
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    for spelling, want in (("auto", None), ("", None),
+                           ("0", False), ("off", False), ("no", False),
+                           ("false", False),
+                           ("1", True), ("on", True), ("true", True)):
+        cfg = load_config({"SKETCH_USE_PALLAS": spelling})
+        assert SketchConfig.from_agent_config(cfg).use_pallas is want, \
+            spelling
