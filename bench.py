@@ -70,7 +70,7 @@ def cpu_exact_baseline(pool) -> float:
     return run()
 
 
-def tpu_ingest_rate(pool, use_pallas: bool = False):
+def tpu_ingest_rate(pool, use_pallas: bool | None = None):
     import jax
 
     from netobserv_tpu.sketch import state as sk
@@ -231,7 +231,10 @@ def main():
     rng = np.random.default_rng(2026)
     universe, pool = make_pool(rng)
     baseline = cpu_exact_baseline(pool)
-    use_pallas = "--pallas" in sys.argv
+    # default None = auto (fused Pallas kernels on TPU at production width,
+    # scatter elsewhere); --pallas/--scatter force a path for A/B runs
+    use_pallas = (True if "--pallas" in sys.argv
+                  else False if "--scatter" in sys.argv else None)
     if use_pallas:
         import jax
         if jax.default_backend() != "tpu":
@@ -239,13 +242,18 @@ def main():
                   "mode (a Python loop) — the number below is meaningless "
                   "for comparison; use the default scatter path on CPU",
                   file=sys.stderr)
+    # host path FIRST: it is transfer-bound, and this environment's
+    # tunneled link throttles after sustained traffic — measuring it after
+    # the device loop would charge the device loop's transfers against it.
+    # The device-rate metric is compute-bound and link-insensitive (its
+    # batches are staged on device before timing), so order doesn't bias it.
+    hp = host_path_rate()
+    print(f"host-path (evict->pack->ingest): {hp/1e6:.2f} M records/s",
+          file=sys.stderr)
     rate, state, feed = tpu_ingest_rate(pool, use_pallas=use_pallas)
     if "--check" in sys.argv:
         recall = check_recall(state, feed, universe, pool)
         print(f"heavy-hitter recall@100 vs exact: {recall:.3f}", file=sys.stderr)
-    hp = host_path_rate()
-    print(f"host-path (evict->pack->ingest): {hp/1e6:.2f} M records/s",
-          file=sys.stderr)
     out = {
         "metric": "flow_records_per_sec_per_chip",
         "value": round(rate),
