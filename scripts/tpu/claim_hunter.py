@@ -43,10 +43,13 @@ def main() -> None:
             with open(BENCH_OUT, "a") as fh:
                 fh.write(f"\n=== attempt {attempt} default path ===\n")
                 fh.flush()
+                # force --scatter: the flag-less default is now AUTO
+                # (pallas on TPU at production width), which would make
+                # this A/B measure pallas against itself
                 rc1 = subprocess.run(
-                    [sys.executable, "bench.py", "--check"], stdout=fh,
-                    stderr=fh, env=env, cwd=REPO).returncode
-                fh.write(f"[bench --check rc={rc1}]\n"
+                    [sys.executable, "bench.py", "--check", "--scatter"],
+                    stdout=fh, stderr=fh, env=env, cwd=REPO).returncode
+                fh.write(f"[bench --check --scatter rc={rc1}]\n"
                          f"\n=== attempt {attempt} pallas path ===\n")
                 fh.flush()
                 rc2 = subprocess.run(
