@@ -4,7 +4,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test test-cpu bench gen-protobuf native bpf verify-maps lint perftest \
+.PHONY: all test test-cpu bench gen-protobuf native bpf verify-maps lint perftest bytecode-image \
         dryrun smoke clean
 
 all: native gen-protobuf
@@ -51,6 +51,13 @@ smoke:
 # kernel capture-plane load rig: sendmmsg storm -> parity check (needs root)
 perftest:
 	$(PY) examples/performance/local_perftest.py --packets 1000000 --flows 256
+
+# bpfman bytecode container (labels generated from the canonical sources)
+bytecode-image:
+	docker build -f Containerfile.bytecode \
+	  --build-arg PROGRAMS="$$($(PY) scripts/gen_bytecode_labels.py programs)" \
+	  --build-arg MAPS="$$($(PY) scripts/gen_bytecode_labels.py maps)" \
+	  -t netobserv-tpu-bytecode .
 
 clean:
 	rm -rf netobserv_tpu/datapath/native/build

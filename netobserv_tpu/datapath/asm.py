@@ -28,11 +28,19 @@ HELPER_KTIME_GET_NS = 5
 HELPER_RINGBUF_OUTPUT = 130
 
 
+#: struct bpf_insn packs dst_reg:4/src_reg:4 as C BITFIELDS, so the nibble
+#: order follows the host's bitfield allocation: dst in the LOW nibble on
+#: little-endian, the HIGH nibble on big-endian (s390x)
+_REGS_BYTE = ((lambda dst, src: (src << 4) | dst)
+              if __import__("sys").byteorder == "little"
+              else (lambda dst, src: (dst << 4) | src))
+
+
 def encode(opcode: int, dst: int = 0, src: int = 0, off: int = 0,
            imm: int = 0) -> bytes:
     """Encode one eBPF instruction (struct bpf_insn) — the single encoding
     definition shared with syscall_bpf."""
-    return struct.pack("<BBhi", opcode, (src << 4) | dst, off, imm)
+    return struct.pack("=BBhi", opcode, _REGS_BYTE(dst, src), off, imm)
 
 
 def encode_ld_map_fd(dst: int, map_fd: int) -> bytes:
@@ -115,10 +123,10 @@ class Asm:
         self._insns.append(("jumpx", op, dst, src, target))
 
     def call(self, helper: int) -> None:
-        self._emit(struct.pack("<BBhi", 0x85, 0, 0, helper))
+        self._emit(struct.pack("=BBhi", 0x85, 0, 0, helper))
 
     def exit(self) -> None:
-        self._emit(struct.pack("<BBhi", 0x95, 0, 0, 0))
+        self._emit(struct.pack("=BBhi", 0x95, 0, 0, 0))
 
     # --- assembly ---
     def assemble(self) -> bytes:
@@ -129,9 +137,10 @@ class Asm:
             elif item[0] == "jump":
                 _tag, op, dst, imm, target = item
                 off = self._labels[target] - i - 1
-                out.append(struct.pack("<BBhi", op, dst, off, imm))
+                out.append(struct.pack("=BBhi", op, dst, off, imm))
             else:  # jumpx
                 _tag, op, dst, src, target = item
                 off = self._labels[target] - i - 1
-                out.append(struct.pack("<BBhi", op, (src << 4) | dst, off, 0))
+                out.append(struct.pack("=BBhi", op, _REGS_BYTE(dst, src),
+                                       off, 0))
         return b"".join(out)

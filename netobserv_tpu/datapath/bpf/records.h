@@ -7,7 +7,9 @@
  * tests/test_layout_parity.py, which compiles this header with the host
  * compiler and diffs offsetof/sizeof against the dtypes. All padding is
  * explicit (`__pad*`) so the layout does not depend on compiler packing
- * decisions. Little-endian only.
+ * decisions. Fields carry the machine's native byte order (shared
+ * kernel<->user structs; userspace twins in model/binfmt.py are
+ * native-endian dtypes, so LE and BE targets both decode correctly).
  *
  * This header is deliberately self-contained (fixed-width types only, no
  * kernel headers) so it can be compiled both by clang -target bpf and by a

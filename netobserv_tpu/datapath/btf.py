@@ -63,11 +63,11 @@ class BTF:
     def __init__(self, path: str = "/sys/kernel/btf/vmlinux"):
         with open(path, "rb") as fh:
             data = fh.read()
-        magic, _ver, _flags, hdr_len = struct.unpack_from("<HBBI", data, 0)
+        magic, _ver, _flags, hdr_len = struct.unpack_from("=HBBI", data, 0)
         if magic != BTF_MAGIC:
             raise ValueError(f"{path}: not BTF (magic {magic:#x})")
         type_off, type_len, str_off, str_len = struct.unpack_from(
-            "<IIII", data, 8)
+            "=IIII", data, 8)
         self._strs = data[hdr_len + str_off:hdr_len + str_off + str_len]
         # types[i] = (kind, name_off, size_or_type, members)
         # members = [(name_off, type_id, offset_bits)] for STRUCT/UNION
@@ -77,7 +77,7 @@ class BTF:
         end = off + type_len
         tid = 0
         while off < end:
-            name_off, info, size = struct.unpack_from("<III", data, off)
+            name_off, info, size = struct.unpack_from("=III", data, off)
             off += 12
             kind = (info >> 24) & 0x1F
             vlen = info & 0xFFFF
@@ -86,7 +86,7 @@ class BTF:
                 members = []
                 for _ in range(vlen):
                     m_name, m_type, m_off = struct.unpack_from(
-                        "<III", data, off)
+                        "=III", data, off)
                     off += 12
                     if (info >> 31) & 1:  # kind_flag: bitfield encoding
                         m_off = m_off & 0xFFFFFF

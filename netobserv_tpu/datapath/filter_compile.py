@@ -77,7 +77,7 @@ def _lpm_key(cidr: str) -> bytes:
     net = ipaddress.ip_network(cidr, strict=False)
     raw = ip_to_16(str(net.network_address))
     prefix = net.prefixlen + (96 if net.version == 4 else 0)
-    return struct.pack("<I", prefix) + raw
+    return struct.pack("=I", prefix) + raw
 
 
 def _tcp_flags_value(name: str) -> int:

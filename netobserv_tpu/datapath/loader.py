@@ -185,11 +185,11 @@ class BpfmanFetcher:
         for ctr in GlobalCounter:
             if ctr is GlobalCounter.MAX:
                 continue
-            key = _struct.pack("<I", ctr.value)
+            key = _struct.pack("=I", ctr.value)
             raw = self._counters.lookup(key)
             if raw is None:
                 continue
-            total = sum(_struct.unpack_from("<Q", raw, off)[0]
+            total = sum(_struct.unpack_from("=Q", raw, off)[0]
                         for off in range(0, len(raw), 8))
             if total:
                 out[ctr] = total
@@ -268,7 +268,7 @@ class BpfmanFetcher:
                 raw = corr.lookup(key)
                 if raw is None:
                     continue
-                (sent_ns,) = _struct.unpack_from("<Q", raw, 0)
+                (sent_ns,) = _struct.unpack_from("=Q", raw, 0)
                 if sent_ns < deadline:
                     if corr.delete(key):
                         purged += 1

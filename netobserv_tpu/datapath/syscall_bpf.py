@@ -103,9 +103,9 @@ class BpfMap:
     def create(cls, map_type: int, key_size: int, value_size: int,
                max_entries: int, name: bytes = b"",
                flags: int = 0) -> "BpfMap":
-        attr = struct.pack("<IIII", map_type, key_size, value_size,
+        attr = struct.pack("=IIII", map_type, key_size, value_size,
                            max_entries)
-        attr += struct.pack("<I", flags)  # map_flags (LPM needs NO_PREALLOC)
+        attr += struct.pack("=I", flags)  # map_flags (LPM needs NO_PREALLOC)
         attr += b"\x00" * 4  # inner_map_fd
         attr += b"\x00" * 4  # numa_node
         attr += name[:15].ljust(16, b"\x00")
@@ -120,7 +120,7 @@ class BpfMap:
 
     def pin(self, path: str) -> None:
         pathbuf = ctypes.create_string_buffer(path.encode() + b"\x00")
-        attr = struct.pack("<QI", ctypes.addressof(pathbuf), self.fd)
+        attr = struct.pack("=QI", ctypes.addressof(pathbuf), self.fd)
         _bpf(BPF_OBJ_PIN, attr)
 
     @staticmethod
@@ -128,10 +128,10 @@ class BpfMap:
         """(map_type, key_size, value_size, max_entries) via
         BPF_OBJ_GET_INFO_BY_FD."""
         info = ctypes.create_string_buffer(88)  # struct bpf_map_info
-        attr = struct.pack("<IIQ", fd, len(info), ctypes.addressof(info))
+        attr = struct.pack("=IIQ", fd, len(info), ctypes.addressof(info))
         _bpf(BPF_OBJ_GET_INFO_BY_FD, attr)
         map_type, _id, key_size, value_size, max_entries = struct.unpack_from(
-            "<IIIII", info.raw, 0)
+            "=IIIII", info.raw, 0)
         return map_type, key_size, value_size, max_entries
 
     @classmethod
@@ -139,7 +139,7 @@ class BpfMap:
                     n_cpus: Optional[int] = None) -> "BpfMap":
         pathbuf = path.encode() + b"\x00"
         str_ptr = ctypes.create_string_buffer(pathbuf)
-        attr = struct.pack("<Q", ctypes.addressof(str_ptr))
+        attr = struct.pack("=Q", ctypes.addressof(str_ptr))
         fd = _bpf(BPF_OBJ_GET, attr)
         # validate the pinned map's REAL sizes: a layout mismatch would let
         # the kernel write past our value buffer (heap corruption)
@@ -180,7 +180,7 @@ class BpfMap:
         kbuf = ctypes.create_string_buffer(key, self.key_size)
         vbuf = value_buf if value_buf is not None else \
             ctypes.create_string_buffer(self._pad_vs * self.n_cpus)
-        attr = struct.pack("<IxxxxQQQ", self.fd, ctypes.addressof(kbuf),
+        attr = struct.pack("=IxxxxQQQ", self.fd, ctypes.addressof(kbuf),
                            ctypes.addressof(vbuf), flags)
         return attr, kbuf, vbuf
 
@@ -218,7 +218,7 @@ class BpfMap:
 
     def delete(self, key: bytes) -> bool:
         kbuf = ctypes.create_string_buffer(key, self.key_size)
-        attr = struct.pack("<IxxxxQQQ", self.fd, ctypes.addressof(kbuf), 0, 0)
+        attr = struct.pack("=IxxxxQQQ", self.fd, ctypes.addressof(kbuf), 0, 0)
         try:
             _bpf(BPF_MAP_DELETE_ELEM, attr)
             return True
@@ -231,7 +231,7 @@ class BpfMap:
         kbuf = ctypes.create_string_buffer(
             key if key is not None else b"\x00" * self.key_size, self.key_size)
         nbuf = ctypes.create_string_buffer(self.key_size)
-        attr = struct.pack("<IxxxxQQQ", self.fd,
+        attr = struct.pack("=IxxxxQQQ", self.fd,
                            0 if key is None else ctypes.addressof(kbuf),
                            ctypes.addressof(nbuf), 0)
         try:
@@ -283,7 +283,7 @@ class BpfMap:
         first = True
         while True:
             attr = bytearray(struct.pack(
-                "<QQQQIIQQ",
+                "=QQQQIIQQ",
                 0 if first else ctypes.addressof(tok_a),
                 ctypes.addressof(tok_b),
                 ctypes.addressof(kbuf), ctypes.addressof(vbuf),
@@ -318,7 +318,7 @@ class BpfMap:
                     return out
                 else:
                     raise
-            count = struct.unpack_from("<I", attr, 32)[0]
+            count = struct.unpack_from("=I", attr, 32)[0]
             # one bounded copy per round (count entries), not the whole
             # chunk-sized buffer
             kraw = kbuf[:count * self.key_size]
@@ -420,13 +420,13 @@ def prog_load(insns: bytes, prog_type: int = BPF_PROG_TYPE_SCHED_CLS,
 
     def attempt(log_level: int, log_buf) -> int:
         attr = struct.pack(
-            "<IIQQIIQI",
+            "=IIQQIIQI",
             prog_type, n_insns, ctypes.addressof(insn_buf),
             ctypes.addressof(lic_buf),
             log_level, len(log_buf) if log_buf is not None else 0,
             ctypes.addressof(log_buf) if log_buf is not None else 0,
             0)  # kern_version
-        attr += struct.pack("<I", 0)  # prog_flags
+        attr += struct.pack("=I", 0)  # prog_flags
         attr += name[:15].ljust(16, b"\x00")
         return _bpf(BPF_PROG_LOAD, attr)
 
@@ -447,7 +447,7 @@ def prog_load(insns: bytes, prog_type: int = BPF_PROG_TYPE_SCHED_CLS,
 
 def obj_pin(fd: int, path: str) -> None:
     pathbuf = ctypes.create_string_buffer(path.encode() + b"\x00")
-    attr = struct.pack("<QI", ctypes.addressof(pathbuf), fd)
+    attr = struct.pack("=QI", ctypes.addressof(pathbuf), fd)
     _bpf(BPF_OBJ_PIN, attr)
 
 
@@ -469,14 +469,14 @@ def link_create_tcx(prog_fd: int, if_index: int, direction: str) -> int:
     # union bpf_attr link_create: prog_fd, target_ifindex, attach_type, flags
     # + zeroed tcx { relative_fd/id, expected_revision } tail (= default
     # anchor position, no revision check)
-    attr = struct.pack("<IIII", prog_fd, if_index, attach_type, 0)
+    attr = struct.pack("=IIII", prog_fd, if_index, attach_type, 0)
     attr += b"\x00" * 16
     return _bpf(BPF_LINK_CREATE, attr)
 
 
 def link_detach(link_fd: int) -> None:
     """Explicit BPF_LINK_DETACH (the link fd alone also detaches on close)."""
-    attr = struct.pack("<I", link_fd)
+    attr = struct.pack("=I", link_fd)
     _bpf(BPF_LINK_DETACH, attr)
 
 
@@ -488,19 +488,19 @@ BPF_LINK_TYPE_TCX = 11
 def prog_id_of(prog_fd: int) -> int:
     """Kernel-assigned program id (bpf_prog_info.id)."""
     info = ctypes.create_string_buffer(256)
-    attr = struct.pack("<IIQ", prog_fd, len(info), ctypes.addressof(info))
+    attr = struct.pack("=IIQ", prog_fd, len(info), ctypes.addressof(info))
     _bpf(BPF_OBJ_GET_INFO_BY_FD, attr)
-    return struct.unpack_from("<I", info.raw, 4)[0]
+    return struct.unpack_from("=I", info.raw, 4)[0]
 
 
 def link_info(link_fd: int) -> tuple[int, int, int, int, int]:
     """(link_type, link_id, prog_id, tcx_ifindex, tcx_attach_type) — the tcx
     fields are only meaningful when link_type == BPF_LINK_TYPE_TCX."""
     info = ctypes.create_string_buffer(256)
-    attr = struct.pack("<IIQ", link_fd, len(info), ctypes.addressof(info))
+    attr = struct.pack("=IIQ", link_fd, len(info), ctypes.addressof(info))
     _bpf(BPF_OBJ_GET_INFO_BY_FD, attr)
-    ltype, lid, pid = struct.unpack_from("<III", info.raw, 0)
-    ifindex, attach_type = struct.unpack_from("<II", info.raw, 16)
+    ltype, lid, pid = struct.unpack_from("=III", info.raw, 0)
+    ifindex, attach_type = struct.unpack_from("=II", info.raw, 16)
     return ltype, lid, pid, ifindex, attach_type
 
 
@@ -508,14 +508,14 @@ def iter_link_ids():
     """Yield every bpf_link id on the system (CAP_BPF required)."""
     cur = 0
     while True:
-        attr = bytearray(struct.pack("<III", cur, 0, 0))
+        attr = bytearray(struct.pack("=III", cur, 0, 0))
         try:
             _bpf_inout(BPF_LINK_GET_NEXT_ID, attr)
         except OSError as exc:
             if exc.errno == errno.ENOENT:
                 return
             raise
-        cur = struct.unpack_from("<I", attr, 4)[0]
+        cur = struct.unpack_from("=I", attr, 4)[0]
         yield cur
 
 
@@ -527,7 +527,7 @@ def find_tcx_link(if_index: int, direction: str,
     tracer.go:464-480). Returns a link fd or None."""
     want = BPF_TCX_INGRESS if direction == "ingress" else BPF_TCX_EGRESS
     for lid in iter_link_ids():
-        attr = struct.pack("<I", lid)
+        attr = struct.pack("=I", lid)
         try:
             fd = _bpf(BPF_LINK_GET_FD_BY_ID, attr)
         except OSError:

@@ -48,16 +48,16 @@ def elf_func_offset(path: str, symbol: str) -> int:
         data = fh.read()
     if data[:4] != b"\x7fELF" or data[4] != 2:
         raise ValueError(f"{path}: not a 64-bit ELF")
-    (e_phoff,) = struct.unpack_from("<Q", data, 0x20)
-    (e_shoff,) = struct.unpack_from("<Q", data, 0x28)
-    e_phentsize, e_phnum = struct.unpack_from("<HH", data, 0x36)
-    e_shentsize, e_shnum = struct.unpack_from("<HH", data, 0x3A)
+    (e_phoff,) = struct.unpack_from("=Q", data, 0x20)
+    (e_shoff,) = struct.unpack_from("=Q", data, 0x28)
+    e_phentsize, e_phnum = struct.unpack_from("=HH", data, 0x36)
+    e_shentsize, e_shnum = struct.unpack_from("=HH", data, 0x3A)
 
     sections = []
     for i in range(e_shnum):
         off = e_shoff + i * e_shentsize
         (_name, stype, _flags, _addr, offset, size, link, _info, _align,
-         entsize) = struct.unpack_from("<IIQQQQIIQQ", data, off)
+         entsize) = struct.unpack_from("=IIQQQQIIQQ", data, off)
         sections.append((stype, offset, size, link, entsize))
 
     vaddr = None
@@ -67,8 +67,8 @@ def elf_func_offset(path: str, symbol: str) -> int:
         _t, str_off, str_size, _l, _e = sections[link]
         for j in range(size // entsize):
             st = offset + j * entsize
-            st_name, st_info = struct.unpack_from("<IB", data, st)
-            (st_value,) = struct.unpack_from("<Q", data, st + 8)
+            st_name, st_info = struct.unpack_from("=IB", data, st)
+            (st_value,) = struct.unpack_from("=Q", data, st + 8)
             if not st_value or (st_info & 0xF) != 2:  # STT_FUNC
                 continue
             end = data.index(b"\x00", str_off + st_name)
@@ -82,9 +82,9 @@ def elf_func_offset(path: str, symbol: str) -> int:
 
     for i in range(e_phnum):
         off = e_phoff + i * e_phentsize
-        p_type, _pf = struct.unpack_from("<II", data, off)
+        p_type, _pf = struct.unpack_from("=II", data, off)
         p_offset, p_vaddr, _paddr, p_filesz = struct.unpack_from(
-            "<QQQQ", data, off + 8)
+            "=QQQQ", data, off + 8)
         if p_type == PT_LOAD and p_vaddr <= vaddr < p_vaddr + p_filesz:
             return vaddr - p_vaddr + p_offset
     raise LookupError(f"{symbol}: vaddr {vaddr:#x} outside any PT_LOAD")
@@ -133,9 +133,9 @@ class UprobeAttachment(_PerfAttachment):
         self._path_buf = ctypes.create_string_buffer(
             os.fsencode(binary_path) + b"\x00")
         attr = bytearray(128)
-        struct.pack_into("<II", attr, 0, uprobe_pmu_type(), 112)
-        struct.pack_into("<Q", attr, 56, ctypes.addressof(self._path_buf))
-        struct.pack_into("<Q", attr, 64, file_offset)
+        struct.pack_into("=II", attr, 0, uprobe_pmu_type(), 112)
+        struct.pack_into("=Q", attr, 56, ctypes.addressof(self._path_buf))
+        struct.pack_into("=Q", attr, 64, file_offset)
         self._open_and_bind(attr, prog_fd,
                             f"uprobe {binary_path}+{file_offset:#x}")
 
@@ -188,9 +188,9 @@ class TracepointAttachment(_PerfAttachment):
 
     def __init__(self, prog_fd: int, category: str, name: str):
         attr = bytearray(128)
-        struct.pack_into("<II", attr, 0, PERF_TYPE_TRACEPOINT, 112)
-        struct.pack_into("<Q", attr, 8, tracepoint_id(category, name))
-        struct.pack_into("<Q", attr, 16, 1)  # sample_period (required != 0)
+        struct.pack_into("=II", attr, 0, PERF_TYPE_TRACEPOINT, 112)
+        struct.pack_into("=Q", attr, 8, tracepoint_id(category, name))
+        struct.pack_into("=Q", attr, 16, 1)  # sample_period (required != 0)
         self._open_and_bind(attr, prog_fd, f"tracepoint {category}/{name}")
 
 

@@ -51,7 +51,7 @@ def _parse_attrs(data: bytes) -> dict[int, bytes]:
     attrs = {}
     off = 0
     while off + 4 <= len(data):
-        alen, atype = struct.unpack_from("<HH", data, off)
+        alen, atype = struct.unpack_from("=HH", data, off)
         if alen < 4:
             break
         attrs[atype] = data[off + 4:off + alen]
@@ -63,7 +63,7 @@ def _parse_link_msg(msg_type: int, payload: bytes) -> Optional[LinkInfo]:
     if len(payload) < 16:
         return None
     _family, _pad, _dev_type, index, flags, _change = struct.unpack_from(
-        "<BBHiII", payload, 0)
+        "=BBHiII", payload, 0)
     attrs = _parse_attrs(payload[16:])
     name = attrs.get(IFLA_IFNAME, b"").split(b"\x00")[0].decode(
         "ascii", "replace")
@@ -76,7 +76,7 @@ def _recv_messages(sock: socket.socket) -> Iterator[tuple[int, bytes]]:
     data = sock.recv(65536)
     off = 0
     while off + 16 <= len(data):
-        mlen, mtype, _flags, _seq, _pid = struct.unpack_from("<IHHII", data, off)
+        mlen, mtype, _flags, _seq, _pid = struct.unpack_from("=IHHII", data, off)
         if mlen < 16:
             break
         yield mtype, data[off + 16:off + mlen]
@@ -88,7 +88,7 @@ def dump_links() -> list[LinkInfo]:
     sock = socket.socket(socket.AF_NETLINK, socket.SOCK_RAW, NETLINK_ROUTE)
     try:
         sock.bind((0, 0))
-        req = struct.pack("<IHHIIBBHiII", 16 + 16, RTM_GETLINK,
+        req = struct.pack("=IHHIIBBHiII", 16 + 16, RTM_GETLINK,
                           NLM_F_REQUEST | NLM_F_DUMP, 1, 0,
                           socket.AF_UNSPEC, 0, 0, 0, 0, 0)
         sock.send(req)
@@ -115,7 +115,7 @@ def dump_addrs() -> list[tuple[int, bytes]]:
     sock = socket.socket(socket.AF_NETLINK, socket.SOCK_RAW, NETLINK_ROUTE)
     try:
         sock.bind((0, 0))
-        req = struct.pack("<IHHIIBBBBi", 16 + 8, RTM_GETADDR,
+        req = struct.pack("=IHHIIBBBBi", 16 + 8, RTM_GETADDR,
                           NLM_F_REQUEST | NLM_F_DUMP, 1, 0,
                           socket.AF_UNSPEC, 0, 0, 0, 0)
         sock.send(req)
@@ -130,7 +130,7 @@ def dump_addrs() -> list[tuple[int, bytes]]:
                     raise OSError("netlink error on RTM_GETADDR dump")
                 if mtype == RTM_NEWADDR and len(payload) >= 8:
                     _family, _plen, _flags, _scope, index = struct.unpack_from(
-                        "<BBBBi", payload, 0)
+                        "=BBBBi", payload, 0)
                     attrs = _parse_attrs(payload[8:])
                     addr = attrs.get(IFA_ADDRESS)
                     if addr:

@@ -12,8 +12,15 @@ ringbuffer drain or a map dump into a structured array in one call — the analo
 the reference's per-record `binary.Read` loop (`pkg/model/record.go:227-231`), which
 was its hottest allocation site, done columnar instead.
 
-All layouts are little-endian + naturally aligned (BPF targets are LE on every arch
-the reference ships: amd64/arm64/ppc64le/s390x-emulated... we pin LE explicitly).
+All layouts are NATIVE-endian + naturally aligned: these structs are shared
+with the in-kernel datapath on the same machine, so they carry the machine's
+byte order — native dtypes are bit-identical to the old explicit-LE ones on
+every little-endian arch (amd64/arm64/ppc64le/riscv64). Every kernel-ABI
+module follows the same rule (guard: tests/test_layout_parity.py native-
+endian scan), and the instruction assembler additionally flips the
+bpf_insn register-bitfield nibble on big-endian hosts — s390x is therefore
+correct by design but NOT CI-verified (no big-endian runners); amd64 and
+real arm64 both run the full suite in CI (.github/workflows/ci.yml).
 """
 
 from __future__ import annotations
@@ -28,8 +35,8 @@ from netobserv_tpu.model import flow as _flow
 FLOW_KEY_DTYPE = np.dtype([
     ("src_ip", "u1", 16),
     ("dst_ip", "u1", 16),
-    ("src_port", "<u2"),
-    ("dst_port", "<u2"),
+    ("src_port", "u2"),
+    ("dst_port", "u2"),
     ("proto", "u1"),
     ("icmp_type", "u1"),
     ("icmp_code", "u1"),
@@ -45,27 +52,27 @@ assert FLOW_KEY_DTYPE.itemsize == 40
 NIFS = _flow.MAX_OBSERVED_INTERFACES
 
 FLOW_STATS_DTYPE = np.dtype([
-    ("first_seen_ns", "<u8"),
-    ("last_seen_ns", "<u8"),
-    ("bytes", "<u8"),
-    ("packets", "<u4"),
-    ("eth_protocol", "<u2"),
-    ("tcp_flags", "<u2"),
+    ("first_seen_ns", "u8"),
+    ("last_seen_ns", "u8"),
+    ("bytes", "u8"),
+    ("packets", "u4"),
+    ("eth_protocol", "u2"),
+    ("tcp_flags", "u2"),
     ("src_mac", "u1", 6),
     ("dst_mac", "u1", 6),
-    ("if_index_first", "<u4"),
-    ("lock", "<u4"),
-    ("sampling", "<u4"),
+    ("if_index_first", "u4"),
+    ("lock", "u4"),
+    ("sampling", "u4"),
     ("direction_first", "u1"),
     ("errno_fallback", "u1"),
     ("dscp", "u1"),
     ("n_observed_intf", "u1"),
     ("observed_direction", "u1", NIFS),
     ("pad0", "u1", 2),  # aligns observed_intf (u32[]) to 4 in the C struct
-    ("observed_intf", "<u4", NIFS),
-    ("ssl_version", "<u2"),
-    ("tls_cipher_suite", "<u2"),
-    ("tls_key_share", "<u2"),
+    ("observed_intf", "u4", NIFS),
+    ("ssl_version", "u2"),
+    ("tls_cipher_suite", "u2"),
+    ("tls_key_share", "u2"),
     ("tls_types", "u1"),
     ("misc_flags", "u1"),
     ("pad1", "u1", 4),
@@ -85,12 +92,12 @@ assert FLOW_EVENT_DTYPE.itemsize == 144
 # per-feature records (values of the per-CPU feature maps, merged at eviction)
 # ---------------------------------------------------------------------------
 DNS_REC_DTYPE = np.dtype([
-    ("first_seen_ns", "<u8"),
-    ("last_seen_ns", "<u8"),
-    ("latency_ns", "<u8"),
-    ("dns_id", "<u2"),
-    ("dns_flags", "<u2"),
-    ("eth_protocol", "<u2"),
+    ("first_seen_ns", "u8"),
+    ("last_seen_ns", "u8"),
+    ("latency_ns", "u8"),
+    ("dns_id", "u2"),
+    ("dns_flags", "u2"),
+    ("eth_protocol", "u2"),
     ("errno", "u1"),
     ("name", "S32"),  # DNS_NAME_MAX_LEN
     ("pad0", "u1", 1),
@@ -98,58 +105,58 @@ DNS_REC_DTYPE = np.dtype([
 assert DNS_REC_DTYPE.itemsize == 64, DNS_REC_DTYPE.itemsize
 
 DROPS_REC_DTYPE = np.dtype([
-    ("first_seen_ns", "<u8"),
-    ("last_seen_ns", "<u8"),
-    ("bytes", "<u2"),
-    ("packets", "<u2"),
-    ("latest_cause", "<u4"),
-    ("latest_flags", "<u2"),
-    ("eth_protocol", "<u2"),
+    ("first_seen_ns", "u8"),
+    ("last_seen_ns", "u8"),
+    ("bytes", "u2"),
+    ("packets", "u2"),
+    ("latest_cause", "u4"),
+    ("latest_flags", "u2"),
+    ("eth_protocol", "u2"),
     ("latest_state", "u1"),
     ("pad0", "u1", 3),
 ])
 assert DROPS_REC_DTYPE.itemsize == 32, DROPS_REC_DTYPE.itemsize
 
 NEVENTS_REC_DTYPE = np.dtype([
-    ("first_seen_ns", "<u8"),
-    ("last_seen_ns", "<u8"),
+    ("first_seen_ns", "u8"),
+    ("last_seen_ns", "u8"),
     ("events", "u1", (_flow.MAX_NETWORK_EVENTS, _flow.MAX_EVENT_MD)),
-    ("bytes", "<u2", _flow.MAX_NETWORK_EVENTS),
-    ("packets", "<u2", _flow.MAX_NETWORK_EVENTS),
-    ("eth_protocol", "<u2"),
+    ("bytes", "u2", _flow.MAX_NETWORK_EVENTS),
+    ("packets", "u2", _flow.MAX_NETWORK_EVENTS),
+    ("eth_protocol", "u2"),
     ("n_events", "u1"),
     ("pad0", "u1", 5),
 ])
 assert NEVENTS_REC_DTYPE.itemsize == 72, NEVENTS_REC_DTYPE.itemsize
 
 XLAT_REC_DTYPE = np.dtype([
-    ("first_seen_ns", "<u8"),
-    ("last_seen_ns", "<u8"),
+    ("first_seen_ns", "u8"),
+    ("last_seen_ns", "u8"),
     ("src_ip", "u1", 16),
     ("dst_ip", "u1", 16),
-    ("src_port", "<u2"),
-    ("dst_port", "<u2"),
-    ("zone_id", "<u2"),
-    ("eth_protocol", "<u2"),
+    ("src_port", "u2"),
+    ("dst_port", "u2"),
+    ("zone_id", "u2"),
+    ("eth_protocol", "u2"),
 ])
 assert XLAT_REC_DTYPE.itemsize == 56, XLAT_REC_DTYPE.itemsize
 
 EXTRA_REC_DTYPE = np.dtype([  # rtt + ipsec (reference: additional_metrics_t)
-    ("first_seen_ns", "<u8"),
-    ("last_seen_ns", "<u8"),
-    ("rtt_ns", "<u8"),
-    ("ipsec_ret", "<i4"),
-    ("eth_protocol", "<u2"),
+    ("first_seen_ns", "u8"),
+    ("last_seen_ns", "u8"),
+    ("rtt_ns", "u8"),
+    ("ipsec_ret", "i4"),
+    ("eth_protocol", "u2"),
     ("ipsec_encrypted", "u1"),
     ("pad0", "u1", 1),
 ])
 assert EXTRA_REC_DTYPE.itemsize == 32, EXTRA_REC_DTYPE.itemsize
 
 QUIC_REC_DTYPE = np.dtype([
-    ("first_seen_ns", "<u8"),
-    ("last_seen_ns", "<u8"),
-    ("version", "<u4"),
-    ("eth_protocol", "<u2"),
+    ("first_seen_ns", "u8"),
+    ("last_seen_ns", "u8"),
+    ("version", "u4"),
+    ("eth_protocol", "u2"),
     ("seen_long_hdr", "u1"),
     ("seen_short_hdr", "u1"),
 ])
@@ -160,7 +167,7 @@ assert QUIC_REC_DTYPE.itemsize == 24, QUIC_REC_DTYPE.itemsize
 # (written by datapath/filter_compile.py, matched by bpf/filter.h)
 # ---------------------------------------------------------------------------
 FILTER_KEY_DTYPE = np.dtype([
-    ("prefix_len", "<u4"),
+    ("prefix_len", "u4"),
     ("ip", "u1", 16),
 ])
 assert FILTER_KEY_DTYPE.itemsize == 20
@@ -174,15 +181,15 @@ FILTER_RULE_DTYPE = np.dtype([
     ("want_drops", "u1"),
     ("peer_cidr_check", "u1"),
     ("pad0", "u1"),
-    ("dport_start", "<u2"), ("dport_end", "<u2"),
-    ("dport1", "<u2"), ("dport2", "<u2"),
-    ("sport_start", "<u2"), ("sport_end", "<u2"),
-    ("sport1", "<u2"), ("sport2", "<u2"),
-    ("port_start", "<u2"), ("port_end", "<u2"),
-    ("port1", "<u2"), ("port2", "<u2"),
-    ("tcp_flags", "<u2"),
+    ("dport_start", "u2"), ("dport_end", "u2"),
+    ("dport1", "u2"), ("dport2", "u2"),
+    ("sport_start", "u2"), ("sport_end", "u2"),
+    ("sport1", "u2"), ("sport2", "u2"),
+    ("port_start", "u2"), ("port_end", "u2"),
+    ("port1", "u2"), ("port2", "u2"),
+    ("tcp_flags", "u2"),
     ("pad1", "u1", 2),
-    ("sample_override", "<u4"),
+    ("sample_override", "u4"),
 ])
 assert FILTER_RULE_DTYPE.itemsize == 40, FILTER_RULE_DTYPE.itemsize
 
@@ -192,9 +199,9 @@ assert FILTER_RULE_DTYPE.itemsize == 40, FILTER_RULE_DTYPE.itemsize
 MAX_PAYLOAD_SIZE = 256
 
 PACKET_EVENT_DTYPE = np.dtype([
-    ("if_index", "<u4"),
-    ("pkt_len", "<u4"),
-    ("timestamp_ns", "<u8"),
+    ("if_index", "u4"),
+    ("pkt_len", "u4"),
+    ("timestamp_ns", "u8"),
     ("payload", "u1", MAX_PAYLOAD_SIZE),
 ])
 assert PACKET_EVENT_DTYPE.itemsize == 272
@@ -205,9 +212,9 @@ assert PACKET_EVENT_DTYPE.itemsize == 272
 MAX_SSL_DATA = 16 * 1024
 
 SSL_EVENT_DTYPE = np.dtype([
-    ("timestamp_ns", "<u8"),
-    ("pid_tgid", "<u8"),
-    ("data_len", "<i4"),
+    ("timestamp_ns", "u8"),
+    ("pid_tgid", "u8"),
+    ("data_len", "i4"),
     ("ssl_type", "u1"),
     ("pad0", "u1", 3),
     ("data", "u1", MAX_SSL_DATA),

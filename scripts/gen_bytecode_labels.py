@@ -1,0 +1,67 @@
+"""Emit the bpfman bytecode-image label JSON (programs + maps) from the
+repo's canonical sources, so the container labels can never drift from the
+code (reference analog: the hand-maintained PROGRAMS/MAPS blocks in
+`.mk/bc.mk` — here they are DERIVED: programs from the C sections, maps
+from datapath/maps.py + maps.h types).
+
+Usage: python scripts/gen_bytecode_labels.py {programs|maps}
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+BPF_DIR = os.path.join(os.path.dirname(__file__), "..", "netobserv_tpu",
+                       "datapath", "bpf")
+
+# SEC prefix -> bpfman program type
+_SEC_TYPES = [
+    ("tcx/", "tcx"), ("tc_", "tc"), ("fentry/", "fentry"),
+    ("kretprobe/", "kretprobe"), ("kprobe/", "kprobe"),
+    ("tracepoint/", "tracepoint"), ("uprobe/", "uprobe"),
+]
+
+_SEC_RE = re.compile(
+    r'SEC\("([^"]+)"\)\s*\n\s*int\s+'
+    r'(?:BPF_(?:KPROBE|KRETPROBE|PROG)\(\s*)?(\w+)')
+_MAP_RE = re.compile(r"DEF_MAP\((\w+),\s*BPF_MAP_TYPE_(\w+)")
+_RINGBUF_RE = re.compile(r"DEF_RINGBUF\((\w+)")
+
+
+def programs() -> dict[str, str]:
+    out: dict[str, str] = {}
+    for fname in ("flowpath.c", "flowpath_probes.c"):
+        src = open(os.path.join(BPF_DIR, fname)).read()
+        for sec, name in _SEC_RE.findall(src):
+            if sec == "license":
+                continue
+            for prefix, ptype in _SEC_TYPES:
+                if sec.startswith(prefix):
+                    out[name] = ptype
+                    break
+    return out
+
+
+def maps() -> dict[str, str]:
+    from netobserv_tpu.datapath.maps import MAPS
+
+    type_by_name: dict[str, str] = {}
+    for fname in ("maps.h",):
+        src = open(os.path.join(BPF_DIR, fname)).read()
+        for name, mtype in _MAP_RE.findall(src):
+            type_by_name[name] = mtype.lower()
+        for name in _RINGBUF_RE.findall(src):
+            type_by_name[name] = "ringbuf"
+    missing = [m for m in MAPS if m not in type_by_name]
+    assert not missing, f"maps.h lacks registry maps: {missing}"
+    return {m: type_by_name[m] for m in MAPS}
+
+
+if __name__ == "__main__":
+    kind = sys.argv[1] if len(sys.argv) > 1 else "programs"
+    print(json.dumps(programs() if kind == "programs" else maps(),
+                     separators=(",", ":")))

@@ -54,3 +54,28 @@ def test_config_constants_present():
                  "cfg_enable_tls_tracking", "cfg_quic_mode",
                  "cfg_enable_ringbuf_fallback", "cfg_enable_pca"]:
         assert re.search(rf"volatile const \w+ {knob}\b", src), knob
+
+
+def test_bytecode_labels_cover_registry_and_programs():
+    """The bpfman bytecode-image labels are generated from the canonical
+    sources (scripts/gen_bytecode_labels.py); every registry map and every
+    non-uprobe entry point must be present with a sane type."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from scripts.gen_bytecode_labels import maps, programs
+
+    from netobserv_tpu.datapath.maps import MAPS
+
+    m = maps()
+    assert set(m) == set(MAPS)
+    assert m["aggregated_flows"] == "hash"
+    assert m["direct_flows"] == "ringbuf"
+    assert m["flows_dns"] == "percpu_hash"
+    p = programs()
+    for name, ptype in (("tcx_ingress_flow", "tcx"), ("tc_egress_flow", "tc"),
+                        ("rtt_fentry", "fentry"), ("rtt_kprobe", "kprobe"),
+                        ("xlat_kprobe", "kprobe"), ("drops_tp", "tracepoint"),
+                        ("ipsec_out_return", "kretprobe")):
+        assert p.get(name) == ptype, (name, p.get(name))

@@ -118,3 +118,33 @@ def test_no_implicit_padding_surprises(c_layout):
         for name in dtype.names:
             covered += dtype.fields[name][0].itemsize
         assert covered == dtype.itemsize, f"{cname} dtype has implicit gaps"
+
+
+def test_kernel_shared_layouts_are_native_endian():
+    """Multi-arch guard: kernel<->user structs carry the MACHINE's byte
+    order — an explicit-endian dtype or struct format would silently
+    mis-decode on the opposite-endian arch (reference ships
+    amd64/arm64/ppc64le/s390x, pkg/ebpf/gen.go). numpy normalizes '<' to
+    native on LE hosts, so the guard scans the SOURCE for pinned orders in
+    every kernel-ABI module."""
+    import inspect
+
+    from netobserv_tpu.datapath import (
+        asm, btf, filter_compile, loader, syscall_bpf, uprobe,
+    )
+    from netobserv_tpu.ifaces import netlink
+    from netobserv_tpu.model import binfmt
+
+    # NOT scanned (deliberately): libbpf.py parses LE BPF ELF objects
+    # (clang -target bpf emits bpfel), replay.py detects pcap endianness
+    # from the file magic, and the wire exporters use network byte order.
+    import re
+
+    fmt = re.compile(r"""["'][<>][0-9BbHhIiQqLlfdsx]+["']""")
+    for mod in (binfmt, syscall_bpf, asm, netlink, loader, uprobe, btf,
+                filter_compile):
+        src = inspect.getsource(mod)
+        hits = fmt.findall(src)
+        assert not hits, \
+            f"{mod.__name__} pins byte order in a kernel-ABI layout " \
+            f"({hits}); use native order"
