@@ -144,6 +144,23 @@ def dense_to_arrays(dense: jax.Array) -> dict[str, jax.Array]:
     }
 
 
+def arrays_to_dense(arrays: dict[str, np.ndarray]) -> np.ndarray:
+    """Host-side inverse of dense_to_arrays: pack an array dict into the
+    flat flowpack dense feed — the one Python twin of the row layout pinned
+    in flowpack.cc fp_pack_dense (tests and the dryrun build synthetic
+    batches through here so a layout change has a single site)."""
+    n = len(arrays["valid"])
+    dense = np.zeros((n, DENSE_WORDS), np.uint32)
+    dense[:, :KEY_WORDS] = arrays["keys"]
+    dense[:, 10] = np.asarray(arrays["bytes"], np.float32).view(np.uint32)
+    dense[:, 11] = arrays["packets"]
+    dense[:, 12] = arrays["rtt_us"]
+    dense[:, 13] = arrays["dns_latency_us"]
+    dense[:, 14] = np.asarray(arrays["valid"], np.uint32)
+    dense[:, 15] = arrays.get("sampling", np.zeros(n, np.int32))
+    return dense.reshape(-1)
+
+
 def ingest(state: SketchState, arrays: dict[str, jax.Array],
            sketch_axis: str | None = None, sketch_shards: int = 1,
            use_pallas: bool | None = None) -> SketchState:

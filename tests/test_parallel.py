@@ -88,19 +88,9 @@ def test_sharded_matches_single_device(mesh_shape):
         assert got_counts[k] == pytest.approx(ref_counts[k], rel=1e-5)
 
 
-def arrays_to_dense(arrays):
-    """Inverse transport: the same batch in flowpack's (B,16)u32 dense form."""
-    from netobserv_tpu.datapath.flowpack import DENSE_WORDS
-
-    n = len(arrays["valid"])
-    dense = np.zeros((n, DENSE_WORDS), np.uint32)
-    dense[:, :KW] = arrays["keys"]
-    dense[:, 10] = arrays["bytes"].view(np.uint32)
-    dense[:, 11] = arrays["packets"]
-    dense[:, 12] = arrays["rtt_us"]
-    dense[:, 13] = arrays["dns_latency_us"]
-    dense[:, 14] = arrays["valid"]
-    return dense
+# inverse transport: the shared single-site packer (layout twin of
+# flowpack.cc fp_pack_dense)
+arrays_to_dense = sk.arrays_to_dense
 
 
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
