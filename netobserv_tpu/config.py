@@ -255,6 +255,10 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     # window handling: "reset" zeroes sketches each window; "decay" multiplies
     # linear sketches by SKETCH_DECAY_FACTOR instead (sliding-window flavor)
     sketch_window_mode: str = field(default="reset", **_env("SKETCH_WINDOW_MODE", "reset"))
+    #: per-window distinct-(dst addr, dst port) pair fan-out at which a
+    #: source bucket is reported as a port-scan suspect
+    sketch_scan_fanout: int = field(default=512,
+                                    **_env("SKETCH_SCAN_FANOUT", "512"))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))
     # where window reports go: "stdout" (JSON lines) or "kafka" (uses the
     # KAFKA_* settings; one message per report, key = "sketch_report")

@@ -83,7 +83,8 @@ def make_report_sink(cfg) -> ReportSink:
     return _default_sink
 
 
-def report_to_json(report, max_heavy: int = 64) -> dict:
+def report_to_json(report, max_heavy: int = 64,
+                   scan_fanout_threshold: float = 512.0) -> dict:
     """Render a device WindowReport into a host JSON object."""
     words = np.asarray(report.heavy.words)
     valid = np.asarray(report.heavy.valid)
@@ -105,6 +106,12 @@ def report_to_json(report, max_heavy: int = 64) -> dict:
             })
     z = np.asarray(report.ddos_z)
     suspects = np.nonzero(z > 6.0)[0]
+    # port-scan suspects: source buckets whose distinct-(dst addr, dst
+    # port) PAIR fan-out this window exceeds the threshold (a scanner
+    # touches hundreds+; a normal client a handful)
+    fanout = np.asarray(report.per_src_fanout)
+    scan = np.argsort(fanout)[::-1]
+    scan = scan[fanout[scan] >= scan_fanout_threshold]
     qs = [0.5, 0.9, 0.95, 0.99, 0.999]
     return {
         "Type": "sketch_window_report",
@@ -119,6 +126,9 @@ def report_to_json(report, max_heavy: int = 64) -> dict:
             qs, np.asarray(report.dns_quantiles_us))},
         "DdosSuspectBuckets": [
             {"bucket": int(b), "z": float(z[b])} for b in suspects[:32]],
+        "PortScanSuspectBuckets": [
+            {"bucket": int(b), "distinct_dst_port_pairs": float(fanout[b])}
+            for b in scan[:32]],
     }
 
 
@@ -130,7 +140,8 @@ class TpuSketchExporter(Exporter):
                  sketch_cfg=None, mesh_shape: str = "", devices: str = "",
                  sink: Optional[ReportSink] = None, metrics=None,
                  checkpoint_dir: str = "", checkpoint_every: int = 0,
-                 decay_factor: Optional[float] = None):
+                 decay_factor: Optional[float] = None,
+                 scan_fanout_threshold: float = 512.0):
         # jax-importing modules are pulled in lazily so the host agent can run
         # exporter-free on machines without accelerators
         from netobserv_tpu.sketch import state as sk
@@ -140,6 +151,7 @@ class TpuSketchExporter(Exporter):
         self._window_s = window_s
         self._cfg = sketch_cfg or sk.SketchConfig()
         self._sink = sink or _default_sink
+        self._scan_fanout = scan_fanout_threshold
         self._metrics = metrics
         self._lock = threading.Lock()
         self._pending: list[Record] = []
@@ -236,6 +248,7 @@ class TpuSketchExporter(Exporter):
                    mesh_shape=cfg.sketch_mesh_shape, metrics=metrics, sink=sink,
                    checkpoint_dir=cfg.sketch_checkpoint_dir,
                    checkpoint_every=cfg.sketch_checkpoint_every,
+                   scan_fanout_threshold=cfg.sketch_scan_fanout,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
 
@@ -381,7 +394,8 @@ class TpuSketchExporter(Exporter):
     def _emit_window(self) -> None:
         self._window_deadline = time.monotonic() + self._window_s
         self._state, report = self._roll(self._state)
-        obj = report_to_json(report)
+        obj = report_to_json(
+            report, scan_fanout_threshold=self._scan_fanout)
         obj["TimestampMs"] = time.time_ns() // 1_000_000
         self._sink(obj)
         if self._metrics is not None:

@@ -48,6 +48,7 @@ def _state_specs(state: sk.SketchState) -> sk.SketchState:
         heavy=topk.TopK(words=h, h1=h, h2=h, counts=h, valid=h),
         hll_src=hll.HLL(regs=d),
         hll_per_dst=hll.PerDstHLL(regs=d),
+        hll_per_src=hll.PerDstHLL(regs=d),
         hist_rtt=quantile.LogHist(counts=d),
         hist_dns=quantile.LogHist(counts=d),
         ddos=ewma.EWMA(mean=d, var=d, rate=d, windows=d),
@@ -193,6 +194,7 @@ def merge_states(s: sk.SketchState, nsk: int) -> sk.SketchState:
         cm_bytes=cm_b, cm_pkts=cm_p, heavy=heavy,
         hll_src=hll.HLL(jax.lax.pmax(s.hll_src.regs, DATA_AXIS)),
         hll_per_dst=hll.PerDstHLL(jax.lax.pmax(s.hll_per_dst.regs, DATA_AXIS)),
+        hll_per_src=hll.PerDstHLL(jax.lax.pmax(s.hll_per_src.regs, DATA_AXIS)),
         hist_rtt=quantile.LogHist(jax.lax.psum(s.hist_rtt.counts, DATA_AXIS)),
         hist_dns=quantile.LogHist(jax.lax.psum(s.hist_dns.counts, DATA_AXIS)),
         ddos=ewma.EWMA(mean=s.ddos.mean, var=s.ddos.var,
@@ -219,7 +221,8 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
 
     report_specs = sk.WindowReport(
         heavy=topk.TopK(words=P(), h1=P(), h2=P(), counts=P(), valid=P()),
-        distinct_src=P(), per_dst_cardinality=P(), rtt_quantiles_us=P(),
+        distinct_src=P(), per_dst_cardinality=P(), per_src_fanout=P(),
+        rtt_quantiles_us=P(),
         dns_quantiles_us=P(), ddos_z=P(), total_records=P(), total_bytes=P(),
         window=P(),
     )
@@ -233,6 +236,7 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
             heavy=merged.heavy,
             distinct_src=hll.estimate(merged.hll_src.regs),
             per_dst_cardinality=hll.estimate(merged.hll_per_dst.regs),
+            per_src_fanout=hll.estimate(merged.hll_per_src.regs),
             rtt_quantiles_us=quantile.quantile(merged.hist_rtt,
                                                jnp.asarray(sk.QS), gamma),
             dns_quantiles_us=quantile.quantile(merged.hist_dns,

@@ -31,6 +31,10 @@ class SketchConfig(NamedTuple):
     hll_precision: int = 14
     perdst_buckets: int = 4096
     perdst_precision: int = 6
+    # per-SOURCE fan-out grid (port-scan detection): distinct (dst, dport)
+    # per source bucket
+    persrc_buckets: int = 4096
+    persrc_precision: int = 6
     topk: int = 1024
     hist_buckets: int = 1024
     ewma_buckets: int = 4096
@@ -61,6 +65,7 @@ class SketchState(NamedTuple):
     heavy: topk.TopK
     hll_src: hll.HLL
     hll_per_dst: hll.PerDstHLL
+    hll_per_src: hll.PerDstHLL  # fan-out grid: distinct (dst,port) per src
     hist_rtt: quantile.LogHist
     hist_dns: quantile.LogHist
     ddos: ewma.EWMA
@@ -75,6 +80,7 @@ class WindowReport(NamedTuple):
     heavy: topk.TopK
     distinct_src: jax.Array        # f32[] global cardinality estimate
     per_dst_cardinality: jax.Array  # f32[D]
+    per_src_fanout: jax.Array       # f32[S] distinct (dst,port) per src bucket
     rtt_quantiles_us: jax.Array    # f32[5] for q = .5 .9 .95 .99 .999
     dns_quantiles_us: jax.Array    # f32[5]
     ddos_z: jax.Array              # f32[m] z-score per dst bucket
@@ -95,6 +101,8 @@ def init_state(cfg: SketchConfig = SketchConfig()) -> SketchState:
         heavy=topk.init(cfg.topk, KEY_WORDS),
         hll_src=hll.init(cfg.hll_precision),
         hll_per_dst=hll.init_per_dst(cfg.perdst_buckets, cfg.perdst_precision),
+        hll_per_src=hll.init_per_dst(cfg.persrc_buckets,
+                                     cfg.persrc_precision),
         hist_rtt=quantile.init(cfg.hist_buckets),
         hist_dns=quantile.init(cfg.hist_buckets),
         ddos=ewma.init(cfg.ewma_buckets),
@@ -237,6 +245,14 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     else:
         hll_src = hll.update(state.hll_src, src_h1, src_h2, valid)
     per_dst = hll.update_per_dst(state.hll_per_dst, dst_h1, src_h1, src_h2, valid)
+    # port-scan signal: distinct (dst addr, dst port) fan-out per SOURCE
+    # bucket — a scanner touches many; a normal client few (dst port =
+    # low half of key word 8, see pack_key_words)
+    dstport_cols = jnp.concatenate(
+        [words[:, 4:8], (words[:, 8] & jnp.uint32(0xFFFF))[:, None]], axis=1)
+    dp_h1, dp_h2 = hashing.base_hashes(dstport_cols, seed=0x5CA7)
+    per_src = hll.update_per_dst(state.hll_per_src, src_h1, dp_h1, dp_h2,
+                                 valid)
     rtt = arrays["rtt_us"]
     dns = arrays["dns_latency_us"]
     gamma = quantile.gamma_for(state.hist_rtt.n_buckets)
@@ -246,7 +262,8 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
 
     return SketchState(
         cm_bytes=cm_b, cm_pkts=cm_p, heavy=heavy, hll_src=hll_src,
-        hll_per_dst=per_dst, hist_rtt=hist_rtt, hist_dns=hist_dns, ddos=ddos,
+        hll_per_dst=per_dst, hll_per_src=per_src, hist_rtt=hist_rtt,
+        hist_dns=hist_dns, ddos=ddos,
         total_records=state.total_records + jnp.sum(valid.astype(jnp.float32)),
         total_bytes=state.total_bytes + jnp.sum(
             jnp.where(valid, bytes_f, 0.0)),
@@ -344,6 +361,7 @@ def decay_state(state: SketchState, factor: float) -> SketchState:
              ).astype(state.cm_pkts.counts.dtype)),
         hll_src=hll.HLL(jnp.zeros_like(state.hll_src.regs)),
         hll_per_dst=hll.PerDstHLL(jnp.zeros_like(state.hll_per_dst.regs)),
+        hll_per_src=hll.PerDstHLL(jnp.zeros_like(state.hll_per_src.regs)),
         hist_rtt=quantile.LogHist(state.hist_rtt.counts * factor),
         hist_dns=quantile.LogHist(state.hist_dns.counts * factor),
         total_records=state.total_records * factor,
@@ -363,6 +381,7 @@ def roll_window(state: SketchState, cfg: SketchConfig,
         heavy=state.heavy,
         distinct_src=hll.estimate(state.hll_src.regs),
         per_dst_cardinality=hll.estimate(state.hll_per_dst.regs),
+        per_src_fanout=hll.estimate(state.hll_per_src.regs),
         rtt_quantiles_us=quantile.quantile(state.hist_rtt, jnp.asarray(QS), gamma),
         dns_quantiles_us=quantile.quantile(state.hist_dns, jnp.asarray(QS), gamma),
         ddos_z=z,
@@ -379,6 +398,8 @@ def roll_window(state: SketchState, cfg: SketchConfig,
             hll_precision=state.hll_src.precision,
             perdst_buckets=state.hll_per_dst.regs.shape[0],
             perdst_precision=int(state.hll_per_dst.regs.shape[1]).bit_length() - 1,
+            persrc_buckets=state.hll_per_src.regs.shape[0],
+            persrc_precision=int(state.hll_per_src.regs.shape[1]).bit_length() - 1,
             topk=state.heavy.k, hist_buckets=state.hist_rtt.n_buckets,
             ewma_buckets=state.ddos.rate.shape[0], ewma_alpha=cfg.ewma_alpha))
         new_state = fresh._replace(ddos=ddos_state,

@@ -270,3 +270,64 @@ class TestEWMA:
         bucket3 = int(np.asarray(dsts)[3]) & 255
         assert sus[bucket3]
         assert sus.sum() == 1
+
+
+def test_port_scan_fanout_detection():
+    """Per-source fan-out grid (beyond-reference analytics): a scanner
+    touching thousands of distinct (dst, port) pairs must light up its
+    source bucket's fan-out estimate and surface in the window report's
+    PortScanSuspectBuckets; normal clients must not."""
+    import numpy as np
+
+    from netobserv_tpu.exporter.tpu_sketch import report_to_json
+    from netobserv_tpu.model.columnar import pack_key_words
+    from netobserv_tpu.sketch import state as sk
+
+    rng = np.random.default_rng(5)
+    cfg = sk.SketchConfig(cm_width=1 << 12, topk=64, persrc_buckets=256,
+                          persrc_precision=6)
+    state = sk.init_state(cfg)
+    ingest = jax.jit(sk.ingest)
+
+    def batch(keys):
+        n = len(keys)
+        return {
+            "keys": keys, "bytes": np.full(n, 100.0, np.float32),
+            "packets": np.ones(n, np.int32),
+            "rtt_us": np.zeros(n, np.int32),
+            "dns_latency_us": np.zeros(n, np.int32),
+            "sampling": np.zeros(n, np.int32),
+            "valid": np.ones(n, np.bool_),
+        }
+
+    import netobserv_tpu.model.binfmt as binfmt
+
+    def keys_for(src_last, dsts_ports):
+        arr = np.zeros(len(dsts_ports), dtype=binfmt.FLOW_KEY_DTYPE)
+        for i, (dst_last, port) in enumerate(dsts_ports):
+            arr[i]["src_ip"][10:12] = 0xFF
+            arr[i]["src_ip"][12:] = [10, 0, 0, src_last]
+            arr[i]["dst_ip"][10:12] = 0xFF
+            arr[i]["dst_ip"][12:] = [10, 0, dst_last % 250 + 1, dst_last // 250]
+            arr[i]["src_port"] = 40000
+            arr[i]["dst_port"] = port
+            arr[i]["proto"] = 6
+        return pack_key_words(arr)
+
+    # the scanner: one source sweeping 2000 distinct (dst, port) pairs
+    scan_pairs = [(i % 500, 1 + i % 4096) for i in range(2000)]
+    state = ingest(state, batch(keys_for(7, scan_pairs)))
+    # normal clients: 50 sources, 4 (dst, port) pairs each
+    for s in range(50):
+        state = ingest(state, batch(keys_for(100 + s % 100,
+                                             [(s, 443), (s, 80),
+                                              (s + 1, 443), (s + 2, 53)])))
+    _, report = sk.roll_window(state, cfg)
+    fanout = np.asarray(report.per_src_fanout)
+    top = float(np.max(fanout))
+    assert top > 1000, f"scanner fan-out estimate too low: {top}"
+    # only the scanner's bucket is anywhere near it
+    assert np.sort(fanout)[-2] < top / 10
+    obj = report_to_json(report)
+    assert obj["PortScanSuspectBuckets"], "scanner not reported"
+    assert obj["PortScanSuspectBuckets"][0]["distinct_dst_port_pairs"] > 1000
