@@ -257,6 +257,7 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_window_mode: str = field(default="reset", **_env("SKETCH_WINDOW_MODE", "reset"))
     #: per-window distinct-(dst addr, dst port) pair fan-out at which a
     #: source bucket is reported as a port-scan suspect
+    #: (default mirrors exporter.tpu_sketch.DEFAULT_SCAN_FANOUT)
     sketch_scan_fanout: int = field(default=512,
                                     **_env("SKETCH_SCAN_FANOUT", "512"))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))

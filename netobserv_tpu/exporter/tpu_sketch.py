@@ -33,6 +33,10 @@ log = logging.getLogger("netobserv_tpu.exporter.tpu_sketch")
 
 ReportSink = Callable[[dict], None]
 
+#: single source of truth for the port-scan fan-out threshold default
+#: (AgentConfig.sketch_scan_fanout overrides via SKETCH_SCAN_FANOUT)
+DEFAULT_SCAN_FANOUT = 512.0
+
 
 def _default_sink(report: dict) -> None:
     sys.stdout.write(json.dumps(report, separators=(",", ":")) + "\n")
@@ -84,7 +88,7 @@ def make_report_sink(cfg) -> ReportSink:
 
 
 def report_to_json(report, max_heavy: int = 64,
-                   scan_fanout_threshold: float = 512.0) -> dict:
+                   scan_fanout_threshold: float = DEFAULT_SCAN_FANOUT) -> dict:
     """Render a device WindowReport into a host JSON object."""
     words = np.asarray(report.heavy.words)
     valid = np.asarray(report.heavy.valid)
@@ -141,7 +145,7 @@ class TpuSketchExporter(Exporter):
                  sink: Optional[ReportSink] = None, metrics=None,
                  checkpoint_dir: str = "", checkpoint_every: int = 0,
                  decay_factor: Optional[float] = None,
-                 scan_fanout_threshold: float = 512.0):
+                 scan_fanout_threshold: float = DEFAULT_SCAN_FANOUT):
         # jax-importing modules are pulled in lazily so the host agent can run
         # exporter-free on machines without accelerators
         from netobserv_tpu.sketch import state as sk

@@ -72,6 +72,8 @@ def test_sharded_matches_single_device(mesh_shape):
                                np.asarray(ref.dns_quantiles_us), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(report.per_dst_cardinality),
                                np.asarray(ref.per_dst_cardinality), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(report.per_src_fanout),
+                               np.asarray(ref.per_src_fanout), rtol=1e-6)
     # top-K: same key set, same estimates
     ref_set = {tuple(w) for w, v in zip(np.asarray(ref.heavy.words),
                                         np.asarray(ref.heavy.valid)) if v}
