@@ -27,7 +27,7 @@ import numpy as np
 BATCH = 16384
 N_BATCHES_POOL = 8
 _DEVICE_NOTE = ""
-WARMUP_ITERS = 3
+WARMUP_ITERS = 10  # the first executions after compile run measurably slower
 TIMED_ITERS = 40
 N_DISTINCT = 50_000
 ZIPF_A = 1.2

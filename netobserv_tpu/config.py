@@ -60,6 +60,10 @@ EXPORT_TPU_SKETCH = "tpu-sketch"
 # Debug-friendly terminal exporter (stdout JSON lines).
 EXPORT_STDOUT = "stdout"
 
+#: port-scan fan-out threshold default — the ONE definition; the
+#: sketch_scan_fanout field and the tpu-sketch exporter both use it
+DEFAULT_SCAN_FANOUT = 512
+
 VALID_EXPORTERS = (
     EXPORT_GRPC, EXPORT_KAFKA, EXPORT_IPFIX_UDP, EXPORT_IPFIX_TCP,
     EXPORT_DIRECT_FLP, EXPORT_TPU_SKETCH, EXPORT_STDOUT,
@@ -257,9 +261,9 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_window_mode: str = field(default="reset", **_env("SKETCH_WINDOW_MODE", "reset"))
     #: per-window distinct-(dst addr, dst port) pair fan-out at which a
     #: source bucket is reported as a port-scan suspect
-    #: (default mirrors exporter.tpu_sketch.DEFAULT_SCAN_FANOUT)
-    sketch_scan_fanout: int = field(default=512,
-                                    **_env("SKETCH_SCAN_FANOUT", "512"))
+    sketch_scan_fanout: int = field(
+        default=DEFAULT_SCAN_FANOUT,
+        **_env("SKETCH_SCAN_FANOUT", str(DEFAULT_SCAN_FANOUT)))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))
     # where window reports go: "stdout" (JSON lines) or "kafka" (uses the
     # KAFKA_* settings; one message per report, key = "sketch_report")

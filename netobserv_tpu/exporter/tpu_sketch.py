@@ -23,6 +23,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from netobserv_tpu.config import DEFAULT_SCAN_FANOUT
 from netobserv_tpu.exporter.base import Exporter
 from netobserv_tpu.sketch import staging
 from netobserv_tpu.model.columnar import FlowBatch, unpack_key_words
@@ -32,10 +33,6 @@ from netobserv_tpu.model.record import Record
 log = logging.getLogger("netobserv_tpu.exporter.tpu_sketch")
 
 ReportSink = Callable[[dict], None]
-
-#: single source of truth for the port-scan fan-out threshold default
-#: (AgentConfig.sketch_scan_fanout overrides via SKETCH_SCAN_FANOUT)
-DEFAULT_SCAN_FANOUT = 512.0
 
 
 def _default_sink(report: dict) -> None:
