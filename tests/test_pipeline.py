@@ -252,3 +252,45 @@ class TestDecayWindows:
         assert reports[1]["HeavyHitters"][0]["EstBytes"] == 500.0
         total_hh = sum(h["EstBytes"] for h in reports[1]["HeavyHitters"])
         assert total_hh <= reports[1]["Bytes"] + 1e-6
+
+
+def test_port_scan_surfaces_in_exporter_window_report():
+    """Agent-level scan detection: a scanning source fed through the FULL
+    TpuSketchExporter pipeline (records -> batches -> device fold -> window
+    roll -> JSON sink) must surface in PortScanSuspectBuckets."""
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.model.flow import FlowKey
+    from netobserv_tpu.model.record import Record
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    def rec(src, dst, dport):
+        return Record(
+            key=FlowKey.make(src, dst, 40000, dport, 6), bytes_=60,
+            packets=1, eth_protocol=0x0800, tcp_flags=0x02, direction=1,
+            src_mac=b"\x02" * 6, dst_mac=b"\x04" * 6, if_index=3,
+            interface="eth0", dscp=0, sampling=0,
+            agent_ip="192.0.2.1")
+
+    reports = []
+    exp = TpuSketchExporter(
+        batch_size=128, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10,
+                                hll_precision=6, perdst_buckets=32,
+                                perdst_precision=4, topk=16, hist_buckets=64,
+                                ewma_buckets=32, persrc_buckets=64,
+                                persrc_precision=6),
+        mesh_shape="", sink=reports.append,
+        scan_fanout_threshold=200)
+    # the scanner: one source sweeping 1024 distinct (dst, port) pairs
+    scan = [rec("10.9.9.9", f"10.0.{i % 250}.{i // 250 + 1}", 1 + i % 1024)
+            for i in range(1024)]
+    # normal client
+    normal = [rec("10.1.1.1", "10.2.2.2", 443) for _ in range(32)]
+    exp.export_batch(scan)
+    exp.export_batch(normal)
+    exp.flush()
+    assert reports, "no window report emitted"
+    suspects = reports[-1]["PortScanSuspectBuckets"]
+    assert suspects, "scanner not reported through the exporter pipeline"
+    assert suspects[0]["distinct_dst_port_pairs"] > 500
+    exp.close()
