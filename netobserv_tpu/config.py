@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import re
 from dataclasses import dataclass, field
@@ -63,6 +64,10 @@ EXPORT_STDOUT = "stdout"
 #: port-scan fan-out threshold default — the ONE definition; the
 #: sketch_scan_fanout field and the tpu-sketch exporter both use it
 DEFAULT_SCAN_FANOUT = 512
+
+#: DDoS z-score threshold default — same single-definition treatment as
+#: DEFAULT_SCAN_FANOUT (the two anomaly signals share an operational shape)
+DEFAULT_DDOS_Z = 6.0
 
 VALID_EXPORTERS = (
     EXPORT_GRPC, EXPORT_KAFKA, EXPORT_IPFIX_UDP, EXPORT_IPFIX_TCP,
@@ -264,6 +269,10 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_scan_fanout: int = field(
         default=DEFAULT_SCAN_FANOUT,
         **_env("SKETCH_SCAN_FANOUT", str(DEFAULT_SCAN_FANOUT)))
+    #: EWMA z-score above which a destination bucket is reported as a DDoS
+    #: suspect (per-window; see exporter/tpu_sketch.py report_to_json)
+    sketch_ddos_z: float = field(default=DEFAULT_DDOS_Z,
+                                 **_env("SKETCH_DDOS_Z", str(DEFAULT_DDOS_Z)))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))
     # where window reports go: "stdout" (JSON lines) or "kafka" (uses the
     # KAFKA_* settings; one message per report, key = "sketch_report")
@@ -306,6 +315,15 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
             raise ValueError(
                 f"SKETCH_REPORT_SINK={self.sketch_report_sink!r} "
                 "(want stdout|kafka)")
+        if self.sketch_cm_width < 16 * self.sketch_topk:
+            # measured F1 cliff (docs/accuracy.md): top-K precision degrades
+            # once Count-Min columns are shared by too many tracked keys —
+            # warn, don't refuse (small-memory deployments may accept it)
+            logging.getLogger("netobserv_tpu.config").warning(
+                "SKETCH_CM_WIDTH=%d is below 16*SKETCH_TOPK=%d: heavy-hitter "
+                "precision degrades measurably at this ratio (docs/"
+                "accuracy.md); widen the sketch or shrink the top-K",
+                self.sketch_cm_width, 16 * self.sketch_topk)
 
 
 _DURATION_FIELDS = {

@@ -102,17 +102,28 @@ ST_NOBS = _st("n_observed_intf")
 ST_OBSDIR = _st("observed_direction")
 ST_OBSIF = _st("observed_intf")
 ST_FLAGS = _st("tcp_flags")
-# atomic-OR staging: tcp_flags is the HIGH u16 of the 4-aligned word that
-# starts at eth_protocol, so `atomic_or(word, flags << 16)` accumulates flag
-# bits across CPUs without touching eth_protocol (which is only ever
-# rewritten with the same value)
+# atomic-OR staging: tcp_flags occupies memory bytes 2..3 of the 4-aligned
+# word that starts at eth_protocol, so an atomic OR of `flags << _FLAGS_SHIFT`
+# accumulates flag bits across CPUs without touching eth_protocol (which is
+# only ever rewritten with the same value)
 assert ST_FLAGS == ST_ETH + 2 and ST_ETH % 4 == 0
-# slot-reservation staging: n_observed_intf is byte 3 of the 4-aligned word
-# at direction_first, so `atomic_fetch_add(word, 1<<24)` hands each CPU an
-# exclusive observed-list slot (the counter wraps at 256, like the C twin's
-# u1); the addend's low 24 bits are zero, so the other three bytes
-# (direction_first/errno_fallback/dscp) are preserved
+# slot-reservation staging: n_observed_intf is memory byte 3 of the
+# 4-aligned word at direction_first, so an atomic fetch-add of
+# `1 << _NOBS_SHIFT` hands each CPU an exclusive observed-list slot; the
+# addend leaves the other three bytes (direction_first/errno_fallback/dscp)
+# untouched
 assert _st("n_observed_intf") == ST_DIR + 3 and ST_DIR % 4 == 0
+# BPF programs execute in HOST byte order, so the staging shifts flip with
+# endianness (the asm_flowpath twin of asm.py's _REGS_BYTE nibble flip): on
+# little-endian, memory bytes 2..3 are the word's HIGH u16 and byte 3 its
+# HIGH byte; on big-endian (s390x) both are the word's LOW bits, so the
+# shifts collapse to 0 and the old-slot extraction masks instead of shifting.
+# Big-endian bound: the LE counter harmlessly wraps out of the u32 at 256,
+# while the BE low-byte counter would carry into dscp — unreachable in
+# practice because the saturation-undo below keeps it ≤ capacity + the
+# number of concurrently executing CPUs (≪ 255).
+_FLAGS_SHIFT = 16 if __import__("sys").byteorder == "little" else 0
+_NOBS_SHIFT = 24 if __import__("sys").byteorder == "little" else 0
 ST_SRC_MAC = _st("src_mac")
 ST_DST_MAC = _st("dst_mac")
 ST_SAMPLING = _st("sampling")
@@ -1181,7 +1192,8 @@ class _Flow:
         a.ldx(BPF_DW, R3, R10, NOW)
         a.stx(BPF_DW, R0, R3, ST_LAST)
         a.ldx(BPF_DW, R3, R10, SPILL)
-        a.alu_imm(0x67, R3, 16)                 # flags -> high u16 of word
+        if _FLAGS_SHIFT:
+            a.alu_imm(0x67, R3, _FLAGS_SHIFT)   # flags -> tcp_flags bytes (LE)
         a.atomic_or(BPF_W, R0, R3, ST_ETH)
         if self.has_filter_sampling:
             # latest effective rate wins (stored by flt_sample on the stack)
@@ -1228,7 +1240,8 @@ class _Flow:
         a.ldx(BPF_DW, R3, R10, NOW)
         a.stx(BPF_DW, R0, R3, ST_LAST)
         a.ldx(BPF_DW, R3, R10, SPILL)
-        a.alu_imm(0x67, R3, 16)                 # flags -> high u16 of word
+        if _FLAGS_SHIFT:
+            a.alu_imm(0x67, R3, _FLAGS_SHIFT)   # flags -> tcp_flags bytes (LE)
         a.atomic_or(BPF_W, R0, R3, ST_ETH)
         # (ifindex, direction) dedup scan over the observed slots (r4 =
         # ifindex; direction is a build-time constant -> immediate compare)
@@ -1246,9 +1259,12 @@ class _Flow:
         # but-not-yet-written slot reads as ifindex 0 (skipped at read-out,
         # record.py), and a racing append of the SAME new interface may
         # duplicate it (dedup'd at read-out, record.py)
-        a.mov_imm(R3, 1 << 24)
+        a.mov_imm(R3, 1 << _NOBS_SHIFT)
         a.atomic_fetch_add(BPF_W, R0, R3, ST_DIR)  # r3 = old word
-        a.alu_imm(0x77, R3, 24)                 # r3 = old n (0..255)
+        if _NOBS_SHIFT:
+            a.alu_imm(0x77, R3, _NOBS_SHIFT)    # r3 = old n (0..255)
+        else:
+            a.alu_imm(0x57, R3, 0xFF)           # BE: old n is the LOW byte
         a.jmp_imm(0x35, R3, n_obs, "obs_full")
         a.mov_reg(R5, R3)
         a.alu_imm(0x67, R5, 2)                  # n << 2
@@ -1264,7 +1280,7 @@ class _Flow:
         # undo the reservation so the counter SATURATES near capacity (at
         # most +n_cpus transient) instead of wrapping at 256 and handing
         # out in-use slots; readers clamp at capacity
-        a.mov_imm(R3, -(1 << 24))
+        a.mov_imm(R3, -(1 << _NOBS_SHIFT))
         a.atomic_add(BPF_W, R0, R3, ST_DIR)
         # overflow: count it, except for zero-proto traffic which routinely
         # saturates the array (reference bpf/flows.c:133-142)

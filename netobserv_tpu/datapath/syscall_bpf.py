@@ -46,9 +46,10 @@ BPF_MAP_LOOKUP_AND_DELETE_ELEM = 21
 BPF_OBJ_GET_INFO_BY_FD = 15
 BPF_MAP_LOOKUP_AND_DELETE_BATCH = 25  # only the delete variant is used here
 
-# per-CPU map types (kernel enum bpf_map_type): values cross the syscall
-# boundary at round_up(value_size, 8) per possible CPU
-PERCPU_MAP_TYPES = frozenset({5, 6, 21})  # PERCPU_HASH/PERCPU_ARRAY/LRU_PERCPU
+# per-CPU map types (kernel enum bpf_map_type, uapi/linux/bpf.h): values
+# cross the syscall boundary at round_up(value_size, 8) per possible CPU.
+# PERCPU_HASH=5, PERCPU_ARRAY=6, LRU_PERCPU_HASH=10, PERCPU_CGROUP_STORAGE=21
+PERCPU_MAP_TYPES = frozenset({5, 6, 10, 21})
 
 # kernel-internal "operation not supported" — what BPF_DO_BATCH returns when
 # the map type has no batch ops; distinct from errno.ENOTSUP (95) and has no

@@ -23,7 +23,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from netobserv_tpu.config import DEFAULT_SCAN_FANOUT
+from netobserv_tpu.config import DEFAULT_DDOS_Z, DEFAULT_SCAN_FANOUT
 from netobserv_tpu.exporter.base import Exporter
 from netobserv_tpu.sketch import staging
 from netobserv_tpu.model.columnar import FlowBatch, unpack_key_words
@@ -85,7 +85,8 @@ def make_report_sink(cfg) -> ReportSink:
 
 
 def report_to_json(report, max_heavy: int = 64,
-                   scan_fanout_threshold: float = DEFAULT_SCAN_FANOUT) -> dict:
+                   scan_fanout_threshold: float = DEFAULT_SCAN_FANOUT,
+                   ddos_z_threshold: float = DEFAULT_DDOS_Z) -> dict:
     """Render a device WindowReport into a host JSON object."""
     words = np.asarray(report.heavy.words)
     valid = np.asarray(report.heavy.valid)
@@ -106,7 +107,7 @@ def report_to_json(report, max_heavy: int = 64,
                 "EstBytes": float(counts[i]),
             })
     z = np.asarray(report.ddos_z)
-    suspects = np.nonzero(z > 6.0)[0]
+    suspects = np.nonzero(z > ddos_z_threshold)[0]
     # port-scan suspects: source buckets whose distinct-(dst addr, dst
     # port) PAIR fan-out this window exceeds the threshold (a scanner
     # touches hundreds+; a normal client a handful)
@@ -142,7 +143,8 @@ class TpuSketchExporter(Exporter):
                  sink: Optional[ReportSink] = None, metrics=None,
                  checkpoint_dir: str = "", checkpoint_every: int = 0,
                  decay_factor: Optional[float] = None,
-                 scan_fanout_threshold: float = DEFAULT_SCAN_FANOUT):
+                 scan_fanout_threshold: float = DEFAULT_SCAN_FANOUT,
+                 ddos_z_threshold: float = DEFAULT_DDOS_Z):
         # jax-importing modules are pulled in lazily so the host agent can run
         # exporter-free on machines without accelerators
         from netobserv_tpu.sketch import state as sk
@@ -153,6 +155,7 @@ class TpuSketchExporter(Exporter):
         self._cfg = sketch_cfg or sk.SketchConfig()
         self._sink = sink or _default_sink
         self._scan_fanout = scan_fanout_threshold
+        self._ddos_z = ddos_z_threshold
         self._metrics = metrics
         self._lock = threading.Lock()
         self._pending: list[Record] = []
@@ -196,7 +199,8 @@ class TpuSketchExporter(Exporter):
             # sharded mode ships the full-width dense feed (a flat compact
             # buffer would not split on row boundaries across the data axis)
             self._ring = staging.DenseStagingRing(
-                self._batch_size, ingest_dense, put=dense_put)
+                self._batch_size, ingest_dense, put=dense_put,
+                metrics=metrics)
         else:
             self._ndata = 1
             self._state = sk.init_state(self._cfg)
@@ -213,7 +217,8 @@ class TpuSketchExporter(Exporter):
                                           with_token=True),
                 spill_cap=spill_cap,
                 ingest_fallback=sk.make_ingest_dense_fn(
-                    use_pallas=self._cfg.use_pallas, with_token=True))
+                    use_pallas=self._cfg.use_pallas, with_token=True),
+                metrics=metrics)
         # the staging ring packs the next batch while the previous
         # transfers/ingests are in flight; its slot-reuse tokens also bound
         # the async dispatch queue to the ring depth, so sustained overload
@@ -250,6 +255,7 @@ class TpuSketchExporter(Exporter):
                    checkpoint_dir=cfg.sketch_checkpoint_dir,
                    checkpoint_every=cfg.sketch_checkpoint_every,
                    scan_fanout_threshold=cfg.sketch_scan_fanout,
+                   ddos_z_threshold=cfg.sketch_ddos_z,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
 
@@ -396,7 +402,8 @@ class TpuSketchExporter(Exporter):
         self._window_deadline = time.monotonic() + self._window_s
         self._state, report = self._roll(self._state)
         obj = report_to_json(
-            report, scan_fanout_threshold=self._scan_fanout)
+            report, scan_fanout_threshold=self._scan_fanout,
+            ddos_z_threshold=self._ddos_z)
         obj["TimestampMs"] = time.time_ns() // 1_000_000
         self._sink(obj)
         if self._metrics is not None:

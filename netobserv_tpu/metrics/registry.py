@@ -120,6 +120,11 @@ class Metrics:
             p + "sketch_ingest_seconds", "Device ingest step latency",
             buckets=(.0001, .0005, .001, .005, .01, .05, .1, .5),
             registry=self.registry)
+        self.sketch_staging_stalls_total = Counter(
+            p + "sketch_staging_stalls_total",
+            "Staging-ring folds that had to WAIT for a slot's previous "
+            "ingest (device slower than the eviction feed)",
+            registry=self.registry)
 
     # --- convenience methods used by pipeline stages ---
     def observe_eviction(self, source: str, n_flows: int, seconds: float) -> None:

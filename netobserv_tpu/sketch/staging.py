@@ -54,10 +54,16 @@ class DenseStagingRing:
     def __init__(self, batch_size: int, ingest: Callable,
                  put: Optional[Callable] = None, n_slots: int = 4,
                  spill_cap: Optional[int] = None,
-                 ingest_fallback: Optional[Callable] = None):
+                 ingest_fallback: Optional[Callable] = None,
+                 metrics=None):
         import jax
 
         self.batch_size = batch_size
+        self._metrics = metrics
+        #: folds that found their slot's previous ingest still running —
+        #: the device (or transfer link) is slower than the eviction feed.
+        #: Mirrored into metrics.sketch_staging_stalls_total when wired.
+        self.stalls = 0
         self.spill_cap = spill_cap
         self._ingest = ingest
         self._ingest_fallback = ingest_fallback
@@ -81,6 +87,10 @@ class DenseStagingRing:
         slot = self._slot
         tok = self._tokens[slot]
         if tok is not None:
+            if not tok.is_ready():
+                self.stalls += 1
+                if self._metrics is not None:
+                    self._metrics.sketch_staging_stalls_total.inc()
             jax.block_until_ready(tok)  # slot's last consumer has finished
         if self.spill_cap is not None:
             buf = flowpack.pack_compact(

@@ -103,3 +103,33 @@ def test_env_surface_covers_reference():
                           inspect.getsource(cfgmod)))
     missing = ref_keys - ours
     assert not missing, f"reference env keys without an equivalent: {missing}"
+
+
+def test_ddos_z_threshold_knob():
+    """SKETCH_DDOS_Z gets the same config treatment as SKETCH_SCAN_FANOUT
+    (both anomaly signals are operator-tunable, VERDICT r3 weak #4)."""
+    c = cfg.load_config(environ={})
+    assert c.sketch_ddos_z == cfg.DEFAULT_DDOS_Z == 6.0
+    c2 = cfg.load_config(environ={"SKETCH_DDOS_Z": "3.5"})
+    assert c2.sketch_ddos_z == 3.5
+
+
+def test_narrow_cm_width_warns(caplog):
+    """SKETCH_CM_WIDTH below 16x SKETCH_TOPK sits past the measured top-K
+    F1 cliff (docs/accuracy.md) — validation must warn the operator (but
+    not refuse: small-memory deployments may accept the tradeoff)."""
+    import logging
+
+    c = cfg.load_config(environ={
+        "EXPORT": "tpu-sketch", "SKETCH_CM_WIDTH": "4096",
+        "SKETCH_TOPK": "1024"})
+    with caplog.at_level(logging.WARNING, "netobserv_tpu.config"):
+        c.validate()
+    assert any("SKETCH_CM_WIDTH" in r.message for r in caplog.records)
+    caplog.clear()
+    ok = cfg.load_config(environ={
+        "EXPORT": "tpu-sketch", "SKETCH_CM_WIDTH": "65536",
+        "SKETCH_TOPK": "1024"})
+    with caplog.at_level(logging.WARNING, "netobserv_tpu.config"):
+        ok.validate()
+    assert not caplog.records

@@ -384,3 +384,47 @@ class TestPackCompact:
                 lambda a, b: np.testing.assert_allclose(
                     np.asarray(a), np.asarray(b), rtol=1e-6),
                 getattr(s_ring, name), getattr(s_ref, name))
+
+
+class TestStagingStallCounter:
+    def test_stall_counted_when_slot_busy(self, native):
+        """A fold that finds its slot's previous ingest still in flight must
+        count a stall (ring.stalls + metrics.sketch_staging_stalls_total) —
+        the operator's signal that the device, not the packer, is the
+        bottleneck; ready slots must not count."""
+        from prometheus_client import CollectorRegistry
+
+        from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+        from netobserv_tpu.sketch import state as sk
+        from netobserv_tpu.sketch.staging import DenseStagingRing
+
+        m = Metrics(MetricsSettings(), registry=CollectorRegistry())
+        cfg = sk.SketchConfig(cm_width=1 << 10, topk=64)
+        ring = DenseStagingRing(
+            32, sk.make_ingest_dense_fn(donate=False, with_token=True),
+            metrics=m)
+        state = sk.init_state(cfg)
+        # a DRAINED ring never stalls: every token is ready by construction
+        for _ in range(6):
+            state = ring.fold(state, _events(8))
+            ring.drain()
+        before = ring.stalls
+        ring.fold(state, _events(8))
+        assert ring.stalls == before  # drained slots are ready slots
+
+        class _BusyToken:
+            def __init__(self):
+                self.blocked = False
+
+            def is_ready(self):
+                return False
+
+            def block_until_ready(self):
+                self.blocked = True
+
+        tok = _BusyToken()
+        ring._tokens[ring._slot] = tok
+        ring.fold(state, _events(8))
+        assert ring.stalls == before + 1
+        assert m.sketch_staging_stalls_total._value.get() == before + 1.0
+        assert tok.blocked  # correctness guard still waited on the slot

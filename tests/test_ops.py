@@ -331,3 +331,28 @@ def test_port_scan_fanout_detection():
     obj = report_to_json(report)
     assert obj["PortScanSuspectBuckets"], "scanner not reported"
     assert obj["PortScanSuspectBuckets"][0]["distinct_dst_port_pairs"] > 1000
+
+
+def test_ddos_z_threshold_configurable():
+    """The DDoS suspect cut is the SKETCH_DDOS_Z knob, not a hardcoded 6.0
+    (VERDICT r3 weak #4): the same report yields different suspect sets at
+    different thresholds."""
+    import numpy as np
+
+    from netobserv_tpu.exporter.tpu_sketch import report_to_json
+    from netobserv_tpu.ops import topk
+    from netobserv_tpu.sketch.state import WindowReport
+
+    z = np.array([0.0, 5.0, 7.0], np.float32)
+    report = WindowReport(
+        heavy=topk.init(4), distinct_src=np.float32(0),
+        per_dst_cardinality=np.zeros(4, np.float32),
+        per_src_fanout=np.zeros(4, np.float32),
+        rtt_quantiles_us=np.zeros(5, np.float32),
+        dns_quantiles_us=np.zeros(5, np.float32), ddos_z=z,
+        total_records=np.float32(0), total_bytes=np.float32(0),
+        window=np.int32(1))
+    default = report_to_json(report)
+    assert [s["bucket"] for s in default["DdosSuspectBuckets"]] == [2]
+    low = report_to_json(report, ddos_z_threshold=4.5)
+    assert [s["bucket"] for s in low["DdosSuspectBuckets"]] == [1, 2]
