@@ -69,6 +69,14 @@ DEFAULT_SCAN_FANOUT = 512
 #: DEFAULT_SCAN_FANOUT (the two anomaly signals share an operational shape)
 DEFAULT_DDOS_Z = 6.0
 
+#: SYN-flood: minimum half-open attempts per victim bucket per window, and
+#: the offered:accepted (SYN : SYN-ACK) ratio both required to report
+DEFAULT_SYNFLOOD_MIN = 128
+DEFAULT_SYNFLOOD_RATIO = 8.0
+
+#: drop-anomaly z-score threshold (EWMA surge of dropped bytes per bucket)
+DEFAULT_DROP_Z = 6.0
+
 VALID_EXPORTERS = (
     EXPORT_GRPC, EXPORT_KAFKA, EXPORT_IPFIX_UDP, EXPORT_IPFIX_TCP,
     EXPORT_DIRECT_FLP, EXPORT_TPU_SKETCH, EXPORT_STDOUT,
@@ -273,6 +281,17 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     #: suspect (per-window; see exporter/tpu_sketch.py report_to_json)
     sketch_ddos_z: float = field(default=DEFAULT_DDOS_Z,
                                  **_env("SKETCH_DDOS_Z", str(DEFAULT_DDOS_Z)))
+    #: SYN-flood report gates: a victim bucket is reported when its window
+    #: half-open count >= MIN and >= RATIO x its SYN-ACK responses
+    sketch_synflood_min: int = field(
+        default=DEFAULT_SYNFLOOD_MIN,
+        **_env("SKETCH_SYNFLOOD_MIN", str(DEFAULT_SYNFLOOD_MIN)))
+    sketch_synflood_ratio: float = field(
+        default=DEFAULT_SYNFLOOD_RATIO,
+        **_env("SKETCH_SYNFLOOD_RATIO", str(DEFAULT_SYNFLOOD_RATIO)))
+    #: drop-anomaly z-score threshold (EWMA surge of dropped bytes)
+    sketch_drop_z: float = field(default=DEFAULT_DROP_Z,
+                                 **_env("SKETCH_DROP_Z", str(DEFAULT_DROP_Z)))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))
     # where window reports go: "stdout" (JSON lines) or "kafka" (uses the
     # KAFKA_* settings; one message per report, key = "sketch_report")

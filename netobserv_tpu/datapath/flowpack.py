@@ -44,12 +44,12 @@ class _Columns(ctypes.Structure):
 _lib: Optional[ctypes.CDLL] = None
 
 
-_ABI_VERSION = 5
+_ABI_VERSION = 6
 
 #: dense TPU-feed row width (words); layout documented in flowpack.cc
-DENSE_WORDS = 16
+DENSE_WORDS = 20
 #: compact (v4) TPU-feed row width; layout documented in flowpack.cc
-COMPACT_WORDS = 9
+COMPACT_WORDS = 10
 #: bytes 8..11 of a v4-in-v6 mapped address as a LE u32
 _V4_PREFIX_WORD2 = 0xFFFF0000
 
@@ -160,10 +160,44 @@ def _fit_rows(arr, n, dtype):
     return np.ascontiguousarray(a)
 
 
+def _feature_words(stats, ex, xl, qc, dr) -> np.ndarray:
+    """(n, 4) u32 feature words 16..19 of the dense row — the numpy twin of
+    flowpack.cc fill_feature_words (w16 = tcp_flags|dscp<<16|markers<<24,
+    w17 = drop bytes|packets<<16, w18 = drop cause|state<<16, w19 = 0)."""
+    n = len(stats)
+    w = np.zeros((n, 4), np.uint32)
+    markers = np.zeros(n, np.uint32)
+    if qc is not None:
+        markers |= ((qc["version"] != 0) | (qc["seen_long_hdr"] != 0)
+                    | (qc["seen_short_hdr"] != 0)).astype(np.uint32)
+    if xl is not None:
+        # complete translation = both endpoints observed (fp_merge_xlat rule)
+        both = xl["src_ip"].any(axis=1) & xl["dst_ip"].any(axis=1)
+        markers |= both.astype(np.uint32) << 1
+    if ex is not None:
+        markers |= (ex["ipsec_encrypted"] != 0).astype(np.uint32) << 2
+        markers |= (ex["ipsec_ret"] != 0).astype(np.uint32) << 3
+    w[:, 0] = (stats["tcp_flags"].astype(np.uint32)
+               | (stats["dscp"].astype(np.uint32) << 16)
+               | (markers << 24))
+    if dr is not None:
+        w[:, 1] = (dr["bytes"].astype(np.uint32)
+                   | (dr["packets"].astype(np.uint32) << 16))
+        # saturate, don't mask: subsystem drop reasons (kernel >= 6.0) carry
+        # the subsystem in bits 16+ — masking would alias them onto core
+        # reasons; saturation lands them in the histogram overflow bucket
+        w[:, 2] = (np.minimum(dr["latest_cause"], np.uint32(0xFFFF))
+                   | (dr["latest_state"].astype(np.uint32) << 16))
+    return w
+
+
 def pack_dense(events_raw: bytes | np.ndarray,
                batch_size: Optional[int] = None,
                extra: Optional[np.ndarray] = None,
                dns: Optional[np.ndarray] = None,
+               drops: Optional[np.ndarray] = None,
+               xlat: Optional[np.ndarray] = None,
+               quic: Optional[np.ndarray] = None,
                out: Optional[np.ndarray] = None,
                use_native: Optional[bool] = None) -> np.ndarray:
     """Raw flow-event buffer -> one (batch_size, DENSE_WORDS) u32 array, the
@@ -183,9 +217,13 @@ def pack_dense(events_raw: bytes | np.ndarray,
         out = np.empty((batch_size, DENSE_WORDS), dtype=np.uint32)
     elif (out.shape != (batch_size, DENSE_WORDS)
           or out.dtype != np.uint32 or not out.flags.c_contiguous):
-        raise ValueError("out must be C-contiguous (batch_size, 16) uint32")
+        raise ValueError(
+            f"out must be C-contiguous (batch_size, {DENSE_WORDS}) uint32")
     ex = _fit_rows(extra, n, binfmt.EXTRA_REC_DTYPE)
     dn = _fit_rows(dns, n, binfmt.DNS_REC_DTYPE)
+    dr = _fit_rows(drops, n, binfmt.DROPS_REC_DTYPE)
+    xl = _fit_rows(xlat, n, binfmt.XLAT_REC_DTYPE)
+    qc = _fit_rows(quic, n, binfmt.QUIC_REC_DTYPE)
     if use_native is None:
         use_native = native_available()
     if use_native and native_available():
@@ -193,6 +231,9 @@ def pack_dense(events_raw: bytes | np.ndarray,
             ctypes.c_void_p(events.ctypes.data), ctypes.c_size_t(n),
             ctypes.c_void_p(ex.ctypes.data if ex is not None else None),
             ctypes.c_void_p(dn.ctypes.data if dn is not None else None),
+            ctypes.c_void_p(dr.ctypes.data if dr is not None else None),
+            ctypes.c_void_p(xl.ctypes.data if xl is not None else None),
+            ctypes.c_void_p(qc.ctypes.data if qc is not None else None),
             ctypes.c_void_p(out.ctypes.data), ctypes.c_size_t(batch_size))
         return out
     out[n:] = 0
@@ -205,6 +246,7 @@ def pack_dense(events_raw: bytes | np.ndarray,
         out[:n, 13] = dn["latency_ns"] // 1000 if dn is not None else 0
         out[:n, 14] = 1
         out[:n, 15] = stats["sampling"]
+        out[:n, 16:] = _feature_words(stats, ex, xl, qc, dr)
     return out
 
 
@@ -213,13 +255,17 @@ def pack_compact(events_raw: bytes | np.ndarray,
                  spill_cap: int,
                  extra: Optional[np.ndarray] = None,
                  dns: Optional[np.ndarray] = None,
+                 drops: Optional[np.ndarray] = None,
+                 xlat: Optional[np.ndarray] = None,
+                 quic: Optional[np.ndarray] = None,
                  out: Optional[np.ndarray] = None,
                  use_native: Optional[bool] = None) -> Optional[np.ndarray]:
     """Raw flow-event buffer -> ONE flat u32 buffer
-    `[batch_size*9 compact v4 rows | spill_cap*16 dense rows]` — the
+    `[batch_size*10 compact v4 rows | spill_cap*20 dense rows]` — the
     low-bytes-per-record TPU feed for v4-dominant traffic (the transfer
     link, not compute, bounds the host path; a v4 key needs 4 words, not
-    10). Non-v4 flows go to the spill lane; returns None when they exceed
+    10). Non-v4 flows — and rows carrying drop data, rare outside drop
+    storms — go to the full-width spill lane; returns None when they exceed
     `spill_cap` (caller falls back to pack_dense for that batch). Layout is
     pinned in flowpack.cc fp_pack_compact; device unpack is
     sketch.state.compact_to_arrays."""
@@ -239,6 +285,9 @@ def pack_compact(events_raw: bytes | np.ndarray,
 
     ex = _fit_rows(extra, n, binfmt.EXTRA_REC_DTYPE)
     dn = _fit_rows(dns, n, binfmt.DNS_REC_DTYPE)
+    dr = _fit_rows(drops, n, binfmt.DROPS_REC_DTYPE)
+    xl = _fit_rows(xlat, n, binfmt.XLAT_REC_DTYPE)
+    qc = _fit_rows(quic, n, binfmt.QUIC_REC_DTYPE)
     if use_native is None:
         use_native = native_available()
     if use_native and native_available():
@@ -247,6 +296,9 @@ def pack_compact(events_raw: bytes | np.ndarray,
             ctypes.c_void_p(events.ctypes.data), ctypes.c_size_t(n),
             ctypes.c_void_p(ex.ctypes.data if ex is not None else None),
             ctypes.c_void_p(dn.ctypes.data if dn is not None else None),
+            ctypes.c_void_p(dr.ctypes.data if dr is not None else None),
+            ctypes.c_void_p(xl.ctypes.data if xl is not None else None),
+            ctypes.c_void_p(qc.ctypes.data if qc is not None else None),
             ctypes.c_void_p(out.ctypes.data), ctypes.c_size_t(batch_size),
             ctypes.c_size_t(spill_cap))
         return None if ns < 0 else out
@@ -259,10 +311,13 @@ def pack_compact(events_raw: bytes | np.ndarray,
         return out
     kw = pack_key_words(events["key"])
     stats = events["stats"]
+    fw = _feature_words(stats, ex, xl, qc, dr)
+    has_drops = (fw[:, 1] != 0) if dr is not None else np.zeros(n, np.bool_)
     is4 = ((kw[:, 0] == 0) & (kw[:, 1] == 0)
            & (kw[:, 2] == _V4_PREFIX_WORD2)
            & (kw[:, 4] == 0) & (kw[:, 5] == 0)
-           & (kw[:, 6] == _V4_PREFIX_WORD2))
+           & (kw[:, 6] == _V4_PREFIX_WORD2)
+           & ~has_drops)
     n_sp = int((~is4).sum())
     if n_sp > spill_cap:
         return None
@@ -280,6 +335,7 @@ def pack_compact(events_raw: bytes | np.ndarray,
     c[:, 6] = rtt[is4]
     c[:, 7] = dlat[is4]
     c[:, 8] = stats["sampling"][is4]
+    c[:, 9] = fw[is4, 0]
     if n_sp:
         s = spill[:n_sp]
         s[:, :10] = kw[~is4]
@@ -289,6 +345,7 @@ def pack_compact(events_raw: bytes | np.ndarray,
         s[:, 13] = dlat[~is4]
         s[:, 14] = 1
         s[:, 15] = stats["sampling"][~is4]
+        s[:, 16:] = fw[~is4]
     return out
 
 

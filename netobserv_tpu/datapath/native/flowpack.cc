@@ -76,10 +76,61 @@ size_t fp_pack(const uint8_t *events, size_t n, struct fp_columns *out) {
 //   word  13     dns_latency_us (from the dns record, else 0)
 //   word  14     valid flag (1 for live rows; padding rows are all-zero)
 //   word  15     sampling
-#define FP_DENSE_WORDS 16
+//   word  16     tcp_flags | dscp << 16 | markers << 24
+//                (markers: bit0 QUIC seen, bit1 NAT translation observed,
+//                 bit2 IPsec encrypted, bit3 IPsec error)
+//   word  17     drop bytes | drop packets << 16   (from the drops record)
+//   word  18     drop latest_cause (low u16) | latest_state << 16
+//   word  19     reserved (0)
+#define FP_DENSE_WORDS 20
+
+static inline uint8_t feature_markers(const struct no_extra_rec *ex,
+                                      const struct no_xlat_rec *xl,
+                                      const struct no_quic_rec *qc,
+                                      size_t i) {
+    uint8_t m = 0;
+    if (qc && (qc[i].version || qc[i].seen_long_hdr || qc[i].seen_short_hdr))
+        m |= 1;
+    if (xl) {
+        // complete translation = both endpoints observed (fp_merge_xlat rule)
+        bool src_set = false, dst_set = false;
+        for (int b = 0; b < NO_IP_LEN; b++) {
+            if (xl[i].src_ip[b]) src_set = true;
+            if (xl[i].dst_ip[b]) dst_set = true;
+        }
+        if (src_set && dst_set) m |= 2;
+    }
+    if (ex && ex[i].ipsec_encrypted) m |= 4;
+    if (ex && ex[i].ipsec_ret != 0) m |= 8;
+    return m;
+}
+
+static inline void fill_feature_words(const struct no_flow_stats *s,
+                                      const struct no_extra_rec *ex,
+                                      const struct no_xlat_rec *xl,
+                                      const struct no_quic_rec *qc,
+                                      const struct no_drops_rec *dr,
+                                      size_t i, uint32_t *w16) {
+    w16[0] = (s->tcp_flags & 0xFFFFu) |
+             (static_cast<uint32_t>(s->dscp & 0xFFu) << 16) |
+             (static_cast<uint32_t>(feature_markers(ex, xl, qc, i)) << 24);
+    w16[1] = dr ? (static_cast<uint32_t>(dr[i].bytes) |
+                   (static_cast<uint32_t>(dr[i].packets) << 16))
+                : 0;
+    // saturate, don't mask: subsystem drop reasons (kernel >= 6.0) carry
+    // the subsystem in bits 16+ — masking would alias them onto unrelated
+    // core reasons; saturation lands them in the histogram's overflow bucket
+    uint32_t cause = dr ? dr[i].latest_cause : 0;
+    if (cause > 0xFFFFu) cause = 0xFFFFu;
+    w16[2] = dr ? (cause | (static_cast<uint32_t>(dr[i].latest_state) << 16))
+                : 0;
+    w16[3] = 0;
+}
 
 void fp_pack_dense(const uint8_t *events, size_t n,
                    const uint8_t *extra, const uint8_t *dns,
+                   const uint8_t *drops, const uint8_t *xlat,
+                   const uint8_t *quic,
                    uint32_t *out, size_t batch_size) {
     const struct no_flow_event *ev =
         reinterpret_cast<const struct no_flow_event *>(events);
@@ -87,6 +138,12 @@ void fp_pack_dense(const uint8_t *events, size_t n,
         reinterpret_cast<const struct no_extra_rec *>(extra);
     const struct no_dns_rec *dn =
         reinterpret_cast<const struct no_dns_rec *>(dns);
+    const struct no_drops_rec *dr =
+        reinterpret_cast<const struct no_drops_rec *>(drops);
+    const struct no_xlat_rec *xl =
+        reinterpret_cast<const struct no_xlat_rec *>(xlat);
+    const struct no_quic_rec *qc =
+        reinterpret_cast<const struct no_quic_rec *>(quic);
     for (size_t i = 0; i < n; i++) {
         const struct no_flow_key *k = &ev[i].key;
         const struct no_flow_stats *s = &ev[i].stats;
@@ -103,6 +160,7 @@ void fp_pack_dense(const uint8_t *events, size_t n,
         row[13] = dn ? static_cast<uint32_t>(dn[i].latency_ns / 1000) : 0;
         row[14] = 1;
         row[15] = s->sampling;
+        fill_feature_words(s, ex, xl, qc, dr, i, row + 16);
     }
     if (n < batch_size)
         std::memset(out + n * FP_DENSE_WORDS, 0,
@@ -111,17 +169,18 @@ void fp_pack_dense(const uint8_t *events, size_t n,
 
 // Compact TPU feed: the host->device link (not compute) bounds the host
 // path, so shrink bytes/record. IPv4 flows (v4-in-v6 mapped keys, RFC 4038
-// — the common case) collapse their 10 key words to 4; non-v4 rows spill to
-// a small full-width (FP_DENSE_WORDS) side lane. One flat buffer:
-//   [batch_size * 9 compact words | spill_cap * 16 dense words]
+// — the common case) collapse their 10 key words to 4; non-v4 rows — and
+// rows carrying DROP data, which are rare outside drop storms — spill to a
+// small full-width (FP_DENSE_WORDS) side lane. One flat buffer:
+//   [batch_size * 10 compact words | spill_cap * 20 dense words]
 // Compact row (must match sketch/state.py compact_to_arrays):
 //   w0 src_v4 (key word 3)   w1 dst_v4 (key word 7)   w2 ports (src<<16|dst)
 //   w3 bit31 = valid, low 24 = proto<<16|icmp_type<<8|icmp_code
 //   w4 bytes f32 bitcast     w5 packets     w6 rtt_us     w7 dns_latency_us
-//   w8 sampling
+//   w8 sampling              w9 tcp_flags | dscp << 16 | markers << 24
 // Returns the number of spill rows used, or -1 if spill_cap would overflow
 // (caller falls back to the full dense pack for that batch).
-#define FP_COMPACT_WORDS 9
+#define FP_COMPACT_WORDS 10
 #define FP_V4_PREFIX_WORD2 0xffff0000u  // bytes 8..11 of a mapped address
 
 static inline bool is_v4_mapped(const uint8_t *ip16) {
@@ -134,6 +193,8 @@ static inline bool is_v4_mapped(const uint8_t *ip16) {
 
 int fp_pack_compact(const uint8_t *events, size_t n,
                     const uint8_t *extra, const uint8_t *dns,
+                    const uint8_t *drops, const uint8_t *xlat,
+                    const uint8_t *quic,
                     uint32_t *out, size_t batch_size, size_t spill_cap) {
     const struct no_flow_event *ev =
         reinterpret_cast<const struct no_flow_event *>(events);
@@ -141,6 +202,12 @@ int fp_pack_compact(const uint8_t *events, size_t n,
         reinterpret_cast<const struct no_extra_rec *>(extra);
     const struct no_dns_rec *dn =
         reinterpret_cast<const struct no_dns_rec *>(dns);
+    const struct no_drops_rec *dr =
+        reinterpret_cast<const struct no_drops_rec *>(drops);
+    const struct no_xlat_rec *xl =
+        reinterpret_cast<const struct no_xlat_rec *>(xlat);
+    const struct no_quic_rec *qc =
+        reinterpret_cast<const struct no_quic_rec *>(quic);
     uint32_t *spill = out + batch_size * FP_COMPACT_WORDS;
     size_t nc = 0, ns = 0;
     for (size_t i = 0; i < n; i++) {
@@ -148,7 +215,8 @@ int fp_pack_compact(const uint8_t *events, size_t n,
         const struct no_flow_stats *s = &ev[i].stats;
         uint32_t rtt = ex ? static_cast<uint32_t>(ex[i].rtt_ns / 1000) : 0;
         uint32_t dlat = dn ? static_cast<uint32_t>(dn[i].latency_ns / 1000) : 0;
-        if (is_v4_mapped(k->src_ip) && is_v4_mapped(k->dst_ip)) {
+        bool has_drops = dr && (dr[i].bytes || dr[i].packets);
+        if (!has_drops && is_v4_mapped(k->src_ip) && is_v4_mapped(k->dst_ip)) {
             uint32_t *row = out + nc * FP_COMPACT_WORDS;
             std::memcpy(&row[0], k->src_ip + 12, 4);
             std::memcpy(&row[1], k->dst_ip + 12, 4);
@@ -161,6 +229,10 @@ int fp_pack_compact(const uint8_t *events, size_t n,
             row[6] = rtt;
             row[7] = dlat;
             row[8] = s->sampling;
+            row[9] = (s->tcp_flags & 0xFFFFu) |
+                     (static_cast<uint32_t>(s->dscp & 0xFFu) << 16) |
+                     (static_cast<uint32_t>(feature_markers(ex, xl, qc, i))
+                      << 24);
             nc++;
         } else {
             if (ns >= spill_cap)
@@ -178,6 +250,7 @@ int fp_pack_compact(const uint8_t *events, size_t n,
             row[13] = dlat;
             row[14] = 1;
             row[15] = s->sampling;
+            fill_feature_words(s, ex, xl, qc, dr, i, row + 16);
             ns++;
         }
     }
@@ -464,6 +537,6 @@ uint32_t fp_crc32c(const uint8_t *data, size_t n) {
     return crc ^ 0xFFFFFFFFu;
 }
 
-uint32_t fp_abi_version(void) { return 5; }
+uint32_t fp_abi_version(void) { return 6; }
 
 }  // extern "C"

@@ -23,7 +23,10 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from netobserv_tpu.config import DEFAULT_DDOS_Z, DEFAULT_SCAN_FANOUT
+from netobserv_tpu.config import (
+    DEFAULT_DDOS_Z, DEFAULT_DROP_Z, DEFAULT_SCAN_FANOUT,
+    DEFAULT_SYNFLOOD_MIN, DEFAULT_SYNFLOOD_RATIO,
+)
 from netobserv_tpu.exporter.base import Exporter
 from netobserv_tpu.sketch import staging
 from netobserv_tpu.model.columnar import FlowBatch, unpack_key_words
@@ -86,7 +89,10 @@ def make_report_sink(cfg) -> ReportSink:
 
 def report_to_json(report, max_heavy: int = 64,
                    scan_fanout_threshold: float = DEFAULT_SCAN_FANOUT,
-                   ddos_z_threshold: float = DEFAULT_DDOS_Z) -> dict:
+                   ddos_z_threshold: float = DEFAULT_DDOS_Z,
+                   synflood_min: float = DEFAULT_SYNFLOOD_MIN,
+                   synflood_ratio: float = DEFAULT_SYNFLOOD_RATIO,
+                   drop_z_threshold: float = DEFAULT_DROP_Z) -> dict:
     """Render a device WindowReport into a host JSON object."""
     words = np.asarray(report.heavy.words)
     valid = np.asarray(report.heavy.valid)
@@ -108,12 +114,30 @@ def report_to_json(report, max_heavy: int = 64,
             })
     z = np.asarray(report.ddos_z)
     suspects = np.nonzero(z > ddos_z_threshold)[0]
+    suspects = suspects[np.argsort(-z[suspects])]  # worst first before [:32]
     # port-scan suspects: source buckets whose distinct-(dst addr, dst
     # port) PAIR fan-out this window exceeds the threshold (a scanner
     # touches hundreds+; a normal client a handful)
     fanout = np.asarray(report.per_src_fanout)
     scan = np.argsort(fanout)[::-1]
     scan = scan[fanout[scan] >= scan_fanout_threshold]
+    # SYN-flood suspects: victim buckets offered >= synflood_min half-open
+    # attempts this window while accepting (SYN-ACKing) at most 1/ratio of
+    # them — the offered:accepted asymmetry IS the flood signature
+    syn = np.asarray(report.syn_rate)
+    synack = np.asarray(report.synack_rate)
+    syn_z = np.asarray(report.syn_z)
+    flood = np.nonzero((syn >= synflood_min)
+                       & (syn >= synflood_ratio * (synack + 1.0)))[0]
+    flood = flood[np.argsort(-syn[flood])]
+    drop_z = np.asarray(report.drop_z)
+    drop_anom = np.nonzero(drop_z > drop_z_threshold)[0]
+    drop_anom = drop_anom[np.argsort(-drop_z[drop_anom])]  # worst first
+    causes = np.asarray(report.drop_causes)
+    cause_idx = np.nonzero(causes > 0)[0]
+    cause_idx = cause_idx[np.argsort(-causes[cause_idx])][:16]
+    dscp = np.asarray(report.dscp_bytes)
+    dscp_idx = np.nonzero(dscp > 0)[0]
     qs = [0.5, 0.9, 0.95, 0.99, 0.999]
     return {
         "Type": "sketch_window_report",
@@ -121,6 +145,10 @@ def report_to_json(report, max_heavy: int = 64,
         "Records": float(report.total_records),
         "Bytes": float(report.total_bytes),
         "DistinctSrcEstimate": float(report.distinct_src),
+        "DropBytes": float(report.total_drop_bytes),
+        "DropPackets": float(report.total_drop_packets),
+        "QuicRecords": float(report.quic_records),
+        "NatRecords": float(report.nat_records),
         "HeavyHitters": heavy,
         "RttQuantilesUs": {str(q): float(v) for q, v in zip(
             qs, np.asarray(report.rtt_quantiles_us))},
@@ -131,6 +159,15 @@ def report_to_json(report, max_heavy: int = 64,
         "PortScanSuspectBuckets": [
             {"bucket": int(b), "distinct_dst_port_pairs": float(fanout[b])}
             for b in scan[:32]],
+        "SynFloodSuspectBuckets": [
+            {"bucket": int(b), "syn": float(syn[b]),
+             "synack": float(synack[b]), "z": float(syn_z[b])}
+            for b in flood[:32]],
+        "DropAnomalyBuckets": [
+            {"bucket": int(b), "z": float(drop_z[b])}
+            for b in drop_anom[:32]],
+        "DropCauses": {str(int(c)): float(causes[c]) for c in cause_idx},
+        "DscpBytes": {str(int(d)): float(dscp[d]) for d in dscp_idx},
     }
 
 
@@ -144,7 +181,10 @@ class TpuSketchExporter(Exporter):
                  checkpoint_dir: str = "", checkpoint_every: int = 0,
                  decay_factor: Optional[float] = None,
                  scan_fanout_threshold: float = DEFAULT_SCAN_FANOUT,
-                 ddos_z_threshold: float = DEFAULT_DDOS_Z):
+                 ddos_z_threshold: float = DEFAULT_DDOS_Z,
+                 synflood_min: float = DEFAULT_SYNFLOOD_MIN,
+                 synflood_ratio: float = DEFAULT_SYNFLOOD_RATIO,
+                 drop_z_threshold: float = DEFAULT_DROP_Z):
         # jax-importing modules are pulled in lazily so the host agent can run
         # exporter-free on machines without accelerators
         from netobserv_tpu.sketch import state as sk
@@ -156,6 +196,9 @@ class TpuSketchExporter(Exporter):
         self._sink = sink or _default_sink
         self._scan_fanout = scan_fanout_threshold
         self._ddos_z = ddos_z_threshold
+        self._synflood_min = synflood_min
+        self._synflood_ratio = synflood_ratio
+        self._drop_z = drop_z_threshold
         self._metrics = metrics
         self._lock = threading.Lock()
         self._pending: list[Record] = []
@@ -256,6 +299,9 @@ class TpuSketchExporter(Exporter):
                    checkpoint_every=cfg.sketch_checkpoint_every,
                    scan_fanout_threshold=cfg.sketch_scan_fanout,
                    ddos_z_threshold=cfg.sketch_ddos_z,
+                   synflood_min=cfg.sketch_synflood_min,
+                   synflood_ratio=cfg.sketch_synflood_ratio,
+                   drop_z_threshold=cfg.sketch_drop_z,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
 
@@ -302,42 +348,47 @@ class TpuSketchExporter(Exporter):
         if not self._pending_ev:
             return
         events = np.concatenate([e.events for e in self._pending_ev])
-        # drops are not concatenated: the sketches never consume them (the
-        # dense feed carries exactly what the ingest reads — flowpack.cc
-        # layout), and this exporter is terminal for evictions
-        extra = self._concat_feature(self._pending_ev, "extra",
-                                     binfmt.EXTRA_REC_DTYPE)
-        dns = self._concat_feature(self._pending_ev, "dns",
-                                   binfmt.DNS_REC_DTYPE)
+        # every feature lane the dense feed carries (flowpack.cc layout):
+        # extra/dns ride as value columns, drops feed the drop-anomaly
+        # signals, xlat/quic fold to marker bits
+        feats = {
+            "extra": self._concat_feature(self._pending_ev, "extra",
+                                          binfmt.EXTRA_REC_DTYPE),
+            "dns": self._concat_feature(self._pending_ev, "dns",
+                                        binfmt.DNS_REC_DTYPE),
+            "drops": self._concat_feature(self._pending_ev, "drops",
+                                          binfmt.DROPS_REC_DTYPE),
+            "xlat": self._concat_feature(self._pending_ev, "xlat",
+                                         binfmt.XLAT_REC_DTYPE),
+            "quic": self._concat_feature(self._pending_ev, "quic",
+                                         binfmt.QUIC_REC_DTYPE),
+        }
         bs = self._batch_size
 
-        def sl(col, lo, hi):
-            return col[lo:hi] if col is not None else None
+        def sl(lo, hi):
+            return {k: (v[lo:hi] if v is not None else None)
+                    for k, v in feats.items()}
 
         off = 0
         while len(events) - off >= bs:
-            self._fold_events(events[off:off + bs], sl(extra, off, off + bs),
-                              sl(dns, off, off + bs))
+            self._fold_events(events[off:off + bs], sl(off, off + bs))
             off += bs
         rest = len(events) - off
         if rest and final:
-            self._fold_events(events[off:], sl(extra, off, None),
-                              sl(dns, off, None))
+            self._fold_events(events[off:], sl(off, None))
             rest = 0
         if rest:
-            self._pending_ev = [EvictedFlows(
-                events[off:], extra=sl(extra, off, None),
-                dns=sl(dns, off, None))]
+            tail = sl(off, None)
+            self._pending_ev = [EvictedFlows(events[off:], **tail)]
             self._pending_ev_n = rest
         else:
             self._pending_ev = []
             self._pending_ev_n = 0
 
-    def _fold_events(self, events, extra, dns) -> None:
+    def _fold_events(self, events, feats) -> None:
         t0 = time.perf_counter()
         n = len(events)
-        self._state = self._ring.fold(self._state, events, extra=extra,
-                                      dns=dns)
+        self._state = self._ring.fold(self._state, events, **feats)
         if self._metrics is not None:
             self._metrics.sketch_batches_total.inc()
             self._metrics.sketch_records_total.inc(n)
@@ -403,7 +454,10 @@ class TpuSketchExporter(Exporter):
         self._state, report = self._roll(self._state)
         obj = report_to_json(
             report, scan_fanout_threshold=self._scan_fanout,
-            ddos_z_threshold=self._ddos_z)
+            ddos_z_threshold=self._ddos_z,
+            synflood_min=self._synflood_min,
+            synflood_ratio=self._synflood_ratio,
+            drop_z_threshold=self._drop_z)
         obj["TimestampMs"] = time.time_ns() // 1_000_000
         self._sink(obj)
         if self._metrics is not None:

@@ -52,7 +52,13 @@ def _state_specs(state: sk.SketchState) -> sk.SketchState:
         hist_rtt=quantile.LogHist(counts=d),
         hist_dns=quantile.LogHist(counts=d),
         ddos=ewma.EWMA(mean=d, var=d, rate=d, windows=d),
-        total_records=d, total_bytes=d, window=d,
+        syn=ewma.EWMA(mean=d, var=d, rate=d, windows=d),
+        synack=d,
+        drops_ewma=ewma.EWMA(mean=d, var=d, rate=d, windows=d),
+        drop_causes=d, dscp_bytes=d,
+        total_records=d, total_bytes=d,
+        total_drop_bytes=d, total_drop_packets=d,
+        quic_records=d, nat_records=d, window=d,
     )
 
 
@@ -67,10 +73,6 @@ def _add_lead(s: sk.SketchState) -> sk.SketchState:
     """Inverse of _drop_lead."""
     out = jax.tree.map(lambda x: x[None], s)
     return out._replace(heavy=jax.tree.map(lambda x: x[None], out.heavy))
-
-
-def _batch_specs(arrays: dict) -> dict:
-    return {k: P(DATA_AXIS) for k in arrays}
 
 
 def init_dist_state(cfg: sk.SketchConfig, mesh: Mesh) -> sk.SketchState:
@@ -139,10 +141,9 @@ def make_sharded_ingest_fn(mesh: Mesh, cfg: sk.SketchConfig,
             return out, (batch[:1] if batch.ndim == 1 else batch[:1, 0])
         return out
 
-    batch_specs = (P(DATA_AXIS) if dense else
-                   _batch_specs({"keys": 0, "bytes": 0, "packets": 0,
-                                 "rtt_us": 0, "dns_latency_us": 0,
-                                 "valid": 0, "sampling": 0}))
+    # one spec as a pytree PREFIX covers the whole batch: every column is
+    # row-sharded over the data axis, whatever feature columns it carries
+    batch_specs = P(DATA_AXIS)
     shmapped = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(specs, batch_specs),
@@ -200,8 +201,23 @@ def merge_states(s: sk.SketchState, nsk: int) -> sk.SketchState:
         ddos=ewma.EWMA(mean=s.ddos.mean, var=s.ddos.var,
                        rate=jax.lax.psum(s.ddos.rate, DATA_AXIS),
                        windows=s.ddos.windows),
+        # the EWMA baselines (mean/var) are replicated and rolled identically
+        # on every device; only the window rates are true partials
+        syn=ewma.EWMA(mean=s.syn.mean, var=s.syn.var,
+                      rate=jax.lax.psum(s.syn.rate, DATA_AXIS),
+                      windows=s.syn.windows),
+        synack=jax.lax.psum(s.synack, DATA_AXIS),
+        drops_ewma=ewma.EWMA(mean=s.drops_ewma.mean, var=s.drops_ewma.var,
+                             rate=jax.lax.psum(s.drops_ewma.rate, DATA_AXIS),
+                             windows=s.drops_ewma.windows),
+        drop_causes=jax.lax.psum(s.drop_causes, DATA_AXIS),
+        dscp_bytes=jax.lax.psum(s.dscp_bytes, DATA_AXIS),
         total_records=jax.lax.psum(s.total_records, DATA_AXIS),
         total_bytes=jax.lax.psum(s.total_bytes, DATA_AXIS),
+        total_drop_bytes=jax.lax.psum(s.total_drop_bytes, DATA_AXIS),
+        total_drop_packets=jax.lax.psum(s.total_drop_packets, DATA_AXIS),
+        quic_records=jax.lax.psum(s.quic_records, DATA_AXIS),
+        nat_records=jax.lax.psum(s.nat_records, DATA_AXIS),
         window=s.window,
     )
 
@@ -223,7 +239,11 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
         heavy=topk.TopK(words=P(), h1=P(), h2=P(), counts=P(), valid=P()),
         distinct_src=P(), per_dst_cardinality=P(), per_src_fanout=P(),
         rtt_quantiles_us=P(),
-        dns_quantiles_us=P(), ddos_z=P(), total_records=P(), total_bytes=P(),
+        dns_quantiles_us=P(), ddos_z=P(), syn_z=P(), syn_rate=P(),
+        synack_rate=P(), drop_z=P(), drop_causes=P(), dscp_bytes=P(),
+        total_records=P(), total_bytes=P(),
+        total_drop_bytes=P(), total_drop_packets=P(),
+        quic_records=P(), nat_records=P(),
         window=P(),
     )
 
@@ -231,6 +251,8 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
         s = _drop_lead(pstate)
         merged = merge_states(s, nsk)
         ddos_state, z = ewma.roll(merged.ddos, cfg.ewma_alpha)
+        syn_state, syn_z = ewma.roll(merged.syn, cfg.ewma_alpha)
+        drops_state, drop_z = ewma.roll(merged.drops_ewma, cfg.ewma_alpha)
         gamma = quantile.gamma_for(merged.hist_rtt.n_buckets)
         report = sk.WindowReport(
             heavy=merged.heavy,
@@ -242,25 +264,40 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
             dns_quantiles_us=quantile.quantile(merged.hist_dns,
                                                jnp.asarray(sk.QS), gamma),
             ddos_z=z,
+            syn_z=syn_z,
+            syn_rate=merged.syn.rate,
+            synack_rate=merged.synack,
+            drop_z=drop_z,
+            drop_causes=merged.drop_causes,
+            dscp_bytes=merged.dscp_bytes,
             total_records=merged.total_records,
             total_bytes=merged.total_bytes,
+            total_drop_bytes=merged.total_drop_bytes,
+            total_drop_packets=merged.total_drop_packets,
+            quic_records=merged.quic_records,
+            nat_records=merged.nat_records,
             window=merged.window,
+        )
+        ewma_rolled = dict(
+            ddos=ddos_state._replace(rate=jnp.zeros_like(s.ddos.rate)),
+            syn=syn_state._replace(rate=jnp.zeros_like(s.syn.rate)),
+            drops_ewma=drops_state._replace(
+                rate=jnp.zeros_like(s.drops_ewma.rate)),
         )
         if decay_factor is not None:
             # decay the local PARTIAL (linearity makes per-shard decay exact)
             new = sk.decay_state(s, decay_factor)._replace(
-                ddos=ddos_state._replace(rate=jnp.zeros_like(s.ddos.rate)),
-                window=s.window + 1,
+                window=s.window + 1, **ewma_rolled,
             )
         elif reset_sketches:
             fresh = jax.tree.map(jnp.zeros_like, s)
             new = fresh._replace(
                 heavy=topk.init(s.heavy.k, s.heavy.words.shape[-1]),
-                ddos=ddos_state._replace(rate=jnp.zeros_like(s.ddos.rate)),
-                window=s.window + 1,
+                window=s.window + 1, **ewma_rolled,
             )
         else:
-            new = s._replace(ddos=ddos_state, window=s.window + 1)
+            new = s._replace(ddos=ddos_state, syn=syn_state,
+                             drops_ewma=drops_state, window=s.window + 1)
         return _add_lead(new), report
 
     shmapped = jax.shard_map(

@@ -79,7 +79,8 @@ class DenseStagingRing:
         self._tokens: list = [None] * n_slots
         self._slot = 0
 
-    def fold(self, state, events, extra=None, dns=None):
+    def fold(self, state, events, extra=None, dns=None, drops=None,
+             xlat=None, quic=None):
         """Pack `events` into the next free slot, ship it, ingest it; returns
         the new sketch state (async — not blocked on)."""
         import jax
@@ -92,35 +93,37 @@ class DenseStagingRing:
                 if self._metrics is not None:
                     self._metrics.sketch_staging_stalls_total.inc()
             jax.block_until_ready(tok)  # slot's last consumer has finished
+        feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat, quic=quic)
         if self.spill_cap is not None:
             buf = flowpack.pack_compact(
                 events, batch_size=self.batch_size, spill_cap=self.spill_cap,
-                extra=extra, dns=dns, out=self._bufs[slot])
+                out=self._bufs[slot], **feats)
             if buf is None:
-                return self._fold_dense_fallback(state, events, extra, dns)
+                return self._fold_dense_fallback(state, events, feats)
             state, self._tokens[slot] = self._ingest(state, self._put(buf))
             self._slot = (slot + 1) % len(self._bufs)
             return state
         buf = flowpack.pack_dense(events, batch_size=self.batch_size,
-                                  extra=extra, dns=dns, out=self._bufs[slot])
-        # ship FLAT: a (B*16,) transfer dodges device-layout padding of the
-        # 16-wide minor dim (the ingest jit reshapes back, fused, free)
+                                  out=self._bufs[slot], **feats)
+        # ship FLAT: a (B*20,) transfer dodges device-layout padding of the
+        # 20-wide minor dim (the ingest jit reshapes back, fused, free)
         state, self._tokens[slot] = self._ingest(
             state, self._put(buf.reshape(-1)))
         self._slot = (slot + 1) % len(self._bufs)
         return state
 
-    def _fold_dense_fallback(self, state, events, extra, dns):
-        """Non-v4 flows exceeded the spill lane: ship this batch full-width.
-        Synchronous (the shared dense buffer has no slot ring), and rare —
-        only v6-dominant traffic takes it repeatedly, at dense-path speed."""
+    def _fold_dense_fallback(self, state, events, feats):
+        """Non-v4 (or spill-overflow) flows exceeded the spill lane: ship
+        this batch full-width. Synchronous (the shared dense buffer has no
+        slot ring), and rare — only v6-dominant traffic or a drop storm
+        takes it repeatedly, at dense-path speed."""
         import jax
 
         if self._dense_buf is None:
             self._dense_buf = np.empty(
                 (self.batch_size, flowpack.DENSE_WORDS), np.uint32)
         buf = flowpack.pack_dense(events, batch_size=self.batch_size,
-                                  extra=extra, dns=dns, out=self._dense_buf)
+                                  out=self._dense_buf, **feats)
         state, tok = self._ingest_fallback(state, self._put(buf.reshape(-1)))
         jax.block_until_ready(tok)
         return state

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from netobserv_tpu.model.columnar import KEY_WORDS, FlowBatch
+from netobserv_tpu.model.flow import TcpFlags
 from netobserv_tpu.ops import countmin, ewma, hashing, hll, quantile, topk
 
 
@@ -69,8 +70,21 @@ class SketchState(NamedTuple):
     hist_rtt: quantile.LogHist
     hist_dns: quantile.LogHist
     ddos: ewma.EWMA
+    # SYN-flood signal: EWMA of half-open SYN attempts per victim bucket,
+    # plus this window's SYN-ACK responses in the SAME buckets (the ratio
+    # denominator; a flooded service accepts far fewer than it is offered)
+    syn: ewma.EWMA
+    synack: jax.Array         # f32[m] — current-window SYN-ACK responses
+    # drop-anomaly signal: EWMA of dropped bytes per victim bucket
+    drops_ewma: ewma.EWMA
+    drop_causes: jax.Array    # f32[N_DROP_CAUSES] — window drop pkts by cause
+    dscp_bytes: jax.Array     # f32[N_DSCP] — window bytes by DSCP class
     total_records: jax.Array  # f32[] — window totals
     total_bytes: jax.Array    # f32[]
+    total_drop_bytes: jax.Array    # f32[]
+    total_drop_packets: jax.Array  # f32[]
+    quic_records: jax.Array   # f32[] — window records with QUIC marker
+    nat_records: jax.Array    # f32[] — window records with a NAT translation
     window: jax.Array         # i32[]
 
 
@@ -84,12 +98,29 @@ class WindowReport(NamedTuple):
     rtt_quantiles_us: jax.Array    # f32[5] for q = .5 .9 .95 .99 .999
     dns_quantiles_us: jax.Array    # f32[5]
     ddos_z: jax.Array              # f32[m] z-score per dst bucket
+    syn_z: jax.Array               # f32[m] half-open SYN surge z per bucket
+    syn_rate: jax.Array            # f32[m] this window's half-open attempts
+    synack_rate: jax.Array         # f32[m] this window's SYN-ACK responses
+    drop_z: jax.Array              # f32[m] dropped-bytes surge z per bucket
+    drop_causes: jax.Array         # f32[N_DROP_CAUSES] drop pkts by cause
+    dscp_bytes: jax.Array          # f32[N_DSCP] bytes by DSCP class
     total_records: jax.Array
     total_bytes: jax.Array
+    total_drop_bytes: jax.Array
+    total_drop_packets: jax.Array
+    quic_records: jax.Array
+    nat_records: jax.Array
     window: jax.Array
 
 
 QS = np.array([0.5, 0.9, 0.95, 0.99, 0.999], dtype=np.float32)
+
+#: drop-cause histogram size — kernel SKB_DROP_REASON values clamp to the
+#: last bucket (the enum tops out well below this; cf. reference
+#: pkg/decode drop-cause table)
+N_DROP_CAUSES = 128
+#: DSCP class histogram size (6-bit code space)
+N_DSCP = 64
 
 
 def init_state(cfg: SketchConfig = SketchConfig()) -> SketchState:
@@ -106,8 +137,17 @@ def init_state(cfg: SketchConfig = SketchConfig()) -> SketchState:
         hist_rtt=quantile.init(cfg.hist_buckets),
         hist_dns=quantile.init(cfg.hist_buckets),
         ddos=ewma.init(cfg.ewma_buckets),
+        syn=ewma.init(cfg.ewma_buckets),
+        synack=jnp.zeros((cfg.ewma_buckets,), jnp.float32),
+        drops_ewma=ewma.init(cfg.ewma_buckets),
+        drop_causes=jnp.zeros((N_DROP_CAUSES,), jnp.float32),
+        dscp_bytes=jnp.zeros((N_DSCP,), jnp.float32),
         total_records=jnp.zeros((), jnp.float32),
         total_bytes=jnp.zeros((), jnp.float32),
+        total_drop_bytes=jnp.zeros((), jnp.float32),
+        total_drop_packets=jnp.zeros((), jnp.float32),
+        quic_records=jnp.zeros((), jnp.float32),
+        nat_records=jnp.zeros((), jnp.float32),
         window=jnp.zeros((), jnp.int32),
     )
 
@@ -124,18 +164,22 @@ def batch_to_device(batch: FlowBatch) -> dict[str, np.ndarray]:
         "dns_latency_us": batch.dns_latency_us.astype(np.int32),
         "valid": batch.valid.astype(np.bool_),
         "sampling": batch.sampling.astype(np.int32),
+        "tcp_flags": batch.tcp_flags.astype(np.int32),
+        "dscp": batch.dscp.astype(np.int32),
+        "drop_bytes": batch.drop_bytes.astype(np.int32),
+        "drop_packets": batch.drop_packets.astype(np.int32),
     }
 
 
-DENSE_WORDS = 16  # row width; must equal flowpack.DENSE_WORDS (layout twin)
+DENSE_WORDS = 20  # row width; must equal flowpack.DENSE_WORDS (layout twin)
 
 
 def dense_to_arrays(dense: jax.Array) -> dict[str, jax.Array]:
     """Device-side unpack of the flowpack dense feed — one host->device
-    transfer per batch instead of six (the transfer link, not compute, bounds
-    the host path on tunneled/PCIe chips). Accepts the batch either as
-    (B, 16) rows or FLAT (B*16,) — flat is how the staging ring ships it:
-    a 1-D transfer avoids the device tiling pad a 16-wide minor dimension
+    transfer per batch instead of many (the transfer link, not compute,
+    bounds the host path on tunneled/PCIe chips). Accepts the batch either
+    as (B, 20) rows or FLAT (B*20,) — flat is how the staging ring ships it:
+    a 1-D transfer avoids the device tiling pad a 20-wide minor dimension
     suffers (measured 1.5-8x transfer inflation on the axon chip), and the
     reshape here fuses into the ingest executable. Row layout is pinned in
     flowpack.cc fp_pack_dense."""
@@ -149,6 +193,12 @@ def dense_to_arrays(dense: jax.Array) -> dict[str, jax.Array]:
         "dns_latency_us": dense[:, 13].astype(jnp.int32),
         "valid": dense[:, 14] != 0,
         "sampling": dense[:, 15].astype(jnp.int32),
+        "tcp_flags": (dense[:, 16] & jnp.uint32(0xFFFF)).astype(jnp.int32),
+        "dscp": ((dense[:, 16] >> 16) & jnp.uint32(0xFF)).astype(jnp.int32),
+        "markers": (dense[:, 16] >> 24).astype(jnp.int32),
+        "drop_bytes": (dense[:, 17] & jnp.uint32(0xFFFF)).astype(jnp.int32),
+        "drop_packets": (dense[:, 17] >> 16).astype(jnp.int32),
+        "drop_cause": (dense[:, 18] & jnp.uint32(0xFFFF)).astype(jnp.int32),
     }
 
 
@@ -156,8 +206,15 @@ def arrays_to_dense(arrays: dict[str, np.ndarray]) -> np.ndarray:
     """Host-side inverse of dense_to_arrays: pack an array dict into the
     flat flowpack dense feed — the one Python twin of the row layout pinned
     in flowpack.cc fp_pack_dense (tests and the dryrun build synthetic
-    batches through here so a layout change has a single site)."""
+    batches through here so a layout change has a single site). The feature
+    columns (tcp_flags/dscp/markers/drop_*) are optional — absent keys pack
+    as zero, matching a datapath with those trackers disabled."""
     n = len(arrays["valid"])
+    zeros = np.zeros(n, np.uint32)
+
+    def col(name):
+        return np.asarray(arrays.get(name, zeros), np.uint32)
+
     dense = np.zeros((n, DENSE_WORDS), np.uint32)
     dense[:, :KEY_WORDS] = arrays["keys"]
     dense[:, 10] = np.asarray(arrays["bytes"], np.float32).view(np.uint32)
@@ -165,7 +222,11 @@ def arrays_to_dense(arrays: dict[str, np.ndarray]) -> np.ndarray:
     dense[:, 12] = arrays["rtt_us"]
     dense[:, 13] = arrays["dns_latency_us"]
     dense[:, 14] = np.asarray(arrays["valid"], np.uint32)
-    dense[:, 15] = arrays.get("sampling", np.zeros(n, np.int32))
+    dense[:, 15] = col("sampling")
+    dense[:, 16] = (col("tcp_flags") | (col("dscp") << 16)
+                    | (col("markers") << 24))
+    dense[:, 17] = col("drop_bytes") | (col("drop_packets") << 16)
+    dense[:, 18] = col("drop_cause")
     return dense.reshape(-1)
 
 
@@ -260,13 +321,68 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     hist_dns = quantile.update(state.hist_dns, dns, valid & (dns > 0), gamma)
     ddos = ewma.accumulate(state.ddos, dst_h1, bytes_f, valid)
 
+    # --- feature-lane signals (trace-time optional: a feed without the
+    # column — e.g. the legacy six-array dict — simply skips the signal) ---
+    mass = factor.astype(jnp.float32) if samp is not None else 1.0
+    flags = arrays.get("tcp_flags")
+    syn_state, synack_arr = state.syn, state.synack
+    if flags is not None:
+        # SYN-flood: half-open attempts (SYN seen, never ACKed — a spoofed
+        # flood leaves one such record per probe) bucket by victim = dst;
+        # SYN-ACK response flows bucket by victim = src (the responder),
+        # using the SAME hash seed so both land in one bucket per victim.
+        # Flag bits ride the dense feed from the datapath's OR-accumulated
+        # tcp_flags (reference exports them per flow, proto/flow.proto:30).
+        f = flags.astype(jnp.int32)
+        half_open = valid & ((f & TcpFlags.SYN) != 0) & \
+            ((f & TcpFlags.ACK) == 0)
+        syn_state = ewma.accumulate(state.syn, dst_h1,
+                                    jnp.where(half_open, mass, 0.0), valid)
+        vic_h1, _ = hashing.base_hashes(words[:, 0:4], seed=0x0D57)
+        sa_idx = (vic_h1 & jnp.uint32(state.synack.shape[0] - 1)
+                  ).astype(jnp.int32)
+        is_synack = valid & ((f & TcpFlags.SYN_ACK) != 0)
+        synack_arr = state.synack.at[sa_idx].add(
+            jnp.where(is_synack, mass, 0.0), mode="drop")
+    dscp = arrays.get("dscp")
+    dscp_bytes = state.dscp_bytes
+    if dscp is not None:
+        dscp_bytes = dscp_bytes.at[dscp.astype(jnp.int32) & (N_DSCP - 1)].add(
+            jnp.where(valid, bytes_f, 0.0), mode="drop")
+    db = arrays.get("drop_bytes")
+    drops_state, drop_causes = state.drops_ewma, state.drop_causes
+    tdb, tdp = state.total_drop_bytes, state.total_drop_packets
+    if db is not None:
+        dbf = db.astype(jnp.float32) * mass
+        dpf = arrays["drop_packets"].astype(jnp.float32) * mass
+        drops_state = ewma.accumulate(state.drops_ewma, dst_h1, dbf, valid)
+        tdb = tdb + jnp.sum(jnp.where(valid, dbf, 0.0))
+        tdp = tdp + jnp.sum(jnp.where(valid, dpf, 0.0))
+        cause = arrays.get("drop_cause")
+        if cause is not None:
+            ci = jnp.minimum(cause.astype(jnp.int32), N_DROP_CAUSES - 1)
+            drop_causes = drop_causes.at[ci].add(
+                jnp.where(valid & (dpf > 0), dpf, 0.0), mode="drop")
+    mk = arrays.get("markers")
+    quic_rec, nat_rec = state.quic_records, state.nat_records
+    if mk is not None:
+        mki = mk.astype(jnp.int32)
+        quic_rec = quic_rec + jnp.sum(
+            (valid & ((mki & 1) != 0)).astype(jnp.float32))
+        nat_rec = nat_rec + jnp.sum(
+            (valid & ((mki & 2) != 0)).astype(jnp.float32))
+
     return SketchState(
         cm_bytes=cm_b, cm_pkts=cm_p, heavy=heavy, hll_src=hll_src,
         hll_per_dst=per_dst, hll_per_src=per_src, hist_rtt=hist_rtt,
         hist_dns=hist_dns, ddos=ddos,
+        syn=syn_state, synack=synack_arr, drops_ewma=drops_state,
+        drop_causes=drop_causes, dscp_bytes=dscp_bytes,
         total_records=state.total_records + jnp.sum(valid.astype(jnp.float32)),
         total_bytes=state.total_bytes + jnp.sum(
             jnp.where(valid, bytes_f, 0.0)),
+        total_drop_bytes=tdb, total_drop_packets=tdp,
+        quic_records=quic_rec, nat_records=nat_rec,
         window=state.window,
     )
 
@@ -278,19 +394,21 @@ def make_ingest_fn(donate: bool = True,
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
-COMPACT_WORDS = 9  # must equal flowpack.COMPACT_WORDS (layout twin)
+COMPACT_WORDS = 10  # must equal flowpack.COMPACT_WORDS (layout twin)
 _V4_PREFIX_WORD2 = 0xFFFF0000  # bytes 8..11 of a v4-in-v6 mapped address
 
 
 def compact_to_arrays(flat: jax.Array, batch_size: int,
                       spill_cap: int) -> dict[str, jax.Array]:
     """Device-side unpack of the flowpack COMPACT feed (flat
-    `[batch_size*9 v4 rows | spill_cap*16 dense rows]`, layout pinned in
+    `[batch_size*10 v4 rows | spill_cap*20 dense rows]`, layout pinned in
     flowpack.cc fp_pack_compact). Reconstructs full 10-word v4-mapped keys
     from the 4-word compact form and concatenates the spill lane, yielding
     one (batch_size + spill_cap)-row array dict for the ordinary ingest —
     the row widening happens in HBM where bandwidth is ~free; the transfer
-    link only ever saw ~40% of the dense feed's bytes."""
+    link only ever saw ~half of the dense feed's bytes. Drop columns are
+    zero on the compact lane by construction: drop-carrying rows always
+    ride the spill lane (fp_pack_compact routes them there)."""
     c = flat[:batch_size * COMPACT_WORDS].reshape(batch_size, COMPACT_WORDS)
     spill = dense_to_arrays(
         flat[batch_size * COMPACT_WORDS:].reshape(spill_cap, DENSE_WORDS))
@@ -300,6 +418,7 @@ def compact_to_arrays(flat: jax.Array, batch_size: int,
         [zeros, zeros, prefix, c[:, 0],
          zeros, zeros, prefix, c[:, 1],
          c[:, 2], c[:, 3] & jnp.uint32(0x00FFFFFF)], axis=1)
+    izeros = zeros.astype(jnp.int32)
     comp = {
         "keys": keys,
         "bytes": jax.lax.bitcast_convert_type(c[:, 4], jnp.float32),
@@ -308,6 +427,12 @@ def compact_to_arrays(flat: jax.Array, batch_size: int,
         "dns_latency_us": c[:, 7].astype(jnp.int32),
         "valid": (c[:, 3] & jnp.uint32(0x80000000)) != 0,
         "sampling": c[:, 8].astype(jnp.int32),
+        "tcp_flags": (c[:, 9] & jnp.uint32(0xFFFF)).astype(jnp.int32),
+        "dscp": ((c[:, 9] >> 16) & jnp.uint32(0xFF)).astype(jnp.int32),
+        "markers": (c[:, 9] >> 24).astype(jnp.int32),
+        "drop_bytes": izeros,
+        "drop_packets": izeros,
+        "drop_cause": izeros,
     }
     return {k: jnp.concatenate([comp[k], spill[k]], axis=0) for k in comp}
 
@@ -364,8 +489,17 @@ def decay_state(state: SketchState, factor: float) -> SketchState:
         hll_per_src=hll.PerDstHLL(jnp.zeros_like(state.hll_per_src.regs)),
         hist_rtt=quantile.LogHist(state.hist_rtt.counts * factor),
         hist_dns=quantile.LogHist(state.hist_dns.counts * factor),
+        # window accumulators paired with an EWMA rate (synack) reset with
+        # it; pure per-window histograms decay like the latency hists
+        synack=jnp.zeros_like(state.synack),
+        drop_causes=state.drop_causes * factor,
+        dscp_bytes=state.dscp_bytes * factor,
         total_records=state.total_records * factor,
         total_bytes=state.total_bytes * factor,
+        total_drop_bytes=state.total_drop_bytes * factor,
+        total_drop_packets=state.total_drop_packets * factor,
+        quic_records=state.quic_records * factor,
+        nat_records=state.nat_records * factor,
     )
 
 
@@ -376,6 +510,8 @@ def roll_window(state: SketchState, cfg: SketchConfig,
     """Close the current window: emit a report, roll EWMA baselines, and
     reset (or decay) the windowed sketch state while keeping the baselines."""
     ddos_state, z = ewma.roll(state.ddos, cfg.ewma_alpha)
+    syn_state, syn_z = ewma.roll(state.syn, cfg.ewma_alpha)
+    drops_state, drop_z = ewma.roll(state.drops_ewma, cfg.ewma_alpha)
     gamma = quantile.gamma_for(state.hist_rtt.n_buckets)
     report = WindowReport(
         heavy=state.heavy,
@@ -385,13 +521,24 @@ def roll_window(state: SketchState, cfg: SketchConfig,
         rtt_quantiles_us=quantile.quantile(state.hist_rtt, jnp.asarray(QS), gamma),
         dns_quantiles_us=quantile.quantile(state.hist_dns, jnp.asarray(QS), gamma),
         ddos_z=z,
+        syn_z=syn_z,
+        syn_rate=state.syn.rate,
+        synack_rate=state.synack,
+        drop_z=drop_z,
+        drop_causes=state.drop_causes,
+        dscp_bytes=state.dscp_bytes,
         total_records=state.total_records,
         total_bytes=state.total_bytes,
+        total_drop_bytes=state.total_drop_bytes,
+        total_drop_packets=state.total_drop_packets,
+        quic_records=state.quic_records,
+        nat_records=state.nat_records,
         window=state.window,
     )
     if decay_factor is not None:
         new_state = decay_state(state, decay_factor)._replace(
-            ddos=ddos_state, window=state.window + 1)
+            ddos=ddos_state, syn=syn_state, drops_ewma=drops_state,
+            window=state.window + 1)
     elif reset_sketches:
         fresh = init_state(SketchConfig(
             cm_depth=state.cm_bytes.depth, cm_width=state.cm_bytes.width,
@@ -402,10 +549,13 @@ def roll_window(state: SketchState, cfg: SketchConfig,
             persrc_precision=int(state.hll_per_src.regs.shape[1]).bit_length() - 1,
             topk=state.heavy.k, hist_buckets=state.hist_rtt.n_buckets,
             ewma_buckets=state.ddos.rate.shape[0], ewma_alpha=cfg.ewma_alpha))
-        new_state = fresh._replace(ddos=ddos_state,
+        new_state = fresh._replace(ddos=ddos_state, syn=syn_state,
+                                   drops_ewma=drops_state,
                                    window=state.window + 1)
     else:
-        new_state = state._replace(ddos=ddos_state, window=state.window + 1)
+        new_state = state._replace(ddos=ddos_state, syn=syn_state,
+                                   drops_ewma=drops_state,
+                                   window=state.window + 1)
     return new_state, report
 
 

@@ -6,7 +6,9 @@ import pytest
 
 import tests.conftest  # noqa: F401
 
-from scripts.accuracy_sweep import run_case, run_mesh_hll_case
+from scripts.accuracy_sweep import (
+    run_case, run_drop_case, run_mesh_hll_case, run_synflood_case,
+)
 
 
 @pytest.mark.parametrize("zipf_s,width,k,mode", [
@@ -30,3 +32,16 @@ def test_merged_mesh_hll_bound():
     if err is None:
         pytest.skip("needs 4 devices")
     assert err < 0.03, f"merged HLL err {err}"
+
+
+@pytest.mark.parametrize("flood_n", [128, 2048])
+def test_synflood_detection_bound(flood_n):
+    detected, fp, syn, synack = run_synflood_case(flood_n)
+    assert detected, f"flood of {flood_n} half-opens missed"
+    assert fp == 0, f"{fp} healthy buckets falsely flagged"
+
+
+def test_drop_anomaly_detection_bound():
+    detected, fp, victim_z, other_z = run_drop_case(10.0)
+    assert detected and fp == 0
+    assert victim_z > 100 * other_z  # unambiguous separation

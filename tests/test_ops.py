@@ -344,13 +344,19 @@ def test_ddos_z_threshold_configurable():
     from netobserv_tpu.sketch.state import WindowReport
 
     z = np.array([0.0, 5.0, 7.0], np.float32)
+    zero3 = np.zeros(3, np.float32)
     report = WindowReport(
         heavy=topk.init(4), distinct_src=np.float32(0),
         per_dst_cardinality=np.zeros(4, np.float32),
         per_src_fanout=np.zeros(4, np.float32),
         rtt_quantiles_us=np.zeros(5, np.float32),
         dns_quantiles_us=np.zeros(5, np.float32), ddos_z=z,
+        syn_z=zero3, syn_rate=zero3, synack_rate=zero3, drop_z=zero3,
+        drop_causes=np.zeros(128, np.float32),
+        dscp_bytes=np.zeros(64, np.float32),
         total_records=np.float32(0), total_bytes=np.float32(0),
+        total_drop_bytes=np.float32(0), total_drop_packets=np.float32(0),
+        quic_records=np.float32(0), nat_records=np.float32(0),
         window=np.int32(1))
     default = report_to_json(report)
     assert [s["bucket"] for s in default["DdosSuspectBuckets"]] == [2]

@@ -30,6 +30,14 @@ def make_arrays(n, rng, n_distinct=200):
         "dns_latency_us": rng.integers(0, 100, n).astype(np.int32),
         "sampling": np.zeros(n, np.int32),
         "valid": np.ones(n, np.bool_),
+        # feature lane (flags/dscp/markers/drops) — nonzero so the dict and
+        # dense transports must agree on the new signal planes too
+        "tcp_flags": rng.integers(0, 1 << 9, n).astype(np.int32),
+        "dscp": rng.integers(0, 64, n).astype(np.int32),
+        "markers": rng.integers(0, 16, n).astype(np.int32),
+        "drop_bytes": rng.integers(0, 100, n).astype(np.int32),
+        "drop_packets": rng.integers(0, 3, n).astype(np.int32),
+        "drop_cause": rng.integers(0, 80, n).astype(np.int32),
     }
 
 

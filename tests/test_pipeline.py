@@ -294,3 +294,95 @@ def test_port_scan_surfaces_in_exporter_window_report():
     assert suspects, "scanner not reported through the exporter pipeline"
     assert suspects[0]["distinct_dst_port_pairs"] > 500
     exp.close()
+
+
+def test_syn_flood_surfaces_in_exporter_window_report():
+    """Agent-level SYN-flood detection: a spoofed flood (many half-open SYN
+    records to one victim, few SYN-ACK responses) through the FULL
+    TpuSketchExporter pipeline must surface in SynFloodSuspectBuckets;
+    a busy-but-healthy service (every SYN answered) must not."""
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.model.flow import FlowKey
+    from netobserv_tpu.model.record import Record
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    def rec(src, dst, sport, dport, flags):
+        return Record(
+            key=FlowKey.make(src, dst, sport, dport, 6), bytes_=60,
+            packets=1, eth_protocol=0x0800, tcp_flags=flags, direction=1,
+            src_mac=b"\x02" * 6, dst_mac=b"\x04" * 6, if_index=3,
+            interface="eth0", dscp=0, sampling=0, agent_ip="192.0.2.1")
+
+    reports = []
+    exp = TpuSketchExporter(
+        batch_size=128, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10,
+                                hll_precision=6, perdst_buckets=32,
+                                perdst_precision=4, topk=16, hist_buckets=64,
+                                ewma_buckets=64),
+        sink=reports.append, synflood_min=64, synflood_ratio=8.0)
+    victim = "10.0.0.5"
+    # the flood: 512 spoofed sources, SYN never ACKed (half-open), and the
+    # victim manages only a handful of SYN-ACK responses
+    flood = [rec(f"172.16.{i % 200}.{i % 250 + 1}", victim,
+                 1024 + i, 80, 0x02) for i in range(512)]
+    flood += [rec(victim, f"172.16.0.{i + 1}", 80, 2000 + i, 0x112)
+              for i in range(4)]
+    # a busy healthy service: 200 clients, every handshake completes (client
+    # flows carry SYN|ACK, server responses carry SYN-ACK)
+    healthy = [rec(f"10.7.0.{i % 250 + 1}", "10.0.0.9", 3000 + i, 443, 0x12)
+               for i in range(200)]
+    healthy += [rec("10.0.0.9", f"10.7.0.{i % 250 + 1}", 443, 3000 + i, 0x112)
+                for i in range(200)]
+    exp.export_batch(flood)
+    exp.export_batch(healthy)
+    exp.flush()  # close() below rolls one more (empty) window
+    assert reports, "no window report emitted"
+    suspects = reports[0]["SynFloodSuspectBuckets"]
+    assert suspects, "flood not reported through the exporter pipeline"
+    assert suspects[0]["syn"] >= 500
+    assert suspects[0]["synack"] <= 8
+    # exactly the victim's bucket: the healthy service bucket stays quiet
+    assert len(suspects) == 1
+    exp.close()
+
+
+def test_drop_storm_surfaces_in_exporter_window_report():
+    """Agent-level drop-anomaly detection over the COLUMNAR fast path: two
+    calm windows seed the EWMA baseline, then a drop storm (kernel drops
+    record array riding the eviction) must push the victim bucket's
+    dropped-bytes z-score over the threshold and surface cause totals."""
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    reports = []
+    exp = TpuSketchExporter(
+        batch_size=64, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10,
+                                hll_precision=6, perdst_buckets=32,
+                                perdst_precision=4, topk=16, hist_buckets=64,
+                                ewma_buckets=64),
+        sink=reports.append, drop_z_threshold=6.0)
+
+    def evict(drop_bytes, cause=2):
+        ev = make_events(64)
+        drops = np.zeros(64, dtype=binfmt.DROPS_REC_DTYPE)
+        if drop_bytes:
+            drops["bytes"] = drop_bytes
+            drops["packets"] = 3
+            drops["latest_cause"] = cause
+        return EvictedFlows(ev, drops=drops if drop_bytes else None)
+
+    for _ in range(2):  # calm baseline windows (EWMA warmup)
+        exp.export_evicted(evict(0))
+        exp.flush()
+    exp.export_evicted(evict(1400, cause=5))
+    exp.flush()  # close() below rolls one more (empty) window
+    storm = reports[2]
+    assert storm["DropBytes"] == 1400.0 * 64
+    assert storm["DropPackets"] == 3.0 * 64
+    assert storm["DropCauses"] == {"5": 3.0 * 64}
+    assert storm["DropAnomalyBuckets"], "drop storm not reported"
+    calm = reports[1]
+    assert calm["DropBytes"] == 0.0 and not calm["DropAnomalyBuckets"]
+    exp.close()
