@@ -17,10 +17,10 @@ test-cpu:
 	$(CPU_ENV) $(PY) -m pytest tests/ -x -q
 
 bench:
-	$(PY) bench.py --check
+	$(PY) bench.py
 
 bench-cpu:
-	JAX_PLATFORMS=cpu $(PY) bench.py --check
+	JAX_PLATFORMS=cpu $(PY) bench.py
 
 gen-protobuf:
 	protoc --python_out=netobserv_tpu/pb -I proto proto/flow.proto proto/packet.proto

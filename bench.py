@@ -2,18 +2,29 @@
 
 Prints ONE JSON line:
   {"metric": "flow_records_per_sec_per_chip", "value": N, "unit": "records/s",
-   "vs_baseline": R}
+   "vs_baseline": R, "p10": ..., "p90": ..., "segments": ...,
+   "recall_at_100": ..., "fanout_off_records_per_sec": ...,
+   "host_path_burst": ..., "host_path_sustained": ..., ...}
 
-- value: steady-state flow records folded per second into the full sketch state
-  (Count-Min bytes+packets, top-K, HLL, per-dst HLL, 2 histograms, EWMA) on the
-  default device (the real TPU chip under the driver).
-- vs_baseline: ratio against the CPU exact-aggregation baseline measured in the
-  same process (vectorized numpy per-key aggregation — the honest stand-in for
-  the reference's Go Accounter/map-eviction path, BASELINE.md "baseline to
-  beat"; the reference publishes no absolute numbers).
+- value: MEDIAN of per-segment steady-state rates folding flow records into
+  the full sketch state (Count-Min bytes+packets, top-K, HLL + both fan-out
+  grids, histograms, 3 EWMAs, feature-lane signals) on the default device
+  (the real TPU chip under the driver). p10/p90 bound the spread so a real
+  regression is distinguishable from tunnel mood (VERDICT r3 weak #1).
+- vs_baseline: ratio against the CPU exact-aggregation baseline measured in
+  the same process (vectorized numpy per-key aggregation — the honest
+  stand-in for the reference's Go Accounter/map-eviction path, BASELINE.md
+  "baseline to beat"; the reference publishes no absolute numbers).
+- fanout_off_records_per_sec: same ingest with the per-src fan-out grid
+  disabled — the round-over-round A/B that attributes the grid's cost.
+- host_path_burst / host_path_sustained: the evict→pack→transfer→ingest
+  production ring measured in 1s segments — burst = best segment (the
+  path's capability), sustained = median (what a throttling tunnel actually
+  delivers); host_segments lists every segment so consumers see the spread.
+  host_pack / host_put give the stage split.
 
-Run `python bench.py --check` to additionally report heavy-hitter recall vs the
-exact oracle on stderr (BASELINE acceptance bound: <1% recall loss).
+Heavy-hitter recall vs the exact oracle is always computed and included in
+the JSON (`recall_at_100`; the BASELINE bound is <1% loss).
 """
 
 from __future__ import annotations
@@ -28,7 +39,8 @@ BATCH = 16384
 N_BATCHES_POOL = 8
 _DEVICE_NOTE = ""
 WARMUP_ITERS = 10  # the first executions after compile run measurably slower
-TIMED_ITERS = 40
+SEGMENT_ITERS = 12
+N_SEGMENTS = 8
 N_DISTINCT = 50_000
 ZIPF_A = 1.2
 
@@ -38,6 +50,11 @@ def make_pool(rng: np.random.Generator):
     pool = []
     for _ in range(N_BATCHES_POOL):
         ranks = np.minimum(rng.zipf(ZIPF_A, BATCH) - 1, N_DISTINCT - 1)
+        # feature lane included so the measured rate pays for the FULL
+        # signal set (flags/SYN, dscp, markers, drops) — drops mostly zero,
+        # as in live traffic
+        drop_b = np.where(rng.random(BATCH) < 0.02,
+                          rng.integers(1, 1500, BATCH), 0).astype(np.int32)
         pool.append(({
             "keys": universe[ranks],
             "bytes": rng.integers(64, 9000, BATCH).astype(np.float32),
@@ -46,6 +63,12 @@ def make_pool(rng: np.random.Generator):
             "dns_latency_us": rng.integers(0, 2000, BATCH).astype(np.int32),
             "sampling": np.zeros(BATCH, np.int32),
             "valid": np.ones(BATCH, np.bool_),
+            "tcp_flags": rng.integers(0, 1 << 9, BATCH).astype(np.int32),
+            "dscp": rng.integers(0, 64, BATCH).astype(np.int32),
+            "markers": rng.integers(0, 4, BATCH).astype(np.int32),
+            "drop_bytes": drop_b,
+            "drop_packets": (drop_b > 0).astype(np.int32),
+            "drop_cause": np.where(drop_b > 0, 2, 0).astype(np.int32),
         }, ranks))
     return universe, pool
 
@@ -70,32 +93,40 @@ def cpu_exact_baseline(pool) -> float:
     return run()
 
 
-def tpu_ingest_rate(pool, use_pallas: bool | None = None):
+def tpu_ingest_rate(pool, use_pallas: bool | None = None,
+                    enable_fanout: bool = True):
+    """Per-segment device ingest rates. Returns (segment_rates, state, feed)."""
     import jax
 
     from netobserv_tpu.sketch import state as sk
 
     cfg = sk.SketchConfig()  # production defaults: cm 4x65536, topk 1024
     state = sk.init_state(cfg)
-    ingest = sk.make_ingest_fn(donate=True, use_pallas=use_pallas)
+    ingest = sk.make_ingest_fn(donate=True, use_pallas=use_pallas,
+                               enable_fanout=enable_fanout)
     dev_batches = [
         {k: jax.device_put(v) for k, v in arrays.items()} for arrays, _ in pool]
 
     feed: list[int] = []  # exact pool indices folded into the state
-    for i in range(WARMUP_ITERS):
-        bi = i % len(dev_batches)
+    it = 0
+    for _ in range(WARMUP_ITERS):
+        bi = it % len(dev_batches)
         feed.append(bi)
         state = ingest(state, dev_batches[bi])
+        it += 1
     jax.block_until_ready(state)
 
-    t0 = time.perf_counter()
-    for i in range(TIMED_ITERS):
-        bi = i % len(dev_batches)
-        feed.append(bi)
-        state = ingest(state, dev_batches[bi])
-    jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
-    return TIMED_ITERS * BATCH / dt, state, feed
+    rates = []
+    for _ in range(N_SEGMENTS):
+        t0 = time.perf_counter()
+        for _ in range(SEGMENT_ITERS):
+            bi = it % len(dev_batches)
+            feed.append(bi)
+            state = ingest(state, dev_batches[bi])
+            it += 1
+        jax.block_until_ready(state)
+        rates.append(SEGMENT_ITERS * BATCH / (time.perf_counter() - t0))
+    return rates, state, feed
 
 
 def check_recall(state, feed, universe, pool) -> float:
@@ -115,13 +146,18 @@ def check_recall(state, feed, universe, pool) -> float:
     return hits / k
 
 
-def host_path_rate(seconds: float = 3.0) -> float:
+def host_path_stats(seconds: float = 8.0) -> dict:
     """Full host-path throughput: synthetic eviction bytes -> native
-    single-pass dense pack (flowpack.cc fp_pack_dense) -> ONE device_put per
-    batch -> async ingest dispatch, pipelined by the SAME DenseStagingRing
-    the production exporter uses (sketch/staging.py) so the measured path is
-    the shipped path. The reference's analog hot spot is its per-record
-    decode (pkg/model/record_bench_test.go)."""
+    single-pass pack (flowpack.cc) -> ONE device_put per batch -> async
+    ingest dispatch, pipelined by the SAME DenseStagingRing the production
+    exporter uses (sketch/staging.py) so the measured path is the shipped
+    path. The reference's analog hot spot is its per-record decode
+    (pkg/model/record_bench_test.go).
+
+    Measured in ~1s segments: `host_path_burst` = best segment (the path's
+    capability on a healthy link), `host_path_sustained` = median segment
+    (what a throttling tunnel actually delivers); every segment rate is
+    reported so the spread is visible, plus the pack/put stage split."""
     import jax
 
     from netobserv_tpu.datapath import flowpack
@@ -152,25 +188,48 @@ def host_path_rate(seconds: float = 3.0) -> float:
     state = ring.fold(state, full[0])
     jax.block_until_ready(state)  # warm/compile
 
-    def trial() -> float:
-        nonlocal state
+    seg_rates = []
+    i = 0
+    t_end = time.perf_counter() + seconds
+    while time.perf_counter() < t_end:
         n = 0
         t0 = time.perf_counter()
-        i = 0
-        while time.perf_counter() - t0 < seconds / 2:
+        while time.perf_counter() - t0 < 1.0:
             state = ring.fold(state, full[i % len(full)])
             n += BATCH
             i += 1
         jax.block_until_ready(state)
-        return n / (time.perf_counter() - t0)
+        seg_rates.append(n / (time.perf_counter() - t0))
+    print(f"host-path segments: {[round(r / 1e6, 2) for r in seg_rates]} "
+          "M rec/s", file=sys.stderr)
 
-    # two trials, best wins: the tunneled link in this environment throttles
-    # unpredictably mid-run, and the metric is the path's capability, not
-    # the tunnel's mood; both trials go to stderr for transparency
-    rates = [trial(), trial()]
-    print(f"host-path trials: {[round(r / 1e6, 2) for r in rates]} M rec/s",
-          file=sys.stderr)
-    return max(rates)
+    # stage split: pack alone (reused buffer), put alone (sync transfer)
+    buf = np.empty(flowpack.compact_buf_len(BATCH, spill_cap), np.uint32)
+
+    def stage_rate(fn, seconds=1.5):
+        fn(0)  # warm
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            fn(n)
+            n += 1
+        return n * BATCH / (time.perf_counter() - t0)
+
+    pack_rate = stage_rate(
+        lambda j: flowpack.pack_compact(full[j % len(full)], batch_size=BATCH,
+                                        spill_cap=spill_cap, out=buf))
+
+    def put_sync(j):
+        jax.device_put(buf).block_until_ready()
+    put_rate = stage_rate(put_sync)
+
+    return {
+        "host_path_burst": round(max(seg_rates)),
+        "host_path_sustained": round(float(np.median(seg_rates))),
+        "host_segments": [round(r) for r in seg_rates],
+        "host_pack_records_per_sec": round(pack_rate),
+        "host_put_records_per_sec": round(put_rate),
+    }
 
 
 def _device_watchdog(timeout_s: float | None = None,
@@ -258,19 +317,28 @@ def main():
     # the device loop would charge the device loop's transfers against it.
     # The device-rate metric is compute-bound and link-insensitive (its
     # batches are staged on device before timing), so order doesn't bias it.
-    hp = host_path_rate()
-    print(f"host-path (evict->pack->ingest): {hp/1e6:.2f} M records/s",
-          file=sys.stderr)
-    rate, state, feed = tpu_ingest_rate(pool, use_pallas=use_pallas)
-    if "--check" in sys.argv:
-        recall = check_recall(state, feed, universe, pool)
-        print(f"heavy-hitter recall@100 vs exact: {recall:.3f}", file=sys.stderr)
+    host = host_path_stats()
+    print(f"host-path burst {host['host_path_burst']/1e6:.2f}M / sustained "
+          f"{host['host_path_sustained']/1e6:.2f}M records/s", file=sys.stderr)
+    rates, state, feed = tpu_ingest_rate(pool, use_pallas=use_pallas)
+    recall = check_recall(state, feed, universe, pool)
+    print(f"device segments: {[round(r / 1e6, 1) for r in rates]} M rec/s; "
+          f"recall@100={recall:.3f}", file=sys.stderr)
+    # A/B: the same ingest without the per-src fan-out grid, so the grid's
+    # cost is attributable round over round
+    rates_off, _, _ = tpu_ingest_rate(pool, use_pallas=use_pallas,
+                                      enable_fanout=False)
     out = {
         "metric": "flow_records_per_sec_per_chip",
-        "value": round(rate),
+        "value": round(float(np.median(rates))),
+        "p10": round(float(np.percentile(rates, 10))),
+        "p90": round(float(np.percentile(rates, 90))),
+        "segments": len(rates),
         "unit": "records/s",
-        "vs_baseline": round(rate / baseline, 3),
-        "host_path_records_per_sec": round(hp),
+        "vs_baseline": round(float(np.median(rates)) / baseline, 3),
+        "recall_at_100": round(recall, 4),
+        "fanout_off_records_per_sec": round(float(np.median(rates_off))),
+        **host,
     }
     if _DEVICE_NOTE:
         out["device"] = _DEVICE_NOTE

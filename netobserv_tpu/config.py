@@ -292,10 +292,23 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     #: drop-anomaly z-score threshold (EWMA surge of dropped bytes)
     sketch_drop_z: float = field(default=DEFAULT_DROP_Z,
                                  **_env("SKETCH_DROP_Z", str(DEFAULT_DROP_Z)))
+    #: native packer threads for the DENSE feed (0 = auto: cpu count, max
+    #: 8) — the sharded-mesh ring and the compact ring's dense fallback.
+    #: The single-chip compact pack stays a single pass (its data-dependent
+    #: spill compaction doesn't row-shard; at ~80M rec/s it sits above any
+    #: realistic link anyway, docs/tpu_sketch.md)
+    sketch_pack_threads: int = field(default=0,
+                                     **_env("SKETCH_PACK_THREADS", "0"))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))
     # where window reports go: "stdout" (JSON lines) or "kafka" (uses the
     # KAFKA_* settings; one message per report, key = "sketch_report")
     sketch_report_sink: str = field(default="stdout", **_env("SKETCH_REPORT_SINK", "stdout"))
+
+    def resolved_pack_threads(self) -> int:
+        """SKETCH_PACK_THREADS with 0 = auto (cpu count, capped at 8)."""
+        if self.sketch_pack_threads > 0:
+            return self.sketch_pack_threads
+        return min(os.cpu_count() or 1, 8)
 
     def parsed_filter_rules(self) -> list[FlowFilterRule]:
         return parse_filter_rules(self.flow_filter_rules)

@@ -250,6 +250,74 @@ def pack_dense(events_raw: bytes | np.ndarray,
     return out
 
 
+_PACK_POOL = None
+_PACK_POOL_SIZE = 0
+_PACK_POOL_LOCK = __import__("threading").Lock()
+
+
+def _pack_submit(threads: int, fns):
+    """Submit shard jobs under the pool lock: creation, growth (with
+    retirement of the old pool's workers) and submission are one atomic
+    step, so a concurrent grower can never shut a pool down between another
+    caller obtaining it and submitting to it. shutdown(wait=False) lets
+    already-submitted futures run to completion."""
+    global _PACK_POOL, _PACK_POOL_SIZE
+    with _PACK_POOL_LOCK:
+        if _PACK_POOL is None or _PACK_POOL_SIZE < threads:
+            from concurrent.futures import ThreadPoolExecutor
+            if _PACK_POOL is not None:
+                _PACK_POOL.shutdown(wait=False)
+            _PACK_POOL = ThreadPoolExecutor(max_workers=threads,
+                                            thread_name_prefix="flowpack")
+            _PACK_POOL_SIZE = threads
+        return [_PACK_POOL.submit(fn) for fn in fns]
+
+
+def pack_dense_sharded(events_raw: bytes | np.ndarray,
+                       batch_size: int,
+                       threads: int,
+                       extra: Optional[np.ndarray] = None,
+                       dns: Optional[np.ndarray] = None,
+                       drops: Optional[np.ndarray] = None,
+                       xlat: Optional[np.ndarray] = None,
+                       quic: Optional[np.ndarray] = None,
+                       out: Optional[np.ndarray] = None) -> np.ndarray:
+    """pack_dense with the rows sharded across `threads` packer threads —
+    each thread runs the native single-pass pack on a disjoint row range of
+    the SAME output buffer (ctypes releases the GIL, so the passes execute
+    in true parallel). Identical output to pack_dense (equivalence-tested);
+    the eviction-buffer sharding the host path needs once the transfer link
+    stops being the bottleneck (PCIe-attached chips — docs/tpu_sketch.md)."""
+    if isinstance(events_raw, np.ndarray):
+        events = np.ascontiguousarray(events_raw, dtype=binfmt.FLOW_EVENT_DTYPE)
+    else:
+        events = binfmt.decode_flow_events(events_raw)
+    n = len(events)
+    if n > batch_size:
+        raise ValueError(f"{n} events exceed batch size {batch_size}")
+    if threads <= 1 or n < 2 * threads or not native_available():
+        return pack_dense(events, batch_size=batch_size, extra=extra,
+                          dns=dns, drops=drops, xlat=xlat, quic=quic, out=out)
+    if out is None:
+        out = np.empty((batch_size, DENSE_WORDS), dtype=np.uint32)
+    feats = {"extra": extra, "dns": dns, "drops": drops, "xlat": xlat,
+             "quic": quic}
+    bounds = [n * i // threads for i in range(threads + 1)]
+
+    def shard(i):
+        lo, hi = bounds[i], bounds[i + 1]
+        # the LAST shard also zero-pads the buffer tail (rows n..batch_size)
+        bs = (batch_size - lo) if i == threads - 1 else (hi - lo)
+        pack_dense(events[lo:hi], batch_size=bs, out=out[lo:lo + bs],
+                   **{k: (v[lo:hi] if v is not None and len(v) else None)
+                      for k, v in feats.items()})
+
+    for f in _pack_submit(threads, [lambda i=i: shard(i)
+                                    for i in range(threads)]):
+        f.result()
+    return out
+
+
 def pack_compact(events_raw: bytes | np.ndarray,
                  batch_size: int,
                  spill_cap: int,

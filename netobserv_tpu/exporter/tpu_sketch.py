@@ -184,7 +184,8 @@ class TpuSketchExporter(Exporter):
                  ddos_z_threshold: float = DEFAULT_DDOS_Z,
                  synflood_min: float = DEFAULT_SYNFLOOD_MIN,
                  synflood_ratio: float = DEFAULT_SYNFLOOD_RATIO,
-                 drop_z_threshold: float = DEFAULT_DROP_Z):
+                 drop_z_threshold: float = DEFAULT_DROP_Z,
+                 pack_threads: int = 1):
         # jax-importing modules are pulled in lazily so the host agent can run
         # exporter-free on machines without accelerators
         from netobserv_tpu.sketch import state as sk
@@ -243,25 +244,29 @@ class TpuSketchExporter(Exporter):
             # buffer would not split on row boundaries across the data axis)
             self._ring = staging.DenseStagingRing(
                 self._batch_size, ingest_dense, put=dense_put,
-                metrics=metrics)
+                metrics=metrics, pack_threads=pack_threads)
         else:
             self._ndata = 1
             self._state = sk.init_state(self._cfg)
-            self._ingest = sk.make_ingest_fn(use_pallas=self._cfg.use_pallas)
+            self._ingest = sk.make_ingest_fn(
+                use_pallas=self._cfg.use_pallas,
+                enable_fanout=self._cfg.enable_fanout)
             self._roll = sk.make_roll_fn(self._cfg, decay_factor=decay_factor)
-            # single-device: v4-compact feed (~40% of the dense bytes — the
+            # single-device: v4-compact feed (~half the dense bytes — the
             # host->device link is the bottleneck), dense fallback for
             # batches whose non-v4 flows overflow the spill lane
             spill_cap = staging.default_spill_cap(self._batch_size)
             self._ring = staging.DenseStagingRing(
                 self._batch_size,
-                sk.make_ingest_compact_fn(self._batch_size, spill_cap,
-                                          use_pallas=self._cfg.use_pallas,
-                                          with_token=True),
+                sk.make_ingest_compact_fn(
+                    self._batch_size, spill_cap,
+                    use_pallas=self._cfg.use_pallas, with_token=True,
+                    enable_fanout=self._cfg.enable_fanout),
                 spill_cap=spill_cap,
                 ingest_fallback=sk.make_ingest_dense_fn(
-                    use_pallas=self._cfg.use_pallas, with_token=True),
-                metrics=metrics)
+                    use_pallas=self._cfg.use_pallas, with_token=True,
+                    enable_fanout=self._cfg.enable_fanout),
+                metrics=metrics, pack_threads=pack_threads)
         # the staging ring packs the next batch while the previous
         # transfers/ingests are in flight; its slot-reuse tokens also bound
         # the async dispatch queue to the ring depth, so sustained overload
@@ -302,6 +307,7 @@ class TpuSketchExporter(Exporter):
                    synflood_min=cfg.sketch_synflood_min,
                    synflood_ratio=cfg.sketch_synflood_ratio,
                    drop_z_threshold=cfg.sketch_drop_z,
+                   pack_threads=cfg.resolved_pack_threads(),
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
 

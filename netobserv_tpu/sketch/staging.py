@@ -55,11 +55,15 @@ class DenseStagingRing:
                  put: Optional[Callable] = None, n_slots: int = 4,
                  spill_cap: Optional[int] = None,
                  ingest_fallback: Optional[Callable] = None,
-                 metrics=None):
+                 metrics=None, pack_threads: int = 1):
         import jax
 
         self.batch_size = batch_size
         self._metrics = metrics
+        #: >1 shards each dense pack across this many native packer threads
+        #: (flowpack.pack_dense_sharded) — matters on hosts where the pack,
+        #: not the transfer link, bounds the feed
+        self.pack_threads = pack_threads
         #: folds that found their slot's previous ingest still running —
         #: the device (or transfer link) is slower than the eviction feed.
         #: Mirrored into metrics.sketch_staging_stalls_total when wired.
@@ -103,8 +107,9 @@ class DenseStagingRing:
             state, self._tokens[slot] = self._ingest(state, self._put(buf))
             self._slot = (slot + 1) % len(self._bufs)
             return state
-        buf = flowpack.pack_dense(events, batch_size=self.batch_size,
-                                  out=self._bufs[slot], **feats)
+        buf = flowpack.pack_dense_sharded(
+            events, batch_size=self.batch_size, threads=self.pack_threads,
+            out=self._bufs[slot], **feats)
         # ship FLAT: a (B*20,) transfer dodges device-layout padding of the
         # 20-wide minor dim (the ingest jit reshapes back, fused, free)
         state, self._tokens[slot] = self._ingest(
@@ -122,8 +127,9 @@ class DenseStagingRing:
         if self._dense_buf is None:
             self._dense_buf = np.empty(
                 (self.batch_size, flowpack.DENSE_WORDS), np.uint32)
-        buf = flowpack.pack_dense(events, batch_size=self.batch_size,
-                                  out=self._dense_buf, **feats)
+        buf = flowpack.pack_dense_sharded(
+            events, batch_size=self.batch_size, threads=self.pack_threads,
+            out=self._dense_buf, **feats)
         state, tok = self._ingest_fallback(state, self._put(buf.reshape(-1)))
         jax.block_until_ready(tok)
         return state

@@ -44,6 +44,9 @@ class SketchConfig(NamedTuple):
     #: (measured faster than the XLA scatter there, docs/tpu_sketch.md);
     #: the scatter everywhere else, incl. CPU where the kernel interprets
     use_pallas: bool | None = None
+    #: False skips the per-source fan-out grid fold (port-scan signal) —
+    #: the bench A/B switch for attributing its ingest cost
+    enable_fanout: bool = True
 
     @classmethod
     def from_agent_config(cls, cfg) -> "SketchConfig":
@@ -232,7 +235,8 @@ def arrays_to_dense(arrays: dict[str, np.ndarray]) -> np.ndarray:
 
 def ingest(state: SketchState, arrays: dict[str, jax.Array],
            sketch_axis: str | None = None, sketch_shards: int = 1,
-           use_pallas: bool | None = None) -> SketchState:
+           use_pallas: bool | None = None,
+           enable_fanout: bool = True) -> SketchState:
     """Fold one batch into all sketches. Pure; jit with donate_argnums=0.
 
     When `sketch_axis` is set (inside shard_map over a 2D mesh), the Count-Min
@@ -306,14 +310,18 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     else:
         hll_src = hll.update(state.hll_src, src_h1, src_h2, valid)
     per_dst = hll.update_per_dst(state.hll_per_dst, dst_h1, src_h1, src_h2, valid)
-    # port-scan signal: distinct (dst addr, dst port) fan-out per SOURCE
-    # bucket — a scanner touches many; a normal client few (dst port =
-    # low half of key word 8, see pack_key_words)
-    dstport_cols = jnp.concatenate(
-        [words[:, 4:8], (words[:, 8] & jnp.uint32(0xFFFF))[:, None]], axis=1)
-    dp_h1, dp_h2 = hashing.base_hashes(dstport_cols, seed=0x5CA7)
-    per_src = hll.update_per_dst(state.hll_per_src, src_h1, dp_h1, dp_h2,
-                                 valid)
+    if enable_fanout:
+        # port-scan signal: distinct (dst addr, dst port) fan-out per SOURCE
+        # bucket — a scanner touches many; a normal client few (dst port =
+        # low half of key word 8, see pack_key_words)
+        dstport_cols = jnp.concatenate(
+            [words[:, 4:8], (words[:, 8] & jnp.uint32(0xFFFF))[:, None]],
+            axis=1)
+        dp_h1, dp_h2 = hashing.base_hashes(dstport_cols, seed=0x5CA7)
+        per_src = hll.update_per_dst(state.hll_per_src, src_h1, dp_h1, dp_h2,
+                                     valid)
+    else:
+        per_src = state.hll_per_src
     rtt = arrays["rtt_us"]
     dns = arrays["dns_latency_us"]
     gamma = quantile.gamma_for(state.hist_rtt.n_buckets)
@@ -388,9 +396,11 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
 
 
 def make_ingest_fn(donate: bool = True,
-                   use_pallas: bool | None = None):
+                   use_pallas: bool | None = None,
+                   enable_fanout: bool = True):
     """Jitted ingest; donates the state buffers so updates are in-place on HBM."""
-    fn = lambda s, a: ingest(s, a, use_pallas=use_pallas)  # noqa: E731
+    fn = lambda s, a: ingest(s, a, use_pallas=use_pallas,  # noqa: E731
+                             enable_fanout=enable_fanout)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
@@ -440,20 +450,23 @@ def compact_to_arrays(flat: jax.Array, batch_size: int,
 def make_ingest_compact_fn(batch_size: int, spill_cap: int,
                            donate: bool = True,
                            use_pallas: bool | None = None,
-                           with_token: bool = False):
+                           with_token: bool = False,
+                           enable_fanout: bool = True):
     """Jitted `(state, flat compact feed) -> state` (see compact_to_arrays /
     flowpack.pack_compact). `with_token` as in make_ingest_dense_fn."""
     def fn(s, flat):
         arrays = compact_to_arrays(flat, batch_size, spill_cap)
-        s = ingest(s, arrays, use_pallas=use_pallas)
+        s = ingest(s, arrays, use_pallas=use_pallas,
+                   enable_fanout=enable_fanout)
         return (s, flat[:1]) if with_token else s
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def make_ingest_dense_fn(donate: bool = True,
                          use_pallas: bool | None = None,
-                         with_token: bool = False):
-    """Jitted `(state, dense (B,16)u32) -> state` — the single-transfer host
+                         with_token: bool = False,
+                         enable_fanout: bool = True):
+    """Jitted `(state, dense (B,20)u32) -> state` — the single-transfer host
     feed path (see dense_to_arrays / flowpack.pack_dense).
 
     `with_token=True` returns `(state, token)` where token is a tiny slice of
@@ -462,11 +475,12 @@ def make_ingest_dense_fn(donate: bool = True,
     slot-reuse guard for `sketch.staging.DenseStagingRing`."""
     if with_token:
         def fn(s, d):
-            return ingest(s, dense_to_arrays(d),
-                          use_pallas=use_pallas), d.reshape(-1)[:1]
+            return ingest(s, dense_to_arrays(d), use_pallas=use_pallas,
+                          enable_fanout=enable_fanout), d.reshape(-1)[:1]
     else:
         fn = lambda s, d: ingest(s, dense_to_arrays(d),  # noqa: E731
-                                 use_pallas=use_pallas)
+                                 use_pallas=use_pallas,
+                                 enable_fanout=enable_fanout)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 

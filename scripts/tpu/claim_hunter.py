@@ -47,9 +47,9 @@ def main() -> None:
                 # (pallas on TPU at production width), which would make
                 # this A/B measure pallas against itself
                 rc1 = subprocess.run(
-                    [sys.executable, "bench.py", "--check", "--scatter"],
+                    [sys.executable, "bench.py", "--scatter"],
                     stdout=fh, stderr=fh, env=env, cwd=REPO).returncode
-                fh.write(f"[bench --check --scatter rc={rc1}]\n"
+                fh.write(f"[bench --scatter rc={rc1}]\n"
                          f"\n=== attempt {attempt} pallas path ===\n")
                 fh.flush()
                 rc2 = subprocess.run(

@@ -363,3 +363,30 @@ def test_ddos_z_threshold_configurable():
     low = report_to_json(report, ddos_z_threshold=4.5)
     # worst-z first (severity order survives the [:32] truncation)
     assert [s["bucket"] for s in low["DdosSuspectBuckets"]] == [2, 1]
+
+
+def test_enable_fanout_false_skips_grid():
+    """SketchConfig.enable_fanout=False (the bench A/B switch) must leave the
+    per-src fan-out grid untouched while every other sketch still folds —
+    wired through the exporter's ingest factories, not just the bench."""
+    import numpy as np
+
+    from netobserv_tpu.sketch import state as sk
+
+    cfg = sk.SketchConfig(cm_width=1 << 10, topk=16, enable_fanout=False)
+    n = 32
+    arrays = {
+        "keys": np.random.default_rng(3).integers(
+            0, 2**32, (n, 10)).astype(np.uint32),
+        "bytes": np.full(n, 10.0, np.float32),
+        "packets": np.ones(n, np.int32),
+        "rtt_us": np.zeros(n, np.int32),
+        "dns_latency_us": np.zeros(n, np.int32),
+        "sampling": np.zeros(n, np.int32),
+        "valid": np.ones(n, np.bool_),
+    }
+    s = sk.make_ingest_fn(donate=False, enable_fanout=cfg.enable_fanout)(
+        sk.init_state(cfg), arrays)
+    assert float(np.asarray(s.hll_per_src.regs).sum()) == 0.0
+    assert float(np.asarray(s.hll_per_dst.regs).sum()) > 0.0
+    assert float(s.total_records) == n
