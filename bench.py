@@ -93,30 +93,37 @@ def cpu_exact_baseline(pool) -> float:
     return run()
 
 
-def tpu_ingest_rate(pool, use_pallas: bool | None = None,
-                    enable_fanout: bool = True):
-    """Per-segment device ingest rates. Returns (segment_rates, state, feed)."""
+def tpu_ingest_rate(pool, use_pallas: bool | None = None):
+    """Per-segment device ingest rates with the per-src fan-out grid ON and
+    OFF, segments INTERLEAVED so both arms see the same device/tunnel state
+    (a trailing run measures the link's mood, not the ablation — this
+    environment throttles over a run). Returns (rates_on, rates_off, state,
+    feed); recall is computed from the fanout-on state."""
     import jax
 
     from netobserv_tpu.sketch import state as sk
 
     cfg = sk.SketchConfig()  # production defaults: cm 4x65536, topk 1024
     state = sk.init_state(cfg)
-    ingest = sk.make_ingest_fn(donate=True, use_pallas=use_pallas,
-                               enable_fanout=enable_fanout)
+    state_off = sk.init_state(cfg)
+    ingest = sk.make_ingest_fn(donate=True, use_pallas=use_pallas)
+    ingest_off = sk.make_ingest_fn(donate=True, use_pallas=use_pallas,
+                                   enable_fanout=False)
     dev_batches = [
         {k: jax.device_put(v) for k, v in arrays.items()} for arrays, _ in pool]
 
-    feed: list[int] = []  # exact pool indices folded into the state
+    feed: list[int] = []  # exact pool indices folded into the fanout-on state
     it = 0
     for _ in range(WARMUP_ITERS):
         bi = it % len(dev_batches)
         feed.append(bi)
         state = ingest(state, dev_batches[bi])
+        state_off = ingest_off(state_off, dev_batches[bi])
         it += 1
-    jax.block_until_ready(state)
+    jax.block_until_ready((state, state_off))
 
-    rates = []
+    rates_on: list[float] = []
+    rates_off: list[float] = []
     for _ in range(N_SEGMENTS):
         t0 = time.perf_counter()
         for _ in range(SEGMENT_ITERS):
@@ -125,8 +132,14 @@ def tpu_ingest_rate(pool, use_pallas: bool | None = None,
             state = ingest(state, dev_batches[bi])
             it += 1
         jax.block_until_ready(state)
-        rates.append(SEGMENT_ITERS * BATCH / (time.perf_counter() - t0))
-    return rates, state, feed
+        rates_on.append(SEGMENT_ITERS * BATCH / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        for _ in range(SEGMENT_ITERS):
+            state_off = ingest_off(state_off, dev_batches[it % len(dev_batches)])
+            it += 1
+        jax.block_until_ready(state_off)
+        rates_off.append(SEGMENT_ITERS * BATCH / (time.perf_counter() - t0))
+    return rates_on, rates_off, state, feed
 
 
 def check_recall(state, feed, universe, pool) -> float:
@@ -320,14 +333,12 @@ def main():
     host = host_path_stats()
     print(f"host-path burst {host['host_path_burst']/1e6:.2f}M / sustained "
           f"{host['host_path_sustained']/1e6:.2f}M records/s", file=sys.stderr)
-    rates, state, feed = tpu_ingest_rate(pool, use_pallas=use_pallas)
+    rates, rates_off, state, feed = tpu_ingest_rate(pool,
+                                                    use_pallas=use_pallas)
     recall = check_recall(state, feed, universe, pool)
-    print(f"device segments: {[round(r / 1e6, 1) for r in rates]} M rec/s; "
+    print(f"device segments: {[round(r / 1e6, 1) for r in rates]} M rec/s "
+          f"(fanout off: {[round(r / 1e6, 1) for r in rates_off]}); "
           f"recall@100={recall:.3f}", file=sys.stderr)
-    # A/B: the same ingest without the per-src fan-out grid, so the grid's
-    # cost is attributable round over round
-    rates_off, _, _ = tpu_ingest_rate(pool, use_pallas=use_pallas,
-                                      enable_fanout=False)
     out = {
         "metric": "flow_records_per_sec_per_chip",
         "value": round(float(np.median(rates))),

@@ -99,6 +99,47 @@ def main() -> None:
     results["ingest_device"] = rate(dev_only)
     jax.block_until_ready(st[0])
 
+    # 5. compact production ring + batch-size sweep of the compact put
+    #    (bigger batches amortize any per-transfer overhead of the link)
+    from netobserv_tpu.sketch.staging import default_spill_cap
+    for bs in (BATCH, BATCH * 4):
+        # at least 2 slices of bs rows, whatever the pool size
+        big = np.concatenate([raw] * (2 * bs // len(raw) + 1)) \
+            if len(raw) < 3 * bs else raw
+        fulls = [np.ascontiguousarray(big[i:i + bs])
+                 for i in range(0, len(big) - bs, bs)][:6]
+        assert fulls, (len(big), bs)
+        spill = default_spill_cap(bs)
+        cring = DenseStagingRing(
+            bs, sk.make_ingest_compact_fn(bs, spill, donate=True,
+                                          with_token=True),
+            spill_cap=spill,
+            ingest_fallback=sk.make_ingest_dense_fn(donate=True,
+                                                    with_token=True))
+        cstate = sk.init_state(cfg)
+        cstate = cring.fold(cstate, fulls[0])
+        jax.block_until_ready(cstate)
+        ch = [cstate]
+
+        def cfold(i):
+            ch[0] = cring.fold(ch[0], fulls[i % len(fulls)])
+        n = 0
+        for _ in range(2):
+            cfold(n); n += 1
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < SECONDS:
+            cfold(n); n += 1
+        jax.block_until_ready(ch[0])
+        results[f"ring_compact_{bs}"] = (n - 2) * bs / (
+            time.perf_counter() - t0)
+
+        cbuf = np.empty(flowpack.compact_buf_len(bs, spill), np.uint32)
+        flowpack.pack_compact(fulls[0], batch_size=bs, spill_cap=spill,
+                              out=cbuf)
+        def cput(i):
+            jax.device_put(cbuf).block_until_ready()
+        results[f"put_compact_{bs}"] = rate(cput) * (bs / BATCH)
+
     results = {k: round(v) for k, v in results.items()}
     results["device"] = jax.devices()[0].platform
     print(json.dumps(results))

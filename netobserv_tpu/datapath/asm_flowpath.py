@@ -45,11 +45,13 @@ appends via atomic fetch-add slot reservation (no lost/torn entries; the
 counter saturates near capacity instead of wrapping). The one plain store
 is last_seen — racing writers both store ~now, correct to a packet's skew.
 Residual benign race: the SAME new interface appending twice under a race
-(dedup'd again at read-out). Per-packet trackers (TCP flags, DNS/TLS/QUIC)
-stay on the constant-offset fast path — slow-path flows are keyed and
-counted but not feature-enriched. Validated by the live verifier,
-end-to-end veth traffic tests, and a cross-CPU stress test
-(tests/test_asm_flowpath.py).
+(dedup'd again at read-out). Per-packet trackers run on BOTH parse paths:
+TCP flags everywhere, and the UDP payload probes (DNS, QUIC) read at the
+fast path's constant offset or the slow path's dynamic CURSOR via
+bpf_skb_load_bytes (`udp_trackers`) — IPv4-options/IPv6-ext flows are
+fully feature-enriched except passive TLS, which needs the TCP doff walk
+and stays fast-path-only. Validated by the live verifier, end-to-end veth
+traffic tests, and a cross-CPU stress test (tests/test_asm_flowpath.py).
 """
 
 from __future__ import annotations
@@ -323,55 +325,86 @@ class _Flow:
         a.ldx(BPF_H, R3, R7, l4 + 2)
         a.endian_be(R3, 16)
         a.stx(BPF_H, R10, R3, KEY + KY_DPORT)
-        done = f"udp_trk_done_{v}"
+        self.udp_trackers(tag=v, payload_base=l4 + 8)
+        a.jmp("key_done")
+
+    def udp_trackers(self, tag: str, payload_base: int | None) -> None:
+        """DNS-header + QUIC-invariant probes over the UDP payload — shared
+        by the constant-offset fast path (`payload_base` = l4 + 8) and the
+        IPv4-options/IPv6-ext slow paths (`payload_base=None`: the UDP
+        header sits at the dynamic CURSOR stack slot), closing the r3 gap
+        where slow-path flows skipped DNS/QUIC tracking. All payload reads
+        go through bpf_skb_load_bytes: it takes a RUNTIME offset (no
+        verifier constant needed) and reads frag-resident payload (UDP GSO)
+        that direct packet pointers cannot reach. Expects r9 = transport
+        protocol; only UDP(17) rows enter the probes."""
+        a = self.a
+
+        def payload_addr(extra: int) -> None:
+            """r2 = packet offset of UDP payload + extra."""
+            if payload_base is not None:
+                a.mov_imm(R2, payload_base + extra)
+            else:
+                a.ldx(BPF_DW, R2, R10, CURSOR)
+                a.alu_imm(0x07, R2, 8 + extra)
+
+        def load_payload(extra: int, n: int, fail: str) -> None:
+            a.mov_reg(R1, R6)
+            payload_addr(extra)
+            a.mov_reg(R3, R10)
+            a.alu_imm(0x07, R3, TLSBUF)
+            a.mov_imm(R4, n)
+            a.call(HELPER_SKB_LOAD_BYTES)
+            a.jmp_imm(0x55, R0, 0, fail)        # payload too short
+
+        def ntohs_from_buf(off: int) -> None:
+            """r3 = host-order u16 from two BE bytes at TLSBUF+off."""
+            a.ldx(BPF_B, R3, R10, TLSBUF + off)
+            a.alu_imm(0x67, R3, 8)
+            a.ldx(BPF_B, R4, R10, TLSBUF + off + 1)
+            a.alu_reg(0x4F, R3, R4)
+
+        done = f"udp_trk_done_{tag}"
         if self.dns_inflight_fd is not None:
             # DNS header parse (UDP on the DNS port only)
             a.jmp_imm(0x55, R9, 17, "key_done")     # TCP: no UDP trackers
             a.ldx(BPF_H, R3, R10, KEY + KY_SPORT)
-            a.jmp_imm(0x15, R3, self.dns_port, f"dns_hdr_{v}")
+            a.jmp_imm(0x15, R3, self.dns_port, f"dns_hdr_{tag}")
             a.ldx(BPF_H, R3, R10, KEY + KY_DPORT)
-            a.jmp_imm(0x55, R3, self.dns_port, f"dns_done_{v}")
-            a.label(f"dns_hdr_{v}")
-            self.bounds(l4 + 8 + 12, f"dns_done_{v}")   # full no_dns_hdr
-            a.ldx(BPF_H, R3, R7, l4 + 8)            # transaction id
-            a.endian_be(R3, 16)
+            a.jmp_imm(0x55, R3, self.dns_port, f"dns_done_{tag}")
+            a.label(f"dns_hdr_{tag}")
+            load_payload(0, 12, f"dns_done_{tag}")  # full no_dns_hdr
+            ntohs_from_buf(0)                       # transaction id
             a.stx(BPF_H, R10, R3, DNSMETA)
-            a.ldx(BPF_H, R3, R7, l4 + 10)           # flags
-            a.endian_be(R3, 16)
+            ntohs_from_buf(2)                       # flags
             a.stx(BPF_H, R10, R3, DNSMETA + 2)
             a.st_imm(BPF_W, R10, DNSMETA + 4, 1)    # header seen
-            # qname starts after the 12-byte header; the offset is per-IP
-            # -version, so stash it for the common dns_rec block (TLSBUF+8:
-            # QUIC's 5-byte scratch and TLS's TCP-only use never collide)
-            a.st_imm(BPF_W, R10, TLSBUF + 8, l4 + 8 + 12)
-            a.label(f"dns_done_{v}")
+            # qname starts after the 12-byte header; the offset differs per
+            # IP version/path, so stash it for the common dns_rec block
+            # (TLSBUF+8 held header bytes 8..11, already consumed; QUIC's
+            # 5-byte scratch and TLS's TCP-only use never collide)
+            payload_addr(12)
+            a.stx(BPF_W, R10, R2, TLSBUF + 8)
+            a.label(f"dns_done_{tag}")
         if self.flows_quic_fd is not None and self.quic_mode:
             # QUIC invariants (quic.h / RFC 8999): fixed bit, long-header
-            # version, short-header established marker. Reads go through
-            # bpf_skb_load_bytes — UDP GSO payload lives in page frags where
-            # packet-pointer bounds stop at the linear headers.
+            # version, short-header established marker.
             a.jmp_imm(0x55, R9, 17, "key_done")     # UDP only
             if self.quic_mode == 1:                 # only UDP/443
                 a.ldx(BPF_H, R3, R10, KEY + KY_SPORT)
-                a.jmp_imm(0x15, R3, 443, f"quic_port_ok_{v}")
+                a.jmp_imm(0x15, R3, 443, f"quic_port_ok_{tag}")
                 a.ldx(BPF_H, R3, R10, KEY + KY_DPORT)
                 a.jmp_imm(0x55, R3, 443, done)
-                a.label(f"quic_port_ok_{v}")
-            a.mov_reg(R1, R6)
-            a.mov_imm(R2, l4 + 8)                   # UDP payload offset
-            a.mov_reg(R3, R10)
-            a.alu_imm(0x07, R3, TLSBUF)
-            a.mov_imm(R4, 5)                        # first byte + version
-            a.call(HELPER_SKB_LOAD_BYTES)
-            a.jmp_imm(0x55, R0, 0, done)            # payload too short
+                a.label(f"quic_port_ok_{tag}")
+            load_payload(0, 5, done)                # first byte + version
             a.ldx(BPF_B, R3, R10, TLSBUF)
-            a.jmp_imm(0x45, R3, 0x40, f"quic_fixed_{v}")  # fixed bit set?
+            a.jmp_imm(0x45, R3, 0x40, f"quic_fixed_{tag}")  # fixed bit?
             a.jmp(done)
-            a.label(f"quic_fixed_{v}")
-            a.jmp_imm(0x45, R3, 0x80, f"quic_long_{v}")   # long header?
+            a.label(f"quic_fixed_{tag}")
+            a.jmp_imm(0x45, R3, 0x80, f"quic_long_{tag}")   # long header?
             a.st_imm(BPF_B, R10, QMETA, 1)          # short: established
             a.jmp(done)
-            a.label(f"quic_long_{v}")
+            a.label(f"quic_long_{tag}")
             a.mov_imm(R4, 0)                        # version: 4 BE bytes
             for i in range(4):
                 a.alu_imm(0x67, R4, 8)
@@ -382,7 +415,6 @@ class _Flow:
             a.st_imm(BPF_B, R10, QMETA, 1)
             a.st_imm(BPF_B, R10, QMETA + 1, 1)      # long header seen
         a.label(done)
-        a.jmp("key_done")
 
     def parse_tls(self, l4: int, v: str) -> None:
         """Passive TLS metadata from the TCP payload (tls.h twin): record
@@ -632,10 +664,12 @@ class _Flow:
         slow paths, where the L4 offset isn't a verifier-visible constant.
         Ports/ICMP + TCP FLAGS (into SPILL, so flag accumulation, the
         filter's tcp_flags predicate, and handshake-RTT stamping all work
-        for slow-path TCP flows too); payload trackers (DNS/TLS/QUIC) stay
-        on the constant-offset fast path. r9 = final transport protocol.
-        Truncated packets keep the address+proto key (reference behavior:
-        fill_l4info leaves ports zero when the header doesn't fit)."""
+        for slow-path TCP flows too), plus the UDP payload trackers
+        (DNS/QUIC via the shared `udp_trackers`, reading at CURSOR+8);
+        only passive TLS stays fast-path-only (it needs the TCP doff
+        walk). r9 = final transport protocol. Truncated packets keep the
+        address+proto key (reference behavior: fill_l4info leaves ports
+        zero when the header doesn't fit)."""
         a = self.a
         t = f"slow_{v}"
 
@@ -677,6 +711,11 @@ class _Flow:
         a.label(f"{t}_p")
         load_at_cursor(4)
         ports_from_tlsbuf()
+        # UDP payload trackers (DNS/QUIC) at the DYNAMIC offset: the UDP
+        # header sits at CURSOR, so slow-path flows get the same feature
+        # enrichment as the fast path (r3 gap closed; TLS stays fast-path
+        # -only — its parse needs the TCP doff walk)
+        self.udp_trackers(tag=t, payload_base=None)
         a.jmp("key_done")
         a.label(f"{t}_i")
         load_at_cursor(2)
@@ -1394,6 +1433,10 @@ class _Flow:
             a.ldx(BPF_W, R4, R6, SKB_LEN)
             a.jmp_reg(0xBD, R4, R5, "dnsname_done")  # no bytes past header
             a.alu_reg(0x1F, R4, R5)             # r4 = available bytes
+            # slow-path queries carry a DYNAMIC qname offset (scalar, not
+            # const), so the verifier cannot derive r4 >= 1 from the branch
+            # above — pin it explicitly (skb_load_bytes rejects size 0)
+            a.jmp_imm(0xB5, R4, 0, "dnsname_done")
             name_max = binfmt.DNS_REC_DTYPE["name"].itemsize
             a.jmp_imm(0xB5, R4, name_max, "dnsname_len_ok")
             a.mov_imm(R4, name_max)

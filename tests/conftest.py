@@ -17,13 +17,17 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-from netobserv_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+try:
+    import jax  # noqa: F401
+except ImportError:
+    # big-endian CI tier (qemu-s390x): no jax wheels exist there — only the
+    # jax-free suites (layout parity, binfmt, model, asm bytecode) run
+    jax = None
+else:
+    from netobserv_tpu.utils.platform import maybe_force_cpu
 
-maybe_force_cpu()
-
-import jax  # noqa: E402
-
-assert jax.devices()[0].platform == "cpu"
+    maybe_force_cpu()
+    assert jax.devices()[0].platform == "cpu"
 
 import pytest  # noqa: E402
 

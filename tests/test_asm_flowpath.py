@@ -1427,66 +1427,6 @@ def test_full_agent_over_kernel_datapath(veth):
         t.join(timeout=5)
 
 
-def test_datapath_emits_atomic_concurrency_ops():
-    """The lock-free concurrency contract is enforced at the BYTECODE level
-    (this image has one CPU, so cross-CPU races cannot manifest locally):
-    the hit path must use atomic adds for bytes/packets, an atomic OR for
-    tcp_flags, and an atomic fetch-add for observed-slot reservation — the
-    lock-free equivalents of flowpath.c's spin-locked update."""
-    from netobserv_tpu.datapath.asm_flowpath import build_flow_program
-
-    prog = build_flow_program(map_fd=3)
-    ops = [(prog[i], prog[i + 1] & 0x0F,
-            int.from_bytes(prog[i + 4:i + 8], "little", signed=True))
-           for i in range(0, len(prog), 8)]
-    atomics = [(op, imm) for op, _dst, imm in ops if op in (0xC3, 0xDB)]
-    assert any(op == 0xDB and imm == 0 for op, imm in atomics), \
-        "no 64-bit atomic add (bytes)"
-    assert any(op == 0xC3 and imm == 0 for op, imm in atomics), \
-        "no 32-bit atomic add (packets)"
-    assert any(op == 0xC3 and imm == 0x40 for op, imm in atomics), \
-        "no atomic OR (tcp_flags accumulation)"
-    assert any(op == 0xC3 and imm == 0x01 for op, imm in atomics), \
-        "no atomic fetch-add (observed-slot reservation)"
-
-
-def test_staging_shifts_follow_host_byte_order(monkeypatch):
-    """The word-staged atomics (tcp_flags OR into the eth_protocol word,
-    observed-slot fetch-add into the direction_first word) address sub-fields
-    by BIT position, which flips with host endianness: bytes 2..3 are the
-    HIGH u16 on little-endian but the LOW u16 on big-endian (s390x). Build
-    the program under a simulated big-endian host and assert the staging
-    constants collapse to shift 0 and the old-slot extraction switches from
-    a >>24 to an &0xFF — without this, a BE datapath would OR tcp_flags into
-    eth_protocol and count slots in direction_first."""
-    import importlib
-
-    from netobserv_tpu.datapath import asm_flowpath as afp
-
-    host_order = sys.byteorder
-    monkeypatch.setattr(sys, "byteorder", "big")
-    try:
-        be = importlib.reload(afp)
-        assert be._FLAGS_SHIFT == 0 and be._NOBS_SHIFT == 0
-        prog = be.build_flow_program(map_fd=3)
-        ops = [(prog[i], int.from_bytes(prog[i + 4:i + 8], "little",
-                                        signed=True))
-               for i in range(0, len(prog), 8)]
-        # BE extraction: 32-bit AND-imm 0xFF after the fetch-add; the LE
-        # >>24 slot extraction must be gone
-        assert any(op == 0x57 and imm == 0xFF for op, imm in ops)
-        assert not any(op == 0x77 and imm == 24 for op, imm in ops)
-    finally:
-        # reload under the TRUE host order (not hardcoded LE) so the rest
-        # of the session builds a correctly-shifted datapath on any host
-        monkeypatch.setattr(sys, "byteorder", host_order)
-        host = importlib.reload(afp)
-    if host_order == "little":
-        assert host._FLAGS_SHIFT == 16 and host._NOBS_SHIFT == 24
-    else:
-        assert host._FLAGS_SHIFT == 0 and host._NOBS_SHIFT == 0
-
-
 def test_concurrent_same_flow_conservation(veth):
     """Concurrency stress: several threads hammer the SAME flow key while
     others churn TCP handshakes; every packet and flag bit must survive
@@ -1592,5 +1532,61 @@ def test_slow_path_tcp_flags_and_rtt_enrichment(veth):
         fl = int(flow["tcp_flags"])
         assert fl & 0x02 and fl & 0x18 and fl & 0x01, \
             f"slow-path flags not enriched: {fl:#x}"
+    finally:
+        fetcher.close()
+
+
+def test_dns_latency_on_ipv6_ext_header_query(veth):
+    """Slow-path feature enrichment (r3 gap closed): a DNS query AND its
+    response each carried behind an IPv6 destination-options extension
+    header — both packets take the dynamic-cursor slow path, where the
+    shared udp_trackers probe must parse the DNS header at CURSOR+8,
+    stamp the inflight entry, correlate, and record latency + qname,
+    exactly like the fast path (reference tracks regardless of options,
+    bpf/dns_tracker.h:68-127)."""
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    _run("ip", "addr", "add", "fd00:199::1/64", "dev", veth, "nodad")
+    _run("ip", "netns", "exec", NS, "ip", "addr", "add", "fd00:199::2/64",
+         "dev", "nf1", "nodad")
+    time.sleep(0.3)
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, enable_dns=True)
+    try:
+        fetcher.attach(_ifindex(veth), veth, "both")
+        dns_id = 0xD0D6
+        dstopts = bytes([0, 0, 1, 2, 0, 0, 1, 0])  # PadN; kernel fills nh
+        q = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+        q.bind(("fd00:199::1", 40124))
+        q.sendmsg([_dns_payload(dns_id, response=False)],
+                  [(socket.IPPROTO_IPV6, socket.IPV6_DSTOPTS, dstopts)],
+                  0, ("fd00:199::2", 53))
+        time.sleep(0.15)
+        resp = _dns_payload(dns_id, response=True)
+        _run("ip", "netns", "exec", NS, sys.executable, "-c",
+             "import socket;"
+             "s=socket.socket(socket.AF_INET6,socket.SOCK_DGRAM);"
+             "s.bind(('fd00:199::2',53));"
+             f"d=bytes([0,0,1,2,0,0,1,0]);"
+             f"s.sendmsg([bytes.fromhex('{resp.hex()}')],"
+             "[(socket.IPPROTO_IPV6,socket.IPV6_DSTOPTS,d)],"
+             "0,('fd00:199::1',40124))")
+        q.close()
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        assert evicted.dns is not None, "flows_dns never drained"
+        hit = None
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            if int(k["src_port"]) == 53 and int(k["dst_port"]) == 40124:
+                assert int(evicted.events["stats"][i]["eth_protocol"]) \
+                    == 0x86DD
+                hit = evicted.dns[i]
+        assert hit is not None, "v6-ext response flow missing"
+        assert int(hit["dns_id"]) == dns_id
+        assert int(hit["dns_flags"]) & 0x8000  # QR bit: response seen
+        from netobserv_tpu.utils.dnsnames import decode_qname
+        assert decode_qname(bytes(hit["name"])) == "example.com"
+        lat = int(hit["latency_ns"])
+        assert 50_000_000 < lat < 5_000_000_000, f"latency {lat}ns"
     finally:
         fetcher.close()
