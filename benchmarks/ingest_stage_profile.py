@@ -132,6 +132,18 @@ def main() -> None:
         donate_argnums=(0,))
     results["stage_hll_grid"] = seg_rate(
         grid_fn, hll.init_per_dst(cfg.perdst_buckets, cfg.perdst_precision))
+    if jax.default_backend() == "tpu":
+        # A/B: the flat-indexed one-hot grid fold (O(D*m) lane compares per
+        # record) vs the scatter above (O(1) touches) — docs/tpu_sketch.md
+        # records the verdict on wiring it into ingest
+        from netobserv_tpu.ops.pallas import hll_kernel
+        grid_pl = jax.jit(
+            lambda g: hll_kernel.update_per_dst(g, dst_h1, src_h1, src_h2,
+                                                valid),
+            donate_argnums=(0,))
+        results["stage_hll_grid_pallas"] = seg_rate(
+            grid_pl,
+            hll.init_per_dst(cfg.perdst_buckets, cfg.perdst_precision))
 
     gamma = quantile.gamma_for(cfg.hist_buckets)
     hist_fn = jax.jit(

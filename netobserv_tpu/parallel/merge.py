@@ -156,10 +156,46 @@ def make_sharded_ingest_fn(mesh: Mesh, cfg: sk.SketchConfig,
 
 def shard_dense(mesh: Mesh, dense: np.ndarray) -> jax.Array:
     """Place a flowpack dense batch onto the mesh, rows split over the data
-    axis, replicated over the sketch axis. Accepts (B, 16) rows or the flat
-    (B*16,) form the staging ring ships (a contiguous flat split lands on
+    axis, replicated over the sketch axis. Accepts (B, 20) rows or the flat
+    (B*20,) form the staging ring ships (a contiguous flat split lands on
     row boundaries because B divides evenly over the data axis)."""
     return jax.device_put(dense, NamedSharding(mesh, P(DATA_AXIS)))
+
+
+def shard_dense_per_device(mesh: Mesh, flat: np.ndarray) -> jax.Array:
+    """shard_dense via EXPLICIT per-device placement: slice the flat host
+    buffer along the data axis and issue one single-device `device_put` per
+    LOCAL device, then assemble the global array. Semantically identical to
+    `shard_dense`; the difference is the transfer shape — N independent
+    host->device DMAs this host can run in parallel, instead of one sharded
+    put whose slicing strategy is the runtime's.
+
+    Multi-process meshes: each process places only the slices of ITS OWN
+    devices (`make_array_from_single_device_arrays` takes addressable
+    shards only), so `flat` must hold this host's rows at their GLOBAL
+    positions — in practice every host packs the full batch layout and
+    transfers just its slices (the per-host feed shape the multi-chip
+    budget calls for, docs/tpu_sketch.md); `__graft_entry__` measures both
+    strategies and the dryrun reports the split."""
+    assert flat.ndim == 1
+    ndata = mesh.shape[DATA_AXIS]
+    per = len(flat) // ndata
+    assert per * ndata == len(flat)
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    shards = []
+    me = jax.process_index()
+    # Mesh.devices is an (data, sketch) ndarray; P(DATA_AXIS) replicates
+    # each data-slice across the sketch columns
+    for i in range(ndata):
+        row = None
+        for dev in np.asarray(mesh.devices)[i]:
+            if dev.process_index != me:
+                continue  # another host feeds that device
+            if row is None:
+                row = flat[i * per:(i + 1) * per]
+            shards.append(jax.device_put(row, dev))
+    return jax.make_array_from_single_device_arrays(
+        flat.shape, sharding, shards)
 
 
 # ---------------------------------------------------------------------------

@@ -82,6 +82,15 @@ def test_sharded_matches_single_device(mesh_shape):
                                np.asarray(ref.per_dst_cardinality), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(report.per_src_fanout),
                                np.asarray(ref.per_src_fanout), rtol=1e-6)
+    # feature-lane signals cross the ICI merge exactly too
+    for field in ("syn_rate", "synack_rate", "drop_causes", "dscp_bytes"):
+        np.testing.assert_allclose(np.asarray(getattr(report, field)),
+                                   np.asarray(getattr(ref, field)),
+                                   rtol=1e-6, err_msg=field)
+    for field in ("total_drop_bytes", "total_drop_packets", "quic_records",
+                  "nat_records"):
+        assert float(getattr(report, field)) == pytest.approx(
+            float(getattr(ref, field)), rel=1e-6), field
     # top-K: same key set, same estimates
     ref_set = {tuple(w) for w, v in zip(np.asarray(ref.heavy.words),
                                         np.asarray(ref.heavy.valid)) if v}
@@ -273,3 +282,26 @@ def test_steady_state_ingest_has_no_collectives(mesh_shape):
     merge_fn = pmerge.make_merge_fn(mesh, CFG)
     hlo_roll = merge_fn.lower(dist).compile().as_text()
     assert any(c in hlo_roll for c in ("all-reduce", "all-gather"))
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
+def test_shard_dense_per_device_equivalent(mesh_shape):
+    """Explicit per-device placement (N independent DMAs — the multi-chip
+    feed shape) must produce the same global sharded array as the one-put
+    shard_dense, and feed the sharded ingest identically."""
+    ndata, nsk = mesh_shape
+    if ndata * nsk > len(jax.devices()):
+        pytest.skip("not enough devices")
+    rng = np.random.default_rng(9)
+    arrays = make_arrays(ndata * 64, rng, n_distinct=32)
+    flat = arrays_to_dense(arrays)
+    mesh = make_mesh(MeshSpec(data=ndata, sketch=nsk))
+    a = pmerge.shard_dense(mesh, flat)
+    b = pmerge.shard_dense_per_device(mesh, flat)
+    assert a.sharding.is_equivalent_to(b.sharding, a.ndim)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ing = pmerge.make_sharded_ingest_fn(mesh, CFG, donate=False, dense=True)
+    d1 = ing(pmerge.init_dist_state(CFG, mesh), a)
+    d2 = ing(pmerge.init_dist_state(CFG, mesh), b)
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+        np.asarray(x), np.asarray(y)), d1, d2)
