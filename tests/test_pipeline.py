@@ -386,3 +386,41 @@ def test_drop_storm_surfaces_in_exporter_window_report():
     calm = reports[1]
     assert calm["DropBytes"] == 0.0 and not calm["DropAnomalyBuckets"]
     exp.close()
+
+
+def test_decay_preserves_signal_planes():
+    """Decay-mode window rolls must treat the feature-lane planes
+    consistently: linear histograms (drop causes, DSCP bytes) decay like
+    the latency hists; the SYN-ACK window accumulator resets with its
+    paired EWMA rate; totals decay."""
+    import numpy as np
+
+    from netobserv_tpu.sketch import state as sk
+
+    cfg = sk.SketchConfig(cm_width=1 << 10, topk=16, ewma_buckets=32)
+    n = 16
+    arrays = {
+        "keys": np.random.default_rng(0).integers(
+            0, 2**32, (n, 10)).astype(np.uint32),
+        "bytes": np.full(n, 100.0, np.float32),
+        "packets": np.ones(n, np.int32),
+        "rtt_us": np.zeros(n, np.int32),
+        "dns_latency_us": np.zeros(n, np.int32),
+        "sampling": np.zeros(n, np.int32),
+        "valid": np.ones(n, np.bool_),
+        "tcp_flags": np.full(n, 0x102, np.int32),  # SYN-ACK responses
+        "dscp": np.full(n, 46, np.int32),
+        "markers": np.full(n, 3, np.int32),        # quic + nat
+        "drop_bytes": np.full(n, 10, np.int32),
+        "drop_packets": np.ones(n, np.int32),
+        "drop_cause": np.full(n, 4, np.int32),
+    }
+    s = sk.ingest(sk.init_state(cfg), arrays)
+    assert float(s.synack.sum()) == n
+    s2 = sk.decay_state(s, 0.5)
+    assert float(s2.drop_causes.sum()) == n / 2        # linear: decays
+    assert float(s2.dscp_bytes.sum()) == 100.0 * n / 2
+    assert float(s2.total_drop_bytes) == 10 * n / 2
+    assert float(s2.quic_records) == n / 2
+    assert float(s2.nat_records) == n / 2
+    assert float(s2.synack.sum()) == 0.0               # paired w/ EWMA rate
