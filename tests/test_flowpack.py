@@ -461,3 +461,56 @@ class TestShardedPack:
         got = flowpack.pack_dense_sharded(ev, batch_size=64, threads=4,
                                           dns=dns)
         np.testing.assert_array_equal(got, ref)
+
+
+class TestCompactDropSpill:
+    def test_drop_rows_spill_and_signals_match_dense(self, native):
+        """Drop-carrying rows must ride the spill lane (the compact lane
+        zeros drop columns by construction), and the compact transport must
+        agree with the dense transport on EVERY signal plane the feature
+        lane feeds — drops EWMA, cause histogram, totals, SYN, markers."""
+        import jax
+
+        from netobserv_tpu.sketch import state as sk
+
+        events = _mixed_events(24, n_v6=3)
+        events["stats"]["tcp_flags"] = 0x02  # half-open SYNs
+        n = len(events)
+        drops = np.zeros(n, binfmt.DROPS_REC_DTYPE)
+        drops["bytes"][::5] = 700          # v4 rows with drops must spill
+        drops["packets"][::5] = 2
+        drops["latest_cause"][::5] = 6
+        quic = np.zeros(n, binfmt.QUIC_REC_DTYPE)
+        quic["version"][1] = 1
+        xlat = np.zeros(n, binfmt.XLAT_REC_DTYPE)
+        xlat["src_ip"][2] = 9
+        xlat["dst_ip"][2] = 9
+
+        # native and numpy compact packs agree with features present
+        a = flowpack.pack_compact(events, batch_size=32, spill_cap=12,
+                                  drops=drops, quic=quic, xlat=xlat,
+                                  use_native=True)
+        b = flowpack.pack_compact(events, batch_size=32, spill_cap=12,
+                                  drops=drops, quic=quic, xlat=xlat,
+                                  use_native=False)
+        np.testing.assert_array_equal(a, b)
+
+        cfg = sk.SketchConfig(cm_width=1 << 10, topk=64)
+        dense = flowpack.pack_dense(events, batch_size=32, drops=drops,
+                                    quic=quic, xlat=xlat)
+        s_dense = sk.make_ingest_dense_fn(donate=False)(
+            sk.init_state(cfg), dense)
+        s_comp = sk.make_ingest_compact_fn(32, 12, donate=False)(
+            sk.init_state(cfg), a)
+        for name in ("drops_ewma", "drop_causes", "total_drop_bytes",
+                     "total_drop_packets", "syn", "synack", "dscp_bytes",
+                     "quic_records", "nat_records"):
+            jax.tree.map(
+                lambda x, y: np.testing.assert_allclose(
+                    np.asarray(x), np.asarray(y), rtol=1e-6, err_msg=name),
+                getattr(s_dense, name), getattr(s_comp, name))
+        # _events stamps sampling=50: the sketches fold the de-biased
+        # estimate (x50), same as fast-path volume counters
+        assert float(s_comp.total_drop_bytes) == 700.0 * 50 * len(drops[::5])
+        assert float(s_comp.quic_records) == 1.0
+        assert float(s_comp.nat_records) == 1.0
