@@ -1590,3 +1590,56 @@ def test_dns_latency_on_ipv6_ext_header_query(veth):
         assert 50_000_000 < lat < 5_000_000_000, f"latency {lat}ns"
     finally:
         fetcher.close()
+
+
+def test_kernel_syn_flood_surfaces_in_sketch_report(veth):
+    """Full-stack anomaly detection: REAL half-open TCP connects (SYNs to a
+    black-hole address — static neighbor entry, nobody answers, so no
+    SYN-ACK ever returns) captured by the verifier-loaded datapath,
+    evicted, fed columnar through the tpu-sketch exporter — the kernel's
+    OR-accumulated tcp_flags ride the dense feature lane and must light up
+    SynFloodSuspectBuckets in the window report."""
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    # 10.198.0.9 does not exist; the static lladdr makes the SYN transmit
+    # (and cross the egress hook) while nothing can answer it
+    _run("ip", "neigh", "replace", "10.198.0.9", "lladdr",
+         "02:00:00:00:09:09", "dev", veth)
+    fetcher = MinimalKernelFetcher(cache_max_flows=4096)
+    reports = []
+    exp = TpuSketchExporter(
+        batch_size=512, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 12,
+                                hll_precision=6, perdst_buckets=32,
+                                perdst_precision=4, topk=32, hist_buckets=64,
+                                ewma_buckets=64),
+        sink=reports.append, synflood_min=64, synflood_ratio=8.0)
+    socks = []
+    try:
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        for i in range(200):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setblocking(False)
+            s.bind(("10.198.0.1", 30000 + i))
+            s.connect_ex(("10.198.0.9", 9991))   # SYN leaves, never answered
+            socks.append(s)
+        time.sleep(0.4)
+        evicted = fetcher.lookup_and_delete()
+        assert len(evicted) >= 150, f"only {len(evicted)} flows captured"
+        flags = evicted.events["stats"]["tcp_flags"]
+        assert ((flags & 0x02) != 0).sum() >= 150  # SYNs recorded
+        assert ((flags & 0x10) != 0).sum() == 0    # nothing ACKed
+        exp.export_evicted(evicted)
+        exp.flush()
+        suspects = reports[0]["SynFloodSuspectBuckets"]
+        assert suspects, "kernel-captured flood not reported"
+        assert suspects[0]["syn"] >= 150
+        assert suspects[0]["synack"] == 0
+    finally:
+        for s in socks:
+            s.close()
+        exp.close()
+        fetcher.close()
+        _run("ip", "neigh", "del", "10.198.0.9", "dev", veth)
