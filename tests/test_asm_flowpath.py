@@ -1643,3 +1643,52 @@ def test_kernel_syn_flood_surfaces_in_sketch_report(veth):
         exp.close()
         fetcher.close()
         _run("ip", "neigh", "del", "10.198.0.9", "dev", veth)
+
+
+def test_kernel_drop_storm_surfaces_in_sketch_report():
+    """Full-stack drop analytics: REAL kernel drops (UDP rcvbuf overflow
+    through the assembled kfree_skb tracepoint) evicted with their drops
+    record, fed columnar through the tpu-sketch exporter — the report must
+    carry the drop totals and attribute the kernel's drop cause
+    (SKB_DROP_REASON_SOCKET_RCVBUFF) in DropCauses."""
+    from netobserv_tpu.datapath import btf
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    if not btf.available():
+        pytest.skip("no /sys/kernel/btf/vmlinux")
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024,
+                                   enable_pkt_drops=True)
+    reports = []
+    exp = TpuSketchExporter(
+        batch_size=256, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 12,
+                                hll_precision=6, perdst_buckets=32,
+                                perdst_precision=4, topk=32, hist_buckets=64,
+                                ewma_buckets=64),
+        sink=reports.append)
+    try:
+        rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 2048)
+        rx.bind(("127.0.0.1", 0))
+        port = rx.getsockname()[1]
+        tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for _ in range(300):
+            tx.sendto(b"x" * 1200, ("127.0.0.1", port))
+        tx.close()
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        rx.close()
+        assert evicted.drops is not None
+        exp.export_evicted(evicted)
+        exp.flush()
+        rep = reports[0]
+        assert rep["DropPackets"] > 0
+        assert rep["DropBytes"] > 0
+        # cause 6 = SKB_DROP_REASON_SOCKET_RCVBUFF, straight from the kernel
+        assert "6" in rep["DropCauses"]
+        assert rep["DropCauses"]["6"] == rep["DropPackets"]
+    finally:
+        exp.close()
+        fetcher.close()
