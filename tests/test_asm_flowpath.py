@@ -1692,3 +1692,41 @@ def test_kernel_drop_storm_surfaces_in_sketch_report():
     finally:
         exp.close()
         fetcher.close()
+
+
+def test_kernel_quic_marker_surfaces_in_sketch_report(veth):
+    """Full-stack marker path: kernel-tracked QUIC flows (flows_quic per-CPU
+    records from crafted RFC 8999 packets) fold to the feature lane's QUIC
+    marker bit and land in the window report's QuicRecords total."""
+    import struct as _s
+
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, quic_mode=2)
+    reports = []
+    exp = TpuSketchExporter(
+        batch_size=128, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10,
+                                hll_precision=6, perdst_buckets=32,
+                                perdst_precision=4, topk=16, hist_buckets=64,
+                                ewma_buckets=32),
+        sink=reports.append)
+    try:
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("10.198.0.1", 47474))
+        long_hdr = bytes([0xC3]) + _s.pack(">I", 1) + b"\x00" * 20
+        s.sendto(long_hdr, ("10.198.0.2", 8443))
+        # a plain UDP flow for contrast — first byte 0x00 keeps the QUIC
+        # fixed bit (0x40) clear, so any-port mode must NOT count it
+        s.sendto(b"\x00plain", ("10.198.0.2", 9000))
+        s.close()
+        time.sleep(0.3)
+        exp.export_evicted(fetcher.lookup_and_delete())
+        exp.flush()
+        assert reports[0]["QuicRecords"] == 1.0
+    finally:
+        exp.close()
+        fetcher.close()
