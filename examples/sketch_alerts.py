@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""Human-readable alert tail for tpu-sketch window reports.
+
+Pipe the agent's report stream (stdout sink, or a Kafka consumer) into this
+script to turn `sketch_window_report` JSON lines into operator-facing alert
+lines — the sketch-plane analog of the reference's `flowlogs-dump` example
+collector (examples/flowlogs-dump):
+
+    EXPORT=tpu-sketch SKETCH_WINDOW=10s python -m netobserv_tpu \\
+        | python examples/sketch_alerts.py
+
+Reads JSON lines on stdin; non-report lines pass through untouched.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from datetime import datetime, timezone
+
+
+def fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TB"
+
+
+def render(rep: dict) -> None:
+    ts = rep.get("TimestampMs")
+    when = (datetime.fromtimestamp(ts / 1e3, tz=timezone.utc)
+            .strftime("%H:%M:%S") if ts else "--:--:--")
+    head = (f"[{when}] window {rep.get('Window')}: "
+            f"{rep.get('Records', 0):.0f} flows, "
+            f"{fmt_bytes(rep.get('Bytes', 0.0))}, "
+            f"~{rep.get('DistinctSrcEstimate', 0.0):.0f} sources")
+    extras = []
+    if rep.get("DropPackets"):
+        extras.append(f"{rep['DropPackets']:.0f} pkts dropped "
+                      f"({fmt_bytes(rep.get('DropBytes', 0.0))})")
+    if rep.get("QuicRecords"):
+        extras.append(f"{rep['QuicRecords']:.0f} QUIC flows")
+    if rep.get("NatRecords"):
+        extras.append(f"{rep['NatRecords']:.0f} NAT'd flows")
+    print(head + ("; " + ", ".join(extras) if extras else ""))
+    for hh in rep.get("HeavyHitters", [])[:5]:
+        print(f"    top: {hh['SrcAddr']}:{hh['SrcPort']} -> "
+              f"{hh['DstAddr']}:{hh['DstPort']} proto {hh['Proto']} "
+              f"~{fmt_bytes(hh['EstBytes'])}")
+    for b in rep.get("DdosSuspectBuckets", []):
+        print(f"  ALERT ddos: dst bucket {b['bucket']} volume surge "
+              f"z={b['z']:.1f}")
+    for b in rep.get("SynFloodSuspectBuckets", []):
+        print(f"  ALERT syn-flood: victim bucket {b['bucket']} "
+              f"{b['syn']:.0f} half-open vs {b['synack']:.0f} accepted "
+              f"(z={b['z']:.1f})")
+    for b in rep.get("PortScanSuspectBuckets", []):
+        print(f"  ALERT port-scan: src bucket {b['bucket']} touched "
+              f"~{b['distinct_dst_port_pairs']:.0f} distinct (dst, port) "
+              "pairs")
+    for b in rep.get("DropAnomalyBuckets", []):
+        print(f"  ALERT drop-storm: dst bucket {b['bucket']} dropped-bytes "
+              f"surge z={b['z']:.1f}")
+    causes = rep.get("DropCauses") or {}
+    if causes:
+        top = sorted(causes.items(), key=lambda kv: -kv[1])[:4]
+        print("    drop causes: " + ", ".join(
+            f"reason {c}: {n:.0f} pkts" for c, n in top))
+
+
+def main() -> None:
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            print(line)
+            continue
+        if obj.get("Type") == "sketch_window_report":
+            render(obj)
+        else:
+            print(line)
+
+
+if __name__ == "__main__":
+    main()
