@@ -1730,3 +1730,44 @@ def test_kernel_quic_marker_surfaces_in_sketch_report(veth):
     finally:
         exp.close()
         fetcher.close()
+
+
+def test_quic_tracking_on_ipv6_ext_header(veth):
+    """Slow-path QUIC enrichment: a long-header QUIC packet carried behind
+    an IPv6 destination-options extension header takes the dynamic-cursor
+    parse, where the shared udp_trackers probe must read the invariants at
+    CURSOR+8 and record the version."""
+    import struct as _s
+
+    from netobserv_tpu.datapath.loader import MinimalKernelFetcher
+
+    _run("ip", "addr", "add", "fd00:200::1/64", "dev", veth, "nodad")
+    _run("ip", "netns", "exec", NS, "ip", "addr", "add", "fd00:200::2/64",
+         "dev", "nf1", "nodad")
+    time.sleep(0.3)
+    fetcher = MinimalKernelFetcher(cache_max_flows=1024, quic_mode=2)
+    try:
+        fetcher.attach(_ifindex(veth), veth, "egress")
+        s = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+        s.bind(("fd00:200::1", 48484))
+        dstopts = bytes([0, 0, 1, 2, 0, 0, 1, 0])
+        long_hdr = bytes([0xC3]) + _s.pack(">I", 1) + b"\x00" * 20
+        s.sendmsg([long_hdr],
+                  [(socket.IPPROTO_IPV6, socket.IPV6_DSTOPTS, dstopts)],
+                  0, ("fd00:200::2", 8443))
+        s.close()
+        time.sleep(0.3)
+        evicted = fetcher.lookup_and_delete()
+        assert evicted.quic is not None, "flows_quic never drained"
+        hit = None
+        for i in range(len(evicted)):
+            k = evicted.events["key"][i]
+            if int(k["src_port"]) == 48484:
+                assert int(evicted.events["stats"][i]["eth_protocol"]) \
+                    == 0x86DD
+                hit = evicted.quic[i]
+        assert hit is not None, "v6-ext QUIC flow missing"
+        assert int(hit["version"]) == 1
+        assert int(hit["seen_long_hdr"]) == 1
+    finally:
+        fetcher.close()
