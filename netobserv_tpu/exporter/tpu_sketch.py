@@ -136,6 +136,15 @@ def report_to_json(report, max_heavy: int = 64,
     causes = np.asarray(report.drop_causes)
     cause_idx = np.nonzero(causes > 0)[0]
     cause_idx = cause_idx[np.argsort(-causes[cause_idx])][:16]
+    from netobserv_tpu.utils.drop_reasons import drop_reason_name
+
+    def cause_name(c: int) -> str:
+        # live-kernel mapping first (the static reference table mislabels
+        # on newer kernels — utils/drop_reasons.py); the histogram's last
+        # bucket catches saturated/subsystem reasons (state.py N_DROP_CAUSES)
+        if c == causes.shape[0] - 1:
+            return "OTHER_OR_SUBSYSTEM"
+        return drop_reason_name(int(c))
     dscp = np.asarray(report.dscp_bytes)
     dscp_idx = np.nonzero(dscp > 0)[0]
     qs = [0.5, 0.9, 0.95, 0.99, 0.999]
@@ -167,6 +176,8 @@ def report_to_json(report, max_heavy: int = 64,
             {"bucket": int(b), "z": float(drop_z[b])}
             for b in drop_anom[:32]],
         "DropCauses": {str(int(c)): float(causes[c]) for c in cause_idx},
+        "DropCauseNames": {cause_name(int(c)): float(causes[c])
+                           for c in cause_idx},
         "DscpBytes": {str(int(d)): float(dscp[d]) for d in dscp_idx},
     }
 

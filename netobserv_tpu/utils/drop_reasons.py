@@ -1,0 +1,49 @@
+"""Kernel drop-reason names, read from the LIVE kernel when possible.
+
+The reference decodes drop causes through a static string table generated
+from one kernel version's enum (`pkg/decode/decode_protobuf.go` tables,
+mirrored for FLP-name parity in `exporter/flp_tables.py`). But the kernel
+enum is NOT stable across versions — e.g. on 6.18, reason 6 is
+SOCKET_RCVBUFF while the reference's table era had SOCKET_FILTER there
+(SOCKET_CLOSE/UNIX_* were inserted above it) — so a static table silently
+mislabels drops on newer kernels, a reference bug this framework inherits
+only where wire parity demands it (FLP field values).
+
+For this framework's OWN analytics output (sketch report DropCauseNames)
+correctness wins: the authoritative mapping is the running kernel's
+`__print_symbolic` table in the kfree_skb tracepoint format — the same
+tracefs file the drops program already parses for context offsets. The
+reference-parity table remains the fallback where tracefs is unavailable
+(no root / locked down).
+"""
+
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+
+_FORMAT = "/sys/kernel/tracing/events/skb/kfree_skb/format"
+_SYM = re.compile(r"\{\s*(\d+)\s*,\s*\"([A-Za-z0-9_]+)\"\s*\}")
+
+
+@lru_cache(maxsize=1)
+def live_drop_reasons() -> dict[int, str]:
+    """reason id -> SKB_DROP_REASON_* name from the running kernel's
+    tracepoint print format; {} when tracefs is unreadable."""
+    try:
+        with open(_FORMAT) as fh:
+            text = fh.read()
+    except OSError:
+        return {}
+    return {int(num): f"SKB_DROP_REASON_{name}"
+            for num, name in _SYM.findall(text)}
+
+
+def drop_reason_name(cause: int) -> str:
+    """Best-available name: live kernel first, reference-parity table
+    second, the numeric id last."""
+    live = live_drop_reasons()
+    if live:
+        return live.get(cause, str(cause))
+    from netobserv_tpu.exporter.flp_tables import DROP_CAUSES
+    return DROP_CAUSES.get(cause, str(cause))

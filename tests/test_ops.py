@@ -390,3 +390,50 @@ def test_enable_fanout_false_skips_grid():
     assert float(np.asarray(s.hll_per_src.regs).sum()) == 0.0
     assert float(np.asarray(s.hll_per_dst.regs).sum()) > 0.0
     assert float(s.total_records) == n
+
+
+def test_drop_cause_names_in_report(monkeypatch):
+    """DropCauseNames maps kernel reason IDs through the LIVE kernel's
+    tracepoint symbol table (the reference's static table mislabels on
+    newer kernels — utils/drop_reasons.py), with the histogram's overflow
+    bucket labeled explicitly."""
+    import numpy as np
+
+    from netobserv_tpu.utils import drop_reasons
+    from netobserv_tpu.exporter.tpu_sketch import report_to_json
+    from netobserv_tpu.ops import topk
+    from netobserv_tpu.sketch.state import N_DROP_CAUSES, WindowReport
+
+    monkeypatch.setattr(drop_reasons, "live_drop_reasons",
+                        lambda: {6: "SKB_DROP_REASON_SOCKET_RCVBUFF"})
+
+    causes = np.zeros(N_DROP_CAUSES, np.float32)
+    causes[6] = 12.0                 # SKB_DROP_REASON_SOCKET_RCVBUFF
+    causes[N_DROP_CAUSES - 1] = 3.0  # saturated subsystem reasons
+    zero = np.zeros(4, np.float32)
+    report = WindowReport(
+        heavy=topk.init(4), distinct_src=np.float32(0),
+        per_dst_cardinality=zero, per_src_fanout=zero,
+        rtt_quantiles_us=np.zeros(5, np.float32),
+        dns_quantiles_us=np.zeros(5, np.float32),
+        ddos_z=zero, syn_z=zero, syn_rate=zero, synack_rate=zero,
+        drop_z=zero, drop_causes=causes,
+        dscp_bytes=np.zeros(64, np.float32),
+        total_records=np.float32(0), total_bytes=np.float32(0),
+        total_drop_bytes=np.float32(0), total_drop_packets=np.float32(0),
+        quic_records=np.float32(0), nat_records=np.float32(0),
+        window=np.int32(0))
+    obj = report_to_json(report)
+    assert obj["DropCauseNames"]["SKB_DROP_REASON_SOCKET_RCVBUFF"] == 12.0
+    assert obj["DropCauseNames"]["OTHER_OR_SUBSYSTEM"] == 3.0
+    assert obj["DropCauses"] == {"6": 12.0, str(N_DROP_CAUSES - 1): 3.0}
+
+
+def test_drop_reason_name_fallback_to_parity_table(monkeypatch):
+    """Without tracefs (no root / locked down) the name lookup falls back
+    to the reference-parity FLP table; unknown ids print numerically."""
+    from netobserv_tpu.utils import drop_reasons
+
+    monkeypatch.setattr(drop_reasons, "live_drop_reasons", lambda: {})
+    assert drop_reasons.drop_reason_name(2) == "SKB_DROP_REASON_NOT_SPECIFIED"
+    assert drop_reasons.drop_reason_name(64000) == "64000"
