@@ -147,6 +147,20 @@ def report_to_json(report, max_heavy: int = 64,
         return drop_reason_name(int(c))
     dscp = np.asarray(report.dscp_bytes)
     dscp_idx = np.nonzero(dscp > 0)[0]
+
+    def dscp_name(c: int) -> str:
+        # RFC 2474/2597/3246 codepoints (stable, unlike the kernel enums);
+        # unnamed codepoints print numerically
+        if c == 46:
+            return "EF"
+        if c == 44:
+            return "VOICE-ADMIT"
+        if c % 8 == 0:
+            return f"CS{c // 8}"
+        afc, afd = c // 8, (c % 8) // 2
+        if 1 <= afc <= 4 and 1 <= afd <= 3 and c % 2 == 0:
+            return f"AF{afc}{afd}"
+        return str(c)
     qs = [0.5, 0.9, 0.95, 0.99, 0.999]
     return {
         "Type": "sketch_window_report",
@@ -179,6 +193,8 @@ def report_to_json(report, max_heavy: int = 64,
         "DropCauseNames": {cause_name(int(c)): float(causes[c])
                            for c in cause_idx},
         "DscpBytes": {str(int(d)): float(dscp[d]) for d in dscp_idx},
+        "DscpClassBytes": {dscp_name(int(d)): float(dscp[d])
+                           for d in dscp_idx},
     }
 
 

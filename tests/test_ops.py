@@ -437,3 +437,35 @@ def test_drop_reason_name_fallback_to_parity_table(monkeypatch):
     monkeypatch.setattr(drop_reasons, "live_drop_reasons", lambda: {})
     assert drop_reasons.drop_reason_name(2) == "SKB_DROP_REASON_NOT_SPECIFIED"
     assert drop_reasons.drop_reason_name(64000) == "64000"
+
+
+def test_dscp_class_names_in_report():
+    """DscpClassBytes labels QoS codepoints with their RFC names (EF, CSx,
+    AFxy); unnamed codepoints stay numeric."""
+    import numpy as np
+
+    from netobserv_tpu.exporter.tpu_sketch import report_to_json
+    from netobserv_tpu.ops import topk
+    from netobserv_tpu.sketch.state import N_DROP_CAUSES, WindowReport
+
+    dscp = np.zeros(64, np.float32)
+    dscp[46] = 10.0   # EF
+    dscp[0] = 5.0     # CS0 (best effort)
+    dscp[10] = 2.0    # AF11
+    dscp[3] = 1.0     # unnamed
+    zero = np.zeros(4, np.float32)
+    report = WindowReport(
+        heavy=topk.init(4), distinct_src=np.float32(0),
+        per_dst_cardinality=zero, per_src_fanout=zero,
+        rtt_quantiles_us=np.zeros(5, np.float32),
+        dns_quantiles_us=np.zeros(5, np.float32),
+        ddos_z=zero, syn_z=zero, syn_rate=zero, synack_rate=zero,
+        drop_z=zero, drop_causes=np.zeros(N_DROP_CAUSES, np.float32),
+        dscp_bytes=dscp,
+        total_records=np.float32(0), total_bytes=np.float32(0),
+        total_drop_bytes=np.float32(0), total_drop_packets=np.float32(0),
+        quic_records=np.float32(0), nat_records=np.float32(0),
+        window=np.int32(0))
+    obj = report_to_json(report)
+    assert obj["DscpClassBytes"] == {
+        "EF": 10.0, "CS0": 5.0, "AF11": 2.0, "3": 1.0}
