@@ -256,7 +256,9 @@ def _device_watchdog(timeout_s: float | None = None,
     own and this parent initializes CPU-only from scratch.
 
     Env knobs: BENCH_TPU_PROBE_TIMEOUT (s/attempt, default 300),
-    BENCH_TPU_PROBE_ATTEMPTS (default 2), BENCH_TPU_RETRY_SLEEP (default 60).
+    BENCH_TPU_PROBE_ATTEMPTS (default 3), BENCH_TPU_RETRY_SLEEP (default
+    120 — observed tunnel outages recover on minute scales when they
+    recover at all, so a wider window catches more of them).
     """
     import os
     import subprocess
@@ -264,8 +266,8 @@ def _device_watchdog(timeout_s: float | None = None,
     timeout_s = timeout_s or float(os.environ.get(
         "BENCH_TPU_PROBE_TIMEOUT", "300"))
     attempts = attempts or int(os.environ.get(
-        "BENCH_TPU_PROBE_ATTEMPTS", "2"))
-    retry_sleep = float(os.environ.get("BENCH_TPU_RETRY_SLEEP", "60"))
+        "BENCH_TPU_PROBE_ATTEMPTS", "3"))
+    retry_sleep = float(os.environ.get("BENCH_TPU_RETRY_SLEEP", "120"))
     reason = "no attempts made"
     for i in range(attempts):
         probe = subprocess.Popen(
