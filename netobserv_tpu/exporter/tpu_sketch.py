@@ -495,6 +495,14 @@ class TpuSketchExporter(Exporter):
         self._sink(obj)
         if self._metrics is not None:
             self._metrics.sketch_window_reports_total.inc()
+            self._metrics.sketch_window_records.set(obj["Records"])
+            self._metrics.sketch_window_drop_bytes.set(obj["DropBytes"])
+            for sig, key in (("ddos", "DdosSuspectBuckets"),
+                             ("port_scan", "PortScanSuspectBuckets"),
+                             ("syn_flood", "SynFloodSuspectBuckets"),
+                             ("drop_storm", "DropAnomalyBuckets")):
+                self._metrics.sketch_window_suspects.labels(sig).set(
+                    len(obj[key]))
         if self._ckpt is not None and self._ckpt_every:
             self._n_windows_saved += 1
             if self._n_windows_saved % self._ckpt_every == 0:

@@ -125,6 +125,17 @@ class Metrics:
             "Staging-ring folds that had to WAIT for a slot's previous "
             "ingest (device slower than the eviction feed)",
             registry=self.registry)
+        self.sketch_window_records = Gauge(
+            p + "sketch_window_records", "Flow records in the last window",
+            registry=self.registry)
+        self.sketch_window_drop_bytes = Gauge(
+            p + "sketch_window_drop_bytes",
+            "Kernel-dropped bytes in the last window",
+            registry=self.registry)
+        self.sketch_window_suspects = Gauge(
+            p + "sketch_window_suspects",
+            "Anomaly suspects reported in the last window, by signal",
+            ["signal"], registry=self.registry)
 
     # --- convenience methods used by pipeline stages ---
     def observe_eviction(self, source: str, n_flows: int, seconds: float) -> None:

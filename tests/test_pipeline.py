@@ -424,3 +424,35 @@ def test_decay_preserves_signal_planes():
     assert float(s2.quic_records) == n / 2
     assert float(s2.nat_records) == n / 2
     assert float(s2.synack.sum()) == 0.0               # paired w/ EWMA rate
+
+
+def test_window_analytics_gauges():
+    """Window rolls publish last-window analytics to Prometheus (records,
+    drop bytes, suspect counts per signal) so operators can alert off the
+    metrics endpoint, not only the JSON stream."""
+    from prometheus_client import CollectorRegistry
+
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    m = Metrics(MetricsSettings(), registry=CollectorRegistry())
+    exp = TpuSketchExporter(
+        batch_size=64, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10,
+                                hll_precision=6, perdst_buckets=32,
+                                perdst_precision=4, topk=16, hist_buckets=64,
+                                ewma_buckets=64),
+        sink=lambda rep: None, metrics=m)
+    ev = make_events(40)
+    drops = np.zeros(40, dtype=binfmt.DROPS_REC_DTYPE)
+    drops["bytes"] = 100
+    drops["packets"] = 1
+    exp.export_evicted(EvictedFlows(ev, drops=drops))
+    exp.flush()  # close() rolls one more (empty) window afterwards
+    assert m.sketch_window_records._value.get() == 40.0
+    assert m.sketch_window_drop_bytes._value.get() == 100.0 * 40
+    for sig in ("ddos", "port_scan", "syn_flood", "drop_storm"):
+        assert m.sketch_window_suspects.labels(sig)._value.get() == 0.0
+    exp.close()
+    assert m.sketch_window_records._value.get() == 0.0  # last window wins
