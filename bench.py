@@ -198,7 +198,22 @@ def host_path_stats(seconds: float = 8.0) -> dict:
         [fetcher.lookup_and_delete().events for _ in range(40)])
     full = [np.ascontiguousarray(raw[i:i + BATCH])
             for i in range(0, len(raw) - BATCH, BATCH)]
-    state = ring.fold(state, full[0])
+    # feature arrays ride the evictions in real deployments — the measured
+    # pack must pay for them (rtt/dns columns + a sparse drops lane)
+    from netobserv_tpu.model import binfmt
+    rng = np.random.default_rng(7)
+    feats = []
+    for _ in range(len(full)):
+        ex = np.zeros(BATCH, binfmt.EXTRA_REC_DTYPE)
+        ex["rtt_ns"] = rng.integers(0, 5_000_000, BATCH)
+        dn = np.zeros(BATCH, binfmt.DNS_REC_DTYPE)
+        dn["latency_ns"] = rng.integers(0, 2_000_000, BATCH)
+        dr = np.zeros(BATCH, binfmt.DROPS_REC_DTYPE)
+        hit = rng.random(BATCH) < 0.02
+        dr["bytes"] = np.where(hit, 1400, 0)
+        dr["packets"] = hit
+        feats.append({"extra": ex, "dns": dn, "drops": dr})
+    state = ring.fold(state, full[0], **feats[0])
     jax.block_until_ready(state)  # warm/compile
 
     seg_rates = []
@@ -208,7 +223,8 @@ def host_path_stats(seconds: float = 8.0) -> dict:
         n = 0
         t0 = time.perf_counter()
         while time.perf_counter() - t0 < 1.0:
-            state = ring.fold(state, full[i % len(full)])
+            state = ring.fold(state, full[i % len(full)],
+                              **feats[i % len(full)])
             n += BATCH
             i += 1
         jax.block_until_ready(state)
@@ -230,7 +246,8 @@ def host_path_stats(seconds: float = 8.0) -> dict:
 
     pack_rate = stage_rate(
         lambda j: flowpack.pack_compact(full[j % len(full)], batch_size=BATCH,
-                                        spill_cap=spill_cap, out=buf))
+                                        spill_cap=spill_cap, out=buf,
+                                        **feats[j % len(full)]))
 
     def put_sync(j):
         jax.device_put(buf).block_until_ready()
