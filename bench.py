@@ -194,25 +194,28 @@ def host_path_stats(seconds: float = 8.0) -> dict:
     # pre-generate evictions and concatenate into FULL batches, the way the
     # exporter accumulates them (padding only at window close); the load
     # generator must not shadow the measured path (map bytes -> pack -> ingest)
-    raw = np.concatenate(
-        [fetcher.lookup_and_delete().events for _ in range(40)])
+    evictions = [fetcher.lookup_and_delete() for _ in range(40)]
+    raw = np.concatenate([e.events for e in evictions])
+    raw_extra = np.concatenate([e.extra for e in evictions])
     full = [np.ascontiguousarray(raw[i:i + BATCH])
             for i in range(0, len(raw) - BATCH, BATCH)]
     # feature arrays ride the evictions in real deployments — the measured
-    # pack must pay for them (rtt/dns columns + a sparse drops lane)
+    # pack must pay for them: the fetcher's own rtt records, plus synthetic
+    # dns latency and a sparse drops lane
     from netobserv_tpu.model import binfmt
     rng = np.random.default_rng(7)
     feats = []
-    for _ in range(len(full)):
-        ex = np.zeros(BATCH, binfmt.EXTRA_REC_DTYPE)
-        ex["rtt_ns"] = rng.integers(0, 5_000_000, BATCH)
+    for bi in range(len(full)):
         dn = np.zeros(BATCH, binfmt.DNS_REC_DTYPE)
         dn["latency_ns"] = rng.integers(0, 2_000_000, BATCH)
         dr = np.zeros(BATCH, binfmt.DROPS_REC_DTYPE)
         hit = rng.random(BATCH) < 0.02
         dr["bytes"] = np.where(hit, 1400, 0)
         dr["packets"] = hit
-        feats.append({"extra": ex, "dns": dn, "drops": dr})
+        feats.append({
+            "extra": np.ascontiguousarray(
+                raw_extra[bi * BATCH:(bi + 1) * BATCH]),
+            "dns": dn, "drops": dr})
     state = ring.fold(state, full[0], **feats[0])
     jax.block_until_ready(state)  # warm/compile
 
@@ -244,10 +247,13 @@ def host_path_stats(seconds: float = 8.0) -> dict:
             n += 1
         return n * BATCH / (time.perf_counter() - t0)
 
-    pack_rate = stage_rate(
-        lambda j: flowpack.pack_compact(full[j % len(full)], batch_size=BATCH,
-                                        spill_cap=spill_cap, out=buf,
-                                        **feats[j % len(full)]))
+    def pack_stage(j):
+        out = flowpack.pack_compact(full[j % len(full)], batch_size=BATCH,
+                                    spill_cap=spill_cap, out=buf,
+                                    **feats[j % len(full)])
+        # a None (spill overflow) would silently time the early-bail path
+        assert out is not None, "compact pack overflowed the spill lane"
+    pack_rate = stage_rate(pack_stage)
 
     def put_sync(j):
         jax.device_put(buf).block_until_ready()
