@@ -58,3 +58,33 @@ def test_roundtrip_distributed(tmp_path):
         # sharding layout survives the round trip
     assert restored.cm_bytes.counts.sharding == dist.cm_bytes.counts.sharding
     ckpt.close()
+
+
+def test_incompatible_checkpoint_degrades_to_fresh_window(tmp_path):
+    """A checkpoint from an OLDER state layout (e.g. round-3 states lacking
+    the signal planes) must not kill the exporter: restore raises, the
+    exporter logs and starts a fresh window (exporters never crash the
+    pipeline — CLAUDE.md invariant)."""
+    import pytest
+
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+
+    # simulate the old layout: the state pytree minus the round-4 fields
+    old = {k: v for k, v in sk.init_state(CFG)._asdict().items()
+           if k not in ("syn", "synack", "drops_ewma", "drop_causes",
+                        "dscp_bytes", "total_drop_bytes",
+                        "total_drop_packets", "quic_records", "nat_records")}
+    ckpt = SketchCheckpointer(str(tmp_path / "ck"))
+    ckpt.save(7, old, wait=True)
+    with pytest.raises(Exception):
+        ckpt.restore(sk.init_state(CFG))
+    ckpt.close()
+
+    reports = []
+    exp = TpuSketchExporter(
+        batch_size=16, window_s=3600, sketch_cfg=CFG,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1,
+        sink=reports.append)
+    exp.flush()  # a fresh window works; the agent never crashed
+    exp.close()
+    assert reports and reports[0]["Records"] == 0.0
