@@ -456,3 +456,35 @@ def test_window_analytics_gauges():
         assert m.sketch_window_suspects.labels(sig)._value.get() == 0.0
     exp.close()
     assert m.sketch_window_records._value.get() == 0.0  # last window wins
+
+
+def test_ingest_never_retraces_across_windows():
+    """CLAUDE.md invariant pinned: folding evictions of VARYING live counts
+    (padding), rolling windows, and folding again must all hit ONE compiled
+    ingest executable — a retrace would silently tank steady-state rate."""
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    exp = TpuSketchExporter(
+        batch_size=64, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10,
+                                hll_precision=6, perdst_buckets=32,
+                                perdst_precision=4, topk=16, hist_buckets=64,
+                                ewma_buckets=32),
+        sink=lambda rep: None)
+    # warm: first fold compiles; a donated-state layout respecialization
+    # may add ONE more executable on call 2 — steady state starts here
+    for n in (64, 17):
+        exp.export_evicted(EvictedFlows(make_events(n)))
+        exp.flush()
+    ingest_jit = exp._ring._ingest
+    warm = ingest_jit._cache_size()
+    assert warm <= 2, f"ingest compiled {warm} variants during warmup"
+    for n in (64, 3, 64, 17, 5):
+        exp.export_evicted(EvictedFlows(make_events(n)))
+        exp.flush()  # windows roll between batches too
+    assert ingest_jit._cache_size() == warm, "steady-state ingest retraced"
+    if exp._ring._ingest_fallback is not None:
+        assert exp._ring._ingest_fallback._cache_size() == 0, \
+            "dense fallback ran unexpectedly"
+    exp.close()
