@@ -61,6 +61,10 @@ def render(rep: dict) -> None:
     for b in rep.get("DropAnomalyBuckets", []):
         print(f"  ALERT drop-storm: dst bucket {b['bucket']} dropped-bytes "
               f"surge z={b['z']:.1f}")
+    for b in rep.get("AsymmetricConversationBuckets", []):
+        print(f"  ALERT one-way: conversation bucket {b['bucket']} moved "
+              f"{fmt_bytes(b['bytes'])} with "
+              f"{b['one_way_share']:.0%} in one direction")
     causes = rep.get("DropCauses") or {}
     if causes:
         top = sorted(causes.items(), key=lambda kv: -kv[1])[:4]

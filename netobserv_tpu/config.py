@@ -77,6 +77,11 @@ DEFAULT_SYNFLOOD_RATIO = 8.0
 #: drop-anomaly z-score threshold (EWMA surge of dropped bytes per bucket)
 DEFAULT_DROP_Z = 6.0
 
+#: conversation asymmetry: minimum window bytes in a pair bucket and the
+#: one-way share (max(dir)/total) at which it is reported
+DEFAULT_ASYM_MIN_BYTES = 1 << 20
+DEFAULT_ASYM_RATIO = 0.95
+
 VALID_EXPORTERS = (
     EXPORT_GRPC, EXPORT_KAFKA, EXPORT_IPFIX_UDP, EXPORT_IPFIX_TCP,
     EXPORT_DIRECT_FLP, EXPORT_TPU_SKETCH, EXPORT_STDOUT,
@@ -292,6 +297,14 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     #: drop-anomaly z-score threshold (EWMA surge of dropped bytes)
     sketch_drop_z: float = field(default=DEFAULT_DROP_Z,
                                  **_env("SKETCH_DROP_Z", str(DEFAULT_DROP_Z)))
+    #: conversation-asymmetry report gates: bucket volume floor and the
+    #: one-way byte share (max direction / total) that flags it
+    sketch_asym_min_bytes: int = field(
+        default=DEFAULT_ASYM_MIN_BYTES,
+        **_env("SKETCH_ASYM_MIN_BYTES", str(DEFAULT_ASYM_MIN_BYTES)))
+    sketch_asym_ratio: float = field(
+        default=DEFAULT_ASYM_RATIO,
+        **_env("SKETCH_ASYM_RATIO", str(DEFAULT_ASYM_RATIO)))
     #: native packer threads for the DENSE feed (0 = auto: cpu count, max
     #: 8) — the sharded-mesh ring and the compact ring's dense fallback.
     #: The single-chip compact pack stays a single pass (its data-dependent

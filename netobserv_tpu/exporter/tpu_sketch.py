@@ -24,8 +24,9 @@ from typing import Callable, Optional
 import numpy as np
 
 from netobserv_tpu.config import (
-    DEFAULT_DDOS_Z, DEFAULT_DROP_Z, DEFAULT_SCAN_FANOUT,
-    DEFAULT_SYNFLOOD_MIN, DEFAULT_SYNFLOOD_RATIO,
+    DEFAULT_ASYM_MIN_BYTES, DEFAULT_ASYM_RATIO, DEFAULT_DDOS_Z,
+    DEFAULT_DROP_Z, DEFAULT_SCAN_FANOUT, DEFAULT_SYNFLOOD_MIN,
+    DEFAULT_SYNFLOOD_RATIO,
 )
 from netobserv_tpu.exporter.base import Exporter
 from netobserv_tpu.sketch import staging
@@ -92,7 +93,9 @@ def report_to_json(report, max_heavy: int = 64,
                    ddos_z_threshold: float = DEFAULT_DDOS_Z,
                    synflood_min: float = DEFAULT_SYNFLOOD_MIN,
                    synflood_ratio: float = DEFAULT_SYNFLOOD_RATIO,
-                   drop_z_threshold: float = DEFAULT_DROP_Z) -> dict:
+                   drop_z_threshold: float = DEFAULT_DROP_Z,
+                   asym_min_bytes: float = DEFAULT_ASYM_MIN_BYTES,
+                   asym_ratio: float = DEFAULT_ASYM_RATIO) -> dict:
     """Render a device WindowReport into a host JSON object."""
     words = np.asarray(report.heavy.words)
     valid = np.asarray(report.heavy.valid)
@@ -145,6 +148,16 @@ def report_to_json(report, max_heavy: int = 64,
         if c == causes.shape[0] - 1:
             return "OTHER_OR_SUBSYSTEM"
         return drop_reason_name(int(c))
+    # one-way conversations: pair buckets over the volume floor whose
+    # byte share in one direction exceeds the ratio (exfil / UDP-flood
+    # shape; a healthy TCP transfer still carries ~3-5% ACK backflow)
+    fwd = np.asarray(report.conv_fwd)
+    rev = np.asarray(report.conv_rev)
+    conv_total = fwd + rev
+    one_way_share = np.maximum(fwd, rev) / np.maximum(conv_total, 1.0)
+    asym = np.nonzero((conv_total >= asym_min_bytes)
+                      & (one_way_share >= asym_ratio))[0]
+    asym = asym[np.argsort(-conv_total[asym])]
     dscp = np.asarray(report.dscp_bytes)
     dscp_idx = np.nonzero(dscp > 0)[0]
 
@@ -189,6 +202,10 @@ def report_to_json(report, max_heavy: int = 64,
         "DropAnomalyBuckets": [
             {"bucket": int(b), "z": float(drop_z[b])}
             for b in drop_anom[:32]],
+        "AsymmetricConversationBuckets": [
+            {"bucket": int(b), "bytes": float(conv_total[b]),
+             "one_way_share": round(float(one_way_share[b]), 4)}
+            for b in asym[:32]],
         "DropCauses": {str(int(c)): float(causes[c]) for c in cause_idx},
         "DropCauseNames": {cause_name(int(c)): float(causes[c])
                            for c in cause_idx},
@@ -212,7 +229,9 @@ class TpuSketchExporter(Exporter):
                  synflood_min: float = DEFAULT_SYNFLOOD_MIN,
                  synflood_ratio: float = DEFAULT_SYNFLOOD_RATIO,
                  drop_z_threshold: float = DEFAULT_DROP_Z,
-                 pack_threads: int = 1):
+                 pack_threads: int = 1,
+                 asym_min_bytes: float = DEFAULT_ASYM_MIN_BYTES,
+                 asym_ratio: float = DEFAULT_ASYM_RATIO):
         # jax-importing modules are pulled in lazily so the host agent can run
         # exporter-free on machines without accelerators
         from netobserv_tpu.sketch import state as sk
@@ -227,6 +246,8 @@ class TpuSketchExporter(Exporter):
         self._synflood_min = synflood_min
         self._synflood_ratio = synflood_ratio
         self._drop_z = drop_z_threshold
+        self._asym_min_bytes = asym_min_bytes
+        self._asym_ratio = asym_ratio
         self._metrics = metrics
         self._lock = threading.Lock()
         self._pending: list[Record] = []
@@ -335,6 +356,8 @@ class TpuSketchExporter(Exporter):
                    synflood_ratio=cfg.sketch_synflood_ratio,
                    drop_z_threshold=cfg.sketch_drop_z,
                    pack_threads=cfg.resolved_pack_threads(),
+                   asym_min_bytes=cfg.sketch_asym_min_bytes,
+                   asym_ratio=cfg.sketch_asym_ratio,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
 
@@ -490,7 +513,9 @@ class TpuSketchExporter(Exporter):
             ddos_z_threshold=self._ddos_z,
             synflood_min=self._synflood_min,
             synflood_ratio=self._synflood_ratio,
-            drop_z_threshold=self._drop_z)
+            drop_z_threshold=self._drop_z,
+            asym_min_bytes=self._asym_min_bytes,
+            asym_ratio=self._asym_ratio)
         obj["TimestampMs"] = time.time_ns() // 1_000_000
         self._sink(obj)
         if self._metrics is not None:
@@ -500,7 +525,8 @@ class TpuSketchExporter(Exporter):
             for sig, key in (("ddos", "DdosSuspectBuckets"),
                              ("port_scan", "PortScanSuspectBuckets"),
                              ("syn_flood", "SynFloodSuspectBuckets"),
-                             ("drop_storm", "DropAnomalyBuckets")):
+                             ("drop_storm", "DropAnomalyBuckets"),
+                             ("asym_conv", "AsymmetricConversationBuckets")):
                 self._metrics.sketch_window_suspects.labels(sig).set(
                     len(obj[key]))
         if self._ckpt is not None and self._ckpt_every:

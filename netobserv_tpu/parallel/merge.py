@@ -56,6 +56,7 @@ def _state_specs(state: sk.SketchState) -> sk.SketchState:
         synack=d,
         drops_ewma=ewma.EWMA(mean=d, var=d, rate=d, windows=d),
         drop_causes=d, dscp_bytes=d,
+        conv_fwd=d, conv_rev=d,
         total_records=d, total_bytes=d,
         total_drop_bytes=d, total_drop_packets=d,
         quic_records=d, nat_records=d, window=d,
@@ -249,6 +250,8 @@ def merge_states(s: sk.SketchState, nsk: int) -> sk.SketchState:
                              windows=s.drops_ewma.windows),
         drop_causes=jax.lax.psum(s.drop_causes, DATA_AXIS),
         dscp_bytes=jax.lax.psum(s.dscp_bytes, DATA_AXIS),
+        conv_fwd=jax.lax.psum(s.conv_fwd, DATA_AXIS),
+        conv_rev=jax.lax.psum(s.conv_rev, DATA_AXIS),
         total_records=jax.lax.psum(s.total_records, DATA_AXIS),
         total_bytes=jax.lax.psum(s.total_bytes, DATA_AXIS),
         total_drop_bytes=jax.lax.psum(s.total_drop_bytes, DATA_AXIS),
@@ -278,6 +281,7 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
         rtt_quantiles_us=P(),
         dns_quantiles_us=P(), ddos_z=P(), syn_z=P(), syn_rate=P(),
         synack_rate=P(), drop_z=P(), drop_causes=P(), dscp_bytes=P(),
+        conv_fwd=P(), conv_rev=P(),
         total_records=P(), total_bytes=P(),
         total_drop_bytes=P(), total_drop_packets=P(),
         quic_records=P(), nat_records=P(),
@@ -307,6 +311,8 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
             drop_z=drop_z,
             drop_causes=merged.drop_causes,
             dscp_bytes=merged.dscp_bytes,
+            conv_fwd=merged.conv_fwd,
+            conv_rev=merged.conv_rev,
             total_records=merged.total_records,
             total_bytes=merged.total_bytes,
             total_drop_bytes=merged.total_drop_bytes,

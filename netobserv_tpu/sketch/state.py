@@ -82,6 +82,12 @@ class SketchState(NamedTuple):
     drops_ewma: ewma.EWMA
     drop_causes: jax.Array    # f32[N_DROP_CAUSES] — window drop pkts by cause
     dscp_bytes: jax.Array     # f32[N_DSCP] — window bytes by DSCP class
+    # conversation-asymmetry signal: bytes per DIRECTION of each unordered
+    # endpoint pair (one-way elephants = exfiltration / UDP-flood shape).
+    # The bucket hash is direction-invariant (sum of the two endpoint
+    # hashes under one seed); "fwd" is the canonical lower-hash endpoint
+    conv_fwd: jax.Array       # f32[m]
+    conv_rev: jax.Array       # f32[m]
     total_records: jax.Array  # f32[] — window totals
     total_bytes: jax.Array    # f32[]
     total_drop_bytes: jax.Array    # f32[]
@@ -107,6 +113,8 @@ class WindowReport(NamedTuple):
     drop_z: jax.Array              # f32[m] dropped-bytes surge z per bucket
     drop_causes: jax.Array         # f32[N_DROP_CAUSES] drop pkts by cause
     dscp_bytes: jax.Array          # f32[N_DSCP] bytes by DSCP class
+    conv_fwd: jax.Array            # f32[m] bytes toward the canonical dir
+    conv_rev: jax.Array            # f32[m] bytes the other way
     total_records: jax.Array
     total_bytes: jax.Array
     total_drop_bytes: jax.Array
@@ -145,6 +153,8 @@ def init_state(cfg: SketchConfig = SketchConfig()) -> SketchState:
         drops_ewma=ewma.init(cfg.ewma_buckets),
         drop_causes=jnp.zeros((N_DROP_CAUSES,), jnp.float32),
         dscp_bytes=jnp.zeros((N_DSCP,), jnp.float32),
+        conv_fwd=jnp.zeros((cfg.ewma_buckets,), jnp.float32),
+        conv_rev=jnp.zeros((cfg.ewma_buckets,), jnp.float32),
         total_records=jnp.zeros((), jnp.float32),
         total_bytes=jnp.zeros((), jnp.float32),
         total_drop_bytes=jnp.zeros((), jnp.float32),
@@ -329,6 +339,22 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     hist_dns = quantile.update(state.hist_dns, dns, valid & (dns > 0), gamma)
     ddos = ewma.accumulate(state.ddos, dst_h1, bytes_f, valid)
 
+    # conversation asymmetry: hash BOTH endpoints under one seed so the
+    # pair bucket is direction-invariant (A->B and B->A land together);
+    # the lower endpoint hash defines the canonical "fwd" direction
+    src_sym, _ = hashing.base_hashes(words[:, 0:4], seed=0x0D57)
+    pair_idx = ((src_sym + dst_h1) & jnp.uint32(state.conv_fwd.shape[0] - 1)
+                ).astype(jnp.int32)
+    is_fwd = src_sym < dst_h1
+    # self-pairs (src == dst: hairpin NAT, loopback capture) have no
+    # meaningful direction — both ways would land "fwd" and fire a false
+    # one-way alert every window; exclude them from the signal
+    conv_ok = valid & (src_sym != dst_h1)
+    conv_fwd = state.conv_fwd.at[pair_idx].add(
+        jnp.where(conv_ok & is_fwd, bytes_f, 0.0), mode="drop")
+    conv_rev = state.conv_rev.at[pair_idx].add(
+        jnp.where(conv_ok & ~is_fwd, bytes_f, 0.0), mode="drop")
+
     # --- feature-lane signals (trace-time optional: a feed without the
     # column — e.g. the legacy six-array dict — simply skips the signal) ---
     mass = factor.astype(jnp.float32) if samp is not None else 1.0
@@ -346,8 +372,9 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
             ((f & TcpFlags.ACK) == 0)
         syn_state = ewma.accumulate(state.syn, dst_h1,
                                     jnp.where(half_open, mass, 0.0), valid)
-        vic_h1, _ = hashing.base_hashes(words[:, 0:4], seed=0x0D57)
-        sa_idx = (vic_h1 & jnp.uint32(state.synack.shape[0] - 1)
+        # src_sym (above) hashes the src words under the dst seed — exactly
+        # the victim-bucket hash the SYN-ACK side needs
+        sa_idx = (src_sym & jnp.uint32(state.synack.shape[0] - 1)
                   ).astype(jnp.int32)
         is_synack = valid & ((f & TcpFlags.SYN_ACK) != 0)
         synack_arr = state.synack.at[sa_idx].add(
@@ -386,6 +413,7 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
         hist_dns=hist_dns, ddos=ddos,
         syn=syn_state, synack=synack_arr, drops_ewma=drops_state,
         drop_causes=drop_causes, dscp_bytes=dscp_bytes,
+        conv_fwd=conv_fwd, conv_rev=conv_rev,
         total_records=state.total_records + jnp.sum(valid.astype(jnp.float32)),
         total_bytes=state.total_bytes + jnp.sum(
             jnp.where(valid, bytes_f, 0.0)),
@@ -508,6 +536,8 @@ def decay_state(state: SketchState, factor: float) -> SketchState:
         synack=jnp.zeros_like(state.synack),
         drop_causes=state.drop_causes * factor,
         dscp_bytes=state.dscp_bytes * factor,
+        conv_fwd=state.conv_fwd * factor,
+        conv_rev=state.conv_rev * factor,
         total_records=state.total_records * factor,
         total_bytes=state.total_bytes * factor,
         total_drop_bytes=state.total_drop_bytes * factor,
@@ -541,6 +571,8 @@ def roll_window(state: SketchState, cfg: SketchConfig,
         drop_z=drop_z,
         drop_causes=state.drop_causes,
         dscp_bytes=state.dscp_bytes,
+        conv_fwd=state.conv_fwd,
+        conv_rev=state.conv_rev,
         total_records=state.total_records,
         total_bytes=state.total_bytes,
         total_drop_bytes=state.total_drop_bytes,

@@ -354,6 +354,7 @@ def test_ddos_z_threshold_configurable():
         syn_z=zero3, syn_rate=zero3, synack_rate=zero3, drop_z=zero3,
         drop_causes=np.zeros(128, np.float32),
         dscp_bytes=np.zeros(64, np.float32),
+        conv_fwd=zero3, conv_rev=zero3,
         total_records=np.float32(0), total_bytes=np.float32(0),
         total_drop_bytes=np.float32(0), total_drop_packets=np.float32(0),
         quic_records=np.float32(0), nat_records=np.float32(0),
@@ -419,6 +420,7 @@ def test_drop_cause_names_in_report(monkeypatch):
         ddos_z=zero, syn_z=zero, syn_rate=zero, synack_rate=zero,
         drop_z=zero, drop_causes=causes,
         dscp_bytes=np.zeros(64, np.float32),
+        conv_fwd=zero, conv_rev=zero,
         total_records=np.float32(0), total_bytes=np.float32(0),
         total_drop_bytes=np.float32(0), total_drop_packets=np.float32(0),
         quic_records=np.float32(0), nat_records=np.float32(0),
@@ -461,7 +463,7 @@ def test_dscp_class_names_in_report():
         dns_quantiles_us=np.zeros(5, np.float32),
         ddos_z=zero, syn_z=zero, syn_rate=zero, synack_rate=zero,
         drop_z=zero, drop_causes=np.zeros(N_DROP_CAUSES, np.float32),
-        dscp_bytes=dscp,
+        dscp_bytes=dscp, conv_fwd=zero, conv_rev=zero,
         total_records=np.float32(0), total_bytes=np.float32(0),
         total_drop_bytes=np.float32(0), total_drop_packets=np.float32(0),
         quic_records=np.float32(0), nat_records=np.float32(0),

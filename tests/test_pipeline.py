@@ -488,3 +488,71 @@ def test_ingest_never_retraces_across_windows():
         assert exp._ring._ingest_fallback._cache_size() == 0, \
             "dense fallback ran unexpectedly"
     exp.close()
+
+
+def test_one_way_conversation_surfaces_in_exporter_window_report():
+    """Conversation-asymmetry detection through the FULL exporter pipeline:
+    a one-way elephant transfer (A->B only) must surface in
+    AsymmetricConversationBuckets; a balanced conversation (both directions)
+    must not — regardless of flow direction order."""
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.model.flow import FlowKey
+    from netobserv_tpu.model.record import Record
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    def rec(src, dst, sport, dport, nbytes):
+        return Record(
+            key=FlowKey.make(src, dst, sport, dport, 17), bytes_=nbytes,
+            packets=max(1, nbytes // 1400), eth_protocol=0x0800, tcp_flags=0,
+            direction=1, src_mac=b"\x02" * 6, dst_mac=b"\x04" * 6,
+            if_index=3, interface="eth0", dscp=0, sampling=0,
+            agent_ip="192.0.2.1")
+
+    reports = []
+    exp = TpuSketchExporter(
+        batch_size=16, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10,
+                                hll_precision=6, perdst_buckets=32,
+                                perdst_precision=4, topk=16, hist_buckets=64,
+                                ewma_buckets=64),
+        sink=reports.append, asym_min_bytes=1 << 20, asym_ratio=0.95)
+    # one-way elephant: 4MB A->B, nothing back
+    flows = [rec("10.5.0.1", "10.5.0.2", 5001, 5002, 1 << 20)
+             for _ in range(4)]
+    # balanced conversation, larger than the floor in BOTH directions
+    flows += [rec("10.6.0.1", "10.6.0.2", 6001, 6002, 1 << 20),
+              rec("10.6.0.2", "10.6.0.1", 6002, 6001, (1 << 20) - 4096)]
+    exp.export_batch(flows)
+    exp.flush()
+    asym = reports[0]["AsymmetricConversationBuckets"]
+    assert len(asym) == 1, f"expected exactly the one-way pair: {asym}"
+    assert asym[0]["bytes"] == float(4 << 20)
+    assert asym[0]["one_way_share"] == 1.0
+    exp.close()
+
+
+def test_hairpin_conversations_excluded_from_asymmetry():
+    """src == dst traffic (hairpin NAT / loopback capture) has no
+    meaningful direction — it must not fire a one-way alert."""
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.model.flow import FlowKey
+    from netobserv_tpu.model.record import Record
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    reports = []
+    exp = TpuSketchExporter(
+        batch_size=8, window_s=3600,
+        sketch_cfg=SketchConfig(cm_depth=2, cm_width=1 << 10,
+                                hll_precision=6, perdst_buckets=32,
+                                perdst_precision=4, topk=16, hist_buckets=64,
+                                ewma_buckets=64),
+        sink=reports.append, asym_min_bytes=1 << 20)
+    hair = [Record(key=FlowKey.make("10.9.9.9", "10.9.9.9", 4000 + d, 4001, 17),
+                   bytes_=2 << 20, packets=9, eth_protocol=0x0800,
+                   tcp_flags=0, direction=d % 2, src_mac=b"\x02" * 6,
+                   dst_mac=b"\x04" * 6, if_index=3, interface="lo", dscp=0,
+                   sampling=0, agent_ip="192.0.2.1") for d in range(4)]
+    exp.export_batch(hair)
+    exp.flush()
+    assert reports[0]["AsymmetricConversationBuckets"] == []
+    exp.close()
