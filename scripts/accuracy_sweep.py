@@ -245,6 +245,48 @@ def run_drop_case(storm_factor: float, seed: int = 0, z_threshold: float = 6.0,
             float(others.max()))
 
 
+def run_asym_case(elephant_mb: float, bg_pairs: int = 512, seed: int = 0,
+                  min_bytes: float = 1 << 20, ratio: float = 0.95):
+    """Conversation-asymmetry sweep: one-way elephants of `elephant_mb`
+    against balanced background conversations (each direction ~512KB).
+    Returns (detected, false_positives)."""
+    rng = np.random.default_rng(seed)
+    cfg = sk.SketchConfig(cm_width=1 << 12, topk=64)
+    state = sk.init_state(cfg)
+    ingest = jax.jit(sk.ingest)
+    a_ends = rng.integers(0, 2**32, (bg_pairs, 4), dtype=np.uint32)
+    b_ends = rng.integers(0, 2**32, (bg_pairs, 4), dtype=np.uint32)
+    per_dir = 512 * 1024 / 8  # 8 records each way per pair
+    for src, dst in ((a_ends, b_ends), (b_ends, a_ends)):
+        for _ in range(8):
+            kw = _keys_for_pairs(rng, src, dst, bg_pairs)
+            arrays = _signal_arrays(kw, np.full(bg_pairs, 0x12))
+            arrays["bytes"] = np.full(bg_pairs, per_dir, np.float32)
+            state = ingest(state, arrays)
+    exfil_src = rng.integers(0, 2**32, 4, dtype=np.uint32)
+    exfil_dst = rng.integers(0, 2**32, 4, dtype=np.uint32)
+    kw = _keys_for_pairs(rng, np.tile(exfil_src, (8, 1)),
+                         np.tile(exfil_dst, (8, 1)), 8)
+    arrays = _signal_arrays(kw, np.full(8, 0x12))
+    arrays["bytes"] = np.full(8, elephant_mb * (1 << 20) / 8, np.float32)
+    state = ingest(state, arrays)
+    _, report = sk.roll_window(state, cfg)
+    fwd = np.asarray(report.conv_fwd)
+    rev = np.asarray(report.conv_rev)
+    total = fwd + rev
+    share = np.maximum(fwd, rev) / np.maximum(total, 1.0)
+    flagged = set(np.nonzero((total >= min_bytes) & (share >= ratio))[0]
+                  .tolist())
+    from netobserv_tpu.ops import hashing
+    s_h, _ = hashing.base_hashes(
+        jnp.asarray(exfil_src[None, :], jnp.uint32), seed=0x0D57)
+    d_h, _ = hashing.base_hashes(
+        jnp.asarray(exfil_dst[None, :], jnp.uint32), seed=0x0D57)
+    vb = int((np.asarray(s_h)[0] + np.asarray(d_h)[0])
+             & (cfg.ewma_buckets - 1))
+    return vb in flagged, len(flagged - {vb})
+
+
 def run_mesh_hll_case(zipf_s: float, seed: int = 0):
     """Config 3: distinct-src over a 4-way data mesh, merged over the mesh."""
     from netobserv_tpu.parallel import MeshSpec, make_mesh, merge as pmerge
@@ -295,6 +337,11 @@ def main() -> None:
         drop_rows.append((factor, det, fp, vz, oz))
         print(f"drop x{factor}: detected={det} fp={fp} z={vz:.1f}",
               file=sys.stderr)
+    asym_rows = []
+    for mb in (1.5, 4.0, 16.0, 256.0):
+        det, fp = run_asym_case(mb)
+        asym_rows.append((mb, det, fp))
+        print(f"asym {mb}MB: detected={det} fp={fp}", file=sys.stderr)
 
     out = os.path.join(os.path.dirname(__file__), "..", "docs", "accuracy.md")
     with open(out, "w") as fh:
@@ -333,6 +380,20 @@ def main() -> None:
         for factor, det, fp, vz, oz in drop_rows:
             fh.write(f"| {factor:.0f}x | {det} | {fp} | {vz:.0f} | "
                      f"{oz:.1f} |\n")
+        fh.write(
+            "\n## Config 5 signals: conversation asymmetry "
+            "(512 balanced 1MB background pairs; gates 1MB floor, "
+            "0.95 one-way share)\n\n"
+            "| one-way elephant | detected | false-positive buckets |\n"
+            "|---|---|---|\n")
+        for mb, det, fp in asym_rows:
+            fh.write(f"| {mb}MB | {det} | {fp} |\n")
+        fh.write(
+            "\nAsymmetry note: elephants just above the volume floor can "
+            "be muted by a pair-bucket collision with balanced background "
+            "traffic (12.5% odds at 512 pairs / 4096 buckets) — the share "
+            "dilutes below the gate. Sizing the floor a few x below the "
+            "flows you care about restores headroom.\n")
         fh.write(
             "\nNotes: recall is vs the true top-100 keys by byte volume; "
             "F1 compares the full reported table against the equal-size "

@@ -45,3 +45,9 @@ def test_drop_anomaly_detection_bound():
     detected, fp, victim_z, other_z = run_drop_case(10.0)
     assert detected and fp == 0
     assert victim_z > 100 * other_z  # unambiguous separation
+
+
+def test_asymmetry_detection_bound():
+    from scripts.accuracy_sweep import run_asym_case
+    detected, fp = run_asym_case(16.0)
+    assert detected and fp == 0
