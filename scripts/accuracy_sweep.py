@@ -339,9 +339,12 @@ def main() -> None:
               file=sys.stderr)
     asym_rows = []
     for mb in (1.5, 4.0, 16.0, 256.0):
-        det, fp = run_asym_case(mb)
+        runs = [run_asym_case(mb, seed=s) for s in range(8)]
+        det = sum(d for d, _ in runs) / len(runs)
+        fp = sum(f for _, f in runs)
         asym_rows.append((mb, det, fp))
-        print(f"asym {mb}MB: detected={det} fp={fp}", file=sys.stderr)
+        print(f"asym {mb}MB: detection rate={det:.2f} fp={fp}",
+              file=sys.stderr)
 
     out = os.path.join(os.path.dirname(__file__), "..", "docs", "accuracy.md")
     with open(out, "w") as fh:
@@ -383,17 +386,18 @@ def main() -> None:
         fh.write(
             "\n## Config 5 signals: conversation asymmetry "
             "(512 balanced 1MB background pairs; gates 1MB floor, "
-            "0.95 one-way share)\n\n"
-            "| one-way elephant | detected | false-positive buckets |\n"
-            "|---|---|---|\n")
+            "0.95 one-way share; 8 seeds per row)\n\n"
+            "| one-way elephant | detection rate | false-positive buckets "
+            "(all runs) |\n|---|---|---|\n")
         for mb, det, fp in asym_rows:
-            fh.write(f"| {mb}MB | {det} | {fp} |\n")
+            fh.write(f"| {mb}MB | {det:.2f} | {fp} |\n")
         fh.write(
-            "\nAsymmetry note: elephants just above the volume floor can "
-            "be muted by a pair-bucket collision with balanced background "
+            "\nAsymmetry note: elephants near the volume floor can be "
+            "muted by a pair-bucket collision with balanced background "
             "traffic (12.5% odds at 512 pairs / 4096 buckets) — the share "
             "dilutes below the gate. Sizing the floor a few x below the "
-            "flows you care about restores headroom.\n")
+            "flows you care about restores headroom; false positives stay "
+            "at zero throughout.\n")
         fh.write(
             "\nNotes: recall is vs the true top-100 keys by byte volume; "
             "F1 compares the full reported table against the equal-size "
