@@ -298,7 +298,8 @@ class TpuSketchExporter(Exporter):
             self._state = sk.init_state(self._cfg)
             self._ingest = sk.make_ingest_fn(
                 use_pallas=self._cfg.use_pallas,
-                enable_fanout=self._cfg.enable_fanout)
+                enable_fanout=self._cfg.enable_fanout,
+                enable_asym=self._cfg.enable_asym)
             self._roll = sk.make_roll_fn(self._cfg, decay_factor=decay_factor)
             # single-device: v4-compact feed (~half the dense bytes — the
             # host->device link is the bottleneck), dense fallback for
@@ -309,11 +310,13 @@ class TpuSketchExporter(Exporter):
                 sk.make_ingest_compact_fn(
                     self._batch_size, spill_cap,
                     use_pallas=self._cfg.use_pallas, with_token=True,
-                    enable_fanout=self._cfg.enable_fanout),
+                    enable_fanout=self._cfg.enable_fanout,
+                    enable_asym=self._cfg.enable_asym),
                 spill_cap=spill_cap,
                 ingest_fallback=sk.make_ingest_dense_fn(
                     use_pallas=self._cfg.use_pallas, with_token=True,
-                    enable_fanout=self._cfg.enable_fanout),
+                    enable_fanout=self._cfg.enable_fanout,
+                    enable_asym=self._cfg.enable_asym),
                 metrics=metrics, pack_threads=pack_threads)
         # the staging ring packs the next batch while the previous
         # transfers/ingests are in flight; its slot-reuse tokens also bound

@@ -137,7 +137,8 @@ def make_sharded_ingest_fn(mesh: Mesh, cfg: sk.SketchConfig,
                       # owner-sharded sketches keep the masked-scatter path;
                       # the Pallas fold applies to whole-width replicas
                       use_pallas=(cfg.use_pallas if nsk == 1 else False),
-                      enable_fanout=cfg.enable_fanout)
+                      enable_fanout=cfg.enable_fanout,
+                      enable_asym=cfg.enable_asym)
         out = _add_lead(s)
         if with_token:
             return out, (batch[:1] if batch.ndim == 1 else batch[:1, 0])

@@ -47,6 +47,8 @@ class SketchConfig(NamedTuple):
     #: False skips the per-source fan-out grid fold (port-scan signal) —
     #: the bench A/B switch for attributing its ingest cost
     enable_fanout: bool = True
+    #: False skips the conversation-asymmetry fold (one-way detection)
+    enable_asym: bool = True
 
     @classmethod
     def from_agent_config(cls, cfg) -> "SketchConfig":
@@ -246,7 +248,8 @@ def arrays_to_dense(arrays: dict[str, np.ndarray]) -> np.ndarray:
 def ingest(state: SketchState, arrays: dict[str, jax.Array],
            sketch_axis: str | None = None, sketch_shards: int = 1,
            use_pallas: bool | None = None,
-           enable_fanout: bool = True) -> SketchState:
+           enable_fanout: bool = True,
+           enable_asym: bool = True) -> SketchState:
     """Fold one batch into all sketches. Pure; jit with donate_argnums=0.
 
     When `sketch_axis` is set (inside shard_map over a 2D mesh), the Count-Min
@@ -343,17 +346,20 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     # pair bucket is direction-invariant (A->B and B->A land together);
     # the lower endpoint hash defines the canonical "fwd" direction
     src_sym, _ = hashing.base_hashes(words[:, 0:4], seed=0x0D57)
-    pair_idx = ((src_sym + dst_h1) & jnp.uint32(state.conv_fwd.shape[0] - 1)
-                ).astype(jnp.int32)
-    is_fwd = src_sym < dst_h1
-    # self-pairs (src == dst: hairpin NAT, loopback capture) have no
-    # meaningful direction — both ways would land "fwd" and fire a false
-    # one-way alert every window; exclude them from the signal
-    conv_ok = valid & (src_sym != dst_h1)
-    conv_fwd = state.conv_fwd.at[pair_idx].add(
-        jnp.where(conv_ok & is_fwd, bytes_f, 0.0), mode="drop")
-    conv_rev = state.conv_rev.at[pair_idx].add(
-        jnp.where(conv_ok & ~is_fwd, bytes_f, 0.0), mode="drop")
+    if enable_asym:
+        pair_idx = ((src_sym + dst_h1)
+                    & jnp.uint32(state.conv_fwd.shape[0] - 1)).astype(jnp.int32)
+        is_fwd = src_sym < dst_h1
+        # self-pairs (src == dst: hairpin NAT, loopback capture) have no
+        # meaningful direction — both ways would land "fwd" and fire a
+        # false one-way alert every window; exclude them from the signal
+        conv_ok = valid & (src_sym != dst_h1)
+        conv_fwd = state.conv_fwd.at[pair_idx].add(
+            jnp.where(conv_ok & is_fwd, bytes_f, 0.0), mode="drop")
+        conv_rev = state.conv_rev.at[pair_idx].add(
+            jnp.where(conv_ok & ~is_fwd, bytes_f, 0.0), mode="drop")
+    else:
+        conv_fwd, conv_rev = state.conv_fwd, state.conv_rev
 
     # --- feature-lane signals (trace-time optional: a feed without the
     # column — e.g. the legacy six-array dict — simply skips the signal) ---
@@ -425,10 +431,12 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
 
 def make_ingest_fn(donate: bool = True,
                    use_pallas: bool | None = None,
-                   enable_fanout: bool = True):
+                   enable_fanout: bool = True,
+                   enable_asym: bool = True):
     """Jitted ingest; donates the state buffers so updates are in-place on HBM."""
     fn = lambda s, a: ingest(s, a, use_pallas=use_pallas,  # noqa: E731
-                             enable_fanout=enable_fanout)
+                             enable_fanout=enable_fanout,
+                             enable_asym=enable_asym)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
@@ -479,13 +487,14 @@ def make_ingest_compact_fn(batch_size: int, spill_cap: int,
                            donate: bool = True,
                            use_pallas: bool | None = None,
                            with_token: bool = False,
-                           enable_fanout: bool = True):
+                           enable_fanout: bool = True,
+                           enable_asym: bool = True):
     """Jitted `(state, flat compact feed) -> state` (see compact_to_arrays /
     flowpack.pack_compact). `with_token` as in make_ingest_dense_fn."""
     def fn(s, flat):
         arrays = compact_to_arrays(flat, batch_size, spill_cap)
         s = ingest(s, arrays, use_pallas=use_pallas,
-                   enable_fanout=enable_fanout)
+                   enable_fanout=enable_fanout, enable_asym=enable_asym)
         return (s, flat[:1]) if with_token else s
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
@@ -493,7 +502,8 @@ def make_ingest_compact_fn(batch_size: int, spill_cap: int,
 def make_ingest_dense_fn(donate: bool = True,
                          use_pallas: bool | None = None,
                          with_token: bool = False,
-                         enable_fanout: bool = True):
+                         enable_fanout: bool = True,
+                         enable_asym: bool = True):
     """Jitted `(state, dense (B,20)u32) -> state` — the single-transfer host
     feed path (see dense_to_arrays / flowpack.pack_dense).
 
@@ -504,11 +514,13 @@ def make_ingest_dense_fn(donate: bool = True,
     if with_token:
         def fn(s, d):
             return ingest(s, dense_to_arrays(d), use_pallas=use_pallas,
-                          enable_fanout=enable_fanout), d.reshape(-1)[:1]
+                          enable_fanout=enable_fanout,
+                          enable_asym=enable_asym), d.reshape(-1)[:1]
     else:
         fn = lambda s, d: ingest(s, dense_to_arrays(d),  # noqa: E731
                                  use_pallas=use_pallas,
-                                 enable_fanout=enable_fanout)
+                                 enable_fanout=enable_fanout,
+                                 enable_asym=enable_asym)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 

@@ -471,3 +471,27 @@ def test_dscp_class_names_in_report():
     obj = report_to_json(report)
     assert obj["DscpClassBytes"] == {
         "EF": 10.0, "CS0": 5.0, "AF11": 2.0, "3": 1.0}
+
+
+def test_enable_asym_false_skips_conversation_fold():
+    import numpy as np
+
+    from netobserv_tpu.sketch import state as sk
+
+    cfg = sk.SketchConfig(cm_width=1 << 10, topk=16, enable_asym=False)
+    n = 16
+    arrays = {
+        "keys": np.random.default_rng(4).integers(
+            0, 2**32, (n, 10)).astype(np.uint32),
+        "bytes": np.full(n, 10.0, np.float32),
+        "packets": np.ones(n, np.int32),
+        "rtt_us": np.zeros(n, np.int32),
+        "dns_latency_us": np.zeros(n, np.int32),
+        "sampling": np.zeros(n, np.int32),
+        "valid": np.ones(n, np.bool_),
+    }
+    s = sk.make_ingest_fn(donate=False, enable_asym=cfg.enable_asym)(
+        sk.init_state(cfg), arrays)
+    assert float(np.asarray(s.conv_fwd).sum()) == 0.0
+    assert float(np.asarray(s.conv_rev).sum()) == 0.0
+    assert float(s.total_records) == n
