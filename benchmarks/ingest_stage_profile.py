@@ -75,10 +75,12 @@ def main() -> None:
     results: dict[str, float] = {}
 
     # ---- full ingest + ablations ------------------------------------------
-    def ingest_variant(name, use_pallas=None, enable_fanout=True, drop=()):
+    def ingest_variant(name, use_pallas=None, enable_fanout=True,
+                       enable_asym=True, drop=()):
         batch = {k: v for k, v in dev.items() if k not in drop}
         fn = jax.jit(lambda s, a: sk.ingest(s, a, use_pallas=use_pallas,
-                                            enable_fanout=enable_fanout),
+                                            enable_fanout=enable_fanout,
+                                            enable_asym=enable_asym),
                      donate_argnums=(0,))
         results[name] = seg_rate(lambda s: fn(s, batch), sk.init_state(cfg))
 
@@ -87,8 +89,9 @@ def main() -> None:
     ingest_variant("ingest_full")
     ingest_variant("ingest_no_features", drop=FEATURES)
     ingest_variant("ingest_no_fanout", enable_fanout=False)
-    ingest_variant("ingest_no_features_no_fanout", enable_fanout=False,
-                   drop=FEATURES)
+    ingest_variant("ingest_no_asym", enable_asym=False)
+    ingest_variant("ingest_core_only", enable_fanout=False,
+                   enable_asym=False, drop=FEATURES)
 
     # ---- op-level stages at production shapes -----------------------------
     words = dev["keys"]
