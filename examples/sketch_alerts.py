@@ -48,10 +48,11 @@ def render(rep: dict) -> None:
               f"{hh['DstAddr']}:{hh['DstPort']} proto {hh['Proto']} "
               f"~{fmt_bytes(hh['EstBytes'])}")
     for b in rep.get("DdosSuspectBuckets", []):
-        print(f"  ALERT ddos: dst bucket {b['bucket']} volume surge "
-              f"z={b['z']:.1f}")
+        who = ", ".join(b.get("probable_victims") or []) or f"bucket {b['bucket']}"
+        print(f"  ALERT ddos: {who} volume surge z={b['z']:.1f}")
     for b in rep.get("SynFloodSuspectBuckets", []):
-        print(f"  ALERT syn-flood: victim bucket {b['bucket']} "
+        who = ", ".join(b.get("probable_victims") or []) or f"bucket {b['bucket']}"
+        print(f"  ALERT syn-flood: {who} "
               f"{b['syn']:.0f} half-open vs {b['synack']:.0f} accepted "
               f"(z={b['z']:.1f})")
     for b in rep.get("PortScanSuspectBuckets", []):

@@ -115,6 +115,24 @@ def report_to_json(report, max_heavy: int = 64,
                 "Proto": int(k["proto"]),
                 "EstBytes": float(counts[i]),
             })
+    # best-effort victim names: heavy-hitter dst addresses hashed into the
+    # same EWMA buckets the anomaly signals use (numpy hash twin — report
+    # rendering must never dispatch a device op). Spoofed floods' own flows
+    # rarely make the heavy table, but the victim's legitimate traffic does.
+    n_buckets = np.asarray(report.ddos_z).shape[0]
+    dst_bucket_names: dict[int, list] = {}
+    if sel:
+        from netobserv_tpu.ops.hashing import hash_words_np
+        dst_buckets = hash_words_np(words[np.asarray(sel)][:, 4:8],
+                                    seed=0x0D57) & (n_buckets - 1)
+        for j, b in enumerate(dst_buckets):
+            names = dst_bucket_names.setdefault(int(b), [])
+            if len(names) < 3 and heavy[j]["DstAddr"] not in names:
+                names.append(heavy[j]["DstAddr"])
+
+    def victims(bucket: int) -> list:
+        return dst_bucket_names.get(int(bucket), [])
+
     z = np.asarray(report.ddos_z)
     suspects = np.nonzero(z > ddos_z_threshold)[0]
     suspects = suspects[np.argsort(-z[suspects])]  # worst first before [:32]
@@ -191,16 +209,19 @@ def report_to_json(report, max_heavy: int = 64,
         "DnsLatencyQuantilesUs": {str(q): float(v) for q, v in zip(
             qs, np.asarray(report.dns_quantiles_us))},
         "DdosSuspectBuckets": [
-            {"bucket": int(b), "z": float(z[b])} for b in suspects[:32]],
+            {"bucket": int(b), "z": float(z[b]),
+             "probable_victims": victims(b)} for b in suspects[:32]],
         "PortScanSuspectBuckets": [
             {"bucket": int(b), "distinct_dst_port_pairs": float(fanout[b])}
             for b in scan[:32]],
         "SynFloodSuspectBuckets": [
             {"bucket": int(b), "syn": float(syn[b]),
-             "synack": float(synack[b]), "z": float(syn_z[b])}
+             "synack": float(synack[b]), "z": float(syn_z[b]),
+             "probable_victims": victims(b)}
             for b in flood[:32]],
         "DropAnomalyBuckets": [
-            {"bucket": int(b), "z": float(drop_z[b])}
+            {"bucket": int(b), "z": float(drop_z[b]),
+             "probable_victims": victims(b)}
             for b in drop_anom[:32]],
         "AsymmetricConversationBuckets": [
             {"bucket": int(b), "bytes": float(conv_total[b]),

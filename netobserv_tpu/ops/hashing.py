@@ -66,6 +66,31 @@ def base_hashes(words: jax.Array, seed: int = 0) -> tuple[jax.Array, jax.Array]:
     return h1, h2 | jnp.uint32(1)
 
 
+def hash_words_np(words: np.ndarray, seed: int = 0) -> np.ndarray:
+    """Pure-numpy twin of `hash_words` under `base_hashes`' h1 seeding —
+    for HOST-side bucket lookups (e.g. mapping report suspect buckets back
+    to heavy-hitter keys) without dispatching a device op (a wedged
+    accelerator link must never stall report rendering). Equivalence-tested
+    against the jax path."""
+    w = np.ascontiguousarray(words, dtype=np.uint32)
+    nwords = w.shape[-1]
+    with np.errstate(over="ignore"):
+        h = np.full(w.shape[:-1], np.uint32(0x9747B28C) ^ np.uint32(seed),
+                    np.uint32)
+        for i in range(nwords):
+            k = w[..., i] * _C1
+            k = ((k << np.uint32(15)) | (k >> np.uint32(17))) * _C2
+            h = h ^ k
+            h = ((h << np.uint32(13)) | (h >> np.uint32(19))) * _M5 + _N1
+        h = h ^ np.uint32(nwords * 4)
+        h = h ^ (h >> np.uint32(16))
+        h = h * _F1
+        h = h ^ (h >> np.uint32(13))
+        h = h * _F2
+        h = h ^ (h >> np.uint32(16))
+    return h
+
+
 def row_indices(h1: jax.Array, h2: jax.Array, depth: int, width: int) -> jax.Array:
     """Kirsch–Mitzenmacher: index for row i is (h1 + i*h2) mod width.
 

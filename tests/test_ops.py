@@ -495,3 +495,59 @@ def test_enable_asym_false_skips_conversation_fold():
     assert float(np.asarray(s.conv_fwd).sum()) == 0.0
     assert float(np.asarray(s.conv_rev).sum()) == 0.0
     assert float(s.total_records) == n
+
+
+def test_hash_words_np_twin_matches_jax():
+    """The host-side numpy hash twin must equal base_hashes' h1 for every
+    seed the report path uses (bucket mapping would silently misattribute
+    victims otherwise)."""
+    from netobserv_tpu.ops.hashing import base_hashes, hash_words_np
+
+    rng = np.random.default_rng(12)
+    w = rng.integers(0, 2**32, (256, 4), dtype=np.uint32)
+    for seed in (0, 0x0517, 0x0D57, 0x5CA7):
+        a = np.asarray(base_hashes(jnp.asarray(w), seed=seed)[0])
+        np.testing.assert_array_equal(a, hash_words_np(w, seed=seed))
+
+
+def test_ddos_suspects_carry_probable_victims():
+    """A DDoS suspect bucket names the heavy-hitter destination(s) that hash
+    into it — the operator's bridge from bucket ids to concrete victims."""
+    import numpy as np
+
+    from netobserv_tpu.exporter.tpu_sketch import report_to_json
+    from netobserv_tpu.model.columnar import pack_key_words
+    from netobserv_tpu.sketch import state as sk
+    import netobserv_tpu.model.binfmt as binfmt
+    from netobserv_tpu.ops.hashing import hash_words_np
+
+    cfg = sk.SketchConfig(cm_width=1 << 12, topk=16, ewma_buckets=64)
+    state = sk.init_state(cfg)
+    n = 64
+    arr = np.zeros(n, dtype=binfmt.FLOW_KEY_DTYPE)
+    for i in range(n):
+        arr[i]["src_ip"][10:12] = 0xFF
+        arr[i]["src_ip"][12:] = [10, 0, 0, i % 250 + 1]
+        arr[i]["dst_ip"][10:12] = 0xFF
+        arr[i]["dst_ip"][12:] = [10, 9, 9, 9]   # one victim
+        arr[i]["src_port"] = 30000 + i
+        arr[i]["dst_port"] = 80
+        arr[i]["proto"] = 6
+    kw = pack_key_words(arr)
+    arrays = {
+        "keys": kw, "bytes": np.full(n, 1e6, np.float32),
+        "packets": np.ones(n, np.int32), "rtt_us": np.zeros(n, np.int32),
+        "dns_latency_us": np.zeros(n, np.int32),
+        "sampling": np.zeros(n, np.int32), "valid": np.ones(n, np.bool_),
+    }
+    ingest = jax.jit(sk.ingest)
+    # two calm baseline windows, then the surge window
+    for scale in (1e-3, 1e-3, 1.0):
+        scaled = dict(arrays, bytes=arrays["bytes"] * scale)
+        state = ingest(state, scaled)
+        state, report = sk.roll_window(state, cfg)
+    obj = report_to_json(report)
+    assert obj["DdosSuspectBuckets"], "surge not flagged"
+    vb = int(hash_words_np(kw[:1, 4:8], seed=0x0D57)[0] & 63)
+    hit = [s for s in obj["DdosSuspectBuckets"] if s["bucket"] == vb]
+    assert hit and "10.9.9.9" in hit[0]["probable_victims"]
