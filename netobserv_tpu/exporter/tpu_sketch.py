@@ -122,13 +122,20 @@ def report_to_json(report, max_heavy: int = 64,
     n_buckets = np.asarray(report.ddos_z).shape[0]
     dst_bucket_names: dict[int, list] = {}
     if sel:
-        from netobserv_tpu.ops.hashing import hash_words_np
-        dst_buckets = hash_words_np(words[np.asarray(sel)][:, 4:8],
-                                    seed=0x0D57) & (n_buckets - 1)
-        for j, b in enumerate(dst_buckets):
-            names = dst_bucket_names.setdefault(int(b), [])
-            if len(names) < 3 and heavy[j]["DstAddr"] not in names:
-                names.append(heavy[j]["DstAddr"])
+        from netobserv_tpu.ops.hashing import DST_BUCKET_SEED, hash_words_np
+        sel_words = words[np.asarray(sel)]
+        # BOTH directions name a victim: its inbound traffic buckets via
+        # the dst words, its outbound (e.g. a flooded server still serving)
+        # via the src words — the device folds both into the same bucket
+        # family (state.py src_sym/dst_h1 share DST_BUCKET_SEED)
+        for cols, field in ((sel_words[:, 4:8], "DstAddr"),
+                            (sel_words[:, 0:4], "SrcAddr")):
+            buckets = hash_words_np(cols, seed=DST_BUCKET_SEED) \
+                & (n_buckets - 1)
+            for j, b in enumerate(buckets):
+                names = dst_bucket_names.setdefault(int(b), [])
+                if len(names) < 3 and heavy[j][field] not in names:
+                    names.append(heavy[j][field])
 
     def victims(bucket: int) -> list:
         return dst_bucket_names.get(int(bucket), [])

@@ -59,6 +59,15 @@ def hash_words(words: jax.Array, seed: int | jax.Array) -> jax.Array:
     return fmix32(h)
 
 
+#: seed of the VICTIM/destination bucket family — the per-dst HLL grid,
+#: every EWMA victim bucket (ddos/syn/drops), the conversation pair hash,
+#: and the exporter's host-side victim naming all key off it; one
+#: definition so the device and host sides cannot drift
+DST_BUCKET_SEED = 0x0D57
+#: seed of the source-hash family (global/per-src HLL, fan-out grid)
+SRC_BUCKET_SEED = 0x0517
+
+
 def base_hashes(words: jax.Array, seed: int = 0) -> tuple[jax.Array, jax.Array]:
     """Two independent base hashes (h2 forced odd so strides generate Z_{2^k})."""
     h1 = hash_words(words, jnp.uint32(0x9747B28C) ^ jnp.uint32(seed))

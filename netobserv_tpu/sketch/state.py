@@ -285,8 +285,10 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
         pkts = pkts * factor
 
     h1, h2 = hashing.base_hashes(words)
-    src_h1, src_h2 = hashing.base_hashes(words[:, 0:4], seed=0x0517)
-    dst_h1, _ = hashing.base_hashes(words[:, 4:8], seed=0x0D57)
+    src_h1, src_h2 = hashing.base_hashes(words[:, 0:4],
+                                         seed=hashing.SRC_BUCKET_SEED)
+    dst_h1, _ = hashing.base_hashes(words[:, 4:8],
+                                    seed=hashing.DST_BUCKET_SEED)
 
     if sketch_axis is None:
         # the Pallas kernel needs the width to tile; silently use the XLA
@@ -345,7 +347,8 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     # conversation asymmetry: hash BOTH endpoints under one seed so the
     # pair bucket is direction-invariant (A->B and B->A land together);
     # the lower endpoint hash defines the canonical "fwd" direction
-    src_sym, _ = hashing.base_hashes(words[:, 0:4], seed=0x0D57)
+    src_sym, _ = hashing.base_hashes(words[:, 0:4],
+                                     seed=hashing.DST_BUCKET_SEED)
     if enable_asym:
         pair_idx = ((src_sym + dst_h1)
                     & jnp.uint32(state.conv_fwd.shape[0] - 1)).astype(jnp.int32)

@@ -160,7 +160,7 @@ def _signal_arrays(kw, flags, drop_bytes=None, drop_packets=None,
 def _victim_bucket(dst_words, m):
     from netobserv_tpu.ops import hashing
     h1, _ = hashing.base_hashes(
-        jnp.asarray(dst_words[None, :], jnp.uint32), seed=0x0D57)
+        jnp.asarray(dst_words[None, :], jnp.uint32), seed=hashing.DST_BUCKET_SEED)
     return int(np.asarray(h1)[0] & (m - 1))
 
 
@@ -279,9 +279,9 @@ def run_asym_case(elephant_mb: float, bg_pairs: int = 512, seed: int = 0,
                   .tolist())
     from netobserv_tpu.ops import hashing
     s_h, _ = hashing.base_hashes(
-        jnp.asarray(exfil_src[None, :], jnp.uint32), seed=0x0D57)
+        jnp.asarray(exfil_src[None, :], jnp.uint32), seed=hashing.DST_BUCKET_SEED)
     d_h, _ = hashing.base_hashes(
-        jnp.asarray(exfil_dst[None, :], jnp.uint32), seed=0x0D57)
+        jnp.asarray(exfil_dst[None, :], jnp.uint32), seed=hashing.DST_BUCKET_SEED)
     vb = int((np.asarray(s_h)[0] + np.asarray(d_h)[0])
              & (cfg.ewma_buckets - 1))
     return vb in flagged, len(flagged - {vb})

@@ -548,6 +548,7 @@ def test_ddos_suspects_carry_probable_victims():
         state, report = sk.roll_window(state, cfg)
     obj = report_to_json(report)
     assert obj["DdosSuspectBuckets"], "surge not flagged"
-    vb = int(hash_words_np(kw[:1, 4:8], seed=0x0D57)[0] & 63)
+    from netobserv_tpu.ops.hashing import DST_BUCKET_SEED
+    vb = int(hash_words_np(kw[:1, 4:8], seed=DST_BUCKET_SEED)[0] & 63)
     hit = [s for s in obj["DdosSuspectBuckets"] if s["bucket"] == vb]
     assert hit and "10.9.9.9" in hit[0]["probable_victims"]
