@@ -2,15 +2,24 @@
 "flagship model".
 
 One `ingest` call folds a fixed-shape columnar flow batch into:
-- Count-Min (bytes, float32) + Count-Min (packets, int32) over the 5-tuple,
+- Count-Min (bytes) + Count-Min (packets) over the 5-tuple (both f32),
 - a top-K heavy-hitter table scored by CM byte estimates,
-- a global distinct-source HyperLogLog and a per-destination-bucket HLL grid,
+- a global distinct-source HyperLogLog, a per-destination HLL grid, and a
+  per-source (dst, port) fan-out HLL grid (port-scan signal),
 - RTT and DNS-latency log-histograms,
-- an EWMA DDoS accumulator over destination buckets.
+- EWMA accumulators per victim bucket: DDoS volume, half-open SYN attempts
+  (+ the window's SYN-ACK responses for the offered:accepted ratio), and
+  kernel-dropped bytes,
+- drop-cause and DSCP histograms, QUIC/NAT marker totals,
+- per-direction bytes of each unordered endpoint pair (conversation
+  asymmetry — one-way/exfil shape).
 
-The streaming-chunk design is the long-context answer for this domain
-(SURVEY.md §5.7): state is constant-size in stream length; batches are the
-"sequence chunks"; time is windowed by `roll_window`.
+The flag/drop/marker inputs ride the dense feed's feature lane (words
+16..19, flowpack.cc layout); feeds without those columns simply skip the
+corresponding signals (trace-time optional). The streaming-chunk design is
+the long-context answer for this domain (SURVEY.md §5.7): state is
+constant-size in stream length; batches are the "sequence chunks"; time is
+windowed by `roll_window`.
 """
 
 from __future__ import annotations
