@@ -99,14 +99,17 @@ def main() -> None:
     bytes_f = dev["bytes"]
     h1, h2 = jax.jit(hashing.base_hashes)(words)
     src_h1, src_h2 = jax.jit(
-        lambda w: hashing.base_hashes(w, seed=0x0517))(words[:, 0:4])
+        lambda w: hashing.base_hashes(
+            w, seed=hashing.SRC_BUCKET_SEED))(words[:, 0:4])
     dst_h1, _ = jax.jit(
-        lambda w: hashing.base_hashes(w, seed=0x0D57))(words[:, 4:8])
+        lambda w: hashing.base_hashes(
+            w, seed=hashing.DST_BUCKET_SEED))(words[:, 4:8])
     jax.block_until_ready((h1, h2, src_h1, src_h2, dst_h1))
 
-    hash_fn = jax.jit(lambda w: (hashing.base_hashes(w),
-                                 hashing.base_hashes(w[:, 0:4], seed=0x0517),
-                                 hashing.base_hashes(w[:, 4:8], seed=0x0D57)))
+    hash_fn = jax.jit(lambda w: (
+        hashing.base_hashes(w),
+        hashing.base_hashes(w[:, 0:4], seed=hashing.SRC_BUCKET_SEED),
+        hashing.base_hashes(w[:, 4:8], seed=hashing.DST_BUCKET_SEED)))
     results["stage_hashing_x3"] = seg_rate(
         lambda c: hash_fn(words)[0][0] + c, jnp.uint32(0))
 

@@ -340,8 +340,11 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
                 window=s.window + 1, **ewma_rolled,
             )
         else:
+            # synack resets with its paired EWMA rate (see state.roll_window)
             new = s._replace(ddos=ddos_state, syn=syn_state,
-                             drops_ewma=drops_state, window=s.window + 1)
+                             drops_ewma=drops_state,
+                             synack=jnp.zeros_like(s.synack),
+                             window=s.window + 1)
         return _add_lead(new), report
 
     shmapped = jax.shard_map(

@@ -247,10 +247,14 @@ def arrays_to_dense(arrays: dict[str, np.ndarray]) -> np.ndarray:
     dense[:, 13] = arrays["dns_latency_us"]
     dense[:, 14] = np.asarray(arrays["valid"], np.uint32)
     dense[:, 15] = col("sampling")
-    dense[:, 16] = (col("tcp_flags") | (col("dscp") << 16)
+    dense[:, 16] = ((col("tcp_flags") & 0xFFFF) | (col("dscp") << 16)
                     | (col("markers") << 24))
-    dense[:, 17] = col("drop_bytes") | (col("drop_packets") << 16)
-    dense[:, 18] = col("drop_cause")
+    # saturate the 16-bit drop lanes like flowpack.cc fill_feature_words
+    # (the C side's inputs are u16 by dtype; this twin takes arbitrary
+    # ints and must not bleed bits into the adjacent lane)
+    dense[:, 17] = (np.minimum(col("drop_bytes"), 0xFFFF)
+                    | (np.minimum(col("drop_packets"), 0xFFFF) << 16))
+    dense[:, 18] = np.minimum(col("drop_cause"), 0xFFFF)
     return dense.reshape(-1)
 
 
@@ -623,8 +627,13 @@ def roll_window(state: SketchState, cfg: SketchConfig,
                                    drops_ewma=drops_state,
                                    window=state.window + 1)
     else:
+        # synack pairs with the syn EWMA's per-window rate (which roll just
+        # zeroed) — it must reset with it even when sketches are kept, or
+        # the flood ratio divides a window numerator by a cumulative
+        # denominator and detection decays every window
         new_state = state._replace(ddos=ddos_state, syn=syn_state,
                                    drops_ewma=drops_state,
+                                   synack=jnp.zeros_like(state.synack),
                                    window=state.window + 1)
     return new_state, report
 
