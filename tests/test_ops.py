@@ -552,3 +552,37 @@ def test_ddos_suspects_carry_probable_victims():
     vb = int(hash_words_np(kw[:1, 4:8], seed=DST_BUCKET_SEED)[0] & 63)
     hit = [s for s in obj["DdosSuspectBuckets"] if s["bucket"] == vb]
     assert hit and "10.9.9.9" in hit[0]["probable_victims"]
+
+
+def test_keep_state_roll_resets_synack_with_its_ewma():
+    """roll_window(reset_sketches=False) must zero synack alongside the syn
+    EWMA rate — the flood ratio pairs a per-window numerator with a
+    per-window denominator in EVERY roll mode."""
+    import numpy as np
+
+    from netobserv_tpu.sketch import state as sk
+
+    cfg = sk.SketchConfig(cm_width=1 << 10, topk=16, ewma_buckets=32)
+    n = 8
+    arrays = {
+        "keys": np.random.default_rng(1).integers(
+            0, 2**32, (n, 10)).astype(np.uint32),
+        "bytes": np.full(n, 10.0, np.float32),
+        "packets": np.ones(n, np.int32),
+        "rtt_us": np.zeros(n, np.int32),
+        "dns_latency_us": np.zeros(n, np.int32),
+        "sampling": np.zeros(n, np.int32),
+        "valid": np.ones(n, np.bool_),
+        "tcp_flags": np.full(n, 0x112, np.int32),  # SYN-ACK responses
+        "dscp": np.zeros(n, np.int32),
+        "drop_bytes": np.zeros(n, np.int32),
+        "drop_packets": np.zeros(n, np.int32),
+        "drop_cause": np.zeros(n, np.int32),
+    }
+    s = sk.ingest(sk.init_state(cfg), arrays)
+    assert float(np.asarray(s.synack).sum()) == n
+    for kwargs in ({"reset_sketches": True}, {"reset_sketches": False},
+                   {"decay_factor": 0.5}):
+        rolled, _ = sk.roll_window(s, cfg, **kwargs)
+        assert float(np.asarray(rolled.synack).sum()) == 0.0, kwargs
+        assert float(np.asarray(rolled.syn.rate).sum()) == 0.0, kwargs
