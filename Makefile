@@ -68,3 +68,13 @@ bench-micro:
 
 gen-docs:
 	$(PY) scripts/gen_config_docs.py
+
+# full accuracy sweep -> docs/accuracy.md (detection sweeps included)
+accuracy:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) scripts/accuracy_sweep.py
+
+# host-path + per-stage device profiles (run on the real chip when healthy)
+profile:
+	$(PY) benchmarks/host_path_profile.py
+	$(PY) benchmarks/ingest_stage_profile.py
