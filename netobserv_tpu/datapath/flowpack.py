@@ -44,12 +44,18 @@ class _Columns(ctypes.Structure):
 _lib: Optional[ctypes.CDLL] = None
 
 
-_ABI_VERSION = 6
+_ABI_VERSION = 7
 
 #: dense TPU-feed row width (words); layout documented in flowpack.cc
 DENSE_WORDS = 20
 #: compact (v4) TPU-feed row width; layout documented in flowpack.cc
 COMPACT_WORDS = 10
+#: resident feed constants; layout documented in flowpack.cc fp_pack_resident
+RESIDENT_HDR = 4
+HOT_WORDS = 3
+NK_WORDS = 11
+#: hot-row rtt code ceiling (µs); larger samples spill full-width
+RTT_MAX_US = 0xFF << 14
 #: bytes 8..11 of a v4-in-v6 mapped address as a LE u32
 _V4_PREFIX_WORD2 = 0xFFFF0000
 
@@ -57,6 +63,99 @@ _V4_PREFIX_WORD2 = 0xFFFF0000
 def compact_buf_len(batch_size: int, spill_cap: int) -> int:
     """Flat word count of a compact feed buffer: compact lane + spill lane."""
     return batch_size * COMPACT_WORDS + spill_cap * DENSE_WORDS
+
+
+class ResidentCaps:
+    """Static side-lane capacities of the resident feed (fixed shapes keep
+    the jitted unpack retrace-free; overflows fall back to the dense feed)."""
+
+    __slots__ = ("dns", "drop", "nk", "spill")
+
+    def __init__(self, dns: int, drop: int, nk: int, spill: int):
+        self.dns, self.drop, self.nk, self.spill = dns, drop, nk, spill
+
+    def __iter__(self):
+        return iter((self.dns, self.drop, self.nk, self.spill))
+
+    def __eq__(self, other):
+        return tuple(self) == tuple(other)
+
+    def __repr__(self):
+        return (f"ResidentCaps(dns={self.dns}, drop={self.drop}, "
+                f"nk={self.nk}, spill={self.spill})")
+
+
+def default_resident_caps(batch_size: int) -> ResidentCaps:
+    """Production sizing (byte budget in docs/tpu_sketch.md): DNS-latency
+    and drop rows are minorities of live traffic; new keys per batch are a
+    trickle once the flow table is warm; the spill lane only carries rows
+    the hot row cannot represent exactly."""
+    return ResidentCaps(dns=max(batch_size // 16, 64),
+                        drop=max(batch_size // 16, 64),
+                        nk=max(batch_size // 32, 64),
+                        spill=max(batch_size // 64, 32))
+
+
+def resident_buf_len(batch_size: int, caps: ResidentCaps) -> int:
+    """Flat word count of a resident feed buffer (header + all lanes)."""
+    return (RESIDENT_HDR + batch_size * HOT_WORDS + caps.dns + caps.drop * 2
+            + caps.nk * NK_WORDS + caps.spill * DENSE_WORDS)
+
+
+class KeyDict:
+    """Host key->slot dictionary backing the resident feed — native
+    (flowpack.cc fp_dict) when the library is built, pure-python twin
+    otherwise (tests pin their equivalence). Slots are assigned sequentially
+    in first-seen order; reset() empties the dictionary (the device key
+    table needs no matching reset: every live slot is redefined through the
+    new-key lane before a hot row references it)."""
+
+    def __init__(self, slot_cap: int = 1 << 18,
+                 use_native: Optional[bool] = None):
+        if slot_cap <= 0 or slot_cap > (1 << 20):
+            raise ValueError("slot_cap must be in 1..2^20 (20-bit slot ids)")
+        self.slot_cap = slot_cap
+        if use_native is None:
+            use_native = native_available()
+        self.native = bool(use_native and native_available())
+        if self.native:
+            _lib.fp_dict_new.restype = ctypes.c_void_p
+            self._handle = _lib.fp_dict_new(ctypes.c_uint32(slot_cap))
+            if not self._handle:
+                raise MemoryError("fp_dict_new failed")
+            self._py = None
+        else:
+            self._handle = None
+            self._py: Optional[dict] = {}
+
+    def _live_handle(self) -> int:
+        if not self._handle:
+            raise ValueError("KeyDict is closed")
+        return self._handle
+
+    def count(self) -> int:
+        if self.native:
+            _lib.fp_dict_count.restype = ctypes.c_uint32
+            return int(_lib.fp_dict_count(ctypes.c_void_p(
+                self._live_handle())))
+        return len(self._py)
+
+    def reset(self) -> None:
+        if self.native:
+            _lib.fp_dict_reset(ctypes.c_void_p(self._live_handle()))
+        else:
+            self._py.clear()
+
+    def close(self) -> None:
+        if self.native and self._handle:
+            _lib.fp_dict_free(ctypes.c_void_p(self._handle))
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def _find_lib() -> Optional[ctypes.CDLL]:
@@ -415,6 +514,157 @@ def pack_compact(events_raw: bytes | np.ndarray,
         s[:, 15] = stats["sampling"][~is4]
         s[:, 16:] = fw[~is4]
     return out
+
+
+def _rtt_code11(rtt_us: int) -> int:
+    e = 0
+    while (rtt_us >> (2 * e)) > 0xFF:
+        e += 1
+    return ((rtt_us >> (2 * e)) & 0xFF) | (e << 8)
+
+
+def _lat_code16(us: int) -> int:
+    e = 0
+    while (us >> e) > 0xFFF and e < 15:
+        e += 1
+    return min(us >> e, 0xFFF) | (e << 12)
+
+
+def pack_resident(events_raw: bytes | np.ndarray,
+                  batch_size: int,
+                  kdict: KeyDict,
+                  caps: ResidentCaps,
+                  start: int = 0,
+                  extra: Optional[np.ndarray] = None,
+                  dns: Optional[np.ndarray] = None,
+                  drops: Optional[np.ndarray] = None,
+                  xlat: Optional[np.ndarray] = None,
+                  quic: Optional[np.ndarray] = None,
+                  out: Optional[np.ndarray] = None
+                  ) -> tuple[np.ndarray, int]:
+    """Raw flow-event buffer -> the resident feed (layout pinned in
+    flowpack.cc fp_pack_resident; device unpack is
+    sketch.state.resident_to_arrays). Packs events[start:] until the hot or
+    spill lane fills; returns (buffer, rows_consumed) — partial packing
+    with continuation (the caller ships the prefix and calls again with the
+    next start), so the dictionary and the device key table learn
+    monotonically even under cold-start key floods. Whether the native or
+    the python path runs follows the dictionary's own nativeness — the two
+    sides share per-row state and cannot be mixed."""
+    if isinstance(events_raw, np.ndarray):
+        events = np.ascontiguousarray(events_raw, dtype=binfmt.FLOW_EVENT_DTYPE)
+    else:
+        events = binfmt.decode_flow_events(events_raw)
+    n = len(events)
+    if batch_size > 0xFFFF:
+        raise ValueError("resident feed row indices are 16-bit")
+    if min(caps.spill, caps.nk) < 1:
+        raise ValueError("resident caps must be >= 1 (progress guarantee)")
+    if not 0 <= start <= n:
+        raise ValueError(f"start {start} out of range 0..{n}")
+    total = resident_buf_len(batch_size, caps)
+    if out is None:
+        out = np.empty(total, dtype=np.uint32)
+    elif (out.shape != (total,) or out.dtype != np.uint32
+          or not out.flags.c_contiguous):
+        raise ValueError(f"out must be C-contiguous ({total},) uint32")
+    ex = _fit_rows(extra, n, binfmt.EXTRA_REC_DTYPE)
+    dn = _fit_rows(dns, n, binfmt.DNS_REC_DTYPE)
+    dr = _fit_rows(drops, n, binfmt.DROPS_REC_DTYPE)
+    xl = _fit_rows(xlat, n, binfmt.XLAT_REC_DTYPE)
+    qc = _fit_rows(quic, n, binfmt.QUIC_REC_DTYPE)
+    if kdict.native:
+        _lib.fp_pack_resident.restype = ctypes.c_int64
+        consumed = _lib.fp_pack_resident(
+            ctypes.c_void_p(events.ctypes.data), ctypes.c_size_t(start),
+            ctypes.c_size_t(n),
+            ctypes.c_void_p(ex.ctypes.data if ex is not None else None),
+            ctypes.c_void_p(dn.ctypes.data if dn is not None else None),
+            ctypes.c_void_p(dr.ctypes.data if dr is not None else None),
+            ctypes.c_void_p(xl.ctypes.data if xl is not None else None),
+            ctypes.c_void_p(qc.ctypes.data if qc is not None else None),
+            ctypes.c_void_p(kdict._live_handle()),
+            ctypes.c_void_p(out.ctypes.data),
+            ctypes.c_size_t(batch_size), ctypes.c_size_t(caps.dns),
+            ctypes.c_size_t(caps.drop), ctypes.c_size_t(caps.nk),
+            ctypes.c_size_t(caps.spill))
+        return out, int(consumed)
+    # ---- python twin (the layout oracle; per-row because the dictionary
+    # state evolves first-seen-sequentially, exactly like the native side)
+    hot_off = RESIDENT_HDR
+    dns_off = hot_off + batch_size * HOT_WORDS
+    drop_off = dns_off + caps.dns
+    nk_off = drop_off + caps.drop * 2
+    spill_off = nk_off + caps.nk * NK_WORDS
+    out[:] = 0
+    def_sampling = int(events["stats"]["sampling"][start]) if start < n else 0
+    out[0] = def_sampling
+    if start >= n:
+        return out, 0
+    kw_all = pack_key_words(events["key"])
+    fw_all = _feature_words(events["stats"], ex, xl, qc, dr)
+    stats = events["stats"]
+    # u32 wrap matches the native cast (and the dense path's u32 column)
+    rtt_all = ((ex["rtt_ns"] // 1000).astype(np.uint32) if ex is not None
+               else np.zeros(n, np.uint32))
+    dlat_all = ((dn["latency_ns"] // 1000).astype(np.uint64) if dn is not None
+                else np.zeros(n, np.uint64))
+    py = kdict._py
+    nh = nd = nr = nk = ns = 0
+    i = start
+    while i < n and nh < batch_size:
+        kb = kw_all[i].tobytes()
+        slot = py.get(kb)
+        if slot is None and nk < caps.nk and len(py) < kdict.slot_cap:
+            slot = len(py)
+            py[kb] = slot
+            row = nk_off + nk * NK_WORDS
+            out[row] = 0x80000000 | slot
+            out[row + 1:row + 11] = kw_all[i]
+            nk += 1
+        rtt = int(rtt_all[i])
+        dlat = int(dlat_all[i])
+        has_drops = dr is not None and bool(dr["bytes"][i] or dr["packets"][i])
+        pk, fl = int(stats["packets"][i]), int(stats["tcp_flags"][i])
+        hot_ok = (slot is not None and pk < 0x800 and fl < 0x800
+                  and int(stats["dscp"][i]) < 0x40
+                  and int(stats["sampling"][i]) == def_sampling
+                  and rtt <= RTT_MAX_US
+                  and (not dlat or nd < caps.dns)
+                  and (not has_drops or nr < caps.drop))
+        if hot_ok:
+            row = hot_off + nh * HOT_WORDS
+            out[row] = 0x80000000 | (_rtt_code11(rtt) << 20) | slot
+            out[row + 1] = np.float32(stats["bytes"][i]).view(np.uint32)
+            out[row + 2] = (pk | (fl << 11)
+                            | (int(stats["dscp"][i]) << 22)
+                            | ((int(fw_all[i, 0]) >> 24) << 28))
+            if dlat:
+                out[dns_off + nd] = (nh << 16) | _lat_code16(dlat)
+                nd += 1
+            if has_drops:
+                cause = min(int(dr["latest_cause"][i]), 0xFFFF)
+                out[drop_off + nr * 2] = (nh << 16) | cause
+                out[drop_off + nr * 2 + 1] = ((int(dr["packets"][i]) << 16)
+                                              | int(dr["bytes"][i]))
+                nr += 1
+            nh += 1
+        else:
+            if ns >= caps.spill:
+                break  # chunk full: caller continues from row i
+            row = spill_off + ns * DENSE_WORDS
+            out[row:row + 10] = kw_all[i]
+            out[row + 10] = np.float32(stats["bytes"][i]).view(np.uint32)
+            out[row + 11] = pk
+            out[row + 12] = rtt
+            out[row + 13] = np.uint32(dlat)
+            out[row + 14] = 1
+            out[row + 15] = stats["sampling"][i]
+            out[row + 16:row + 20] = fw_all[i]
+            ns += 1
+        i += 1
+    out[1], out[2], out[3] = nk, ns, nd | (nr << 16)
+    return out, i - start
 
 
 _MERGE_FNS = {

@@ -263,6 +263,302 @@ int fp_pack_compact(const uint8_t *events, size_t n,
     return static_cast<int>(ns);
 }
 
+// ---------------------------------------------------------------------------
+// Resident-key feed: the lowest-bytes-per-record TPU feed. The host keeps a
+// key -> slot dictionary (this file); the DEVICE keeps a (slot_cap, 10) u32
+// key table in HBM, updated from the new-key lane and gathered by slot id —
+// steady-state records ship as THREE words instead of ten (the transfer
+// link, not compute, bounds the host path; see docs/tpu_sketch.md byte
+// budget). Flat buffer layout (must match sketch/state.py
+// resident_to_arrays and flowpack.py pack_resident):
+//   [0..3]    header: w0 default sampling, w1 n_newkey, w2 n_spill,
+//             w3 n_dns | n_drop << 16   (w1..w3 diagnostic only)
+//   hot lane    batch_size * 3 words:
+//     w0  bit31 valid | bits 28..30 rtt exp | bits 20..27 rtt mant
+//         | bits 0..19 slot id          (rtt_us ~= mant << (2*exp))
+//     w1  bytes as float32 bitcast
+//     w2  packets (bits 0..10) | tcp_flags (11..21) | dscp (22..27)
+//         | markers (28..31)
+//   dns lane    dns_cap words:  row_idx << 16 | dns code
+//         (code: bits 12..15 exp e, bits 0..11 mant m; value_us = m << e)
+//   drop lane   drop_cap * 2 words:
+//     w0  row_idx << 16 | latest_cause (saturated u16)
+//     w1  drop packets << 16 | drop bytes
+//   newkey lane nk_cap * 11 words: w0 = bit31 | slot id, w1..w10 key words
+//   spill lane  spill_cap * FP_DENSE_WORDS dense rows (anything the hot
+//               row can't carry exactly: packets/flags overflow, sampling
+//               mismatch, rtt beyond the code range, lane overflows)
+//
+// fp_pack_resident packs events[start..n) until the hot lane or the spill
+// lane fills, and returns the number of rows CONSUMED — partial packing
+// with continuation: the caller ships the (always self-consistent) prefix
+// and packs the remainder into the next buffer, so the dictionary and the
+// device table learn monotonically even under cold-start floods (no
+// rollback, no dense fallback). A full dictionary is the caller's policy
+// decision: reset it between calls — stale device-table rows are harmless
+// because every live slot is redefined through the new-key lane before any
+// hot row references it. Lane counts land in header words 1..3.
+#define FP_HOT_WORDS 3
+#define FP_RESIDENT_HDR 4
+#define FP_NK_WORDS 11
+#define FP_SLOT_MASK 0xFFFFFu
+#define FP_RTT_MAX_US (0xFFu << 14)
+
+// 16-byte entries: a 64-bit key FINGERPRINT instead of the 40-byte key.
+// The table must be probed once per record at line rate; 48-byte entries
+// made every probe a random DRAM access (measured 9.8M rec/s on the pack
+// loop). A fingerprint collision (p ~ n^2/2^65 — ~1e-6 at a full 2^18
+// table) maps a new flow onto an existing slot: its records fold under
+// that slot's key words, a bounded mis-attribution of the same order as a
+// Count-Min collision. The sketch plane hashes the (gathered) key words
+// themselves, so nothing downstream amplifies it.
+struct fp_dict_entry {
+    uint64_t fp;       // 0 = empty (fingerprints of 0 are remapped to 1)
+    uint32_t slot;
+    uint32_t pad_;
+};
+
+struct fp_dict {
+    struct fp_dict_entry *tab;
+    size_t mask;       // hash table size - 1 (power of two)
+    uint32_t slot_cap;
+    uint32_t next_slot;
+};
+
+static inline uint64_t key_fp64(const uint32_t *kw) {
+    // 40 key bytes = 5 u64 lanes; murmur-style mix per lane + finalizer
+    uint64_t h = 0x9E3779B97F4A7C15ull;
+    for (int i = 0; i < 5; i++) {
+        uint64_t k;
+        std::memcpy(&k, reinterpret_cast<const uint8_t *>(kw) + i * 8, 8);
+        k *= 0xC2B2AE3D27D4EB4Full;
+        k = (k << 31) | (k >> 33);
+        k *= 0x9E3779B185EBCA87ull;
+        h ^= k;
+        h = ((h << 27) | (h >> 37)) * 5 + 0x52DCE729ull;
+    }
+    h ^= h >> 33;
+    h *= 0xFF51AFD7ED558CCDull;
+    h ^= h >> 33;
+    h *= 0xC4CEB9FE1A85EC53ull;
+    h ^= h >> 33;
+    return h ? h : 1;
+}
+
+void *fp_dict_new(uint32_t slot_cap) {
+    if (slot_cap == 0 || slot_cap > (FP_SLOT_MASK + 1))
+        return nullptr;
+    size_t cap = 1;
+    while (cap < static_cast<size_t>(slot_cap) * 2)
+        cap <<= 1;
+    fp_dict *d = new fp_dict;
+    d->tab = new fp_dict_entry[cap]();
+    d->mask = cap - 1;
+    d->slot_cap = slot_cap;
+    d->next_slot = 0;
+    return d;
+}
+
+void fp_dict_free(void *h) {
+    if (!h) return;
+    fp_dict *d = static_cast<fp_dict *>(h);
+    delete[] d->tab;
+    delete d;
+}
+
+void fp_dict_reset(void *h) {
+    fp_dict *d = static_cast<fp_dict *>(h);
+    std::memset(d->tab, 0, (d->mask + 1) * sizeof(fp_dict_entry));
+    d->next_slot = 0;
+}
+
+uint32_t fp_dict_count(void *h) {
+    return static_cast<fp_dict *>(h)->next_slot;
+}
+
+// Find the fingerprint's hash-table index; *found says whether it's there.
+static inline size_t dict_probe(const fp_dict *d, uint64_t fp, bool *found) {
+    size_t i = fp & d->mask;
+    for (;;) {
+        const fp_dict_entry *e = &d->tab[i];
+        if (!e->fp) {
+            *found = false;
+            return i;
+        }
+        if (e->fp == fp) {
+            *found = true;
+            return i;
+        }
+        i = (i + 1) & d->mask;
+    }
+}
+
+static inline void make_kw(const struct no_flow_key *k, uint32_t *kw) {
+    std::memcpy(kw, k->src_ip, 16);
+    std::memcpy(kw + 4, k->dst_ip, 16);
+    kw[8] = (static_cast<uint32_t>(k->src_port) << 16) | k->dst_port;
+    kw[9] = (static_cast<uint32_t>(k->proto) << 16) |
+            (static_cast<uint32_t>(k->icmp_type) << 8) | k->icmp_code;
+}
+
+static inline uint32_t rtt_code11(uint32_t rtt_us) {
+    // bits 0..7 mantissa, bits 8..10 exponent; value ~= m << (2*e)
+    uint32_t e = 0;
+    while ((rtt_us >> (2 * e)) > 0xFFu)
+        e++;
+    return ((rtt_us >> (2 * e)) & 0xFFu) | (e << 8);
+}
+
+static inline uint32_t lat_code16(uint64_t us) {
+    // bits 0..11 mantissa, bits 12..15 exponent; value ~= m << e
+    uint32_t e = 0;
+    while ((us >> e) > 0xFFFu && e < 15)
+        e++;
+    uint64_t m = us >> e;
+    if (m > 0xFFFu) m = 0xFFFu;  // saturate at ~134s
+    return static_cast<uint32_t>(m) | (e << 12);
+}
+
+int64_t fp_pack_resident(const uint8_t *events, size_t start, size_t n,
+                         const uint8_t *extra, const uint8_t *dns,
+                         const uint8_t *drops, const uint8_t *xlat,
+                         const uint8_t *quic,
+                         void *dict_h, uint32_t *out, size_t batch_size,
+                         size_t dns_cap, size_t drop_cap, size_t nk_cap,
+                         size_t spill_cap) {
+    fp_dict *d = static_cast<fp_dict *>(dict_h);
+    const struct no_flow_event *ev =
+        reinterpret_cast<const struct no_flow_event *>(events);
+    const struct no_extra_rec *ex =
+        reinterpret_cast<const struct no_extra_rec *>(extra);
+    const struct no_dns_rec *dn =
+        reinterpret_cast<const struct no_dns_rec *>(dns);
+    const struct no_drops_rec *dr =
+        reinterpret_cast<const struct no_drops_rec *>(drops);
+    const struct no_xlat_rec *xl =
+        reinterpret_cast<const struct no_xlat_rec *>(xlat);
+    const struct no_quic_rec *qc =
+        reinterpret_cast<const struct no_quic_rec *>(quic);
+    uint32_t *hot = out + FP_RESIDENT_HDR;
+    uint32_t *dnsl = hot + batch_size * FP_HOT_WORDS;
+    uint32_t *dropl = dnsl + dns_cap;
+    uint32_t *nkl = dropl + drop_cap * 2;
+    uint32_t *spill = nkl + nk_cap * FP_NK_WORDS;
+    size_t nh = 0, nd = 0, nr = 0, nk = 0, ns = 0;
+    uint32_t def_sampling = start < n ? ev[start].stats.sampling : 0;
+
+    // fingerprint lookahead pipeline: compute row i+PF's fingerprint and
+    // prefetch its table line while processing row i — the probe is a
+    // random access into a multi-MB table, and exposed DRAM latency was
+    // the pack loop's measured bottleneck
+    enum { PF = 16 };
+    uint64_t fpbuf[PF];
+    for (size_t j = start; j < n && j < start + PF; j++) {
+        uint32_t kwp[10];
+        make_kw(&ev[j].key, kwp);
+        fpbuf[j % PF] = key_fp64(kwp);
+        __builtin_prefetch(&d->tab[fpbuf[j % PF] & d->mask]);
+    }
+    size_t i = start;
+    for (; i < n && nh < batch_size; i++) {
+        const struct no_flow_key *k = &ev[i].key;
+        const struct no_flow_stats *s = &ev[i].stats;
+        // row i's fingerprint FIRST: the ring slot is about to be reused
+        // for row i+PF
+        uint64_t fp = fpbuf[i % PF];
+        if (i + PF < n) {
+            uint32_t kwp[10];
+            make_kw(&ev[i + PF].key, kwp);
+            fpbuf[(i + PF) % PF] = key_fp64(kwp);
+            __builtin_prefetch(&d->tab[fpbuf[(i + PF) % PF] & d->mask]);
+        }
+        uint32_t kw[10];
+        make_kw(k, kw);
+        // ensure the key has a slot (insert through the new-key lane);
+        // nk-lane or dictionary exhaustion just routes the row to spill —
+        // the key is learned by a later chunk
+        bool found;
+        size_t hi = dict_probe(d, fp, &found);
+        bool have_slot = found;
+        uint32_t slot = found ? d->tab[hi].slot : 0;
+        if (!found && nk < nk_cap && d->next_slot < d->slot_cap) {
+            slot = d->next_slot++;
+            d->tab[hi].fp = fp;
+            d->tab[hi].slot = slot;
+            uint32_t *row = nkl + nk * FP_NK_WORDS;
+            row[0] = 0x80000000u | slot;
+            std::memcpy(row + 1, kw, 40);
+            nk++;
+            have_slot = true;
+        }
+        uint32_t rtt = ex ? static_cast<uint32_t>(ex[i].rtt_ns / 1000) : 0;
+        uint64_t dlat = dn ? dn[i].latency_ns / 1000 : 0;
+        bool has_drops = dr && (dr[i].bytes || dr[i].packets);
+        bool hot_ok = have_slot && s->packets < 0x800 &&
+                      s->tcp_flags < 0x800 && s->dscp < 0x40 &&
+                      s->sampling == def_sampling && rtt <= FP_RTT_MAX_US &&
+                      (!dlat || nd < dns_cap) &&
+                      (!has_drops || nr < drop_cap);
+        if (hot_ok) {
+            uint32_t *row = hot + nh * FP_HOT_WORDS;
+            row[0] = 0x80000000u | (rtt_code11(rtt) << 20) | slot;
+            float b = static_cast<float>(s->bytes);
+            std::memcpy(&row[1], &b, 4);
+            row[2] = (s->packets & 0x7FFu) |
+                     (static_cast<uint32_t>(s->tcp_flags & 0x7FFu) << 11) |
+                     (static_cast<uint32_t>(s->dscp & 0x3Fu) << 22) |
+                     (static_cast<uint32_t>(feature_markers(ex, xl, qc, i))
+                      << 28);
+            if (dlat) {
+                dnsl[nd++] = (static_cast<uint32_t>(nh) << 16) |
+                             lat_code16(dlat);
+            }
+            if (has_drops) {
+                uint32_t cause = dr[i].latest_cause;
+                if (cause > 0xFFFFu) cause = 0xFFFFu;
+                uint32_t *de = dropl + nr * 2;
+                de[0] = (static_cast<uint32_t>(nh) << 16) | cause;
+                de[1] = (static_cast<uint32_t>(dr[i].packets) << 16) |
+                        dr[i].bytes;
+                nr++;
+            }
+            nh++;
+        } else {
+            if (ns >= spill_cap)
+                break;  // chunk full: caller continues from row i
+            uint32_t *row = spill + ns * FP_DENSE_WORDS;
+            std::memcpy(row, kw, 40);
+            float b = static_cast<float>(s->bytes);
+            std::memcpy(&row[10], &b, 4);
+            row[11] = s->packets;
+            row[12] = rtt;
+            row[13] = static_cast<uint32_t>(dlat);
+            row[14] = 1;
+            row[15] = s->sampling;
+            fill_feature_words(s, ex, xl, qc, dr, i, row + 16);
+            ns++;
+        }
+    }
+    out[0] = def_sampling;
+    out[1] = static_cast<uint32_t>(nk);
+    out[2] = static_cast<uint32_t>(ns);
+    out[3] = static_cast<uint32_t>(nd) | (static_cast<uint32_t>(nr) << 16);
+    if (nh < batch_size)
+        std::memset(hot + nh * FP_HOT_WORDS, 0,
+                    (batch_size - nh) * FP_HOT_WORDS * sizeof(uint32_t));
+    if (nd < dns_cap)
+        std::memset(dnsl + nd, 0, (dns_cap - nd) * sizeof(uint32_t));
+    if (nr < drop_cap)
+        std::memset(dropl + nr * 2, 0, (drop_cap - nr) * 2 * sizeof(uint32_t));
+    if (nk < nk_cap)
+        std::memset(nkl + nk * FP_NK_WORDS, 0,
+                    (nk_cap - nk) * FP_NK_WORDS * sizeof(uint32_t));
+    if (ns < spill_cap)
+        std::memset(spill + ns * FP_DENSE_WORDS, 0,
+                    (spill_cap - ns) * FP_DENSE_WORDS * sizeof(uint32_t));
+    return static_cast<int64_t>(i - start);
+}
+
 static inline void merge_times(uint64_t *dfirst, uint64_t *dlast,
                                uint64_t sfirst, uint64_t slast) {
     if (*dfirst == 0 || (sfirst != 0 && sfirst < *dfirst))
@@ -514,7 +810,32 @@ static void crc32c_init() {
     crc32c_ready = true;
 }
 
+#if defined(__x86_64__)
+// Hardware CRC32C (SSE4.2) — ~10x the sliced table walk; the key
+// dictionary hashes 40 bytes per record, so this sits on the pack hot path.
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(const uint8_t *data, size_t n) {
+    uint64_t crc = 0xFFFFFFFFu;
+    size_t i = 0;
+    for (; n - i >= 8; i += 8) {
+        uint64_t v;
+        std::memcpy(&v, data + i, 8);
+        crc = __builtin_ia32_crc32di(crc, v);
+    }
+    for (; i < n; i++)
+        crc = __builtin_ia32_crc32qi(static_cast<uint32_t>(crc), data[i]);
+    return static_cast<uint32_t>(crc) ^ 0xFFFFFFFFu;
+}
+static int crc32c_have_hw = -1;
+#endif
+
 uint32_t fp_crc32c(const uint8_t *data, size_t n) {
+#if defined(__x86_64__)
+    if (crc32c_have_hw < 0)
+        crc32c_have_hw = __builtin_cpu_supports("sse4.2") ? 1 : 0;
+    if (crc32c_have_hw)
+        return crc32c_hw(data, n);
+#endif
     if (!crc32c_ready)
         crc32c_init();
     uint32_t crc = 0xFFFFFFFFu;
@@ -537,6 +858,6 @@ uint32_t fp_crc32c(const uint8_t *data, size_t n) {
     return crc ^ 0xFFFFFFFFu;
 }
 
-uint32_t fp_abi_version(void) { return 6; }
+uint32_t fp_abi_version(void) { return 7; }
 
 }  // extern "C"

@@ -28,6 +28,7 @@ from netobserv_tpu.config import (
     DEFAULT_DROP_Z, DEFAULT_SCAN_FANOUT, DEFAULT_SYNFLOOD_MIN,
     DEFAULT_SYNFLOOD_RATIO,
 )
+from netobserv_tpu.datapath import flowpack
 from netobserv_tpu.exporter.base import Exporter
 from netobserv_tpu.sketch import staging
 from netobserv_tpu.model.columnar import FlowBatch, unpack_key_words
@@ -329,23 +330,20 @@ class TpuSketchExporter(Exporter):
                 enable_fanout=self._cfg.enable_fanout,
                 enable_asym=self._cfg.enable_asym)
             self._roll = sk.make_roll_fn(self._cfg, decay_factor=decay_factor)
-            # single-device: v4-compact feed (~half the dense bytes — the
-            # host->device link is the bottleneck), dense fallback for
-            # batches whose non-v4 flows overflow the spill lane
-            spill_cap = staging.default_spill_cap(self._batch_size)
-            self._ring = staging.DenseStagingRing(
+            # single-device: resident-key feed (~15B/record — hot rows
+            # reference a device-resident key table by slot id; the
+            # host->device link is the bottleneck, byte budget in
+            # docs/tpu_sketch.md). Lane overflows continue into the next
+            # chunk; a full key dictionary rolls its epoch in the ring.
+            caps = flowpack.default_resident_caps(self._batch_size)
+            self._ring = staging.ResidentStagingRing(
                 self._batch_size,
-                sk.make_ingest_compact_fn(
-                    self._batch_size, spill_cap,
+                sk.make_ingest_resident_fn(
+                    self._batch_size, caps,
                     use_pallas=self._cfg.use_pallas, with_token=True,
                     enable_fanout=self._cfg.enable_fanout,
                     enable_asym=self._cfg.enable_asym),
-                spill_cap=spill_cap,
-                ingest_fallback=sk.make_ingest_dense_fn(
-                    use_pallas=self._cfg.use_pallas, with_token=True,
-                    enable_fanout=self._cfg.enable_fanout,
-                    enable_asym=self._cfg.enable_asym),
-                metrics=metrics, pack_threads=pack_threads)
+                caps=caps, metrics=metrics)
         # the staging ring packs the next batch while the previous
         # transfers/ingests are in flight; its slot-reuse tokens also bound
         # the async dispatch queue to the ring depth, so sustained overload

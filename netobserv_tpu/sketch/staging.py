@@ -142,3 +142,98 @@ class DenseStagingRing:
         for tok in self._tokens:
             if tok is not None:
                 jax.block_until_ready(tok)
+
+
+class ResidentStagingRing:
+    """Staging ring for the RESIDENT feed — the lowest-bytes-per-record host
+    path (~15B/record vs the compact feed's 40B; byte budget in
+    docs/tpu_sketch.md). The host keeps a key->slot dictionary
+    (`flowpack.KeyDict`, native); the device keeps the matching key table
+    (`sketch.state.init_key_table`) threaded through the jitted ingest.
+
+    `ingest` must be `make_ingest_resident_fn(with_token=True)`:
+    `(state, table, flat) -> (state, table, token)`. The packer packs until
+    a lane fills and reports how many rows it consumed; the ring ships that
+    (always self-consistent) prefix and continues from the stop point in
+    the next slot — so the dictionary and the device table learn
+    monotonically even under cold-start key floods, with no dense fallback
+    and no rollback. A full dictionary starts a fresh epoch (reset) at the
+    next fold: stale device-table rows are harmless because every live
+    slot is redefined through the new-key lane before any hot row
+    references it."""
+
+    def __init__(self, batch_size: int, ingest: Callable,
+                 caps=None, slot_cap: int = 1 << 18,
+                 put: Optional[Callable] = None, n_slots: int = 4,
+                 metrics=None):
+        import jax
+
+        from netobserv_tpu.sketch import state as sk
+
+        self.batch_size = batch_size
+        self.caps = caps or flowpack.default_resident_caps(batch_size)
+        self.slot_cap = slot_cap
+        self.kdict = flowpack.KeyDict(slot_cap)
+        self.key_table = jax.device_put(sk.init_key_table(slot_cap))
+        self._ingest = ingest
+        self._put = put or jax.device_put
+        self._metrics = metrics
+        self.stalls = 0
+        self.continuations = 0  # extra chunks beyond one per fold()
+        self.dict_resets = 0    # full-dictionary epochs
+        self.spill_rows = 0     # rows that rode the full-width spill lane
+        total = flowpack.resident_buf_len(batch_size, self.caps)
+        self._bufs = [np.empty(total, np.uint32) for _ in range(n_slots)]
+        self._tokens: list = [None] * n_slots
+        self._slot = 0
+
+    def fold(self, state, events, extra=None, dns=None, drops=None,
+             xlat=None, quic=None):
+        """Pack `events` (possibly in several chunks) into free ring slots,
+        ship and ingest each; returns the new sketch state (async — not
+        blocked on)."""
+        import jax
+
+        feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat, quic=quic)
+        n = len(events)
+        if n == 0:
+            return state
+        start = 0
+        first = True
+        while start < n:
+            if self.kdict.count() >= self.slot_cap:
+                # epoch roll: the device table needs no reset — every live
+                # slot is redefined before any hot row references it
+                self.kdict.reset()
+                self.dict_resets += 1
+            slot = self._slot
+            tok = self._tokens[slot]
+            if tok is not None:
+                if not tok.is_ready():
+                    self.stalls += 1
+                    if self._metrics is not None:
+                        self._metrics.sketch_staging_stalls_total.inc()
+                jax.block_until_ready(tok)
+            buf, consumed = flowpack.pack_resident(
+                events, batch_size=self.batch_size, kdict=self.kdict,
+                caps=self.caps, start=start, out=self._bufs[slot], **feats)
+            if consumed == 0 and n:
+                raise RuntimeError("resident pack made no progress")
+            self.spill_rows += int(buf[2])
+            if not first:
+                self.continuations += 1
+            first = False
+            start += consumed
+            state, self.key_table, self._tokens[slot] = self._ingest(
+                state, self.key_table, self._put(buf))
+            self._slot = (slot + 1) % len(self._bufs)
+        return state
+
+    def drain(self) -> None:
+        """Block until every in-flight batch has been fully ingested (host
+        buffers are then free; used before checkpoint/window close)."""
+        import jax
+
+        for tok in self._tokens:
+            if tok is not None:
+                jax.block_until_ready(tok)

@@ -515,6 +515,111 @@ def make_ingest_compact_fn(batch_size: int, spill_cap: int,
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
+RESIDENT_HDR = 4   # layout twins of flowpack.cc fp_pack_resident
+HOT_WORDS = 3
+NK_WORDS = 11
+
+
+def init_key_table(slot_cap: int) -> jax.Array:
+    """Device twin of the host KeyDict: (slot_cap, 10) u32 key words per
+    slot, updated from the new-key lane and gathered by hot-row slot id.
+    Auxiliary state — NOT part of SketchState (window rolls and checkpoints
+    leave it alone; a fresh process simply starts empty on both sides)."""
+    return jnp.zeros((slot_cap, KEY_WORDS), jnp.uint32)
+
+
+def resident_to_arrays(flat: jax.Array, key_table: jax.Array,
+                       batch_size: int, caps) -> tuple[dict, jax.Array]:
+    """Device-side unpack of the flowpack RESIDENT feed (layout pinned in
+    flowpack.cc fp_pack_resident; host packer flowpack.pack_resident).
+    Scatters the new-key lane into the key table FIRST — a slot referenced
+    by this batch's hot lane may have been defined by this same batch —
+    then gathers full 10-word keys by slot id, decodes the range-coded
+    rtt/dns codes, scatters the sparse dns/drop lanes onto their rows, and
+    concatenates the full-width spill lane. Returns (arrays, new_key_table)
+    for the ordinary ingest: all the row widening happens in HBM, the
+    transfer link only ever saw ~15 bytes/record (byte budget in
+    docs/tpu_sketch.md)."""
+    hot_off = RESIDENT_HDR
+    dns_off = hot_off + batch_size * HOT_WORDS
+    drop_off = dns_off + caps.dns
+    nk_off = drop_off + caps.drop * 2
+    spill_off = nk_off + caps.nk * NK_WORDS
+    hdr = flat[:RESIDENT_HDR]
+    hot = flat[hot_off:dns_off].reshape(batch_size, HOT_WORDS)
+    dnsl = flat[dns_off:drop_off]
+    dropl = flat[drop_off:nk_off].reshape(caps.drop, 2)
+    nk = flat[nk_off:spill_off].reshape(caps.nk, NK_WORDS)
+    spill = dense_to_arrays(flat[spill_off:].reshape(caps.spill, DENSE_WORDS))
+
+    slot_cap = key_table.shape[0]
+    nk_def = (nk[:, 0] >> 31) != 0
+    # undefined rows index out of range -> mode="drop" discards the write
+    nk_slot = jnp.where(nk_def, nk[:, 0] & jnp.uint32(0xFFFFF),
+                        jnp.uint32(slot_cap)).astype(jnp.int32)
+    key_table = key_table.at[nk_slot].set(nk[:, 1:], mode="drop")
+
+    w0 = hot[:, 0]
+    valid = (w0 >> 31) != 0
+    slots = (w0 & jnp.uint32(0xFFFFF)).astype(jnp.int32)
+    keys = key_table[slots]
+    rtt = (((w0 >> 20) & jnp.uint32(0xFF))
+           << (2 * ((w0 >> 28) & jnp.uint32(0x7)))).astype(jnp.int32)
+    w2 = hot[:, 2]
+    # sparse dns lane: unused entries are all-zero -> add 0 to row 0
+    d_idx = (dnsl >> 16).astype(jnp.int32)
+    d_val = ((dnsl & jnp.uint32(0xFFF))
+             << ((dnsl >> 12) & jnp.uint32(0xF))).astype(jnp.int32)
+    dns_arr = jnp.zeros((batch_size,), jnp.int32).at[d_idx].add(
+        d_val, mode="drop")
+    # sparse drop lane: bytes/packets scatter-add; cause scatter-max (a
+    # value, not a count — zero rows are no-ops under max as well)
+    r_idx = (dropl[:, 0] >> 16).astype(jnp.int32)
+    zeros_b = jnp.zeros((batch_size,), jnp.int32)
+    drop_bytes = zeros_b.at[r_idx].add(
+        (dropl[:, 1] & jnp.uint32(0xFFFF)).astype(jnp.int32), mode="drop")
+    drop_pkts = zeros_b.at[r_idx].add(
+        (dropl[:, 1] >> 16).astype(jnp.int32), mode="drop")
+    drop_cause = zeros_b.at[r_idx].max(
+        (dropl[:, 0] & jnp.uint32(0xFFFF)).astype(jnp.int32), mode="drop")
+    comp = {
+        "keys": keys,
+        "bytes": jax.lax.bitcast_convert_type(hot[:, 1], jnp.float32),
+        "packets": (w2 & jnp.uint32(0x7FF)).astype(jnp.int32),
+        "rtt_us": rtt,
+        "dns_latency_us": dns_arr,
+        "valid": valid,
+        "sampling": jnp.broadcast_to(hdr[0].astype(jnp.int32), (batch_size,)),
+        "tcp_flags": ((w2 >> 11) & jnp.uint32(0x7FF)).astype(jnp.int32),
+        "dscp": ((w2 >> 22) & jnp.uint32(0x3F)).astype(jnp.int32),
+        "markers": (w2 >> 28).astype(jnp.int32),
+        "drop_bytes": drop_bytes,
+        "drop_packets": drop_pkts,
+        "drop_cause": drop_cause,
+    }
+    arrays = {k: jnp.concatenate([comp[k], spill[k]], axis=0) for k in comp}
+    return arrays, key_table
+
+
+def make_ingest_resident_fn(batch_size: int, caps,
+                            donate: bool = True,
+                            use_pallas: bool | None = None,
+                            with_token: bool = False,
+                            enable_fanout: bool = True,
+                            enable_asym: bool = True):
+    """Jitted `(state, key_table, flat resident feed) -> (state, key_table
+    [, token])` — the lowest-bytes-per-record host feed (see
+    resident_to_arrays / flowpack.pack_resident). The key table is threaded
+    alongside the sketch state (both donated) so table updates are in-place
+    HBM scatters."""
+    def fn(s, table, flat):
+        arrays, table = resident_to_arrays(flat, table, batch_size, caps)
+        s = ingest(s, arrays, use_pallas=use_pallas,
+                   enable_fanout=enable_fanout, enable_asym=enable_asym)
+        return (s, table, flat[:1]) if with_token else (s, table)
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+
+
 def make_ingest_dense_fn(donate: bool = True,
                          use_pallas: bool | None = None,
                          with_token: bool = False,

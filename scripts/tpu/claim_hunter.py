@@ -1,8 +1,17 @@
 """Persistent TPU claim hunter: retry the axon backend until a chip lands,
-then immediately run the benchmark on it (default + --pallas) and record the
-output. Never kills a claim in flight — failed/hung probes are waited out.
+then immediately run the full evidence set on it and record the output:
 
-Run detached: nohup python .tpu_probe/hunter.py &
+  1. `python bench.py` (auto path — the shipped configuration; a successful
+     run refreshes scripts/tpu/last_good_tpu.json, the cache bench.py embeds
+     as `cached_tpu_result` if a later driver-time run hits a tunnel outage)
+  2. `python bench.py --scatter` (the pallas-vs-scatter A/B arm)
+  3. `python benchmarks/ingest_stage_profile.py` (per-signal ablation table
+     for docs/tpu_sketch.md)
+
+Never kills a claim in flight — failed/hung probes are waited out (killing a
+claim mid-flight wedges the tunnel for ~25 min; see CLAUDE.md).
+
+Run detached: nohup python scripts/tpu/claim_hunter.py &
 """
 
 import os
@@ -11,9 +20,10 @@ import sys
 import time
 
 BASE = os.path.dirname(os.path.abspath(__file__))
-REPO = os.path.dirname(BASE)
+REPO = os.path.dirname(os.path.dirname(BASE))
 LOG = os.path.join(BASE, "hunter.log")
 BENCH_OUT = os.path.join(BASE, "bench_tpu.out")
+PROFILE_OUT = os.path.join(BASE, "profile_tpu.out")
 
 
 def say(msg: str) -> None:
@@ -21,8 +31,18 @@ def say(msg: str) -> None:
         fh.write(f"[{time.strftime('%H:%M:%S')}] {msg}\n")
 
 
+def run_logged(label: str, cmd: list[str], out_path: str, env) -> int:
+    with open(out_path, "a") as fh:
+        fh.write(f"\n=== {label} ===\n")
+        fh.flush()
+        rc = subprocess.run(cmd, stdout=fh, stderr=fh, env=env,
+                            cwd=REPO).returncode
+        fh.write(f"[{label} rc={rc}]\n")
+    return rc
+
+
 def main() -> None:
-    say(f"hunter start pid={os.getpid()}")
+    say(f"hunter start pid={os.getpid()} repo={REPO}")
     attempt = 0
     while True:
         attempt += 1
@@ -40,31 +60,28 @@ def main() -> None:
             env = dict(os.environ)
             env.pop("JAX_PLATFORMS", None)
             env["BENCH_TPU_PROBE_TIMEOUT"] = "1200"
-            with open(BENCH_OUT, "a") as fh:
-                fh.write(f"\n=== attempt {attempt} default path ===\n")
-                fh.flush()
-                # force --scatter: the flag-less default is now AUTO
-                # (pallas on TPU at production width), which would make
-                # this A/B measure pallas against itself
-                rc1 = subprocess.run(
-                    [sys.executable, "bench.py", "--scatter"],
-                    stdout=fh, stderr=fh, env=env, cwd=REPO).returncode
-                fh.write(f"[bench --scatter rc={rc1}]\n"
-                         f"\n=== attempt {attempt} pallas path ===\n")
-                fh.flush()
-                rc2 = subprocess.run(
-                    [sys.executable, "bench.py", "--pallas"], stdout=fh,
-                    stderr=fh, env=env, cwd=REPO).returncode
-                fh.write(f"[bench --pallas rc={rc2}]\n")
-            say(f"attempt {attempt}: bench done rc={rc1}/{rc2}")
+            rc1 = run_logged(f"attempt {attempt} auto (shipped) path",
+                             [sys.executable, "bench.py"], BENCH_OUT, env)
+            say(f"attempt {attempt}: bench auto rc={rc1}")
+            rc2 = run_logged(f"attempt {attempt} scatter A/B",
+                             [sys.executable, "bench.py", "--scatter"],
+                             BENCH_OUT, env)
+            say(f"attempt {attempt}: bench --scatter rc={rc2}")
+            rc3 = run_logged(f"attempt {attempt} stage profile",
+                             [sys.executable,
+                              "benchmarks/ingest_stage_profile.py"],
+                             PROFILE_OUT, env)
+            say(f"attempt {attempt}: stage profile rc={rc3}")
             if rc1 == 0:
-                say("hunter exiting: on-chip bench captured")
+                say("hunter exiting: on-chip bench captured "
+                    "(last_good_tpu.json refreshed)")
                 return
             say("bench failed on the claimed chip; continuing to hunt")
         else:
             err_tail = (r.stderr or "").strip().splitlines()
             say(f"attempt {attempt}: failed after {dt:.0f}s "
-                f"rc={r.returncode} ({err_tail[-1] if err_tail else 'no stderr'})")
+                f"rc={r.returncode} "
+                f"({err_tail[-1] if err_tail else 'no stderr'})")
         time.sleep(120)
 
 
