@@ -1,0 +1,77 @@
+#!/usr/bin/env bash
+# IPFIX-variant Kind e2e: agent DaemonSet with EXPORT=ipfix+udp -> the
+# in-repo collector example learns the v4/v6 templates and prints decoded
+# flows; the host asserts per-flow byte accounting from its logs. The
+# reference's bar: e2e/ipfix/ipfix_test.go:23-30.
+set -euo pipefail
+cd "$(dirname "$0")/../../.."
+
+CLUSTER=netobserv-e2e-ipfix
+N_PKTS=9
+PAYLOAD=100
+
+echo "=== build agent image"
+docker build -t netobserv-tpu-agent:e2e -f e2e/cluster/kind/Dockerfile .
+
+echo "=== kind cluster"
+kind delete cluster --name "$CLUSTER" 2>/dev/null || true
+kind create cluster --name "$CLUSTER" --wait 120s
+kind load docker-image netobserv-tpu-agent:e2e --name "$CLUSTER"
+
+cleanup() { kind delete cluster --name "$CLUSTER" || true; }
+trap cleanup EXIT
+
+echo "=== deploy stack (ipfix collector + agent EXPORT=ipfix+udp)"
+kubectl apply -f e2e/cluster/kind/manifests_ipfix.yml
+kubectl -n netobserv-e2e wait --for=condition=ready pod/ipfix-collector \
+  --timeout=180s
+kubectl -n netobserv-e2e rollout status ds/agent --timeout=180s
+kubectl -n netobserv-e2e wait --for=condition=ready pod/server pod/pinger \
+  --timeout=180s
+
+SERVER_IP=$(kubectl -n netobserv-e2e get pod server \
+  -o jsonpath='{.status.podIP}')
+PINGER_IP=$(kubectl -n netobserv-e2e get pod pinger \
+  -o jsonpath='{.status.podIP}')
+echo "pinger=$PINGER_IP server=$SERVER_IP"
+
+echo "=== drive traffic ($N_PKTS x ${PAYLOAD}B UDP)"
+kubectl -n netobserv-e2e exec pinger -- python -c "
+import socket, time
+s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+s.bind(('0.0.0.0', 47000))
+for _ in range($N_PKTS):
+    s.sendto(b'x' * $PAYLOAD, ('$SERVER_IP', 7777))
+    time.sleep(0.1)
+"
+
+echo "=== assert per-flow accounting from the collector's decoded stream"
+python - <<PYEOF
+import re, subprocess, sys, time
+
+n_pkts, payload = $N_PKTS, $PAYLOAD
+expected = n_pkts * (payload + 8 + 20 + 14)
+deadline = time.time() + 120
+pkts = bts = 0
+while time.time() < deadline:
+    logs = subprocess.run(
+        ["kubectl", "-n", "netobserv-e2e", "logs", "ipfix-collector"],
+        capture_output=True, text=True).stdout
+    pkts = bts = 0
+    for line in logs.splitlines():
+        kv = dict(p.split("=", 1) for p in line.split() if "=" in p)
+        if (kv.get("srcV4") == "$PINGER_IP"
+                and kv.get("dstV4") == "$SERVER_IP"
+                and kv.get("dstPort") == "7777"):
+            pkts += int(kv.get("packets", 0))
+            bts += int(kv.get("bytes", 0))
+    print(f"seen: {pkts} packets / {bts} bytes", flush=True)
+    if pkts >= n_pkts:
+        break
+    time.sleep(3)
+assert pkts == n_pkts, f"packets {pkts} != {n_pkts}"
+assert bts == expected, f"bytes {bts} != {expected}"
+print(f"PASS: ipfix path per-flow accounting exact "
+      f"({pkts} packets, {bts} bytes)")
+PYEOF
+echo "=== ipfix cluster e2e OK"

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Kafka-variant Kind e2e: agent DaemonSet with EXPORT=kafka -> single-node
+# KRaft Kafka -> in-cluster consumer (the repo's pure-python Fetch client)
+# decodes pbflow records off the topic and the host asserts per-flow byte
+# accounting. The reference's bar: e2e/kafka/kafka_test.go:32-60 (agent ->
+# Strimzi Kafka -> FLP transformer -> Loki; here the consumer does the
+# topic-side assertion directly).
+set -euo pipefail
+cd "$(dirname "$0")/../../.."
+
+CLUSTER=netobserv-e2e-kafka
+N_PKTS=9
+PAYLOAD=100
+
+echo "=== build agent image"
+docker build -t netobserv-tpu-agent:e2e -f e2e/cluster/kind/Dockerfile .
+
+echo "=== kind cluster"
+kind delete cluster --name "$CLUSTER" 2>/dev/null || true
+kind create cluster --name "$CLUSTER" --wait 120s
+kind load docker-image netobserv-tpu-agent:e2e --name "$CLUSTER"
+
+cleanup() { kind delete cluster --name "$CLUSTER" || true; }
+trap cleanup EXIT
+
+echo "=== deploy stack (KRaft kafka + agent EXPORT=kafka + traffic pods)"
+kubectl apply -f e2e/cluster/kind/manifests_kafka.yml
+kubectl -n netobserv-e2e wait --for=condition=ready pod -l app=kafka \
+  --timeout=300s
+kubectl -n netobserv-e2e rollout status ds/agent --timeout=180s
+kubectl -n netobserv-e2e wait --for=condition=ready pod/server pod/pinger \
+  pod/consumer --timeout=180s
+
+SERVER_IP=$(kubectl -n netobserv-e2e get pod server \
+  -o jsonpath='{.status.podIP}')
+PINGER_IP=$(kubectl -n netobserv-e2e get pod pinger \
+  -o jsonpath='{.status.podIP}')
+echo "pinger=$PINGER_IP server=$SERVER_IP"
+
+echo "=== drive traffic ($N_PKTS x ${PAYLOAD}B UDP)"
+kubectl -n netobserv-e2e exec pinger -- python -c "
+import socket, time
+s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+s.bind(('0.0.0.0', 47000))
+for _ in range($N_PKTS):
+    s.sendto(b'x' * $PAYLOAD, ('$SERVER_IP', 7777))
+    time.sleep(0.1)
+"
+
+echo "=== consume the topic and assert per-flow accounting"
+kubectl -n netobserv-e2e exec consumer -- python - <<PYEOF
+import json, sys, time
+from netobserv_tpu.kafka.consumer import KafkaConsumer
+from netobserv_tpu.exporter.pb_convert import pb_to_record
+from netobserv_tpu.pb import flow_pb2
+
+n_pkts, payload = $N_PKTS, $PAYLOAD
+expected = n_pkts * (payload + 8 + 20 + 14)
+consumer = KafkaConsumer(
+    brokers=["kafka.netobserv-e2e.svc.cluster.local:9092"],
+    topic="network-flows")
+deadline = time.time() + 120
+pkts = bts = 0
+while time.time() < deadline:
+    for _key, value in consumer.poll(max_wait_ms=1000):
+        pb = flow_pb2.Record()
+        pb.ParseFromString(value)
+        r = pb_to_record(pb)
+        if (r.key.src == "$PINGER_IP" and r.key.dst == "$SERVER_IP"
+                and r.key.dst_port == 7777):
+            pkts += r.packets
+            bts += r.bytes_
+    print(f"seen: {pkts} packets / {bts} bytes", flush=True)
+    if pkts >= n_pkts:
+        break
+    time.sleep(3)
+assert pkts == n_pkts, f"packets {pkts} != {n_pkts}"
+assert bts == expected, f"bytes {bts} != {expected}"
+print(f"PASS: kafka path per-flow accounting exact "
+      f"({pkts} packets, {bts} bytes)")
+PYEOF
+echo "=== kafka cluster e2e OK"

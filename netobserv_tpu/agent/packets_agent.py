@@ -49,7 +49,10 @@ class PacketsAgent:
         self.cfg = cfg
         self.fetcher = fetcher
         self.exporter = exporter or GRPCPacketExporter(
-            cfg.target_host, cfg.target_port)
+            cfg.target_host, cfg.target_port,
+            tls_ca=cfg.target_tls_ca_cert_path,
+            tls_cert=cfg.target_tls_user_cert_path,
+            tls_key=cfg.target_tls_user_key_path)
         buf = cfg.buffers_length
         self._pkt_q: "queue.Queue[PacketRecord]" = queue.Queue(maxsize=buf * 10)
         self._batch_q: "queue.Queue[list[PacketRecord]]" = queue.Queue(maxsize=buf)

@@ -601,29 +601,37 @@ def pack_resident(events_raw: bytes | np.ndarray,
     out[0] = def_sampling
     if start >= n:
         return out, 0
-    kw_all = pack_key_words(events["key"])
-    fw_all = _feature_words(events["stats"], ex, xl, qc, dr)
+    # derived arrays over the REMAINDER only — a batch split into many
+    # continuation chunks must not recompute the full batch per chunk
+    sl = slice(start, n)
+    kw_rel = pack_key_words(events["key"][sl])
+    fw_rel = _feature_words(events["stats"][sl],
+                            ex[sl] if ex is not None else None,
+                            xl[sl] if xl is not None else None,
+                            qc[sl] if qc is not None else None,
+                            dr[sl] if dr is not None else None)
     stats = events["stats"]
     # u32 wrap matches the native cast (and the dense path's u32 column)
-    rtt_all = ((ex["rtt_ns"] // 1000).astype(np.uint32) if ex is not None
-               else np.zeros(n, np.uint32))
-    dlat_all = ((dn["latency_ns"] // 1000).astype(np.uint64) if dn is not None
-                else np.zeros(n, np.uint64))
+    rtt_rel = ((ex["rtt_ns"][sl] // 1000).astype(np.uint32)
+               if ex is not None else np.zeros(n - start, np.uint32))
+    dlat_rel = ((dn["latency_ns"][sl] // 1000).astype(np.uint64)
+                if dn is not None else np.zeros(n - start, np.uint64))
     py = kdict._py
     nh = nd = nr = nk = ns = 0
     i = start
     while i < n and nh < batch_size:
-        kb = kw_all[i].tobytes()
+        j = i - start
+        kb = kw_rel[j].tobytes()
         slot = py.get(kb)
         if slot is None and nk < caps.nk and len(py) < kdict.slot_cap:
             slot = len(py)
             py[kb] = slot
             row = nk_off + nk * NK_WORDS
             out[row] = 0x80000000 | slot
-            out[row + 1:row + 11] = kw_all[i]
+            out[row + 1:row + 11] = kw_rel[j]
             nk += 1
-        rtt = int(rtt_all[i])
-        dlat = int(dlat_all[i])
+        rtt = int(rtt_rel[j])
+        dlat = int(dlat_rel[j])
         has_drops = dr is not None and bool(dr["bytes"][i] or dr["packets"][i])
         pk, fl = int(stats["packets"][i]), int(stats["tcp_flags"][i])
         hot_ok = (slot is not None and pk < 0x800 and fl < 0x800
@@ -638,7 +646,7 @@ def pack_resident(events_raw: bytes | np.ndarray,
             out[row + 1] = np.float32(stats["bytes"][i]).view(np.uint32)
             out[row + 2] = (pk | (fl << 11)
                             | (int(stats["dscp"][i]) << 22)
-                            | ((int(fw_all[i, 0]) >> 24) << 28))
+                            | ((int(fw_rel[j, 0]) >> 24) << 28))
             if dlat:
                 out[dns_off + nd] = (nh << 16) | _lat_code16(dlat)
                 nd += 1
@@ -653,14 +661,14 @@ def pack_resident(events_raw: bytes | np.ndarray,
             if ns >= caps.spill:
                 break  # chunk full: caller continues from row i
             row = spill_off + ns * DENSE_WORDS
-            out[row:row + 10] = kw_all[i]
+            out[row:row + 10] = kw_rel[j]
             out[row + 10] = np.float32(stats["bytes"][i]).view(np.uint32)
             out[row + 11] = pk
             out[row + 12] = rtt
             out[row + 13] = np.uint32(dlat)
             out[row + 14] = 1
             out[row + 15] = stats["sampling"][i]
-            out[row + 16:row + 20] = fw_all[i]
+            out[row + 16:row + 20] = fw_rel[j]
             ns += 1
         i += 1
     out[1], out[2], out[3] = nk, ns, nd | (nr << 16)

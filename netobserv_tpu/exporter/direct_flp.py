@@ -723,11 +723,88 @@ def _build_generic(params: dict) -> Stage:
     return stage
 
 
+class _KafkaEncode:
+    """FLP `encode kafka` (encode_kafka.go): each entry is JSON-serialized
+    and produced to a topic through the in-repo wire producer
+    (`kafka/producer.py`). Entries pass through to the rest of the
+    pipeline. Produce failures are logged and dropped — a dead broker must
+    not wedge the eviction loop (exporters never crash the pipeline)."""
+
+    def __init__(self, params: dict, producer=None):
+        self._params = params
+        self._producer = producer  # tests inject; lazily built otherwise
+        self._pending: list[tuple[None, bytes]] = []
+
+    def _ensure_producer(self):
+        if self._producer is None:
+            from netobserv_tpu.kafka.producer import KafkaProducer
+            address = self._params.get("address", "localhost:9092")
+            self._producer = KafkaProducer(
+                brokers=[address],
+                topic=self._params.get("topic", "network-flows"))
+        return self._producer
+
+    def __call__(self, entry: dict) -> dict:
+        self._pending.append(
+            (None, json.dumps(entry, separators=(",", ":")).encode()))
+        return entry
+
+    def sweep(self) -> list:
+        if self._pending:
+            batch, self._pending = self._pending, []
+            try:
+                self._ensure_producer().send_batch(batch)
+            except Exception as exc:
+                log.warning("FLP kafka encode failed (%s); %d entries "
+                            "dropped from the topic (pipeline continues)",
+                            exc, len(batch))
+        return []
+
+    def close(self) -> None:
+        if self._producer is not None:
+            self._producer.close()
+
+
+class _IPFIXWrite:
+    """FLP `write ipfix` (write_ipfix.go): the entry stream becomes IPFIX
+    data records through the in-repo exporter (`exporter/ipfix.py`, v4/v6
+    templates, MTU split, TCP template re-send). Terminal stage. The
+    exporter is built lazily inside the try-guarded push — a temporarily
+    unreachable TCP collector must not crash agent startup (exporters
+    never crash the pipeline)."""
+
+    def __init__(self, params: dict, exporter=None):
+        self._params = params
+        self._exp = exporter
+
+    def _ensure_exporter(self):
+        if self._exp is None:
+            from netobserv_tpu.exporter.ipfix import IPFIXExporter
+            self._exp = IPFIXExporter(
+                self._params.get("targetHost", "localhost"),
+                int(self._params.get("targetPort", 4739)),
+                transport=str(self._params.get("transport", "udp")).lower())
+        return self._exp
+
+    def push(self, entries: list[dict]) -> None:
+        from netobserv_tpu.exporter.flp_map import map_to_record
+        try:
+            self._ensure_exporter().export_batch(
+                [map_to_record(e) for e in entries])
+        except Exception as exc:
+            log.warning("FLP ipfix write failed (%s); %d records dropped",
+                        exc, len(entries))
+
+    def close(self) -> None:
+        if self._exp is not None:
+            self._exp.close()
+
+
 class DirectFLPExporter(Exporter):
     name = "direct-flp"
 
     def __init__(self, flp_config: str = "", stream=None, prom_registry=None,
-                 kube_source=None, location_db=None):
+                 kube_source=None, location_db=None, kafka_producer=None):
         from prometheus_client import CollectorRegistry
 
         self._stream = stream if stream is not None else sys.stdout
@@ -740,6 +817,7 @@ class DirectFLPExporter(Exporter):
         # pluggable enrichment backends (exporter.flp_enrich protocols)
         self._kube_source = kube_source
         self._location_db = location_db
+        self._kafka_producer = kafka_producer  # tests inject a wired producer
         if flp_config.strip():
             self._build(yaml.safe_load(flp_config))
 
@@ -779,6 +857,10 @@ class DirectFLPExporter(Exporter):
                     self._stages.append(
                         _build_prom(e.get("prom", {}), self.prom_registry,
                                     self._prom_names))
+                elif e.get("type") == "kafka":
+                    self._stages.append(
+                        _KafkaEncode(e.get("kafka", {}),
+                                     producer=self._kafka_producer))
                 else:
                     log.warning("unsupported encode type %r ignored",
                                 e.get("type"))
@@ -786,6 +868,8 @@ class DirectFLPExporter(Exporter):
                 wtype = p["write"].get("type", "stdout")
                 if wtype == "loki":
                     self._writer = _LokiWriter(p["write"].get("loki", {}))
+                elif wtype == "ipfix":
+                    self._writer = _IPFIXWrite(p["write"].get("ipfix", {}))
                 elif wtype != "stdout":
                     log.warning("write type %r unsupported; using stdout", wtype)
             elif "ingest" in p or not p:
@@ -837,6 +921,14 @@ class DirectFLPExporter(Exporter):
             except Exception as exc:
                 log.warning("shutdown flush failed (%s); remaining "
                             "connection records dropped", exc)
+        # release stage/writer transports (kafka producer, ipfix socket)
+        for closer in (*self._stages, self._writer):
+            close = getattr(closer, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception as exc:
+                    log.warning("stage close failed: %s", exc)
 
 
 class _LokiWriter:

@@ -103,3 +103,47 @@ def record_to_map(r: Record) -> dict:
         out["QuicLongHdr"] = f.quic_seen_long_hdr
         out["QuicShortHdr"] = f.quic_seen_short_hdr
     return out
+
+
+def _parse_mac(v) -> bytes:
+    if isinstance(v, bytes):
+        return (v + b"\x00" * 6)[:6]
+    try:
+        return bytes(int(p, 16) for p in str(v).split(":"))[:6].ljust(6, b"\x00")
+    except ValueError:
+        return b"\x00" * 6
+
+
+def map_to_record(entry: dict) -> Record:
+    """Inverse of `record_to_map` for the fields the wire exporters carry
+    (IPFIX templates, pbflow) — lets FLP write stages reuse the Record-based
+    exporters on an entry stream that has passed through transform stages.
+    Unknown/enriched keys are ignored; missing keys default to zero values
+    (same tolerance as the reference's generic-map decode,
+    pkg/decode/decode_protobuf.go)."""
+    from netobserv_tpu.model.flow import FlowKey, ip_to_16
+
+    key = FlowKey(
+        src_ip=ip_to_16(entry.get("SrcAddr", "0.0.0.0")),
+        dst_ip=ip_to_16(entry.get("DstAddr", "0.0.0.0")),
+        src_port=int(entry.get("SrcPort", 0)),
+        dst_port=int(entry.get("DstPort", 0)),
+        proto=int(entry.get("Proto", 0)),
+        icmp_type=int(entry.get("IcmpType", 0)),
+        icmp_code=int(entry.get("IcmpCode", 0)))
+    return Record(
+        key=key,
+        bytes_=int(entry.get("Bytes", 0)),
+        packets=int(entry.get("Packets", 0)),
+        eth_protocol=int(entry.get("Etype", 0)),
+        tcp_flags=int(entry.get("Flags", 0)),
+        direction=int(entry.get("FlowDirection", 0)),
+        src_mac=_parse_mac(entry.get("SrcMac", "")),
+        dst_mac=_parse_mac(entry.get("DstMac", "")),
+        interface=str(entry.get("Interface", "")),
+        dscp=int(entry.get("Dscp", 0)),
+        sampling=int(entry.get("Sampling", 0)),
+        time_flow_start_ns=int(entry.get("TimeFlowStartMs", 0)) * 1_000_000,
+        time_flow_end_ns=int(entry.get("TimeFlowEndMs", 0)) * 1_000_000,
+        agent_ip=str(entry.get("AgentIP", "")),
+    )

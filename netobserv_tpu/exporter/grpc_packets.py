@@ -2,6 +2,8 @@
 
 Reference analog: `pkg/exporter/grpc_packets.go` — the pcap file header goes
 out once, then each packet as a pcap-framed chunk wrapped in pbpacket.Packet.
+TLS/mTLS options mirror the flow client (reference
+`pkg/grpc/packet/client.go` takes the same credentials as the flow side).
 """
 
 from __future__ import annotations
@@ -12,6 +14,7 @@ from typing import Optional
 import grpc
 from google.protobuf import any_pb2, wrappers_pb2
 
+from netobserv_tpu.grpc.flow import _channel_credentials
 from netobserv_tpu.model.packet_record import (
     PacketRecord, frame_packet, pcap_file_header,
 )
@@ -23,8 +26,13 @@ _SEND = "/pbpacket.Collector/Send"
 
 
 class PacketClient:
-    def __init__(self, host: str, port: int):
-        self._channel = grpc.insecure_channel(f"{host}:{port}")
+    def __init__(self, host: str, port: int, tls_ca: str = "",
+                 tls_cert: str = "", tls_key: str = ""):
+        creds = _channel_credentials(tls_ca, tls_cert, tls_key)
+        target = f"{host}:{port}"
+        self._channel = (grpc.secure_channel(target, creds)
+                         if creds is not None
+                         else grpc.insecure_channel(target))
         self._send = self._channel.unary_unary(
             _SEND,
             request_serializer=packet_pb2.Packet.SerializeToString,
@@ -45,8 +53,11 @@ class GRPCPacketExporter:
     name = "grpc-packets"
 
     def __init__(self, host: str, port: int,
-                 client: Optional[PacketClient] = None):
-        self._client = client or PacketClient(host, port)
+                 client: Optional[PacketClient] = None,
+                 tls_ca: str = "", tls_cert: str = "", tls_key: str = ""):
+        self._client = client or PacketClient(host, port, tls_ca=tls_ca,
+                                              tls_cert=tls_cert,
+                                              tls_key=tls_key)
         self._sent_header = False
 
     def export_packets(self, packets: list[PacketRecord]) -> None:
@@ -60,7 +71,8 @@ class GRPCPacketExporter:
         self._client.close()
 
 
-def start_packet_collector(port: int = 0, out=None):
+def start_packet_collector(port: int = 0, out=None,
+                           tls_cert: str = "", tls_key: str = ""):
     """In-process pbpacket collector for tests/examples; returns
     (server, bound_port, queue-of-bytes)."""
     import queue as _queue
@@ -82,6 +94,11 @@ def start_packet_collector(port: int = 0, out=None):
             response_serializer=packet_pb2.CollectorReply.SerializeToString)})
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
     server.add_generic_rpc_handlers((handler,))
-    bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    if tls_cert and tls_key:
+        creds = grpc.ssl_server_credentials(
+            [(open(tls_key, "rb").read(), open(tls_cert, "rb").read())])
+        bound = server.add_secure_port(f"0.0.0.0:{port}", creds)
+    else:
+        bound = server.add_insecure_port(f"0.0.0.0:{port}")
     server.start()
     return server, bound, out

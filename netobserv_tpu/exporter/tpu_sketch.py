@@ -335,6 +335,10 @@ class TpuSketchExporter(Exporter):
             # host->device link is the bottleneck, byte budget in
             # docs/tpu_sketch.md). Lane overflows continue into the next
             # chunk; a full key dictionary rolls its epoch in the ring.
+            if pack_threads > 1:
+                log.info("SKETCH_PACK_THREADS=%d applies to the sharded "
+                         "dense feed only; the single-device resident pack "
+                         "is single-threaded (~30M rec/s)", pack_threads)
             caps = flowpack.default_resident_caps(self._batch_size)
             self._ring = staging.ResidentStagingRing(
                 self._batch_size,

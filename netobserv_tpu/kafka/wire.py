@@ -144,3 +144,20 @@ class Reader:
         if n < 0:
             return None
         return self._take(n)
+
+
+def unzigzag(u: int) -> int:
+    return (u >> 1) ^ -(u & 1)
+
+
+def read_varint(data: bytes, off: int) -> tuple[int, int]:
+    """Decode one zigzag varint at `off`; returns (value, next_off)."""
+    shift = 0
+    u = 0
+    while True:
+        b = data[off]
+        off += 1
+        u |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return unzigzag(u), off
+        shift += 7

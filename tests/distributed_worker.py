@@ -73,6 +73,48 @@ def main() -> None:
     print(f"DIST_OK records={total:.0f} procs={jax.process_count()} "
           f"mesh={dict(mesh.shape)}", flush=True)
 
+    # optional volume leg (__graft_entry__._spanning_mesh_check): push a
+    # zipf stream through the spanning mesh and assert recall vs the exact
+    # oracle — every process computes the same oracle from the same seed
+    n_volume = int(os.environ.get("NETOBSERV_WORKER_RECORDS", "0"))
+    if n_volume <= 0:
+        return
+    batch = 2048
+    n_distinct = 4000
+    vrng = np.random.default_rng(99)
+    universe = vrng.integers(0, 2**32, (n_distinct, 10), dtype=np.uint32)
+    exact = np.zeros(n_distinct, np.float64)
+    steps = max(1, n_volume // batch)
+    dist = pmerge.init_dist_state(cfg, mesh)
+    vingest = pmerge.make_sharded_ingest_fn(mesh, cfg)
+    for _ in range(steps):
+        ranks = np.minimum(vrng.zipf(1.2, batch) - 1, n_distinct - 1)
+        byts = vrng.integers(64, 9000, batch).astype(np.float32)
+        np.add.at(exact, ranks, byts.astype(np.float64))
+        varrays = {
+            "keys": universe[ranks],
+            "bytes": byts,
+            "packets": vrng.integers(1, 10, batch).astype(np.int32),
+            "rtt_us": np.zeros(batch, np.int32),
+            "dns_latency_us": np.zeros(batch, np.int32),
+            "sampling": np.zeros(batch, np.int32),
+            "valid": np.ones(batch, np.bool_),
+        }
+        dist = vingest(dist, pmerge.shard_batch(mesh, varrays))
+        jax.block_until_ready(dist)
+    dist, vreport = merge_fn(dist)
+    jax.block_until_ready((dist, vreport))
+    vtotal = float(vreport.total_records)
+    assert vtotal == steps * batch, (vtotal, steps * batch)
+    k = 20
+    true_top = np.argsort(exact)[::-1][:k]
+    got = {tuple(w) for w, v in zip(np.asarray(vreport.heavy.words),
+                                    np.asarray(vreport.heavy.valid)) if v}
+    recall = sum(tuple(universe[t]) in got for t in true_top) / k
+    assert recall >= 0.85, f"spanning-mesh recall@{k} {recall:.2f}"
+    print(f"DIST_VOLUME_OK records={vtotal:.0f} recall@{k}={recall:.3f} "
+          f"procs={jax.process_count()} mesh={dict(mesh.shape)}", flush=True)
+
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -249,3 +249,47 @@ class TestKafkaWire:
         a = make_record(src="10.0.0.1", dst="10.0.0.2")
         b = make_record(src="10.0.0.2", dst="10.0.0.1")
         assert partition_key(a) == partition_key(b)
+
+
+def test_ipfix_collector_example_decodes_exporter_stream():
+    """The Kind IPFIX suite's assertion path, offline: the collector
+    example's template learner + data parser decode the exporter's UDP
+    stream into the key=value lines run_ipfix.sh greps (reference bar:
+    e2e/ipfix/ipfix_test.go)."""
+    import importlib.util
+    import os
+    import socket
+
+    from netobserv_tpu.exporter.ipfix import IPFIXExporter
+
+    spec = importlib.util.spec_from_file_location(
+        "ipfix_collector", os.path.join(
+            os.path.dirname(__file__), "..", "examples", "ipfix_collector.py"))
+    col = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(col)
+
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(3)
+    exp = IPFIXExporter("127.0.0.1", rx.getsockname()[1], transport="udp")
+    exp.export_batch([make_record(src="10.1.2.3", dst="10.4.5.6",
+                                  sport=47000, dport=7777, proto=17)])
+    templates: dict = {}
+    lines: list[str] = []
+    msg, _ = rx.recvfrom(65535)
+    off = 16
+    while off + 4 <= len(msg):
+        set_id, set_len = struct.unpack(">HH", msg[off:off + 4])
+        payload = msg[off + 4:off + set_len]
+        if set_id == 2:
+            col.parse_templates(payload, templates)
+        elif set_id in templates:
+            lines.extend(col.parse_data(payload, templates[set_id]))
+        off += max(set_len, 4)
+    exp.close()
+    rx.close()
+    assert lines, "no data records decoded"
+    kv = dict(p.split("=", 1) for p in lines[0].split() if "=" in p)
+    assert kv["srcV4"] == "10.1.2.3" and kv["dstV4"] == "10.4.5.6"
+    assert kv["dstPort"] == "7777"
+    assert int(kv["bytes"]) > 0 and int(kv["packets"]) > 0

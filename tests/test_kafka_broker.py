@@ -128,7 +128,66 @@ class FakeBroker(threading.Thread):
                 return None
             return struct.pack(">i", n_topics) + topic_resps + \
                 struct.pack(">i", 0)  # throttle
+        if api == 2:  # ListOffsets v1: every partition starts at 0
+            out = struct.pack(">i", 1)  # one topic
+            out += _kstr(self.topic)
+            parts = [pid for pid, _ in self._offset_req_parts(body)]
+            out += struct.pack(">i", len(parts))
+            for pid in parts:
+                out += struct.pack(">ihqq", pid, 0, -1, 0)
+            return out
+        if api == 1:  # Fetch v4: serve every batch produced so far
+            # body: replica(4) max_wait(4) min_bytes(4) max_bytes(4) iso(1)
+            off = 17
+            (n_topics,) = struct.unpack(">i", body[off:off + 4])
+            off += 4
+            out = struct.pack(">i", 0)  # throttle
+            out += struct.pack(">i", n_topics)
+            for _ in range(n_topics):
+                (tlen,) = struct.unpack(">h", body[off:off + 2])
+                name = body[off + 2:off + 2 + tlen]
+                off += 2 + tlen
+                (n_parts,) = struct.unpack(">i", body[off:off + 4])
+                off += 4
+                out += struct.pack(">h", tlen) + name
+                out += struct.pack(">i", n_parts)
+                for _ in range(n_parts):
+                    pid, fetch_off, _maxb = struct.unpack(
+                        ">iqi", body[off:off + 16])
+                    off += 16
+                    # rewrite base offsets so consecutive batches advance
+                    blob = b""
+                    base = 0
+                    for bpid, batch in self.produced:
+                        if bpid != pid:
+                            continue
+                        n_recs = struct.unpack(">i", batch[57:61])[0]
+                        if base >= fetch_off:
+                            blob += struct.pack(">q", base) + batch[8:]
+                        base += n_recs
+                    out += struct.pack(">ihqq", pid, 0, base, base)
+                    out += struct.pack(">i", 0)  # no aborted txns
+                    out += struct.pack(">i", len(blob)) + blob
+            return out
         raise AssertionError(f"unexpected api {api}")
+
+    @staticmethod
+    def _offset_req_parts(body):
+        # ListOffsets v1 body: replica(4), topics[(name, parts[(pid, ts)])]
+        off = 4
+        (n_topics,) = struct.unpack(">i", body[off:off + 4])
+        off += 4
+        parts = []
+        for _ in range(n_topics):
+            (tlen,) = struct.unpack(">h", body[off:off + 2])
+            off += 2 + tlen
+            (n_parts,) = struct.unpack(">i", body[off:off + 4])
+            off += 4
+            for _ in range(n_parts):
+                pid, ts = struct.unpack(">iq", body[off:off + 12])
+                off += 12
+                parts.append((pid, ts))
+        return parts
 
 
 @pytest.fixture
@@ -200,3 +259,77 @@ def test_exporter_through_fake_broker(broker):
     exp.close()
     total = sum(struct.unpack(">i", b[57:61])[0] for _p, b in broker.produced)
     assert total == 5
+
+def test_record_batch_roundtrip_through_consumer_decode():
+    """producer._record_batch -> consumer.decode_record_batches is an
+    identity on (key, value) pairs, both uncompressed and gzip."""
+    from netobserv_tpu.kafka.consumer import decode_record_batches
+    from netobserv_tpu.kafka.producer import _record_batch
+
+    msgs = [(b"k1", b"v1"), (None, b"v2"), (b"", b"x" * 1000)]
+    for codec in ("none", "gzip"):
+        batch = _record_batch(msgs, compression=codec)
+        got, next_off = decode_record_batches(batch)
+        assert got == msgs
+        assert next_off == len(msgs)
+    # concatenated batches with a truncated tail: complete ones decode
+    two = _record_batch(msgs[:1]) + _record_batch(msgs[1:])
+    got, _ = decode_record_batches(two + two[:10])
+    assert got == msgs
+
+
+def test_consumer_fetches_what_producer_sent(broker):
+    from netobserv_tpu.kafka.consumer import KafkaConsumer
+
+    producer = KafkaProducer(brokers=[f"127.0.0.1:{broker.port}"],
+                             topic=broker.topic)
+    sent = [(f"k{i}".encode(), f"value-{i}".encode()) for i in range(20)]
+    producer.send_batch(sent[:12])
+    producer.send_batch(sent[12:])
+    consumer = KafkaConsumer(brokers=[f"127.0.0.1:{broker.port}"],
+                             topic=broker.topic)
+    got = []
+    for _ in range(5):
+        got.extend(consumer.poll())
+        if len(got) >= len(sent):
+            break
+    assert sorted(got) == sorted(sent)
+    # offsets advanced: a second poll returns nothing new
+    assert consumer.poll() == []
+    producer.close()
+    consumer.close()
+
+
+def test_export_then_consume_pbflow_roundtrip(broker):
+    """The Kind Kafka suite's assertion path, offline: KafkaExporter's
+    pbflow messages come back through KafkaConsumer + pb_to_record with
+    per-flow accounting intact (e2e/cluster/kind/run_kafka.sh runs this
+    same pipeline against a real KRaft broker)."""
+    from netobserv_tpu.exporter.kafka import KafkaExporter
+    from netobserv_tpu.exporter.pb_convert import pb_to_record
+    from netobserv_tpu.kafka.consumer import KafkaConsumer
+    from netobserv_tpu.pb import flow_pb2
+    from tests.test_exporters import make_record
+
+    producer = KafkaProducer(brokers=[f"127.0.0.1:{broker.port}"],
+                             topic=broker.topic)
+    exp = KafkaExporter(producer)
+    sent = [make_record(proto=6), make_record(proto=17)]
+    exp.export_batch(sent)
+
+    consumer = KafkaConsumer(brokers=[f"127.0.0.1:{broker.port}"],
+                             topic=broker.topic)
+    got = []
+    for _ in range(5):
+        for _key, value in consumer.poll():
+            pb = flow_pb2.Record()
+            pb.ParseFromString(value)
+            got.append(pb_to_record(pb))
+        if len(got) >= len(sent):
+            break
+    assert len(got) == len(sent)
+    assert {r.key.proto for r in got} == {6, 17}
+    assert sorted(r.bytes_ for r in got) == sorted(r.bytes_ for r in sent)
+    assert sorted(r.packets for r in got) == sorted(r.packets for r in sent)
+    exp.close()
+    consumer.close()
