@@ -140,6 +140,29 @@ def main() -> None:
             jax.device_put(cbuf).block_until_ready()
         results[f"put_compact_{bs}"] = rate(cput) * (bs / BATCH)
 
+    # 6. resident production ring (the shipped default) + its put ceiling
+    from netobserv_tpu.sketch.staging import ResidentStagingRing
+    caps = flowpack.default_resident_caps(BATCH)
+    rring = ResidentStagingRing(
+        BATCH, sk.make_ingest_resident_fn(BATCH, caps, donate=True,
+                                          with_token=True), caps=caps)
+    rstate = sk.init_state(cfg)
+    for f in full:  # warm dict + compile
+        rstate = rring.fold(rstate, f)
+    jax.block_until_ready(rstate)
+    rh = [rstate]
+    def rfold(i):
+        rh[0] = rring.fold(rh[0], full[i % len(full)])
+    results["ring_resident"] = rate(rfold)
+    jax.block_until_ready(rh[0])
+    rbuf = np.empty(flowpack.resident_buf_len(BATCH, caps), np.uint32)
+    flowpack.pack_resident(full[0], BATCH, rring.kdict, caps, out=rbuf)
+    results["put_resident"] = rate(
+        lambda i: jax.device_put(rbuf).block_until_ready())
+    results["pack_resident"] = rate(
+        lambda i: flowpack.pack_resident(full[i % len(full)], BATCH,
+                                         rring.kdict, caps, out=rbuf))
+
     results = {k: round(v) for k, v in results.items()}
     results["device"] = jax.devices()[0].platform
     print(json.dumps(results))

@@ -56,13 +56,30 @@ from netobserv_tpu.pb import flow_pb2
 
 n_pkts, payload = $N_PKTS, $PAYLOAD
 expected = n_pkts * (payload + 8 + 20 + 14)
-consumer = KafkaConsumer(
-    brokers=["kafka.netobserv-e2e.svc.cluster.local:9092"],
-    topic="network-flows")
 deadline = time.time() + 120
 pkts = bts = 0
+consumer = None
 while time.time() < deadline:
-    for _key, value in consumer.poll(max_wait_ms=1000):
+    try:
+        if consumer is None:
+            # the topic auto-creates on the agent's first produce; KRaft
+            # may also answer the first metadata with LEADER_NOT_AVAILABLE
+            # — keep retrying construction until the deadline. A rebuild
+            # restarts from EARLIEST, so the counters restart with it
+            # (no double counting)
+            consumer = KafkaConsumer(
+                brokers=["kafka.netobserv-e2e.svc.cluster.local:9092"],
+                topic="network-flows")
+            pkts = bts = 0
+        batch = consumer.poll(max_wait_ms=1000)
+    except Exception as exc:
+        print(f"consumer retry: {exc}", flush=True)
+        if consumer is not None:
+            consumer.close()
+        consumer = None  # transient NOT_LEADER etc.: rebuild + re-resolve
+        time.sleep(3)
+        continue
+    for _key, value in batch:
         pb = flow_pb2.Record()
         pb.ParseFromString(value)
         r = pb_to_record(pb)

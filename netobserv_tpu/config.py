@@ -313,6 +313,16 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_pack_threads: int = field(default=0,
                                      **_env("SKETCH_PACK_THREADS", "0"))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))
+    #: single-device host->device feed format: "resident" (default,
+    #: ~15B/record slot-id rows against a device key table), "compact"
+    #: (40B v4-compact rows) or "dense" (80B full-width rows). Sharded
+    #: meshes always ship dense (rows must split on the data axis).
+    sketch_feed: str = field(default="resident", **_env("SKETCH_FEED", "resident"))
+    #: resident-feed key-table capacity (slots; power of two <= 2^20).
+    #: A full dictionary rolls its epoch — size it above the flow-cache
+    #: working set (CACHE_MAX_FLOWS)
+    sketch_resident_slots: int = field(
+        default=1 << 18, **_env("SKETCH_RESIDENT_SLOTS", str(1 << 18)))
     # where window reports go: "stdout" (JSON lines) or "kafka" (uses the
     # KAFKA_* settings; one message per report, key = "sketch_report")
     sketch_report_sink: str = field(default="stdout", **_env("SKETCH_REPORT_SINK", "stdout"))

@@ -260,7 +260,9 @@ class TpuSketchExporter(Exporter):
                  drop_z_threshold: float = DEFAULT_DROP_Z,
                  pack_threads: int = 1,
                  asym_min_bytes: float = DEFAULT_ASYM_MIN_BYTES,
-                 asym_ratio: float = DEFAULT_ASYM_RATIO):
+                 asym_ratio: float = DEFAULT_ASYM_RATIO,
+                 feed: str = "resident",
+                 resident_slots: int = 1 << 18):
         # jax-importing modules are pulled in lazily so the host agent can run
         # exporter-free on machines without accelerators
         from netobserv_tpu.sketch import state as sk
@@ -330,24 +332,8 @@ class TpuSketchExporter(Exporter):
                 enable_fanout=self._cfg.enable_fanout,
                 enable_asym=self._cfg.enable_asym)
             self._roll = sk.make_roll_fn(self._cfg, decay_factor=decay_factor)
-            # single-device: resident-key feed (~15B/record — hot rows
-            # reference a device-resident key table by slot id; the
-            # host->device link is the bottleneck, byte budget in
-            # docs/tpu_sketch.md). Lane overflows continue into the next
-            # chunk; a full key dictionary rolls its epoch in the ring.
-            if pack_threads > 1:
-                log.info("SKETCH_PACK_THREADS=%d applies to the sharded "
-                         "dense feed only; the single-device resident pack "
-                         "is single-threaded (~30M rec/s)", pack_threads)
-            caps = flowpack.default_resident_caps(self._batch_size)
-            self._ring = staging.ResidentStagingRing(
-                self._batch_size,
-                sk.make_ingest_resident_fn(
-                    self._batch_size, caps,
-                    use_pallas=self._cfg.use_pallas, with_token=True,
-                    enable_fanout=self._cfg.enable_fanout,
-                    enable_asym=self._cfg.enable_asym),
-                caps=caps, metrics=metrics)
+            self._ring = self._make_single_device_ring(
+                feed, resident_slots, pack_threads, metrics)
         # the staging ring packs the next batch while the previous
         # transfers/ingests are in flight; its slot-reuse tokens also bound
         # the async dispatch queue to the ring depth, so sustained overload
@@ -391,6 +377,8 @@ class TpuSketchExporter(Exporter):
                    pack_threads=cfg.resolved_pack_threads(),
                    asym_min_bytes=cfg.sketch_asym_min_bytes,
                    asym_ratio=cfg.sketch_asym_ratio,
+                   feed=cfg.sketch_feed,
+                   resident_slots=cfg.sketch_resident_slots,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
 
@@ -523,6 +511,43 @@ class TpuSketchExporter(Exporter):
                     self._metrics.count_error("tpu-sketch")
 
     # --- internals ---
+    def _make_single_device_ring(self, feed: str, resident_slots: int,
+                                 pack_threads: int, metrics):
+        """Single-device staging ring by feed format (SKETCH_FEED):
+        "resident" (default) ships ~15B/record slot-id hot rows against a
+        device key table (byte budget in docs/tpu_sketch.md; lane
+        overflows continue into the next chunk, a full dictionary rolls
+        its epoch); "compact" ships 40B v4-compact rows with a dense
+        fallback; "dense" ships 80B full-width rows (the debugging
+        baseline — also what sharded meshes use)."""
+        sk = self._sk
+        kw = dict(use_pallas=self._cfg.use_pallas, with_token=True,
+                  enable_fanout=self._cfg.enable_fanout,
+                  enable_asym=self._cfg.enable_asym)
+        if feed == "resident":
+            if pack_threads > 1:
+                log.info("SKETCH_PACK_THREADS=%d applies to the dense/"
+                         "compact feeds only; the resident pack is "
+                         "single-threaded (~30M rec/s)", pack_threads)
+            caps = flowpack.default_resident_caps(self._batch_size)
+            return staging.ResidentStagingRing(
+                self._batch_size,
+                sk.make_ingest_resident_fn(self._batch_size, caps, **kw),
+                caps=caps, slot_cap=resident_slots, metrics=metrics)
+        if feed == "compact":
+            spill_cap = staging.default_spill_cap(self._batch_size)
+            return staging.DenseStagingRing(
+                self._batch_size,
+                sk.make_ingest_compact_fn(self._batch_size, spill_cap, **kw),
+                spill_cap=spill_cap,
+                ingest_fallback=sk.make_ingest_dense_fn(**kw),
+                metrics=metrics, pack_threads=pack_threads)
+        if feed != "dense":
+            log.warning("unknown SKETCH_FEED %r; using dense", feed)
+        return staging.DenseStagingRing(
+            self._batch_size, sk.make_ingest_dense_fn(**kw),
+            metrics=metrics, pack_threads=pack_threads)
+
     def _fold(self, records: list[Record]) -> None:
         t0 = time.perf_counter()
         # always pad to the fixed batch size: a single static shape means the

@@ -47,7 +47,10 @@ def decode_record_batches(blob: bytes,
             break  # partial trailing batch
         magic = blob[off + 16]
         if magic != 2:
+            # legacy (v0/v1) message set: not decoded, but the offset MUST
+            # still advance or poll() would re-fetch this blob forever
             log.warning("skipping record batch with magic %d", magic)
+            next_offset = max(next_offset or 0, base_offset + 1)
             off = end
             continue
         attrs = struct.unpack(">h", blob[off + 21:off + 23])[0]

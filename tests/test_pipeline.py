@@ -556,3 +556,40 @@ def test_hairpin_conversations_excluded_from_asymmetry():
     exp.flush()
     assert reports[0]["AsymmetricConversationBuckets"] == []
     exp.close()
+
+
+def test_feed_formats_agree_on_window_totals():
+    """SKETCH_FEED=resident|compact|dense are three transports for the SAME
+    math: identical evictions must produce identical window totals and
+    heavy-hitter sets through the production exporter."""
+    import numpy as np
+
+    from netobserv_tpu.datapath.fetcher import EvictedFlows
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.model import binfmt
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    cfg = SketchConfig(cm_depth=2, cm_width=1 << 10, hll_precision=6,
+                       perdst_buckets=32, perdst_precision=4, topk=16,
+                       hist_buckets=64, ewma_buckets=32)
+    reports = {}
+    for feed in ("resident", "compact", "dense"):
+        out = []
+        exp = TpuSketchExporter(batch_size=64, window_s=3600,
+                                sketch_cfg=cfg, sink=out.append, feed=feed)
+        extra = np.zeros(8, dtype=binfmt.EXTRA_REC_DTYPE)
+        extra["rtt_ns"] = 2_000_000
+        exp.export_evicted(EvictedFlows(make_events(8), extra=extra))
+        exp.export_evicted(EvictedFlows(make_events(5, sport0=9000,
+                                                    nbytes=50_000)))
+        exp.flush()
+        assert len(out) == 1, feed
+        reports[feed] = out[0]
+    base = reports["dense"]
+    for feed in ("resident", "compact"):
+        rep = reports[feed]
+        assert rep["Records"] == base["Records"] == 13, feed
+        assert rep["Bytes"] == base["Bytes"], feed
+        hh = lambda r: {(h["SrcAddr"], h["SrcPort"], h["EstBytes"])
+                        for h in r["HeavyHitters"]}
+        assert hh(rep) == hh(base), feed
