@@ -313,10 +313,10 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_pack_threads: int = field(default=0,
                                      **_env("SKETCH_PACK_THREADS", "0"))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))
-    #: single-device host->device feed format: "resident" (default,
-    #: ~15B/record slot-id rows against a device key table), "compact"
-    #: (40B v4-compact rows) or "dense" (80B full-width rows). Sharded
-    #: meshes always ship dense (rows must split on the data axis).
+    #: host->device feed format: "resident" (default, ~15B/record
+    #: slot-id rows against a device key table; sharded meshes use one
+    #: dictionary+table per data shard), "compact" (40B v4-compact rows,
+    #: single-device only) or "dense" (80B full-width rows).
     sketch_feed: str = field(default="resident", **_env("SKETCH_FEED", "resident"))
     #: resident-feed key-table capacity (slots; power of two <= 2^20).
     #: A full dictionary rolls its epoch — size it above the flow-cache

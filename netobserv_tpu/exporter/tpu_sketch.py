@@ -319,11 +319,30 @@ class TpuSketchExporter(Exporter):
                 self._mesh, buf)
             self._roll = pmerge.make_merge_fn(self._mesh, self._cfg,
                                               decay_factor=decay_factor)
-            # sharded mode ships the full-width dense feed (a flat compact
-            # buffer would not split on row boundaries across the data axis)
-            self._ring = staging.DenseStagingRing(
-                self._batch_size, ingest_dense, put=dense_put,
-                metrics=metrics, pack_threads=pack_threads)
+            if feed == "resident":
+                # resident feed over the mesh: per-data-shard dictionaries
+                # + device key tables (~15B/record instead of dense's 80;
+                # lookups stay shard-local — no collectives added)
+                bps = self._batch_size // spec.data
+                caps = flowpack.default_resident_caps(bps)
+                self._ring = staging.ShardedResidentStagingRing(
+                    self._batch_size, spec.data,
+                    pmerge.make_sharded_ingest_resident_fn(
+                        self._mesh, self._cfg, bps, caps),
+                    key_tables=pmerge.init_resident_tables(
+                        self._mesh, resident_slots),
+                    put=dense_put,
+                    caps=caps, slot_cap=resident_slots, metrics=metrics,
+                    pack_threads=pack_threads)
+            else:
+                if feed == "compact":
+                    log.info("SKETCH_FEED=compact has no sharded form "
+                             "(spill compaction breaks the row split); "
+                             "using dense")
+                # dense: full-width rows, row-sharded over the data axis
+                self._ring = staging.DenseStagingRing(
+                    self._batch_size, ingest_dense, put=dense_put,
+                    metrics=metrics, pack_threads=pack_threads)
         else:
             self._ndata = 1
             self._state = sk.init_state(self._cfg)

@@ -156,6 +156,55 @@ def make_sharded_ingest_fn(mesh: Mesh, cfg: sk.SketchConfig,
     return jax.jit(shmapped, donate_argnums=(0,) if donate else ())
 
 
+def init_resident_tables(mesh: Mesh, slot_cap: int) -> jax.Array:
+    """Per-DATA-shard device key tables for the sharded resident feed:
+    (n_data, slot_cap, KEY_WORDS) u32, sharded P(data) — each data shard
+    owns an independent table fed by its own host-side dictionary, and the
+    sketch-axis replicas stay consistent because every sketch column of a
+    data row applies the same new-key lane. Lookups are pure local gathers,
+    so the steady-state no-collectives invariant is untouched."""
+    ndata = mesh.shape[DATA_AXIS]
+    arr = np.zeros((ndata, slot_cap, sk.KEY_WORDS), np.uint32)
+    return jax.device_put(arr, NamedSharding(mesh, P(DATA_AXIS)))
+
+
+def make_sharded_ingest_resident_fn(mesh: Mesh, cfg: sk.SketchConfig,
+                                    batch_per_shard: int, caps,
+                                    donate: bool = True) -> Callable:
+    """Jitted `(dist_state, key_tables, flat) -> (dist_state, key_tables,
+    token)` — the RESIDENT feed over the mesh (~15B/record instead of the
+    dense feed's 80). `flat` concatenates one per-shard resident buffer per
+    data shard (`flowpack.resident_buf_len(batch_per_shard, caps)` words
+    each, packed by that shard's own KeyDict —
+    `sketch.staging.ShardedResidentStagingRing`); the contiguous split over
+    the data axis lands exactly on buffer boundaries. Each shard scatters
+    its new-key lane into ITS table slice and gathers hot-row keys locally
+    — no collectives."""
+    nsk = mesh.shape[SKETCH_AXIS]
+    template = sk.init_state(cfg)
+    specs = _state_specs(template)
+
+    def local_step(pstate: sk.SketchState, table, flat):
+        s = _drop_lead(pstate)
+        arrays, tbl = sk.resident_to_arrays(flat, table[0], batch_per_shard,
+                                            caps)
+        s = sk.ingest(s, arrays,
+                      sketch_axis=SKETCH_AXIS if nsk > 1 else None,
+                      sketch_shards=nsk,
+                      use_pallas=(cfg.use_pallas if nsk == 1 else False),
+                      enable_fanout=cfg.enable_fanout,
+                      enable_asym=cfg.enable_asym)
+        return _add_lead(s), tbl[None], flat[:1]
+
+    shmapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(specs, P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(specs, P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=(0, 1) if donate else ())
+
+
 def shard_dense(mesh: Mesh, dense: np.ndarray) -> jax.Array:
     """Place a flowpack dense batch onto the mesh, rows split over the data
     axis, replicated over the sketch axis. Accepts (B, 20) rows or the flat

@@ -33,7 +33,49 @@ def default_spill_cap(batch_size: int) -> int:
     return max(batch_size // 8, 64)
 
 
-class DenseStagingRing:
+class _SlotRing:
+    """Shared slot/token protocol of every staging ring — ONE definition of
+    the slot-reuse guard described in the module docstring (the token must
+    be a slice of the jitted ingest's input; blocking on the put result is
+    not sufficient on zero-copy backends)."""
+
+    def _init_slots(self, bufs: list, metrics) -> None:
+        self._bufs = bufs
+        self._tokens: list = [None] * len(bufs)
+        self._slot = 0
+        self._metrics = metrics
+        self.stalls = 0
+
+    def _wait_slot(self) -> int:
+        """Return the next slot index, blocking until its previous consumer
+        (the ingest that read the slot's buffer) has finished."""
+        import jax
+
+        slot = self._slot
+        tok = self._tokens[slot]
+        if tok is not None:
+            if not tok.is_ready():
+                self.stalls += 1
+                if self._metrics is not None:
+                    self._metrics.sketch_staging_stalls_total.inc()
+            jax.block_until_ready(tok)
+        return slot
+
+    def _advance(self, slot: int, token) -> None:
+        self._tokens[slot] = token
+        self._slot = (slot + 1) % len(self._bufs)
+
+    def drain(self) -> None:
+        """Block until every in-flight batch has been fully ingested (host
+        buffers are then free; used before checkpoint/window close)."""
+        import jax
+
+        for tok in self._tokens:
+            if tok is not None:
+                jax.block_until_ready(tok)
+
+
+class DenseStagingRing(_SlotRing):
     """Reusable host buffers + in-flight tokens for the dense ingest path.
 
     `ingest` must be a token-returning jitted fn — built with
@@ -59,15 +101,10 @@ class DenseStagingRing:
         import jax
 
         self.batch_size = batch_size
-        self._metrics = metrics
         #: >1 shards each dense pack across this many native packer threads
         #: (flowpack.pack_dense_sharded) — matters on hosts where the pack,
         #: not the transfer link, bounds the feed
         self.pack_threads = pack_threads
-        #: folds that found their slot's previous ingest still running —
-        #: the device (or transfer link) is slower than the eviction feed.
-        #: Mirrored into metrics.sketch_staging_stalls_total when wired.
-        self.stalls = 0
         self.spill_cap = spill_cap
         self._ingest = ingest
         self._ingest_fallback = ingest_fallback
@@ -78,25 +115,15 @@ class DenseStagingRing:
                 raise ValueError("compact mode needs ingest_fallback")
         else:
             shape = (batch_size, flowpack.DENSE_WORDS)
-        self._bufs = [np.empty(shape, np.uint32) for _ in range(n_slots)]
+        self._init_slots([np.empty(shape, np.uint32)
+                          for _ in range(n_slots)], metrics)
         self._dense_buf: Optional[np.ndarray] = None  # lazy fallback buffer
-        self._tokens: list = [None] * n_slots
-        self._slot = 0
 
     def fold(self, state, events, extra=None, dns=None, drops=None,
              xlat=None, quic=None):
         """Pack `events` into the next free slot, ship it, ingest it; returns
         the new sketch state (async — not blocked on)."""
-        import jax
-
-        slot = self._slot
-        tok = self._tokens[slot]
-        if tok is not None:
-            if not tok.is_ready():
-                self.stalls += 1
-                if self._metrics is not None:
-                    self._metrics.sketch_staging_stalls_total.inc()
-            jax.block_until_ready(tok)  # slot's last consumer has finished
+        slot = self._wait_slot()
         feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat, quic=quic)
         if self.spill_cap is not None:
             buf = flowpack.pack_compact(
@@ -104,17 +131,16 @@ class DenseStagingRing:
                 out=self._bufs[slot], **feats)
             if buf is None:
                 return self._fold_dense_fallback(state, events, feats)
-            state, self._tokens[slot] = self._ingest(state, self._put(buf))
-            self._slot = (slot + 1) % len(self._bufs)
+            state, token = self._ingest(state, self._put(buf))
+            self._advance(slot, token)
             return state
         buf = flowpack.pack_dense_sharded(
             events, batch_size=self.batch_size, threads=self.pack_threads,
             out=self._bufs[slot], **feats)
         # ship FLAT: a (B*20,) transfer dodges device-layout padding of the
         # 20-wide minor dim (the ingest jit reshapes back, fused, free)
-        state, self._tokens[slot] = self._ingest(
-            state, self._put(buf.reshape(-1)))
-        self._slot = (slot + 1) % len(self._bufs)
+        state, token = self._ingest(state, self._put(buf.reshape(-1)))
+        self._advance(slot, token)
         return state
 
     def _fold_dense_fallback(self, state, events, feats):
@@ -134,17 +160,117 @@ class DenseStagingRing:
         jax.block_until_ready(tok)
         return state
 
-    def drain(self) -> None:
-        """Block until every in-flight batch has been fully ingested (host
-        buffers are then free; used before checkpoint/window close)."""
-        import jax
 
-        for tok in self._tokens:
-            if tok is not None:
-                jax.block_until_ready(tok)
+class ShardedResidentStagingRing(_SlotRing):
+    """Resident feed over a DATA-sharded mesh: the global batch splits into
+    `n_shards` contiguous row blocks, each packed by its OWN KeyDict into
+    its own per-shard resident buffer region; the concatenated flat buffer
+    ships with one sharded put whose contiguous split lands exactly on the
+    region boundaries. Device-side twin:
+    `parallel.merge.make_sharded_ingest_resident_fn` +
+    `init_resident_tables` (one independent key table per data shard —
+    lookups stay local, the steady-state no-collectives invariant holds).
+
+    Multi-process note: every process must fold the SAME global batches
+    (the existing `shard_batch`/`shard_dense` assumption) — dictionary
+    evolution is deterministic in row order, so all processes assign
+    identical slots.
+
+    `ingest`: `(dist_state, key_tables, flat) -> (dist_state, key_tables,
+    token)`. `put` places the flat host buffer (defaults to a plain
+    device_put; pass `parallel.merge.shard_dense` bound to the mesh).
+    `pack_threads > 1` packs the shard regions concurrently (the per-shard
+    KeyDicts are independent; ctypes releases the GIL)."""
+
+    def __init__(self, batch_size: int, n_shards: int, ingest: Callable,
+                 key_tables, put: Callable,
+                 caps=None, slot_cap: int = 1 << 18, n_slots: int = 4,
+                 metrics=None, pack_threads: int = 1):
+        if batch_size % n_shards:
+            raise ValueError("batch_size must divide evenly over the shards")
+        self.batch_size = batch_size
+        self.n_shards = n_shards
+        self.batch_per_shard = batch_size // n_shards
+        self.caps = caps or flowpack.default_resident_caps(
+            self.batch_per_shard)
+        self.slot_cap = slot_cap
+        self.pack_threads = pack_threads
+        self.kdicts = [flowpack.KeyDict(slot_cap) for _ in range(n_shards)]
+        self.key_tables = key_tables
+        self._ingest = ingest
+        self._put = put
+        self.continuations = 0
+        self.dict_resets = 0
+        self.spill_rows = 0
+        self._shard_words = flowpack.resident_buf_len(self.batch_per_shard,
+                                                      self.caps)
+        self._init_slots([np.empty(n_shards * self._shard_words, np.uint32)
+                          for _ in range(n_slots)], metrics)
+
+    def fold(self, state, events, extra=None, dns=None, drops=None,
+             xlat=None, quic=None):
+        """Pack `events` (split over the shards, possibly in several
+        chunks) into free ring slots, ship and ingest each; returns the new
+        dist state (async — not blocked on)."""
+        n = len(events)
+        if n == 0:
+            return state
+        feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat, quic=quic)
+        bounds = [n * i // self.n_shards for i in range(self.n_shards + 1)]
+        shard_ev = [events[bounds[i]:bounds[i + 1]]
+                    for i in range(self.n_shards)]
+        shard_feats = [
+            {k: (v[bounds[i]:bounds[i + 1]] if v is not None and len(v)
+                 else None) for k, v in feats.items()}
+            for i in range(self.n_shards)]
+        starts = [0] * self.n_shards
+        first = True
+        while any(starts[i] < len(shard_ev[i])
+                  for i in range(self.n_shards)):
+            slot = self._wait_slot()
+            buf = self._bufs[slot]
+
+            def pack_shard(i):
+                # touches only shard-local state (its dict, its buffer
+                # region, starts[i]); returns the diagnostic counters so
+                # threaded packs don't race on shared attributes
+                kd = self.kdicts[i]
+                resets = 0
+                if kd.count() >= self.slot_cap:
+                    kd.reset()  # per-shard epoch roll (ResidentStagingRing)
+                    resets = 1
+                region = buf[i * self._shard_words:
+                             (i + 1) * self._shard_words]
+                _, consumed = flowpack.pack_resident(
+                    shard_ev[i], batch_size=self.batch_per_shard,
+                    kdict=kd, caps=self.caps, start=starts[i],
+                    out=region, **shard_feats[i])
+                if consumed == 0 and starts[i] < len(shard_ev[i]):
+                    raise RuntimeError("resident pack made no progress")
+                starts[i] += consumed
+                return int(region[2]), resets
+
+            if self.pack_threads > 1 and self.n_shards > 1:
+                # per-shard dictionaries are independent; the native pack
+                # releases the GIL, so shards pack in true parallel
+                outs = [f.result() for f in flowpack._pack_submit(
+                    min(self.pack_threads, self.n_shards),
+                    [lambda i=i: pack_shard(i)
+                     for i in range(self.n_shards)])]
+            else:
+                outs = [pack_shard(i) for i in range(self.n_shards)]
+            self.spill_rows += sum(o[0] for o in outs)
+            self.dict_resets += sum(o[1] for o in outs)
+            if not first:
+                self.continuations += 1
+            first = False
+            state, self.key_tables, token = self._ingest(
+                state, self.key_tables, self._put(buf))
+            self._advance(slot, token)
+        return state
 
 
-class ResidentStagingRing:
+class ResidentStagingRing(_SlotRing):
     """Staging ring for the RESIDENT feed — the lowest-bytes-per-record host
     path (~15B/record vs the compact feed's 40B; byte budget in
     docs/tpu_sketch.md). The host keeps a key->slot dictionary
@@ -177,23 +303,18 @@ class ResidentStagingRing:
         self.key_table = jax.device_put(sk.init_key_table(slot_cap))
         self._ingest = ingest
         self._put = put or jax.device_put
-        self._metrics = metrics
-        self.stalls = 0
         self.continuations = 0  # extra chunks beyond one per fold()
         self.dict_resets = 0    # full-dictionary epochs
         self.spill_rows = 0     # rows that rode the full-width spill lane
         total = flowpack.resident_buf_len(batch_size, self.caps)
-        self._bufs = [np.empty(total, np.uint32) for _ in range(n_slots)]
-        self._tokens: list = [None] * n_slots
-        self._slot = 0
+        self._init_slots([np.empty(total, np.uint32)
+                          for _ in range(n_slots)], metrics)
 
     def fold(self, state, events, extra=None, dns=None, drops=None,
              xlat=None, quic=None):
         """Pack `events` (possibly in several chunks) into free ring slots,
         ship and ingest each; returns the new sketch state (async — not
         blocked on)."""
-        import jax
-
         feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat, quic=quic)
         n = len(events)
         if n == 0:
@@ -206,14 +327,7 @@ class ResidentStagingRing:
                 # slot is redefined before any hot row references it
                 self.kdict.reset()
                 self.dict_resets += 1
-            slot = self._slot
-            tok = self._tokens[slot]
-            if tok is not None:
-                if not tok.is_ready():
-                    self.stalls += 1
-                    if self._metrics is not None:
-                        self._metrics.sketch_staging_stalls_total.inc()
-                jax.block_until_ready(tok)
+            slot = self._wait_slot()
             buf, consumed = flowpack.pack_resident(
                 events, batch_size=self.batch_size, kdict=self.kdict,
                 caps=self.caps, start=start, out=self._bufs[slot], **feats)
@@ -224,16 +338,7 @@ class ResidentStagingRing:
                 self.continuations += 1
             first = False
             start += consumed
-            state, self.key_table, self._tokens[slot] = self._ingest(
+            state, self.key_table, token = self._ingest(
                 state, self.key_table, self._put(buf))
-            self._slot = (slot + 1) % len(self._bufs)
+            self._advance(slot, token)
         return state
-
-    def drain(self) -> None:
-        """Block until every in-flight batch has been fully ingested (host
-        buffers are then free; used before checkpoint/window close)."""
-        import jax
-
-        for tok in self._tokens:
-            if tok is not None:
-                jax.block_until_ready(tok)
