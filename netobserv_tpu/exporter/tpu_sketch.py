@@ -339,6 +339,8 @@ class TpuSketchExporter(Exporter):
                     log.info("SKETCH_FEED=compact has no sharded form "
                              "(spill compaction breaks the row split); "
                              "using dense")
+                elif feed != "dense":
+                    log.warning("unknown SKETCH_FEED %r; using dense", feed)
                 # dense: full-width rows, row-sharded over the data axis
                 self._ring = staging.DenseStagingRing(
                     self._batch_size, ingest_dense, put=dense_put,
