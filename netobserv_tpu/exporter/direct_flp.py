@@ -800,6 +800,44 @@ class _IPFIXWrite:
             self._exp.close()
 
 
+class _GRPCWrite:
+    """FLP `write grpc` (write_grpc.go): the entry stream leaves as pbflow
+    Records to a pbflow.Collector (the in-repo flow client — TLS/mTLS via
+    the `tls: {caCertPath, userCertPath, userKeyPath}` block). Terminal
+    stage; lazily constructed and error-swallowing like the other
+    writers."""
+
+    def __init__(self, params: dict, client=None):
+        self._params = params
+        self._client = client
+
+    def _ensure_client(self):
+        if self._client is None:
+            from netobserv_tpu.grpc.flow import FlowClient
+            tls = self._params.get("tls", {})
+            self._client = FlowClient(
+                self._params.get("targetHost", "localhost"),
+                int(self._params.get("targetPort", 9999)),
+                tls_ca=tls.get("caCertPath", ""),
+                tls_cert=tls.get("userCertPath", ""),
+                tls_key=tls.get("userKeyPath", ""))
+        return self._client
+
+    def push(self, entries: list[dict]) -> None:
+        from netobserv_tpu.exporter.flp_map import map_to_record
+        from netobserv_tpu.exporter.pb_convert import records_to_pb
+        try:
+            self._ensure_client().send(
+                records_to_pb([map_to_record(e) for e in entries]))
+        except Exception as exc:
+            log.warning("FLP grpc write failed (%s); %d records dropped",
+                        exc, len(entries))
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+
+
 class DirectFLPExporter(Exporter):
     name = "direct-flp"
 
@@ -870,6 +908,8 @@ class DirectFLPExporter(Exporter):
                     self._writer = _LokiWriter(p["write"].get("loki", {}))
                 elif wtype == "ipfix":
                     self._writer = _IPFIXWrite(p["write"].get("ipfix", {}))
+                elif wtype == "grpc":
+                    self._writer = _GRPCWrite(p["write"].get("grpc", {}))
                 elif wtype != "stdout":
                     log.warning("write type %r unsupported; using stdout", wtype)
             elif "ingest" in p or not p:

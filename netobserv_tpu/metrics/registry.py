@@ -125,6 +125,20 @@ class Metrics:
             "Staging-ring folds that had to WAIT for a slot's previous "
             "ingest (device slower than the eviction feed)",
             registry=self.registry)
+        self.sketch_resident_continuations_total = Counter(
+            p + "sketch_resident_continuations_total",
+            "Extra resident-feed chunks shipped because a side lane filled "
+            "(sustained high rates mean the caps are undersized for this "
+            "traffic mix)", registry=self.registry)
+        self.sketch_resident_dict_epochs_total = Counter(
+            p + "sketch_resident_dict_epochs_total",
+            "Resident key-dictionary epoch rolls (dictionary reached "
+            "SKETCH_RESIDENT_SLOTS; size it above the flow working set)",
+            registry=self.registry)
+        self.sketch_resident_spill_rows_total = Counter(
+            p + "sketch_resident_spill_rows_total",
+            "Rows that rode the full-width spill lane instead of a hot row",
+            registry=self.registry)
         self.sketch_window_records = Gauge(
             p + "sketch_window_records", "Flow records in the last window",
             registry=self.registry)

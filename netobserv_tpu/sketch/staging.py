@@ -234,6 +234,14 @@ class ShardedResidentStagingRing(_SlotRing):
                 # touches only shard-local state (its dict, its buffer
                 # region, starts[i]); returns the diagnostic counters so
                 # threaded packs don't race on shared attributes
+                if starts[i] >= len(shard_ev[i]):
+                    # exhausted shard in a continuation chunk: ship a
+                    # zeroed region, and don't roll its dictionary epoch
+                    # for rows it isn't packing
+                    region = buf[i * self._shard_words:
+                                 (i + 1) * self._shard_words]
+                    region[:] = 0
+                    return 0, 0
                 kd = self.kdicts[i]
                 resets = 0
                 if kd.count() >= self.slot_cap:
@@ -259,8 +267,19 @@ class ShardedResidentStagingRing(_SlotRing):
                      for i in range(self.n_shards)])]
             else:
                 outs = [pack_shard(i) for i in range(self.n_shards)]
-            self.spill_rows += sum(o[0] for o in outs)
-            self.dict_resets += sum(o[1] for o in outs)
+            chunk_spills = sum(o[0] for o in outs)
+            chunk_resets = sum(o[1] for o in outs)
+            self.spill_rows += chunk_spills
+            self.dict_resets += chunk_resets
+            if self._metrics is not None:
+                if chunk_spills:
+                    self._metrics.sketch_resident_spill_rows_total.inc(
+                        chunk_spills)
+                if chunk_resets:
+                    self._metrics.sketch_resident_dict_epochs_total.inc(
+                        chunk_resets)
+                if not first:
+                    self._metrics.sketch_resident_continuations_total.inc()
             if not first:
                 self.continuations += 1
             first = False
@@ -327,6 +346,8 @@ class ResidentStagingRing(_SlotRing):
                 # slot is redefined before any hot row references it
                 self.kdict.reset()
                 self.dict_resets += 1
+                if self._metrics is not None:
+                    self._metrics.sketch_resident_dict_epochs_total.inc()
             slot = self._wait_slot()
             buf, consumed = flowpack.pack_resident(
                 events, batch_size=self.batch_size, kdict=self.kdict,
@@ -334,6 +355,12 @@ class ResidentStagingRing(_SlotRing):
             if consumed == 0 and n:
                 raise RuntimeError("resident pack made no progress")
             self.spill_rows += int(buf[2])
+            if self._metrics is not None:
+                if buf[2]:
+                    self._metrics.sketch_resident_spill_rows_total.inc(
+                        int(buf[2]))
+                if not first:
+                    self._metrics.sketch_resident_continuations_total.inc()
             if not first:
                 self.continuations += 1
             first = False

@@ -904,3 +904,33 @@ def test_write_ipfix_emits_data_records():
     assert any(struct.unpack(">H", msg[o:o+2])[0] == TEMPLATE_V4
                for o in range(16, len(msg) - 1, 2))
     rx.close()
+
+
+GRPC_CFG_TMPL = """
+pipeline:
+  - name: out
+parameters:
+  - name: out
+    write:
+      type: grpc
+      grpc:
+        targetHost: 127.0.0.1
+        targetPort: %d
+"""
+
+
+def test_write_grpc_sends_pbflow_records():
+    """FLP `write grpc` (reference write_grpc.go): entries leave as pbflow
+    Records to a Collector (round-tripped through the in-repo server)."""
+    from netobserv_tpu.grpc.flow import start_flow_collector
+
+    server, port, out = start_flow_collector(0)
+    try:
+        exp = DirectFLPExporter(flp_config=GRPC_CFG_TMPL % port)
+        exp.export_batch([make_record(proto=6), make_record(proto=17)])
+        msg = out.get(timeout=10)
+        assert len(msg.entries) == 2
+        assert {e.transport.protocol for e in msg.entries} == {6, 17}
+        exp.close()
+    finally:
+        server.stop(0)

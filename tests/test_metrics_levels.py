@@ -98,3 +98,35 @@ def test_listener_passes_interface_identity():
     m.count_interface_event("attach", ifname="veth1", ifindex=7,
                             netns="", mac="02:00:00:00:00:01", retries=1)
     assert 'ifname="veth1"' in _expo(m)
+
+
+def test_resident_staging_metrics_surface():
+    """The resident ring's operational counters (continuation chunks, dict
+    epochs, spill rows) reach the prometheus registry the agent scrapes."""
+    from netobserv_tpu.datapath import flowpack
+    from netobserv_tpu.datapath.replay import SyntheticFetcher
+    from prometheus_client import CollectorRegistry
+
+    from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+    from netobserv_tpu.sketch import state as sk
+    from netobserv_tpu.sketch.staging import ResidentStagingRing
+
+    if not flowpack.build_native():
+        pytest.skip("native flowpack unavailable")
+    m = Metrics(MetricsSettings(level="info"), registry=CollectorRegistry())
+    B = 256
+    caps = flowpack.ResidentCaps(dns=8, drop=8, nk=8, spill=4)  # tiny lanes
+    ring = ResidentStagingRing(
+        B, sk.make_ingest_resident_fn(B, caps, with_token=True),
+        caps=caps, slot_cap=64, metrics=m)
+    state = sk.init_state(sk.SketchConfig(
+        cm_depth=2, cm_width=1 << 10, hll_precision=6, perdst_buckets=32,
+        perdst_precision=4, topk=16, hist_buckets=64, ewma_buckets=32))
+    fetcher = SyntheticFetcher(flows_per_eviction=B, n_distinct=400, seed=3)
+    for _ in range(4):
+        state = ring.fold(state, fetcher.lookup_and_delete().events[:B])
+    ring.drain()
+    g = m.registry.get_sample_value
+    assert g("ebpf_agent_sketch_resident_continuations_total") >= 1
+    assert g("ebpf_agent_sketch_resident_dict_epochs_total") >= 1
+    assert g("ebpf_agent_sketch_resident_spill_rows_total") >= 1
