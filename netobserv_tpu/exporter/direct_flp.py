@@ -30,8 +30,17 @@ Supported stage subset (the shapes the reference's smoke-test configs use):
   histogram metrics with labels and equal/not_equal/presence/absence/
   match_regex filters, registered on the exporter's `prom_registry`
   (served by the agent's metrics server when one is running)
-- `write` / type `stdout` (default when no pipeline is configured) or type
-  `loki` (push-API JSON streams with label promotion and tenant header)
+- `encode` / type `kafka` (encode_kafka.go): JSON entries produced to a
+  topic through the in-repo wire producer
+- `encode` / type `s3` (encode_s3.go): batched JSON objects with the FLP
+  store header, SigV4-signed PUTs under the reference's object layout
+- `write` / type `stdout` (default when no pipeline is configured), type
+  `loki` (push-API JSON streams with label promotion and tenant header),
+  type `ipfix` (v4/v6 templates through the wire exporter) or type `grpc`
+  (pbflow Records to a Collector, TLS/mTLS)
+
+Not embedded: the OTLP encode family (no OTLP SDK in this image) and FLP
+ingest stages (meaningless in direct mode — the agent IS the ingest).
 """
 
 from __future__ import annotations
@@ -800,6 +809,140 @@ class _IPFIXWrite:
             self._exp.close()
 
 
+def _sigv4_put(endpoint: str, secure: bool, bucket: str, key: str,
+               body: bytes, access_key: str, secret_key: str,
+               region: str = "us-east-1", timeout: float = 10.0,
+               now=None) -> None:
+    """Minimal AWS Signature V4 PUT-object over stdlib http.client — the
+    S3 wire contract the reference's minio client speaks (no SDK in this
+    image; the signature math is pinned by tests/test_direct_flp.py, which
+    re-derives it server-side)."""
+    import datetime
+    import hashlib
+    import hmac
+    import http.client
+
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    host = endpoint
+    path = "/" + bucket + "/" + key
+    payload_hash = hashlib.sha256(body).hexdigest()
+    headers = {
+        "host": host,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+    }
+    signed = ";".join(sorted(headers))
+    canonical = "\n".join([
+        "PUT", path, "",
+        "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
+        signed, payload_hash])
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def hm(k, msg):
+        return hmac.new(k, msg.encode(), hashlib.sha256).digest()
+
+    sig_key = hm(hm(hm(hm(("AWS4" + secret_key).encode(), datestamp),
+                       region), "s3"), "aws4_request")
+    signature = hmac.new(sig_key, to_sign.encode(), hashlib.sha256).hexdigest()
+    auth = (f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={signed}, Signature={signature}")
+    cls = http.client.HTTPSConnection if secure else http.client.HTTPConnection
+    conn = cls(endpoint, timeout=timeout)
+    try:
+        conn.request("PUT", path, body=body,
+                     headers={**headers, "Authorization": auth,
+                              "Content-Length": str(len(body))})
+        resp = conn.getresponse()
+        resp.read()
+        if resp.status >= 300:
+            raise IOError(f"S3 PUT {path} -> {resp.status}")
+    finally:
+        conn.close()
+
+
+class _S3Encode:
+    """FLP `encode s3` (encode_s3.go): entries buffer until `batchSize`,
+    then ship as one JSON object with the FLP store header (version,
+    capture window, count, user header parameters) under the reference's
+    object-name layout `account/year=/month=/day=/hour=/stream-id=/<seq>`.
+    Entries pass through; PUT failures are logged and dropped."""
+
+    def __init__(self, params: dict, put=None):
+        import time as _time
+        import uuid
+
+        self._p = params
+        self._batch_size = int(params.get("batchSize", 10) or 10)
+        self._pending: list[dict] = []
+        self._stream_id = params.get("streamId", uuid.uuid4().hex[:12])
+        self._seq = 0
+        self._interval_start = _time.time()
+        self._put = put or self._default_put
+
+    def _default_put(self, key: str, body: bytes) -> None:
+        _sigv4_put(self._p.get("endpoint", "localhost:9000"),
+                   bool(self._p.get("secure", False)),
+                   self._p.get("bucket", "netobserv"), key, body,
+                   self._p.get("accessKeyId", ""),
+                   self._p.get("secretAccessKey", ""))
+
+    def __call__(self, entry: dict) -> dict:
+        self._pending.append(entry)
+        return entry
+
+    def _object(self, flows, start_ts, end_ts) -> dict:
+        import datetime
+
+        def rfc3339(ts):
+            return datetime.datetime.fromtimestamp(
+                ts, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+        obj = dict(self._p.get("objectHeaderParameters", {}) or {})
+        obj["version"] = "v0.1"
+        obj["capture_start_time"] = rfc3339(start_ts)
+        obj["capture_end_time"] = rfc3339(end_ts)
+        obj["number_of_flow_logs"] = len(flows)
+        obj["flow_logs"] = flows
+        return obj
+
+    def _flush_batches(self, final: bool = False) -> None:
+        import time as _time
+
+        while (len(self._pending) >= self._batch_size
+               or (final and self._pending)):
+            batch = self._pending[:self._batch_size]
+            self._pending = self._pending[self._batch_size:]
+            now = _time.time()
+            t = _time.gmtime(now)
+            key = (f"{self._p.get('account', 'netobserv')}/"
+                   f"year={t.tm_year:04d}/month={t.tm_mon:02d}/"
+                   f"day={t.tm_mday:02d}/hour={t.tm_hour:02d}/"
+                   f"stream-id={self._stream_id}/{self._seq:08d}")
+            body = json.dumps(self._object(batch, self._interval_start, now),
+                              separators=(",", ":")).encode()
+            self._interval_start = now
+            self._seq += 1
+            try:
+                self._put(key, body)
+            except Exception as exc:
+                log.warning("FLP s3 encode failed (%s); %d entries dropped "
+                            "from the store (pipeline continues)",
+                            exc, len(batch))
+
+    def sweep(self) -> list:
+        self._flush_batches()
+        return []
+
+    def flush(self) -> list:
+        self._flush_batches(final=True)
+        return []
+
+
 class _GRPCWrite:
     """FLP `write grpc` (write_grpc.go): the entry stream leaves as pbflow
     Records to a pbflow.Collector (the in-repo flow client — TLS/mTLS via
@@ -899,6 +1042,8 @@ class DirectFLPExporter(Exporter):
                     self._stages.append(
                         _KafkaEncode(e.get("kafka", {}),
                                      producer=self._kafka_producer))
+                elif e.get("type") == "s3":
+                    self._stages.append(_S3Encode(e.get("s3", {})))
                 else:
                     log.warning("unsupported encode type %r ignored",
                                 e.get("type"))
