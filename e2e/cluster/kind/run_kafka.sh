@@ -48,7 +48,10 @@ for _ in range($N_PKTS):
 "
 
 echo "=== consume the topic and assert per-flow accounting"
-kubectl -n netobserv-e2e exec consumer -- python - <<PYEOF
+# -i is load-bearing: without it kubectl does not forward the heredoc, the
+# in-pod python reads EOF and exits 0, and the suite passes vacuously. The
+# PASS grep below guards against any future regression of the same shape.
+ASSERT_OUT=$(kubectl -n netobserv-e2e exec -i consumer -- python - <<PYEOF
 import json, sys, time
 from netobserv_tpu.kafka.consumer import KafkaConsumer
 from netobserv_tpu.exporter.pb_convert import pb_to_record
@@ -96,4 +99,9 @@ assert bts == expected, f"bytes {bts} != {expected}"
 print(f"PASS: kafka path per-flow accounting exact "
       f"({pkts} packets, {bts} bytes)")
 PYEOF
+)
+echo "$ASSERT_OUT"
+# the suite is only OK if the in-pod assertion actually ran and printed its
+# PASS line — an empty/EOF exec must fail loudly, not succeed silently
+grep -q "PASS: kafka path per-flow accounting exact" <<<"$ASSERT_OUT"
 echo "=== kafka cluster e2e OK"

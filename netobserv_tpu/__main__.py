@@ -61,10 +61,13 @@ def main() -> int:
     srv = None
     metrics = getattr(agent, "metrics", None)
     if cfg.metrics_enable and metrics is not None:
+        # /healthz + /readyz ride on the metrics server when the agent
+        # exposes a supervised health snapshot (FlowsAgent does)
         srv = start_metrics_server(
             metrics.registry, cfg.metrics_server_address,
             cfg.metrics_server_port, cfg.metrics_tls_cert_path,
-            cfg.metrics_tls_key_path)
+            cfg.metrics_tls_key_path,
+            health_source=getattr(agent, "health_snapshot", None))
 
     stop = threading.Event()
 

@@ -13,6 +13,7 @@ import queue
 import threading
 from typing import Optional
 
+from netobserv_tpu.agent.supervisor import Supervisor
 from netobserv_tpu.config import AgentConfig
 from netobserv_tpu.datapath.fetcher import FlowFetcher
 from netobserv_tpu.exporter import build_exporter
@@ -27,6 +28,10 @@ class Status(enum.Enum):
     NOT_STARTED = "NotStarted"
     STARTING = "Starting"
     STARTED = "Started"
+    #: a supervised stage exhausted its restart budget: the agent keeps
+    #: serving with the surviving stages, but /readyz reports 503 and the
+    #: condition is explicit (never a silent stall)
+    DEGRADED = "Degraded"
     STOPPING = "Stopping"
     STOPPED = "Stopped"
 
@@ -140,6 +145,66 @@ class FlowsAgent:
             self.iface_listener = InterfaceListener(
                 cfg, fetcher, metrics=self.metrics, informer=iface_informer)
 
+        # supervision: every stage thread registers a heartbeat + restart;
+        # crashed/hung stages restart with bounded backoff, exhausted
+        # budgets degrade the agent explicitly (agent/supervisor.py)
+        self.supervisor = Supervisor(
+            metrics=self.metrics,
+            check_period_s=cfg.supervisor_check_period,
+            on_degraded=self._on_stage_degraded)
+        self._register_stages()
+
+    def _register_stages(self) -> None:
+        cfg = self.cfg
+        budget = dict(max_restarts=cfg.supervisor_max_restarts,
+                      backoff_initial_s=cfg.supervisor_backoff_initial,
+                      backoff_max_s=cfg.supervisor_backoff_max,
+                      healthy_reset_s=cfg.supervisor_healthy_reset)
+        hb = cfg.supervisor_heartbeat_timeout
+        sup = self.supervisor
+        # the map tracer beats once per eviction wakeup, so its hang
+        # deadline rides on top of the eviction period
+        sup.register_stage("map-tracer", self.map_tracer,
+                           heartbeat_timeout_s=cfg.cache_active_timeout + hb,
+                           **budget)
+        sup.register_stage("capacity-limiter", self.limiter,
+                           heartbeat_timeout_s=hb, **budget)
+        sup.register_stage("exporter", self.terminal,
+                           heartbeat_timeout_s=hb, **budget)
+        if self.accounter is not None:
+            sup.register_stage("accounter", self.accounter,
+                               heartbeat_timeout_s=hb, **budget)
+        if self.rb_tracer is not None:
+            sup.register_stage("ringbuf-tracer", self.rb_tracer,
+                               heartbeat_timeout_s=hb, **budget)
+        if self.ssl_tracer is not None:
+            sup.register_stage("ssl-tracer", self.ssl_tracer,
+                               heartbeat_timeout_s=hb, **budget)
+        if self.iface_listener is not None:
+            sup.register_stage("iface-listener", self.iface_listener,
+                               heartbeat_timeout_s=hb, **budget)
+        # the tpu-sketch exporter supervises its own window timer (and any
+        # future exporter with background threads can opt in the same way)
+        register = getattr(self.exporter, "register_supervised", None)
+        if register is not None:
+            register(sup, heartbeat_timeout_s=hb, **budget)
+
+    def _on_stage_degraded(self, stage: str) -> None:
+        with self._status_lock:
+            if self._status == Status.STARTED:
+                self._status = Status.DEGRADED
+        log.error("agent DEGRADED: stage %s is down for good "
+                  "(restart budget exhausted)", stage)
+
+    def health_snapshot(self) -> dict:
+        """Machine-readable agent health for /healthz + /readyz
+        (metrics/server.py)."""
+        return {
+            "status": self.status.value,
+            "degraded": self.supervisor.degraded,
+            "stages": self.supervisor.snapshot(),
+        }
+
     @classmethod
     def from_config(cls, cfg: AgentConfig) -> "FlowsAgent":
         cfg.validate()
@@ -174,6 +239,8 @@ class FlowsAgent:
         if self.ssl_tracer is not None:
             self.ssl_tracer.start()
         self.map_tracer.start()
+        if self.cfg.supervisor_enable:
+            self.supervisor.start()
         self._set_status(Status.STARTED)
         self._active_stop = stop = stop or self._stop
         stop.wait()
@@ -189,6 +256,9 @@ class FlowsAgent:
         if self.status in (Status.STOPPING, Status.STOPPED):
             return
         self._set_status(Status.STOPPING)
+        # the supervisor goes first: a stopping stage's dead thread must not
+        # be mistaken for a crash and restarted mid-shutdown
+        self.supervisor.stop()
         # stop stages source-first, with a final eviction so nothing is lost
         if self.iface_listener is not None:
             self.iface_listener.stop()

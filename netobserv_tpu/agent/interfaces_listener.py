@@ -19,6 +19,7 @@ from netobserv_tpu.ifaces import (
     Event, EventType, InterfaceFilter, Poller, Registerer, Watcher,
 )
 from netobserv_tpu.model.record import interface_namer, set_interface_namer
+from netobserv_tpu.utils import faultinject
 
 log = logging.getLogger("netobserv_tpu.agent.ifaces")
 
@@ -49,12 +50,18 @@ class InterfaceListener:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.attached: set[tuple[str, int]] = set()
+        #: supervision hook: beats once per poll (agent/supervisor.py)
+        self.heartbeat = lambda: None
+        self._events: Optional["queue.Queue[Event]"] = None
 
     def start(self) -> None:
         set_interface_namer(self._registerer.name_for)
-        events = self._informer.subscribe()
+        # a supervisor restart reuses the live subscription — resubscribing
+        # would replay/miss discovery events depending on the informer
+        if self._events is None:
+            self._events = self._informer.subscribe()
         self._thread = threading.Thread(
-            target=self._loop, args=(events,), name="iface-listener",
+            target=self._loop, args=(self._events,), name="iface-listener",
             daemon=True)
         self._thread.start()
 
@@ -72,6 +79,8 @@ class InterfaceListener:
 
     def _loop(self, events: "queue.Queue[Event]") -> None:
         while not self._stop.is_set():
+            self.heartbeat()
+            faultinject.fire("iface_listener.loop")
             try:
                 event = events.get(timeout=0.2)
             except queue.Empty:

@@ -213,6 +213,31 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     metrics_tls_key_path: str = field(default="", **_env("METRICS_TLS_KEY_PATH"))
     metrics_prefix: str = field(default="ebpf_agent_", **_env("METRICS_PREFIX", "ebpf_agent_"))
 
+    # --- pipeline supervision (agent/supervisor.py; new) ---
+    #: master switch for the stage supervisor (crash/hang detection,
+    #: bounded restarts, DEGRADED transitions, /healthz detail)
+    supervisor_enable: bool = field(
+        default=True, **_env("SUPERVISOR_ENABLE", "true"))
+    supervisor_check_period: float = field(
+        default=0.25, **_env("SUPERVISOR_CHECK_PERIOD", "250ms"))
+    #: consecutive failures a stage may accrue before it is DEGRADED
+    supervisor_max_restarts: int = field(
+        default=5, **_env("SUPERVISOR_MAX_RESTARTS", "5"))
+    supervisor_backoff_initial: float = field(
+        default=0.2, **_env("SUPERVISOR_BACKOFF_INITIAL", "200ms"))
+    supervisor_backoff_max: float = field(
+        default=30.0, **_env("SUPERVISOR_BACKOFF_MAX", "30s"))
+    #: a stage healthy this long after a restart earns its budget back
+    supervisor_healthy_reset: float = field(
+        default=30.0, **_env("SUPERVISOR_HEALTHY_RESET", "30s"))
+    #: hang deadline for fast-poll stages; timer-paced stages (map tracer,
+    #: sketch window) get this ON TOP of their own period. The default must
+    #: sit ABOVE the worst legitimate stall in a stage loop — the sketch
+    #: ingest's first on-chip compile can block the exporter thread for
+    #: minutes (see .claude/skills/verify) and must not be "detected"
+    supervisor_heartbeat_timeout: float = field(
+        default=300.0, **_env("SUPERVISOR_HEARTBEAT_TIMEOUT", "5m"))
+
     # --- feature enables (propagated to the datapath as compile-time consts) ---
     enable_rtt: bool = field(default=False, **_env("ENABLE_RTT", "false"))
     enable_pkt_drops: bool = field(default=False, **_env("ENABLE_PKT_DROPS", "false"))
@@ -384,6 +409,9 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
 _DURATION_FIELDS = {
     "cache_active_timeout", "listen_poll_period", "stale_entries_evict_timeout",
     "grpc_reconnect_timer", "grpc_reconnect_timer_randomization", "sketch_window",
+    "supervisor_check_period", "supervisor_backoff_initial",
+    "supervisor_backoff_max", "supervisor_healthy_reset",
+    "supervisor_heartbeat_timeout",
 }
 
 

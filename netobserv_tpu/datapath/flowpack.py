@@ -665,7 +665,10 @@ def pack_resident(events_raw: bytes | np.ndarray,
             out[row + 10] = np.float32(stats["bytes"][i]).view(np.uint32)
             out[row + 11] = pk
             out[row + 12] = rtt
-            out[row + 13] = np.uint32(dlat)
+            # explicit u32 wrap: the native packer casts (uint32_t)dlat, and
+            # np.uint32(x) raises OverflowError for x >= 2^32 (a DNS latency
+            # over ~71 minutes in µs) instead of wrapping like the C++ side
+            out[row + 13] = np.uint32(dlat & 0xFFFFFFFF)
             out[row + 14] = 1
             out[row + 15] = stats["sampling"][i]
             out[row + 16:row + 20] = fw_rel[j]

@@ -8,6 +8,7 @@ import threading
 from typing import Optional
 
 from netobserv_tpu.model.record import Record
+from netobserv_tpu.utils import faultinject
 
 log = logging.getLogger("netobserv_tpu.exporter")
 
@@ -43,6 +44,8 @@ class QueueExporter:
         self._metrics = metrics
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: supervision hook: beats once per poll (agent/supervisor.py)
+        self.heartbeat = lambda: None
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -66,6 +69,11 @@ class QueueExporter:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            self.heartbeat()
+            # the fault point sits OUTSIDE _export's try: it simulates a bug
+            # in the terminal stage itself (supervisor territory), while
+            # errors raised BY the exporter stay swallowed+counted below
+            faultinject.fire("exporter.loop")
             try:
                 batch = self._in.get(timeout=0.2)
             except queue.Empty:
@@ -74,6 +82,9 @@ class QueueExporter:
 
     def _export(self, batch) -> None:
         try:
+            # inside the try: an armed "exporter.export" behaves exactly
+            # like a throwing exporter — swallowed and counted, never fatal
+            faultinject.fire("exporter.export")
             if isinstance(batch, list):
                 self._exporter.export_batch(batch)
             else:  # EvictedFlows on the columnar fast path

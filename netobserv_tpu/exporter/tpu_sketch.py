@@ -34,6 +34,7 @@ from netobserv_tpu.sketch import staging
 from netobserv_tpu.model.columnar import FlowBatch, unpack_key_words
 from netobserv_tpu.model.flow import ip_from_16
 from netobserv_tpu.model.record import Record
+from netobserv_tpu.utils import faultinject
 
 log = logging.getLogger("netobserv_tpu.exporter.tpu_sketch")
 
@@ -376,9 +377,35 @@ class TpuSketchExporter(Exporter):
                     self._ckpt.latest_step(), exc)
         # idle-window timer: reports keep flowing even when no batches arrive
         self._closed = threading.Event()
+        #: supervision hook for the window timer (agent/supervisor.py)
+        self.heartbeat = lambda: None
+        self._timer: Optional[threading.Thread] = None
+        self.start_window_timer()
+
+    @property
+    def _window_poll_s(self) -> float:
+        """Window timer wakeup period — the ONE definition; the heartbeat
+        deadline in register_supervised rides on top of it."""
+        return min(1.0, self._window_s / 10)
+
+    def start_window_timer(self) -> None:
+        """(Re)start the idle-window timer thread; the supervisor uses this
+        as the sketch-window stage's restart callable."""
         self._timer = threading.Thread(
             target=self._window_loop, name="sketch-window", daemon=True)
         self._timer.start()
+
+    def register_supervised(self, supervisor, heartbeat_timeout_s=None,
+                            **kwargs) -> None:
+        """Register the window timer with the agent's supervisor. The
+        heartbeat deadline rides on top of the timer's own poll period."""
+        beat = supervisor.register(
+            "sketch-window", restart=self.start_window_timer,
+            thread_getter=lambda: self._timer,
+            heartbeat_timeout_s=(heartbeat_timeout_s or 10.0)
+            + self._window_poll_s,
+            **kwargs)
+        self.heartbeat = beat
 
     @classmethod
     def from_config(cls, cfg, metrics=None, sink=None):
@@ -486,12 +513,42 @@ class TpuSketchExporter(Exporter):
     def _fold_events(self, events, feats) -> None:
         t0 = time.perf_counter()
         n = len(events)
-        self._state = self._ring.fold(self._state, events, **feats)
+        try:
+            faultinject.fire("sketch.ingest")
+            self._state = self._ring.fold(self._state, events, **feats)
+        except Exception as exc:
+            # graceful degradation: a device error loses THIS batch (counted)
+            # instead of poisoning the exporter thread / window timer
+            self._count_ingest_error(n, exc)
+            return
         if self._metrics is not None:
             self._metrics.sketch_batches_total.inc()
             self._metrics.sketch_records_total.inc(n)
             self._metrics.sketch_ingest_seconds.observe(
                 time.perf_counter() - t0)
+
+    def _count_ingest_error(self, n: int, exc: Exception) -> None:
+        log.error("sketch ingest failed (batch of %d dropped): %s", n, exc)
+        if self._metrics is not None:
+            self._metrics.sketch_ingest_errors_total.inc()
+            self._metrics.count_error("tpu-sketch-ingest")
+        # resident feed: the host dictionary may have committed slot
+        # definitions the device table never received (the dropped buffer
+        # carried them). Roll the epoch so every live slot is redefined
+        # through the new-key lane before any hot row references it —
+        # otherwise later hot rows would score against stale device keys
+        # (the resident-feed contract, CLAUDE.md)
+        kdicts = getattr(self._ring, "kdicts", None)
+        if kdicts is None:
+            kd = getattr(self._ring, "kdict", None)
+            kdicts = [kd] if kd is not None else []
+        for kd in kdicts:
+            kd.reset()
+        if kdicts:
+            self._ring.dict_resets += len(kdicts)
+            if self._metrics is not None:
+                self._metrics.sketch_resident_dict_epochs_total.inc(
+                    len(kdicts))
 
     def _drain_pending_locked(self) -> None:
         if self._pending:
@@ -516,9 +573,13 @@ class TpuSketchExporter(Exporter):
             sink_close()
 
     def _window_loop(self) -> None:
-        poll = min(1.0, self._window_s / 10)
-        while not self._closed.wait(timeout=poll):
+        while not self._closed.wait(timeout=self._window_poll_s):
+            self.heartbeat()
+            # outside the try: a bug in the timer stage itself — the
+            # supervisor's job (restart), not the swallow-and-retry path
+            faultinject.fire("sketch.window_timer")
             try:
+                faultinject.fire("sketch.window_roll")
                 with self._lock:
                     if time.monotonic() >= self._window_deadline:
                         self._drain_pending_locked()
@@ -574,10 +635,15 @@ class TpuSketchExporter(Exporter):
         # always pad to the fixed batch size: a single static shape means the
         # jitted ingest compiles exactly once (no per-window retraces)
         batch = FlowBatch.from_records(records, batch_size=self._batch_size)
-        arrays = self._sk.batch_to_device(batch)
-        if self._distributed:
-            arrays = self._pm.shard_batch(self._mesh, arrays)
-        self._state = self._ingest(self._state, arrays)
+        try:
+            faultinject.fire("sketch.ingest")
+            arrays = self._sk.batch_to_device(batch)
+            if self._distributed:
+                arrays = self._pm.shard_batch(self._mesh, arrays)
+            self._state = self._ingest(self._state, arrays)
+        except Exception as exc:
+            self._count_ingest_error(len(records), exc)
+            return
         if self._metrics is not None:
             self._metrics.sketch_batches_total.inc()
             self._metrics.sketch_records_total.inc(len(records))

@@ -19,6 +19,7 @@ from netobserv_tpu.model import accumulate, binfmt
 from netobserv_tpu.model.record import (
     MonotonicClock, Record, interface_namer, records_from_events,
 )
+from netobserv_tpu.utils import faultinject
 
 log = logging.getLogger("netobserv_tpu.flow.accounter")
 
@@ -39,6 +40,8 @@ class Accounter:
         self._entries: dict[bytes, np.void] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: supervision hook: beats once per poll (agent/supervisor.py)
+        self.heartbeat = lambda: None
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -54,6 +57,8 @@ class Accounter:
     def _loop(self) -> None:
         deadline = time.monotonic() + self._timeout
         while not self._stop.is_set():
+            self.heartbeat()
+            faultinject.fire("accounter.loop")
             timeout = max(deadline - time.monotonic(), 0.01)
             try:
                 event = self._in.get(timeout=min(timeout, 0.2))

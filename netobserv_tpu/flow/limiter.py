@@ -14,6 +14,7 @@ import time
 from typing import Optional
 
 from netobserv_tpu.model.record import Record
+from netobserv_tpu.utils import faultinject
 
 log = logging.getLogger("netobserv_tpu.flow.limiter")
 
@@ -32,6 +33,8 @@ class CapacityLimiter:
         self._dropped_since_log = 0
         self._log_period = _INITIAL_LOG_PERIOD_S
         self._next_log = 0.0
+        #: supervision hook: beats once per poll (agent/supervisor.py)
+        self.heartbeat = lambda: None
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -58,6 +61,8 @@ class CapacityLimiter:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            self.heartbeat()
+            faultinject.fire("limiter.forward")
             try:
                 batch = self._in.get(timeout=0.2)
             except queue.Empty:

@@ -15,6 +15,7 @@ import time
 from typing import Optional
 
 from netobserv_tpu.datapath.fetcher import FlowFetcher
+from netobserv_tpu.utils import faultinject
 from netobserv_tpu.utils.dnsnames import decode_qname
 from netobserv_tpu.model.record import (
     InterfaceNamer, MonotonicClock, Record, interface_namer,
@@ -56,6 +57,9 @@ class MapTracer:
         self._stop = threading.Event()
         self._evict_lock = threading.Lock()  # one eviction at a time
         self._thread: Optional[threading.Thread] = None
+        #: supervision hook (agent/supervisor.py): the loop beats once per
+        #: wakeup; the supervisor replaces this no-op at registration
+        self.heartbeat = lambda: None
 
     def flush(self) -> None:
         """Force an early eviction (map-pressure relief)."""
@@ -79,8 +83,10 @@ class MapTracer:
             # wait for either the ticker period or an explicit flush
             self._flush.wait(timeout=self._timeout)
             self._flush.clear()
+            self.heartbeat()
             if self._stop.is_set():
                 return
+            faultinject.fire("map_tracer.evict")
             self._evict_once()
 
     def _evict_once(self) -> None:

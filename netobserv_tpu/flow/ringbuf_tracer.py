@@ -19,6 +19,7 @@ import numpy as np
 
 from netobserv_tpu.datapath.fetcher import FlowFetcher
 from netobserv_tpu.model import binfmt
+from netobserv_tpu.utils import faultinject
 
 log = logging.getLogger("netobserv_tpu.flow.ringbuf_tracer")
 
@@ -37,6 +38,8 @@ class RingBufTracer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_log = 0.0
+        #: supervision hook: beats once per poll (agent/supervisor.py)
+        self.heartbeat = lambda: None
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -50,7 +53,9 @@ class RingBufTracer:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            raw = self._fetcher.read_ringbuf(self._poll)
+            self.heartbeat()
+            raw = faultinject.fire("ringbuf_tracer.read",
+                                   self._fetcher.read_ringbuf(self._poll))
             if raw is None:
                 continue
             if len(raw) != binfmt.FLOW_EVENT_DTYPE.itemsize:

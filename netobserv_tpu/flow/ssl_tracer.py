@@ -17,6 +17,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from netobserv_tpu.model import binfmt
+from netobserv_tpu.utils import faultinject
 
 log = logging.getLogger("netobserv_tpu.flow.ssl_tracer")
 
@@ -56,6 +57,8 @@ class SSLTracer:
         self._poll = poll_timeout_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: supervision hook: beats once per poll (agent/supervisor.py)
+        self.heartbeat = lambda: None
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, name="ssl-tracer",
@@ -69,7 +72,9 @@ class SSLTracer:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            raw = self._fetcher.read_ssl(self._poll)
+            self.heartbeat()
+            raw = faultinject.fire("ssl_tracer.read",
+                                   self._fetcher.read_ssl(self._poll))
             if raw is None:
                 continue
             event = decode_ssl_event(raw)

@@ -45,14 +45,26 @@ def decode_record_batches(blob: bytes,
         end = off + 12 + batch_len
         if batch_len <= 0 or end > len(blob):
             break  # partial trailing batch
+        if batch_len < 5:
+            # the magic byte sits 5 bytes into the batch body: a corrupt
+            # batch_len in 1..4 would make the read below peek past the
+            # batch end and misroute the decoder — treat as partial
+            break
         magic = blob[off + 16]
         if magic != 2:
-            # legacy (v0/v1) message set: not decoded, but the offset MUST
-            # still advance or poll() would re-fetch this blob forever
+            # legacy (v0/v1) message set (can legitimately be < 49 bytes):
+            # not decoded, but the offset MUST still advance or poll()
+            # would re-fetch this blob forever
             log.warning("skipping record batch with magic %d", magic)
             next_offset = max(next_offset or 0, base_offset + 1)
             off = end
             continue
+        if batch_len < 49:
+            # a v2 batch body is at least 49 bytes (through the record
+            # count at +57..61); a corrupt batch_len in 1..48 passes the
+            # end-bounds check yet would crash the header unpacks below
+            # with struct.error — treat it like a partial trailing batch
+            break
         attrs = struct.unpack(">h", blob[off + 21:off + 23])[0]
         last_delta = struct.unpack(">i", blob[off + 23:off + 27])[0]
         n_records = struct.unpack(">i", blob[off + 57:off + 61])[0]

@@ -150,6 +150,25 @@ class Metrics:
             p + "sketch_window_suspects",
             "Anomaly suspects reported in the last window, by signal",
             ["signal"], registry=self.registry)
+        # supervision layer (agent/supervisor.py)
+        self.stage_failures_total = Counter(
+            p + "stage_failures_total",
+            "Supervised-stage failures detected (crash = dead thread, "
+            "hang = heartbeat deadline exceeded)", ["stage", "kind"],
+            registry=self.registry)
+        self.stage_restarts_total = Counter(
+            p + "stage_restarts_total",
+            "Supervised-stage restarts performed", ["stage"],
+            registry=self.registry)
+        self.stage_degraded = Gauge(
+            p + "stage_degraded",
+            "1 when a stage exhausted its restart budget and was marked "
+            "DEGRADED", ["stage"], registry=self.registry)
+        self.sketch_ingest_errors_total = Counter(
+            p + "sketch_ingest_errors_total",
+            "Device ingest failures absorbed by dropping the batch "
+            "(graceful degradation; the window timer stays alive)",
+            registry=self.registry)
 
     # --- convenience methods used by pipeline stages ---
     def observe_eviction(self, source: str, n_flows: int, seconds: float) -> None:
@@ -179,6 +198,15 @@ class Metrics:
 
     def count_error(self, component: str, severity: str = "error") -> None:
         self.errors_total.labels(component, severity).inc()
+
+    def count_stage_failure(self, stage: str, kind: str) -> None:
+        self.stage_failures_total.labels(stage, kind).inc()
+
+    def count_stage_restart(self, stage: str) -> None:
+        self.stage_restarts_total.labels(stage).inc()
+
+    def set_stage_degraded(self, stage: str, degraded: bool) -> None:
+        self.stage_degraded.labels(stage).set(1 if degraded else 0)
 
     def count_interface_event(self, kind: str, ifname: str = "",
                               ifindex: int = 0, netns: str = "",

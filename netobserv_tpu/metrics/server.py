@@ -1,32 +1,86 @@
-"""Async /metrics HTTP server with optional TLS.
+"""Async /metrics HTTP server with optional TLS, plus /healthz and /readyz.
 
 Reference analog: `pkg/prometheus/prom_server.go:27-70` (TLS1.3 minimum when
 certs are configured) and the hardened defaults in `pkg/server/common.go`.
+
+Health surface (supervision layer, agent/supervisor.py): when a
+``health_source`` callable is supplied, the server also answers
+
+- ``/healthz`` — liveness + per-stage detail. 200 while the agent runs
+  (including Degraded: the process is alive and partially serving — a
+  kubelet restart would lose the healthy stages too); 503 once Stopped.
+- ``/readyz``  — readiness. 200 only while status is Started and no stage
+  is Degraded; 503 otherwise (orchestrators pull a degraded pod out of
+  rotation without killing it).
+
+Both return the same machine-readable JSON body:
+``{"status": ..., "degraded": ..., "stages": {name: {state, restarts,
+consecutive_failures, last_failure, heartbeat_age_s, ...}}}``.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
 
 from prometheus_client import CollectorRegistry, generate_latest
 from prometheus_client.exposition import CONTENT_TYPE_LATEST
 
 log = logging.getLogger("netobserv_tpu.metrics.server")
 
+#: health_source contract: () -> {"status": str, "degraded": bool,
+#: "stages": {...}} (FlowsAgent.health_snapshot)
+HealthSource = Callable[[], dict]
+
+_READY_STATUSES = ("Started",)
+# "Stopping" stays live: the graceful shutdown performs a final eviction
+# and checkpoint — a liveness 503 there would invite a force-kill that
+# loses exactly the flows the source-first stop ordering preserves
+_LIVE_STATUSES = ("NotStarted", "Starting", "Started", "Degraded",
+                  "Stopping")
+
 
 class _Handler(BaseHTTPRequestHandler):
     registry: CollectorRegistry = None  # set per-server subclass
+    health_source: Optional[HealthSource] = None
 
     def do_GET(self):  # noqa: N802 - http.server API
-        if self.path.split("?")[0] not in ("/metrics", "/"):
+        path = self.path.split("?")[0]
+        if path in ("/healthz", "/readyz"):
+            self._serve_health(path)
+            return
+        if path not in ("/metrics", "/"):
             self.send_error(404)
             return
         payload = generate_latest(self.registry)
         self.send_response(200)
         self.send_header("Content-Type", CONTENT_TYPE_LATEST)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _serve_health(self, path: str) -> None:
+        if self.health_source is None:
+            self.send_error(404, explain="no health source configured")
+            return
+        try:
+            health = self.health_source()
+        except Exception as exc:  # a broken probe must still answer
+            health = {"status": "Unknown", "degraded": True,
+                      "error": str(exc), "stages": {}}
+        status = health.get("status", "Unknown")
+        degraded = bool(health.get("degraded"))
+        if path == "/readyz":
+            ok = status in _READY_STATUSES and not degraded
+        else:
+            ok = status in _LIVE_STATUSES
+        payload = json.dumps(health, separators=(",", ":")).encode()
+        self.send_response(200 if ok else 503)
+        self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
@@ -37,10 +91,13 @@ class _Handler(BaseHTTPRequestHandler):
 
 def start_metrics_server(registry: CollectorRegistry, address: str = "",
                          port: int = 9090, tls_cert_path: str = "",
-                         tls_key_path: str = "") -> ThreadingHTTPServer:
+                         tls_key_path: str = "",
+                         health_source: Optional[HealthSource] = None,
+                         ) -> ThreadingHTTPServer:
     """Start the exposition server on a daemon thread; returns the server
     (call .shutdown() to stop)."""
-    handler = type("Handler", (_Handler,), {"registry": registry})
+    handler = type("Handler", (_Handler,),
+                   {"registry": registry, "health_source": health_source})
     srv = ThreadingHTTPServer((address or "0.0.0.0", port), handler)
     srv.timeout = 10  # hardened-ish defaults (reference: pkg/server/common.go)
     if tls_cert_path and tls_key_path:
@@ -51,7 +108,7 @@ def start_metrics_server(registry: CollectorRegistry, address: str = "",
     t = threading.Thread(target=srv.serve_forever, name="metrics-http",
                          daemon=True)
     t.start()
-    log.info("metrics server listening on %s:%d (tls=%s)",
+    log.info("metrics server listening on %s:%d (tls=%s, health=%s)",
              address or "0.0.0.0", srv.server_address[1],
-             bool(tls_cert_path))
+             bool(tls_cert_path), health_source is not None)
     return srv

@@ -24,6 +24,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from netobserv_tpu.datapath import flowpack
+from netobserv_tpu.utils import faultinject
 
 
 def default_spill_cap(batch_size: int) -> int:
@@ -51,6 +52,10 @@ class _SlotRing:
         (the ingest that read the slot's buffer) has finished."""
         import jax
 
+        # chaos seam: a hang here models a wedged device/transfer stalling
+        # the staging feed — the thread folding (the exporter stage) stops
+        # beating and the supervisor's hang detection takes over
+        faultinject.fire("sketch.staging_wait")
         slot = self._slot
         tok = self._tokens[slot]
         if tok is not None:

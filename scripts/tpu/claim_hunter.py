@@ -14,6 +14,7 @@ claim mid-flight wedges the tunnel for ~25 min; see CLAUDE.md).
 Run detached: nohup python scripts/tpu/claim_hunter.py &
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -41,6 +42,34 @@ def run_logged(label: str, cmd: list[str], out_path: str, env) -> int:
     return rc
 
 
+def bench_ran_on_chip(out_path: str) -> bool:
+    """True only when the LAST bench artifact in `out_path` reports an
+    accelerator device. bench.py exits 0 even when the claimed chip wedges
+    mid-run and it falls back to CPU (device=cpu-fallback) — a run like
+    that never refreshes last_good_tpu.json, so stopping the hunt on rc
+    alone could leave the cache unprimed forever."""
+    try:
+        with open(out_path) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return False
+    for line in reversed(lines):
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if "metric" not in obj:
+            continue
+        device = obj.get("device", "")
+        # the device note is the platform name ("tpu"/the plugin's name);
+        # absent on forced-CPU runs, "cpu"/"cpu-fallback" on fallbacks
+        return bool(device) and device not in ("cpu", "cpu-fallback")
+    return False
+
+
 def main() -> None:
     say(f"hunter start pid={os.getpid()} repo={REPO}")
     attempt = 0
@@ -62,7 +91,12 @@ def main() -> None:
             env["BENCH_TPU_PROBE_TIMEOUT"] = "1200"
             rc1 = run_logged(f"attempt {attempt} auto (shipped) path",
                              [sys.executable, "bench.py"], BENCH_OUT, env)
-            say(f"attempt {attempt}: bench auto rc={rc1}")
+            # judge the AUTO run's artifact now, before the scatter run
+            # appends its own JSON line to the same file — only the auto
+            # run refreshes last_good_tpu.json
+            auto_on_chip = bench_ran_on_chip(BENCH_OUT)
+            say(f"attempt {attempt}: bench auto rc={rc1} "
+                f"on_chip={auto_on_chip}")
             rc2 = run_logged(f"attempt {attempt} scatter A/B",
                              [sys.executable, "bench.py", "--scatter"],
                              BENCH_OUT, env)
@@ -72,11 +106,15 @@ def main() -> None:
                               "benchmarks/ingest_stage_profile.py"],
                              PROFILE_OUT, env)
             say(f"attempt {attempt}: stage profile rc={rc3}")
-            if rc1 == 0:
+            if rc1 == 0 and auto_on_chip:
                 say("hunter exiting: on-chip bench captured "
                     "(last_good_tpu.json refreshed)")
                 return
-            say("bench failed on the claimed chip; continuing to hunt")
+            if rc1 == 0:
+                say("bench exited 0 but the artifact reports a CPU "
+                    "fallback (chip wedged mid-run?); continuing to hunt")
+            else:
+                say("bench failed on the claimed chip; continuing to hunt")
         else:
             err_tail = (r.stderr or "").strip().splitlines()
             say(f"attempt {attempt}: failed after {dt:.0f}s "

@@ -31,6 +31,15 @@ else:
 
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    # tier-1 (ROADMAP.md) runs `-m 'not slow'`; the slow tier holds the
+    # live-kernel e2e suites and the 8-device-mesh compile-heavy suites
+    # (VERDICT weak #4: keep the default suite near its documented ~2 min)
+    config.addinivalue_line(
+        "markers", "slow: live-kernel / multi-device tests excluded from "
+        "the tier-1 run (use `-m slow` or no marker filter to include)")
+
 # The real-kernel suites (test_asm_flowpath, test_bpfman, test_prog_load) gate
 # on a mounted bpffs; as root, mount it (and tracefs, for the tracepoint
 # probes) up front so those tests actually run instead of silently skipping.

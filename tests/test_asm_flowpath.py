@@ -18,10 +18,13 @@ from netobserv_tpu.datapath import syscall_bpf as sb
 BPFFS = "/sys/fs/bpf"
 NS = "nvflow"
 
-pytestmark = pytest.mark.skipif(
-    not (os.geteuid() == 0 and shutil.which("tc") and shutil.which("ip")
-         and os.path.ismount(BPFFS) and sb.bpf_available()),
-    reason="needs root, tc/ip, bpffs, and CAP_BPF")
+pytestmark = [
+    pytest.mark.slow,  # live-kernel e2e: veth namespaces + real traffic
+    pytest.mark.skipif(
+        not (os.geteuid() == 0 and shutil.which("tc") and shutil.which("ip")
+             and os.path.ismount(BPFFS) and sb.bpf_available()),
+        reason="needs root, tc/ip, bpffs, and CAP_BPF"),
+]
 
 
 def _run(*cmd):

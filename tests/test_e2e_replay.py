@@ -137,6 +137,7 @@ def test_wall_times_are_current(exported_flows):
         assert abs(f["TimeFlowEndMs"] - now_ms) < 60_000
 
 
+@pytest.mark.slow  # full-binary subprocess e2e, minutes (VERDICT weak #4)
 def test_pcap_syn_flood_to_sketch_report(tmp_path):
     """FULL-BINARY anomaly e2e: a pcap carrying a spoofed SYN flood replayed
     through `python -m netobserv_tpu` with EXPORT=tpu-sketch — the flood

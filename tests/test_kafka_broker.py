@@ -278,6 +278,46 @@ def test_record_batch_roundtrip_through_consumer_decode():
     assert got == msgs
 
 
+def test_decode_tolerates_corrupt_short_batch_len():
+    """A corrupt batch_len in 1..48 (below the minimum v2 batch header)
+    must be treated like a partial trailing batch, not crash poll() with
+    struct.error on the header unpacks."""
+    import struct as _struct
+
+    from netobserv_tpu.kafka.consumer import decode_record_batches
+    from netobserv_tpu.kafka.producer import _record_batch
+
+    msgs = [(b"k", b"v")]
+    good = _record_batch(msgs)
+    # batch_len in 1..4: too short to even hold the magic byte — must not
+    # peek past the batch end and misroute down the legacy path
+    runt = _struct.pack(">q", 7) + _struct.pack(">i", 2) + b"\x00\x00"
+    got, next_off = decode_record_batches(good + runt + good)
+    assert got == msgs  # parse stops at the runt; no desync into garbage
+    assert next_off == 1
+    for bad_len in (5, 17, 48):
+        # a v2-magic batch whose batch_len is below the 49-byte header
+        # minimum, blob truncated exactly at end (the broker fetch-size
+        # boundary shape): the header unpacks at +57..61 would crash
+        corrupt = (_struct.pack(">q", 7) + _struct.pack(">i", bad_len)
+                   + b"\x00\x00\x00\x00\x02" + b"\x00" * (bad_len - 5))
+        # corrupt tail after a good batch: the good one still decodes
+        got, next_off = decode_record_batches(good + corrupt)
+        assert got == msgs
+        assert next_off == 1
+        # corrupt blob alone: no records, no crash
+        got, next_off = decode_record_batches(corrupt)
+        assert got == []
+        assert next_off is None
+    # a LEGACY (v0/v1) message set shorter than 49 bytes is not corrupt:
+    # the offset must still advance past it (no poll() re-fetch loop)
+    legacy = _struct.pack(">q", 7) + _struct.pack(">i", 17) \
+        + b"\x00\x00\x00\x00\x01" + b"\x00" * 12
+    got, next_off = decode_record_batches(good + legacy)
+    assert got == msgs
+    assert next_off == 8  # advanced past the legacy batch at offset 7
+
+
 def test_consumer_fetches_what_producer_sent(broker):
     from netobserv_tpu.kafka.consumer import KafkaConsumer
 

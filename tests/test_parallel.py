@@ -10,6 +10,14 @@ import tests.conftest  # noqa: F401
 import jax
 import jax.numpy as jnp
 
+# 8-virtual-device mesh compile-and-EXECUTE tests dominate tier-1 wall
+# time (VERDICT weak #4): slow tier, with `make dryrun` covering multichip
+# sharding in the default gate. The two *_has_no_collectives HLO-text
+# checks stay UN-marked: they only lower (no device execution) and they
+# pin the CLAUDE.md steady-state no-collectives invariant — that guard
+# must stay inside the tier-1 keep-it-green loop.
+slow = pytest.mark.slow
+
 from netobserv_tpu.parallel import make_mesh, MeshSpec, merge as pmerge
 from netobserv_tpu.sketch import state as sk
 
@@ -48,6 +56,7 @@ def single_device_report(arrays):
     return report
 
 
+@slow
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4)])
 def test_sharded_matches_single_device(mesh_shape):
     """Exactness: with a key universe that fits every local table, the merged
@@ -112,6 +121,7 @@ def test_sharded_matches_single_device(mesh_shape):
 arrays_to_dense = sk.arrays_to_dense
 
 
+@slow
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
 def test_sharded_dense_matches_dict_transport(mesh_shape):
     """The dense (single-transfer) sharded ingest must produce the same
@@ -135,6 +145,7 @@ def test_sharded_dense_matches_dict_transport(mesh_shape):
         np.asarray(a), np.asarray(b)), d1, d2)
 
 
+@slow
 def test_topk_recall_skewed():
     """On zipf-skewed traffic (the realistic heavy-hitter regime) the merged
     distributed table recalls the true global top keys."""
@@ -171,6 +182,7 @@ def test_topk_recall_skewed():
     assert hits / check_k >= 0.95, f"recall {hits}/{check_k}"
 
 
+@slow
 def test_multiple_windows_and_state_reset():
     mesh = make_mesh(MeshSpec(data=4, sketch=2))
     rng = np.random.default_rng(1)
@@ -188,6 +200,7 @@ def test_multiple_windows_and_state_reset():
     assert float(jnp.sum(dist.total_records)) == 0.0
 
 
+@slow
 def test_ddos_alarm_travels_through_merge():
     mesh = make_mesh(MeshSpec(data=8, sketch=1))
     rng = np.random.default_rng(2)
@@ -207,6 +220,7 @@ def test_ddos_alarm_travels_through_merge():
     assert bool((report.ddos_z > 6.0).any())
 
 
+@slow
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
 def test_staging_ring_sharded_dense_token(mesh_shape):
     """The production distributed exporter combination — DenseStagingRing +
@@ -284,6 +298,7 @@ def test_steady_state_ingest_has_no_collectives(mesh_shape):
     assert any(c in hlo_roll for c in ("all-reduce", "all-gather"))
 
 
+@slow
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
 def test_shard_dense_per_device_equivalent(mesh_shape):
     """Explicit per-device placement (N independent DMAs — the multi-chip
@@ -307,6 +322,7 @@ def test_shard_dense_per_device_equivalent(mesh_shape):
         np.asarray(x), np.asarray(y)), d1, d2)
 
 
+@slow
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
 def test_sharded_resident_feed_matches_dense(mesh_shape):
     """The sharded RESIDENT feed (per-data-shard dictionaries + device key

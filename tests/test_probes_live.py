@@ -39,12 +39,15 @@ def _have_kprobes() -> bool:
                 "/sys/kernel/debug/tracing/kprobe_events")))
 
 
-pytestmark = pytest.mark.skipif(
-    not (os.geteuid() == 0 and shutil.which("ip")
-         and os.path.ismount("/sys/fs/bpf") and sb.bpf_available()
-         and os.path.exists(OBJ) and os.path.exists(PROBES_OBJ)
-         and libbpf.available() and _have_kprobes()),
-    reason="needs root, bpffs, kprobes, libbpf, and the clang objects")
+pytestmark = [
+    pytest.mark.slow,  # live-kernel kprobe e2e: xfrm/nat/psample rigs
+    pytest.mark.skipif(
+        not (os.geteuid() == 0 and shutil.which("ip")
+             and os.path.ismount("/sys/fs/bpf") and sb.bpf_available()
+             and os.path.exists(OBJ) and os.path.exists(PROBES_OBJ)
+             and libbpf.available() and _have_kprobes()),
+        reason="needs root, bpffs, kprobes, libbpf, and the clang objects"),
+]
 
 
 def _run(*cmd, check=True):

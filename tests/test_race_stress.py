@@ -28,6 +28,7 @@ def _limiter_dropped(agent) -> int:
     return int(v or 0)
 
 
+@pytest.mark.slow  # ~1 min sustained-load soak (VERDICT weak #4 tiering)
 def test_concurrent_injection_conserves_records():
     """Many threads inject eviction batches while the agent drains, flushes,
     and exports; every injected flow key must come out exactly once (the
@@ -93,6 +94,7 @@ def test_concurrent_injection_conserves_records():
         assert not t.is_alive(), "agent failed to stop under load"
 
 
+@pytest.mark.slow  # ~10 s flush-race soak (VERDICT weak #4 tiering)
 def test_concurrent_flush_and_inject():
     """Flush broadcasts racing steady-state evictions must neither deadlock
     nor drop the in-flight batches (MapTracer Flush path)."""

@@ -81,6 +81,34 @@ def test_native_matches_python_twin():
     kd_n.close()
 
 
+def test_native_matches_python_twin_on_overflowing_latency():
+    """A DNS latency >= 2^32 µs must WRAP identically on both packers in the
+    spill lane ((uint32_t) cast in flowpack.cc; np.uint32(dlat) used to raise
+    OverflowError in the python twin instead)."""
+    caps = flowpack.default_resident_caps(B)
+    kd_n = flowpack.KeyDict(1 << 12, use_native=True)
+    kd_p = flowpack.KeyDict(1 << 12, use_native=False)
+    (events, feats), = make_feed(n_batches=1)
+    # dlat_us = latency_ns // 1000 = 2^32 + 7 -> wraps to 7 in the u32 column
+    feats["dns"]["latency_ns"][:4] = ((1 << 32) + 7) * 1000
+    # force those rows OFF the hot lane (packets over the 11-bit packed
+    # budget) so they take the full-width spill row where the cast lives
+    events["stats"]["packets"][:4] = 0x900
+    start = 0
+    n_spilled = 0
+    while start < len(events):
+        bn, cn = flowpack.pack_resident(events, B, kd_n, caps,
+                                        start=start, **feats)
+        bp, cp = flowpack.pack_resident(events, B, kd_p, caps,
+                                        start=start, **feats)
+        assert cn == cp and cn > 0
+        assert np.array_equal(bn, bp)
+        n_spilled += int(bn[2])
+        start += cn
+    assert n_spilled >= 4  # the overflowing rows actually rode the spill lane
+    kd_n.close()
+
+
 def test_rtt_code_roundtrip_error_bound():
     # 11-bit code: m << (2e); relative error < 2^-8 within the code range
     for v in [0, 1, 255, 256, 1000, 4095, 65535, 1 << 20, flowpack.RTT_MAX_US]:

@@ -1,0 +1,521 @@
+"""Chaos suite for the supervision layer (agent/supervisor.py).
+
+For every supervised pipeline stage: an injected crash AND an injected hang
+each recover within one restart cycle (restart counter +1, the health
+surface reflects the transition, the agent process never exits), and an
+exhausted restart budget yields an explicit DEGRADED status — never a
+silent stall. Also pins the two invariants the layer must not break:
+exporter errors stay swallowed+counted (no restart), and fault injection is
+zero-cost when nothing is armed.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from netobserv_tpu.agent import FlowsAgent, Status
+from netobserv_tpu.agent.supervisor import StageState, Supervisor
+from netobserv_tpu.config import load_config
+from netobserv_tpu.datapath.fetcher import FakeFetcher
+from netobserv_tpu.exporter.base import Exporter
+from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+from netobserv_tpu.utils import faultinject
+from netobserv_tpu.utils.faultinject import FaultInjected
+
+from tests.test_pipeline import CollectExporter, make_events
+
+# injected crashes ARE unhandled thread exceptions — that is the scenario
+# under test; don't let pytest's threadexception plugin spam the summary
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+# fast supervision constants for chaos runs: sub-second detection and
+# restart so the whole suite stays in tier-1 budget
+FAST_SUP = {
+    "SUPERVISOR_CHECK_PERIOD": "50ms",
+    "SUPERVISOR_BACKOFF_INITIAL": "50ms",
+    "SUPERVISOR_BACKOFF_MAX": "200ms",
+    # stages beat every <=0.2s when idle, but a loaded CI box can stall a
+    # healthy thread well past that — keep enough slack that only an
+    # INJECTED hang trips the deadline (a 600ms deadline flaked under
+    # full-suite load)
+    "SUPERVISOR_HEARTBEAT_TIMEOUT": "2s",
+    "SUPERVISOR_HEALTHY_RESET": "30s",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faultinject.clear()
+    faultinject.hits.clear()
+    # give released zombie threads a beat to die before the next test
+    time.sleep(0.05)
+
+
+class FakeInformer:
+    def __init__(self):
+        self.q: queue.Queue = queue.Queue()
+
+    def subscribe(self):
+        return self.q
+
+    def stop(self):
+        pass
+
+
+def make_agent(fake=None, exporter=None, informer=None, **env):
+    cfg = load_config(environ={
+        "EXPORT": "stdout", "CACHE_ACTIVE_TIMEOUT": "100ms",
+        "BUFFERS_LENGTH": "10", **FAST_SUP, **env})
+    fake = fake or FakeFetcher()
+    exporter = exporter or CollectExporter()
+    agent = FlowsAgent(cfg, fake, exporter, iface_informer=informer)
+    return agent, fake, exporter
+
+
+def start_agent(agent):
+    stop = threading.Event()
+    t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while agent.status != Status.STARTED and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert agent.status == Status.STARTED
+    return stop, t
+
+
+def wait_for(pred, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# (stage name, fault point, extra env, needs informer)
+STAGES = [
+    ("map-tracer", "map_tracer.evict", {}, False),
+    ("capacity-limiter", "limiter.forward", {}, False),
+    ("exporter", "exporter.loop", {}, False),
+    ("accounter", "accounter.loop",
+     {"ENABLE_FLOWS_RINGBUF_FALLBACK": "true"}, False),
+    ("ringbuf-tracer", "ringbuf_tracer.read",
+     {"ENABLE_FLOWS_RINGBUF_FALLBACK": "true"}, False),
+    ("ssl-tracer", "ssl_tracer.read",
+     {"ENABLE_OPENSSL_TRACKING": "true"}, False),
+    ("iface-listener", "iface_listener.loop", {}, True),
+]
+
+
+@pytest.mark.parametrize("stage,point,env,informer",
+                         [pytest.param(*s, id=s[0]) for s in STAGES])
+def test_stage_crash_and_hang_recover(stage, point, env, informer):
+    """The acceptance matrix: per stage, one crash and one hang, each
+    recovered within one restart cycle; the agent never exits."""
+    agent, fake, _out = make_agent(
+        informer=FakeInformer() if informer else None, **env)
+    stop, t = start_agent(agent)
+    try:
+        snap = agent.supervisor.snapshot()
+        assert snap[stage]["state"] == "Running"
+
+        # --- crash: the stage thread dies on an injected exception ---
+        faultinject.arm(point, "crash", times=1)
+        wait_for(lambda: faultinject.hits.get(point, 0) >= 1,
+                 msg=f"{point} crash to fire")
+        wait_for(lambda: agent.supervisor.snapshot()[stage]["restarts"] >= 1
+                 and agent.supervisor.snapshot()[stage]["state"] == "Running",
+                 msg=f"{stage} restart after crash")
+        assert agent.status == Status.STARTED  # never exited, not degraded
+        assert t.is_alive()
+        crash_snap = agent.supervisor.snapshot()[stage]
+        assert crash_snap["last_failure"] == "crash"
+        after_crash = crash_snap["restarts"]
+
+        # --- hang: the stage thread stops beating but stays alive ---
+        faultinject.arm(point, "hang", times=1)
+        wait_for(lambda: agent.supervisor.snapshot()[stage]["restarts"]
+                 > after_crash
+                 and agent.supervisor.snapshot()[stage]["state"] == "Running",
+                 timeout=10, msg=f"{stage} restart after hang")
+        hang_snap = agent.supervisor.snapshot()[stage]
+        assert hang_snap["last_failure"] == "hang"
+        assert agent.status == Status.STARTED
+        assert t.is_alive()
+        # restart counters surfaced in the metrics registry too
+        assert agent.metrics.stage_restarts_total.labels(
+            stage)._value.get() >= 2
+        faultinject.clear()  # release the zombie before shutdown
+    finally:
+        faultinject.clear()
+        stop.set()
+        t.join(timeout=8)
+    assert agent.status == Status.STOPPED
+
+
+def test_pipeline_keeps_flowing_after_stage_crash():
+    """No records lost beyond the documented queue bound: a limiter crash
+    mid-stream delays batches (bounded queues hold them) but every record
+    still reaches the exporter after the restart."""
+    agent, fake, out = make_agent()
+    stop, t = start_agent(agent)
+    try:
+        faultinject.arm("limiter.forward", "crash", times=1)
+        wait_for(lambda: faultinject.hits.get("limiter.forward", 0) >= 1,
+                 msg="limiter crash to fire")
+        total = 0
+        for i in range(3):
+            fake.inject_events(make_events(4, sport0=1000 + 10 * i))
+            total += 4
+        got = 0
+        deadline = time.monotonic() + 8
+        while got < total and time.monotonic() < deadline:
+            try:
+                got += len(out.batches.get(timeout=0.5))
+            except queue.Empty:
+                continue
+        assert got == total, f"lost records across restart: {got}/{total}"
+        assert agent.supervisor.snapshot()["capacity-limiter"]["restarts"] >= 1
+    finally:
+        faultinject.clear()
+        stop.set()
+        t.join(timeout=8)
+
+
+def test_exhausted_budget_degrades_not_stalls():
+    """A stage that keeps dying past its budget => explicit DEGRADED agent
+    status + tripped gauge; the process and the other stages stay up."""
+    agent, fake, out = make_agent(SUPERVISOR_MAX_RESTARTS="1")
+    stop, t = start_agent(agent)
+    try:
+        faultinject.arm("limiter.forward", "crash")  # unlimited: crash loop
+        wait_for(lambda: agent.supervisor.degraded, timeout=10,
+                 msg="supervisor degraded")
+        snap = agent.supervisor.snapshot()["capacity-limiter"]
+        assert snap["state"] == "Degraded"
+        wait_for(lambda: agent.status == Status.DEGRADED,
+                 msg="agent status Degraded")
+        assert t.is_alive()  # degraded, but the agent process never exits
+        assert agent.metrics.stage_degraded.labels(
+            "capacity-limiter")._value.get() == 1
+        # the rest of the pipeline is still being supervised and running
+        assert agent.supervisor.snapshot()["map-tracer"]["state"] == "Running"
+        assert agent.supervisor.snapshot()["exporter"]["state"] == "Running"
+    finally:
+        faultinject.clear()
+        stop.set()
+        t.join(timeout=8)
+    assert agent.status == Status.STOPPED
+
+
+def test_healthz_and_readyz_reflect_transitions():
+    """The health endpoints answer machine-readably through healthy ->
+    restarted -> degraded, on the existing metrics server."""
+    from netobserv_tpu.metrics.server import start_metrics_server
+
+    agent, fake, out = make_agent(SUPERVISOR_MAX_RESTARTS="1")
+    srv = start_metrics_server(agent.metrics.registry, "127.0.0.1", 0,
+                               health_source=agent.health_snapshot)
+    port = srv.server_address[1]
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    stop, t = start_agent(agent)
+    try:
+        code, body = get("/healthz")
+        assert code == 200
+        assert body["status"] == "Started" and not body["degraded"]
+        assert body["stages"]["map-tracer"]["state"] == "Running"
+        code, _ = get("/readyz")
+        assert code == 200
+
+        # one crash: healthz shows the restart
+        faultinject.arm("map_tracer.evict", "crash", times=1)
+        wait_for(lambda: get("/healthz")[1]
+                 ["stages"]["map-tracer"]["restarts"] >= 1,
+                 msg="healthz to show the restart")
+        code, body = get("/healthz")
+        assert code == 200
+        assert body["stages"]["map-tracer"]["last_failure"] == "crash"
+
+        # budget exhaustion: ready flips 503, healthz stays live + explicit
+        faultinject.arm("map_tracer.evict", "crash")
+        wait_for(lambda: get("/readyz")[0] == 503, timeout=10,
+                 msg="readyz to flip 503")
+        code, body = get("/healthz")
+        assert code == 200  # alive (don't make the kubelet kill the pod)
+        assert body["status"] == "Degraded" and body["degraded"]
+        assert body["stages"]["map-tracer"]["state"] == "Degraded"
+        # /metrics still serves alongside the health surface
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+            assert b"stage_restarts_total" in resp.read()
+
+        faultinject.clear()
+        stop.set()
+        t.join(timeout=8)
+        code, _body = get("/healthz")
+        assert code == 503  # Stopped: liveness finally fails
+    finally:
+        faultinject.clear()
+        stop.set()
+        t.join(timeout=8)
+        srv.shutdown()
+
+
+def test_exporter_errors_still_swallowed_not_restarted():
+    """CLAUDE.md invariant: QueueExporter swallows+counts exporter errors.
+    An exporter that throws must produce export_errors_total increments and
+    ZERO supervisor restarts — then keep exporting when it recovers."""
+    agent, fake, out = make_agent()
+    stop, t = start_agent(agent)
+    try:
+        faultinject.arm("exporter.export", "crash", times=1)
+        fake.inject_events(make_events(3))
+        wait_for(lambda: faultinject.hits.get("exporter.export", 0) >= 1,
+                 msg="exporter fault to fire")
+        # the batch hit the armed fault and was counted as an export error
+        wait_for(lambda: agent.metrics.export_errors_total.labels(
+            "collect", "FaultInjected")._value.get() >= 1,
+            msg="export error counted")
+        # the terminal stage thread was NEVER restarted: errors raised BY
+        # the exporter are not stage failures
+        snap = agent.supervisor.snapshot()["exporter"]
+        assert snap["restarts"] == 0 and snap["state"] == "Running"
+        # recovered: later batches flow
+        fake.inject_events(make_events(2, sport0=7000))
+        batch = out.batches.get(timeout=5)
+        assert len(batch) == 2
+    finally:
+        faultinject.clear()
+        stop.set()
+        t.join(timeout=8)
+
+
+def test_sketch_window_timer_crash_restarts_and_roll_errors_swallowed():
+    """The tpu-sketch window timer: a crash in the timer stage itself is
+    supervisor territory (restart); an error raised during the roll stays
+    swallowed+counted (the exporter-never-kills-the-pipeline invariant)."""
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+
+    metrics = Metrics(MetricsSettings())
+    exp = TpuSketchExporter.__new__(TpuSketchExporter)  # timer harness only
+    exp._window_s = 0.5
+    exp._lock = threading.Lock()
+    exp._metrics = metrics
+    exp._sink = lambda obj: None
+    exp._window_deadline = time.monotonic() + 1e9  # never actually roll
+    exp._closed = threading.Event()
+    exp.heartbeat = lambda: None
+    exp._timer = None
+    exp.start_window_timer()
+
+    sup = Supervisor(metrics=metrics, check_period_s=0.05)
+    exp.register_supervised(sup, heartbeat_timeout_s=2.0,
+                            max_restarts=3, backoff_initial_s=0.05,
+                            backoff_max_s=0.2, healthy_reset_s=30.0)
+    sup.start()
+    try:
+        # roll-path error: swallowed and counted, timer thread stays up
+        faultinject.arm("sketch.window_roll", "crash", times=2)
+        wait_for(lambda: metrics.errors_total.labels(
+            "tpu-sketch", "error")._value.get() >= 2,
+            msg="roll errors counted")
+        assert exp._timer.is_alive()
+        assert sup.snapshot()["sketch-window"]["restarts"] == 0
+
+        # timer-stage crash: the supervisor restarts the thread
+        faultinject.arm("sketch.window_timer", "crash", times=1)
+        wait_for(lambda: sup.snapshot()["sketch-window"]["restarts"] >= 1,
+                 msg="window timer restart")
+        assert exp._timer.is_alive()
+        after_crash = sup.snapshot()["sketch-window"]["restarts"]
+
+        # timer-stage hang: heartbeat deadline catches it
+        faultinject.arm("sketch.window_timer", "hang", times=1)
+        wait_for(lambda: sup.snapshot()["sketch-window"]["restarts"]
+                 > after_crash, timeout=10, msg="window timer hang restart")
+    finally:
+        faultinject.clear()
+        sup.stop()
+        exp._closed.set()
+        exp._timer.join(timeout=2)
+
+
+def test_ingest_error_rolls_resident_dict_epoch():
+    """A dropped batch may have carried slot definitions the device table
+    never received: the counted-drop recovery must roll the resident
+    dictionary epoch (CLAUDE.md resident-feed contract) — and must leave
+    dictionary-less (dense) rings alone."""
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+
+    metrics = Metrics(MetricsSettings())
+    exp = TpuSketchExporter.__new__(TpuSketchExporter)
+    exp._metrics = metrics
+
+    class FakeKD:
+        resets = 0
+
+        def reset(self):
+            self.resets += 1
+
+    class ResidentRing:
+        def __init__(self):
+            self.kdict = FakeKD()
+            self.dict_resets = 0
+
+    exp._ring = ResidentRing()
+    exp._count_ingest_error(8, RuntimeError("device lost"))
+    assert exp._ring.kdict.resets == 1
+    assert exp._ring.dict_resets == 1
+    assert metrics.sketch_ingest_errors_total._value.get() == 1
+
+    class DenseRing:  # no kdict/kdicts: full keys ship every batch
+        pass
+
+    exp._ring = DenseRing()
+    exp._count_ingest_error(8, RuntimeError("device lost"))  # no crash
+
+
+def test_staging_wait_fault_seam():
+    """The resident/dense staging feed exposes a chaos seam at the slot
+    wait — a wedged device stalls the fold there, which surfaces as an
+    exporter-stage hang to the supervisor."""
+    pytest.importorskip("jax")
+    from netobserv_tpu.sketch import staging
+
+    ring = staging.DenseStagingRing(64, ingest=lambda s, d: (s, d))
+    faultinject.arm("sketch.staging_wait", "crash", times=1)
+    with pytest.raises(FaultInjected):
+        ring._wait_slot()
+    # disarmed again: the seam is transparent
+    assert ring._wait_slot() == 0
+
+
+class _Boom(Exception):
+    pass
+
+
+class BoomExporter(Exporter):
+    name = "boom"
+
+    def export_batch(self, records):
+        raise _Boom("exporter outage")
+
+
+def test_degraded_exporter_spills_and_counts():
+    """Persistent exporter failure = graceful degradation, not stage death:
+    every batch is swallowed+counted while the pipeline keeps running."""
+    agent, fake, _ = make_agent(exporter=BoomExporter())
+    stop, t = start_agent(agent)
+    try:
+        for i in range(3):
+            fake.inject_events(make_events(2, sport0=2000 + 10 * i))
+        wait_for(lambda: agent.metrics.export_errors_total.labels(
+            "boom", "_Boom")._value.get() >= 3, msg="outage batches counted")
+        snap = agent.supervisor.snapshot()["exporter"]
+        assert snap["state"] == "Running" and snap["restarts"] == 0
+        assert agent.status == Status.STARTED
+    finally:
+        stop.set()
+        t.join(timeout=8)
+
+
+# --- fault-injection seam unit behavior ---
+
+def test_fire_disarmed_is_identity_and_cheap():
+    """FAULT_POINTS unset => fire() returns its payload by identity on a
+    one-branch path; the bound below is ~50x slack over measured cost so
+    it only fails if somebody puts real work on the disarmed path."""
+    payload = object()
+    assert faultinject.fire("whatever", payload) is payload
+    assert not faultinject.armed("whatever")
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        faultinject.fire("bench.hot", payload)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"disarmed fault point too expensive: {dt:.3f}s/100k"
+
+
+def test_fire_corrupt_and_delay_and_env_config():
+    faultinject.arm("p.corrupt", "corrupt")
+    raw = b"\x12\x34\x56\x78" * 4
+    mangled = faultinject.fire("p.corrupt", raw)
+    assert mangled != raw and len(mangled) <= len(raw)
+    faultinject.clear("p.corrupt")
+
+    faultinject.arm("p.delay", "delay", arg=0.05)
+    t0 = time.perf_counter()
+    assert faultinject.fire("p.delay", 7) == 7
+    assert time.perf_counter() - t0 >= 0.05
+    faultinject.clear()
+
+    # env-style spec parsing
+    faultinject.configure("a.b:crash:0:2;c.d:delay:0.01")
+    assert faultinject.armed("a.b") and faultinject.armed("c.d")
+    with pytest.raises(FaultInjected):
+        faultinject.fire("a.b")
+    with pytest.raises(FaultInjected):
+        faultinject.fire("a.b")
+    assert not faultinject.armed("a.b")  # times=2 exhausted
+    faultinject.clear()
+    with pytest.raises(ValueError):
+        faultinject.configure("nonsense")
+    with pytest.raises(ValueError):
+        faultinject.arm("x", "explode")
+
+
+def test_clear_by_name_releases_exhausted_hang():
+    """A bounded-`times` hang is popped from the armed set at fire time;
+    clear(name) must still release the thread blocked inside it."""
+    done = threading.Event()
+
+    def worker():
+        try:
+            faultinject.fire("p.hang")
+        except SystemExit:
+            done.set()
+
+    faultinject.arm("p.hang", "hang", times=1)
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    wait_for(lambda: faultinject.hits.get("p.hang", 0) == 1,
+             msg="hang to fire")
+    assert not faultinject.armed("p.hang")  # exhausted, yet still blocking
+    faultinject.clear("p.hang")
+    wait_for(done.is_set, msg="named clear to release the hang")
+    t.join(timeout=2)
+
+
+def test_corrupt_ringbuf_event_is_counted_not_fatal():
+    """End-to-end corrupt action: a mangled ringbuf event takes the
+    bad-size path (logged, skipped); the tracer thread survives."""
+    agent, fake, out = make_agent(ENABLE_FLOWS_RINGBUF_FALLBACK="true")
+    stop, t = start_agent(agent)
+    try:
+        faultinject.arm("ringbuf_tracer.read", "corrupt", times=1)
+        fake.inject_ringbuf(make_events(1))
+        wait_for(lambda: faultinject.hits.get("ringbuf_tracer.read", 0) >= 1,
+                 msg="corrupt fault to fire")
+        time.sleep(0.3)  # give a mis-parse a chance to kill the thread
+        snap = agent.supervisor.snapshot()["ringbuf-tracer"]
+        assert snap["state"] == "Running" and snap["restarts"] == 0
+    finally:
+        faultinject.clear()
+        stop.set()
+        t.join(timeout=8)
