@@ -22,6 +22,11 @@ bench:
 bench-cpu:
 	JAX_PLATFORMS=cpu $(PY) bench.py
 
+# host path only (~15s): pack/transfer/fold rates, pack-thread scaling,
+# roll-stall — the per-PR CI artifact (no device ingest loop, no oracle)
+bench-host:
+	JAX_PLATFORMS=cpu $(PY) bench.py --host-only
+
 gen-protobuf:
 	protoc --python_out=netobserv_tpu/pb -I proto proto/flow.proto proto/packet.proto
 

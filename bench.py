@@ -169,13 +169,82 @@ def check_recall(state, feed, universe, pool) -> float:
     return hits / k
 
 
-def host_path_stats(seconds: float = 8.0) -> dict:
+def resolved_pack_threads() -> int:
+    """SKETCH_PACK_THREADS resolved through AgentConfig.resolved_pack_threads
+    — ONE definition of the 0 = auto rule, so the benched thread count is
+    exactly the shipped agent's."""
+    from netobserv_tpu.config import AgentConfig
+    want = int(os.environ.get("SKETCH_PACK_THREADS", "0") or 0)
+    return AgentConfig(sketch_pack_threads=want).resolved_pack_threads()
+
+
+def lane_pack_rate(full, feats, n_threads: int, seconds: float = 1.2) -> float:
+    """Pure pack-stage rate of the LANE-SHARDED resident pack at
+    `n_threads`: the batch splits into that many lanes, each with its own
+    KeyDict and buffer region, packed on the shared pool (the native pack
+    releases the GIL, so lanes pack in true parallel — the
+    `SKETCH_PACK_THREADS` scaling evidence for docs/tpu_sketch.md)."""
+    from netobserv_tpu.datapath import flowpack
+    from netobserv_tpu.sketch import staging
+
+    lanes = staging.pick_lanes(BATCH, n_threads)
+    caps = flowpack.default_resident_caps(BATCH // lanes)
+    words = flowpack.resident_buf_len(BATCH // lanes, caps)
+    kds = [flowpack.KeyDict(1 << 18) for _ in range(lanes)]
+    buf = np.empty(lanes * words, np.uint32)
+    bounds = [BATCH * i // lanes for i in range(lanes + 1)]
+
+    def pack_batch(j):
+        ev, fts = full[j % len(full)], feats[j % len(full)]
+
+        def one(i):
+            # continuation-aware: a cold lane dictionary can fill the
+            # new-key lane mid-chunk; production ships the prefix and
+            # continues — the measured stage must do the same work
+            region = buf[i * words:(i + 1) * words]
+            seg = ev[bounds[i]:bounds[i + 1]]
+            sf = {k: (v[bounds[i]:bounds[i + 1]] if v is not None else None)
+                  for k, v in fts.items()}
+            start = 0
+            while start < len(seg):
+                if kds[i].count() >= kds[i].slot_cap:
+                    kds[i].reset()  # epoch roll, like the production ring
+                _, c = flowpack.pack_resident(
+                    seg, batch_size=BATCH // lanes, kdict=kds[i], caps=caps,
+                    start=start, out=region, **sf)
+                if c == 0:
+                    raise RuntimeError("resident pack made no progress")
+                start += c
+        if lanes > 1:
+            for f in flowpack._pack_submit(
+                    lanes, [lambda i=i: one(i) for i in range(lanes)]):
+                f.result()
+        else:
+            one(0)
+
+    for j in range(len(full)):  # warm the lane dictionaries
+        pack_batch(j)
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        pack_batch(n)
+        n += 1
+    rate = n * BATCH / (time.perf_counter() - t0)
+    for kd in kds:
+        kd.close()
+    return rate
+
+
+def host_path_stats(seconds: float = 8.0,
+                    pack_threads: int | None = None) -> dict:
     """Full host-path throughput: synthetic eviction bytes -> native
     single-pass pack (flowpack.cc) -> ONE device_put per batch -> async
-    ingest dispatch, pipelined by the SAME ResidentStagingRing the
-    production exporter uses (sketch/staging.py) so the measured path is
-    the shipped path. The resident feed ships ~15 bytes/record (hot rows
-    reference a device-resident key table by 20-bit slot id; byte budget in
+    ingest dispatch, pipelined by the SAME staging ring the production
+    exporter uses (sketch/staging.py) so the measured path is the shipped
+    path — the lane-sharded resident ring when SKETCH_PACK_THREADS engages
+    more than one packer thread, the single-lane ring otherwise. The
+    resident feed ships ~15 bytes/record (hot rows reference a
+    device-resident key table by 20-bit slot id; byte budget in
     docs/tpu_sketch.md) — the transfer link, not compute, bounds this path.
     The reference's analog hot spot is its per-record decode
     (pkg/model/record_bench_test.go).
@@ -183,23 +252,46 @@ def host_path_stats(seconds: float = 8.0) -> dict:
     Measured in ~1s segments: `host_path_burst` = best segment (the path's
     capability on a healthy link), `host_path_sustained` = median segment
     (what a throttling tunnel actually delivers); every segment rate is
-    reported so the spread is visible, plus the pack/put stage split and
-    the measured bytes/record + link rate (the byte-budget evidence)."""
+    reported (p10/p90 bound the spread), plus per-fold latency p50/p99,
+    the pack-thread scaling ladder, the put stage split and the measured
+    bytes/record + link rate (the byte-budget evidence)."""
     import jax
 
     from netobserv_tpu.datapath import flowpack
     from netobserv_tpu.datapath.replay import SyntheticFetcher
-    from netobserv_tpu.sketch import state as sk
-    from netobserv_tpu.sketch.staging import ResidentStagingRing
+    from netobserv_tpu.sketch import staging, state as sk
+    from netobserv_tpu.sketch.staging import (
+        ResidentStagingRing, ShardedResidentStagingRing,
+    )
 
     flowpack.build_native()
+    if pack_threads is None:
+        pack_threads = resolved_pack_threads()
     cfg = sk.SketchConfig()
     state = sk.init_state(cfg)
-    caps = flowpack.default_resident_caps(BATCH)
-    ring = ResidentStagingRing(
-        BATCH, sk.make_ingest_resident_fn(BATCH, caps, donate=True,
-                                          with_token=True),
-        caps=caps)
+    # the RING mirrors the exporter's lane gate (explicit SKETCH_PACK_
+    # THREADS engages lanes; auto only on >= 4 cores) so the segment rates
+    # measure the shipped path; the pack LADDER below still measures every
+    # thread count so scaling stays visible on any host
+    explicit = int(os.environ.get("SKETCH_PACK_THREADS", "0") or 0) > 0
+    ring_threads = pack_threads if (explicit or (os.cpu_count() or 1) >= 4) \
+        else 1
+    lanes = staging.pick_lanes(BATCH, ring_threads)
+    if lanes > 1:
+        caps = flowpack.default_resident_caps(BATCH // lanes)
+        ring = ShardedResidentStagingRing(
+            BATCH, 1,
+            sk.make_ingest_resident_lanes_fn(BATCH // lanes, caps, lanes,
+                                             donate=True),
+            key_tables=jax.device_put(sk.init_key_tables(lanes, 1 << 18)),
+            put=jax.device_put, caps=caps, slot_cap=1 << 18,
+            pack_threads=pack_threads, lanes=lanes)
+    else:
+        caps = flowpack.default_resident_caps(BATCH)
+        ring = ResidentStagingRing(
+            BATCH, sk.make_ingest_resident_fn(BATCH, caps, donate=True,
+                                              with_token=True),
+            caps=caps)
     fetcher = SyntheticFetcher(flows_per_eviction=BATCH, n_distinct=N_DISTINCT)
     # pre-generate evictions and concatenate into FULL batches, the way the
     # exporter accumulates them (padding only at window close); the load
@@ -234,10 +326,11 @@ def host_path_stats(seconds: float = 8.0) -> dict:
         state = ring.fold(state, full[bi], **feats[bi])
     jax.block_until_ready(state)
     ring.drain()
-    buf_bytes = flowpack.resident_buf_len(BATCH, caps) * 4
+    buf_bytes = lanes * flowpack.resident_buf_len(BATCH // lanes, caps) * 4
 
     seg_rates = []
     seg_bytes = []
+    fold_s: list[float] = []  # per-fold wall latency (the exporter seam)
     i = 0
     t_end = time.perf_counter() + seconds
     while time.perf_counter() < t_end:
@@ -245,8 +338,10 @@ def host_path_stats(seconds: float = 8.0) -> dict:
         chunk0 = ring.continuations
         t0 = time.perf_counter()
         while time.perf_counter() - t0 < 1.0:
+            f0 = time.perf_counter()
             state = ring.fold(state, full[i % len(full)],
                               **feats[i % len(full)])
+            fold_s.append(time.perf_counter() - f0)
             n += BATCH
             i += 1
         jax.block_until_ready(state)
@@ -258,36 +353,42 @@ def host_path_stats(seconds: float = 8.0) -> dict:
     print(f"host-path segments: {[round(r / 1e6, 2) for r in seg_rates]} "
           "M rec/s", file=sys.stderr)
 
-    # stage split: pack alone (reused buffer, warm dictionary), put alone
-    buf = np.empty(flowpack.resident_buf_len(BATCH, caps), np.uint32)
-
-    def stage_rate(fn, seconds=1.5):
-        fn(0)  # warm
-        n = 0
-        t0 = time.perf_counter()
-        while time.perf_counter() - t0 < seconds:
-            fn(n)
-            n += 1
-        return n * BATCH / (time.perf_counter() - t0)
-
-    def pack_stage(j):
-        _, consumed = flowpack.pack_resident(
-            full[j % len(full)], batch_size=BATCH, kdict=ring.kdict,
-            caps=caps, out=buf, **feats[j % len(full)])
-        # a short consume would silently time the early-bail path
-        assert consumed == BATCH, "resident pack split the warm batch"
-    pack_rate = stage_rate(pack_stage)
+    # stage split: lane-sharded pack alone (own dicts, warm), put alone.
+    # The scaling ladder {1, 2, 4, engaged} is the SKETCH_PACK_THREADS
+    # evidence: pack rate should scale with threads until cores run out.
+    ladder = sorted({1, 2, 4, pack_threads})
+    pack_scaling = {str(t): round(lane_pack_rate(full, feats, t))
+                    for t in ladder}
+    pack_rate = pack_scaling[str(pack_threads)]
+    buf = np.empty(lanes * flowpack.resident_buf_len(BATCH // lanes, caps),
+                   np.uint32)
 
     def put_sync(j):
         jax.device_put(buf).block_until_ready()
-    put_rate = stage_rate(put_sync)
+    put_sync(0)  # warm
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 1.5:
+        put_sync(n)
+        n += 1
+    put_rate = n * BATCH / (time.perf_counter() - t0)
 
     bpr = buf_bytes / BATCH
     return {
         "host_path_burst": round(max(seg_rates)),
         "host_path_sustained": round(float(np.median(seg_rates))),
+        "host_path_p10": round(float(np.percentile(seg_rates, 10))),
+        "host_path_p90": round(float(np.percentile(seg_rates, 90))),
         "host_segments": [round(r) for r in seg_rates],
-        "host_pack_records_per_sec": round(pack_rate),
+        "host_fold_ms_p50": round(
+            float(np.percentile(fold_s, 50)) * 1e3, 3),
+        "host_fold_ms_p99": round(
+            float(np.percentile(fold_s, 99)) * 1e3, 3),
+        "host_pack_records_per_sec": pack_rate,
+        "host_pack_records_per_sec_1t": pack_scaling["1"],
+        "host_pack_scaling": pack_scaling,
+        "host_pack_threads": pack_threads,
+        "host_pack_lanes": lanes,
         "host_put_records_per_sec": round(put_rate),
         # byte-budget evidence: wire cost of the resident format and the
         # link rate actually achieved in the best/median segment
@@ -299,7 +400,64 @@ def host_path_stats(seconds: float = 8.0) -> dict:
         "host_staging": {"stalls": ring.stalls,
                          "continuations": ring.continuations,
                          "dict_resets": ring.dict_resets,
-                         "spill_rows": ring.spill_rows},
+                         "spill_rows": ring.spill_rows,
+                         "dense_fallbacks": getattr(ring, "dense_fallbacks",
+                                                    0)},
+    }
+
+
+def roll_stall_stats(run_s: float = 3.2, sink_block_s: float = 0.5) -> dict:
+    """Fold latency ACROSS a window roll vs steady state, with a sink that
+    blocks `sink_block_s` per report — the non-blocking-roll evidence: the
+    exporter's roll only swaps state under its lock and publishes (merge,
+    transfer, JSON render, sink I/O) on the window-timer thread, so
+    `export_evicted` fold p99 during a roll should sit within ~2x of steady
+    state instead of inheriting the sink's 500ms."""
+    from netobserv_tpu.datapath.replay import SyntheticFetcher
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    sink_spans: list[tuple[float, float]] = []
+
+    def blocking_sink(obj):
+        t0 = time.perf_counter()
+        time.sleep(sink_block_s)
+        sink_spans.append((t0, time.perf_counter()))
+
+    B = 2048
+    exp = TpuSketchExporter(
+        batch_size=B, window_s=0.8,
+        sketch_cfg=SketchConfig(cm_width=1 << 12, topk=256, hll_precision=8,
+                                perdst_buckets=256, perdst_precision=4,
+                                persrc_buckets=256, persrc_precision=4,
+                                hist_buckets=256, ewma_buckets=256),
+        sink=blocking_sink)
+    fetcher = SyntheticFetcher(flows_per_eviction=B, n_distinct=2000)
+    evs = [fetcher.lookup_and_delete() for _ in range(8)]
+    for e in evs:  # compile + warm the resident dictionary
+        exp.export_evicted(e)
+    exp.flush()
+    samples: list[tuple[float, float]] = []
+    t_end = time.perf_counter() + run_s
+    i = 0
+    while time.perf_counter() < t_end:
+        t0 = time.perf_counter()
+        exp.export_evicted(evs[i % len(evs)])
+        samples.append((t0, time.perf_counter() - t0))
+        i += 1
+    exp.close()
+
+    def in_roll(t: float) -> bool:
+        return any(s0 - 0.1 <= t <= s1 + 0.1 for s0, s1 in sink_spans)
+
+    roll = [dt for t, dt in samples if in_roll(t)] or [0.0]
+    steady = [dt for t, dt in samples if not in_roll(t)] or [0.0]
+    return {
+        "host_roll_stall_ms": round(float(np.percentile(roll, 99)) * 1e3, 3),
+        "host_roll_steady_ms_p99": round(
+            float(np.percentile(steady, 99)) * 1e3, 3),
+        "host_roll_windows": len(sink_spans),
+        "host_roll_sink_block_ms": round(sink_block_s * 1e3),
     }
 
 
@@ -371,6 +529,18 @@ def main():
     if not maybe_force_cpu():
         global _DEVICE_NOTE
         _DEVICE_NOTE = _device_watchdog()
+    if "--host-only" in sys.argv:
+        # `make bench-host` (~15s): host path + roll stall only, no device
+        # ingest loop or CPU oracle — the per-PR CI artifact
+        host = host_path_stats(seconds=4.0)
+        host.update(roll_stall_stats())
+        out = {"metric": "host_path_records_per_sec",
+               "value": host["host_path_sustained"], "unit": "records/s",
+               **host}
+        if _DEVICE_NOTE:
+            out["device"] = _DEVICE_NOTE
+        print(json.dumps(out))
+        return
     rng = np.random.default_rng(2026)
     universe, pool = make_pool(rng)
     baseline = cpu_exact_baseline(pool)
@@ -391,8 +561,12 @@ def main():
     # The device-rate metric is compute-bound and link-insensitive (its
     # batches are staged on device before timing), so order doesn't bias it.
     host = host_path_stats()
+    host.update(roll_stall_stats())
     print(f"host-path burst {host['host_path_burst']/1e6:.2f}M / sustained "
-          f"{host['host_path_sustained']/1e6:.2f}M records/s", file=sys.stderr)
+          f"{host['host_path_sustained']/1e6:.2f}M records/s; pack scaling "
+          f"{host['host_pack_scaling']}; roll stall p99 "
+          f"{host['host_roll_stall_ms']}ms vs steady "
+          f"{host['host_roll_steady_ms_p99']}ms", file=sys.stderr)
     rates, rates_off, state, feed = tpu_ingest_rate(pool,
                                                     use_pallas=use_pallas)
     recall = check_recall(state, feed, universe, pool)

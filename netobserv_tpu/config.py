@@ -330,11 +330,14 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_asym_ratio: float = field(
         default=DEFAULT_ASYM_RATIO,
         **_env("SKETCH_ASYM_RATIO", str(DEFAULT_ASYM_RATIO)))
-    #: native packer threads for the DENSE feed (0 = auto: cpu count, max
-    #: 8) — the sharded-mesh ring and the compact ring's dense fallback.
-    #: The single-chip compact pack stays a single pass (its data-dependent
-    #: spill compaction doesn't row-shard; at ~80M rec/s it sits above any
-    #: realistic link anyway, docs/tpu_sketch.md)
+    #: native packer threads (0 = auto: cpu count, max 8). Dense feed:
+    #: row-sharded single-pass packs. RESIDENT feed (the default): the
+    #: batch splits into this many pack LANES, each with its own
+    #: dictionary + device key table, packed in true parallel — the
+    #: host-pack ceiling scales with threads (docs/tpu_sketch.md
+    #: "host-path ceiling"). The single-chip compact pack stays a single
+    #: pass (its data-dependent spill compaction doesn't row-shard; at
+    #: ~80M rec/s it sits above any realistic link anyway)
     sketch_pack_threads: int = field(default=0,
                                      **_env("SKETCH_PACK_THREADS", "0"))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))

@@ -102,6 +102,27 @@ def resident_buf_len(batch_size: int, caps: ResidentCaps) -> int:
             + caps.nk * NK_WORDS + caps.spill * DENSE_WORDS)
 
 
+def zero_resident_region(out: np.ndarray, batch_size: int,
+                         caps: ResidentCaps) -> None:
+    """Mask a resident region as EMPTY by zeroing only the words the device
+    unpack (`sketch.state.resident_to_arrays`) reads as validity gates:
+    hot-row word 0 (valid bit + slot + rtt code), the sparse dns/drop lanes
+    (their entries scatter by embedded row index), new-key word 0 (defined
+    bit) and spill word 14 (valid). Every other word of an invalid row is
+    masked on device, so stale content there is unreadable — this writes
+    ~1/3 of a full `region[:] = 0` memset, which is what the exhausted-shard
+    continuation path used to pay per chunk."""
+    hot_off = RESIDENT_HDR
+    dns_off = hot_off + batch_size * HOT_WORDS
+    nk_off = dns_off + caps.dns + caps.drop * 2
+    spill_off = nk_off + caps.nk * NK_WORDS
+    out[:RESIDENT_HDR] = 0
+    out[hot_off:dns_off:HOT_WORDS] = 0   # hot valid|rtt|slot words
+    out[dns_off:nk_off] = 0              # dns + drop lanes (row-idx entries)
+    out[nk_off:spill_off:NK_WORDS] = 0   # new-key defined bits
+    out[spill_off + 14::DENSE_WORDS] = 0  # spill valid words
+
+
 class KeyDict:
     """Host key->slot dictionary backing the resident feed — native
     (flowpack.cc fp_dict) when the library is built, pure-python twin

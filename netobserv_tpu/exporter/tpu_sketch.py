@@ -14,6 +14,7 @@ default — feed it to Kafka/gRPC by passing a different sink).
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import sys
@@ -260,6 +261,7 @@ class TpuSketchExporter(Exporter):
                  synflood_ratio: float = DEFAULT_SYNFLOOD_RATIO,
                  drop_z_threshold: float = DEFAULT_DROP_Z,
                  pack_threads: int = 1,
+                 pack_threads_explicit: bool = True,
                  asym_min_bytes: float = DEFAULT_ASYM_MIN_BYTES,
                  asym_ratio: float = DEFAULT_ASYM_RATIO,
                  feed: str = "resident",
@@ -281,10 +283,25 @@ class TpuSketchExporter(Exporter):
         self._asym_min_bytes = asym_min_bytes
         self._asym_ratio = asym_ratio
         self._metrics = metrics
+        # resident pack LANES cost per-lane device key tables and only pay
+        # off where parallel dictionary probes actually scale: engage them
+        # for an EXPLICIT SKETCH_PACK_THREADS (the operator chose), but an
+        # auto-resolved count only on hosts with enough cores (a 2-vCPU
+        # box measures ~30% SLOWER with 2 lanes — docs/tpu_sketch.md)
+        import os as _os
+        self._lane_threads = pack_threads if (
+            pack_threads_explicit or (_os.cpu_count() or 1) >= 4) else 1
         self._lock = threading.Lock()
         self._pending: list[Record] = []
-        self._pending_ev: list = []  # EvictedFlows on the columnar fast path
-        self._pending_ev_n = 0
+        # rolled-but-unpublished device-side WindowReports, queued under
+        # self._lock, rendered+delivered by the window-timer thread OUTSIDE
+        # it — folds never wait on report_to_json or a sink. Bounded: a
+        # sink that wedges forever must not pin an ever-growing set of
+        # device reports (drops are counted in _roll_locked). State is
+        # deliberately NOT queued with the report — later folds donate it.
+        self._reports: collections.deque = collections.deque()
+        self._max_queued_reports = 8
+        self._publish_lock = threading.Lock()
         self._window_deadline = time.monotonic() + window_s
         self._n_windows_saved = 0
         # distributed init MUST precede anything that touches the JAX backend
@@ -323,18 +340,25 @@ class TpuSketchExporter(Exporter):
             if feed == "resident":
                 # resident feed over the mesh: per-data-shard dictionaries
                 # + device key tables (~15B/record instead of dense's 80;
-                # lookups stay shard-local — no collectives added)
+                # lookups stay shard-local — no collectives added). When
+                # pack threads outnumber the data shards, each shard's rows
+                # additionally split into pack LANES so every thread gets
+                # its own dictionary+region (host-pack parallelism beyond
+                # the mesh width)
                 bps = self._batch_size // spec.data
-                caps = flowpack.default_resident_caps(bps)
+                lanes = staging.pick_lanes(
+                    bps, max(1, self._lane_threads // spec.data))
+                bpl = bps // lanes
+                caps = flowpack.default_resident_caps(bpl)
                 self._ring = staging.ShardedResidentStagingRing(
                     self._batch_size, spec.data,
                     pmerge.make_sharded_ingest_resident_fn(
-                        self._mesh, self._cfg, bps, caps),
+                        self._mesh, self._cfg, bpl, caps, lanes=lanes),
                     key_tables=pmerge.init_resident_tables(
-                        self._mesh, resident_slots),
+                        self._mesh, resident_slots, lanes=lanes),
                     put=dense_put,
                     caps=caps, slot_cap=resident_slots, metrics=metrics,
-                    pack_threads=pack_threads)
+                    pack_threads=pack_threads, lanes=lanes)
             else:
                 if feed == "compact":
                     log.info("SKETCH_FEED=compact has no sharded form "
@@ -356,6 +380,10 @@ class TpuSketchExporter(Exporter):
             self._roll = sk.make_roll_fn(self._cfg, decay_factor=decay_factor)
             self._ring = self._make_single_device_ring(
                 feed, resident_slots, pack_threads, metrics)
+        # zero-concat eviction accumulator (columnar fast path): rows copy
+        # once into a preallocated rolling buffer instead of per-fold
+        # np.concatenate over events + five feature lanes
+        self._pending_buf = staging.PendingEventBuffer(self._batch_size)
         # the staging ring packs the next batch while the previous
         # transfers/ingests are in flight; its slot-reuse tokens also bound
         # the async dispatch queue to the ring depth, so sustained overload
@@ -423,6 +451,7 @@ class TpuSketchExporter(Exporter):
                    synflood_ratio=cfg.sketch_synflood_ratio,
                    drop_z_threshold=cfg.sketch_drop_z,
                    pack_threads=cfg.resolved_pack_threads(),
+                   pack_threads_explicit=cfg.sketch_pack_threads > 0,
                    asym_min_bytes=cfg.sketch_asym_min_bytes,
                    asym_ratio=cfg.sketch_asym_ratio,
                    feed=cfg.sketch_feed,
@@ -442,73 +471,18 @@ class TpuSketchExporter(Exporter):
                 if self._pending:
                     self._fold(self._pending)
                     self._pending = []
-                self._emit_window()
+                self._roll_locked()
 
     def export_evicted(self, evicted) -> None:
-        """Columnar fast path: fold raw evictions without building Records."""
+        """Columnar fast path: fold raw evictions without building Records.
+        Full batches fold as the rolling buffer fills (zero concatenation);
+        a due window only dispatches the roll here — rendering and sink I/O
+        happen on the timer thread, so this never waits on a sink."""
         with self._lock:
-            self._pending_ev.append(evicted)
-            self._pending_ev_n += len(evicted)
-            if self._pending_ev_n >= self._batch_size:
-                self._fold_pending_events()
+            self._pending_buf.append(evicted, self._fold_events)
             if time.monotonic() >= self._window_deadline:
                 self._drain_pending_locked()
-                self._emit_window()
-
-    @staticmethod
-    def _concat_feature(pending, attr, dtype):
-        cols = [getattr(e, attr) for e in pending]
-        if not any(c is not None and len(c) for c in cols):
-            return None
-        return np.concatenate([
-            c if c is not None and len(c) else np.zeros(len(e.events), dtype)
-            for e, c in zip(pending, cols)])
-
-    def _fold_pending_events(self, final: bool = False) -> None:
-        """Concatenate queued evictions and fold full batches; the remainder is
-        requeued (or, when `final`, folded as a padded partial batch)."""
-        from netobserv_tpu.datapath.fetcher import EvictedFlows
-        from netobserv_tpu.model import binfmt
-
-        if not self._pending_ev:
-            return
-        events = np.concatenate([e.events for e in self._pending_ev])
-        # every feature lane the dense feed carries (flowpack.cc layout):
-        # extra/dns ride as value columns, drops feed the drop-anomaly
-        # signals, xlat/quic fold to marker bits
-        feats = {
-            "extra": self._concat_feature(self._pending_ev, "extra",
-                                          binfmt.EXTRA_REC_DTYPE),
-            "dns": self._concat_feature(self._pending_ev, "dns",
-                                        binfmt.DNS_REC_DTYPE),
-            "drops": self._concat_feature(self._pending_ev, "drops",
-                                          binfmt.DROPS_REC_DTYPE),
-            "xlat": self._concat_feature(self._pending_ev, "xlat",
-                                         binfmt.XLAT_REC_DTYPE),
-            "quic": self._concat_feature(self._pending_ev, "quic",
-                                         binfmt.QUIC_REC_DTYPE),
-        }
-        bs = self._batch_size
-
-        def sl(lo, hi):
-            return {k: (v[lo:hi] if v is not None else None)
-                    for k, v in feats.items()}
-
-        off = 0
-        while len(events) - off >= bs:
-            self._fold_events(events[off:off + bs], sl(off, off + bs))
-            off += bs
-        rest = len(events) - off
-        if rest and final:
-            self._fold_events(events[off:], sl(off, None))
-            rest = 0
-        if rest:
-            tail = sl(off, None)
-            self._pending_ev = [EvictedFlows(events[off:], **tail)]
-            self._pending_ev_n = rest
-        else:
-            self._pending_ev = []
-            self._pending_ev_n = 0
+                self._roll_locked()
 
     def _fold_events(self, events, feats) -> None:
         t0 = time.perf_counter()
@@ -554,13 +528,15 @@ class TpuSketchExporter(Exporter):
         if self._pending:
             self._fold(self._pending)
             self._pending = []
-        self._fold_pending_events(final=True)
+        self._pending_buf.flush_to(self._fold_events)
 
     def flush(self) -> None:
-        """Fold pending records and close the current window now."""
+        """Fold pending records, close the current window now, and publish
+        the report synchronously (shutdown/tests path)."""
         with self._lock:
             self._drain_pending_locked()
-            self._emit_window()
+            self._roll_locked()
+        self._publish_queued()
 
     def close(self) -> None:
         self._closed.set()
@@ -583,14 +559,23 @@ class TpuSketchExporter(Exporter):
                 with self._lock:
                     if time.monotonic() >= self._window_deadline:
                         self._drain_pending_locked()
-                        self._emit_window()
+                        self._roll_locked()
             except Exception as exc:
-                # a sink outage (e.g. Kafka down) must not kill the timer —
-                # the next window retries
+                # a roll failure must not kill the timer — the next window
+                # retries
                 log.error("window roll failed (will retry next window): %s",
                           exc)
                 if self._metrics is not None:
                     self._metrics.count_error("tpu-sketch")
+            # publish OUTSIDE the exporter lock: folds proceed while the
+            # report transfers/renders and the sink (possibly blocking
+            # Kafka I/O) delivers. A crash here is a timer-stage bug — the
+            # supervisor restarts the thread and the still-queued report
+            # publishes exactly once after the restart (no double-emit:
+            # the deadline already advanced at roll time).
+            if self._reports:
+                faultinject.fire("sketch.window_publish")
+            self._publish_queued()
 
     # --- internals ---
     def _make_single_device_ring(self, feed: str, resident_slots: int,
@@ -599,18 +584,33 @@ class TpuSketchExporter(Exporter):
         "resident" (default) ships ~15B/record slot-id hot rows against a
         device key table (byte budget in docs/tpu_sketch.md; lane
         overflows continue into the next chunk, a full dictionary rolls
-        its epoch); "compact" ships 40B v4-compact rows with a dense
+        its epoch) — SKETCH_PACK_THREADS > 1 splits the batch into that
+        many pack LANES, each with its own dictionary + device key table,
+        packed in true parallel (the host-pack ceiling scales with
+        threads); "compact" ships 40B v4-compact rows with a dense
         fallback; "dense" ships 80B full-width rows (the debugging
         baseline — also what sharded meshes use)."""
+        import jax
+
         sk = self._sk
         kw = dict(use_pallas=self._cfg.use_pallas, with_token=True,
                   enable_fanout=self._cfg.enable_fanout,
                   enable_asym=self._cfg.enable_asym)
         if feed == "resident":
-            if pack_threads > 1:
-                log.info("SKETCH_PACK_THREADS=%d applies to the dense/"
-                         "compact feeds only; the resident pack is "
-                         "single-threaded (~30M rec/s)", pack_threads)
+            lanes = staging.pick_lanes(self._batch_size, self._lane_threads)
+            if lanes > 1:
+                bpl = self._batch_size // lanes
+                caps = flowpack.default_resident_caps(bpl)
+                return staging.ShardedResidentStagingRing(
+                    self._batch_size, 1,
+                    sk.make_ingest_resident_lanes_fn(
+                        bpl, caps, lanes, use_pallas=self._cfg.use_pallas,
+                        enable_fanout=self._cfg.enable_fanout,
+                        enable_asym=self._cfg.enable_asym),
+                    key_tables=jax.device_put(
+                        sk.init_key_tables(lanes, resident_slots)),
+                    put=jax.device_put, caps=caps, slot_cap=resident_slots,
+                    metrics=metrics, pack_threads=pack_threads, lanes=lanes)
             caps = flowpack.default_resident_caps(self._batch_size)
             return staging.ResidentStagingRing(
                 self._batch_size,
@@ -650,9 +650,57 @@ class TpuSketchExporter(Exporter):
             self._metrics.sketch_ingest_seconds.observe(
                 time.perf_counter() - t0)
 
-    def _emit_window(self) -> None:
+    def _roll_locked(self) -> None:
+        """Close the window UNDER self._lock: advance the deadline, dispatch
+        the (async) device roll, swap in the fresh-window state, and queue
+        the still-on-device report. No host transfer, JSON rendering, or
+        sink I/O happens here — that is `_publish_queued`'s job on the
+        window-timer thread, so `export_batch`/`export_evicted` callers
+        blocked on this lock never wait behind a sink."""
         self._window_deadline = time.monotonic() + self._window_s
         self._state, report = self._roll(self._state)
+        self._reports.append(report)
+        while len(self._reports) > self._max_queued_reports:
+            # a wedged sink has the timer blocked mid-publish: shed the
+            # OLDEST unpublished window instead of accumulating device
+            # reports without bound (counted, like any lost report)
+            try:
+                self._reports.popleft()
+            except IndexError:
+                break  # the publisher drained it between len() and pop
+            log.error("window report queue full (sink stalled?); "
+                      "dropping the oldest unpublished report")
+            if self._metrics is not None:
+                self._metrics.count_error("tpu-sketch")
+        # checkpointing stays at roll time: later folds DONATE self._state
+        # into the jitted ingest, so a deferred save could read a deleted
+        # buffer. orbax copies to host before save() returns; the int()
+        # waits only for the roll itself, and only on checkpoint windows.
+        if self._ckpt is not None and self._ckpt_every:
+            self._n_windows_saved += 1
+            if self._n_windows_saved % self._ckpt_every == 0:
+                self._ckpt.save(int(report.window), self._state)
+
+    def _publish_queued(self) -> None:
+        """Render and deliver every queued window report (timer thread, or
+        flush() at shutdown). A sink/render failure loses THAT report —
+        counted, logged — because its window already rolled; the next
+        window's report still flows."""
+        with self._publish_lock:
+            while self._reports:
+                try:
+                    report = self._reports.popleft()
+                except IndexError:
+                    return  # _roll_locked's shed loop emptied it first
+                try:
+                    self._publish_report(report)
+                except Exception as exc:
+                    log.error("window report publish failed "
+                              "(report lost): %s", exc)
+                    if self._metrics is not None:
+                        self._metrics.count_error("tpu-sketch")
+
+    def _publish_report(self, report) -> None:
         obj = report_to_json(
             report, scan_fanout_threshold=self._scan_fanout,
             ddos_z_threshold=self._ddos_z,
@@ -674,7 +722,3 @@ class TpuSketchExporter(Exporter):
                              ("asym_conv", "AsymmetricConversationBuckets")):
                 self._metrics.sketch_window_suspects.labels(sig).set(
                     len(obj[key]))
-        if self._ckpt is not None and self._ckpt_every:
-            self._n_windows_saved += 1
-            if self._n_windows_saved % self._ckpt_every == 0:
-                self._ckpt.save(int(obj["Window"]), self._state)

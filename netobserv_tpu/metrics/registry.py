@@ -135,6 +135,13 @@ class Metrics:
             "Resident key-dictionary epoch rolls (dictionary reached "
             "SKETCH_RESIDENT_SLOTS; size it above the flow working set)",
             registry=self.registry)
+        self.sketch_dense_fallback_total = Counter(
+            p + "sketch_dense_fallback_total",
+            "Compact-feed batches whose non-v4/drop rows overflowed the "
+            "spill lane and shipped full-width instead (synchronous, "
+            "dense-path speed — sustained increments mean v6-heavy or "
+            "drop-storm traffic outgrew the compact feed)",
+            registry=self.registry)
         self.sketch_resident_spill_rows_total = Counter(
             p + "sketch_resident_spill_rows_total",
             "Rows that rode the full-width spill lane instead of a hot row",

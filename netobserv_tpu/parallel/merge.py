@@ -27,7 +27,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from netobserv_tpu.ops import countmin, ewma, hll, quantile, topk
-from netobserv_tpu.parallel.mesh import DATA_AXIS, SKETCH_AXIS
+from netobserv_tpu.parallel.mesh import (
+    DATA_AXIS, SKETCH_AXIS, shard_map_compat,
+)
 from netobserv_tpu.sketch import state as sk
 
 # ---------------------------------------------------------------------------
@@ -76,6 +78,25 @@ def _add_lead(s: sk.SketchState) -> sk.SketchState:
     return out._replace(heavy=jax.tree.map(lambda x: x[None], out.heavy))
 
 
+def _put_global(arr: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
+    """device_put a host-global array with the given sharding. On a
+    multi-process mesh each addressable shard is placed explicitly: every
+    process holds the SAME global array (the existing shard_batch/
+    shard_dense contract), and some jax releases route the one-put form
+    through a cross-host equality collective that CPU backends cannot
+    execute (the 2-process gloo dryrun would die in device_put)."""
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    shards = [
+        jax.device_put(arr[idx], d)
+        for d, idx in sharding.addressable_devices_indices_map(
+            arr.shape).items()
+    ]
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, sharding, shards)
+
+
 def init_dist_state(cfg: sk.SketchConfig, mesh: Mesh) -> sk.SketchState:
     """Per-device partial sketch state, zeros, laid out across the mesh."""
     ndata = mesh.shape[DATA_AXIS]
@@ -89,7 +110,7 @@ def init_dist_state(cfg: sk.SketchConfig, mesh: Mesh) -> sk.SketchState:
         lead = (ndata, nsk) if (len(spec) >= 2 and spec[1] == SKETCH_AXIS) \
             else (ndata,)
         arr = np.zeros(lead + leaf.shape, dtype=leaf.dtype)
-        return jax.device_put(arr, NamedSharding(mesh, spec))
+        return _put_global(arr, mesh, spec)
 
     return jax.tree.map(place, template, specs)
 
@@ -99,7 +120,7 @@ def shard_batch(mesh: Mesh, arrays: dict[str, np.ndarray]) -> dict[str, jax.Arra
     mesh, split along the data axis and replicated along the sketch axis."""
     out = {}
     for k, v in arrays.items():
-        out[k] = jax.device_put(v, NamedSharding(mesh, P(DATA_AXIS)))
+        out[k] = _put_global(np.asarray(v), mesh, P(DATA_AXIS))
     return out
 
 
@@ -147,47 +168,51 @@ def make_sharded_ingest_fn(mesh: Mesh, cfg: sk.SketchConfig,
     # one spec as a pytree PREFIX covers the whole batch: every column is
     # row-sharded over the data axis, whatever feature columns it carries
     batch_specs = P(DATA_AXIS)
-    shmapped = jax.shard_map(
+    shmapped = shard_map_compat(
         local_step, mesh=mesh,
         in_specs=(specs, batch_specs),
         out_specs=(specs, P(DATA_AXIS)) if with_token else specs,
-        check_vma=False,
+        check=False,
     )
     return jax.jit(shmapped, donate_argnums=(0,) if donate else ())
 
 
-def init_resident_tables(mesh: Mesh, slot_cap: int) -> jax.Array:
+def init_resident_tables(mesh: Mesh, slot_cap: int,
+                         lanes: int = 1) -> jax.Array:
     """Per-DATA-shard device key tables for the sharded resident feed:
-    (n_data, slot_cap, KEY_WORDS) u32, sharded P(data) — each data shard
-    owns an independent table fed by its own host-side dictionary, and the
-    sketch-axis replicas stay consistent because every sketch column of a
-    data row applies the same new-key lane. Lookups are pure local gathers,
-    so the steady-state no-collectives invariant is untouched."""
+    (n_data, lanes, slot_cap, KEY_WORDS) u32, sharded P(data) — each data
+    shard owns `lanes` independent tables, one per host-side packer lane
+    (lanes > 1 lets the host pack a shard's rows across several threads;
+    `sketch.staging.ShardedResidentStagingRing`), and the sketch-axis
+    replicas stay consistent because every sketch column of a data row
+    applies the same new-key lanes. Lookups are pure local gathers, so the
+    steady-state no-collectives invariant is untouched."""
     ndata = mesh.shape[DATA_AXIS]
-    arr = np.zeros((ndata, slot_cap, sk.KEY_WORDS), np.uint32)
-    return jax.device_put(arr, NamedSharding(mesh, P(DATA_AXIS)))
+    arr = np.zeros((ndata, lanes, slot_cap, sk.KEY_WORDS), np.uint32)
+    return _put_global(arr, mesh, P(DATA_AXIS))
 
 
 def make_sharded_ingest_resident_fn(mesh: Mesh, cfg: sk.SketchConfig,
-                                    batch_per_shard: int, caps,
-                                    donate: bool = True) -> Callable:
+                                    batch_per_lane: int, caps,
+                                    donate: bool = True,
+                                    lanes: int = 1) -> Callable:
     """Jitted `(dist_state, key_tables, flat) -> (dist_state, key_tables,
     token)` — the RESIDENT feed over the mesh (~15B/record instead of the
-    dense feed's 80). `flat` concatenates one per-shard resident buffer per
-    data shard (`flowpack.resident_buf_len(batch_per_shard, caps)` words
-    each, packed by that shard's own KeyDict —
+    dense feed's 80). `flat` concatenates `lanes` resident regions per data
+    shard (`flowpack.resident_buf_len(batch_per_lane, caps)` words each,
+    packed by that region's own KeyDict —
     `sketch.staging.ShardedResidentStagingRing`); the contiguous split over
-    the data axis lands exactly on buffer boundaries. Each shard scatters
-    its new-key lane into ITS table slice and gathers hot-row keys locally
-    — no collectives."""
+    the data axis lands exactly on per-shard region-group boundaries. Each
+    shard scatters its new-key lanes into ITS table slices and gathers
+    hot-row keys locally — no collectives."""
     nsk = mesh.shape[SKETCH_AXIS]
     template = sk.init_state(cfg)
     specs = _state_specs(template)
 
     def local_step(pstate: sk.SketchState, table, flat):
         s = _drop_lead(pstate)
-        arrays, tbl = sk.resident_to_arrays(flat, table[0], batch_per_shard,
-                                            caps)
+        arrays, tbl = sk.resident_lane_arrays(flat, table[0], batch_per_lane,
+                                              caps, lanes)
         s = sk.ingest(s, arrays,
                       sketch_axis=SKETCH_AXIS if nsk > 1 else None,
                       sketch_shards=nsk,
@@ -196,11 +221,11 @@ def make_sharded_ingest_resident_fn(mesh: Mesh, cfg: sk.SketchConfig,
                       enable_asym=cfg.enable_asym)
         return _add_lead(s), tbl[None], flat[:1]
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map_compat(
         local_step, mesh=mesh,
         in_specs=(specs, P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=(specs, P(DATA_AXIS), P(DATA_AXIS)),
-        check_vma=False,
+        check=False,
     )
     return jax.jit(shmapped, donate_argnums=(0, 1) if donate else ())
 
@@ -210,7 +235,7 @@ def shard_dense(mesh: Mesh, dense: np.ndarray) -> jax.Array:
     axis, replicated over the sketch axis. Accepts (B, 20) rows or the flat
     (B*20,) form the staging ring ships (a contiguous flat split lands on
     row boundaries because B divides evenly over the data axis)."""
-    return jax.device_put(dense, NamedSharding(mesh, P(DATA_AXIS)))
+    return _put_global(np.asarray(dense), mesh, P(DATA_AXIS))
 
 
 def shard_dense_per_device(mesh: Mesh, flat: np.ndarray) -> jax.Array:
@@ -396,8 +421,8 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
                              window=s.window + 1)
         return _add_lead(new), report
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map_compat(
         local_roll, mesh=mesh, in_specs=(specs,),
-        out_specs=(specs, report_specs), check_vma=False,
+        out_specs=(specs, report_specs), check=False,
     )
     return jax.jit(shmapped, donate_argnums=(0,))

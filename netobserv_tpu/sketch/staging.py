@@ -24,6 +24,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from netobserv_tpu.datapath import flowpack
+from netobserv_tpu.model import binfmt
 from netobserv_tpu.utils import faultinject
 
 
@@ -32,6 +33,88 @@ def default_spill_cap(batch_size: int) -> int:
     (v6-heavy batches beyond it fall back to the dense feed). Bench and the
     exporter share this so the measured configuration is the shipped one."""
     return max(batch_size // 8, 64)
+
+
+def pick_lanes(per_unit: int, want: int) -> int:
+    """Largest lane count <= `want` that divides `per_unit` evenly (lane
+    regions need equal fixed shapes for the retrace-free jitted unpack)."""
+    lanes = max(1, min(want, per_unit))
+    while per_unit % lanes:
+        lanes -= 1
+    return lanes
+
+
+class PendingEventBuffer:
+    """Preallocated rolling accumulator for queued evictions — the
+    zero-concat fold path. The exporter used to `np.concatenate` every
+    queued eviction's events AND five feature lanes per fold (materializing
+    zero arrays for absent lanes); this copies each incoming row exactly
+    once into a fixed buffer and hands the fold zero-copy prefix views.
+
+    Feature-lane semantics match the old `_concat_feature`: a lane is
+    passed to the fold iff ANY eviction in the current batch carried it,
+    with zeroed rows standing in for evictions that lacked it (`_live`
+    tracks per-lane liveness so untouched lanes cost nothing)."""
+
+    LANES = (("extra", binfmt.EXTRA_REC_DTYPE),
+             ("dns", binfmt.DNS_REC_DTYPE),
+             ("drops", binfmt.DROPS_REC_DTYPE),
+             ("xlat", binfmt.XLAT_REC_DTYPE),
+             ("quic", binfmt.QUIC_REC_DTYPE))
+
+    def __init__(self, batch_size: int):
+        self.batch_size = batch_size
+        self.n = 0
+        self.events = np.zeros(batch_size, binfmt.FLOW_EVENT_DTYPE)
+        self._lanes = {name: np.zeros(batch_size, dt)
+                       for name, dt in self.LANES}
+        self._live = {name: False for name, _ in self.LANES}
+
+    def __len__(self) -> int:
+        return self.n
+
+    def append(self, evicted, fold: Callable) -> None:
+        """Copy `evicted` (an EvictedFlows) into the buffer; every time the
+        buffer reaches a full batch, `fold(events, feats)` fires with views
+        into it (the fold must consume them before returning — both ring
+        pack paths copy synchronously) and the buffer rolls over."""
+        ev = evicted.events
+        off = 0
+        while off < len(ev):
+            take = min(len(ev) - off, self.batch_size - self.n)
+            lo, hi = self.n, self.n + take
+            self.events[lo:hi] = ev[off:off + take]
+            for name, _ in self.LANES:
+                col = getattr(evicted, name, None)
+                lane = self._lanes[name]
+                if col is not None and len(col):
+                    if not self._live[name]:
+                        lane[:lo] = 0  # earlier evictions lacked this lane
+                        self._live[name] = True
+                    c = col[off:off + take]
+                    lane[lo:lo + len(c)] = c
+                    lane[lo + len(c):hi] = 0  # short lane: zero-pad its tail
+                elif self._live[name]:
+                    lane[lo:hi] = 0
+            self.n += take
+            off += take
+            if self.n == self.batch_size:
+                self.flush_to(fold)
+
+    def flush_to(self, fold: Callable) -> None:
+        """Fold whatever is buffered (a partial batch pads downstream) and
+        reset; no-op when empty."""
+        if not self.n:
+            return
+        n = self.n
+        feats = {name: (self._lanes[name][:n] if self._live[name] else None)
+                 for name, _ in self.LANES}
+        # reset BEFORE folding: a fold that raises must not leave the rows
+        # queued for a re-fold (the exporter counts the batch as dropped)
+        self.n = 0
+        for name, _ in self.LANES:
+            self._live[name] = False
+        fold(self.events[:n], feats)
 
 
 class _SlotRing:
@@ -123,6 +206,7 @@ class DenseStagingRing(_SlotRing):
         self._init_slots([np.empty(shape, np.uint32)
                           for _ in range(n_slots)], metrics)
         self._dense_buf: Optional[np.ndarray] = None  # lazy fallback buffer
+        self.dense_fallbacks = 0  # spill-overflow batches shipped full-width
 
     def fold(self, state, events, extra=None, dns=None, drops=None,
              xlat=None, quic=None):
@@ -152,9 +236,13 @@ class DenseStagingRing(_SlotRing):
         """Non-v4 (or spill-overflow) flows exceeded the spill lane: ship
         this batch full-width. Synchronous (the shared dense buffer has no
         slot ring), and rare — only v6-dominant traffic or a drop storm
-        takes it repeatedly, at dense-path speed."""
+        takes it repeatedly, at dense-path speed; the counter makes that
+        degradation observable (sketch_dense_fallback_total)."""
         import jax
 
+        self.dense_fallbacks += 1
+        if self._metrics is not None:
+            self._metrics.sketch_dense_fallback_total.inc()
         if self._dense_buf is None:
             self._dense_buf = np.empty(
                 (self.batch_size, flowpack.DENSE_WORDS), np.uint32)
@@ -167,14 +255,26 @@ class DenseStagingRing(_SlotRing):
 
 
 class ShardedResidentStagingRing(_SlotRing):
-    """Resident feed over a DATA-sharded mesh: the global batch splits into
-    `n_shards` contiguous row blocks, each packed by its OWN KeyDict into
-    its own per-shard resident buffer region; the concatenated flat buffer
-    ships with one sharded put whose contiguous split lands exactly on the
-    region boundaries. Device-side twin:
-    `parallel.merge.make_sharded_ingest_resident_fn` +
-    `init_resident_tables` (one independent key table per data shard —
-    lookups stay local, the steady-state no-collectives invariant holds).
+    """Resident feed split into independent pack REGIONS — `n_shards` data
+    shards x `lanes` lanes per shard. The batch splits into
+    `n_shards * lanes` contiguous row blocks, each packed by its OWN
+    KeyDict into its own resident buffer region; the concatenated flat
+    buffer ships with one put whose contiguous data-axis split lands
+    exactly on per-shard region-group boundaries.
+
+    Two deployments share this ring:
+
+    - mesh (`n_shards` > 1): device twin
+      `parallel.merge.make_sharded_ingest_resident_fn` +
+      `init_resident_tables` (independent key tables per (shard, lane) —
+      lookups stay local, the steady-state no-collectives invariant holds);
+      `put` is `parallel.merge.shard_dense` bound to the mesh.
+    - single device (`n_shards` == 1, `lanes` > 1): device twin
+      `sketch.state.make_ingest_resident_lanes_fn` + `init_key_tables`;
+      `put` is a plain `device_put`. This is how SKETCH_PACK_THREADS
+      engages the resident feed — the per-lane packs run on the pool in
+      true parallel (native pack releases the GIL), raising the host-pack
+      ceiling that a single `pack_resident` pass tops out at.
 
     Multi-process note: every process must fold the SAME global batches
     (the existing `shard_batch`/`shard_dense` assumption) — dictionary
@@ -182,80 +282,80 @@ class ShardedResidentStagingRing(_SlotRing):
     identical slots.
 
     `ingest`: `(dist_state, key_tables, flat) -> (dist_state, key_tables,
-    token)`. `put` places the flat host buffer (defaults to a plain
-    device_put; pass `parallel.merge.shard_dense` bound to the mesh).
-    `pack_threads > 1` packs the shard regions concurrently (the per-shard
-    KeyDicts are independent; ctypes releases the GIL)."""
+    token)`. `pack_threads > 1` packs the regions concurrently."""
 
     def __init__(self, batch_size: int, n_shards: int, ingest: Callable,
                  key_tables, put: Callable,
                  caps=None, slot_cap: int = 1 << 18, n_slots: int = 4,
-                 metrics=None, pack_threads: int = 1):
-        if batch_size % n_shards:
-            raise ValueError("batch_size must divide evenly over the shards")
+                 metrics=None, pack_threads: int = 1, lanes: int = 1):
+        n_regions = n_shards * lanes
+        if batch_size % n_regions:
+            raise ValueError(
+                "batch_size must divide evenly over shards x lanes")
         self.batch_size = batch_size
         self.n_shards = n_shards
-        self.batch_per_shard = batch_size // n_shards
+        self.lanes = lanes
+        self.n_regions = n_regions
+        self.batch_per_region = batch_size // n_regions
         self.caps = caps or flowpack.default_resident_caps(
-            self.batch_per_shard)
+            self.batch_per_region)
         self.slot_cap = slot_cap
         self.pack_threads = pack_threads
-        self.kdicts = [flowpack.KeyDict(slot_cap) for _ in range(n_shards)]
+        self.kdicts = [flowpack.KeyDict(slot_cap) for _ in range(n_regions)]
         self.key_tables = key_tables
         self._ingest = ingest
         self._put = put
         self.continuations = 0
         self.dict_resets = 0
         self.spill_rows = 0
-        self._shard_words = flowpack.resident_buf_len(self.batch_per_shard,
-                                                      self.caps)
-        self._init_slots([np.empty(n_shards * self._shard_words, np.uint32)
+        self._region_words = flowpack.resident_buf_len(self.batch_per_region,
+                                                       self.caps)
+        self._init_slots([np.empty(n_regions * self._region_words, np.uint32)
                           for _ in range(n_slots)], metrics)
 
     def fold(self, state, events, extra=None, dns=None, drops=None,
              xlat=None, quic=None):
-        """Pack `events` (split over the shards, possibly in several
+        """Pack `events` (split over the regions, possibly in several
         chunks) into free ring slots, ship and ingest each; returns the new
         dist state (async — not blocked on)."""
         n = len(events)
         if n == 0:
             return state
         feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat, quic=quic)
-        bounds = [n * i // self.n_shards for i in range(self.n_shards + 1)]
-        shard_ev = [events[bounds[i]:bounds[i + 1]]
-                    for i in range(self.n_shards)]
+        nr = self.n_regions
+        bounds = [n * i // nr for i in range(nr + 1)]
+        shard_ev = [events[bounds[i]:bounds[i + 1]] for i in range(nr)]
         shard_feats = [
             {k: (v[bounds[i]:bounds[i + 1]] if v is not None and len(v)
                  else None) for k, v in feats.items()}
-            for i in range(self.n_shards)]
-        starts = [0] * self.n_shards
+            for i in range(nr)]
+        starts = [0] * nr
         first = True
-        while any(starts[i] < len(shard_ev[i])
-                  for i in range(self.n_shards)):
+        while any(starts[i] < len(shard_ev[i]) for i in range(nr)):
             slot = self._wait_slot()
             buf = self._bufs[slot]
 
             def pack_shard(i):
-                # touches only shard-local state (its dict, its buffer
+                # touches only region-local state (its dict, its buffer
                 # region, starts[i]); returns the diagnostic counters so
                 # threaded packs don't race on shared attributes
+                region = buf[i * self._region_words:
+                             (i + 1) * self._region_words]
                 if starts[i] >= len(shard_ev[i]):
-                    # exhausted shard in a continuation chunk: ship a
-                    # zeroed region, and don't roll its dictionary epoch
-                    # for rows it isn't packing
-                    region = buf[i * self._shard_words:
-                                 (i + 1) * self._shard_words]
-                    region[:] = 0
+                    # exhausted region in a continuation chunk: mask it
+                    # empty (validity words only — 1/3 of a full memset),
+                    # and don't roll its dictionary epoch for rows it
+                    # isn't packing
+                    flowpack.zero_resident_region(
+                        region, self.batch_per_region, self.caps)
                     return 0, 0
                 kd = self.kdicts[i]
                 resets = 0
                 if kd.count() >= self.slot_cap:
-                    kd.reset()  # per-shard epoch roll (ResidentStagingRing)
+                    kd.reset()  # per-region epoch roll (ResidentStagingRing)
                     resets = 1
-                region = buf[i * self._shard_words:
-                             (i + 1) * self._shard_words]
                 _, consumed = flowpack.pack_resident(
-                    shard_ev[i], batch_size=self.batch_per_shard,
+                    shard_ev[i], batch_size=self.batch_per_region,
                     kdict=kd, caps=self.caps, start=starts[i],
                     out=region, **shard_feats[i])
                 if consumed == 0 and starts[i] < len(shard_ev[i]):
@@ -263,15 +363,14 @@ class ShardedResidentStagingRing(_SlotRing):
                 starts[i] += consumed
                 return int(region[2]), resets
 
-            if self.pack_threads > 1 and self.n_shards > 1:
-                # per-shard dictionaries are independent; the native pack
-                # releases the GIL, so shards pack in true parallel
+            if self.pack_threads > 1 and nr > 1:
+                # per-region dictionaries are independent; the native pack
+                # releases the GIL, so regions pack in true parallel
                 outs = [f.result() for f in flowpack._pack_submit(
-                    min(self.pack_threads, self.n_shards),
-                    [lambda i=i: pack_shard(i)
-                     for i in range(self.n_shards)])]
+                    min(self.pack_threads, nr),
+                    [lambda i=i: pack_shard(i) for i in range(nr)])]
             else:
-                outs = [pack_shard(i) for i in range(self.n_shards)]
+                outs = [pack_shard(i) for i in range(nr)]
             chunk_spills = sum(o[0] for o in outs)
             chunk_resets = sum(o[1] for o in outs)
             self.spill_rows += chunk_spills

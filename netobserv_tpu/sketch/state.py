@@ -601,6 +601,67 @@ def resident_to_arrays(flat: jax.Array, key_table: jax.Array,
     return arrays, key_table
 
 
+def init_key_tables(n_lanes: int, slot_cap: int) -> jax.Array:
+    """Per-LANE device key tables for the lane-sharded resident feed on a
+    single device: (n_lanes, slot_cap, KEY_WORDS) u32 — one independent
+    table per host-side packer lane (`sketch.staging` lane-sharded ring),
+    the single-device twin of `parallel.merge.init_resident_tables`."""
+    return jnp.zeros((n_lanes, slot_cap, KEY_WORDS), jnp.uint32)
+
+
+def _resident_region_words(batch_size: int, caps) -> int:
+    """Flat word count of one resident region — the layout twin of
+    `flowpack.resident_buf_len` (state.py keeps its own constants so the
+    device unpack has no host-package import)."""
+    return (RESIDENT_HDR + batch_size * HOT_WORDS + caps.dns + caps.drop * 2
+            + caps.nk * NK_WORDS + caps.spill * DENSE_WORDS)
+
+
+def resident_lane_arrays(flat: jax.Array, key_tables: jax.Array,
+                         batch_per_lane: int, caps,
+                         n_lanes: int) -> tuple[dict, jax.Array]:
+    """Unpack `n_lanes` concatenated resident regions against per-lane key
+    tables into ONE array dict for the ordinary ingest. The three-place wire
+    contract (flowpack.cc fp_pack_resident <-> flowpack.pack_resident <->
+    resident_to_arrays) is unchanged PER REGION — this only loops it and
+    concatenates the resulting fixed-shape columns, so the jitted caller
+    still never retraces. Returns (arrays, new_key_tables)."""
+    words = _resident_region_words(batch_per_lane, caps)
+    lanes, tables = [], []
+    for i in range(n_lanes):
+        arrays, tbl = resident_to_arrays(
+            flat[i * words:(i + 1) * words], key_tables[i], batch_per_lane,
+            caps)
+        lanes.append(arrays)
+        tables.append(tbl)
+    if n_lanes == 1:
+        return lanes[0], tables[0][None]
+    out = {k: jnp.concatenate([a[k] for a in lanes], axis=0)
+           for k in lanes[0]}
+    return out, jnp.stack(tables)
+
+
+def make_ingest_resident_lanes_fn(batch_per_lane: int, caps, n_lanes: int,
+                                  donate: bool = True,
+                                  use_pallas: bool | None = None,
+                                  enable_fanout: bool = True,
+                                  enable_asym: bool = True):
+    """Jitted `(state, key_tables, flat) -> (state, key_tables, token)` for
+    the LANE-SHARDED resident feed on one device: `flat` concatenates
+    `n_lanes` independent resident regions, each packed by its own host
+    KeyDict (`sketch.staging.ShardedResidentStagingRing` with one shard and
+    L lanes — the native pack releases the GIL, so lanes pack in true
+    parallel), and `key_tables` is `init_key_tables(n_lanes, slot_cap)`.
+    Always returns the slot-reuse token (the ring requires it)."""
+    def fn(s, tables, flat):
+        arrays, tables = resident_lane_arrays(flat, tables, batch_per_lane,
+                                              caps, n_lanes)
+        s = ingest(s, arrays, use_pallas=use_pallas,
+                   enable_fanout=enable_fanout, enable_asym=enable_asym)
+        return s, tables, flat[:1]
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+
+
 def make_ingest_resident_fn(batch_size: int, caps,
                             donate: bool = True,
                             use_pallas: bool | None = None,

@@ -98,8 +98,8 @@ JAX_PLATFORMS=cpu DATAPATH=synthetic EXPORT=tpu-sketch SKETCH_WINDOW=3s \
   SKETCH_CM_WIDTH=16384 SKETCH_TOPK=64 CACHE_ACTIVE_TIMEOUT=300ms \
   timeout 10 $PY -m netobserv_tpu 2>/dev/null | head -1 || true
 
-section "4. Benchmark"
-JAX_PLATFORMS=cpu timeout 300 $PY bench.py 2>/dev/null | tail -1 || true
+section "4. Benchmark (host path + roll stall + device loop)"
+JAX_PLATFORMS=cpu timeout 480 $PY bench.py 2>/dev/null | tail -1 || true
 
 section "5. Multichip dry-run (8 virtual devices)"
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -323,11 +323,13 @@ def test_shard_dense_per_device_equivalent(mesh_shape):
 
 
 @slow
-@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
-def test_sharded_resident_feed_matches_dense(mesh_shape):
+@pytest.mark.parametrize("mesh_shape,lanes",
+                         [((8, 1), 1), ((4, 2), 1), ((4, 2), 2)])
+def test_sharded_resident_feed_matches_dense(mesh_shape, lanes):
     """The sharded RESIDENT feed (per-data-shard dictionaries + device key
     tables, ~15B/record) is a transport for the same math as the dense
-    feed: identical global batches must produce identical merged reports."""
+    feed: identical global batches must produce identical merged reports —
+    with pack lanes per shard too (SKETCH_PACK_THREADS on a mesh)."""
     from netobserv_tpu.datapath import flowpack
     from netobserv_tpu.model import binfmt
     from netobserv_tpu.sketch.staging import ShardedResidentStagingRing
@@ -337,8 +339,8 @@ def test_sharded_resident_feed_matches_dense(mesh_shape):
         pytest.skip("not enough devices")
     mesh = make_mesh(MeshSpec(data=ndata, sketch=nsk))
     B = ndata * 128
-    bps = B // ndata
-    caps = flowpack.default_resident_caps(bps)
+    bpl = B // ndata // lanes
+    caps = flowpack.default_resident_caps(bpl)
 
     # synthetic evictions with features (rtt + sparse dns/drops)
     from netobserv_tpu.datapath.replay import SyntheticFetcher
@@ -359,10 +361,11 @@ def test_sharded_resident_feed_matches_dense(mesh_shape):
     # resident path
     ring = ShardedResidentStagingRing(
         B, ndata,
-        pmerge.make_sharded_ingest_resident_fn(mesh, CFG, bps, caps),
-        key_tables=pmerge.init_resident_tables(mesh, 1 << 12),
+        pmerge.make_sharded_ingest_resident_fn(mesh, CFG, bpl, caps,
+                                               lanes=lanes),
+        key_tables=pmerge.init_resident_tables(mesh, 1 << 12, lanes=lanes),
         put=lambda buf: pmerge.shard_dense(mesh, buf),
-        caps=caps, slot_cap=1 << 12)
+        caps=caps, slot_cap=1 << 12, lanes=lanes)
     dist_r = pmerge.init_dist_state(CFG, mesh)
     for events, feats in feeds:
         dist_r = ring.fold(dist_r, events, **feats)
@@ -397,25 +400,28 @@ def test_sharded_resident_feed_matches_dense(mesh_shape):
     assert got_r == got_d
 
 
-@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2)])
-def test_sharded_resident_ingest_has_no_collectives(mesh_shape):
+@pytest.mark.parametrize("mesh_shape,lanes",
+                         [((8, 1), 1), ((4, 2), 1), ((4, 2), 2)])
+def test_sharded_resident_ingest_has_no_collectives(mesh_shape, lanes):
     """The resident transport must not weaken the steady-state invariant:
     table scatter/gather are shard-local, so the compiled sharded resident
-    ingest contains NO collectives on either mesh axis."""
+    ingest contains NO collectives on either mesh axis — including with
+    pack LANES per shard (the per-lane unpack loop + table stack must stay
+    purely local)."""
     from netobserv_tpu.datapath import flowpack
 
     ndata, nsk = mesh_shape
     if ndata * nsk > len(jax.devices()):
         pytest.skip("not enough devices")
     mesh = make_mesh(MeshSpec(data=ndata, sketch=nsk))
-    bps = 64
-    caps = flowpack.default_resident_caps(bps)
-    fn = pmerge.make_sharded_ingest_resident_fn(mesh, CFG, bps, caps,
-                                                donate=False)
+    bpl = 64 // lanes
+    caps = flowpack.default_resident_caps(bpl)
+    fn = pmerge.make_sharded_ingest_resident_fn(mesh, CFG, bpl, caps,
+                                                donate=False, lanes=lanes)
     dist = pmerge.init_dist_state(CFG, mesh)
-    tables = pmerge.init_resident_tables(mesh, 1 << 12)
+    tables = pmerge.init_resident_tables(mesh, 1 << 12, lanes=lanes)
     flat = pmerge.shard_dense(mesh, np.zeros(
-        ndata * flowpack.resident_buf_len(bps, caps), np.uint32))
+        ndata * lanes * flowpack.resident_buf_len(bpl, caps), np.uint32))
     hlo = fn.lower(dist, tables, flat).compile().as_text()
     for coll in ("all-reduce", "all-gather", "collective-permute",
                  "reduce-scatter", "all-to-all"):

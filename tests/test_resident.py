@@ -271,3 +271,141 @@ def test_buf_len_matches_layout():
     caps = flowpack.ResidentCaps(dns=16, drop=8, nk=4, spill=2)
     assert flowpack.resident_buf_len(32, caps) == (
         4 + 32 * 3 + 16 + 8 * 2 + 4 * 11 + 2 * 20)
+
+
+# --- lane-sharded resident feed (single device, SKETCH_PACK_THREADS) ---
+
+
+def _fold_lanes(feed, lanes, slot_cap=1 << 12, caps=None):
+    """Fold `feed` through the LANE-SHARDED resident ring on one device
+    (n_shards=1, L lanes — the SKETCH_PACK_THREADS path)."""
+    import jax
+
+    from netobserv_tpu.sketch import state as sk
+    from netobserv_tpu.sketch.staging import ShardedResidentStagingRing
+
+    bpl = B // lanes
+    caps = caps or flowpack.default_resident_caps(bpl)
+    cfg = sk.SketchConfig()
+    ring = ShardedResidentStagingRing(
+        B, 1, sk.make_ingest_resident_lanes_fn(bpl, caps, lanes),
+        key_tables=jax.device_put(sk.init_key_tables(lanes, slot_cap)),
+        put=jax.device_put, caps=caps, slot_cap=slot_cap,
+        pack_threads=lanes, lanes=lanes)
+    s = sk.init_state(cfg)
+    for events, feats in feed:
+        s = ring.fold(s, events, **feats)
+    ring.drain()
+    jax.block_until_ready(s)
+    return s, ring
+
+
+@needs_jax
+def test_lane_sharded_matches_unsharded_resident():
+    """Single-device lane-sharded resident ingest == the unsharded resident
+    ingest on the same stream: order-independent sketches (CM planes, HLL
+    registers, totals) are bit-identical, heavy-hitter recall matches, and
+    each lane's device key table matches the keys its dictionary assigned."""
+    import jax
+
+    from netobserv_tpu.ops import hll
+
+    feed = make_feed(n_batches=6, n_distinct=250, v6_every=23)
+    s_single, _, ring_single = _fold_both_ways(feed)
+    s_lanes, ring = _fold_lanes(feed, lanes=4)
+    assert ring.continuations == 0  # default caps hold the whole stream
+
+    for f in ("total_records", "total_bytes", "total_drop_bytes",
+              "total_drop_packets", "quic_records", "nat_records"):
+        assert float(getattr(s_lanes, f)) == pytest.approx(
+            float(getattr(s_single, f))), f
+    np.testing.assert_allclose(np.asarray(s_lanes.cm_bytes.counts),
+                               np.asarray(s_single.cm_bytes.counts))
+    np.testing.assert_allclose(np.asarray(s_lanes.cm_pkts.counts),
+                               np.asarray(s_single.cm_pkts.counts))
+    np.testing.assert_array_equal(np.asarray(s_lanes.hll_src.regs),
+                                  np.asarray(s_single.hll_src.regs))
+    assert float(hll.estimate(s_lanes.hll_src.regs)) == pytest.approx(
+        float(hll.estimate(s_single.hll_src.regs)))
+    # 250 distinct keys << topk slots: BOTH tables hold every key (recall 1)
+    got_l = {tuple(w) for w, v in zip(np.asarray(s_lanes.heavy.words),
+                                      np.asarray(s_lanes.heavy.valid)) if v}
+    got_s = {tuple(w) for w, v in zip(np.asarray(s_single.heavy.words),
+                                      np.asarray(s_single.heavy.valid)) if v}
+    assert got_l == got_s
+
+    # key-table contract per lane: slot i of lane L's device table holds the
+    # i-th DISTINCT key first seen in lane L's row slice, in stream order
+    # (the dictionary assigns slots sequentially; the new-key lane defines
+    # them on device before any hot row references them)
+    from netobserv_tpu.model.columnar import pack_key_words
+    tables = np.asarray(ring.key_tables)  # (lanes, slot_cap, 10)
+    for lane in range(ring.n_regions):
+        expected: dict[bytes, int] = {}
+        for events, _feats in feed:
+            n = len(events)
+            lo = n * lane // ring.n_regions
+            hi = n * (lane + 1) // ring.n_regions
+            for kw in pack_key_words(events["key"][lo:hi]):
+                expected.setdefault(kw.tobytes(), len(expected))
+        assert ring.kdicts[lane].count() == len(expected)
+        for kb, slot in expected.items():
+            assert tables[lane, slot].tobytes() == kb
+
+
+@needs_jax
+def test_lane_ring_exhausted_region_masks_stale_buffer():
+    """Continuation chunks with UNEVEN lane progress: the exhausted lane's
+    region keeps the previous chunk's bytes and is masked empty via the
+    strided validity zeroing (flowpack.zero_resident_region) — results must
+    still match the dense ingest exactly (a stale row leaking through the
+    mask would break every total)."""
+    caps = flowpack.ResidentCaps(dns=8, drop=8, nk=64, spill=2)
+    feed = make_feed(n_batches=3, n_distinct=100)
+    for events, _ in feed:
+        # second half of every batch: packets over the 11-bit hot budget
+        # force the spill lane (cap 2) -> lane 1 needs many continuation
+        # chunks while lane 0 finishes in one -> exhausted-region path
+        events["stats"]["packets"][len(events) // 2:] = 0x900
+    import jax
+
+    from netobserv_tpu.sketch import state as sk
+
+    s_lanes, ring = _fold_lanes(feed, lanes=2, caps=caps)
+    assert ring.continuations > 0
+
+    dense_fn = sk.make_ingest_dense_fn(with_token=True)
+    s_d = sk.init_state(sk.SketchConfig())
+    for events, feats in feed:
+        db = flowpack.pack_dense(events, batch_size=B, **feats)
+        s_d, _ = dense_fn(s_d, jax.device_put(db.reshape(-1)))
+    jax.block_until_ready(s_d)
+    _assert_exact_signals_match(s_lanes, s_d)
+
+
+@needs_jax
+def test_zero_resident_region_masks_garbage_exactly():
+    """flowpack.zero_resident_region on an all-0xFF buffer must make the
+    device unpack + ingest behave exactly like a fully zeroed region (the
+    pin for replacing the full memset with strided validity writes)."""
+    import jax
+
+    from netobserv_tpu.sketch import state as sk
+
+    bs = 32
+    caps = flowpack.ResidentCaps(dns=4, drop=4, nk=4, spill=2)
+    total = flowpack.resident_buf_len(bs, caps)
+    garbage = np.full(total, 0xFFFFFFFF, np.uint32)
+    flowpack.zero_resident_region(garbage, bs, caps)
+    zeros = np.zeros(total, np.uint32)
+    cfg = sk.SketchConfig(cm_width=1 << 10, topk=16, ewma_buckets=32,
+                          hll_precision=6, perdst_buckets=32,
+                          perdst_precision=4, persrc_buckets=32,
+                          persrc_precision=4, hist_buckets=64)
+    fn = sk.make_ingest_resident_fn(bs, caps, donate=False)
+    table = jax.device_put(sk.init_key_table(64))
+    s_g, t_g = fn(sk.init_state(cfg), table, jax.device_put(garbage))
+    s_z, t_z = fn(sk.init_state(cfg), table, jax.device_put(zeros))
+    np.testing.assert_array_equal(np.asarray(t_g), np.asarray(t_z))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), s_g, s_z)

@@ -317,6 +317,8 @@ def test_sketch_window_timer_crash_restarts_and_roll_errors_swallowed():
     exp = TpuSketchExporter.__new__(TpuSketchExporter)  # timer harness only
     exp._window_s = 0.5
     exp._lock = threading.Lock()
+    exp._publish_lock = threading.Lock()
+    exp._reports = __import__("collections").deque()
     exp._metrics = metrics
     exp._sink = lambda obj: None
     exp._window_deadline = time.monotonic() + 1e9  # never actually roll
@@ -332,10 +334,12 @@ def test_sketch_window_timer_crash_restarts_and_roll_errors_swallowed():
     sup.start()
     try:
         # roll-path error: swallowed and counted, timer thread stays up
+        # (generous timeout: the 0.05s timer poll starves under full-suite
+        # load on small CI boxes — only an injected fault can fail this)
         faultinject.arm("sketch.window_roll", "crash", times=2)
         wait_for(lambda: metrics.errors_total.labels(
             "tpu-sketch", "error")._value.get() >= 2,
-            msg="roll errors counted")
+            timeout=15, msg="roll errors counted")
         assert exp._timer.is_alive()
         assert sup.snapshot()["sketch-window"]["restarts"] == 0
 
