@@ -27,6 +27,12 @@ bench-cpu:
 bench-host:
 	JAX_PLATFORMS=cpu $(PY) bench.py --host-only
 
+# same run at 1% trace sampling: the flight-recorder overhead A/B
+# (docs/observability.md "Overhead budget"; compare host_fold_ms_p50 /
+# host_path_sustained against the bench-host artifact)
+bench-host-traced:
+	TRACE_SAMPLE=0.01 JAX_PLATFORMS=cpu $(PY) bench.py --host-only
+
 gen-protobuf:
 	protoc --python_out=netobserv_tpu/pb -I proto proto/flow.proto proto/packet.proto
 

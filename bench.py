@@ -536,6 +536,10 @@ def main():
         host.update(roll_stall_stats())
         out = {"metric": "host_path_records_per_sec",
                "value": host["host_path_sustained"], "unit": "records/s",
+               # self-describing artifact: the traced/untraced A/B
+               # (docs/observability.md) needs to know which run this was
+               "trace_sample": float(os.environ.get("TRACE_SAMPLE", "0")
+                                     or 0),
                **host}
         if _DEVICE_NOTE:
             out["device"] = _DEVICE_NOTE

@@ -20,6 +20,7 @@ from netobserv_tpu.exporter import build_exporter
 from netobserv_tpu.exporter.base import Exporter, QueueExporter
 from netobserv_tpu.flow import Accounter, CapacityLimiter, MapTracer, RingBufTracer
 from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+from netobserv_tpu.utils import retrace, tracing
 
 log = logging.getLogger("netobserv_tpu.agent")
 
@@ -49,6 +50,11 @@ class FlowsAgent:
         self.exporter = exporter
         self.metrics = metrics or Metrics(MetricsSettings(
             prefix=cfg.metrics_prefix, level=cfg.metrics_level))
+        # observability plumbing (utils/tracing.py, utils/retrace.py):
+        # sampled flight-recorder spans feed stage_seconds{stage=...}, and
+        # post-warmup jit retraces alarm via sketch_retraces_total{fn=...}
+        tracing.set_metrics(self.metrics)
+        retrace.set_metrics(self.metrics)
         self._status = Status.NOT_STARTED
         self._status_lock = threading.Lock()
         self._stop = threading.Event()

@@ -35,7 +35,7 @@ from netobserv_tpu.sketch import staging
 from netobserv_tpu.model.columnar import FlowBatch, unpack_key_words
 from netobserv_tpu.model.flow import ip_from_16
 from netobserv_tpu.model.record import Record
-from netobserv_tpu.utils import faultinject
+from netobserv_tpu.utils import faultinject, retrace, tracing
 
 log = logging.getLogger("netobserv_tpu.exporter.tpu_sketch")
 
@@ -283,6 +283,16 @@ class TpuSketchExporter(Exporter):
         self._asym_min_bytes = asym_min_bytes
         self._asym_ratio = asym_ratio
         self._metrics = metrics
+        if metrics is not None:
+            # retrace alarms and span histograms land in THIS agent's
+            # registry (module-level binding: one facade per process in
+            # production; tests rebind freely)
+            retrace.set_metrics(metrics)
+            tracing.set_metrics(metrics)
+        #: batch trace (flight recorder) riding the pending buffer: the
+        #: first sampled eviction's trace is finished by the fold that
+        #: consumes its rows
+        self._pending_trace = None
         # resident pack LANES cost per-lane device key tables and only pay
         # off where parallel dictionary probes actually scale: engage them
         # for an EXPLICIT SKETCH_PACK_THREADS (the operator chose), but an
@@ -373,11 +383,16 @@ class TpuSketchExporter(Exporter):
         else:
             self._ndata = 1
             self._state = sk.init_state(self._cfg)
-            self._ingest = sk.make_ingest_fn(
+            # retrace watchdog: every jitted entry point the exporter can
+            # dispatch is watched — its first compile is warmup, any later
+            # compile alarms (sketch_retraces_total{fn=...})
+            self._ingest = retrace.watch(sk.make_ingest_fn(
                 use_pallas=self._cfg.use_pallas,
                 enable_fanout=self._cfg.enable_fanout,
-                enable_asym=self._cfg.enable_asym)
-            self._roll = sk.make_roll_fn(self._cfg, decay_factor=decay_factor)
+                enable_asym=self._cfg.enable_asym), "ingest")
+            self._roll = retrace.watch(
+                sk.make_roll_fn(self._cfg, decay_factor=decay_factor),
+                "roll")
             self._ring = self._make_single_device_ring(
                 feed, resident_slots, pack_threads, metrics)
         # zero-concat eviction accumulator (columnar fast path): rows copy
@@ -468,33 +483,46 @@ class TpuSketchExporter(Exporter):
                                         self._pending[self._batch_size:])
                 self._fold(chunk)
             if time.monotonic() >= self._window_deadline:
-                if self._pending:
-                    self._fold(self._pending)
-                    self._pending = []
-                self._roll_locked()
+                self._close_window_locked()
 
     def export_evicted(self, evicted) -> None:
         """Columnar fast path: fold raw evictions without building Records.
         Full batches fold as the rolling buffer fills (zero concatenation);
         a due window only dispatches the roll here — rendering and sink I/O
         happen on the timer thread, so this never waits on a sink."""
+        trace = getattr(evicted, "trace", None)
         with self._lock:
+            if trace is not None:
+                if self._pending_trace is None:
+                    self._pending_trace = trace  # the next fold finishes it
+                else:
+                    trace.finish()  # rare: two sampled evictions in one fold
             self._pending_buf.append(evicted, self._fold_events)
             if time.monotonic() >= self._window_deadline:
-                self._drain_pending_locked()
-                self._roll_locked()
+                self._close_window_locked()
 
     def _fold_events(self, events, feats) -> None:
         t0 = time.perf_counter()
         n = len(events)
+        # batch trace continuity: the sampled eviction trace riding the
+        # pending buffer (or a fold-local sample when none) — the gap from
+        # its evict span to this fold span IS the export queue wait
+        trace = self._pending_trace
+        self._pending_trace = None
+        if trace is None:
+            trace = tracing.start_trace("fold")
         try:
-            faultinject.fire("sketch.ingest")
-            self._state = self._ring.fold(self._state, events, **feats)
+            with trace.stage("fold"):
+                faultinject.fire("sketch.ingest")
+                self._state = self._ring.fold(self._state, events,
+                                              trace=trace, **feats)
         except Exception as exc:
             # graceful degradation: a device error loses THIS batch (counted)
             # instead of poisoning the exporter thread / window timer
             self._count_ingest_error(n, exc)
             return
+        finally:
+            trace.finish()
         if self._metrics is not None:
             self._metrics.sketch_batches_total.inc()
             self._metrics.sketch_records_total.inc(n)
@@ -530,12 +558,27 @@ class TpuSketchExporter(Exporter):
             self._pending = []
         self._pending_buf.flush_to(self._fold_events)
 
+    def _close_window_locked(self) -> None:
+        """Drain pending rows and dispatch the roll, under ONE window trace
+        (roll_drain + roll_dispatch spans; the render/sink spans attach when
+        the queued report publishes on the timer thread)."""
+        wtrace = tracing.start_trace("window")
+        try:
+            with wtrace.stage("roll_drain"):
+                self._drain_pending_locked()
+            self._roll_locked(wtrace)
+        except BaseException:
+            # a failed roll never reaches the report queue, so nothing else
+            # will seal the trace — a failing window's spans are exactly the
+            # evidence the recorder exists for
+            wtrace.finish()
+            raise
+
     def flush(self) -> None:
         """Fold pending records, close the current window now, and publish
         the report synchronously (shutdown/tests path)."""
         with self._lock:
-            self._drain_pending_locked()
-            self._roll_locked()
+            self._close_window_locked()
         self._publish_queued()
 
     def close(self) -> None:
@@ -558,8 +601,7 @@ class TpuSketchExporter(Exporter):
                 faultinject.fire("sketch.window_roll")
                 with self._lock:
                     if time.monotonic() >= self._window_deadline:
-                        self._drain_pending_locked()
-                        self._roll_locked()
+                        self._close_window_locked()
             except Exception as exc:
                 # a roll failure must not kill the timer — the next window
                 # retries
@@ -603,10 +645,11 @@ class TpuSketchExporter(Exporter):
                 caps = flowpack.default_resident_caps(bpl)
                 return staging.ShardedResidentStagingRing(
                     self._batch_size, 1,
-                    sk.make_ingest_resident_lanes_fn(
+                    retrace.watch(sk.make_ingest_resident_lanes_fn(
                         bpl, caps, lanes, use_pallas=self._cfg.use_pallas,
                         enable_fanout=self._cfg.enable_fanout,
                         enable_asym=self._cfg.enable_asym),
+                        "ingest_resident_lanes"),
                     key_tables=jax.device_put(
                         sk.init_key_tables(lanes, resident_slots)),
                     put=jax.device_put, caps=caps, slot_cap=resident_slots,
@@ -614,43 +657,59 @@ class TpuSketchExporter(Exporter):
             caps = flowpack.default_resident_caps(self._batch_size)
             return staging.ResidentStagingRing(
                 self._batch_size,
-                sk.make_ingest_resident_fn(self._batch_size, caps, **kw),
+                retrace.watch(
+                    sk.make_ingest_resident_fn(self._batch_size, caps, **kw),
+                    "ingest_resident"),
                 caps=caps, slot_cap=resident_slots, metrics=metrics)
         if feed == "compact":
             spill_cap = staging.default_spill_cap(self._batch_size)
             return staging.DenseStagingRing(
                 self._batch_size,
-                sk.make_ingest_compact_fn(self._batch_size, spill_cap, **kw),
+                retrace.watch(
+                    sk.make_ingest_compact_fn(self._batch_size, spill_cap,
+                                              **kw), "ingest_compact"),
                 spill_cap=spill_cap,
-                ingest_fallback=sk.make_ingest_dense_fn(**kw),
+                ingest_fallback=retrace.watch(
+                    sk.make_ingest_dense_fn(**kw), "ingest_dense"),
                 metrics=metrics, pack_threads=pack_threads)
         if feed != "dense":
             log.warning("unknown SKETCH_FEED %r; using dense", feed)
         return staging.DenseStagingRing(
-            self._batch_size, sk.make_ingest_dense_fn(**kw),
+            self._batch_size,
+            retrace.watch(sk.make_ingest_dense_fn(**kw), "ingest_dense"),
             metrics=metrics, pack_threads=pack_threads)
 
     def _fold(self, records: list[Record]) -> None:
         t0 = time.perf_counter()
-        # always pad to the fixed batch size: a single static shape means the
-        # jitted ingest compiles exactly once (no per-window retraces)
-        batch = FlowBatch.from_records(records, batch_size=self._batch_size)
+        trace = tracing.start_trace("fold")
         try:
-            faultinject.fire("sketch.ingest")
-            arrays = self._sk.batch_to_device(batch)
-            if self._distributed:
-                arrays = self._pm.shard_batch(self._mesh, arrays)
-            self._state = self._ingest(self._state, arrays)
-        except Exception as exc:
-            self._count_ingest_error(len(records), exc)
-            return
+            # always pad to the fixed batch size: a single static shape
+            # means the jitted ingest compiles exactly once (no per-window
+            # retraces). A from_records failure still propagates to the
+            # caller (an export error, not an ingest error) — only the
+            # trace seal is widened over it.
+            with trace.stage("pack"):
+                batch = FlowBatch.from_records(records,
+                                               batch_size=self._batch_size)
+            try:
+                faultinject.fire("sketch.ingest")
+                with trace.stage("ingest_dispatch"):
+                    arrays = self._sk.batch_to_device(batch)
+                    if self._distributed:
+                        arrays = self._pm.shard_batch(self._mesh, arrays)
+                    self._state = self._ingest(self._state, arrays)
+            except Exception as exc:
+                self._count_ingest_error(len(records), exc)
+                return
+        finally:
+            trace.finish()
         if self._metrics is not None:
             self._metrics.sketch_batches_total.inc()
             self._metrics.sketch_records_total.inc(len(records))
             self._metrics.sketch_ingest_seconds.observe(
                 time.perf_counter() - t0)
 
-    def _roll_locked(self) -> None:
+    def _roll_locked(self, wtrace=tracing.NULL_TRACE) -> None:
         """Close the window UNDER self._lock: advance the deadline, dispatch
         the (async) device roll, swap in the fresh-window state, and queue
         the still-on-device report. No host transfer, JSON rendering, or
@@ -658,16 +717,21 @@ class TpuSketchExporter(Exporter):
         window-timer thread, so `export_batch`/`export_evicted` callers
         blocked on this lock never wait behind a sink."""
         self._window_deadline = time.monotonic() + self._window_s
-        self._state, report = self._roll(self._state)
-        self._reports.append(report)
+        with wtrace.stage("roll_dispatch"):
+            self._state, report = self._roll(self._state)
+        # the window trace rides the queued report; render/sink spans attach
+        # at publish time on the timer thread (the gap in between is the
+        # report's queue wait)
+        self._reports.append((report, wtrace))
         while len(self._reports) > self._max_queued_reports:
             # a wedged sink has the timer blocked mid-publish: shed the
             # OLDEST unpublished window instead of accumulating device
             # reports without bound (counted, like any lost report)
             try:
-                self._reports.popleft()
+                _shed, shed_trace = self._reports.popleft()
             except IndexError:
                 break  # the publisher drained it between len() and pop
+            shed_trace.finish()
             log.error("window report queue full (sink stalled?); "
                       "dropping the oldest unpublished report")
             if self._metrics is not None:
@@ -689,28 +753,35 @@ class TpuSketchExporter(Exporter):
         with self._publish_lock:
             while self._reports:
                 try:
-                    report = self._reports.popleft()
+                    report, wtrace = self._reports.popleft()
                 except IndexError:
                     return  # _roll_locked's shed loop emptied it first
                 try:
-                    self._publish_report(report)
+                    self._publish_report(report, wtrace)
                 except Exception as exc:
                     log.error("window report publish failed "
                               "(report lost): %s", exc)
                     if self._metrics is not None:
                         self._metrics.count_error("tpu-sketch")
+                finally:
+                    wtrace.finish()
 
-    def _publish_report(self, report) -> None:
-        obj = report_to_json(
-            report, scan_fanout_threshold=self._scan_fanout,
-            ddos_z_threshold=self._ddos_z,
-            synflood_min=self._synflood_min,
-            synflood_ratio=self._synflood_ratio,
-            drop_z_threshold=self._drop_z,
-            asym_min_bytes=self._asym_min_bytes,
-            asym_ratio=self._asym_ratio)
+    def _publish_report(self, report, wtrace=tracing.NULL_TRACE) -> None:
+        with wtrace.stage("report_render"):
+            # includes the device->host transfer of the report arrays (the
+            # first np.asarray touch) — deliberately not split out, so the
+            # un-traced path never adds a blocking device sync
+            obj = report_to_json(
+                report, scan_fanout_threshold=self._scan_fanout,
+                ddos_z_threshold=self._ddos_z,
+                synflood_min=self._synflood_min,
+                synflood_ratio=self._synflood_ratio,
+                drop_z_threshold=self._drop_z,
+                asym_min_bytes=self._asym_min_bytes,
+                asym_ratio=self._asym_ratio)
         obj["TimestampMs"] = time.time_ns() // 1_000_000
-        self._sink(obj)
+        with wtrace.stage("report_sink"):
+            self._sink(obj)
         if self._metrics is not None:
             self._metrics.sketch_window_reports_total.inc()
             self._metrics.sketch_window_records.set(obj["Records"])

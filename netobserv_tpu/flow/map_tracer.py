@@ -15,7 +15,7 @@ import time
 from typing import Optional
 
 from netobserv_tpu.datapath.fetcher import FlowFetcher
-from netobserv_tpu.utils import faultinject
+from netobserv_tpu.utils import faultinject, tracing
 from netobserv_tpu.utils.dnsnames import decode_qname
 from netobserv_tpu.model.record import (
     InterfaceNamer, MonotonicClock, Record, interface_namer,
@@ -94,12 +94,17 @@ class MapTracer:
             self._evict_locked()
 
     def _evict_locked(self) -> None:
+        # flight recorder: a batch trace is born here and rides the evicted
+        # batch to the exporter fold (columnar path); un-sampled evictions
+        # get the shared NULL trace — no timestamps, no locks
+        trace = tracing.start_trace("batch")
         t0 = time.perf_counter()
-        evicted = self._fetcher.lookup_and_delete()
-        # purge orphaned auxiliary entries (e.g. DNS queries never answered)
-        purge = getattr(self._fetcher, "purge_stale", None)
-        if purge is not None:
-            purge(self._stale_purge_s)
+        with trace.stage("evict"):
+            evicted = self._fetcher.lookup_and_delete()
+            # purge orphaned auxiliary entries (e.g. DNS never answered)
+            purge = getattr(self._fetcher, "purge_stale", None)
+            if purge is not None:
+                purge(self._stale_purge_s)
         if self._metrics is not None:
             self._metrics.observe_eviction(
                 "map", len(evicted), time.perf_counter() - t0)
@@ -111,8 +116,10 @@ class MapTracer:
             import gc
             gc.collect()
         if len(evicted) == 0:
-            return
+            return  # idle eviction: drop the trace unrecorded (no flows)
         if self._columnar:
+            if trace.sampled:
+                evicted.trace = trace  # the exporter fold finishes it
             try:
                 self._out.put_nowait(evicted)
             except queue.Full:
@@ -120,18 +127,24 @@ class MapTracer:
                     self._metrics.count_dropped(len(evicted), "map_tracer")
                 log.warning("eviction dropped: downstream buffer full "
                             "(%d flows)", len(evicted))
+                trace.finish()  # never reaches the fold — seal what we have
             return
-        namer = self._namer or interface_namer()
-        records = records_from_events(
-            evicted.events, clock=self._clock, agent_ip=self._agent_ip,
-            namer=namer)
-        _attach_features(records, evicted, ssl_correlator=self._ssl_correlator)
-        if self._udn_mapper is not None:
-            for rec in records:
-                rec.udn = self._udn_mapper.udn_for(rec.interface)
-                rec.dup_list = [
-                    (name, d, self._udn_mapper.udn_for(name))
-                    for name, d, _u in rec.dup_list]
+        with trace.stage("enrich"):
+            namer = self._namer or interface_namer()
+            records = records_from_events(
+                evicted.events, clock=self._clock, agent_ip=self._agent_ip,
+                namer=namer)
+            _attach_features(records, evicted,
+                             ssl_correlator=self._ssl_correlator)
+            if self._udn_mapper is not None:
+                for rec in records:
+                    rec.udn = self._udn_mapper.udn_for(rec.interface)
+                    rec.dup_list = [
+                        (name, d, self._udn_mapper.udn_for(name))
+                        for name, d, _u in rec.dup_list]
+        # record batches are plain lists and cannot carry a trace context;
+        # the record path's trace ends at enqueue (evict + enrich spans)
+        trace.finish()
         try:
             self._out.put_nowait(records)
         except queue.Full:

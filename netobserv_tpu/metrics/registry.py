@@ -51,8 +51,13 @@ class MetricsSettings:
 class Metrics:
     """Facade handed to every pipeline stage (reference: `metrics.Metrics`)."""
 
-    def __init__(self, settings: MetricsSettings = MetricsSettings(),
+    def __init__(self, settings: MetricsSettings | None = None,
                  registry: CollectorRegistry | None = None):
+        # construct per call: a dataclass default instance would be silently
+        # SHARED by every Metrics() built without args (one caller mutating
+        # trace_ttl_s would retime every other facade's janitor)
+        if settings is None:
+            settings = MetricsSettings()
         self.settings = settings
         self.level = settings.normalized_level()
         self.registry = registry if registry is not None else CollectorRegistry()
@@ -176,6 +181,22 @@ class Metrics:
             "Device ingest failures absorbed by dropping the batch "
             "(graceful degradation; the window timer stays alive)",
             registry=self.registry)
+        # flight recorder (utils/tracing.py) + retrace watchdog
+        # (utils/retrace.py)
+        self.stage_seconds = Histogram(
+            p + "stage_seconds",
+            "Per-stage latency of sampled batch/window traces (flight "
+            "recorder spans; populated only when TRACE_SAMPLE > 0)",
+            ["stage"],
+            buckets=(.0001, .0005, .001, .005, .01, .05, .1, .5, 1, 5),
+            registry=self.registry)
+        self.sketch_retraces_total = Counter(
+            p + "sketch_retraces_total",
+            "Post-warmup XLA recompilations of a watched jitted entry "
+            "point — the fixed-shape ingest invariant is broken (each one "
+            "is a multi-second stall; see the retrace watchdog log line "
+            "for the offending abstract shapes)", ["fn"],
+            registry=self.registry)
 
     # --- convenience methods used by pipeline stages ---
     def observe_eviction(self, source: str, n_flows: int, seconds: float) -> None:
@@ -205,6 +226,12 @@ class Metrics:
 
     def count_error(self, component: str, severity: str = "error") -> None:
         self.errors_total.labels(component, severity).inc()
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        self.stage_seconds.labels(stage).observe(seconds)
+
+    def count_retrace(self, fn: str) -> None:
+        self.sketch_retraces_total.labels(fn).inc()
 
     def count_stage_failure(self, stage: str, kind: str) -> None:
         self.stage_failures_total.labels(stage, kind).inc()

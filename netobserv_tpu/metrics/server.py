@@ -96,8 +96,15 @@ def start_metrics_server(registry: CollectorRegistry, address: str = "",
                          ) -> ThreadingHTTPServer:
     """Start the exposition server on a daemon thread; returns the server
     (call .shutdown() to stop)."""
+    # staticmethod keeps a plain-function health_source from being rebound
+    # as an instance method of the handler (which would call it with `self`
+    # and turn every probe into a swallowed TypeError -> "Unknown" 503);
+    # bound methods like FlowsAgent.health_snapshot pass through unchanged
     handler = type("Handler", (_Handler,),
-                   {"registry": registry, "health_source": health_source})
+                   {"registry": registry,
+                    "health_source": (staticmethod(health_source)
+                                      if health_source is not None
+                                      else None)})
     srv = ThreadingHTTPServer((address or "0.0.0.0", port), handler)
     srv.timeout = 10  # hardened-ish defaults (reference: pkg/server/common.go)
     if tls_cert_path and tls_key_path:

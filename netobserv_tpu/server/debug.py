@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import gc
 import io
+import json
 import logging
 import sys
 import threading
@@ -13,6 +14,9 @@ from collections import Counter
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 log = logging.getLogger("netobserv_tpu.server.debug")
+
+_JSON = "application/json"
+_TEXT = "text/plain; charset=utf-8"
 
 
 def _threads_dump() -> str:
@@ -44,24 +48,91 @@ def _gc_dump() -> str:
     return "".join(lines)
 
 
+def _traces_dump() -> str:
+    """Flight recorder: last N completed batch/window traces, newest first,
+    each with per-stage durations and inter-stage queue-wait gaps
+    (utils/tracing.py; empty unless TRACE_SAMPLE > 0)."""
+    from netobserv_tpu.utils import tracing
+
+    return json.dumps({
+        "sampling_enabled": tracing.enabled(),
+        "traces": tracing.snapshot(),
+    }, separators=(",", ":"))
+
+
+def _jax_dump() -> str:
+    """JAX runtime state: backend/platform, devices, live-array count,
+    compilation-cache stats, and the retrace watchdog's per-entry-point
+    compile accounting (utils/retrace.py). Touching this route initializes
+    the JAX backend if nothing else has."""
+    from netobserv_tpu.utils import retrace
+
+    out: dict = {}
+    try:
+        import jax
+
+        out["backend"] = jax.default_backend()
+        out["process_index"] = jax.process_index()
+        out["device_count"] = jax.device_count()
+        out["devices"] = [str(d) for d in jax.devices()]
+        out["live_arrays"] = len(jax.live_arrays())
+        try:
+            from jax._src import compilation_cache as cc
+
+            cache = cc._cache  # persistent cache; None when never enabled
+            out["compilation_cache"] = {
+                "enabled": cache is not None,
+                "dir": (jax.config.jax_compilation_cache_dir or ""),
+            }
+        except Exception:
+            out["compilation_cache"] = {"enabled": False}
+    except Exception as exc:  # debug surface must answer on broken backends
+        out["error"] = str(exc)
+    out["retrace_watchdog"] = retrace.snapshot()
+    out["retraces_total"] = retrace.total_retraces()
+    return json.dumps(out, separators=(",", ":"))
+
+
+#: route -> (handler, content type, one-line description for the index)
+_ROUTES = {
+    "/debug/threads": (
+        _threads_dump, _TEXT,
+        "stack dump of every live thread"),
+    "/debug/tracemalloc": (
+        _tracemalloc_dump, _TEXT,
+        "top host allocation sites (first hit arms tracemalloc)"),
+    "/debug/gc": (
+        _gc_dump, _TEXT,
+        "gc counters and the most common live object types"),
+    "/debug/traces": (
+        _traces_dump, _JSON,
+        "flight recorder: last completed batch/window traces, newest "
+        "first, with per-stage durations and queue-wait gaps "
+        "(TRACE_SAMPLE)"),
+    "/debug/jax": (
+        _jax_dump, _JSON,
+        "jax backend/devices, live arrays, compilation cache, and the "
+        "retrace watchdog's per-entry-point compile counts"),
+}
+
+
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
-        routes = {
-            "/debug/threads": _threads_dump,
-            "/debug/tracemalloc": _tracemalloc_dump,
-            "/debug/gc": _gc_dump,
-        }
         path = self.path.split("?")[0]
         if path in ("/", "/debug", "/debug/"):
-            body = "\n".join(routes) + "\n"
-        elif path in routes:
-            body = routes[path]()
+            body = "".join(f"{route:<22} {desc}\n"
+                           for route, (_fn, _ct, desc)
+                           in sorted(_ROUTES.items()))
+            ctype = _TEXT
+        elif path in _ROUTES:
+            fn, ctype, _desc = _ROUTES[path]
+            body = fn()
         else:
             self.send_error(404)
             return
         payload = body.encode()
         self.send_response(200)
-        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)

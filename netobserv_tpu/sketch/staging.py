@@ -25,7 +25,7 @@ import numpy as np
 
 from netobserv_tpu.datapath import flowpack
 from netobserv_tpu.model import binfmt
-from netobserv_tpu.utils import faultinject
+from netobserv_tpu.utils import faultinject, tracing
 
 
 def default_spill_cap(batch_size: int) -> int:
@@ -130,7 +130,17 @@ class _SlotRing:
         self._metrics = metrics
         self.stalls = 0
 
-    def _wait_slot(self) -> int:
+    def _fold_trace(self, trace):
+        """Resolve a fold's trace context: the caller's (batch trace riding
+        the eviction, or the exporter's NULL), else sample one here — a
+        directly-driven ring (bench.py --host-only) still exercises the
+        span layer. Returns (trace, owned): the ring finishes only traces
+        it created."""
+        if trace is not None:
+            return trace, False
+        return tracing.start_trace("fold"), True
+
+    def _wait_slot(self, trace=tracing.NULL_TRACE) -> int:
         """Return the next slot index, blocking until its previous consumer
         (the ingest that read the slot's buffer) has finished."""
         import jax
@@ -146,7 +156,10 @@ class _SlotRing:
                 self.stalls += 1
                 if self._metrics is not None:
                     self._metrics.sketch_staging_stalls_total.inc()
-            jax.block_until_ready(tok)
+                with trace.stage("staging_wait"):
+                    jax.block_until_ready(tok)
+            else:
+                jax.block_until_ready(tok)
         return slot
 
     def _advance(self, slot: int, token) -> None:
@@ -209,28 +222,40 @@ class DenseStagingRing(_SlotRing):
         self.dense_fallbacks = 0  # spill-overflow batches shipped full-width
 
     def fold(self, state, events, extra=None, dns=None, drops=None,
-             xlat=None, quic=None):
+             xlat=None, quic=None, trace=None):
         """Pack `events` into the next free slot, ship it, ingest it; returns
         the new sketch state (async — not blocked on)."""
-        slot = self._wait_slot()
-        feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat, quic=quic)
-        if self.spill_cap is not None:
-            buf = flowpack.pack_compact(
-                events, batch_size=self.batch_size, spill_cap=self.spill_cap,
-                out=self._bufs[slot], **feats)
-            if buf is None:
-                return self._fold_dense_fallback(state, events, feats)
-            state, token = self._ingest(state, self._put(buf))
+        trace, owned = self._fold_trace(trace)
+        try:
+            slot = self._wait_slot(trace)
+            feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat,
+                         quic=quic)
+            if self.spill_cap is not None:
+                with trace.stage("pack"):
+                    buf = flowpack.pack_compact(
+                        events, batch_size=self.batch_size,
+                        spill_cap=self.spill_cap,
+                        out=self._bufs[slot], **feats)
+                if buf is None:
+                    return self._fold_dense_fallback(state, events, feats)
+                with trace.stage("ingest_dispatch"):
+                    state, token = self._ingest(state, self._put(buf))
+                self._advance(slot, token)
+                return state
+            with trace.stage("pack"):
+                buf = flowpack.pack_dense_sharded(
+                    events, batch_size=self.batch_size,
+                    threads=self.pack_threads, out=self._bufs[slot], **feats)
+            # ship FLAT: a (B*20,) transfer dodges device-layout padding of
+            # the 20-wide minor dim (the ingest jit reshapes back, fused,
+            # free)
+            with trace.stage("ingest_dispatch"):
+                state, token = self._ingest(state, self._put(buf.reshape(-1)))
             self._advance(slot, token)
             return state
-        buf = flowpack.pack_dense_sharded(
-            events, batch_size=self.batch_size, threads=self.pack_threads,
-            out=self._bufs[slot], **feats)
-        # ship FLAT: a (B*20,) transfer dodges device-layout padding of the
-        # 20-wide minor dim (the ingest jit reshapes back, fused, free)
-        state, token = self._ingest(state, self._put(buf.reshape(-1)))
-        self._advance(slot, token)
-        return state
+        finally:
+            if owned:
+                trace.finish()
 
     def _fold_dense_fallback(self, state, events, feats):
         """Non-v4 (or spill-overflow) flows exceeded the spill lane: ship
@@ -314,13 +339,24 @@ class ShardedResidentStagingRing(_SlotRing):
                           for _ in range(n_slots)], metrics)
 
     def fold(self, state, events, extra=None, dns=None, drops=None,
-             xlat=None, quic=None):
+             xlat=None, quic=None, trace=None):
         """Pack `events` (split over the regions, possibly in several
         chunks) into free ring slots, ship and ingest each; returns the new
         dist state (async — not blocked on)."""
         n = len(events)
         if n == 0:
             return state
+        trace, owned = self._fold_trace(trace)
+        try:
+            return self._fold_traced(state, events, extra, dns, drops, xlat,
+                                     quic, trace)
+        finally:
+            if owned:
+                trace.finish()
+
+    def _fold_traced(self, state, events, extra, dns, drops, xlat, quic,
+                     trace):
+        n = len(events)
         feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat, quic=quic)
         nr = self.n_regions
         bounds = [n * i // nr for i in range(nr + 1)]
@@ -332,7 +368,7 @@ class ShardedResidentStagingRing(_SlotRing):
         starts = [0] * nr
         first = True
         while any(starts[i] < len(shard_ev[i]) for i in range(nr)):
-            slot = self._wait_slot()
+            slot = self._wait_slot(trace)
             buf = self._bufs[slot]
 
             def pack_shard(i):
@@ -363,14 +399,15 @@ class ShardedResidentStagingRing(_SlotRing):
                 starts[i] += consumed
                 return int(region[2]), resets
 
-            if self.pack_threads > 1 and nr > 1:
-                # per-region dictionaries are independent; the native pack
-                # releases the GIL, so regions pack in true parallel
-                outs = [f.result() for f in flowpack._pack_submit(
-                    min(self.pack_threads, nr),
-                    [lambda i=i: pack_shard(i) for i in range(nr)])]
-            else:
-                outs = [pack_shard(i) for i in range(nr)]
+            with trace.stage("resident_pack"):
+                if self.pack_threads > 1 and nr > 1:
+                    # per-region dictionaries are independent; the native
+                    # pack releases the GIL, so regions pack in true parallel
+                    outs = [f.result() for f in flowpack._pack_submit(
+                        min(self.pack_threads, nr),
+                        [lambda i=i: pack_shard(i) for i in range(nr)])]
+                else:
+                    outs = [pack_shard(i) for i in range(nr)]
             chunk_spills = sum(o[0] for o in outs)
             chunk_resets = sum(o[1] for o in outs)
             self.spill_rows += chunk_spills
@@ -387,8 +424,9 @@ class ShardedResidentStagingRing(_SlotRing):
             if not first:
                 self.continuations += 1
             first = False
-            state, self.key_tables, token = self._ingest(
-                state, self.key_tables, self._put(buf))
+            with trace.stage("ingest_dispatch"):
+                state, self.key_tables, token = self._ingest(
+                    state, self.key_tables, self._put(buf))
             self._advance(slot, token)
         return state
 
@@ -434,7 +472,7 @@ class ResidentStagingRing(_SlotRing):
                           for _ in range(n_slots)], metrics)
 
     def fold(self, state, events, extra=None, dns=None, drops=None,
-             xlat=None, quic=None):
+             xlat=None, quic=None, trace=None):
         """Pack `events` (possibly in several chunks) into free ring slots,
         ship and ingest each; returns the new sketch state (async — not
         blocked on)."""
@@ -442,34 +480,43 @@ class ResidentStagingRing(_SlotRing):
         n = len(events)
         if n == 0:
             return state
-        start = 0
-        first = True
-        while start < n:
-            if self.kdict.count() >= self.slot_cap:
-                # epoch roll: the device table needs no reset — every live
-                # slot is redefined before any hot row references it
-                self.kdict.reset()
-                self.dict_resets += 1
+        trace, owned = self._fold_trace(trace)
+        try:
+            start = 0
+            first = True
+            while start < n:
+                if self.kdict.count() >= self.slot_cap:
+                    # epoch roll: the device table needs no reset — every
+                    # live slot is redefined before any hot row references it
+                    self.kdict.reset()
+                    self.dict_resets += 1
+                    if self._metrics is not None:
+                        self._metrics.sketch_resident_dict_epochs_total.inc()
+                slot = self._wait_slot(trace)
+                with trace.stage("resident_pack"):
+                    buf, consumed = flowpack.pack_resident(
+                        events, batch_size=self.batch_size, kdict=self.kdict,
+                        caps=self.caps, start=start, out=self._bufs[slot],
+                        **feats)
+                if consumed == 0 and n:
+                    raise RuntimeError("resident pack made no progress")
+                self.spill_rows += int(buf[2])
                 if self._metrics is not None:
-                    self._metrics.sketch_resident_dict_epochs_total.inc()
-            slot = self._wait_slot()
-            buf, consumed = flowpack.pack_resident(
-                events, batch_size=self.batch_size, kdict=self.kdict,
-                caps=self.caps, start=start, out=self._bufs[slot], **feats)
-            if consumed == 0 and n:
-                raise RuntimeError("resident pack made no progress")
-            self.spill_rows += int(buf[2])
-            if self._metrics is not None:
-                if buf[2]:
-                    self._metrics.sketch_resident_spill_rows_total.inc(
-                        int(buf[2]))
+                    if buf[2]:
+                        self._metrics.sketch_resident_spill_rows_total.inc(
+                            int(buf[2]))
+                    if not first:
+                        self._metrics \
+                            .sketch_resident_continuations_total.inc()
                 if not first:
-                    self._metrics.sketch_resident_continuations_total.inc()
-            if not first:
-                self.continuations += 1
-            first = False
-            start += consumed
-            state, self.key_table, token = self._ingest(
-                state, self.key_table, self._put(buf))
-            self._advance(slot, token)
-        return state
+                    self.continuations += 1
+                first = False
+                start += consumed
+                with trace.stage("ingest_dispatch"):
+                    state, self.key_table, token = self._ingest(
+                        state, self.key_table, self._put(buf))
+                self._advance(slot, token)
+            return state
+        finally:
+            if owned:
+                trace.finish()

@@ -1,0 +1,197 @@
+"""Jit retrace watchdog: the "ingest must never retrace" invariant, live.
+
+The CLAUDE.md invariant — fixed batch shapes, padding, masks, no
+data-dependent shapes under jit — is enforced by tests but was never
+*watched* in production, where a retrace is a multi-second ingest stall and
+an unbounded compile-cache leak. This module turns it into an alarm:
+
+- every jitted entry point the pipeline constructs is wrapped with
+  :func:`watch` (``exporter/tpu_sketch.py`` for the single-device fns,
+  ``parallel/merge.py`` for the sharded ones);
+- a process-wide ``jax.monitoring`` listener counts XLA *lowerings*
+  (``/jax/core/compile/jaxpr_to_mlir_module_duration``) and attributes each
+  to the watched entry point currently executing on that thread (jit traces
+  and lowers synchronously in the calling thread; lowering fires on every
+  retrace even when the persistent compilation cache serves the executable,
+  which ``backend_compile`` events would miss);
+- each entry point's first ``warmup_calls`` calls (default 1,
+  ``RETRACE_WARMUP_CALLS``) may compile freely — that is the expected
+  warmup window. A compile on any later call is a retrace: it increments
+  ``sketch_retraces_total{fn=...}`` (when a Metrics facade is bound via
+  :func:`set_metrics`) and logs the offending abstract shapes.
+
+``RETRACE_WATCHDOG=0`` disables wrapping entirely (``watch`` returns the
+function untouched). The wrapper itself costs two thread-local attribute
+writes per call — per *batch*, never per record.
+
+Wrapped functions delegate attribute access to the underlying jit function,
+so AOT introspection (``fn.lower(...)``, ``fn._cache_size()``) keeps working
+(tests/test_parallel.py lowers the sharded ingest to assert the
+no-collectives invariant — through the wrapper).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import weakref
+from typing import Any, Callable, Optional
+
+log = logging.getLogger("netobserv_tpu.retrace")
+
+#: fires once per jaxpr->MLIR lowering, i.e. once per (re)trace of a jitted
+#: callable, regardless of persistent-compilation-cache hits
+_LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+
+_enabled = os.environ.get("RETRACE_WATCHDOG", "1").strip().lower() not in (
+    "0", "false", "no", "off")
+_default_warmup = int(os.environ.get("RETRACE_WARMUP_CALLS", "1") or 1)
+_metrics = None
+_installed = False
+_install_lock = threading.Lock()
+_tls = threading.local()
+#: every live Watched wrapper, for /debug/jax and tests. Weak references:
+#: the registry must not pin dead exporters' jit functions (and their
+#: compile caches) for process lifetime — a torn-down wrapper just drops
+#: out of the accounting
+_registry: list["weakref.ref[Watched]"] = []
+#: process-lifetime alarm history — survives wrapper GC (the registry is
+#: weak, the verdict is not)
+_retraces_total = 0
+
+
+def _describe(args: tuple, limit: int = 600) -> str:
+    """Abstract shapes of a call's arguments (dtype[shape] per leaf)."""
+    try:
+        import jax
+
+        desc = str(jax.tree.map(
+            lambda x: f"{getattr(x, 'dtype', type(x).__name__)}"
+                      f"{list(getattr(x, 'shape', []))}", args))
+    except Exception as exc:  # never let diagnostics break the caller
+        desc = f"<unrenderable args: {exc}>"
+    return desc if len(desc) <= limit else desc[:limit] + "...(truncated)"
+
+
+class Watched:
+    """Callable wrapper counting compilations of one jitted entry point."""
+
+    __slots__ = ("_fn", "name", "warmup_calls", "calls", "compiles",
+                 "retraces", "last_retrace", "__weakref__")
+
+    def __init__(self, fn: Callable, name: str, warmup_calls: int):
+        self._fn = fn
+        self.name = name
+        self.warmup_calls = warmup_calls
+        self.calls = 0
+        self.compiles = 0
+        self.retraces = 0
+        self.last_retrace: str = ""
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        prev = getattr(_tls, "active", None)
+        _tls.active = self
+        _tls.args = args
+        try:
+            return self._fn(*args, **kwargs)
+        finally:
+            _tls.active = prev
+            _tls.args = None
+
+    def __getattr__(self, item: str) -> Any:
+        # delegate .lower / ._cache_size / __wrapped__-style access
+        return getattr(object.__getattribute__(self, "_fn"), item)
+
+    def _note_compile(self) -> None:
+        global _retraces_total
+        self.compiles += 1
+        if self.calls <= self.warmup_calls:
+            return  # expected warmup compile
+        self.retraces += 1
+        _retraces_total += 1
+        self.last_retrace = _describe(getattr(_tls, "args", None) or ())
+        log.error(
+            "post-warmup XLA retrace of jitted entry %r (call %d, compile "
+            "%d): the fixed-shape ingest invariant is broken; offending "
+            "abstract shapes: %s",
+            self.name, self.calls, self.compiles, self.last_retrace)
+        m = _metrics
+        if m is not None:
+            m.count_retrace(self.name)
+
+    def stats(self) -> dict:
+        return {"fn": self.name, "calls": self.calls,
+                "compiles": self.compiles, "retraces": self.retraces,
+                "warmup_calls": self.warmup_calls,
+                **({"last_retrace": self.last_retrace}
+                   if self.last_retrace else {})}
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    if event != _LOWER_EVENT:
+        return
+    w = getattr(_tls, "active", None)
+    if w is not None:
+        w._note_compile()
+
+
+def _ensure_installed() -> None:
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _installed = True
+
+
+def watch(fn: Callable, name: str,
+          warmup_calls: Optional[int] = None) -> Callable:
+    """Wrap a jitted entry point for retrace accounting. Returns `fn`
+    unchanged when the watchdog is disabled; never double-wraps."""
+    if not _enabled or isinstance(fn, Watched):
+        return fn
+    _ensure_installed()
+    w = Watched(fn, name, _default_warmup if warmup_calls is None
+                else warmup_calls)
+    with _install_lock:
+        _registry.append(weakref.ref(w))
+        if len(_registry) % 64 == 0:  # amortized sweep of dead wrappers
+            _registry[:] = [r for r in _registry if r() is not None]
+    return w
+
+
+def _live_watched() -> list[Watched]:
+    return [w for w in (r() for r in _registry) if w is not None]
+
+
+def set_metrics(metrics) -> None:
+    """Bind the Metrics facade whose ``count_retrace`` receives post-warmup
+    retraces (sketch_retraces_total{fn=...})."""
+    global _metrics
+    _metrics = metrics
+
+
+def configure(enabled: Optional[bool] = None,
+              warmup_calls: Optional[int] = None) -> None:
+    """Test/ops hook: toggle the watchdog or change the default warmup
+    window for subsequently watched functions."""
+    global _enabled, _default_warmup
+    if enabled is not None:
+        _enabled = enabled
+    if warmup_calls is not None:
+        _default_warmup = warmup_calls
+
+
+def snapshot() -> list[dict]:
+    """Per-entry-point compile accounting (live wrappers), for /debug/jax."""
+    return [w.stats() for w in _live_watched()]
+
+
+def total_retraces() -> int:
+    """Process-lifetime post-warmup retrace count (monotonic; includes
+    wrappers that have since been garbage-collected)."""
+    return _retraces_total

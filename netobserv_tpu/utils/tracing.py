@@ -1,0 +1,290 @@
+"""Flight recorder: sampled end-to-end batch tracing with stage spans.
+
+A *trace* follows one unit of work through the pipeline — a "batch" trace is
+born at map eviction and rides the EvictedFlows object through the queues to
+the exporter fold; a "window" trace is born at window roll and rides the
+queued device report through render and sink delivery. Each pipeline stage
+wraps its work in a *span* (``with trace.stage("resident_pack"): ...``);
+completed traces land in a fixed-size ring buffer (the flight recorder,
+``/debug/traces`` on the debug server) and every span duration feeds the
+``stage_seconds{stage=...}`` histogram family when a Metrics facade is bound
+(:func:`set_metrics`, done by ``FlowsAgent.__init__``).
+
+The inter-span *gaps* are as load-bearing as the spans: the time between the
+``evict`` span's end and the ``fold`` span's start is exactly the
+evicted/export queue wait — the first thing to grow when the exporter falls
+behind.
+
+Sampling and the zero-cost contract:
+
+- ``TRACE_SAMPLE`` (env, float in [0, 1], default 0/unset = disabled) is the
+  per-trace sampling rate, applied deterministically PER TRACE KIND (every
+  round(1/rate)-th :func:`start_trace` call of that kind samples, so
+  ``TRACE_SAMPLE=1`` traces everything, tests are reproducible, and the
+  pipeline's periodic call pattern cannot alias one kind out of the
+  sample).
+- Disabled (the default), :func:`start_trace` is one module-bool check
+  returning the shared :data:`NULL_TRACE`, whose ``stage()`` returns the
+  shared :data:`NULL_SPAN` context manager — no locks, no timestamps, no
+  allocations anywhere on the hot path (the same discipline as
+  ``utils.faultinject``; pinned by tests/test_tracing.py and the
+  ``bench.py --host-only`` A/B in docs/observability.md).
+- Unsampled calls while enabled cost one int increment + one modulo.
+
+``TRACE_RING`` (env, default 64) bounds how many completed traces the
+recorder keeps; snapshots are newest-first.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+__all__ = [
+    "NULL_SPAN", "NULL_TRACE", "Trace", "FlightRecorder",
+    "start_trace", "configure", "set_metrics", "snapshot", "enabled",
+]
+
+
+class _NullSpan:
+    """Shared no-op context manager handed out whenever tracing is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _NullTrace:
+    """Shared do-nothing trace: every un-sampled batch carries this."""
+
+    __slots__ = ()
+    sampled = False
+
+    def stage(self, name: str):
+        return NULL_SPAN
+
+    def finish(self) -> None:
+        pass
+
+
+NULL_TRACE = _NullTrace()
+
+
+class _Span:
+    __slots__ = ("stage", "t0", "t1", "thread")
+
+    def __init__(self, stage: str, t0: float, t1: float, thread: str):
+        self.stage = stage
+        self.t0 = t0
+        self.t1 = t1
+        self.thread = thread
+
+
+class _SpanCtx:
+    """Context manager recording one stage span onto its trace (records on
+    exit even when the stage raised — a failed stage's duration is evidence,
+    not noise)."""
+
+    __slots__ = ("_trace", "_stage", "_t0")
+
+    def __init__(self, trace: "Trace", stage: str):
+        self._trace = trace
+        self._stage = stage
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._trace._add(self._stage, self._t0, time.perf_counter())
+        return False
+
+
+class Trace:
+    """One sampled unit of work. Spans may be appended from several threads
+    (evict on the map-tracer thread, fold on the exporter thread, publish on
+    the window timer), so appends take a per-trace lock — sampled traces are
+    rare by construction, the lock never sits on the un-sampled path."""
+
+    __slots__ = ("kind", "id", "unix_t0", "t0", "spans", "_lock", "_done")
+    sampled = True
+
+    def __init__(self, kind: str, trace_id: int):
+        self.kind = kind
+        self.id = trace_id
+        self.unix_t0 = time.time()
+        self.t0 = time.perf_counter()
+        self.spans: list[_Span] = []
+        self._lock = threading.Lock()
+        self._done = False
+
+    def stage(self, name: str) -> _SpanCtx:
+        return _SpanCtx(self, name)
+
+    def _add(self, stage: str, t0: float, t1: float) -> None:
+        with self._lock:
+            if not self._done:
+                self.spans.append(_Span(
+                    stage, t0, t1, threading.current_thread().name))
+
+    def finish(self) -> None:
+        """Seal the trace and hand it to the flight recorder (idempotent —
+        a batch trace that merged into an already-traced fold is finished
+        by whoever holds it last)."""
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+            spans = list(self.spans)
+        m = _metrics
+        if m is not None:
+            for s in spans:
+                m.observe_stage(s.stage, s.t1 - s.t0)
+        if spans:
+            _recorder.add(self)
+
+    def render(self) -> dict:
+        """JSON-ready view: spans sorted by start, durations and the
+        queue-wait gap to the previous stage in milliseconds."""
+        with self._lock:
+            spans = sorted(self.spans, key=lambda s: s.t0)
+        stages = []
+        prev_t1: Optional[float] = None
+        for s in spans:
+            stages.append({
+                "stage": s.stage,
+                "thread": s.thread,
+                "offset_ms": round((s.t0 - self.t0) * 1e3, 3),
+                "dur_ms": round((s.t1 - s.t0) * 1e3, 3),
+                # inter-stage gap = queue wait (negative means the spans
+                # overlapped across threads; reported raw, not clipped)
+                "gap_ms": (round((s.t0 - prev_t1) * 1e3, 3)
+                           if prev_t1 is not None else 0.0),
+            })
+            prev_t1 = s.t1
+        total = (spans[-1].t1 - spans[0].t0) if spans else 0.0
+        return {
+            "id": self.id,
+            "kind": self.kind,
+            "start_unix_ms": int(self.unix_t0 * 1e3),
+            "total_ms": round(total * 1e3, 3),
+            "stages": stages,
+        }
+
+
+class FlightRecorder:
+    """Fixed-size ring of completed traces."""
+
+    def __init__(self, capacity: int = 64):
+        self._dq: deque = deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+
+    def add(self, trace: Trace) -> None:
+        with self._lock:
+            self._dq.append(trace)
+
+    def snapshot(self) -> list[dict]:
+        """Newest-first JSON-ready dump (the /debug/traces body)."""
+        with self._lock:
+            traces = list(self._dq)
+        return [t.render() for t in reversed(traces)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._dq.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dq)
+
+
+# --- module state ----------------------------------------------------------
+
+_enabled = False
+# sample every _period-th start_trace() call PER KIND: a single shared
+# counter would alias with the pipeline's periodic call pattern (each
+# eviction issues one "batch" and one "fold" call, so at rate 0.5 one kind
+# would land on the sampled residue every time and the other never; the
+# once-per-window "window" call would pin to one residue at low rates).
+# Kept >= 1 at ALL times so a concurrent configure(0) can never expose a
+# modulo-by-zero to a hot-path thread that already saw _enabled=True.
+_period = 1
+# itertools.count: atomic under the GIL — start_trace is called from the
+# map-tracer, exporter, and timer threads concurrently, and a plain `+= 1`
+# would lose increments (skewing the deterministic period) and hand out
+# duplicate trace ids
+_counters: dict = {}
+_counters_lock = threading.Lock()
+_next_id = itertools.count(1)
+_metrics = None  # Metrics facade (set_metrics); observe_stage sink
+_recorder = FlightRecorder(int(os.environ.get("TRACE_RING", "64") or 64))
+
+recorder = _recorder  # public alias (server/debug.py, tests)
+
+
+def configure(sample: Optional[float] = None,
+              capacity: Optional[int] = None) -> None:
+    """(Re)configure sampling; ``None`` re-reads the TRACE_SAMPLE env var.
+    Rates in (0, 1] sample every round(1/rate)-th trace; 0 disables."""
+    global _enabled, _period, _counters, _recorder, recorder
+    if sample is None:
+        sample = float(os.environ.get("TRACE_SAMPLE", "0") or 0)
+    if not 0.0 <= sample <= 1.0:
+        raise ValueError(f"TRACE_SAMPLE={sample!r} must be in [0, 1]")
+    if capacity is not None:
+        _recorder = recorder = FlightRecorder(capacity)
+    _counters = {}
+    if sample <= 0.0:
+        _enabled = False  # _period stays >= 1 (hot-path race safety above)
+    else:
+        _period = max(1, round(1.0 / sample))
+        _enabled = True
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def start_trace(kind: str = "batch"):
+    """The hot-path entry: returns a live :class:`Trace` for sampled calls,
+    the shared :data:`NULL_TRACE` otherwise. Disabled = one bool check.
+    Sampling is deterministic PER KIND (see _period above)."""
+    if not _enabled:
+        return NULL_TRACE
+    c = _counters.get(kind)
+    if c is None:
+        with _counters_lock:
+            c = _counters.setdefault(kind, itertools.count(1))
+    if next(c) % _period:
+        return NULL_TRACE
+    return Trace(kind, next(_next_id))
+
+
+def set_metrics(metrics) -> None:
+    """Bind the Metrics facade whose ``observe_stage`` receives every span
+    of every finished trace (stage_seconds{stage=...})."""
+    global _metrics
+    _metrics = metrics
+
+
+def snapshot() -> list[dict]:
+    """Newest-first completed traces (the /debug/traces payload)."""
+    return _recorder.snapshot()
+
+
+# arm from the environment at import; unset -> disabled, start_trace stays
+# on the one-branch path
+if os.environ.get("TRACE_SAMPLE"):
+    configure()

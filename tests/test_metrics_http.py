@@ -1,0 +1,128 @@
+"""HTTP surface of the metrics server (metrics/server.py).
+
+The supervisor-driven side of /healthz + /readyz is pinned in
+tests/test_supervision.py through a live agent; this suite pins the SERVER
+contract in isolation: the full status matrix for both probes, 404 on
+unknown paths, a broken health_source still answering machine-readable 503
+JSON, and the exposition route serving the registry (including the new
+stage_seconds / sketch_retraces_total families).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+from netobserv_tpu.metrics.server import start_metrics_server
+
+
+def _get(srv, path):
+    port = srv.server_address[1]
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.headers.get("Content-Type", ""), \
+                resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.headers.get("Content-Type", ""), err.read()
+
+
+@pytest.fixture
+def server_factory():
+    servers = []
+
+    def make(health_source=None, metrics=None):
+        m = metrics or Metrics()
+        srv = start_metrics_server(m.registry, "127.0.0.1", 0,
+                                   health_source=health_source)
+        servers.append(srv)
+        return srv, m
+
+    yield make
+    for srv in servers:
+        srv.shutdown()
+
+
+# (status, degraded) -> (healthz code, readyz code)
+HEALTH_MATRIX = [
+    ("NotStarted", False, 200, 503),
+    ("Starting", False, 200, 503),
+    ("Started", False, 200, 200),
+    ("Started", True, 200, 503),   # degraded: live but out of rotation
+    ("Degraded", True, 200, 503),
+    ("Stopping", False, 200, 503),  # graceful shutdown must not be killed
+    ("Stopped", False, 503, 503),
+    ("Unknown", False, 503, 503),
+]
+
+
+@pytest.mark.parametrize("status,degraded,healthz,readyz", HEALTH_MATRIX)
+def test_health_status_matrix(server_factory, status, degraded,
+                              healthz, readyz):
+    srv, _ = server_factory(
+        health_source=lambda: {"status": status, "degraded": degraded,
+                               "stages": {}})
+    code, ctype, body = _get(srv, "/healthz")
+    assert code == healthz
+    assert ctype.startswith("application/json")
+    assert json.loads(body)["status"] == status
+    code, ctype, body = _get(srv, "/readyz")
+    assert code == readyz
+    assert json.loads(body)["degraded"] is degraded
+
+
+def test_unknown_path_404s(server_factory):
+    srv, _ = server_factory()
+    code, _ctype, _body = _get(srv, "/nope")
+    assert code == 404
+    code, _ctype, _body = _get(srv, "/metricz")
+    assert code == 404
+
+
+def test_health_routes_404_without_source(server_factory):
+    srv, _ = server_factory(health_source=None)
+    assert _get(srv, "/healthz")[0] == 404
+    assert _get(srv, "/readyz")[0] == 404
+
+
+def test_broken_health_source_still_answers_503_json(server_factory):
+    def broken():
+        raise RuntimeError("probe exploded")
+
+    srv, _ = server_factory(health_source=broken)
+    for path in ("/healthz", "/readyz"):
+        code, ctype, body = _get(srv, path)
+        assert code == 503
+        assert ctype.startswith("application/json")
+        obj = json.loads(body)
+        assert obj["status"] == "Unknown" and obj["degraded"] is True
+        assert "probe exploded" in obj["error"]
+
+
+def test_metrics_route_serves_registry(server_factory):
+    srv, m = server_factory()
+    m.observe_stage("fold", 0.01)
+    m.count_retrace("ingest")
+    code, ctype, body = _get(srv, "/metrics")
+    assert code == 200
+    text = body.decode()
+    assert 'ebpf_agent_stage_seconds_count{stage="fold"} 1.0' in text
+    assert 'ebpf_agent_sketch_retraces_total{fn="ingest"} 1.0' in text
+
+
+def test_metrics_settings_not_shared_between_instances():
+    """Regression: the old `settings: MetricsSettings = MetricsSettings()`
+    dataclass-default meant every no-arg Metrics() shared ONE settings
+    object — mutating one facade's trace TTL retimed every other's
+    janitor."""
+    a, b = Metrics(), Metrics()
+    assert a.settings is not b.settings
+    a.settings.trace_ttl_s = 1.0
+    assert b.settings.trace_ttl_s == 300.0
+    # explicit settings still pass through untouched
+    s = MetricsSettings(prefix="x_", level="debug")
+    assert Metrics(s).settings is s

@@ -1,0 +1,64 @@
+"""Drift guard: docs/observability.md must catalog every registry metric
+and the tracing/watchdog env knobs (the ISSUE-3 doc contract). Registering
+a metric without documenting what it means — and what to do when it moves —
+fails here."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from netobserv_tpu.metrics.registry import Metrics
+
+DOC = os.path.join(os.path.dirname(__file__), "..", "docs",
+                   "observability.md")
+
+
+@pytest.fixture(scope="module")
+def doc_text() -> str:
+    with open(DOC) as fh:
+        return fh.read()
+
+
+def registry_metric_names() -> list[str]:
+    """Exposition names of every family a default Metrics() registers
+    (counters re-gain their _total suffix; prometheus_client strips it
+    on collect)."""
+    m = Metrics()
+    names = []
+    for family in m.registry.collect():
+        name = family.name
+        if family.type == "counter":
+            name += "_total"
+        names.append(name)
+    assert len(names) > 20, "registry walk looks broken"
+    return names
+
+
+def test_every_registry_metric_is_documented(doc_text):
+    missing = [n for n in registry_metric_names()
+               if f"`{n}`" not in doc_text]
+    assert not missing, (
+        f"metrics registered but missing from docs/observability.md: "
+        f"{missing} — add a row (name, labels, meaning, what to do when "
+        f"it moves)")
+
+
+def test_tracing_and_watchdog_envs_are_documented(doc_text):
+    for env in ("TRACE_SAMPLE", "TRACE_RING", "RETRACE_WATCHDOG",
+                "RETRACE_WARMUP_CALLS"):
+        assert f"`{env}`" in doc_text, f"{env} undocumented"
+
+
+def test_documented_metrics_exist(doc_text):
+    """The inverse drift: a doc row whose metric was renamed/removed is as
+    misleading as a missing row."""
+    import re
+
+    documented = set(re.findall(r"`(ebpf_agent_[a-z0-9_]+)`", doc_text))
+    live = set(registry_metric_names())
+    stale = sorted(documented - live)
+    assert not stale, (
+        f"docs/observability.md documents metrics the registry no longer "
+        f"has: {stale}")

@@ -1,0 +1,411 @@
+"""Flight recorder + retrace watchdog (utils/tracing.py, utils/retrace.py).
+
+Pins the tentpole contracts:
+
+- tracing disabled (TRACE_SAMPLE unset) is a TRUE no-op: start_trace returns
+  the one shared null trace, whose stage() returns the one shared null span
+  — no per-call allocations, no timestamps, no recorder traffic;
+- sampled traces capture per-stage durations and inter-stage queue-wait
+  gaps, newest-first in the fixed-size ring;
+- the batch journey (evict -> queue -> fold -> pack -> ingest dispatch) and
+  the window journey (roll drain -> roll dispatch -> render -> sink) both
+  land in the recorder end to end through the real exporter;
+- /debug/traces and /debug/jax answer on the debug server and the index
+  describes every route;
+- the retrace watchdog: a post-warmup recompile of a watched jitted entry
+  point increments sketch_retraces_total{fn=...}; the warmup window
+  suppresses the expected first compile.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+from prometheus_client import generate_latest
+
+from netobserv_tpu.metrics.registry import Metrics
+from netobserv_tpu.utils import retrace, tracing
+
+
+@pytest.fixture(autouse=True)
+def _reset_tracing():
+    yield
+    tracing.configure(sample=0.0)
+    tracing.recorder.clear()
+    tracing.set_metrics(None)
+    retrace.set_metrics(None)
+
+
+SMALL_CFG_KW = dict(cm_width=1 << 12, topk=256, hll_precision=8,
+                    perdst_buckets=256, perdst_precision=4,
+                    persrc_buckets=256, persrc_precision=4,
+                    hist_buckets=256, ewma_buckets=256)
+
+
+# --- null-path contract ----------------------------------------------------
+
+def test_disabled_is_shared_null_objects():
+    tracing.configure(sample=0.0)
+    t1 = tracing.start_trace("batch")
+    t2 = tracing.start_trace("window")
+    assert t1 is tracing.NULL_TRACE and t2 is tracing.NULL_TRACE
+    assert not t1.sampled
+    # stage() hands out the one shared null context manager: no per-call
+    # allocation, no timestamps
+    s1 = t1.stage("evict")
+    s2 = t1.stage("fold")
+    assert s1 is tracing.NULL_SPAN and s2 is tracing.NULL_SPAN
+    with s1:
+        pass
+    t1.finish()
+    assert len(tracing.recorder) == 0
+    assert not tracing.enabled()
+
+
+def test_null_trace_survives_every_pipeline_verb():
+    """The null object must accept the full Trace surface (the pipeline
+    never branches on sampled-ness except to attach to EvictedFlows)."""
+    t = tracing.NULL_TRACE
+    with t.stage("anything"):
+        with t.stage("nested"):
+            pass
+    t.finish()
+    t.finish()  # idempotent
+
+
+# --- sampled traces --------------------------------------------------------
+
+def test_sampled_trace_records_stages_gaps_and_order():
+    tracing.configure(sample=1.0, capacity=8)
+    t = tracing.start_trace("batch")
+    assert t.sampled
+    with t.stage("evict"):
+        pass
+    with t.stage("fold"):
+        pass
+    t.finish()
+    snap = tracing.snapshot()
+    assert len(snap) == 1
+    got = snap[0]
+    assert got["kind"] == "batch"
+    names = [s["stage"] for s in got["stages"]]
+    assert names == ["evict", "fold"]
+    for s in got["stages"]:
+        assert s["dur_ms"] >= 0.0
+    # the second stage's gap is the wait between evict end and fold start
+    assert got["stages"][0]["gap_ms"] == 0.0
+    assert got["stages"][1]["gap_ms"] >= 0.0
+    assert got["total_ms"] >= 0.0
+
+
+def test_recorder_is_bounded_and_newest_first():
+    tracing.configure(sample=1.0, capacity=4)
+    for i in range(10):
+        t = tracing.start_trace("batch")
+        with t.stage("evict"):
+            pass
+        t.finish()
+    snap = tracing.snapshot()
+    assert len(snap) == 4
+    ids = [s["id"] for s in snap]
+    assert ids == sorted(ids, reverse=True)  # newest first
+
+
+def test_sampling_period_is_deterministic():
+    tracing.configure(sample=0.5, capacity=16)
+    sampled = [tracing.start_trace().sampled for _ in range(8)]
+    assert sampled == [False, True] * 4
+
+
+def test_sampling_counters_are_per_kind():
+    """The pipeline issues interleaved kinds in a fixed pattern (one batch
+    + one fold per eviction, one window per roll); a SHARED counter would
+    alias that pattern and starve a kind forever. Each kind must sample on
+    its own period."""
+    tracing.configure(sample=0.5, capacity=16)
+    seen = {"batch": [], "window": []}
+    for _ in range(4):  # strict alternation — the aliasing-prone pattern
+        seen["batch"].append(tracing.start_trace("batch").sampled)
+        seen["window"].append(tracing.start_trace("window").sampled)
+    assert seen["batch"] == [False, True, False, True]
+    assert seen["window"] == [False, True, False, True]
+
+
+def test_finish_without_spans_records_nothing():
+    tracing.configure(sample=1.0, capacity=4)
+    t = tracing.start_trace("batch")
+    t.finish()
+    assert len(tracing.recorder) == 0
+
+
+def test_spans_feed_stage_seconds_histogram():
+    tracing.configure(sample=1.0, capacity=4)
+    m = Metrics()
+    tracing.set_metrics(m)
+    t = tracing.start_trace("batch")
+    with t.stage("fold"):
+        pass
+    t.finish()
+    text = generate_latest(m.registry).decode()
+    assert 'ebpf_agent_stage_seconds_count{stage="fold"} 1.0' in text
+
+
+# --- end-to-end through the real exporter ---------------------------------
+
+def _small_exporter(sink, window_s=60.0, batch_size=512):
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    return TpuSketchExporter(batch_size=batch_size, window_s=window_s,
+                             sketch_cfg=SketchConfig(**SMALL_CFG_KW),
+                             sink=sink)
+
+
+def test_batch_and_window_traces_end_to_end():
+    from netobserv_tpu.datapath.replay import SyntheticFetcher
+
+    tracing.configure(sample=1.0, capacity=32)
+    reports: list = []
+    exp = _small_exporter(reports.append)
+    try:
+        fetcher = SyntheticFetcher(flows_per_eviction=512, n_distinct=200)
+        for _ in range(3):
+            ev = fetcher.lookup_and_delete()
+            # what MapTracer does on the columnar path
+            trace = tracing.start_trace("batch")
+            with trace.stage("evict"):
+                pass
+            ev.trace = trace
+            exp.export_evicted(ev)
+        exp.flush()
+    finally:
+        exp.close()
+    assert reports, "flush must publish a window report"
+    snap = tracing.snapshot()
+    kinds = {s["kind"] for s in snap}
+    assert "batch" in kinds and "window" in kinds
+    batch = next(s for s in snap if s["kind"] == "batch")
+    names = [st["stage"] for st in batch["stages"]]
+    assert names[0] == "evict"
+    assert "fold" in names
+    assert "resident_pack" in names or "pack" in names
+    assert "ingest_dispatch" in names
+    # the evict->fold gap is the export queue wait
+    fold = next(st for st in batch["stages"] if st["stage"] == "fold")
+    assert "gap_ms" in fold
+    window = next(s for s in snap if s["kind"] == "window")
+    wnames = [st["stage"] for st in window["stages"]]
+    for expect in ("roll_drain", "roll_dispatch", "report_render",
+                   "report_sink"):
+        assert expect in wnames, (expect, wnames)
+
+
+def test_map_tracer_attaches_trace_on_columnar_path():
+    import queue
+
+    from netobserv_tpu.datapath.fetcher import FakeFetcher
+    from netobserv_tpu.flow import MapTracer
+
+    from tests.test_pipeline import make_events
+
+    tracing.configure(sample=1.0, capacity=8)
+    fake = FakeFetcher()
+    fake.inject_events(make_events(3))
+    out: queue.Queue = queue.Queue()
+    mt = MapTracer(fake, out, columnar=True)
+    mt._evict_once()
+    evicted = out.get_nowait()
+    assert evicted.trace is not None and evicted.trace.sampled
+    stages = [s.stage for s in evicted.trace.spans]
+    assert stages == ["evict"]
+
+    # disabled: no attribute rides the eviction at all
+    tracing.configure(sample=0.0)
+    fake.inject_events(make_events(2))
+    mt._evict_once()
+    evicted = out.get_nowait()
+    assert getattr(evicted, "trace", None) is None
+
+
+def test_exporter_disabled_tracing_records_nothing():
+    from netobserv_tpu.datapath.replay import SyntheticFetcher
+
+    tracing.configure(sample=0.0)
+    exp = _small_exporter(lambda obj: None)
+    try:
+        fetcher = SyntheticFetcher(flows_per_eviction=512, n_distinct=100)
+        exp.export_evicted(fetcher.lookup_and_delete())
+        exp.flush()
+    finally:
+        exp.close()
+    assert len(tracing.recorder) == 0
+
+
+# --- debug server routes ---------------------------------------------------
+
+def _get(srv, path):
+    port = srv.server_address[1]
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+
+
+def test_debug_traces_and_jax_routes():
+    from netobserv_tpu.server import start_debug_server
+
+    tracing.configure(sample=1.0, capacity=8)
+    t = tracing.start_trace("batch")
+    with t.stage("evict"):
+        pass
+    t.finish()
+    srv = start_debug_server("127.0.0.1:0")
+    try:
+        status, ctype, body = _get(srv, "/debug/traces")
+        assert status == 200 and ctype.startswith("application/json")
+        obj = json.loads(body)
+        assert obj["sampling_enabled"] is True
+        assert obj["traces"][0]["stages"][0]["stage"] == "evict"
+
+        status, ctype, body = _get(srv, "/debug/jax")
+        assert status == 200 and ctype.startswith("application/json")
+        obj = json.loads(body)
+        assert obj["backend"] == "cpu"
+        assert obj["device_count"] >= 1
+        assert isinstance(obj["live_arrays"], int)
+        assert "compilation_cache" in obj
+        assert isinstance(obj["retrace_watchdog"], list)
+
+        # the index lists every route with a one-line description
+        status, _ctype, body = _get(srv, "/debug")
+        text = body.decode()
+        for route in ("/debug/threads", "/debug/tracemalloc", "/debug/gc",
+                      "/debug/traces", "/debug/jax"):
+            assert route in text
+            line = next(ln for ln in text.splitlines()
+                        if ln.startswith(route))
+            assert len(line.split(None, 1)[1]) > 10, f"{route} undescribed"
+
+        # unknown path still 404s
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv, "/debug/nope")
+        assert err.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+# --- retrace watchdog ------------------------------------------------------
+
+def test_retrace_watchdog_counts_post_warmup_recompiles():
+    import jax
+    import jax.numpy as jnp
+
+    m = Metrics()
+    retrace.set_metrics(m)
+    fn = retrace.watch(jax.jit(lambda x: x * 2 + 1), "test_entry",
+                       warmup_calls=1)
+    # warmup: the first call's compile is expected — no alarm
+    fn(jnp.ones(8))
+    assert fn.compiles == 1 and fn.retraces == 0
+    # steady state at the same shape: silence
+    for _ in range(3):
+        fn(jnp.ones(8))
+    assert fn.compiles == 1 and fn.retraces == 0
+    # changed shape after warmup: the invariant is broken -> alarm
+    fn(jnp.ones(16))
+    assert fn.compiles == 2 and fn.retraces == 1
+    assert "[16]" in fn.last_retrace
+    text = generate_latest(m.registry).decode()
+    assert ('ebpf_agent_sketch_retraces_total{fn="test_entry"} 1.0'
+            in text)
+
+
+def test_retrace_warmup_window_suppresses_false_positives():
+    import jax
+    import jax.numpy as jnp
+
+    m = Metrics()
+    retrace.set_metrics(m)
+    # a 2-call warmup tolerates two distinct warmup shapes (e.g. an entry
+    # point warmed on both its steady and its flush shape)
+    fn = retrace.watch(jax.jit(lambda x: x + 1), "warmup_entry",
+                       warmup_calls=2)
+    fn(jnp.ones(4))
+    fn(jnp.ones(8))
+    assert fn.compiles == 2 and fn.retraces == 0
+    text = generate_latest(m.registry).decode()
+    assert 'fn="warmup_entry"' not in text
+
+
+def test_retrace_watchdog_on_real_ingest_changed_batch_shape():
+    """The CI-speed force-retrace: a jitted dense ingest fed a CHANGED batch
+    shape after warmup must fire sketch_retraces_total."""
+    import jax
+
+    from netobserv_tpu.sketch import state as sk
+
+    m = Metrics()
+    retrace.set_metrics(m)
+    cfg = sk.SketchConfig(**SMALL_CFG_KW)
+    state = sk.init_state(cfg)
+    ingest = retrace.watch(
+        sk.make_ingest_dense_fn(donate=False), "ingest_dense_test")
+    rng = np.random.default_rng(3)
+
+    def dense(n):
+        # build via arrays_to_dense: keys + counters only
+        arrays = {
+            "keys": rng.integers(0, 2**32, (n, 10), dtype=np.uint32),
+            "bytes": rng.integers(1, 1500, n).astype(np.float32),
+            "packets": np.ones(n, np.int32),
+            "rtt_us": np.zeros(n, np.int32),
+            "dns_latency_us": np.zeros(n, np.int32),
+            "sampling": np.zeros(n, np.int32),
+            "valid": np.ones(n, np.bool_),
+        }
+        return sk.arrays_to_dense(arrays).reshape(-1)
+
+    state = ingest(state, jax.device_put(dense(64)))
+    jax.block_until_ready(state)
+    assert ingest.retraces == 0
+    # same shape again: still silent
+    state = ingest(state, jax.device_put(dense(64)))
+    assert ingest.retraces == 0
+    # the forbidden event: a different batch shape post-warmup
+    state = ingest(state, jax.device_put(dense(128)))
+    jax.block_until_ready(state)
+    assert ingest.retraces == 1
+    text = generate_latest(m.registry).decode()
+    assert 'fn="ingest_dense_test"' in text
+
+
+def test_watch_delegates_jit_introspection():
+    import jax
+    import jax.numpy as jnp
+
+    fn = retrace.watch(jax.jit(lambda x: x + 1), "lower_entry")
+    lowered = fn.lower(jnp.ones(4))  # AOT path through the wrapper
+    assert "add" in lowered.as_text()
+    # double-watch returns the same wrapper
+    assert retrace.watch(fn, "again") is fn
+
+
+def test_exporter_full_cycle_stays_retrace_silent():
+    """Acceptance pin: a full exporter cycle (folds incl. a padded partial
+    batch + window roll + publish) performs ZERO post-warmup retraces."""
+    from netobserv_tpu.datapath.replay import SyntheticFetcher
+
+    before = retrace.total_retraces()
+    exp = _small_exporter(lambda obj: None)
+    try:
+        fetcher = SyntheticFetcher(flows_per_eviction=300, n_distinct=100)
+        for _ in range(6):  # 300-row evictions roll over the 512 batch
+            exp.export_evicted(fetcher.lookup_and_delete())
+        exp.flush()
+        exp.flush()  # second window: roll is past ITS warmup call too
+    finally:
+        exp.close()
+    assert retrace.total_retraces() == before
