@@ -33,6 +33,12 @@ bench-host:
 bench-host-traced:
 	TRACE_SAMPLE=0.01 JAX_PLATFORMS=cpu $(PY) bench.py --host-only
 
+# per-stage device breakdown (~60s): ingest ablations (signals/asym/fanout
+# on/off), pallas-vs-scatter A/B (TPU), superbatch ladder 1x/2x/4x — the
+# per-PR CI artifact tracking the fusion win (docs/tpu_sketch.md)
+bench-device:
+	JAX_PLATFORMS=cpu $(PY) bench.py --device-only
+
 gen-protobuf:
 	protoc --python_out=netobserv_tpu/pb -I proto proto/flow.proto proto/packet.proto
 

@@ -257,12 +257,11 @@ def host_path_stats(seconds: float = 8.0,
     bytes/record + link rate (the byte-budget evidence)."""
     import jax
 
+    from netobserv_tpu.config import AgentConfig
     from netobserv_tpu.datapath import flowpack
     from netobserv_tpu.datapath.replay import SyntheticFetcher
     from netobserv_tpu.sketch import staging, state as sk
-    from netobserv_tpu.sketch.staging import (
-        ResidentStagingRing, ShardedResidentStagingRing,
-    )
+    from netobserv_tpu.sketch.staging import ShardedResidentStagingRing
 
     flowpack.build_native()
     if pack_threads is None:
@@ -277,21 +276,19 @@ def host_path_stats(seconds: float = 8.0,
     ring_threads = pack_threads if (explicit or (os.cpu_count() or 1) >= 4) \
         else 1
     lanes = staging.pick_lanes(BATCH, ring_threads)
-    if lanes > 1:
-        caps = flowpack.default_resident_caps(BATCH // lanes)
-        ring = ShardedResidentStagingRing(
-            BATCH, 1,
-            sk.make_ingest_resident_lanes_fn(BATCH // lanes, caps, lanes,
-                                             donate=True),
-            key_tables=jax.device_put(sk.init_key_tables(lanes, 1 << 18)),
-            put=jax.device_put, caps=caps, slot_cap=1 << 18,
-            pack_threads=pack_threads, lanes=lanes)
-    else:
-        caps = flowpack.default_resident_caps(BATCH)
-        ring = ResidentStagingRing(
-            BATCH, sk.make_ingest_resident_fn(BATCH, caps, donate=True,
-                                              with_token=True),
-            caps=caps)
+    # the superbatch fold ladder the production exporter ships
+    # (SKETCH_SUPERBATCH): sustained load coalesces queued evictions into
+    # superbatch_max-batch folds, so that is what the segments measure
+    ladder = AgentConfig().parsed_superbatch_ladder()
+    kmax = max(ladder)
+    caps = flowpack.default_resident_caps(BATCH // lanes)
+    ingests = {k: sk.make_ingest_resident_lanes_fn(
+        BATCH // lanes, caps, k * lanes, donate=True) for k in ladder}
+    ring = ShardedResidentStagingRing(
+        BATCH, 1, ingests,
+        key_tables=jax.device_put(sk.init_key_tables(kmax * lanes, 1 << 18)),
+        put=jax.device_put, caps=caps, slot_cap=1 << 18,
+        pack_threads=pack_threads, lanes=lanes, ladder=ladder)
     fetcher = SyntheticFetcher(flows_per_eviction=BATCH, n_distinct=N_DISTINCT)
     # pre-generate evictions and concatenate into FULL batches, the way the
     # exporter accumulates them (padding only at window close); the load
@@ -319,14 +316,29 @@ def host_path_stats(seconds: float = 8.0,
         dr["bytes"] = np.where(hit, 1400, 0)
         dr["packets"] = hit
         feats.append({"extra": ex, "dns": dn, "drops": dr})
-    # warm: compile AND let the key dictionary learn the working set (the
+    # superbatch folds: the production exporter coalesces queued evictions
+    # into superbatch_max-batch folds under sustained load, so the segments
+    # fold kmax*BATCH rows per dispatch (the largest ladder shape). An
+    # oversized configured ladder degrades to the largest entry the
+    # generated pool can actually feed (several folds per segment) instead
+    # of dividing by an empty superfold list
+    kmax = max((k for k in ladder if k * BATCH < len(raw)), default=1)
+    sb_rows = kmax * BATCH
+    supers = [np.ascontiguousarray(raw[i:i + sb_rows])
+              for i in range(0, len(raw) - sb_rows, sb_rows)]
+    sfeats = [{name: np.concatenate(
+        [feats[(si * kmax + j) % len(feats)][name] for j in range(kmax)])
+        for name in ("extra", "dns", "drops")} for si in range(len(supers))]
+    # warm: compile AND let the key dictionaries learn the working set (the
     # steady state is what the segments measure; cold-start continuation
     # chunks are covered by tests, not timed here)
-    for bi in range(len(full)):
-        state = ring.fold(state, full[bi], **feats[bi])
+    for si in range(len(supers)):
+        state = ring.fold(state, supers[si], **sfeats[si])
     jax.block_until_ready(state)
     ring.drain()
-    buf_bytes = lanes * flowpack.resident_buf_len(BATCH // lanes, caps) * 4
+    # one shipped chunk per superfold: kmax*lanes regions
+    buf_bytes = kmax * lanes * flowpack.resident_buf_len(
+        BATCH // lanes, caps) * 4
 
     seg_rates = []
     seg_bytes = []
@@ -336,19 +348,21 @@ def host_path_stats(seconds: float = 8.0,
     while time.perf_counter() < t_end:
         n = 0
         chunk0 = ring.continuations
+        nfolds = 0
         t0 = time.perf_counter()
         while time.perf_counter() - t0 < 1.0:
             f0 = time.perf_counter()
-            state = ring.fold(state, full[i % len(full)],
-                              **feats[i % len(full)])
+            state = ring.fold(state, supers[i % len(supers)],
+                              **sfeats[i % len(supers)])
             fold_s.append(time.perf_counter() - f0)
-            n += BATCH
+            n += sb_rows
+            nfolds += 1
             i += 1
         jax.block_until_ready(state)
         dt = time.perf_counter() - t0
         seg_rates.append(n / dt)
         # chunks shipped = one per fold + any continuation chunks
-        chunks = n // BATCH + (ring.continuations - chunk0)
+        chunks = nfolds + (ring.continuations - chunk0)
         seg_bytes.append(chunks * buf_bytes / dt)
     print(f"host-path segments: {[round(r / 1e6, 2) for r in seg_rates]} "
           "M rec/s", file=sys.stderr)
@@ -356,9 +370,9 @@ def host_path_stats(seconds: float = 8.0,
     # stage split: lane-sharded pack alone (own dicts, warm), put alone.
     # The scaling ladder {1, 2, 4, engaged} is the SKETCH_PACK_THREADS
     # evidence: pack rate should scale with threads until cores run out.
-    ladder = sorted({1, 2, 4, pack_threads})
+    pthreads = sorted({1, 2, 4, pack_threads})
     pack_scaling = {str(t): round(lane_pack_rate(full, feats, t))
-                    for t in ladder}
+                    for t in pthreads}
     pack_rate = pack_scaling[str(pack_threads)]
     buf = np.empty(lanes * flowpack.resident_buf_len(BATCH // lanes, caps),
                    np.uint32)
@@ -373,13 +387,19 @@ def host_path_stats(seconds: float = 8.0,
         n += 1
     put_rate = n * BATCH / (time.perf_counter() - t0)
 
-    bpr = buf_bytes / BATCH
+    bpr = buf_bytes / sb_rows
     return {
         "host_path_burst": round(max(seg_rates)),
         "host_path_sustained": round(float(np.median(seg_rates))),
         "host_path_p10": round(float(np.percentile(seg_rates, 10))),
         "host_path_p90": round(float(np.percentile(seg_rates, 90))),
         "host_segments": [round(r) for r in seg_rates],
+        # self-describing fold shape: every measured fold dispatches this
+        # many coalesced batches as one superbatch (SKETCH_SUPERBATCH)
+        "host_superbatch_ladder": list(ladder),
+        "host_fold_batches": kmax,
+        "host_superbatch_folds": {str(k): v for k, v in
+                                  sorted(ring.superbatch_folds.items())},
         "host_fold_ms_p50": round(
             float(np.percentile(fold_s, 50)) * 1e3, 3),
         "host_fold_ms_p99": round(
@@ -404,6 +424,127 @@ def host_path_stats(seconds: float = 8.0,
                          "dense_fallbacks": getattr(ring, "dense_fallbacks",
                                                     0)},
     }
+
+
+def device_stage_stats() -> dict:
+    """Per-stage DEVICE breakdown (`--device-only` / `make bench-device`):
+    ingest ablations (feature-lane signals on/off, asym on/off, fanout
+    on/off), the pallas-vs-scatter A/B (TPU only — interpret mode off-TPU
+    is a Python loop, meaningless for comparison), and the superbatch
+    ladder 1x/2x/4x fold rates — so the fused-signal-kernel win and the
+    coalescing crossover are tracked release-over-release (CI uploads the
+    JSON as the non-gating `bench-device` artifact next to `bench-host`)."""
+    import jax
+
+    from netobserv_tpu.config import AgentConfig
+    from netobserv_tpu.datapath import flowpack
+    from netobserv_tpu.datapath.replay import SyntheticFetcher
+    from netobserv_tpu.sketch import staging, state as sk
+
+    rng = np.random.default_rng(2026)
+    _universe, pool = make_pool(rng)
+    dev_batches = [
+        {k: jax.device_put(v) for k, v in arrays.items()} for arrays, _ in pool]
+    base_keys = ("keys", "bytes", "packets", "rtt_us", "dns_latency_us",
+                 "sampling", "valid")
+    dev_base = [{k: b[k] for k in base_keys} for b in dev_batches]
+    cfg = sk.SketchConfig()
+
+    def rate(fn, batches, segs: int = 4, iters: int = SEGMENT_ITERS) -> int:
+        state = sk.init_state(cfg)
+        it = 0
+        for _ in range(WARMUP_ITERS):
+            state = fn(state, batches[it % len(batches)])
+            it += 1
+        jax.block_until_ready(state)
+        rates = []
+        for _ in range(segs):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state = fn(state, batches[it % len(batches)])
+                it += 1
+            jax.block_until_ready(state)
+            rates.append(iters * BATCH / (time.perf_counter() - t0))
+        return round(float(np.median(rates)))
+
+    on_tpu = jax.default_backend() == "tpu"
+    out: dict = {"metric": "device_stage_breakdown", "unit": "records/s",
+                 "device_backend": jax.default_backend(), "batch": BATCH}
+    out["device_ingest_all_on"] = rate(
+        sk.make_ingest_fn(donate=True), dev_batches)
+    # feature-lane signals off = the columns simply absent (the production
+    # trace-time gate); attributes the fused signal plane's total cost
+    out["device_ingest_no_feature_signals"] = rate(
+        sk.make_ingest_fn(donate=True), dev_base)
+    out["device_ingest_no_asym"] = rate(
+        sk.make_ingest_fn(donate=True, enable_asym=False), dev_batches)
+    out["device_ingest_no_fanout"] = rate(
+        sk.make_ingest_fn(donate=True, enable_fanout=False), dev_batches)
+    if on_tpu:
+        out["device_ingest_pallas"] = rate(
+            sk.make_ingest_fn(donate=True, use_pallas=True), dev_batches)
+        out["device_ingest_scatter"] = rate(
+            sk.make_ingest_fn(donate=True, use_pallas=False), dev_batches)
+    else:
+        out["device_pallas_note"] = (
+            "pallas arm skipped off-TPU (interpret mode is a Python loop); "
+            "ablations above run the scatter path")
+
+    # superbatch ladder: fold rate at each k (k*BATCH rows per dispatch —
+    # the ring picks exactly the k entry), events-only resident feed
+    flowpack.build_native()
+    ladder = AgentConfig().parsed_superbatch_ladder()
+    caps = flowpack.default_resident_caps(BATCH)
+    ingests = {k: sk.make_ingest_resident_lanes_fn(BATCH, caps, k,
+                                                   donate=True)
+               for k in ladder}
+    ring = staging.ShardedResidentStagingRing(
+        BATCH, 1, ingests,
+        key_tables=jax.device_put(
+            sk.init_key_tables(max(ladder), 1 << 18)),
+        put=jax.device_put, caps=caps, slot_cap=1 << 18, lanes=1,
+        ladder=ladder)
+    fetcher = SyntheticFetcher(flows_per_eviction=BATCH,
+                               n_distinct=N_DISTINCT)
+    raw = np.concatenate(
+        [fetcher.lookup_and_delete().events for _ in range(40)])
+    state = sk.init_state(cfg)
+    by_k = {k: [np.ascontiguousarray(raw[o:o + k * BATCH])
+                for o in range(0, len(raw) - k * BATCH, k * BATCH)]
+            for k in ladder}
+    # an oversized ladder entry the 40-eviction pool cannot feed is
+    # skipped (noted), not divided by an empty fold list
+    skipped = [k for k, folds in by_k.items() if not folds]
+    by_k = {k: folds for k, folds in by_k.items() if folds}
+    if skipped:
+        out["device_superbatch_skipped"] = skipped
+    for k in by_k:  # warm every shape's compile + dictionaries first
+        for f in by_k[k]:
+            state = ring.fold(state, f)
+    ring.drain()
+    # ALTERNATE the ladder sizes across rounds (this environment drifts
+    # over a run; a sequential per-k block would charge the drift to
+    # whichever k ran last) and keep each k's best round
+    sb_rates: dict = {}
+    for _ in range(2):
+        for k in by_k:
+            rows = k * BATCH
+            folds = by_k[k]
+            n = 0
+            i = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 1.0:
+                state = ring.fold(state, folds[i % len(folds)])
+                n += rows
+                i += 1
+            jax.block_until_ready(state)
+            ring.drain()
+            rate = round(n / (time.perf_counter() - t0))
+            sb_rates[str(k)] = max(sb_rates.get(str(k), 0), rate)
+    out["device_superbatch_ladder"] = sb_rates
+    out["device_superbatch_folds"] = {
+        str(k): v for k, v in sorted(ring.superbatch_folds.items())}
+    return out
 
 
 def roll_stall_stats(run_s: float = 3.2, sink_block_s: float = 0.5) -> dict:
@@ -529,6 +670,15 @@ def main():
     if not maybe_force_cpu():
         global _DEVICE_NOTE
         _DEVICE_NOTE = _device_watchdog()
+    if "--device-only" in sys.argv:
+        # `make bench-device`: per-stage device breakdown only (ingest
+        # ablations, pallas A/B on TPU, superbatch ladder) — the non-gating
+        # CI artifact tracking the fusion win release-over-release
+        out = device_stage_stats()
+        if _DEVICE_NOTE:
+            out["device"] = _DEVICE_NOTE
+        print(json.dumps(out))
+        return
     if "--host-only" in sys.argv:
         # `make bench-host` (~15s): host path + roll stall only, no device
         # ingest loop or CPU oracle — the per-PR CI artifact

@@ -354,12 +354,42 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     # where window reports go: "stdout" (JSON lines) or "kafka" (uses the
     # KAFKA_* settings; one message per report, key = "sketch_report")
     sketch_report_sink: str = field(default="stdout", **_env("SKETCH_REPORT_SINK", "stdout"))
+    #: superbatch fold ladder: comma-separated batch multiples (must
+    #: include 1). Queued evictions coalesce into the largest fitting
+    #: ladder shape and fold as ONE device dispatch; "1" disables
+    #: coalescing (docs/tpu_sketch.md "superbatch fold coalescing")
+    sketch_superbatch: str = field(default="1,2,4",
+                                   **_env("SKETCH_SUPERBATCH", "1,2,4"))
 
     def resolved_pack_threads(self) -> int:
         """SKETCH_PACK_THREADS with 0 = auto (cpu count, capped at 8)."""
         if self.sketch_pack_threads > 0:
             return self.sketch_pack_threads
         return min(os.cpu_count() or 1, 8)
+
+    def parsed_superbatch_ladder(self) -> tuple:
+        """SKETCH_SUPERBATCH as a sorted, deduplicated int tuple — the ONE
+        parse of the ladder spec (exporter and bench both use it)."""
+        try:
+            ladder = tuple(sorted({int(tok) for tok in
+                                   self.sketch_superbatch.split(",") if tok}))
+        except ValueError as exc:
+            raise ValueError(
+                f"SKETCH_SUPERBATCH={self.sketch_superbatch!r}: "
+                "want comma-separated ints, e.g. 1,2,4") from exc
+        if not ladder or ladder[0] != 1 or any(k < 1 for k in ladder):
+            raise ValueError(
+                f"SKETCH_SUPERBATCH={self.sketch_superbatch!r}: the ladder "
+                "must include 1 and be positive")
+        if ladder[-1] > 64:
+            # fail fast on a typo: every entry costs a jitted executable,
+            # ring buffers and key-table rows sized k*batch — a stray
+            # '400' would OOM at startup instead of erroring here
+            raise ValueError(
+                f"SKETCH_SUPERBATCH={self.sketch_superbatch!r}: ladder "
+                "entries above 64 are almost certainly a typo (each costs "
+                "k*batch-sized buffers and key-table rows)")
+        return ladder
 
     def parsed_filter_rules(self) -> list[FlowFilterRule]:
         return parse_filter_rules(self.flow_filter_rules)
@@ -398,6 +428,7 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
             raise ValueError(
                 f"SKETCH_REPORT_SINK={self.sketch_report_sink!r} "
                 "(want stdout|kafka)")
+        self.parsed_superbatch_ladder()  # raises on a malformed ladder spec
         if self.sketch_cm_width < 16 * self.sketch_topk:
             # measured F1 cliff (docs/accuracy.md): top-K precision degrades
             # once Count-Min columns are shared by too many tracked keys —

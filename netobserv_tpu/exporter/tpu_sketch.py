@@ -265,7 +265,14 @@ class TpuSketchExporter(Exporter):
                  asym_min_bytes: float = DEFAULT_ASYM_MIN_BYTES,
                  asym_ratio: float = DEFAULT_ASYM_RATIO,
                  feed: str = "resident",
-                 resident_slots: int = 1 << 18):
+                 resident_slots: int = 1 << 18,
+                 superbatch: tuple = (1,),
+                 warm_ladder: bool = False):
+        # superbatch defaults to NO ladder for direct construction: the
+        # ladder costs superbatch_max-sized ring buffers, dictionaries and
+        # key-table rows up front, and only pays off once warmed — the
+        # production entry (`from_config`) passes the SKETCH_SUPERBATCH
+        # ladder AND warms it; embedders opting in should do the same
         # jax-importing modules are pulled in lazily so the host agent can run
         # exporter-free on machines without accelerators
         from netobserv_tpu.sketch import state as sk
@@ -301,6 +308,14 @@ class TpuSketchExporter(Exporter):
         import os as _os
         self._lane_threads = pack_threads if (
             pack_threads_explicit or (_os.cpu_count() or 1) >= 4) else 1
+        #: superbatch fold ladder (SKETCH_SUPERBATCH): queued evictions
+        #: coalesce into the largest fitting k*batch superbatch and fold as
+        #: ONE fixed-shape dispatch from a per-k pre-built jit
+        #: (sketch/staging.py ladder; docs/tpu_sketch.md)
+        self._superbatch = tuple(sorted({int(k) for k in (superbatch
+                                                          or (1,))}))
+        if self._superbatch[0] != 1:
+            raise ValueError("superbatch ladder must include 1")
         self._lock = threading.Lock()
         self._pending: list[Record] = []
         # rolled-but-unpublished device-side WindowReports, queued under
@@ -360,15 +375,21 @@ class TpuSketchExporter(Exporter):
                     bps, max(1, self._lane_threads // spec.data))
                 bpl = bps // lanes
                 caps = flowpack.default_resident_caps(bpl)
+                ladder = self._superbatch
+                ingests = {
+                    k: pmerge.make_sharded_ingest_resident_fn(
+                        self._mesh, self._cfg, bpl, caps, lanes=k * lanes,
+                        watch_name=f"sharded_ingest_resident_x{k}")
+                    for k in ladder}
                 self._ring = staging.ShardedResidentStagingRing(
-                    self._batch_size, spec.data,
-                    pmerge.make_sharded_ingest_resident_fn(
-                        self._mesh, self._cfg, bpl, caps, lanes=lanes),
+                    self._batch_size, spec.data, ingests,
                     key_tables=pmerge.init_resident_tables(
-                        self._mesh, resident_slots, lanes=lanes),
+                        self._mesh, resident_slots,
+                        lanes=max(ladder) * lanes),
                     put=dense_put,
                     caps=caps, slot_cap=resident_slots, metrics=metrics,
-                    pack_threads=pack_threads, lanes=lanes)
+                    pack_threads=pack_threads, lanes=lanes, ladder=ladder,
+                    lazy_ladder=True)
             else:
                 if feed == "compact":
                     log.info("SKETCH_FEED=compact has no sharded form "
@@ -397,8 +418,14 @@ class TpuSketchExporter(Exporter):
                 feed, resident_slots, pack_threads, metrics)
         # zero-concat eviction accumulator (columnar fast path): rows copy
         # once into a preallocated rolling buffer instead of per-fold
-        # np.concatenate over events + five feature lanes
-        self._pending_buf = staging.PendingEventBuffer(self._batch_size)
+        # np.concatenate over events + five feature lanes. Sized for the
+        # ring's superbatch ladder: queued evictions coalesce up to
+        # superbatch_max batches and fold as ONE ladder dispatch (window
+        # close always flushes, so nothing waits past the window)
+        self._pending_buf = staging.PendingEventBuffer(
+            self._batch_size, getattr(self._ring, "superbatch_max", 1))
+        if warm_ladder:
+            self.warm_superbatch_ladder()
         # the staging ring packs the next batch while the previous
         # transfers/ingests are in flight; its slot-reuse tokens also bound
         # the async dispatch queue to the ring depth, so sustained overload
@@ -424,6 +451,75 @@ class TpuSketchExporter(Exporter):
         self.heartbeat = lambda: None
         self._timer: Optional[threading.Thread] = None
         self.start_window_timer()
+
+    def warm_superbatch_ladder(self, block: bool = False) -> None:
+        """Compile every superbatch ladder entry ahead of traffic, against
+        THROWAWAY zero state/tables of identical shapes (the compile cache
+        keys on shapes, so the first real superbatch hits a warm
+        executable instead of stalling mid-traffic on a multi-second
+        compile). Runs on a background thread by default — agent startup
+        isn't serialized behind the ladder — and counts as each watched
+        entry's warmup call, so the no-retrace alarm stays armed.
+
+        The exporter's ring is built `lazy_ladder`: entries beyond 1x only
+        become SELECTABLE here, as each compile lands (`ring.mark_warm`) —
+        an unwarmed exporter folds 1x forever rather than ever paying a
+        ladder compile inside a live `export_evicted`.
+
+        MULTI-PROCESS meshes warm synchronously regardless of `block`:
+        every process must select the same ladder k for the same fold (the
+        sharded ingest is one SPMD program — divergent k means divergent
+        global computations and a collective hang), so availability must
+        flip deterministically: all entries warmed, in ladder order, on
+        every process, before any process serves traffic."""
+        ring = self._ring
+        if not isinstance(ring, staging.ShardedResidentStagingRing):
+            return  # dense/compact feeds have no ladder (docs/tpu_sketch.md)
+        import jax
+        multiprocess = jax.process_count() > 1
+        if multiprocess:
+            block = True
+
+        def _warm() -> None:
+            import jax
+            for k in ring.ladder:
+                if k in ring._available:
+                    # already selectable (k=1, or a prior warm): live folds
+                    # may be tracing it RIGHT NOW — a concurrent duplicate
+                    # first-trace here would fire a spurious post-warmup
+                    # retrace alarm, for zero benefit
+                    continue
+                try:
+                    if self._distributed:
+                        state = self._pm.init_dist_state(self._cfg,
+                                                         self._mesh)
+                        tables = self._pm.init_resident_tables(
+                            self._mesh, ring.slot_cap,
+                            lanes=ring.superbatch_max * ring.lanes)
+                    else:
+                        state = self._sk.init_state(self._cfg)
+                        tables = jax.device_put(self._sk.init_key_tables(
+                            ring.superbatch_max * ring.lanes, ring.slot_cap))
+                    nr = ring.n_shards * k * ring.lanes
+                    flat = np.zeros(nr * ring._region_words, np.uint32)
+                    out = ring._ingests[k](state, tables, ring._put(flat))
+                    jax.block_until_ready(out[2])
+                    ring.mark_warm(k)
+                except Exception as exc:
+                    if multiprocess:
+                        # divergent availability across processes means
+                        # divergent SPMD programs later — fail the startup
+                        # loudly instead of hanging a collective mid-run
+                        raise
+                    # single process: warm is best-effort, never fatal
+                    log.warning("superbatch ladder warm (k=%d) failed: %s",
+                                k, exc)
+
+        if block:
+            _warm()
+        else:
+            threading.Thread(target=_warm, name="sketch-ladder-warm",
+                             daemon=True).start()
 
     @property
     def _window_poll_s(self) -> float:
@@ -471,6 +567,8 @@ class TpuSketchExporter(Exporter):
                    asym_ratio=cfg.sketch_asym_ratio,
                    feed=cfg.sketch_feed,
                    resident_slots=cfg.sketch_resident_slots,
+                   superbatch=cfg.parsed_superbatch_ladder(),
+                   warm_ladder=True,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
 
@@ -640,27 +738,26 @@ class TpuSketchExporter(Exporter):
                   enable_asym=self._cfg.enable_asym)
         if feed == "resident":
             lanes = staging.pick_lanes(self._batch_size, self._lane_threads)
-            if lanes > 1:
-                bpl = self._batch_size // lanes
-                caps = flowpack.default_resident_caps(bpl)
-                return staging.ShardedResidentStagingRing(
-                    self._batch_size, 1,
-                    retrace.watch(sk.make_ingest_resident_lanes_fn(
-                        bpl, caps, lanes, use_pallas=self._cfg.use_pallas,
-                        enable_fanout=self._cfg.enable_fanout,
-                        enable_asym=self._cfg.enable_asym),
-                        "ingest_resident_lanes"),
-                    key_tables=jax.device_put(
-                        sk.init_key_tables(lanes, resident_slots)),
-                    put=jax.device_put, caps=caps, slot_cap=resident_slots,
-                    metrics=metrics, pack_threads=pack_threads, lanes=lanes)
-            caps = flowpack.default_resident_caps(self._batch_size)
-            return staging.ResidentStagingRing(
-                self._batch_size,
-                retrace.watch(
-                    sk.make_ingest_resident_fn(self._batch_size, caps, **kw),
-                    "ingest_resident"),
-                caps=caps, slot_cap=resident_slots, metrics=metrics)
+            ladder = self._superbatch
+            bpl = self._batch_size // lanes
+            caps = flowpack.default_resident_caps(bpl)
+            # one fixed-shape jitted entry PER ladder size, every one under
+            # its own retrace watch — a post-warmup compile of any ladder
+            # shape is a live alarm (sketch_retraces_total{fn=..._xk})
+            ingests = {
+                k: retrace.watch(sk.make_ingest_resident_lanes_fn(
+                    bpl, caps, k * lanes, use_pallas=self._cfg.use_pallas,
+                    enable_fanout=self._cfg.enable_fanout,
+                    enable_asym=self._cfg.enable_asym),
+                    f"ingest_resident_lanes_x{k}")
+                for k in ladder}
+            return staging.ShardedResidentStagingRing(
+                self._batch_size, 1, ingests,
+                key_tables=jax.device_put(
+                    sk.init_key_tables(max(ladder) * lanes, resident_slots)),
+                put=jax.device_put, caps=caps, slot_cap=resident_slots,
+                metrics=metrics, pack_threads=pack_threads, lanes=lanes,
+                ladder=ladder, lazy_ladder=True)
         if feed == "compact":
             spill_cap = staging.default_spill_cap(self._batch_size)
             return staging.DenseStagingRing(

@@ -151,6 +151,12 @@ class Metrics:
             p + "sketch_resident_spill_rows_total",
             "Rows that rode the full-width spill lane instead of a hot row",
             registry=self.registry)
+        self.sketch_superbatch_folds_total = Counter(
+            p + "sketch_superbatch_folds_total",
+            "Superbatch fold dispatches by ladder size k (k queued batches "
+            "coalesced into one fixed-shape device dispatch; a healthy "
+            "overloaded host shows mass at the largest k, an idle one at "
+            "k=1)", ["k"], registry=self.registry)
         self.sketch_window_records = Gauge(
             p + "sketch_window_records", "Flow records in the last window",
             registry=self.registry)

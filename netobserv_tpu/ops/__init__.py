@@ -9,4 +9,15 @@ Everything here follows three TPU rules (SURVEY.md §7.3, pallas_guide.md):
   ingest step jits, donates, and shards with `shard_map`.
 """
 
-from netobserv_tpu.ops import hashing, countmin, hll, topk, quantile, ewma  # noqa: F401
+import importlib.util
+
+from netobserv_tpu.ops import hashing  # noqa: F401  — jax-OPTIONAL: its
+# numpy twins (hash_words_np, base_hashes_multi_np) must import on
+# jax-less hosts, incl. the big-endian qemu CI tier (ci.yml)
+
+if importlib.util.find_spec("jax") is not None:
+    # gate on jax's PRESENCE, not a blanket except ImportError — a genuine
+    # import failure inside an op module must still surface
+    from netobserv_tpu.ops import (  # noqa: F401
+        countmin, ewma, hll, quantile, topk,
+    )

@@ -11,9 +11,16 @@ runtime map, `pkg/flow/account.go:204-246`) with VPU-friendly lane math.
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
+from typing import NamedTuple
+
 import numpy as np
+
+try:  # pragma: no cover - exercised by the jax-less qemu CI tier
+    import jax
+    import jax.numpy as jnp
+except ImportError:  # big-endian s390x: only the numpy twins are usable
+    jax = None
+    jnp = None
 
 # numpy scalars, NOT jnp: module-level jnp constants would initialize the XLA
 # backend at import time, which breaks jax.distributed.initialize() for any
@@ -66,13 +73,128 @@ def hash_words(words: jax.Array, seed: int | jax.Array) -> jax.Array:
 DST_BUCKET_SEED = 0x0D57
 #: seed of the source-hash family (global/per-src HLL, fan-out grid)
 SRC_BUCKET_SEED = 0x0517
+#: seed of the (dst addr, dst port) fan-out family — the port-scan signal's
+#: per-src HLL grid keys off it (was inlined in sketch/state.py)
+DSTPORT_FANOUT_SEED = 0x5CA7
+
+#: base_hashes' two seed constants (h1 / h2 family); every derived family
+#: xors its bucket seed into these
+_H1_SEED = 0x9747B28C
+_H2_SEED = 0x5BD1E995
 
 
 def base_hashes(words: jax.Array, seed: int = 0) -> tuple[jax.Array, jax.Array]:
     """Two independent base hashes (h2 forced odd so strides generate Z_{2^k})."""
-    h1 = hash_words(words, jnp.uint32(0x9747B28C) ^ jnp.uint32(seed))
-    h2 = hash_words(words, jnp.uint32(0x5BD1E995) ^ jnp.uint32(seed))
+    h1 = hash_words(words, jnp.uint32(_H1_SEED) ^ jnp.uint32(seed))
+    h2 = hash_words(words, jnp.uint32(_H2_SEED) ^ jnp.uint32(seed))
     return h1, h2 | jnp.uint32(1)
+
+
+class MultiHashes(NamedTuple):
+    """Every hash family the sketch ingest consumes, from ONE sweep over the
+    key words (`base_hashes_multi`). Values are bit-identical to the separate
+    `base_hashes` calls they replace — pinned by tests/test_hashing_multi.py."""
+
+    h1: jax.Array       #: flow family h1 (all KEY_WORDS, seed 0)
+    h2: jax.Array       #: flow family h2 (odd)
+    src_h1: jax.Array   #: SRC_BUCKET_SEED over the src words (0:4)
+    src_h2: jax.Array   #: … h2 (odd)
+    dst_h1: jax.Array   #: DST_BUCKET_SEED over the dst words (4:8)
+    dp_h1: jax.Array    #: DSTPORT_FANOUT_SEED over dst words + dst port
+    dp_h2: jax.Array    #: … h2 (odd)
+    src_sym: jax.Array  #: DST_BUCKET_SEED over the SRC words (victim-bucket
+    #: hash of the source endpoint: conv pair + SYN-ACK bucketing)
+
+
+#: word-index sets absorbed by each family (KEY_WORDS layout:
+#: src ip words 0..3, dst ip words 4..7, ports word 8, proto word 9;
+#: index 10 is the synthesized dst-port column)
+_FLOW_IDXS = tuple(range(10))
+_SRC_IDXS = (0, 1, 2, 3)
+_DST_IDXS = (4, 5, 6, 7)
+_DP_IDXS = (4, 5, 6, 7, 10)
+
+
+def base_hashes_multi(words: jax.Array) -> MultiHashes:
+    """All five hash families in ONE pass over the key words.
+
+    The murmur3 per-word k-mix (multiply/rotate/multiply) is seed-independent,
+    so it is computed once per word and shared by every family; only the
+    cheap h-side accumulation runs per family — and the unused h2 halves of
+    the dst-bucket and src-sym families are skipped entirely. Replaces five
+    separate `base_hashes` sweeps in `sketch.state.ingest` (bit-identical;
+    the numpy host twin `hash_words_np` and the seed constants above remain
+    the single source of truth)."""
+    words = words.astype(jnp.uint32)
+    assert words.shape[-1] == 10, "base_hashes_multi expects KEY_WORDS=10"
+    shape = words.shape[:-1]
+
+    def k_mix(w):
+        k = w * _C1
+        return _rotl32(k, 15) * _C2
+
+    ks = [k_mix(words[..., i]) for i in range(10)]
+    # the dst-port column the fan-out family hashes (low half of word 8)
+    ks.append(k_mix(words[..., 8] & jnp.uint32(0xFFFF)))
+
+    def run(seed: int, idxs: tuple[int, ...]) -> jax.Array:
+        h = jnp.broadcast_to(jnp.uint32(seed), shape)
+        for i in idxs:
+            h = _rotl32(h ^ ks[i], 13) * _M5 + _N1
+        return fmix32(h ^ jnp.uint32(len(idxs) * 4))
+
+    return MultiHashes(
+        h1=run(_H1_SEED, _FLOW_IDXS),
+        h2=run(_H2_SEED, _FLOW_IDXS) | jnp.uint32(1),
+        src_h1=run(_H1_SEED ^ SRC_BUCKET_SEED, _SRC_IDXS),
+        src_h2=run(_H2_SEED ^ SRC_BUCKET_SEED, _SRC_IDXS) | jnp.uint32(1),
+        dst_h1=run(_H1_SEED ^ DST_BUCKET_SEED, _DST_IDXS),
+        dp_h1=run(_H1_SEED ^ DSTPORT_FANOUT_SEED, _DP_IDXS),
+        dp_h2=run(_H2_SEED ^ DSTPORT_FANOUT_SEED, _DP_IDXS) | jnp.uint32(1),
+        src_sym=run(_H1_SEED ^ DST_BUCKET_SEED, _SRC_IDXS),
+    )
+
+
+def base_hashes_multi_np(words: np.ndarray) -> dict[str, np.ndarray]:
+    """Pure-numpy twin of `base_hashes_multi` (same field names) — runs on
+    jax-less hosts, including the big-endian qemu CI tier, where it pins the
+    fused sweep against golden vectors so an endianness regression in the
+    shared k-mix cannot drift silently (the multi-hash output feeds the
+    host-side numpy twins via the shared seed constants)."""
+    w = np.ascontiguousarray(words, dtype=np.uint32)
+    assert w.shape[-1] == 10
+    with np.errstate(over="ignore"):
+        def k_mix(col):
+            k = col * _C1
+            return ((k << np.uint32(15)) | (k >> np.uint32(17))) * _C2
+
+        ks = [k_mix(w[..., i]) for i in range(10)]
+        ks.append(k_mix(w[..., 8] & np.uint32(0xFFFF)))
+
+        def run(seed: int, idxs: tuple[int, ...]) -> np.ndarray:
+            h = np.full(w.shape[:-1], np.uint32(seed), np.uint32)
+            for i in idxs:
+                h = h ^ ks[i]
+                h = ((h << np.uint32(13)) | (h >> np.uint32(19))) * _M5 + _N1
+            h = h ^ np.uint32(len(idxs) * 4)
+            h = h ^ (h >> np.uint32(16))
+            h = h * _F1
+            h = h ^ (h >> np.uint32(13))
+            h = h * _F2
+            return h ^ (h >> np.uint32(16))
+
+        return {
+            "h1": run(_H1_SEED, _FLOW_IDXS),
+            "h2": run(_H2_SEED, _FLOW_IDXS) | np.uint32(1),
+            "src_h1": run(_H1_SEED ^ SRC_BUCKET_SEED, _SRC_IDXS),
+            "src_h2": run(_H2_SEED ^ SRC_BUCKET_SEED, _SRC_IDXS)
+            | np.uint32(1),
+            "dst_h1": run(_H1_SEED ^ DST_BUCKET_SEED, _DST_IDXS),
+            "dp_h1": run(_H1_SEED ^ DSTPORT_FANOUT_SEED, _DP_IDXS),
+            "dp_h2": run(_H2_SEED ^ DSTPORT_FANOUT_SEED, _DP_IDXS)
+            | np.uint32(1),
+            "src_sym": run(_H1_SEED ^ DST_BUCKET_SEED, _SRC_IDXS),
+        }
 
 
 def hash_words_np(words: np.ndarray, seed: int = 0) -> np.ndarray:

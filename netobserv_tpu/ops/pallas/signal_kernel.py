@@ -1,0 +1,156 @@
+"""Fused signal-plane fold as ONE Pallas kernel: eight scatter chains -> one
+batch walk.
+
+The un-fused ingest pays a separate serialized XLA scatter/EWMA pass over the
+batch for every small signal table (DDoS/SYN/drop EWMA rates, SYN-ACK
+responses, conversation fwd/rev, DSCP bytes, drop causes). All of those
+tables together are a few tens of KB — they fit VMEM simultaneously — so the
+kernel walks the batch ONCE and updates them together, the TPU analog of the
+single-pass sketch accelerators (arxiv 2504.16896, 2005.13332).
+
+Formulation: the eight scatter-adds group into five INDEX FAMILIES (victim =
+dst bucket, src bucket, conversation pair, DSCP code, drop cause). Per batch
+chunk each family builds its one-hot membership matrix once and contracts it
+with ALL of its value rows on the MXU:
+
+  - dst family  -> ddos bytes, SYN half-open mass, dropped bytes   (3 rows)
+  - src family  -> SYN-ACK responses                               (1 row)
+  - pair family -> conversation fwd / rev bytes                    (2 rows)
+  - dscp / cause -> one row each over a shared 256-lane aux table
+
+so a record costs ~3m + 512 lane compares (m = EWMA bucket count, 12.8K at
+the m=4096 default) plus MXU MACs, replacing eight dependent scatter passes.
+The per-dst / per-src HLL GRIDS are deliberately NOT here: their one-hot
+fold pays D*2^p (262K) compares per record versus a single scatter touch —
+the measured verdict in docs/tpu_sketch.md ("Per-stage ingest attribution").
+
+Same contract as the sibling kernels: `interpret` defaults to True off-TPU
+(testable on the CPU mesh), counters donated via input_output_aliases, and
+bit-exact equivalence with the scatter chain is pinned by
+tests/test_pallas_signal.py (integer-valued f32 masses make the float sums
+order-independent).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+CHUNK_B = 1024
+#: shared width of the small-table aux plane (row 0 = DSCP, row 1 = drop
+#: causes); both tables must fit (sketch.state N_DSCP=64, N_DROP_CAUSES=128)
+AUX_W = 256
+
+#: value-plane row order (main table rows 0..5 match vals rows 0..5)
+#: [ddos, syn, drops | synack | conv_fwd, conv_rev] + aux [dscp, cause]
+N_MAIN = 6
+N_VALS = 8
+#: index families: [dst, src, pair, dscp, cause]
+N_IDX = 5
+
+
+class SignalPlanes(NamedTuple):
+    """The signal tables the fused kernel updates, as plain arrays."""
+
+    ddos_rate: jax.Array   # f32[m]
+    syn_rate: jax.Array    # f32[m]
+    drops_rate: jax.Array  # f32[m]
+    synack: jax.Array      # f32[m]
+    conv_fwd: jax.Array    # f32[m]
+    conv_rev: jax.Array    # f32[m]
+    dscp_bytes: jax.Array  # f32[n_dscp]  (n_dscp <= AUX_W)
+    drop_causes: jax.Array  # f32[n_causes] (n_causes <= AUX_W)
+
+
+def _fold_kernel(main_ref, aux_ref, idx_ref, vals_ref, main_out, aux_out, *,
+                 n_chunks: int, m: int):
+    lanes_m = jax.lax.broadcasted_iota(jnp.int32, (1, m), 1)
+    lanes_a = jax.lax.broadcasted_iota(jnp.int32, (1, AUX_W), 1)
+
+    def chunk_body(i, acc):
+        acc_main, acc_aux = acc
+        sl = pl.dslice(i * CHUNK_B, CHUNK_B)
+        vals = vals_ref[:, sl]                                  # [8, C]
+
+        def onehot(fam, lanes):
+            idx = idx_ref[fam, sl].reshape(CHUNK_B, 1)
+            return (idx == lanes).astype(jnp.float32)           # [C, W]
+
+        # one one-hot build per index family, shared by its value rows
+        c_dst = jnp.dot(vals[0:3], onehot(0, lanes_m),
+                        preferred_element_type=jnp.float32)     # [3, m]
+        c_src = jnp.dot(vals[3:4], onehot(1, lanes_m),
+                        preferred_element_type=jnp.float32)     # [1, m]
+        c_pair = jnp.dot(vals[4:6], onehot(2, lanes_m),
+                         preferred_element_type=jnp.float32)    # [2, m]
+        c_dscp = jnp.dot(vals[6:7], onehot(3, lanes_a),
+                         preferred_element_type=jnp.float32)    # [1, AUX_W]
+        c_cause = jnp.dot(vals[7:8], onehot(4, lanes_a),
+                          preferred_element_type=jnp.float32)   # [1, AUX_W]
+        new_main = acc_main + jnp.concatenate([c_dst, c_src, c_pair], axis=0)
+        new_aux = acc_aux + jnp.concatenate([c_dscp, c_cause], axis=0)
+        return new_main, new_aux
+
+    acc = jax.lax.fori_loop(0, n_chunks, chunk_body,
+                            (main_ref[...], aux_ref[...]))
+    main_out[...] = acc[0]
+    aux_out[...] = acc[1]
+
+
+def eligible(planes: SignalPlanes) -> bool:
+    """Static shape gate: the six m-wide planes must share one power-of-two,
+    lane-aligned width and the aux tables must fit the shared aux plane."""
+    m = planes.ddos_rate.shape[0]
+    return (all(p.shape == (m,) for p in
+                (planes.syn_rate, planes.drops_rate, planes.synack,
+                 planes.conv_fwd, planes.conv_rev))
+            and m % 128 == 0
+            and planes.dscp_bytes.shape[0] <= AUX_W
+            and planes.drop_causes.shape[0] <= AUX_W)
+
+
+def update(planes: SignalPlanes, idx: jax.Array, vals: jax.Array,
+           interpret: bool | None = None) -> SignalPlanes:
+    """Fold one batch into every signal plane in one pass.
+
+    idx:  i32[5, B] — [dst_bucket, src_bucket, pair_bucket, dscp, cause],
+          each already masked into its table's range.
+    vals: f32[8, B] — [ddos, syn, drops, synack, conv_fwd, conv_rev, dscp,
+          cause] masses, already validity/signal-masked (0 = no-op).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    assert eligible(planes), "signal planes ineligible for the fused kernel"
+    m = planes.ddos_rate.shape[0]
+    b = idx.shape[1]
+    assert vals.shape == (N_VALS, b) and idx.shape == (N_IDX, b)
+    pad = (-b) % CHUNK_B
+    if pad:  # zero mass adds nothing — the padded tail is a no-op
+        idx = jnp.pad(idx, ((0, 0), (0, pad)))
+        vals = jnp.pad(vals, ((0, 0), (0, pad)))
+    n_chunks = idx.shape[1] // CHUNK_B
+
+    main = jnp.stack([planes.ddos_rate, planes.syn_rate, planes.drops_rate,
+                      planes.synack, planes.conv_fwd, planes.conv_rev])
+    n_dscp = planes.dscp_bytes.shape[0]
+    n_causes = planes.drop_causes.shape[0]
+    aux = jnp.zeros((2, AUX_W), jnp.float32)
+    aux = aux.at[0, :n_dscp].set(planes.dscp_bytes)
+    aux = aux.at[1, :n_causes].set(planes.drop_causes)
+
+    kernel = functools.partial(_fold_kernel, n_chunks=n_chunks, m=m)
+    new_main, new_aux = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((N_MAIN, m), jnp.float32),
+                   jax.ShapeDtypeStruct((2, AUX_W), jnp.float32)),
+        input_output_aliases={0: 0, 1: 1},
+        interpret=interpret,
+    )(main, aux, idx.astype(jnp.int32), vals.astype(jnp.float32))
+    return SignalPlanes(
+        ddos_rate=new_main[0], syn_rate=new_main[1], drops_rate=new_main[2],
+        synack=new_main[3], conv_fwd=new_main[4], conv_rev=new_main[5],
+        dscp_bytes=new_aux[0, :n_dscp], drop_causes=new_aux[1, :n_causes])

@@ -200,7 +200,9 @@ def init_resident_tables(mesh: Mesh, slot_cap: int,
 def make_sharded_ingest_resident_fn(mesh: Mesh, cfg: sk.SketchConfig,
                                     batch_per_lane: int, caps,
                                     donate: bool = True,
-                                    lanes: int = 1) -> Callable:
+                                    lanes: int = 1,
+                                    watch_name: str =
+                                    "sharded_ingest_resident") -> Callable:
     """Jitted `(dist_state, key_tables, flat) -> (dist_state, key_tables,
     token)` — the RESIDENT feed over the mesh (~15B/record instead of the
     dense feed's 80). `flat` concatenates `lanes` resident regions per data
@@ -209,7 +211,12 @@ def make_sharded_ingest_resident_fn(mesh: Mesh, cfg: sk.SketchConfig,
     `sketch.staging.ShardedResidentStagingRing`); the contiguous split over
     the data axis lands exactly on per-shard region-group boundaries. Each
     shard scatters its new-key lanes into ITS table slices and gathers
-    hot-row keys locally — no collectives."""
+    hot-row keys locally — no collectives.
+
+    `key_tables` may carry MORE than `lanes` rows per shard (the superbatch
+    fold ladder shares one table array across ladder entries —
+    `sketch.state.resident_lane_arrays`); `watch_name` distinguishes ladder
+    entries in the retrace watchdog accounting."""
     nsk = mesh.shape[SKETCH_AXIS]
     template = sk.init_state(cfg)
     specs = _state_specs(template)
@@ -234,7 +241,7 @@ def make_sharded_ingest_resident_fn(mesh: Mesh, cfg: sk.SketchConfig,
     )
     return retrace.watch(
         jax.jit(shmapped, donate_argnums=(0, 1) if donate else ()),
-        "sharded_ingest_resident")
+        watch_name)
 
 
 def shard_dense(mesh: Mesh, dense: np.ndarray) -> jax.Array:

@@ -51,6 +51,18 @@ class PendingEventBuffer:
     zero arrays for absent lanes); this copies each incoming row exactly
     once into a fixed buffer and hands the fold zero-copy prefix views.
 
+    `superbatch_max > 1` sizes the buffer for that many batches and
+    coalesces rows that ARRIVE together: a large eviction (or several
+    queued ones delivered back-to-back) folds as ONE k-batch superbatch
+    the ladder ring dispatches as a single fixed-shape call instead of k
+    per-batch dispatches (`ShardedResidentStagingRing` ladder). Small
+    evictions keep the old cadence — fold as soon as a full batch is
+    buffered — so the exporter-seam latency of a light stream is
+    unchanged; coalescing only ever batches work that was already queued
+    in one `append` (deferring folds to a fill deadline instead was
+    measured to CONCENTRATE slot waits into multi-second export stalls on
+    a device slower than the feed — tests/test_roll_nonblocking.py).
+
     Feature-lane semantics match the old `_concat_feature`: a lane is
     passed to the fold iff ANY eviction in the current batch carried it,
     with zeroed rows standing in for evictions that lacked it (`_live`
@@ -62,11 +74,12 @@ class PendingEventBuffer:
              ("xlat", binfmt.XLAT_REC_DTYPE),
              ("quic", binfmt.QUIC_REC_DTYPE))
 
-    def __init__(self, batch_size: int):
+    def __init__(self, batch_size: int, superbatch_max: int = 1):
         self.batch_size = batch_size
+        self.capacity = batch_size * max(1, superbatch_max)
         self.n = 0
-        self.events = np.zeros(batch_size, binfmt.FLOW_EVENT_DTYPE)
-        self._lanes = {name: np.zeros(batch_size, dt)
+        self.events = np.zeros(self.capacity, binfmt.FLOW_EVENT_DTYPE)
+        self._lanes = {name: np.zeros(self.capacity, dt)
                        for name, dt in self.LANES}
         self._live = {name: False for name, _ in self.LANES}
 
@@ -74,14 +87,16 @@ class PendingEventBuffer:
         return self.n
 
     def append(self, evicted, fold: Callable) -> None:
-        """Copy `evicted` (an EvictedFlows) into the buffer; every time the
-        buffer reaches a full batch, `fold(events, feats)` fires with views
-        into it (the fold must consume them before returning — both ring
-        pack paths copy synchronously) and the buffer rolls over."""
+        """Copy `evicted` (an EvictedFlows) into the buffer, then fire
+        `fold(events, feats)` with views into it for every full batch
+        buffered — as one coalesced batch-aligned prefix (the ladder ring
+        dispatches it as a single superbatch), keeping any sub-batch tail
+        buffered for the next eviction. The fold must consume its views
+        before returning (both ring pack paths copy synchronously)."""
         ev = evicted.events
         off = 0
         while off < len(ev):
-            take = min(len(ev) - off, self.batch_size - self.n)
+            take = min(len(ev) - off, self.capacity - self.n)
             lo, hi = self.n, self.n + take
             self.events[lo:hi] = ev[off:off + take]
             for name, _ in self.LANES:
@@ -98,8 +113,11 @@ class PendingEventBuffer:
                     lane[lo:hi] = 0
             self.n += take
             off += take
-            if self.n == self.batch_size:
+            if self.n == self.capacity:
                 self.flush_to(fold)
+        full = self.n - self.n % self.batch_size
+        if full:
+            self._fold_prefix(fold, full)
 
     def flush_to(self, fold: Callable) -> None:
         """Fold whatever is buffered (a partial batch pads downstream) and
@@ -115,6 +133,28 @@ class PendingEventBuffer:
         for name, _ in self.LANES:
             self._live[name] = False
         fold(self.events[:n], feats)
+
+    def _fold_prefix(self, fold: Callable, rows: int) -> None:
+        """Fold the batch-aligned `rows` prefix and slide the sub-batch
+        tail to the front. The fold consumes its views synchronously, so
+        the tail move happens after it returns; a RAISING fold still drops
+        the prefix (counted upstream) and keeps the tail."""
+        n = self.n
+        feats = {name: (self._lanes[name][:rows] if self._live[name]
+                        else None) for name, _ in self.LANES}
+        try:
+            fold(self.events[:rows], feats)
+        finally:
+            tail = n - rows
+            if tail:
+                self.events[:tail] = self.events[rows:n]
+                for name, _ in self.LANES:
+                    if self._live[name]:
+                        self._lanes[name][:tail] = self._lanes[name][rows:n]
+            else:
+                for name, _ in self.LANES:
+                    self._live[name] = False
+            self.n = tail
 
 
 class _SlotRing:
@@ -306,13 +346,39 @@ class ShardedResidentStagingRing(_SlotRing):
     evolution is deterministic in row order, so all processes assign
     identical slots.
 
-    `ingest`: `(dist_state, key_tables, flat) -> (dist_state, key_tables,
-    token)`. `pack_threads > 1` packs the regions concurrently."""
+    Superbatch LADDER (`ladder=(1, 2, 4)`): when a fold receives k queued
+    batches' worth of rows (the exporter's `PendingEventBuffer` coalesces
+    evictions up to `superbatch_max` batches), the whole superbatch packs
+    into `n_shards * k * lanes` regions and ships as ONE put + ONE jitted
+    ingest dispatch of the k-entry instead of k per-batch dispatches —
+    amortizing the per-dispatch python/jit/transfer overhead. Every ladder
+    entry is its own fixed-shape jitted fn (no retraces); they all share
+    ONE key-table array sized for the largest entry (a smaller entry
+    updates only its leading regions' tables, `state.resident_lane_arrays`)
+    and per-(shard, ladder-position, lane) dictionaries, so a region's
+    dictionary <-> device-table pairing is stable across ladder sizes.
 
-    def __init__(self, batch_size: int, n_shards: int, ingest: Callable,
+    `ingest`: `{k: (dist_state, key_tables, flat) -> (dist_state,
+    key_tables, token)}` for every ladder entry (a bare callable means
+    `{1: fn}`). `key_tables` must carry `superbatch_max * lanes` rows per
+    shard. `pack_threads > 1` packs the regions concurrently."""
+
+    def __init__(self, batch_size: int, n_shards: int, ingest,
                  key_tables, put: Callable,
                  caps=None, slot_cap: int = 1 << 18, n_slots: int = 4,
-                 metrics=None, pack_threads: int = 1, lanes: int = 1):
+                 metrics=None, pack_threads: int = 1, lanes: int = 1,
+                 ladder: tuple = (1,), lazy_ladder: bool = False):
+        self.ladder = tuple(sorted({int(k) for k in ladder}))
+        if not self.ladder or self.ladder[0] != 1:
+            raise ValueError("superbatch ladder must include 1")
+        self.superbatch_max = self.ladder[-1]
+        # lazy_ladder: entries > 1 become SELECTABLE only once mark_warm
+        # says their jit is compiled (the exporter's construction warm) —
+        # a cold ladder entry must never compile inside a live fold, which
+        # would stall export_evicted for seconds (test_roll_nonblocking).
+        # Eager (default) trusts the caller to warm by folding (bench,
+        # offline tools, tests).
+        self._available = {1} if lazy_ladder else set(self.ladder)
         n_regions = n_shards * lanes
         if batch_size % n_regions:
             raise ValueError(
@@ -320,50 +386,89 @@ class ShardedResidentStagingRing(_SlotRing):
         self.batch_size = batch_size
         self.n_shards = n_shards
         self.lanes = lanes
+        #: regions of ONE 1x batch (a k-superbatch packs k*n_regions)
         self.n_regions = n_regions
         self.batch_per_region = batch_size // n_regions
         self.caps = caps or flowpack.default_resident_caps(
             self.batch_per_region)
         self.slot_cap = slot_cap
         self.pack_threads = pack_threads
-        self.kdicts = [flowpack.KeyDict(slot_cap) for _ in range(n_regions)]
+        self.kdicts = [flowpack.KeyDict(slot_cap)
+                       for _ in range(n_regions * self.superbatch_max)]
         self.key_tables = key_tables
-        self._ingest = ingest
+        self._ingests = ingest if not callable(ingest) else {1: ingest}
+        missing = set(self.ladder) - set(self._ingests)
+        if missing:
+            raise ValueError(f"no ingest fn for ladder entries {missing}")
         self._put = put
         self.continuations = 0
         self.dict_resets = 0
         self.spill_rows = 0
+        #: dispatch counts by superbatch size (mirrors
+        #: sketch_superbatch_folds_total{k})
+        self.superbatch_folds: dict[int, int] = {}
         self._region_words = flowpack.resident_buf_len(self.batch_per_region,
                                                        self.caps)
-        self._init_slots([np.empty(n_regions * self._region_words, np.uint32)
-                          for _ in range(n_slots)], metrics)
+        self._init_slots(
+            [np.empty(self.superbatch_max * n_regions * self._region_words,
+                      np.uint32) for _ in range(n_slots)], metrics)
+
+    @property
+    def _ingest(self):
+        """The 1x ladder entry (back-compat: retrace introspection in tests
+        predates the ladder)."""
+        return self._ingests[1]
+
+    def mark_warm(self, *ks: int) -> None:
+        """Make ladder entries selectable (call after compiling them — the
+        exporter's `warm_superbatch_ladder`)."""
+        self._available.update(int(k) for k in ks)
 
     def fold(self, state, events, extra=None, dns=None, drops=None,
              xlat=None, quic=None, trace=None):
         """Pack `events` (split over the regions, possibly in several
         chunks) into free ring slots, ship and ingest each; returns the new
-        dist state (async — not blocked on)."""
+        dist state (async — not blocked on). Row counts beyond one batch
+        dispatch as the largest fitting superbatch ladder entries."""
         n = len(events)
         if n == 0:
             return state
         trace, owned = self._fold_trace(trace)
         try:
-            return self._fold_traced(state, events, extra, dns, drops, xlat,
-                                     quic, trace)
+            feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat,
+                         quic=quic)
+            start = 0
+            while start < n:
+                remaining = n - start
+                k = max((x for x in self.ladder
+                         if x in self._available
+                         and x * self.batch_size <= remaining), default=1)
+                take = min(remaining, k * self.batch_size)
+                chunk_feats = {
+                    name: (v[start:start + take]
+                           if v is not None and len(v) else None)
+                    for name, v in feats.items()}
+                state = self._fold_chunk(state, events[start:start + take],
+                                         chunk_feats, k, trace)
+                start += take
+            return state
         finally:
             if owned:
                 trace.finish()
 
-    def _fold_traced(self, state, events, extra, dns, drops, xlat, quic,
-                     trace):
+    def _fold_chunk(self, state, events, feats, k: int, trace):
+        """Pack and dispatch ONE k-superbatch chunk (<= k * batch_size rows)
+        through the k ladder entry."""
         n = len(events)
-        feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat, quic=quic)
-        nr = self.n_regions
+        nr = self.n_shards * k * self.lanes
+        kl = k * self.lanes
+        kmax_l = self.superbatch_max * self.lanes
+        ship_words = nr * self._region_words
         bounds = [n * i // nr for i in range(nr + 1)]
         shard_ev = [events[bounds[i]:bounds[i + 1]] for i in range(nr)]
         shard_feats = [
-            {k: (v[bounds[i]:bounds[i + 1]] if v is not None and len(v)
-                 else None) for k, v in feats.items()}
+            {name: (v[bounds[i]:bounds[i + 1]] if v is not None and len(v)
+                    else None) for name, v in feats.items()}
             for i in range(nr)]
         starts = [0] * nr
         first = True
@@ -385,7 +490,10 @@ class ShardedResidentStagingRing(_SlotRing):
                     flowpack.zero_resident_region(
                         region, self.batch_per_region, self.caps)
                     return 0, 0
-                kd = self.kdicts[i]
+                # region i of a k-chunk is (shard, ladder-position j) —
+                # dict j of that shard, whatever k the chunk uses, so the
+                # dictionary always matches device table row j
+                kd = self.kdicts[(i // kl) * kmax_l + (i % kl)]
                 resets = 0
                 if kd.count() >= self.slot_cap:
                     kd.reset()  # per-region epoch roll (ResidentStagingRing)
@@ -412,6 +520,7 @@ class ShardedResidentStagingRing(_SlotRing):
             chunk_resets = sum(o[1] for o in outs)
             self.spill_rows += chunk_spills
             self.dict_resets += chunk_resets
+            self.superbatch_folds[k] = self.superbatch_folds.get(k, 0) + 1
             if self._metrics is not None:
                 if chunk_spills:
                     self._metrics.sketch_resident_spill_rows_total.inc(
@@ -421,12 +530,14 @@ class ShardedResidentStagingRing(_SlotRing):
                         chunk_resets)
                 if not first:
                     self._metrics.sketch_resident_continuations_total.inc()
+                self._metrics.sketch_superbatch_folds_total.labels(
+                    str(k)).inc()
             if not first:
                 self.continuations += 1
             first = False
             with trace.stage("ingest_dispatch"):
-                state, self.key_tables, token = self._ingest(
-                    state, self.key_tables, self._put(buf))
+                state, self.key_tables, token = self._ingests[k](
+                    state, self.key_tables, self._put(buf[:ship_words]))
             self._advance(slot, token)
         return state
 

@@ -297,11 +297,13 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
         bytes_f = bytes_f * factor.astype(jnp.float32)
         pkts = pkts * factor
 
-    h1, h2 = hashing.base_hashes(words)
-    src_h1, src_h2 = hashing.base_hashes(words[:, 0:4],
-                                         seed=hashing.SRC_BUCKET_SEED)
-    dst_h1, _ = hashing.base_hashes(words[:, 4:8],
-                                    seed=hashing.DST_BUCKET_SEED)
+    # ONE sweep computes every hash family (flow h1/h2, src bucket, dst
+    # bucket, dst-port fan-out, src-sym): the murmur k-mix per key word is
+    # shared across families instead of five independent base_hashes passes
+    mhash = hashing.base_hashes_multi(words)
+    h1, h2 = mhash.h1, mhash.h2
+    src_h1, src_h2 = mhash.src_h1, mhash.src_h2
+    dst_h1 = mhash.dst_h1
 
     if sketch_axis is None:
         # the Pallas kernel needs the width to tile; silently use the XLA
@@ -340,14 +342,11 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     per_dst = hll.update_per_dst(state.hll_per_dst, dst_h1, src_h1, src_h2, valid)
     if enable_fanout:
         # port-scan signal: distinct (dst addr, dst port) fan-out per SOURCE
-        # bucket — a scanner touches many; a normal client few (dst port =
-        # low half of key word 8, see pack_key_words)
-        dstport_cols = jnp.concatenate(
-            [words[:, 4:8], (words[:, 8] & jnp.uint32(0xFFFF))[:, None]],
-            axis=1)
-        dp_h1, dp_h2 = hashing.base_hashes(dstport_cols, seed=0x5CA7)
-        per_src = hll.update_per_dst(state.hll_per_src, src_h1, dp_h1, dp_h2,
-                                     valid)
+        # bucket — a scanner touches many; a normal client few. The (dst,
+        # port) hashes come from the shared multi-hash sweep above (seed:
+        # hashing.DSTPORT_FANOUT_SEED)
+        per_src = hll.update_per_dst(state.hll_per_src, src_h1, mhash.dp_h1,
+                                     mhash.dp_h2, valid)
     else:
         per_src = state.hll_per_src
     rtt = arrays["rtt_us"]
@@ -355,33 +354,18 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     gamma = quantile.gamma_for(state.hist_rtt.n_buckets)
     hist_rtt = quantile.update(state.hist_rtt, rtt, valid & (rtt > 0), gamma)
     hist_dns = quantile.update(state.hist_dns, dns, valid & (dns > 0), gamma)
-    ddos = ewma.accumulate(state.ddos, dst_h1, bytes_f, valid)
-
-    # conversation asymmetry: hash BOTH endpoints under one seed so the
+    # --- signal planes (trace-time optional feature columns: a feed
+    # without a column — e.g. the legacy six-array dict — simply skips the
+    # corresponding signal; the fused kernel receives a zero value row
+    # instead, which is bit-identical to skipping) ---
+    # conversation asymmetry hashes BOTH endpoints under one seed so the
     # pair bucket is direction-invariant (A->B and B->A land together);
-    # the lower endpoint hash defines the canonical "fwd" direction
-    src_sym, _ = hashing.base_hashes(words[:, 0:4],
-                                     seed=hashing.DST_BUCKET_SEED)
-    if enable_asym:
-        pair_idx = ((src_sym + dst_h1)
-                    & jnp.uint32(state.conv_fwd.shape[0] - 1)).astype(jnp.int32)
-        is_fwd = src_sym < dst_h1
-        # self-pairs (src == dst: hairpin NAT, loopback capture) have no
-        # meaningful direction — both ways would land "fwd" and fire a
-        # false one-way alert every window; exclude them from the signal
-        conv_ok = valid & (src_sym != dst_h1)
-        conv_fwd = state.conv_fwd.at[pair_idx].add(
-            jnp.where(conv_ok & is_fwd, bytes_f, 0.0), mode="drop")
-        conv_rev = state.conv_rev.at[pair_idx].add(
-            jnp.where(conv_ok & ~is_fwd, bytes_f, 0.0), mode="drop")
-    else:
-        conv_fwd, conv_rev = state.conv_fwd, state.conv_rev
-
-    # --- feature-lane signals (trace-time optional: a feed without the
-    # column — e.g. the legacy six-array dict — simply skips the signal) ---
+    # the lower endpoint hash defines the canonical "fwd" direction.
+    # src_sym hashes the src words under the dst seed — also exactly the
+    # victim-bucket hash the SYN-ACK side needs.
+    src_sym = mhash.src_sym
     mass = factor.astype(jnp.float32) if samp is not None else 1.0
     flags = arrays.get("tcp_flags")
-    syn_state, synack_arr = state.syn, state.synack
     if flags is not None:
         # SYN-flood: half-open attempts (SYN seen, never ACKed — a spoofed
         # flood leaves one such record per probe) bucket by victim = dst;
@@ -392,30 +376,110 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
         f = flags.astype(jnp.int32)
         half_open = valid & ((f & TcpFlags.SYN) != 0) & \
             ((f & TcpFlags.ACK) == 0)
-        syn_state = ewma.accumulate(state.syn, dst_h1,
-                                    jnp.where(half_open, mass, 0.0), valid)
-        # src_sym (above) hashes the src words under the dst seed — exactly
-        # the victim-bucket hash the SYN-ACK side needs
-        sa_idx = (src_sym & jnp.uint32(state.synack.shape[0] - 1)
-                  ).astype(jnp.int32)
         is_synack = valid & ((f & TcpFlags.SYN_ACK) != 0)
-        synack_arr = state.synack.at[sa_idx].add(
-            jnp.where(is_synack, mass, 0.0), mode="drop")
     dscp = arrays.get("dscp")
-    dscp_bytes = state.dscp_bytes
-    if dscp is not None:
-        dscp_bytes = dscp_bytes.at[dscp.astype(jnp.int32) & (N_DSCP - 1)].add(
-            jnp.where(valid, bytes_f, 0.0), mode="drop")
     db = arrays.get("drop_bytes")
-    drops_state, drop_causes = state.drops_ewma, state.drop_causes
+    cause = arrays.get("drop_cause") if db is not None else None
     tdb, tdp = state.total_drop_bytes, state.total_drop_packets
     if db is not None:
         dbf = db.astype(jnp.float32) * mass
         dpf = arrays["drop_packets"].astype(jnp.float32) * mass
-        drops_state = ewma.accumulate(state.drops_ewma, dst_h1, dbf, valid)
         tdb = tdb + jnp.sum(jnp.where(valid, dbf, 0.0))
         tdp = tdp + jnp.sum(jnp.where(valid, dpf, 0.0))
-        cause = arrays.get("drop_cause")
+    if enable_asym:
+        pair_idx = ((src_sym + dst_h1)
+                    & jnp.uint32(state.conv_fwd.shape[0] - 1)).astype(jnp.int32)
+        is_fwd = src_sym < dst_h1
+        # self-pairs (src == dst: hairpin NAT, loopback capture) have no
+        # meaningful direction — both ways would land "fwd" and fire a
+        # false one-way alert every window; exclude them from the signal
+        conv_ok = valid & (src_sym != dst_h1)
+
+    use_signal_kernel = use_pallas and sketch_axis is None
+    if use_signal_kernel:
+        from netobserv_tpu.ops.pallas import signal_kernel
+        planes = signal_kernel.SignalPlanes(
+            ddos_rate=state.ddos.rate, syn_rate=state.syn.rate,
+            drops_rate=state.drops_ewma.rate, synack=state.synack,
+            conv_fwd=state.conv_fwd, conv_rev=state.conv_rev,
+            dscp_bytes=state.dscp_bytes, drop_causes=state.drop_causes)
+        use_signal_kernel = signal_kernel.eligible(planes)
+    if use_signal_kernel:
+        # fused signal-plane fold: all eight scatter targets update in ONE
+        # Pallas batch walk (ops/pallas/signal_kernel.py); absent feature
+        # columns contribute zero-mass rows — bit-identical to skipping
+        m_sig = state.conv_fwd.shape[0]
+        zeros_b = jnp.zeros_like(bytes_f)
+        izeros_b = jnp.zeros(bytes_f.shape, jnp.int32)
+        dst_idx = (dst_h1 & jnp.uint32(m_sig - 1)).astype(jnp.int32)
+        src_idx = (src_sym & jnp.uint32(m_sig - 1)).astype(jnp.int32)
+        v_ddos = jnp.where(valid, bytes_f, 0.0)
+        if flags is not None:
+            v_syn = jnp.where(half_open, mass, 0.0)
+            v_synack = jnp.where(is_synack, mass, 0.0)
+        else:
+            v_syn = v_synack = zeros_b
+        if db is not None:
+            v_drops = jnp.where(valid, dbf, 0.0)
+        else:
+            v_drops = zeros_b
+        if cause is not None:
+            cause_idx = jnp.minimum(cause.astype(jnp.int32),
+                                    N_DROP_CAUSES - 1)
+            v_cause = jnp.where(valid & (dpf > 0), dpf, 0.0)
+        else:
+            cause_idx, v_cause = izeros_b, zeros_b
+        if enable_asym:
+            v_fwd = jnp.where(conv_ok & is_fwd, bytes_f, 0.0)
+            v_rev = jnp.where(conv_ok & ~is_fwd, bytes_f, 0.0)
+        else:
+            pair_idx, v_fwd, v_rev = izeros_b, zeros_b, zeros_b
+        if dscp is not None:
+            dscp_idx = dscp.astype(jnp.int32) & (N_DSCP - 1)
+            v_dscp = jnp.where(valid, bytes_f, 0.0)
+        else:
+            dscp_idx, v_dscp = izeros_b, zeros_b
+        out = signal_kernel.update(
+            planes,
+            jnp.stack([dst_idx, src_idx, pair_idx, dscp_idx, cause_idx]),
+            jnp.stack([v_ddos, v_syn, v_drops, v_synack, v_fwd, v_rev,
+                       v_dscp, v_cause]))
+        ddos = state.ddos._replace(rate=out.ddos_rate)
+        syn_state = state.syn._replace(rate=out.syn_rate)
+        drops_state = state.drops_ewma._replace(rate=out.drops_rate)
+        synack_arr = out.synack
+        conv_fwd, conv_rev = out.conv_fwd, out.conv_rev
+        dscp_bytes, drop_causes = out.dscp_bytes, out.drop_causes
+    else:
+        # un-fused scatter chain (CPU / owner-sharded / ineligible shapes)
+        # — the fused kernel above is equivalence-pinned against exactly
+        # this path (tests/test_pallas_signal.py)
+        ddos = ewma.accumulate(state.ddos, dst_h1, bytes_f, valid)
+        if enable_asym:
+            conv_fwd = state.conv_fwd.at[pair_idx].add(
+                jnp.where(conv_ok & is_fwd, bytes_f, 0.0), mode="drop")
+            conv_rev = state.conv_rev.at[pair_idx].add(
+                jnp.where(conv_ok & ~is_fwd, bytes_f, 0.0), mode="drop")
+        else:
+            conv_fwd, conv_rev = state.conv_fwd, state.conv_rev
+        syn_state, synack_arr = state.syn, state.synack
+        if flags is not None:
+            syn_state = ewma.accumulate(state.syn, dst_h1,
+                                        jnp.where(half_open, mass, 0.0),
+                                        valid)
+            sa_idx = (src_sym & jnp.uint32(state.synack.shape[0] - 1)
+                      ).astype(jnp.int32)
+            synack_arr = state.synack.at[sa_idx].add(
+                jnp.where(is_synack, mass, 0.0), mode="drop")
+        dscp_bytes = state.dscp_bytes
+        if dscp is not None:
+            dscp_bytes = dscp_bytes.at[
+                dscp.astype(jnp.int32) & (N_DSCP - 1)].add(
+                jnp.where(valid, bytes_f, 0.0), mode="drop")
+        drops_state, drop_causes = state.drops_ewma, state.drop_causes
+        if db is not None:
+            drops_state = ewma.accumulate(state.drops_ewma, dst_h1, dbf,
+                                          valid)
         if cause is not None:
             ci = jnp.minimum(cause.astype(jnp.int32), N_DROP_CAUSES - 1)
             drop_causes = drop_causes.at[ci].add(
@@ -540,6 +604,35 @@ def resident_to_arrays(flat: jax.Array, key_table: jax.Array,
     for the ordinary ingest: all the row widening happens in HBM, the
     transfer link only ever saw ~15 bytes/record (byte budget in
     docs/tpu_sketch.md)."""
+    return _resident_region_arrays(flat, key_table, batch_size, caps)
+
+
+def _region_nk(flat: jax.Array, batch_size: int, caps,
+               slot_cap: int) -> tuple[jax.Array, jax.Array]:
+    """A region's new-key lane as (slot indices, key words) — undefined
+    rows index slot_cap so a mode=\"drop\" scatter discards them."""
+    nk_off = (RESIDENT_HDR + batch_size * HOT_WORDS + caps.dns
+              + caps.drop * 2)
+    nk = flat[nk_off:nk_off + caps.nk * NK_WORDS].reshape(caps.nk, NK_WORDS)
+    nk_def = (nk[:, 0] >> 31) != 0
+    nk_slot = jnp.where(nk_def, nk[:, 0] & jnp.uint32(0xFFFFF),
+                        jnp.uint32(slot_cap)).astype(jnp.int32)
+    return nk_slot, nk[:, 1:]
+
+
+def _resident_region_arrays(flat: jax.Array, key_tables: jax.Array,
+                            batch_size: int, caps,
+                            lane: int | None = None,
+                            nk_applied: bool = False) -> tuple[dict,
+                                                               jax.Array]:
+    """One resident region against its key table. `lane=None`: key_tables
+    is a single (slot_cap, KW) table; otherwise it is the SHARED
+    (L, slot_cap, KW) per-lane array and this region uses row `lane`.
+    `nk_applied=True` skips the new-key scatter — the caller already
+    applied every region's new-key lane in one combined scatter
+    (`resident_lane_arrays`), which XLA updates in place under donation
+    (a per-region scatter/gather CHAIN was measured to copy the full
+    shared table once per region on the ladder path)."""
     hot_off = RESIDENT_HDR
     dns_off = hot_off + batch_size * HOT_WORDS
     drop_off = dns_off + caps.dns
@@ -552,17 +645,23 @@ def resident_to_arrays(flat: jax.Array, key_table: jax.Array,
     nk = flat[nk_off:spill_off].reshape(caps.nk, NK_WORDS)
     spill = dense_to_arrays(flat[spill_off:].reshape(caps.spill, DENSE_WORDS))
 
-    slot_cap = key_table.shape[0]
+    slot_cap = key_tables.shape[-2]
     nk_def = (nk[:, 0] >> 31) != 0
     # undefined rows index out of range -> mode="drop" discards the write
     nk_slot = jnp.where(nk_def, nk[:, 0] & jnp.uint32(0xFFFFF),
                         jnp.uint32(slot_cap)).astype(jnp.int32)
-    key_table = key_table.at[nk_slot].set(nk[:, 1:], mode="drop")
-
     w0 = hot[:, 0]
     valid = (w0 >> 31) != 0
     slots = (w0 & jnp.uint32(0xFFFFF)).astype(jnp.int32)
-    keys = key_table[slots]
+    if lane is None:
+        if not nk_applied:
+            key_tables = key_tables.at[nk_slot].set(nk[:, 1:], mode="drop")
+        keys = key_tables[slots]
+    else:
+        if not nk_applied:
+            key_tables = key_tables.at[lane, nk_slot].set(nk[:, 1:],
+                                                          mode="drop")
+        keys = key_tables[lane, slots]
     rtt = (((w0 >> 20) & jnp.uint32(0xFF))
            << (2 * ((w0 >> 28) & jnp.uint32(0x7)))).astype(jnp.int32)
     w2 = hot[:, 2]
@@ -598,7 +697,7 @@ def resident_to_arrays(flat: jax.Array, key_table: jax.Array,
         "drop_cause": drop_cause,
     }
     arrays = {k: jnp.concatenate([comp[k], spill[k]], axis=0) for k in comp}
-    return arrays, key_table
+    return arrays, key_tables
 
 
 def init_key_tables(n_lanes: int, slot_cap: int) -> jax.Array:
@@ -625,20 +724,38 @@ def resident_lane_arrays(flat: jax.Array, key_tables: jax.Array,
     contract (flowpack.cc fp_pack_resident <-> flowpack.pack_resident <->
     resident_to_arrays) is unchanged PER REGION — this only loops it and
     concatenates the resulting fixed-shape columns, so the jitted caller
-    still never retraces. Returns (arrays, new_key_tables)."""
+    still never retraces. Returns (arrays, new_key_tables).
+
+    `key_tables` may carry MORE rows than `n_lanes` (the superbatch fold
+    ladder: every ladder entry shares ONE per-region table array sized for
+    the largest superbatch; a smaller entry scatters only into its leading
+    regions' rows). EVERY region's new-key lane applies as one combined
+    scatter on the shared donated array before any hot-row gather — XLA
+    keeps that single scatter in place, where a per-region scatter/gather
+    chain was measured to copy the full table array once per region; the
+    within-region "new keys land before hot rows reference them" ordering
+    is preserved because all scatters precede all gathers and lanes are
+    row-disjoint."""
     words = _resident_region_words(batch_per_lane, caps)
-    lanes, tables = [], []
-    for i in range(n_lanes):
-        arrays, tbl = resident_to_arrays(
-            flat[i * words:(i + 1) * words], key_tables[i], batch_per_lane,
-            caps)
+    regions = [flat[i * words:(i + 1) * words] for i in range(n_lanes)]
+    slot_cap = key_tables.shape[-2]
+    nk_parts = [_region_nk(r, batch_per_lane, caps, slot_cap)
+                for r in regions]
+    lane_ids = jnp.concatenate([
+        jnp.full((caps.nk,), i, jnp.int32) for i in range(n_lanes)])
+    key_tables = key_tables.at[
+        lane_ids, jnp.concatenate([s for s, _ in nk_parts])].set(
+        jnp.concatenate([w for _, w in nk_parts]), mode="drop")
+    lanes = []
+    for i, r in enumerate(regions):
+        arrays, key_tables = _resident_region_arrays(
+            r, key_tables, batch_per_lane, caps, lane=i, nk_applied=True)
         lanes.append(arrays)
-        tables.append(tbl)
     if n_lanes == 1:
-        return lanes[0], tables[0][None]
+        return lanes[0], key_tables
     out = {k: jnp.concatenate([a[k] for a in lanes], axis=0)
            for k in lanes[0]}
-    return out, jnp.stack(tables)
+    return out, key_tables
 
 
 def make_ingest_resident_lanes_fn(batch_per_lane: int, caps, n_lanes: int,

@@ -1,0 +1,118 @@
+"""One-pass multi-seed hashing (`ops/hashing.base_hashes_multi`): the fused
+sweep must be BIT-IDENTICAL to the five separate `base_hashes` calls it
+replaced in `sketch.state.ingest` — the seeds stay the single source of
+truth, and every victim-bucket consumer (device ingest AND the exporter's
+numpy host twins) keys off the same values.
+
+The numpy-twin + golden-vector tests are deliberately jax-free: they run on
+the big-endian qemu CI tier (s390x, ci.yml `layout-multiarch`), where an
+endianness slip in the shared k-mix would drift silently otherwise — the
+multi-hash output feeds the host-side numpy twins."""
+
+import importlib.util
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+
+from netobserv_tpu.ops import hashing
+
+KW = 10
+
+needs_jax = pytest.mark.skipif(importlib.util.find_spec("jax") is None,
+                               reason="jax unavailable (qemu tier)")
+
+
+def _words(n: int = 513, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 2**32, (n, KW), dtype=np.uint32)
+
+
+@needs_jax
+def test_multi_matches_separate_base_hashes_bit_exact():
+    import jax.numpy as jnp
+
+    words = jnp.asarray(_words())
+    mh = hashing.base_hashes_multi(words)
+    h1, h2 = hashing.base_hashes(words)
+    src_h1, src_h2 = hashing.base_hashes(words[:, 0:4],
+                                         seed=hashing.SRC_BUCKET_SEED)
+    dst_h1, _ = hashing.base_hashes(words[:, 4:8],
+                                    seed=hashing.DST_BUCKET_SEED)
+    dp_cols = jnp.concatenate(
+        [words[:, 4:8], (words[:, 8] & jnp.uint32(0xFFFF))[:, None]], axis=1)
+    dp_h1, dp_h2 = hashing.base_hashes(dp_cols,
+                                       seed=hashing.DSTPORT_FANOUT_SEED)
+    src_sym, _ = hashing.base_hashes(words[:, 0:4],
+                                     seed=hashing.DST_BUCKET_SEED)
+    expect = {"h1": h1, "h2": h2, "src_h1": src_h1, "src_h2": src_h2,
+              "dst_h1": dst_h1, "dp_h1": dp_h1, "dp_h2": dp_h2,
+              "src_sym": src_sym}
+    for name, want in expect.items():
+        np.testing.assert_array_equal(np.asarray(getattr(mh, name)),
+                                      np.asarray(want), err_msg=name)
+
+
+@needs_jax
+def test_numpy_twin_matches_jax_multi():
+    import jax.numpy as jnp
+
+    words = _words(n=257, seed=11)
+    mh = hashing.base_hashes_multi(jnp.asarray(words))
+    twin = hashing.base_hashes_multi_np(words)
+    for name, got in twin.items():
+        np.testing.assert_array_equal(got, np.asarray(getattr(mh, name)),
+                                      err_msg=name)
+
+
+def test_numpy_twin_matches_legacy_numpy_twin():
+    """jax-free: the fused numpy sweep's h1 families must equal the
+    existing `hash_words_np` host twin under the same seeds (the exporter's
+    victim-bucket naming path)."""
+    words = _words(n=100, seed=3)
+    twin = hashing.base_hashes_multi_np(words)
+    np.testing.assert_array_equal(twin["h1"], hashing.hash_words_np(words))
+    np.testing.assert_array_equal(
+        twin["src_h1"],
+        hashing.hash_words_np(words[:, 0:4], seed=hashing.SRC_BUCKET_SEED))
+    np.testing.assert_array_equal(
+        twin["dst_h1"],
+        hashing.hash_words_np(words[:, 4:8], seed=hashing.DST_BUCKET_SEED))
+    np.testing.assert_array_equal(
+        twin["src_sym"],
+        hashing.hash_words_np(words[:, 0:4], seed=hashing.DST_BUCKET_SEED))
+
+
+# golden vectors captured on little-endian x86-64; words are a fixed
+# arithmetic pattern so no RNG-version drift can perturb the fixture
+_GOLDEN_WORDS = (np.arange(30, dtype=np.uint32).reshape(3, KW)
+                 * np.uint32(0x9E3779B1) + np.uint32(12345))
+_GOLDEN = {
+    "h1": (0xb57d0400, 0x18c25346, 0x29e8c841),
+    "h2": (0x981175b3, 0x6912363, 0x4fe3936f),
+    "src_h1": (0x536ad683, 0x1f3caec1, 0xdeffa36a),
+    "src_h2": (0xfc8f853f, 0x88b1a6ab, 0xdabc108d),
+    "dst_h1": (0x8d4f57da, 0x50dd4f8f, 0x2bca5809),
+    "dp_h1": (0x82695154, 0x502c41d8, 0x6fbd3efd),
+    "dp_h2": (0xe9fd7fef, 0xd2bbbff3, 0x4e7885a9),
+    "src_sym": (0x3c8f4557, 0xd0c6ebda, 0x6e49046b),
+}
+
+
+def test_numpy_twin_golden_vectors():
+    """jax-free, endianness-sensitive: asserted byte-for-byte on the
+    big-endian qemu tier too. A byte-order bug in the fused k-mix (or in
+    the dst-port extraction `word8 & 0xFFFF`) lands exactly here."""
+    got = hashing.base_hashes_multi_np(_GOLDEN_WORDS)
+    for name, want in _GOLDEN.items():
+        np.testing.assert_array_equal(
+            got[name], np.array(want, np.uint32), err_msg=name)
+
+
+def test_h2_families_are_odd():
+    """Kirsch-Mitzenmacher stride requirement: every h2 family is forced
+    odd so strides generate Z_{2^k} (jax-free via the twin)."""
+    twin = hashing.base_hashes_multi_np(_words(n=64, seed=5))
+    for name in ("h2", "src_h2", "dp_h2"):
+        assert (twin[name] & 1).all(), name

@@ -1,0 +1,290 @@
+"""Superbatch fold coalescing at the seams (ISSUE-4 tentpole 3).
+
+k queued batches folded as ONE ladder superbatch must produce the same
+sketch state as k sequential per-batch folds — including sampling de-bias,
+feature-lane liveness through `PendingEventBuffer`, and the padded-tail
+mask — and NO ladder shape may ever retrace post-warmup.
+
+State comparison: every leaf is pinned bit-exact except the top-K table,
+which is compared as a SET of (key, count) — a superbatch scores all its
+candidates against the fully-updated Count-Min in one `topk.update` while
+the sequential path re-scores incrementally, so slot ORDER (top_k tie
+ranks) may differ while the surviving keys and their final CM estimates
+are identical."""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401
+import jax
+
+from netobserv_tpu.datapath import flowpack
+from netobserv_tpu.datapath.fetcher import EvictedFlows
+from netobserv_tpu.model import binfmt
+from netobserv_tpu.sketch import staging, state as sk
+from netobserv_tpu.utils import retrace
+
+pytestmark = pytest.mark.skipif(
+    not flowpack.build_native(), reason="native flowpack build unavailable")
+
+B = 256
+CFG = sk.SketchConfig(cm_width=1 << 12, topk=512, hll_precision=8,
+                      perdst_buckets=64, perdst_precision=4,
+                      persrc_buckets=64, persrc_precision=4,
+                      hist_buckets=128, ewma_buckets=256)
+
+
+def make_events(n, seed=0, sampling=0):
+    rng = np.random.default_rng(seed)
+    ev = np.zeros(n, binfmt.FLOW_EVENT_DTYPE)
+    # small distinct-key universe: the top-K table (512) holds every key,
+    # so both fold orders converge to the same key set deterministically
+    keys = rng.integers(0, 40, n)
+    ev["key"]["src_ip"][:, 10] = 0xFF
+    ev["key"]["src_ip"][:, 11] = 0xFF
+    ev["key"]["src_ip"][:, 12] = 10
+    ev["key"]["src_ip"][:, 15] = keys
+    ev["key"]["dst_ip"][:] = ev["key"]["src_ip"]
+    ev["key"]["dst_ip"][:, 12] = 20
+    ev["key"]["src_port"] = 1000 + keys
+    ev["key"]["dst_port"] = 443
+    ev["key"]["proto"] = 6
+    ev["stats"]["bytes"] = rng.integers(64, 1500, n)
+    ev["stats"]["packets"] = rng.integers(1, 4, n)
+    ev["stats"]["eth_protocol"] = 0x0800
+    ev["stats"]["if_index_first"] = 1
+    ev["stats"]["sampling"] = sampling
+    ev["stats"]["tcp_flags"] = rng.integers(0, 1 << 9, n)
+    ev["stats"]["dscp"] = rng.integers(0, 64, n)
+    return ev
+
+
+def make_feats(n, seed=1):
+    rng = np.random.default_rng(seed)
+    ex = np.zeros(n, binfmt.EXTRA_REC_DTYPE)
+    ex["rtt_ns"] = rng.integers(0, 5_000_000, n)
+    dn = np.zeros(n, binfmt.DNS_REC_DTYPE)
+    dn["latency_ns"][rng.random(n) < 0.2] = 1_000_000
+    dr = np.zeros(n, binfmt.DROPS_REC_DTYPE)
+    hit = rng.random(n) < 0.1
+    dr["bytes"] = np.where(hit, 900, 0)
+    dr["packets"] = hit
+    dr["latest_cause"] = np.where(hit, 5, 0)
+    return {"extra": ex, "dns": dn, "drops": dr}
+
+
+def _make_ring(ladder=(1, 2, 4), lanes=1, slot_cap=1 << 12):
+    caps = flowpack.default_resident_caps(B // lanes)
+    ingests = {k: sk.make_ingest_resident_lanes_fn(
+        B // lanes, caps, k * lanes, donate=True) for k in ladder}
+    return staging.ShardedResidentStagingRing(
+        B, 1, ingests,
+        key_tables=jax.device_put(
+            sk.init_key_tables(max(ladder) * lanes, slot_cap)),
+        put=jax.device_put, caps=caps, slot_cap=slot_cap, lanes=lanes,
+        ladder=ladder)
+
+
+def assert_states_equal(a: sk.SketchState, b: sk.SketchState):
+    """Bit-exact on every leaf; top-K compared as a (key words, count)
+    set (see module docstring)."""
+    for field in sk.SketchState._fields:
+        if field == "heavy":
+            continue
+        la, lb = getattr(a, field), getattr(b, field)
+        leaves_a, leaves_b = jax.tree.leaves(la), jax.tree.leaves(lb)
+        for xa, xb in zip(leaves_a, leaves_b):
+            np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb),
+                                          err_msg=field)
+    def heavy_set(s):
+        # dist states carry (data, sketch) lead dims on the top-K table —
+        # flatten to rows before the set compare
+        words = np.asarray(s.heavy.words).reshape(-1, sk.KEY_WORDS)
+        counts = np.asarray(s.heavy.counts).reshape(-1)
+        valid = np.asarray(s.heavy.valid).reshape(-1)
+        return {(tuple(w), float(c)) for w, c, v in
+                zip(words, counts, valid) if v}
+
+    assert heavy_set(a) == heavy_set(b)
+
+
+def test_superbatch_equals_sequential_folds():
+    """4 batches as ONE 4x superbatch == 4 sequential 1x folds, features
+    included, bit-exact outside the top-K slot order."""
+    n = 4 * B
+    ev, feats = make_events(n, seed=3), make_feats(n, seed=4)
+    ring_sb = _make_ring(ladder=(1, 2, 4))
+    ring_seq = _make_ring(ladder=(1,))
+    s_sb = ring_sb.fold(sk.init_state(CFG), ev, **feats)
+    ring_sb.drain()
+    s_seq = sk.init_state(CFG)
+    for i in range(4):
+        s_seq = ring_seq.fold(
+            s_seq, ev[i * B:(i + 1) * B],
+            **{k: v[i * B:(i + 1) * B] for k, v in feats.items()})
+    ring_seq.drain()
+    assert ring_sb.superbatch_folds.get(4, 0) >= 1
+    assert ring_seq.superbatch_folds.get(1, 0) >= 4
+    assert_states_equal(s_sb, s_seq)
+
+
+def test_superbatch_equals_sequential_with_lanes():
+    """Same equivalence with 2 pack lanes per batch (region layout k*lanes)."""
+    n = 2 * B
+    ev, feats = make_events(n, seed=5), make_feats(n, seed=6)
+    ring_sb = _make_ring(ladder=(1, 2), lanes=2)
+    ring_seq = _make_ring(ladder=(1,), lanes=2)
+    s_sb = ring_sb.fold(sk.init_state(CFG), ev, **feats)
+    ring_sb.drain()
+    s_seq = sk.init_state(CFG)
+    for i in range(2):
+        s_seq = ring_seq.fold(
+            s_seq, ev[i * B:(i + 1) * B],
+            **{k: v[i * B:(i + 1) * B] for k, v in feats.items()})
+    ring_seq.drain()
+    assert ring_sb.superbatch_folds.get(2, 0) >= 1
+    assert_states_equal(s_sb, s_seq)
+
+
+def test_superbatch_padded_tail_and_mixed_sampling():
+    """A non-multiple row count (padded-tail mask) with MIXED per-row
+    sampling factors (de-bias must ride the spill lane for rows whose
+    sampling differs from the region default) folds identically."""
+    n = 2 * B + 57
+    ev = make_events(n, seed=7)
+    rng = np.random.default_rng(8)
+    ev["stats"]["sampling"] = np.where(rng.random(n) < 0.3, 10, 0)
+    ring_sb = _make_ring(ladder=(1, 2, 4))
+    ring_seq = _make_ring(ladder=(1,))
+    s_sb = ring_sb.fold(sk.init_state(CFG), ev)
+    ring_sb.drain()
+    s_seq = sk.init_state(CFG)
+    for lo in range(0, n, B):
+        s_seq = ring_seq.fold(s_seq, ev[lo:lo + B])
+    ring_seq.drain()
+    assert_states_equal(s_sb, s_seq)
+    # de-bias really happened: sampled rows count x10
+    plain = make_events(n, seed=7)
+    ring_p = _make_ring(ladder=(1,))
+    s_plain = ring_p.fold(sk.init_state(CFG), plain)
+    ring_p.drain()
+    assert float(s_sb.total_bytes) > float(s_plain.total_bytes) * 2
+
+
+def test_pending_buffer_coalesces_and_preserves_lane_liveness():
+    """Exporter-level seam: the SAME eviction stream — mixed lane-carrying
+    and lane-less evictions, ragged sizes — through a coalescing exporter
+    (ladder 1,2,4) and a non-coalescing one (ladder 1) ends in the same
+    state; the coalescing one dispatched superbatches."""
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+
+    def evictions():
+        out = []
+        for i in range(11):
+            # 700 rows in one eviction -> multi-batch arrivals that the
+            # coalescing exporter folds as ladder superbatches
+            n = (97, 700, 301)[i % 3]
+            ev = make_events(n, seed=20 + i, sampling=(0, 4)[i % 2])
+            feats = make_feats(n, seed=40 + i)
+            if i % 3 == 0:
+                out.append(EvictedFlows(ev, **feats))  # all lanes live
+            elif i % 3 == 1:
+                out.append(EvictedFlows(ev, drops=feats["drops"]))
+            else:
+                out.append(EvictedFlows(ev))           # lane-less
+        return out
+
+    # per-device PARTIALS legitimately differ between the two paths (rows
+    # land on data shards by position, and a 4x superbatch splits them
+    # differently than four 1x folds — these tests run on the 8-virtual-
+    # device mesh), so equivalence is pinned on the MERGED window report:
+    # every signal it carries (totals, CM-scored heavy hitters,
+    # cardinalities, quantiles, z-scores, conv/dscp/cause planes) must be
+    # identical — masses are integers, so even float sums are exact
+    reports = {}
+    folds = {}
+    for name, ladder in (("sb", (1, 2, 4)), ("seq", (1,))):
+        got = []
+        exp = TpuSketchExporter(batch_size=B, window_s=3600, sketch_cfg=CFG,
+                                sink=got.append, superbatch=ladder)
+        # the exporter's ladder is lazy: entries > 1 engage only once
+        # warmed (a cold entry must never compile inside a live fold)
+        exp.warm_superbatch_ladder(block=True)
+        for ev in evictions():
+            exp.export_evicted(ev)
+        exp.flush()
+        folds[name] = dict(exp._ring.superbatch_folds)
+        exp.close()
+        rep = got[0]
+        rep.pop("TimestampMs")
+        rep["HeavyHitters"] = sorted(
+            rep["HeavyHitters"], key=lambda h: sorted(h.items()))
+        reports[name] = rep
+    assert any(k > 1 for k in folds["sb"]), folds["sb"]
+    assert set(folds["seq"]) == {1}
+    assert reports["sb"] == reports["seq"]
+
+
+def test_zero_retraces_across_ladder():
+    """Watchdog-verified: folding every ladder size (plus ragged tails and
+    continuation chunks) compiles each ladder entry exactly once — zero
+    post-warmup retraces across the whole ladder, and the warm path
+    pre-compiles every shape so real traffic never compiles at all."""
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+
+    exp = TpuSketchExporter(batch_size=B, window_s=3600, sketch_cfg=CFG,
+                            sink=lambda rep: None, superbatch=(1, 2, 4))
+    exp.warm_superbatch_ladder(block=True)
+    # single-device names ingest_resident_lanes_x{k}; the 8-virtual-device
+    # mesh (tests/conftest.py) names sharded_ingest_resident_x{k}
+    prefixes = ("ingest_resident_lanes_x", "sharded_ingest_resident_x")
+
+    def ladder_watched():
+        return {w["fn"]: w for w in retrace.snapshot()
+                if w["fn"].startswith(prefixes)}
+
+    watched = ladder_watched()
+    assert {fn[-2:] for fn in watched} >= {"x1", "x2", "x4"}, set(watched)
+    for fn, w in watched.items():
+        # x1 is always selectable, so warm deliberately SKIPS it (a live
+        # fold could be tracing it concurrently); it compiles at first use
+        if not fn.endswith("x1"):
+            assert w["calls"] >= 1, w  # the warm call
+    # sizes chosen so capacity fills fire 4x folds and the final drain
+    # holds ~600 rows — a 2x chunk plus a padded 1x tail
+    for size in (4 * B, B, 2 * B, 4 * B, 2 * B + 31, 4 * B, 313):
+        exp.export_evicted(EvictedFlows(make_events(size, seed=size)))
+    with exp._lock:
+        exp._drain_pending_locked()
+    exp._ring.drain()
+    assert {k for k in exp._ring.superbatch_folds} >= {1, 2, 4}
+    for w in ladder_watched().values():
+        assert w["retraces"] == 0, w
+        # ONE compile per fixed shape, ever — the warm call's
+        assert w["compiles"] <= 1, w
+    exp.close()
+
+
+def test_pending_buffer_coalesces_arrivals_keeps_tails():
+    """Rows that arrive together fold as ONE batch-aligned superbatch
+    prefix; the sub-batch tail stays buffered; a capacity fill flushes."""
+    got = []
+    buf = staging.PendingEventBuffer(64, superbatch_max=4)
+    assert buf.capacity == 256
+    ev = make_events(200, seed=1)
+    buf.append(EvictedFlows(ev), lambda e, f: got.append(len(e)))
+    # 200 rows arrived together -> one 192-row (3-batch) superbatch fold,
+    # 8-row tail kept for the next eviction
+    assert got == [192] and len(buf) == 8
+    buf.append(EvictedFlows(ev), lambda e, f: got.append(len(e)))
+    assert got == [192, 192] and len(buf) == 16
+    buf.append(EvictedFlows(make_events(30, seed=2)),
+               lambda e, f: got.append(len(e)))
+    assert got == [192, 192] and len(buf) == 46  # below a batch: deferred
+    buf.flush_to(lambda e, f: got.append(len(e)))
+    assert got == [192, 192, 46] and len(buf) == 0
+    # a single eviction larger than capacity flushes at the fill mark
+    got.clear()
+    buf.append(EvictedFlows(make_events(300, seed=3)),
+               lambda e, f: got.append(len(e)))
+    assert got == [256] and len(buf) == 44
