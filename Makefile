@@ -39,6 +39,12 @@ bench-host-traced:
 bench-device:
 	JAX_PLATFORMS=cpu $(PY) bench.py --device-only
 
+# eviction-plane decode rates (~10s, jax-free path): columnar
+# decode/merge/align vs the per-key idiom on synthetic multi-CPU drains —
+# the per-PR CI artifact for the userspace eviction half
+bench-evict:
+	JAX_PLATFORMS=cpu $(PY) bench.py --evict-only
+
 gen-protobuf:
 	protoc --python_out=netobserv_tpu/pb -I proto proto/flow.proto proto/packet.proto
 

@@ -547,6 +547,183 @@ def device_stage_stats() -> dict:
     return out
 
 
+def _evict_synth(n_flows: int, n_cpus: int, rng) -> tuple:
+    """Synthetic multi-CPU drain buffers: agg keys/stats + per-CPU feature
+    partials with a live-traffic mix (extra on every flow, DNS on ~5%,
+    drops on ~2%, a sprinkle of multi-interface rows, ~1% ringbuf-orphan
+    feature keys absent from the aggregation drain)."""
+    from netobserv_tpu.model import binfmt
+
+    def keys_u8(n, port_base):
+        k = np.zeros(n, binfmt.FLOW_KEY_DTYPE)
+        k["src_ip"] = rng.integers(0, 256, (n, 16))
+        k["dst_ip"] = rng.integers(0, 256, (n, 16))
+        k["src_port"] = (port_base + np.arange(n)) & 0xFFFF
+        k["dst_port"] = 443
+        k["proto"] = 6
+        return np.frombuffer(k.tobytes(), np.uint8).reshape(n, 40).copy()
+
+    agg_keys = keys_u8(n_flows, 0)
+    stats = np.zeros((n_flows, 1), binfmt.FLOW_STATS_DTYPE)
+    s = stats[:, 0]
+    s["bytes"] = rng.integers(64, 10**6, n_flows)
+    s["packets"] = rng.integers(1, 1000, n_flows)
+    s["first_seen_ns"] = rng.integers(1, 10**9, n_flows)
+    s["last_seen_ns"] = s["first_seen_ns"] + rng.integers(1, 10**9, n_flows)
+    s["tcp_flags"] = rng.integers(0, 0x200, n_flows)
+    s["n_observed_intf"] = 1
+    s["observed_intf"][:, 0] = rng.integers(1, 8, n_flows)
+
+    def percpu(dtype, m, fill):
+        v = np.zeros((m, n_cpus), dtype)
+        fill(v)
+        v["first_seen_ns"] = rng.integers(1, 10**9, (m, n_cpus))
+        v["last_seen_ns"] = rng.integers(10**9, 2 * 10**9, (m, n_cpus))
+        return v
+
+    n_orph = max(n_flows // 100, 1)
+    orph_keys = keys_u8(n_orph, 1 << 15)
+    ex_keys = np.concatenate([agg_keys, orph_keys])
+    extra = percpu(binfmt.EXTRA_REC_DTYPE, n_flows + n_orph, lambda v: v.__setitem__(
+        "rtt_ns", rng.integers(0, 10**7, v["rtt_ns"].shape)))
+    n_dns = max(n_flows // 20, 1)
+    dns_keys = agg_keys[:n_dns]
+    dns = percpu(binfmt.DNS_REC_DTYPE, n_dns, lambda v: v.__setitem__(
+        "latency_ns", rng.integers(0, 10**7, v["latency_ns"].shape)))
+    n_drop = max(n_flows // 50, 1)
+    drop_keys = agg_keys[n_flows - n_drop:]
+    drops = percpu(binfmt.DROPS_REC_DTYPE, n_drop, lambda v: (
+        v.__setitem__("bytes", rng.integers(0, 1500, v["bytes"].shape)),
+        v.__setitem__("packets", rng.integers(0, 3, v["packets"].shape))))
+    features = {"extra": (ex_keys, extra), "dns": (dns_keys, dns),
+                "drops": (drop_keys, drops)}
+    return agg_keys, stats, features
+
+
+def _evict_perkey_reference(agg_keys, stats, features):
+    """The pre-columnar eviction decode, verbatim (row-at-a-time python:
+    per-key merge_percpu ctypes round trips, per-key np.frombuffer, a dict
+    for key alignment, and the b''.join interleave copy) — the bench
+    baseline the columnar plane is measured against."""
+    from netobserv_tpu.datapath import flowpack
+    from netobserv_tpu.model import binfmt
+
+    pairs = [(agg_keys[i].tobytes(), stats[i, 0].tobytes())
+             for i in range(len(agg_keys))]
+    events = binfmt.decode_flow_events(
+        b"".join(k + v for k, v in pairs)).copy()
+    key_order = {k: i for i, (k, _v) in enumerate(pairs)}
+    extra_rows = []
+    drained = {}
+    for attr, (fkeys, fvals) in features.items():
+        rows = []
+        for i in range(len(fkeys)):
+            key = fkeys[i].tobytes()
+            partials = np.frombuffer(fvals[i].tobytes(), dtype=fvals.dtype)
+            rec = flowpack.merge_percpu(attr, partials)
+            rows.append((key, rec))
+            if key not in key_order:
+                extra_rows.append((key, attr, rec))
+        drained[attr] = rows
+    if extra_rows:
+        appended = np.zeros(len(extra_rows), dtype=binfmt.FLOW_EVENT_DTYPE)
+        for j, (key, _attr, rec) in enumerate(extra_rows):
+            appended[j]["key"] = np.frombuffer(
+                key, dtype=binfmt.FLOW_KEY_DTYPE)[0]
+            st = appended[j]["stats"]
+            st["first_seen_ns"] = rec["first_seen_ns"]
+            st["last_seen_ns"] = rec["last_seen_ns"]
+            key_order[key] = len(events) + j
+        events = np.concatenate([events, appended])
+    n = len(events)
+    out = {}
+    for attr, rows in drained.items():
+        merged = np.zeros(n, dtype=features[attr][1].dtype)
+        for key, rec in rows:
+            merged[key_order[key]] = rec
+        out[attr] = merged
+    return events, out
+
+
+def evict_stats(flow_counts=(10_000, 100_000), n_cpus: int = 8,
+                seconds: float = 1.5) -> dict:
+    """`--evict-only` / `make bench-evict`: eviction-plane decode rates on
+    synthetic multi-CPU drains — the columnar plane (whole-array decode,
+    fp_merge_*_batch, searchsorted alignment) vs the per-key idiom it
+    replaced, with the columnar per-stage split (decode / merge / align).
+    The ISSUE-5 acceptance bar is columnar >= 10x per-key at 100k x 8."""
+    from netobserv_tpu.datapath import flowpack, loader
+
+    flowpack.build_native()
+    out: dict = {"metric": "evict_decode_records_per_sec",
+                 "unit": "records/s", "evict_n_cpus": n_cpus,
+                 "evict_native": flowpack.native_available(),
+                 "evict_counts": {}}
+    for n_flows in flow_counts:
+        rng = np.random.default_rng(17)
+        agg_keys, stats, features = _evict_synth(n_flows, n_cpus, rng)
+        # total records a drain decodes: agg rows + per-CPU feature rows
+        n_feat = sum(len(k) for k, _ in features.values())
+        n_rec = n_flows + n_feat
+
+        # columnar: the shipped decode (loader.decode_eviction), fed from
+        # raw buffers each round like the batch drain hands them over
+        kraw = agg_keys.tobytes()
+        sraw = stats.tobytes()
+        fraw = {attr: (fk.tobytes(), fv.tobytes(), fv.shape, fv.dtype)
+                for attr, (fk, fv) in features.items()}
+
+        def run_columnar():
+            ak = np.frombuffer(kraw, np.uint8).reshape(n_flows, 40)
+            av = np.frombuffer(sraw, dtype=stats.dtype).reshape(n_flows, 1)
+            dr = {attr: (np.frombuffer(kb, np.uint8).reshape(-1, 40),
+                         np.frombuffer(vb, dtype=dt).reshape(shape))
+                  for attr, (kb, vb, shape, dt) in fraw.items()}
+            return loader.decode_eviction(ak, av, dr)
+
+        ev = run_columnar()  # warm
+        reps = 0
+        merge_s = align_s = 0.0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            ev = run_columnar()
+            merge_s += ev.decode_stats["merge_s"]
+            align_s += ev.decode_stats["align_s"]
+            reps += 1
+        dt = time.perf_counter() - t0
+        col_rate = reps * n_rec / dt
+
+        # per-key reference: one pass is enough (deterministic CPU loop)
+        t0 = time.perf_counter()
+        pk_events, pk_feats = _evict_perkey_reference(agg_keys, stats,
+                                                      features)
+        pk_dt = time.perf_counter() - t0
+        pk_rate = n_rec / pk_dt
+        # sanity: both paths agree on row counts and total aligned volume
+        assert len(pk_events) == len(ev.events), "row-count drift"
+        assert int(pk_feats["extra"]["rtt_ns"].astype(np.uint64).sum()) == \
+            int(ev.extra["rtt_ns"].astype(np.uint64).sum()), "merge drift"
+
+        out["evict_counts"][str(n_flows)] = {
+            "records": n_rec,
+            "columnar_records_per_sec": round(col_rate),
+            "perkey_records_per_sec": round(pk_rate),
+            "speedup": round(col_rate / pk_rate, 1),
+            "decode_ms": round((dt / reps - (merge_s + align_s) / reps)
+                               * 1e3, 3),
+            "merge_ms": round(merge_s / reps * 1e3, 3),
+            "align_ms": round(align_s / reps * 1e3, 3),
+        }
+        print(f"evict {n_flows}x{n_cpus}: columnar "
+              f"{col_rate / 1e6:.2f}M rec/s vs per-key "
+              f"{pk_rate / 1e6:.3f}M rec/s "
+              f"({col_rate / pk_rate:.0f}x)", file=sys.stderr)
+    biggest = str(max(flow_counts))
+    out["value"] = out["evict_counts"][biggest]["columnar_records_per_sec"]
+    out["evict_speedup"] = out["evict_counts"][biggest]["speedup"]
+    return out
+
+
 def roll_stall_stats(run_s: float = 3.2, sink_block_s: float = 0.5) -> dict:
     """Fold latency ACROSS a window roll vs steady state, with a sink that
     blocks `sink_block_s` per report — the non-blocking-roll evidence: the
@@ -678,6 +855,12 @@ def main():
         if _DEVICE_NOTE:
             out["device"] = _DEVICE_NOTE
         print(json.dumps(out))
+        return
+    if "--evict-only" in sys.argv:
+        # `make bench-evict` (~10s, CPU-only): eviction-plane decode rates —
+        # columnar vs the per-key idiom + per-stage split; the non-gating
+        # CI artifact next to bench-host/bench-device
+        print(json.dumps(evict_stats()))
         return
     if "--host-only" in sys.argv:
         # `make bench-host` (~15s): host path + roll stall only, no device

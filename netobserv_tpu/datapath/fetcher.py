@@ -24,7 +24,15 @@ class EvictedFlows:
 
     `events` is a FLOW_EVENT structured array (per-CPU partials already
     merged); feature arrays are aligned with `events` rows (or None when the
-    feature is disabled)."""
+    feature is disabled).
+
+    Ownership contract: every array is OWNED by this object — the columnar
+    drain decode reads zero-copy views of the kernel batch buffers, and
+    construction here is the single copy boundary (a later drain must never
+    mutate an earlier EvictedFlows; pinned by the aliasing regression in
+    tests/test_bpfman.py). `decode_stats` carries the producing drain's
+    per-stage seconds (decode/merge/align) when the columnar eviction plane
+    built it; map_tracer feeds it to `eviction_decode_seconds`."""
 
     def __init__(self, events: np.ndarray,
                  dns: Optional[np.ndarray] = None,
@@ -40,6 +48,7 @@ class EvictedFlows:
         self.xlat = xlat
         self.nevents = nevents
         self.quic = quic
+        self.decode_stats: Optional[dict] = None
 
     def __len__(self) -> int:
         return len(self.events)

@@ -44,7 +44,7 @@ class _Columns(ctypes.Structure):
 _lib: Optional[ctypes.CDLL] = None
 
 
-_ABI_VERSION = 7
+_ABI_VERSION = 8
 
 #: dense TPU-feed row width (words); layout documented in flowpack.cc
 DENSE_WORDS = 20
@@ -718,14 +718,42 @@ _MERGE_FNS = {
 
 def merge_percpu(kind: str, values: np.ndarray,
                  use_native: Optional[bool] = None) -> np.ndarray:
-    """Merge per-CPU partial records (shape (n_cpu,) structured) into one."""
+    """Merge per-CPU partial records (shape (n_cpu,) structured) into one.
+    Single-key API (the accounter path); drains use merge_percpu_batch."""
     fn_name, dtype, py_fn = _MERGE_FNS[kind]
     values = np.ascontiguousarray(values, dtype=dtype)
     if use_native is None:
         use_native = native_available()
     if use_native and native_available():
         out = np.zeros(1, dtype=dtype)
+        # pass the already-contiguous array pointer — materializing a bytes
+        # object per call doubled the per-flow cost of the old drain loop
         getattr(_lib, fn_name)(
-            values.tobytes(), ctypes.c_size_t(len(values)), _ptr(out))
+            _ptr(values), ctypes.c_size_t(len(values)), _ptr(out))
         return out[0]
     return accumulate.merge_percpu(values, py_fn)
+
+
+def merge_percpu_batch(kind: str, values: np.ndarray,
+                       use_native: Optional[bool] = None) -> np.ndarray:
+    """Merge per-CPU partials for a WHOLE drained map: values shaped
+    (n_keys, n_cpus) structured -> (n_keys,) merged records. Native path is
+    one fp_merge_*_batch call over a single pointer (no per-key ctypes round
+    trips); fallback is the columnar numpy twin in model/accumulate.py.
+    Both are equivalence-pinned against the per-record accumulate_* loop
+    (tests/test_evict_columnar.py)."""
+    fn_name, dtype, _py_fn = _MERGE_FNS[kind]
+    values = np.ascontiguousarray(values, dtype=dtype)
+    if values.ndim != 2:
+        raise ValueError(f"values must be (n_keys, n_cpus), got "
+                         f"{values.shape}")
+    n_keys, n_cpus = values.shape
+    if use_native is None:
+        use_native = native_available()
+    if use_native and native_available() and n_keys:
+        out = np.zeros(n_keys, dtype=dtype)
+        getattr(_lib, fn_name + "_batch")(
+            _ptr(values), ctypes.c_size_t(n_keys), ctypes.c_size_t(n_cpus),
+            _ptr(out))
+        return out
+    return accumulate.COLUMNAR_MERGES[kind](values)

@@ -25,8 +25,11 @@ from netobserv_tpu.datapath import flowpack, syscall_bpf
 from netobserv_tpu.datapath.fetcher import EvictedFlows
 from netobserv_tpu.model import binfmt
 from netobserv_tpu.model.flow import GlobalCounter
+from netobserv_tpu.utils import tracing
 
 log = logging.getLogger("netobserv_tpu.datapath.loader")
+
+_U64_MAX = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
 
 _OBJ_PATH = os.path.join(os.path.dirname(__file__), "native", "build",
                          "flowpath.bpf.o")
@@ -55,7 +58,8 @@ class KernelFetcher:
 
 # (map name, value dtype, EvictedFlows attr) — ALL per-CPU feature maps the
 # fetcher drains at eviction (reference merges every enabled feature map,
-# pkg/tracer/tracer.go:1057-1110, incl. quic_flows at :1098-1110)
+# pkg/tracer/tracer.go:1057-1110, incl. quic_flows at :1098-1110). The attr
+# doubles as the flowpack merge kind.
 _FEATURE_MAPS = [
     ("flows_extra", binfmt.EXTRA_REC_DTYPE, "extra"),
     ("flows_dns", binfmt.DNS_REC_DTYPE, "dns"),
@@ -64,6 +68,201 @@ _FEATURE_MAPS = [
     ("flows_xlat", binfmt.XLAT_REC_DTYPE, "xlat"),
     ("flows_quic", binfmt.QUIC_REC_DTYPE, "quic"),
 ]
+
+
+# ---------------------------------------------------------------------------
+# Columnar eviction plane (docs/architecture.md "Eviction plane"): the drain
+# decodes as whole arrays straight from the batch-syscall buffers, per-CPU
+# partials merge as one native/columnar pass per feature map, and key
+# alignment is a void-view sort/searchsorted join — no per-record Python
+# anywhere. bench.py --evict-only drives decode_eviction directly.
+# ---------------------------------------------------------------------------
+
+_KEY_SIZE = binfmt.FLOW_KEY_DTYPE.itemsize
+_KEY_WORDS64 = _KEY_SIZE // 8
+
+
+def _hash_keys_u64(keys_u8: np.ndarray) -> np.ndarray:
+    """(n, 40) u8 -> (n,) u64 mixing hash — the sort key for the alignment
+    join. numpy sorts/compares of void dtypes go through per-element memcmp
+    (measured ~10x slower than a u64 sort at 100k keys), so the join orders
+    by hash and falls back to an exact lexsort only when a 64-bit collision
+    between DISTINCT keys is detected in the drain (adjacent-group check in
+    _join_keys) — correctness never rides the hash."""
+    w = np.ascontiguousarray(keys_u8).view(np.uint64)  # (n, 5)
+    h = w[:, 0].copy()
+    c1 = np.uint64(0x9E3779B97F4A7C15)
+    c2 = np.uint64(0xC2B2AE3D27D4EB4F)
+    for i in range(1, _KEY_WORDS64):
+        h = (h ^ (w[:, i] * c2)) * c1
+        h ^= h >> np.uint64(29)
+    return h
+
+
+def _join_keys(agg_u8: np.ndarray, blocks: list[np.ndarray]
+               ) -> tuple[list[np.ndarray], list[np.ndarray], np.ndarray]:
+    """Vectorized key-alignment join (replaces the per-drain python dict):
+    one sort over [agg keys | every feature block], group by key, and a
+    segmented forward-fill of the last agg index per group.
+
+    Returns (scatter_idx_per_block, orphan_mask_per_block, appended_keys):
+    scatter_idx maps each feature row to its event row — the agg drain row
+    (LAST occurrence for duplicate agg keys, dict-idiom parity) or
+    len(agg) + appended-row for keys absent from the aggregation drain;
+    appended_keys are the unique orphan keys, one event row each."""
+    n = len(agg_u8)
+    allk = np.concatenate([agg_u8] + blocks)
+    total = len(allk)
+    w = allk.view(np.uint64)                       # (total, 5)
+    h = _hash_keys_u64(allk)
+    order = np.argsort(h, kind="stable")
+    ws = w[order]
+    newk = np.empty(total, bool)
+    newk[0] = True
+    newk[1:] = (ws[1:] != ws[:-1]).any(axis=1)
+    hs = h[order]
+    n_hash_groups = 1 + int((hs[1:] != hs[:-1]).sum())
+    if int(newk.sum()) != n_hash_groups:
+        # distinct keys collided on the 64-bit hash inside ONE drain
+        # (p ~ total^2 / 2^65): hash order may interleave equal keys —
+        # redo with the exact (slower) lexicographic order
+        order = np.lexsort(tuple(w[:, i]
+                                 for i in range(_KEY_WORDS64 - 1, -1, -1)))
+        ws = w[order]
+        newk[0] = True
+        newk[1:] = (ws[1:] != ws[:-1]).any(axis=1)
+    g = np.cumsum(newk) - 1
+    # last-agg-index forward fill, reset at group boundaries: offset each
+    # group into its own disjoint value range so maximum.accumulate can
+    # never leak a previous group's index (-1 = no agg row yet)
+    val = np.where(order < n, order, -1).astype(np.int64)
+    span = np.int64(n + 1)
+    fill = np.maximum.accumulate(g * span + val + 1) - g * span - 1
+    match = np.empty(total, np.int64)
+    match[order] = fill
+    g_orig = np.empty(total, np.int64)
+    g_orig[order] = g
+    feat_match = match[n:]
+    feat_g = g_orig[n:]
+    orphan = feat_match < 0
+    if orphan.any():
+        uniq_g = np.unique(feat_g[orphan])
+        group_start = np.nonzero(newk)[0]
+        appended_keys = np.ascontiguousarray(
+            ws[group_start[uniq_g]]).view(np.uint8).reshape(-1, _KEY_SIZE)
+        feat_match = feat_match.copy()
+        feat_match[orphan] = n + np.searchsorted(uniq_g, feat_g[orphan])
+    else:
+        appended_keys = np.empty((0, _KEY_SIZE), np.uint8)
+    idx_blocks, orphan_blocks = [], []
+    off = 0
+    for b in blocks:
+        idx_blocks.append(feat_match[off:off + len(b)])
+        orphan_blocks.append(orphan[off:off + len(b)])
+        off += len(b)
+    return idx_blocks, orphan_blocks, appended_keys
+
+
+def _drain_map_arrays(bmap, dtype) -> tuple[np.ndarray, np.ndarray]:
+    """Drain one map -> (keys_u8 (n, key_size), values (n, n_cpus) dtype).
+    Zero-copy from the batch-syscall buffers when the kernel supports batch
+    ops (the arrays may alias bmap's cached buffers — decode_eviction copies
+    once, at the EvictedFlows boundary); falls back to the per-key drain
+    idiom on old kernels."""
+    res = bmap.drain_batched_arrays()
+    if res is not None:
+        keys_u8, vals_u8 = res
+        n = len(keys_u8)
+        pad = bmap._pad_vs
+        if pad == dtype.itemsize:
+            vals = vals_u8.view(dtype)          # (n, n_cpus) — zero-copy
+        else:
+            # non-8-aligned value struct: strip the kernel's padded stride
+            vals = np.ascontiguousarray(
+                vals_u8.reshape(n, bmap.n_cpus, pad)[:, :, :dtype.itemsize]
+            ).view(dtype)[..., 0]
+        return keys_u8, vals
+    pairs = bmap.drain()
+    n = len(pairs)
+    if not n:
+        return (np.empty((0, bmap.key_size), np.uint8),
+                np.empty((0, bmap.n_cpus), dtype))
+    keys_u8 = np.frombuffer(b"".join(k for k, _ in pairs),
+                            np.uint8).reshape(n, bmap.key_size)
+    vals = np.frombuffer(b"".join(v for _, v in pairs),
+                         dtype=dtype).reshape(n, bmap.n_cpus)
+    return keys_u8, vals
+
+
+def decode_eviction(agg_keys: np.ndarray, agg_vals: np.ndarray,
+                    drained: dict[str, tuple[np.ndarray, np.ndarray]],
+                    trace=None) -> EvictedFlows:
+    """Merge + align halves of the columnar eviction plane.
+
+    agg_keys: (n, 40) u8; agg_vals: (n, 1) FLOW_STATS (the aggregation map
+    is not per-CPU); drained: attr -> (keys_u8 (m, 40), partials
+    (m, n_cpus) feature dtype). Inputs may alias kernel drain buffers —
+    every output array is freshly allocated here (the one copy).
+
+    Feature records whose flow is missing from the aggregation drain
+    (ringbuf-fallback flows, or a racing eviction) become standalone
+    appended events so their metrics aren't lost (reference:
+    tracer.go:1138-1143); one appended row per unique orphan key, shared by
+    every feature that saw it, with min/max seen times across them."""
+    trace = trace if trace is not None else tracing.NULL_TRACE
+    t0 = time.perf_counter()
+    with trace.stage("merge_percpu"):
+        merged = {attr: flowpack.merge_percpu_batch(attr, vals)
+                  for attr, (_keys, vals) in drained.items()}
+    t1 = time.perf_counter()
+    with trace.stage("align"):
+        n_agg = len(agg_keys)
+        attrs = [a for a, (k, _v) in drained.items() if len(k)]
+        if attrs:
+            idx_blocks, orphan_blocks, appended_keys = _join_keys(
+                np.ascontiguousarray(agg_keys),
+                [np.ascontiguousarray(drained[a][0]) for a in attrs])
+            joins = {a: (idx_blocks[i], orphan_blocks[i])
+                     for i, a in enumerate(attrs)}
+        else:
+            joins, appended_keys = {}, np.empty((0, _KEY_SIZE), np.uint8)
+        n = n_agg + len(appended_keys)
+        events = binfmt.events_from_keys_stats(
+            agg_keys.view(binfmt.FLOW_KEY_DTYPE).reshape(-1) if n_agg
+            else np.empty(0, binfmt.FLOW_KEY_DTYPE),
+            agg_vals[:, 0] if n_agg else np.empty(0, binfmt.FLOW_STATS_DTYPE),
+            n_total=n)
+        n_app = len(appended_keys)
+        if n_app:
+            events["key"][n_agg:] = appended_keys.view(
+                binfmt.FLOW_KEY_DTYPE).reshape(-1)
+        first_acc = np.full(n_app, _U64_MAX, np.uint64)
+        last_acc = np.zeros(n_app, np.uint64)
+        features: dict[str, Optional[np.ndarray]] = {}
+        for attr in drained:
+            recs = merged[attr]
+            if n == 0 or not len(recs):
+                features[attr] = None
+                continue
+            idx, orphan = joins[attr]
+            if orphan.any():
+                oi = idx[orphan] - n_agg
+                of = recs["first_seen_ns"][orphan]
+                np.minimum.at(first_acc, oi,
+                              np.where(of == 0, _U64_MAX, of))
+                np.maximum.at(last_acc, oi, recs["last_seen_ns"][orphan])
+            out = np.zeros(n, recs.dtype)
+            out[idx] = recs  # duplicate keys across drain chunks: last wins
+            features[attr] = out
+        if n_app:
+            s = events["stats"]
+            s["first_seen_ns"][n_agg:] = np.where(
+                first_acc == _U64_MAX, np.uint64(0), first_acc)
+            s["last_seen_ns"][n_agg:] = last_acc
+    evicted = EvictedFlows(events, **features)
+    evicted.decode_stats = {"merge_s": t1 - t0,
+                            "align_s": time.perf_counter() - t1}
+    return evicted
 
 
 class BpfmanFetcher:
@@ -121,46 +320,22 @@ class BpfmanFetcher:
         return cls(cfg.bpfman_bpf_fs_path)
 
     def lookup_and_delete(self) -> EvictedFlows:
-        pairs = self._agg.drain()
-        # bulk decode: one buffer pass instead of a per-record frombuffer loop
-        events = binfmt.decode_flow_events(
-            b"".join(k + v for k, v in pairs)).copy()
-        key_order = {k: i for i, (k, _v) in enumerate(pairs)}
-        # feature records whose flow is missing from the aggregation drain
-        # (ringbuf-fallback flows, or a racing eviction) become standalone
-        # events so their metrics aren't lost (reference: tracer.go:1138-1143)
-        extra_rows: list[tuple[bytes, str, np.void]] = []
-        drained: dict[str, list[tuple[bytes, np.void]]] = {}
-        for attr, (fmap, dtype) in self._features.items():
-            rows = []
-            for key, value in fmap.drain():
-                partials = np.frombuffer(value, dtype=dtype)  # (n_cpus,)
-                rec = flowpack.merge_percpu(attr, partials)
-                rows.append((key, rec))
-                if key not in key_order:
-                    extra_rows.append((key, attr, rec))
-            drained[attr] = rows
-        if extra_rows:
-            appended = np.zeros(len(extra_rows),
-                                dtype=binfmt.FLOW_EVENT_DTYPE)
-            for j, (key, _attr, rec) in enumerate(extra_rows):
-                appended[j]["key"] = np.frombuffer(
-                    key, dtype=binfmt.FLOW_KEY_DTYPE)[0]
-                s = appended[j]["stats"]
-                s["first_seen_ns"] = rec["first_seen_ns"]
-                s["last_seen_ns"] = rec["last_seen_ns"]
-                key_order[key] = len(events) + j
-            events = np.concatenate([events, appended])
-        n = len(events)
-        features: dict[str, Optional[np.ndarray]] = {}
-        for attr, (_fmap, dtype) in self._features.items():
-            merged = np.zeros(n, dtype=dtype)
-            hit = False
-            for key, rec in drained[attr]:
-                merged[key_order[key]] = rec
-                hit = True
-            features[attr] = merged if (n and hit) else None
-        return EvictedFlows(events, **features)
+        # columnar eviction plane: whole-array drain decode -> one batched
+        # per-CPU merge per feature map -> vectorized key alignment. Child
+        # spans ride the batch trace map_tracer bound for this drain (per
+        # drain, never per record; unsampled drains get the null trace).
+        trace = tracing.active_trace()
+        t0 = time.perf_counter()
+        with trace.stage("decode"):
+            agg_keys, agg_vals = _drain_map_arrays(
+                self._agg, binfmt.FLOW_STATS_DTYPE)
+            drained = {attr: _drain_map_arrays(fmap, dtype)
+                       for attr, (fmap, dtype) in self._features.items()}
+        t1 = time.perf_counter()
+        evicted = decode_eviction(agg_keys, agg_vals, drained, trace=trace)
+        evicted.decode_stats["decode_s"] = t1 - t0
+        evicted.decode_stats["seconds"] = time.perf_counter() - t0
+        return evicted
 
     def read_ringbuf(self, timeout_s: float) -> Optional[bytes]:
         """Consume the map-full fallback ring buffer (mmap reader) — the

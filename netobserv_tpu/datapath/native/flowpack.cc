@@ -789,6 +789,32 @@ void fp_merge_quic(const uint8_t *values, size_t n_cpu, uint8_t *out_buf) {
     std::memcpy(out_buf, &out, sizeof(out));
 }
 
+// ---------------------------------------------------------------------------
+// Batched per-CPU merges: one call for ALL keys of a drained feature map.
+// values: n_keys * n_cpu consecutive record images (the kernel's
+// LOOKUP_AND_DELETE_BATCH value buffer, padding already stripped/absent —
+// every record struct here is 8-byte-aligned so the per-CPU stride equals
+// sizeof); out: n_keys records. One ctypes round trip replaces n_keys of
+// them — the eviction plane's native fast path (columnar python twin:
+// model/accumulate.py COLUMNAR_MERGES; equivalence pinned in
+// tests/test_evict_columnar.py).
+// ---------------------------------------------------------------------------
+#define FP_MERGE_BATCH(name, type)                                          \
+    void name##_batch(const uint8_t *values, size_t n_keys, size_t n_cpu,   \
+                      uint8_t *out) {                                       \
+        for (size_t k = 0; k < n_keys; k++)                                 \
+            name(values + k * n_cpu * sizeof(type), n_cpu,                  \
+                 out + k * sizeof(type));                                   \
+    }
+
+FP_MERGE_BATCH(fp_merge_stats, struct no_flow_stats)
+FP_MERGE_BATCH(fp_merge_extra, struct no_extra_rec)
+FP_MERGE_BATCH(fp_merge_drops, struct no_drops_rec)
+FP_MERGE_BATCH(fp_merge_dns, struct no_dns_rec)
+FP_MERGE_BATCH(fp_merge_nevents, struct no_nevents_rec)
+FP_MERGE_BATCH(fp_merge_xlat, struct no_xlat_rec)
+FP_MERGE_BATCH(fp_merge_quic, struct no_quic_rec)
+
 // crc32c (Castagnoli) — slice-by-8; used by the Kafka record-batch encoder.
 static uint32_t crc32c_table[8][256];
 static bool crc32c_ready = false;
@@ -858,6 +884,6 @@ uint32_t fp_crc32c(const uint8_t *data, size_t n) {
     return crc ^ 0xFFFFFFFFu;
 }
 
-uint32_t fp_abi_version(void) { return 7; }
+uint32_t fp_abi_version(void) { return 8; }
 
 }  // extern "C"

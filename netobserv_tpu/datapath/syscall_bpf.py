@@ -18,6 +18,8 @@ import platform
 import struct
 from typing import Optional
 
+import numpy as np
+
 log = logging.getLogger("netobserv_tpu.datapath.syscall_bpf")
 
 # syscall numbers for bpf(2)
@@ -251,25 +253,34 @@ class BpfMap:
             key = self.next_key(key)
         return out
 
-    def drain_batched(self,
-                      chunk: int = 2048) -> Optional[list[tuple[bytes, bytes]]]:
-        """Bulk eviction via BPF_MAP_LOOKUP_AND_DELETE_BATCH: one syscall per
-        `chunk` entries instead of two per entry — the batched analog of the
-        reference's per-key eviction loop (`tracer.go:1022-1054`) and the
-        host-path seam its own benchmarks call hot. Returns None (latched)
-        when the kernel or map type doesn't support batch ops (< 5.6)."""
+    def drain_batched_arrays(
+            self, chunk: int = 2048
+    ) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """Bulk eviction via BPF_MAP_LOOKUP_AND_DELETE_BATCH, decoded
+        straight from the syscall buffers: returns ``(keys, values)`` u8
+        arrays of shape ``(n, key_size)`` and ``(n, padded_value_stride)``.
+
+        ZERO-COPY CONTRACT: when the drain completes in one syscall round
+        (the steady state — `chunk` is clamped to the map size), the returned
+        arrays are VIEWS of the cached ``_batch_bufs`` storage and are
+        INVALIDATED by the next drain on this map. Callers copy exactly once,
+        at their output boundary (the columnar eviction plane copies at
+        EvictedFlows construction — pinned by the aliasing regression in
+        tests/test_bpfman.py). Multi-round drains concatenate (fresh
+        arrays). Per-CPU values keep the kernel's round_up(value_size, 8)
+        stride; every record dtype in binfmt is 8-aligned, so the stride is
+        normally the plain itemsize.
+
+        Returns None (latched) when the kernel or map type doesn't support
+        batch ops (< 5.6)."""
         if self._no_batch_ops:
             return None
-        # values cross at the padded per-CPU stride (see element ops above);
-        # returned values are re-packed to the unpadded concatenation
-        pad_vs = self._pad_vs
-        vstride = pad_vs * self.n_cpus
+        vstride = self._pad_vs * self.n_cpus
         # no point sizing rounds past the map itself; buffers are cached on
         # the object so steady-state eviction ticks don't re-zero hundreds
         # of KB per drain
         if self.max_entries:
             chunk = min(chunk, self.max_entries)
-        out: list[tuple[bytes, bytes]] = []
         # the batch token is opaque (u32 bucket cursor for hash maps); size
         # it generously and let the kernel use what it needs
         tok_a = ctypes.create_string_buffer(max(self.key_size, 8))
@@ -281,8 +292,27 @@ class BpfMap:
             kbuf = ctypes.create_string_buffer(self.key_size * chunk)
             vbuf = ctypes.create_string_buffer(vstride * chunk)
             self._batch_bufs = (chunk, kbuf, vbuf)
+        done_k: list[np.ndarray] = []  # banked earlier rounds (copies)
+        done_v: list[np.ndarray] = []
+        pend_k = pend_v = None         # latest round: views into kbuf/vbuf
+
+        def result() -> tuple[np.ndarray, np.ndarray]:
+            if not done_k:
+                if pend_k is None:
+                    return (np.empty((0, self.key_size), np.uint8),
+                            np.empty((0, vstride), np.uint8))
+                return pend_k, pend_v  # single round: zero-copy views
+            ks = done_k + ([pend_k] if pend_k is not None else [])
+            vs = done_v + ([pend_v] if pend_v is not None else [])
+            return np.concatenate(ks), np.concatenate(vs)
+
         first = True
         while True:
+            if pend_k is not None:
+                # the buffers are about to be rewritten: bank this round
+                done_k.append(pend_k.copy())
+                done_v.append(pend_v.copy())
+                pend_k = pend_v = None
             attr = bytearray(struct.pack(
                 "=QQQQIIQQ",
                 0 if first else ctypes.addressof(tok_a),
@@ -302,36 +332,50 @@ class BpfMap:
                     vbuf = ctypes.create_string_buffer(vstride * chunk)
                     self._batch_bufs = (chunk, kbuf, vbuf)
                     continue
-                elif (first and not out
+                elif (first and not done_k
                       and exc.errno in (errno.EINVAL, errno.EPERM,
                                         errno.ENOTSUP, ENOTSUPP_KERNEL)):
                     self._no_batch_ops = True
                     return None
-                elif out:
-                    # entries in `out` are already DELETED from the kernel
+                elif done_k:
+                    # banked entries are already DELETED from the kernel
                     # map; raising would lose them for good (the per-key
                     # idiom loses at most one). Return the partial drain —
                     # the remainder is picked up next eviction tick.
                     log.warning(
                         "batched drain aborted mid-iteration after %d "
                         "entries: %s (returning partial result)",
-                        len(out), exc)
-                    return out
+                        sum(len(k) for k in done_k), exc)
+                    return result()
                 else:
                     raise
             count = struct.unpack_from("=I", attr, 32)[0]
-            # one bounded copy per round (count entries), not the whole
-            # chunk-sized buffer
-            kraw = kbuf[:count * self.key_size]
-            vraw = vbuf[:count * vstride]
-            for i in range(count):
-                out.append(
-                    (kraw[i * self.key_size:(i + 1) * self.key_size],
-                     self._unpad_value(vraw[i * vstride:(i + 1) * vstride])))
+            if count:
+                pend_k = np.frombuffer(
+                    kbuf, dtype=np.uint8, count=count * self.key_size
+                ).reshape(count, self.key_size)
+                pend_v = np.frombuffer(
+                    vbuf, dtype=np.uint8, count=count * vstride
+                ).reshape(count, vstride)
             if done or count == 0:
-                return out
+                return result()
             ctypes.memmove(tok_a, tok_b, len(tok_b))
             first = False
+
+    def drain_batched(self,
+                      chunk: int = 2048) -> Optional[list[tuple[bytes, bytes]]]:
+        """Bulk eviction via BPF_MAP_LOOKUP_AND_DELETE_BATCH: one syscall per
+        `chunk` entries instead of two per entry — the batched analog of the
+        reference's per-key eviction loop (`tracer.go:1022-1054`). The pairs
+        view over drain_batched_arrays (values re-packed to the unpadded
+        concatenation); returns None (latched) when the kernel or map type
+        doesn't support batch ops (< 5.6)."""
+        res = self.drain_batched_arrays(chunk)
+        if res is None:
+            return None
+        keys, vals = res
+        return [(keys[i].tobytes(), self._unpad_value(vals[i].tobytes()))
+                for i in range(len(keys))]
 
     def drain(self) -> list[tuple[bytes, bytes]]:
         """Eviction: batched lookup-and-delete when the kernel supports it,

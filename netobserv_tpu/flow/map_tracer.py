@@ -52,6 +52,7 @@ class MapTracer:
                         "(records are never materialized)")
         # FORCE_GARBAGE_COLLECTION parity: collect after each eviction so
         # the burst of short-lived record objects returns to the allocator
+        # (record path only — the columnar path births no per-record objects)
         self._force_gc = force_gc
         self._flush = threading.Event()
         self._stop = threading.Event()
@@ -100,7 +101,16 @@ class MapTracer:
         trace = tracing.start_trace("batch")
         t0 = time.perf_counter()
         with trace.stage("evict"):
-            evicted = self._fetcher.lookup_and_delete()
+            # bind the sampled trace for the drain's child spans
+            # (decode/merge_percpu/align in the columnar eviction plane);
+            # unsampled drains pay one bool check
+            if trace.sampled:
+                tracing.set_active(trace)
+            try:
+                evicted = self._fetcher.lookup_and_delete()
+            finally:
+                if trace.sampled:
+                    tracing.clear_active()
             # purge orphaned auxiliary entries (e.g. DNS never answered)
             purge = getattr(self._fetcher, "purge_stale", None)
             if purge is not None:
@@ -108,11 +118,19 @@ class MapTracer:
         if self._metrics is not None:
             self._metrics.observe_eviction(
                 "map", len(evicted), time.perf_counter() - t0)
+            self._metrics.evicted_flows_per_drain.observe(len(evicted))
+            ds = getattr(evicted, "decode_stats", None)
+            if ds is not None:
+                self._metrics.eviction_decode_seconds.observe(
+                    ds.get("seconds", 0.0))
             self._metrics.buffer_size.labels("evicted").set(
                 self._out.qsize())
             for key, val in self._fetcher.read_global_counters().items():
                 self._metrics.add_global_counter(key, val)
-        if self._force_gc:
+        if self._force_gc and not self._columnar:
+            # FORCE_GARBAGE_COLLECTION parity is for the record path's burst
+            # of short-lived objects; the columnar fast path materializes no
+            # per-record Python objects, so a collect there is pure stall
             import gc
             gc.collect()
         if len(evicted) == 0:

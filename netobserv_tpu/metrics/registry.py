@@ -111,6 +111,17 @@ class Metrics:
             "Map eviction (lookup+delete) latency",
             buckets=(.001, .005, .01, .025, .05, .1, .25, .5, 1, 2.5, 5),
             registry=self.registry)
+        self.eviction_decode_seconds = Histogram(
+            p + "eviction_decode_seconds",
+            "Columnar eviction-plane latency per drain (decode + per-CPU "
+            "merge + key alignment, the userspace half of an eviction)",
+            buckets=(.0001, .0005, .001, .005, .01, .05, .1, .5, 1),
+            registry=self.registry)
+        self.evicted_flows_per_drain = Histogram(
+            p + "evicted_flows_per_drain",
+            "Flows returned by one map drain (eviction batch size)",
+            buckets=(0, 10, 100, 1000, 10000, 100000, 1000000),
+            registry=self.registry)
         # tpu-sketch backend metrics (new)
         self.sketch_batches_total = Counter(
             p + "sketch_batches_total", "Columnar batches folded on device",

@@ -190,3 +190,253 @@ def merge_percpu(values: np.ndarray, accumulate_fn) -> np.ndarray:
     for i in range(1, len(values)):
         accumulate_fn(out, values[i])
     return out
+
+
+# ---------------------------------------------------------------------------
+# Columnar per-CPU merge: the whole-drain twins of the per-record functions
+# above. Each takes `values` of shape (n_keys, n_cpus) and returns (n_keys,)
+# merged records, bit-exact against running the matching `accumulate_*`
+# sequentially per key (pinned by tests/test_evict_columnar.py, alongside the
+# native fp_merge_*_batch twins) — the merge-semantics contract now has FOUR
+# pinned forms (per-record python, per-key native, columnar python, batch
+# native) and semantics change in all or none.
+# ---------------------------------------------------------------------------
+
+def _col_times(values: np.ndarray, out: np.ndarray) -> None:
+    """first_seen = min over non-zero (zero means unset), last_seen = max."""
+    first = values["first_seen_ns"]
+    masked = np.where(first == np.uint64(0), U64_MAX, first)
+    fmin = masked.min(axis=1)
+    out["first_seen_ns"] = np.where(fmin == U64_MAX, np.uint64(0), fmin)
+    out["last_seen_ns"] = values["last_seen_ns"].max(axis=1)
+
+
+def _col_latest_nonzero(field: np.ndarray) -> np.ndarray:
+    """(n, c) -> (n,): last non-zero value per row, else column 0's value —
+    the vectorized 'latest non-zero observation wins' rule."""
+    n, c = field.shape
+    nz = field != 0
+    has = nz.any(axis=1)
+    last = c - 1 - nz[:, ::-1].argmax(axis=1)
+    idx = np.where(has, last, 0)
+    return field[np.arange(n), idx]
+
+
+def _col_observed_intf(values: np.ndarray, out: np.ndarray) -> None:
+    """Observed-interface dedup-append, vectorized over keys. Candidate
+    positions are walked sequentially ((n_cpus-1) * cap iterations, each a
+    whole-axis op over the keys that have any src entries at all), because
+    each append changes what later candidates dedup against."""
+    n, c = values.shape
+    cap = values.dtype["observed_intf"].shape[0]
+    src_n = np.minimum(values["n_observed_intf"][:, 1:], cap)
+    active = np.nonzero(src_n.any(axis=1))[0]
+    if not len(active):
+        return
+    v = values[active]
+    m = len(active)
+    cnt = np.minimum(v["n_observed_intf"][:, 0], cap).astype(np.int64)
+    d_int = v["observed_intf"][:, 0].copy()
+    d_dir = v["observed_direction"][:, 0].copy()
+    slot = np.arange(cap)[None, :]
+    for ci in range(1, c):
+        s_cnt = np.minimum(v["n_observed_intf"][:, ci], cap)
+        for j in range(cap):
+            valid = j < s_cnt
+            if not valid.any():
+                continue
+            cint = v["observed_intf"][:, ci, j]
+            cdir = v["observed_direction"][:, ci, j]
+            # dedup only against the OCCUPIED dst slots (i < n_dst)
+            seen = ((d_int == cint[:, None]) & (d_dir == cdir[:, None])
+                    & (slot < cnt[:, None])).any(axis=1)
+            rows = np.nonzero(valid & ~seen & (cnt < cap))[0]
+            if len(rows):
+                d_int[rows, cnt[rows]] = cint[rows]
+                d_dir[rows, cnt[rows]] = cdir[rows]
+                cnt[rows] += 1
+    out["observed_intf"][active] = d_int
+    out["observed_direction"][active] = d_dir
+    out["n_observed_intf"][active] = cnt
+
+
+def merge_base_columnar(values: np.ndarray) -> np.ndarray:
+    """Columnar twin of accumulate_base over (n_keys, n_cpus) flow_stats."""
+    n, c = values.shape
+    out = values[:, 0].copy()
+    cap = values.dtype["observed_intf"].shape[0]
+    np.minimum(out["n_observed_intf"], cap, out=out["n_observed_intf"])
+    if c == 1 or n == 0:
+        return out
+    ar = np.arange(n)
+    _col_times(values, out)
+    # bytes: saturating u64 — cumulative clamp per CPU column (8-ish columns)
+    # mirrors the native wrap-detect exactly; a plain sum could overflow
+    acc = values["bytes"][:, 0].astype(np.uint64)
+    for j in range(1, c):
+        s = acc + values["bytes"][:, j]
+        acc = np.where(s < acc, U64_MAX, s)
+    out["bytes"] = acc
+    psum = values["packets"].astype(np.uint64).sum(axis=1)
+    out["packets"] = np.minimum(psum, U32_MAX).astype(np.uint32)
+    out["tcp_flags"] = np.bitwise_or.reduce(values["tcp_flags"], axis=1)
+    for fld in ("eth_protocol", "dscp", "sampling", "errno_fallback",
+                "tls_cipher_suite", "tls_key_share"):
+        out[fld] = _col_latest_nonzero(values[fld])
+    out["tls_types"] = np.bitwise_or.reduce(values["tls_types"], axis=1)
+    # MACs fill-if-unset: the first column (in merge order) with any non-zero
+    # byte wins; all-zero keeps column 0's zeros
+    for fld in ("src_mac", "dst_mac"):
+        first = values[fld].any(axis=2).argmax(axis=1)
+        out[fld] = values[fld][ar, first]
+    # first-seen identity: adopted from each src while the accumulated dst is
+    # still an all-empty entry -> the column at (first non-empty index), or
+    # the last column when every partial is empty
+    nonempty = (values["first_seen_ns"] != 0) | (values["packets"] != 0)
+    j = np.where(nonempty.any(axis=1), nonempty.argmax(axis=1), c - 1)
+    j = np.minimum(j, c - 1)
+    out["if_index_first"] = values["if_index_first"][ar, j]
+    out["direction_first"] = values["direction_first"][ar, j]
+    # ssl_version: first non-zero wins; any DIFFERENT later non-zero raises
+    # the mismatch flag (kernel entry rule)
+    sv = values["ssl_version"]
+    nzv = sv != 0
+    firstv = sv[ar, nzv.argmax(axis=1)]
+    out["ssl_version"] = np.where(nzv.any(axis=1), firstv, 0)
+    mismatch = (nzv & (sv != firstv[:, None])).any(axis=1)
+    out["misc_flags"] = (np.bitwise_or.reduce(values["misc_flags"], axis=1)
+                         | np.where(mismatch, np.uint8(MISC_SSL_MISMATCH),
+                                    np.uint8(0)))
+    _col_observed_intf(values, out)
+    return out
+
+
+def merge_dns_columnar(values: np.ndarray) -> np.ndarray:
+    n, c = values.shape
+    out = values[:, 0].copy()
+    if c == 1 or n == 0:
+        return out
+    _col_times(values, out)
+    out["dns_flags"] = np.bitwise_or.reduce(values["dns_flags"], axis=1)
+    out["dns_id"] = _col_latest_nonzero(values["dns_id"])
+    # errno adopts EVERY incoming partial (even clearing): last column wins
+    out["errno"] = values["errno"][:, -1]
+    out["latency_ns"] = values["latency_ns"].max(axis=1)
+    names = values["name"]
+    nz = names != b""  # S-dtype: trailing-NUL-stripped compare (python rule)
+    has = nz.any(axis=1)
+    last = c - 1 - nz[:, ::-1].argmax(axis=1)
+    out["name"] = names[np.arange(n), np.where(has, last, 0)]
+    return out
+
+
+def merge_drops_columnar(values: np.ndarray) -> np.ndarray:
+    n, c = values.shape
+    out = values[:, 0].copy()
+    if c == 1 or n == 0:
+        return out
+    _col_times(values, out)
+    for fld in ("bytes", "packets"):
+        s = values[fld].astype(np.uint64).sum(axis=1)
+        out[fld] = np.minimum(s, U16_MAX).astype(np.uint16)
+    out["latest_flags"] = np.bitwise_or.reduce(values["latest_flags"], axis=1)
+    out["latest_cause"] = _col_latest_nonzero(values["latest_cause"])
+    out["latest_state"] = _col_latest_nonzero(values["latest_state"])
+    return out
+
+
+def merge_extra_columnar(values: np.ndarray) -> np.ndarray:
+    n, c = values.shape
+    out = values[:, 0].copy()
+    if c == 1 or n == 0:
+        return out
+    ar = np.arange(n)
+    _col_times(values, out)
+    out["rtt_ns"] = values["rtt_ns"].max(axis=1)
+    # ipsec: highest return code wins its encrypted flag; among columns tied
+    # at the max, a later non-zero encrypted overrides (sequential adoption)
+    ret = values["ipsec_ret"]
+    enc = values["ipsec_encrypted"]
+    rstar = ret.max(axis=1)
+    elig = ret == rstar[:, None]
+    encnz = elig & (enc != 0)
+    has = encnz.any(axis=1)
+    last_nz = c - 1 - encnz[:, ::-1].argmax(axis=1)
+    idx = np.where(has, last_nz, elig.argmax(axis=1))
+    out["ipsec_ret"] = rstar
+    out["ipsec_encrypted"] = enc[ar, idx]
+    return out
+
+
+def merge_xlat_columnar(values: np.ndarray) -> np.ndarray:
+    n, c = values.shape
+    out = values[:, 0].copy()
+    if c == 1 or n == 0:
+        return out
+    _col_times(values, out)
+    complete = values["src_ip"].any(axis=2) & values["dst_ip"].any(axis=2)
+    has = complete.any(axis=1)
+    last = c - 1 - complete[:, ::-1].argmax(axis=1)
+    idx = np.where(has, last, 0)
+    ar = np.arange(n)
+    for fld in ("src_ip", "dst_ip", "src_port", "dst_port", "zone_id"):
+        out[fld] = values[fld][ar, idx]
+    return out
+
+
+def merge_quic_columnar(values: np.ndarray) -> np.ndarray:
+    n, c = values.shape
+    out = values[:, 0].copy()
+    if c == 1 or n == 0:
+        return out
+    _col_times(values, out)
+    out["version"] = values["version"].max(axis=1)
+    out["seen_long_hdr"] = values["seen_long_hdr"].max(axis=1)
+    out["seen_short_hdr"] = values["seen_short_hdr"].max(axis=1)
+    return out
+
+
+def merge_nevents_columnar(values: np.ndarray) -> np.ndarray:
+    """Columnar twin of accumulate_network_events: dedup-append into each
+    key's wrapping ring. The ring evolves entry by entry (each append changes
+    the dedup set AND the cursor), so candidates are walked sequentially —
+    (n_cpus-1) * MAX_NETWORK_EVENTS iterations, each vectorized over keys."""
+    n, c = values.shape
+    out = values[:, 0].copy()
+    if c == 1 or n == 0:
+        return out
+    _col_times(values, out)
+    cap = values.dtype["events"].shape[0]
+    idx = (out["n_events"].astype(np.int64)) % cap
+    for ci in range(1, c):
+        for j in range(cap):
+            act = values["packets"][:, ci, j] != 0
+            if not act.any():
+                continue
+            cand = values["events"][:, ci, j]                  # (n, md)
+            dup = (out["events"] == cand[:, None, :]).all(axis=2).any(axis=1)
+            rows = np.nonzero(act & ~dup)[0]
+            if len(rows):
+                ri = idx[rows]
+                out["events"][rows, ri] = cand[rows]
+                nb = (out["bytes"][rows, ri].astype(np.uint64)
+                      + values["bytes"][rows, ci, j])
+                out["bytes"][rows, ri] = np.minimum(nb, U16_MAX)
+                npk = (out["packets"][rows, ri].astype(np.uint64)
+                       + values["packets"][rows, ci, j])
+                out["packets"][rows, ri] = np.minimum(npk, U16_MAX)
+                idx[rows] = (ri + 1) % cap
+        out["n_events"] = idx
+    return out
+
+
+#: kind -> columnar merge fn (kind names shared with flowpack._MERGE_FNS)
+COLUMNAR_MERGES = {
+    "stats": merge_base_columnar,
+    "dns": merge_dns_columnar,
+    "drops": merge_drops_columnar,
+    "extra": merge_extra_columnar,
+    "xlat": merge_xlat_columnar,
+    "quic": merge_quic_columnar,
+    "nevents": merge_nevents_columnar,
+}

@@ -234,3 +234,19 @@ def decode_flow_events(raw: bytes | bytearray | memoryview) -> np.ndarray:
 def encode_flow_events(events: np.ndarray) -> bytes:
     """Inverse of decode (used by tests and the fake tracer)."""
     return np.ascontiguousarray(events, dtype=FLOW_EVENT_DTYPE).tobytes()
+
+
+def events_from_keys_stats(keys: np.ndarray, stats: np.ndarray,
+                           n_total: int | None = None) -> np.ndarray:
+    """Compose FLOW_EVENT rows from separate key/stats arrays — the columnar
+    drain's single copy boundary (replaces the old ``b"".join(k + v)``
+    interleave over the eviction pairs). ``n_total`` over-allocates zeroed
+    tail rows (the loader appends ringbuf-extra standalone events there)."""
+    n = len(keys)
+    if len(stats) != n:
+        raise ValueError(f"keys/stats length mismatch: {n} vs {len(stats)}")
+    out = np.zeros(n_total if n_total is not None else n,
+                   dtype=FLOW_EVENT_DTYPE)
+    out["key"][:n] = keys
+    out["stats"][:n] = stats
+    return out

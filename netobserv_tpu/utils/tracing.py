@@ -47,6 +47,7 @@ from typing import Optional
 __all__ = [
     "NULL_SPAN", "NULL_TRACE", "Trace", "FlightRecorder",
     "start_trace", "configure", "set_metrics", "snapshot", "enabled",
+    "set_active", "clear_active", "active_trace",
 ]
 
 
@@ -270,6 +271,30 @@ def start_trace(kind: str = "batch"):
     if next(c) % _period:
         return NULL_TRACE
     return Trace(kind, next(_next_id))
+
+
+# Per-thread active trace: lets a deep callee (the kernel drain inside
+# BpfmanFetcher.lookup_and_delete) attach child spans to the trace born in
+# map_tracer WITHOUT widening the FlowFetcher protocol. Only SAMPLED traces
+# are ever bound (map_tracer gates on trace.sampled), so the disabled path
+# pays nothing for the binding; the callee's active_trace() lookup is one
+# thread-local getattr PER DRAIN, never per record.
+_active = threading.local()
+
+
+def set_active(trace) -> None:
+    """Bind `trace` as the calling thread's active trace (sampled only)."""
+    _active.trace = trace
+
+
+def clear_active() -> None:
+    _active.trace = None
+
+
+def active_trace():
+    """The calling thread's bound trace, or the shared NULL_TRACE."""
+    t = getattr(_active, "trace", None)
+    return NULL_TRACE if t is None else t
 
 
 def set_metrics(metrics) -> None:

@@ -283,6 +283,49 @@ def test_orphan_feature_record_becomes_standalone_event(pinned_maps):
     fetcher.close()
 
 
+def test_consecutive_drains_do_not_alias(pinned_maps):
+    """drain_batched_arrays returns ZERO-COPY views of the cached batch
+    buffers (`_batch_bufs`); the columnar eviction plane must copy exactly
+    once, at EvictedFlows construction — a second drain through the SAME
+    cached buffers must never rewrite arrays decoded from the first
+    (the one-copy-boundary contract, CLAUDE.md)."""
+    from netobserv_tpu.datapath.loader import BpfmanFetcher
+
+    n_cpus = sb.n_possible_cpus()
+    pinned_maps["aggregated_flows"].update(
+        make_key(6001).tobytes(), make_stats(1111, 1).tobytes())
+    partials = np.zeros(n_cpus, dtype=binfmt.EXTRA_REC_DTYPE)
+    partials[0]["rtt_ns"] = 42
+    pinned_maps["flows_extra"].update(
+        make_key(6001).tobytes(), partials.tobytes())
+
+    fetcher = BpfmanFetcher(PIN_DIR)
+    first = fetcher.lookup_and_delete()
+    assert len(first) == 1
+    snap_events = first.events.copy()
+    snap_extra = first.extra.copy()
+
+    # refill with DIFFERENT content and drain again through the same
+    # cached syscall buffers
+    pinned_maps["aggregated_flows"].update(
+        make_key(7002).tobytes(), make_stats(9999, 9).tobytes())
+    partials[0]["rtt_ns"] = 777
+    pinned_maps["flows_extra"].update(
+        make_key(7002).tobytes(), partials.tobytes())
+    second = fetcher.lookup_and_delete()
+    assert len(second) == 1
+    assert int(second.events["key"][0]["src_port"]) == 7002
+
+    # the first eviction's arrays are intact — the copy happened at the
+    # EvictedFlows boundary, not lazily over the reused buffers
+    assert np.array_equal(first.events, snap_events)
+    assert np.array_equal(first.extra, snap_extra)
+    assert int(first.events["key"][0]["src_port"]) == 6001
+    assert int(first.events["stats"][0]["bytes"]) == 1111
+    assert int(first.extra[0]["rtt_ns"]) == 42
+    fetcher.close()
+
+
 def test_ringbuf_reader_opens_and_times_out(pinned_maps):
     """A pinned BPF_MAP_TYPE_RINGBUF can be mmap'd and polled (only a BPF
     program can submit records, so data-path parsing is covered by the pure

@@ -224,6 +224,41 @@ class TestMergePercpu:
         assert int(out["n_observed_intf"]) == 2  # 3 deduped, 9 appended
 
 
+class TestMergePercpuBatch:
+    """merge_percpu_batch API surface (the full four-form fuzz lives in
+    tests/test_evict_columnar.py): batch rows == per-key calls, native ==
+    columnar fallback, and shape validation."""
+
+    @pytest.mark.parametrize(
+        "kind", ["stats", "extra", "drops", "dns", "nevents", "xlat", "quic"])
+    def test_batch_rows_match_single_key(self, native, kind):
+        rng = np.random.default_rng(21)
+        dtype = flowpack._MERGE_FNS[kind][1]
+        raw = rng.integers(0, 256, (5, 4 * dtype.itemsize),
+                           dtype=np.int64).astype(np.uint8)
+        vals = raw.copy().view(dtype)
+        if kind == "dns":
+            vals["name"] = b"\x03abc"  # keep both name rules equivalent
+        if kind == "nevents":
+            vals["n_events"] = vals["n_events"] % 8
+        for un in (True, False):
+            batch = flowpack.merge_percpu_batch(kind, vals, use_native=un)
+            for i in range(len(vals)):
+                one = flowpack.merge_percpu(kind, vals[i], use_native=un)
+                assert one.tobytes() == batch[i].tobytes(), (kind, un, i)
+
+    def test_rejects_non_2d(self, native):
+        vals = np.zeros(4, dtype=binfmt.EXTRA_REC_DTYPE)
+        with pytest.raises(ValueError):
+            flowpack.merge_percpu_batch("extra", vals)
+
+    def test_empty_batch(self, native):
+        vals = np.zeros((0, 4), dtype=binfmt.EXTRA_REC_DTYPE)
+        for un in (True, False):
+            out = flowpack.merge_percpu_batch("extra", vals, use_native=un)
+            assert out.shape == (0,) and out.dtype == binfmt.EXTRA_REC_DTYPE
+
+
 class TestStagingRing:
     def test_ring_matches_sequential_ingest(self, native):
         """Folding batches through the 4-slot staging ring (buffer reuse +

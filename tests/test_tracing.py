@@ -102,6 +102,78 @@ def test_sampled_trace_records_stages_gaps_and_order():
     assert got["total_ms"] >= 0.0
 
 
+def test_active_trace_binding():
+    """The per-thread active trace (map_tracer binds it around the drain so
+    the columnar eviction plane can attach decode/merge_percpu/align child
+    spans without widening the FlowFetcher protocol): unbound -> the shared
+    null trace; bound -> that trace; cleared -> null again. Bindings are
+    thread-local."""
+    import threading
+
+    assert tracing.active_trace() is tracing.NULL_TRACE
+    tracing.configure(sample=1.0, capacity=8)
+    t = tracing.start_trace("batch")
+    tracing.set_active(t)
+    try:
+        assert tracing.active_trace() is t
+        seen = []
+        th = threading.Thread(
+            target=lambda: seen.append(tracing.active_trace()))
+        th.start()
+        th.join()
+        assert seen == [tracing.NULL_TRACE]  # other threads stay unbound
+    finally:
+        tracing.clear_active()
+    assert tracing.active_trace() is tracing.NULL_TRACE
+
+
+def test_evict_child_spans_ride_the_batch_trace():
+    """A fetcher reading tracing.active_trace() inside lookup_and_delete
+    (the BpfmanFetcher eviction plane) lands its child spans on the SAME
+    sampled trace map_tracer started — and with sampling off, the whole
+    path stays on the shared null objects."""
+    import queue
+
+    from netobserv_tpu.datapath.fetcher import FakeFetcher
+    from netobserv_tpu.flow.map_tracer import MapTracer
+    from netobserv_tpu.model import binfmt
+
+    class SpanningFetcher(FakeFetcher):
+        def lookup_and_delete(self):
+            trace = tracing.active_trace()
+            self.saw_null = trace is tracing.NULL_TRACE
+            with trace.stage("decode"):
+                pass
+            with trace.stage("merge_percpu"):
+                pass
+            with trace.stage("align"):
+                pass
+            return super().lookup_and_delete()
+
+    def run_once():
+        fetcher = SpanningFetcher()
+        events = np.zeros(2, binfmt.FLOW_EVENT_DTYPE)
+        events["key"]["src_port"] = [1, 2]
+        fetcher.inject_events(events)
+        out: queue.Queue = queue.Queue()
+        tracer = MapTracer(fetcher, out, columnar=True)
+        tracer._evict_once()
+        return fetcher, out.get_nowait()
+
+    tracing.configure(sample=1.0, capacity=8)
+    f, evicted = run_once()
+    assert not f.saw_null
+    # the columnar path leaves the open trace riding the EvictedFlows for
+    # the exporter fold — the drain's child spans are already on it,
+    # alongside map_tracer's own evict span
+    stages = {s.stage for s in evicted.trace.spans}
+    assert {"evict", "decode", "merge_percpu", "align"} <= stages
+    tracing.configure(sample=0.0)
+    f2, evicted2 = run_once()
+    assert f2.saw_null  # unsampled drains never see a live trace
+    assert not hasattr(evicted2, "trace")
+
+
 def test_recorder_is_bounded_and_newest_first():
     tracing.configure(sample=1.0, capacity=4)
     for i in range(10):
