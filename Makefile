@@ -71,6 +71,12 @@ smoke:
 	DATAPATH=synthetic EXPORT=stdout CACHE_ACTIVE_TIMEOUT=300ms \
 	  timeout 3 $(PY) -m netobserv_tpu | head -5 || true
 
+# federation e2e slice (~20s, non-gating CI artifact): two in-process
+# agents stream delta frames over real gRPC into a local aggregator and
+# the cluster-wide query surface answers merged top-K/frequency/cardinality
+smoke-federation:
+	JAX_PLATFORMS=cpu $(PY) scripts/smoke_federation.py
+
 # kernel capture-plane load rig: sendmmsg storm -> parity check (needs root)
 perftest:
 	$(PY) examples/performance/local_perftest.py --packets 1000000 --flows 256

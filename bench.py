@@ -838,13 +838,35 @@ def _device_watchdog(timeout_s: float | None = None,
     return "cpu-fallback"
 
 
+def device_provenance(cpu_requested: bool) -> dict:
+    """Explicit device provenance stamped into EVERY bench JSON (round
+    files commit these artifacts): `platform` / `device_kind` / `n_devices`
+    describe what actually ran, `fell_back_to_cpu` is True only when an
+    accelerator was WANTED but the claim failed — a CPU-fallback round can
+    never masquerade as an on-chip number again, and an intentional
+    JAX_PLATFORMS=cpu run is distinguishable from an outage."""
+    out: dict = {"platform": "unknown", "device_kind": "", "n_devices": 0,
+                 "cpu_requested": bool(cpu_requested),
+                 "fell_back_to_cpu": _DEVICE_NOTE == "cpu-fallback"}
+    try:
+        import jax
+        devs = jax.devices()
+        out["platform"] = devs[0].platform
+        out["device_kind"] = getattr(devs[0], "device_kind", "")
+        out["n_devices"] = len(devs)
+    except Exception as exc:  # provenance must never kill the bench
+        out["error"] = str(exc)
+    return out
+
+
 def main():
     import os
 
     # persistent XLA compile cache: repeat bench runs skip recompilation
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
     from netobserv_tpu.utils.platform import maybe_force_cpu
-    if not maybe_force_cpu():
+    cpu_requested = maybe_force_cpu()
+    if not cpu_requested:
         global _DEVICE_NOTE
         _DEVICE_NOTE = _device_watchdog()
     if "--device-only" in sys.argv:
@@ -854,13 +876,16 @@ def main():
         out = device_stage_stats()
         if _DEVICE_NOTE:
             out["device"] = _DEVICE_NOTE
+        out["device_provenance"] = device_provenance(cpu_requested)
         print(json.dumps(out))
         return
     if "--evict-only" in sys.argv:
         # `make bench-evict` (~10s, CPU-only): eviction-plane decode rates —
         # columnar vs the per-key idiom + per-stage split; the non-gating
         # CI artifact next to bench-host/bench-device
-        print(json.dumps(evict_stats()))
+        out = evict_stats()
+        out["device_provenance"] = device_provenance(cpu_requested)
+        print(json.dumps(out))
         return
     if "--host-only" in sys.argv:
         # `make bench-host` (~15s): host path + roll stall only, no device
@@ -876,6 +901,7 @@ def main():
                **host}
         if _DEVICE_NOTE:
             out["device"] = _DEVICE_NOTE
+        out["device_provenance"] = device_provenance(cpu_requested)
         print(json.dumps(out))
         return
     rng = np.random.default_rng(2026)
@@ -924,6 +950,7 @@ def main():
     }
     if _DEVICE_NOTE:
         out["device"] = _DEVICE_NOTE
+    out["device_provenance"] = device_provenance(cpu_requested)
     forced_variant = "--pallas" in sys.argv or "--scatter" in sys.argv
     if _DEVICE_NOTE and _DEVICE_NOTE not in ("cpu", "cpu-fallback"):
         if not forced_variant:  # cache only the shipped auto-path run

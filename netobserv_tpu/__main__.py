@@ -33,7 +33,14 @@ def main() -> int:
         dbg = start_debug_server(cfg.pprof_addr)
 
     try:
-        if cfg.enable_pca:
+        if cfg.federation_mode == "aggregator":
+            # central aggregator tier: delta ingest + device merge + the
+            # cluster-wide query surface, no datapath/flow pipeline at all
+            from netobserv_tpu.federation.service import (
+                FederationAggregatorService,
+            )
+            agent = FederationAggregatorService(cfg)
+        elif cfg.enable_pca:
             import os as _os
 
             if not cfg.target_host or not cfg.target_port:

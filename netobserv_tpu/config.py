@@ -361,6 +361,36 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_superbatch: str = field(default="1,2,4",
                                    **_env("SKETCH_SUPERBATCH", "1,2,4"))
 
+    # --- sketch federation plane (federation/; new) ---
+    #: "host:port" of the central aggregator's Federation gRPC endpoint;
+    #: set on per-host agents to stream one delta frame per closed window
+    #: (requires SKETCH_WINDOW_MODE=reset — decay frames are cumulative)
+    federation_target: str = field(default="", **_env("FEDERATION_TARGET"))
+    #: stable agent identity stamped into delta frames (default: hostname)
+    federation_agent_id: str = field(default="",
+                                     **_env("FEDERATION_AGENT_ID"))
+    #: FEDERATION_MODE=aggregator turns `python -m netobserv_tpu` into the
+    #: central aggregator tier instead of a flow agent
+    federation_mode: str = field(default="", **_env("FEDERATION_MODE"))
+    #: aggregator: Federation gRPC listen port (delta ingest)
+    federation_listen_port: int = field(
+        default=9999, **_env("FEDERATION_LISTEN_PORT", "9999"))
+    #: aggregator: cluster-wide query surface HTTP port (0 = ephemeral,
+    #: for tests; -1 disables the surface)
+    federation_query_port: int = field(
+        default=9998, **_env("FEDERATION_QUERY_PORT", "9998"))
+    #: aggregator window period (cluster report + EWMA baseline roll)
+    federation_window: float = field(default=60.0,
+                                     **_env("FEDERATION_WINDOW", "60s"))
+    #: aggregator device mesh ("" = single device; "4x1" shards agent
+    #: ownership over the data axis and merges over ICI at window roll)
+    federation_mesh_shape: str = field(default="",
+                                       **_env("FEDERATION_MESH_SHAPE"))
+    #: seconds without a delta before an agent counts as dark in /readyz
+    #: detail and the staleness gauge commentary (2 windows by default)
+    federation_stale_after: float = field(
+        default=120.0, **_env("FEDERATION_STALE_AFTER", "120s"))
+
     def resolved_pack_threads(self) -> int:
         """SKETCH_PACK_THREADS with 0 = auto (cpu count, capped at 8)."""
         if self.sketch_pack_threads > 0:
@@ -429,6 +459,19 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
                 f"SKETCH_REPORT_SINK={self.sketch_report_sink!r} "
                 "(want stdout|kafka)")
         self.parsed_superbatch_ladder()  # raises on a malformed ladder spec
+        if self.federation_mode not in ("", "aggregator"):
+            raise ValueError(
+                f"FEDERATION_MODE={self.federation_mode!r} "
+                "(want empty|aggregator)")
+        if self.federation_target and ":" not in self.federation_target:
+            raise ValueError(
+                f"FEDERATION_TARGET={self.federation_target!r} "
+                "(want host:port)")
+        if self.federation_target and self.sketch_window_mode == "decay":
+            logging.getLogger("netobserv_tpu.config").warning(
+                "FEDERATION_TARGET with SKETCH_WINDOW_MODE=decay: delta "
+                "export is disabled (decayed tables are cumulative, the "
+                "aggregator merges per-window deltas)")
         if self.sketch_cm_width < 16 * self.sketch_topk:
             # measured F1 cliff (docs/accuracy.md): top-K precision degrades
             # once Count-Min columns are shared by too many tracked keys —
@@ -445,7 +488,8 @@ _DURATION_FIELDS = {
     "grpc_reconnect_timer", "grpc_reconnect_timer_randomization", "sketch_window",
     "supervisor_check_period", "supervisor_backoff_initial",
     "supervisor_backoff_max", "supervisor_healthy_reset",
-    "supervisor_heartbeat_timeout",
+    "supervisor_heartbeat_timeout", "federation_window",
+    "federation_stale_after",
 }
 
 

@@ -1,0 +1,82 @@
+"""Federation delta sink: streams sketch-delta frames to the aggregator.
+
+Exporter-seam semantics, same rules as every other exporter (CLAUDE.md):
+errors are swallowed + counted, never fatal — a dead aggregator must not
+stall the window timer or lose the local JSON report. Each frame gets a
+small bounded retry ladder with exponential backoff and a reconnect between
+attempts (the aggregator tier restarts/rebalances like any collector); a
+frame that exhausts its ladder is dropped and counted, because the NEXT
+window's frame supersedes it anyway (deltas are per-window snapshots, not a
+log — re-sending stale windows after an outage would only delay fresh
+ones).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from netobserv_tpu.grpc.federation import FederationClient
+
+log = logging.getLogger("netobserv_tpu.exporter.federation")
+
+
+class FederationDeltaSink:
+    """Callable `(frame_bytes) -> bool` used by TpuSketchExporter at window
+    publish time (timer thread — never under the exporter lock)."""
+
+    name = "federation"
+
+    def __init__(self, host: str, port: int, tls_ca: str = "",
+                 tls_cert: str = "", tls_key: str = "",
+                 retries: int = 3, backoff_initial_s: float = 0.2,
+                 backoff_max_s: float = 2.0, timeout_s: float = 10.0,
+                 metrics=None,
+                 client: Optional[FederationClient] = None):
+        self._client = client or FederationClient(host, port, tls_ca,
+                                                  tls_cert, tls_key)
+        self._retries = max(1, retries)
+        self._backoff_initial = backoff_initial_s
+        self._backoff_max = backoff_max_s
+        self._timeout = timeout_s
+        self._metrics = metrics
+
+    def __call__(self, frame: bytes) -> bool:
+        """Push one frame; True when the aggregator accepted it. Never
+        raises — failures are logged + counted and the frame is dropped."""
+        err: Exception | None = None
+        for attempt in range(self._retries):
+            try:
+                ack = self._client.send(frame, timeout_s=self._timeout)
+                if ack.accepted:
+                    self._count("ok", len(frame))
+                    return True
+                # the aggregator SAW the frame and said no (version/shape
+                # mismatch): retrying the same bytes cannot succeed
+                log.error("aggregator rejected delta frame: %s", ack.reason)
+                self._count("rejected", len(frame))
+                return False
+            except Exception as exc:
+                err = exc
+                if attempt + 1 < self._retries:
+                    time.sleep(min(self._backoff_initial * (2 ** attempt),
+                                   self._backoff_max))
+                    try:
+                        self._client.connect()
+                    except Exception:
+                        pass  # next send() attempt surfaces the real error
+        log.error("delta frame dropped after %d attempts: %s",
+                  self._retries, err)
+        self._count("error", len(frame))
+        return False
+
+    def _count(self, result: str, n_bytes: int) -> None:
+        m = self._metrics
+        if m is not None:
+            m.federation_deltas_sent_total.labels(result).inc()
+            if result == "error":
+                m.count_export_error(self.name, "delta_push")
+
+    def close(self) -> None:
+        self._client.close()

@@ -267,7 +267,9 @@ class TpuSketchExporter(Exporter):
                  feed: str = "resident",
                  resident_slots: int = 1 << 18,
                  superbatch: tuple = (1,),
-                 warm_ladder: bool = False):
+                 warm_ladder: bool = False,
+                 delta_sink=None,
+                 agent_id: str = ""):
         # superbatch defaults to NO ladder for direct construction: the
         # ladder costs superbatch_max-sized ring buffers, dictionaries and
         # key-table rows up front, and only pays off once warmed — the
@@ -290,6 +292,22 @@ class TpuSketchExporter(Exporter):
         self._asym_min_bytes = asym_min_bytes
         self._asym_ratio = asym_ratio
         self._metrics = metrics
+        # federation delta export (federation/delta.py): snapshot the
+        # mergeable tables at roll, frame + push them on the timer thread
+        self._delta_sink = delta_sink
+        if agent_id:
+            self._agent_id = agent_id
+        else:
+            import socket
+            self._agent_id = socket.gethostname()
+        if self._delta_sink is not None and decay_factor is not None:
+            # decayed tables are CUMULATIVE (sliding window): pushing them
+            # per window would double-count every prior window's mass at
+            # the aggregator, whose merge assumes per-window deltas
+            log.warning("federation delta export requires "
+                        "SKETCH_WINDOW_MODE=reset (decay frames are "
+                        "cumulative); disabling delta export")
+            self._drop_delta_sink()
         if metrics is not None:
             # retrace alarms and span histograms land in THIS agent's
             # registry (module-level binding: one facade per process in
@@ -360,8 +378,17 @@ class TpuSketchExporter(Exporter):
                 self._mesh, self._cfg, dense=True, with_token=True)
             dense_put = lambda buf: pmerge.shard_dense(  # noqa: E731
                 self._mesh, buf)
-            self._roll = pmerge.make_merge_fn(self._mesh, self._cfg,
-                                              decay_factor=decay_factor)
+            if self._delta_sink is not None and spec.sketch > 1:
+                # width-sharded CM planes are independent local-width
+                # sketches — there is no whole-width snapshot to frame
+                # (parallel/merge.py make_merge_fn with_tables contract)
+                log.warning("federation delta export needs a data-axis-only "
+                            "mesh; disabling it on this %dx%d exporter",
+                            spec.data, spec.sketch)
+                self._drop_delta_sink()
+            self._roll = pmerge.make_merge_fn(
+                self._mesh, self._cfg, decay_factor=decay_factor,
+                with_tables=self._delta_sink is not None)
             if feed == "resident":
                 # resident feed over the mesh: per-data-shard dictionaries
                 # + device key tables (~15B/record instead of dense's 80;
@@ -412,7 +439,8 @@ class TpuSketchExporter(Exporter):
                 enable_fanout=self._cfg.enable_fanout,
                 enable_asym=self._cfg.enable_asym), "ingest")
             self._roll = retrace.watch(
-                sk.make_roll_fn(self._cfg, decay_factor=decay_factor),
+                sk.make_roll_fn(self._cfg, decay_factor=decay_factor,
+                                with_tables=self._delta_sink is not None),
                 "roll")
             self._ring = self._make_single_device_ring(
                 feed, resident_slots, pack_threads, metrics)
@@ -521,6 +549,14 @@ class TpuSketchExporter(Exporter):
             threading.Thread(target=_warm, name="sketch-ladder-warm",
                              daemon=True).start()
 
+    def _drop_delta_sink(self) -> None:
+        """Disable delta export, CLOSING the sink (from_config already
+        opened its gRPC channel — dropping the reference would leak it)."""
+        sink_close = getattr(self._delta_sink, "close", None)
+        if sink_close is not None:
+            sink_close()
+        self._delta_sink = None
+
     @property
     def _window_poll_s(self) -> float:
         """Window timer wakeup period — the ONE definition; the heartbeat
@@ -551,7 +587,14 @@ class TpuSketchExporter(Exporter):
         from netobserv_tpu.sketch.state import SketchConfig
         if sink is None:
             sink = make_report_sink(cfg)
-        return cls(batch_size=cfg.sketch_batch_size, window_s=cfg.sketch_window,
+        delta_sink = None
+        if cfg.federation_target:
+            from netobserv_tpu.exporter.federation import FederationDeltaSink
+            host, _, port = cfg.federation_target.rpartition(":")
+            delta_sink = FederationDeltaSink(host or "127.0.0.1", int(port),
+                                             metrics=metrics)
+        return cls(delta_sink=delta_sink, agent_id=cfg.federation_agent_id,
+                   batch_size=cfg.sketch_batch_size, window_s=cfg.sketch_window,
                    sketch_cfg=SketchConfig.from_agent_config(cfg),
                    mesh_shape=cfg.sketch_mesh_shape, metrics=metrics, sink=sink,
                    checkpoint_dir=cfg.sketch_checkpoint_dir,
@@ -688,6 +731,10 @@ class TpuSketchExporter(Exporter):
         sink_close = getattr(self._sink, "close", None)
         if sink_close is not None:
             sink_close()
+        if self._delta_sink is not None:
+            delta_close = getattr(self._delta_sink, "close", None)
+            if delta_close is not None:
+                delta_close()
 
     def _window_loop(self) -> None:
         while not self._closed.wait(timeout=self._window_poll_s):
@@ -815,17 +862,21 @@ class TpuSketchExporter(Exporter):
         blocked on this lock never wait behind a sink."""
         self._window_deadline = time.monotonic() + self._window_s
         with wtrace.stage("roll_dispatch"):
-            self._state, report = self._roll(self._state)
+            if self._delta_sink is not None:
+                self._state, report, tables = self._roll(self._state)
+            else:
+                self._state, report = self._roll(self._state)
+                tables = None
         # the window trace rides the queued report; render/sink spans attach
         # at publish time on the timer thread (the gap in between is the
         # report's queue wait)
-        self._reports.append((report, wtrace))
+        self._reports.append((report, tables, wtrace))
         while len(self._reports) > self._max_queued_reports:
             # a wedged sink has the timer blocked mid-publish: shed the
             # OLDEST unpublished window instead of accumulating device
             # reports without bound (counted, like any lost report)
             try:
-                _shed, shed_trace = self._reports.popleft()
+                _shed, _shed_tables, shed_trace = self._reports.popleft()
             except IndexError:
                 break  # the publisher drained it between len() and pop
             shed_trace.finish()
@@ -850,11 +901,11 @@ class TpuSketchExporter(Exporter):
         with self._publish_lock:
             while self._reports:
                 try:
-                    report, wtrace = self._reports.popleft()
+                    report, tables, wtrace = self._reports.popleft()
                 except IndexError:
                     return  # _roll_locked's shed loop emptied it first
                 try:
-                    self._publish_report(report, wtrace)
+                    self._publish_report(report, wtrace, tables=tables)
                 except Exception as exc:
                     log.error("window report publish failed "
                               "(report lost): %s", exc)
@@ -863,7 +914,34 @@ class TpuSketchExporter(Exporter):
                 finally:
                     wtrace.finish()
 
-    def _publish_report(self, report, wtrace=tracing.NULL_TRACE) -> None:
+    def _publish_report(self, report, wtrace=tracing.NULL_TRACE,
+                        tables=None) -> None:
+        if self._delta_sink is not None and tables is not None:
+            # federation delta FIRST, in its own try: a dead aggregator (or
+            # a serialize bug) loses the frame — counted by the sink — but
+            # never the local JSON report below. Per window, never per
+            # record, like every fault point / span.
+            try:
+                with wtrace.stage("report_serialize"):
+                    faultinject.fire("sketch.delta_export")
+                    from netobserv_tpu.federation import delta as fdelta
+                    frame = fdelta.encode_frame(
+                        {k: np.asarray(v) for k, v in tables.items()},
+                        agent_id=self._agent_id,
+                        window=int(np.asarray(report.window)),
+                        ts_ms=time.time_ns() // 1_000_000,
+                        dims={"cm_depth": self._cfg.cm_depth,
+                              "cm_width": self._cfg.cm_width,
+                              "hll_precision": self._cfg.hll_precision,
+                              "topk": self._cfg.topk,
+                              "ewma_buckets": self._cfg.ewma_buckets})
+                with wtrace.stage("delta_push"):
+                    self._delta_sink(frame)  # sink swallows+counts inside
+            except Exception as exc:
+                log.error("delta frame serialize/push failed "
+                          "(frame lost, report still publishes): %s", exc)
+                if self._metrics is not None:
+                    self._metrics.count_error("federation")
         with wtrace.stage("report_render"):
             # includes the device->host transfer of the report arrays (the
             # first np.asarray touch) — deliberately not split out, so the

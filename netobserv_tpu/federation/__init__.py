@@ -1,0 +1,10 @@
+"""Sketch federation plane: delta export/ingest + the central aggregator.
+
+Per-host agents snapshot their mergeable sketch tables at every window roll
+into a versioned protobuf frame (`delta.py`, jax-free — it must run on the
+big-endian qemu CI tier), stream it over gRPC (`netobserv_tpu.grpc.
+federation`), and a central TPU aggregator (`aggregator.py`) hierarchically
+merges frames on-device and serves cluster-wide top-K / frequency /
+cardinality / victim buckets from a non-blocking HTTP query surface
+(`query.py`). docs/architecture.md "Sketch federation plane" is the map.
+"""

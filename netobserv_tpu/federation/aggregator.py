@@ -1,0 +1,417 @@
+"""Central TPU aggregator: the fleet's one sketch-merge plane.
+
+Hundreds of per-host agents each stream one delta frame per closed window
+(`federation.delta`); this tier decodes, validates, and hierarchically
+merges them ON DEVICE:
+
+- single device: one jitted `statemerge.merge_tables` entry (donated
+  aggregate, fixed frame shapes — compiled once, watched for retraces);
+- in-pod mesh (`FEDERATION_MESH_SHAPE`): agents are hash-assigned to data
+  shards and folded into per-shard partials with NO collectives
+  (`parallel.merge.make_fold_delta_fn`); the two-axis ICI gather at window
+  roll (`parallel.merge.make_merge_fn`) reconciles — the same steady-state/
+  roll split as the flow ingest, one level up;
+- cross-pod: `parallel.distributed.maybe_initialize_distributed` wires the
+  spanning mesh (FEDERATION_* or SKETCH_* coordinator envs), and the same
+  shard_map programs run across hosts over DCN.
+
+The aggregate IS a `SketchState` fed by deltas instead of records, so the
+existing window roll and report renderer serve the cluster-wide report
+unchanged. Everything query-facing is published as a HOST-side snapshot at
+window roll on the timer thread — the HTTP query surface (`federation.
+query`) never dispatches a device op (same off-hot-path rules as
+/debug/traces).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+import zlib
+from typing import Callable, Optional
+
+import numpy as np
+
+from netobserv_tpu.federation import delta as fdelta
+from netobserv_tpu.pb import sketch_delta_pb2
+from netobserv_tpu.utils import faultinject, retrace, tracing
+
+log = logging.getLogger("netobserv_tpu.federation.aggregator")
+
+
+def agent_owner_shard(agent_id: str, n_shards: int) -> int:
+    """Stable agent -> data-shard assignment (mesh mode): one agent's
+    deltas always fold into the same shard's partial."""
+    return zlib.crc32(agent_id.encode()) % max(1, n_shards)
+
+
+class FederationAggregator:
+    """Delta ingest + on-device merge + windowed cluster reports.
+
+    Exporter-grade failure semantics: a bad frame is acked `accepted=0`
+    and counted, a merge failure loses that frame (counted), a roll
+    failure retries next window — nothing here ever tears down the gRPC
+    stream every other agent is pushing on.
+    """
+
+    def __init__(self, sketch_cfg=None, window_s: float = 60.0,
+                 mesh_shape: str = "", metrics=None,
+                 sink: Optional[Callable[[dict], None]] = None,
+                 stale_after_s: float = 120.0,
+                 report_kwargs: Optional[dict] = None):
+        from netobserv_tpu.parallel.distributed import (
+            maybe_initialize_distributed,
+        )
+        # the aggregator tier's spanning mesh wires under its own env
+        # prefix (FEDERATION_*), falling back to the shared SKETCH_* one
+        maybe_initialize_distributed(prefixes=("FEDERATION_", "SKETCH_"))
+        import jax
+
+        from netobserv_tpu.sketch import state as sk
+
+        self._sk = sk
+        self._cfg = sketch_cfg or sk.SketchConfig()
+        self._window_s = window_s
+        self._metrics = metrics
+        self._sink = sink
+        self._stale_after_s = stale_after_s
+        self._report_kwargs = report_kwargs or {}
+        if metrics is not None:
+            retrace.set_metrics(metrics)
+            tracing.set_metrics(metrics)
+        # frame contract: expected tensor shapes + geometry, derived from
+        # THIS aggregator's config (a foreign shape must never reach the
+        # fixed-shape jitted merge)
+        template = sk.state_tables(sk.init_state(self._cfg))
+        self._expected_shapes = fdelta.expected_shapes(template)
+        self._dims = {"cm_depth": self._cfg.cm_depth,
+                      "cm_width": self._cfg.cm_width,
+                      "hll_precision": self._cfg.hll_precision,
+                      "topk": self._cfg.topk,
+                      "ewma_buckets": self._cfg.ewma_buckets}
+
+        self._distributed = bool(mesh_shape)
+        if self._distributed:
+            from netobserv_tpu.parallel import (
+                MeshSpec, make_mesh, merge as pmerge)
+            spec = MeshSpec.parse(mesh_shape, len(jax.devices()))
+            self._mesh = make_mesh(spec)
+            self._ndata = spec.data
+            self._pm = pmerge
+            self._state = pmerge.init_dist_state(self._cfg, self._mesh)
+            self._fold = pmerge.make_fold_delta_fn(self._mesh, self._cfg)
+            self._roll = pmerge.make_merge_fn(self._mesh, self._cfg,
+                                              with_tables=True)
+        else:
+            from netobserv_tpu.federation import statemerge
+            self._ndata = 1
+            self._state = sk.init_state(self._cfg)
+            self._fold = retrace.watch(
+                jax.jit(statemerge.merge_tables, donate_argnums=(0,)),
+                "federation_merge")
+            self._roll = retrace.watch(
+                sk.make_roll_fn(self._cfg, with_tables=True),
+                "federation_roll")
+
+        self._lock = threading.Lock()          # aggregate state + counters
+        self._publish_lock = threading.Lock()
+        self._reports: collections.deque = collections.deque()
+        self._max_queued_reports = 4
+        self._window_deadline = time.monotonic() + window_s
+        #: agent id -> {"last_ms", "window", "frames"} (monotonic last too)
+        self._agents: dict[str, dict] = {}
+        self._window_agents: set[str] = set()
+        self._frames_total = 0
+        self._snapshot: Optional[dict] = None
+        self._snap_lock = threading.Lock()
+        self._closed = threading.Event()
+        self.heartbeat = lambda: None
+        self._timer: Optional[threading.Thread] = None
+        self.start_window_timer()
+
+    # --- delta ingest (gRPC handler) ------------------------------------
+    def ingest_frame(self, data: bytes) -> sketch_delta_pb2.DeltaAck:
+        """Decode + validate + merge one frame; always returns an ack."""
+        t0 = time.perf_counter()
+        trace = tracing.start_trace("delta")
+        try:
+            faultinject.fire("federation.ingest")
+            try:
+                with trace.stage("delta_decode"):
+                    frame = fdelta.decode_frame(data)
+            except fdelta.DeltaVersionError as exc:
+                return self._reject("version_mismatch", str(exc))
+            except fdelta.DeltaFrameError as exc:
+                return self._reject("decode_error", str(exc))
+            try:
+                fdelta.validate_shapes(frame, self._expected_shapes)
+                if frame.dims != self._dims:
+                    raise fdelta.DeltaFrameError(
+                        f"frame geometry {frame.dims} != aggregator's "
+                        f"{self._dims} (agent {frame.agent_id!r})")
+            except fdelta.DeltaFrameError as exc:
+                return self._reject("shape_mismatch", str(exc))
+            try:
+                with trace.stage("delta_merge_dispatch"):
+                    self._merge_frame(frame)
+            except Exception as exc:
+                log.error("delta merge failed (frame from %r dropped): %s",
+                          frame.agent_id, exc)
+                return self._reject("merge_error", str(exc))
+        finally:
+            trace.finish()
+        m = self._metrics
+        if m is not None:
+            m.federation_deltas_total.labels("ok").inc()
+            m.federation_delta_bytes_total.inc(len(data))
+            m.federation_merge_seconds.observe(time.perf_counter() - t0)
+        return sketch_delta_pb2.DeltaAck(
+            accepted=1, version=fdelta.DELTA_FORMAT_VERSION)
+
+    def _reject(self, result: str,
+                reason: str) -> sketch_delta_pb2.DeltaAck:
+        log.warning("delta frame rejected (%s): %s", result, reason)
+        if self._metrics is not None:
+            self._metrics.federation_deltas_total.labels(result).inc()
+        return sketch_delta_pb2.DeltaAck(
+            accepted=0, version=fdelta.DELTA_FORMAT_VERSION, reason=reason)
+
+    def _merge_frame(self, frame: fdelta.DeltaFrame) -> None:
+        import jax
+
+        if self._distributed:
+            tables = {name: self._pm.put_replicated(
+                self._mesh, np.ascontiguousarray(arr))
+                for name, arr in frame.tables.items()}
+            owner = self._pm.put_replicated(self._mesh, np.asarray(
+                [agent_owner_shard(frame.agent_id, self._ndata)], np.int32))
+        else:
+            tables = {name: jax.device_put(arr)
+                      for name, arr in frame.tables.items()}
+        with self._lock:
+            if self._distributed:
+                self._state = self._fold(self._state, tables, owner)
+            else:
+                self._state = self._fold(self._state, tables)
+            self._frames_total += 1
+            self._window_agents.add(frame.agent_id)
+            info = self._agents.setdefault(
+                frame.agent_id, {"frames": 0, "window": 0, "last_ms": 0.0,
+                                 "last_mono": 0.0})
+            info["frames"] += 1
+            info["window"] = frame.window
+            info["last_ms"] = time.time() * 1e3
+            info["last_mono"] = time.monotonic()
+            if time.monotonic() >= self._window_deadline:
+                self._close_window_locked()
+
+    # --- window roll ----------------------------------------------------
+    def start_window_timer(self) -> None:
+        self._timer = threading.Thread(
+            target=self._window_loop, name="federation-window", daemon=True)
+        self._timer.start()
+
+    @property
+    def _window_poll_s(self) -> float:
+        return min(1.0, self._window_s / 10)
+
+    def register_supervised(self, supervisor, heartbeat_timeout_s=None,
+                            **kwargs) -> None:
+        beat = supervisor.register(
+            "federation-window", restart=self.start_window_timer,
+            thread_getter=lambda: self._timer,
+            heartbeat_timeout_s=(heartbeat_timeout_s or 10.0)
+            + self._window_poll_s,
+            **kwargs)
+        self.heartbeat = beat
+
+    def _window_loop(self) -> None:
+        while not self._closed.wait(timeout=self._window_poll_s):
+            self.heartbeat()
+            faultinject.fire("federation.window_timer")
+            try:
+                faultinject.fire("federation.window_roll")
+                with self._lock:
+                    if time.monotonic() >= self._window_deadline:
+                        self._close_window_locked()
+            except Exception as exc:
+                log.error("federation window roll failed (will retry): %s",
+                          exc)
+                if self._metrics is not None:
+                    self._metrics.count_error("federation")
+            self._update_staleness()
+            self._publish_queued()
+
+    def _close_window_locked(self) -> None:
+        """Dispatch the roll UNDER self._lock; render/publish happen on the
+        timer thread outside it (delta merges never wait on a sink)."""
+        wtrace = tracing.start_trace("federation_window")
+        self._window_deadline = time.monotonic() + self._window_s
+        try:
+            with wtrace.stage("roll_dispatch"):
+                self._state, report, tables = self._roll(self._state)
+        except BaseException:
+            wtrace.finish()
+            raise
+        agents = sorted(self._window_agents)
+        self._window_agents = set()
+        self._reports.append((report, tables, agents, wtrace))
+        while len(self._reports) > self._max_queued_reports:
+            try:
+                _r, _t, _a, shed = self._reports.popleft()
+            except IndexError:
+                break
+            shed.finish()
+            log.error("federation report queue full; dropping the oldest "
+                      "unpublished window")
+            if self._metrics is not None:
+                self._metrics.count_error("federation")
+
+    def _publish_queued(self) -> None:
+        with self._publish_lock:
+            while self._reports:
+                try:
+                    report, tables, agents, wtrace = self._reports.popleft()
+                except IndexError:
+                    return
+                try:
+                    self._publish(report, tables, agents, wtrace)
+                except Exception as exc:
+                    log.error("federation report publish failed "
+                              "(report lost): %s", exc)
+                    if self._metrics is not None:
+                        self._metrics.count_error("federation")
+                finally:
+                    wtrace.finish()
+
+    def _publish(self, report, tables, agents: list, wtrace) -> None:
+        from netobserv_tpu.exporter.tpu_sketch import report_to_json
+
+        with wtrace.stage("report_render"):
+            obj = report_to_json(report, **self._report_kwargs)
+            obj["Type"] = "federation_window_report"
+            obj["Agents"] = agents
+            obj["TimestampMs"] = time.time_ns() // 1_000_000
+            # host copies of the merged tables the query surface reads
+            # (the np.asarray touch includes the device->host transfer)
+            cm_bytes = np.asarray(tables["cm_bytes"])
+            cm_pkts = np.asarray(tables["cm_pkts"])
+            heavy = {k: np.asarray(tables["heavy_" + k])
+                     for k in ("words", "h1", "h2", "counts", "valid")}
+        snap = {
+            "window": obj["Window"],
+            "ts_ms": obj["TimestampMs"],
+            "report": obj,
+            "agents": {a: dict(v) for a, v in self._agents_view().items()},
+            "cm_bytes": cm_bytes,
+            "cm_pkts": cm_pkts,
+            "heavy": heavy,
+            "total_records": obj["Records"],
+            "total_bytes": obj["Bytes"],
+        }
+        with self._snap_lock:
+            self._snapshot = snap
+        m = self._metrics
+        if m is not None:
+            m.federation_active_agents.set(len(agents))
+            m.sketch_window_reports_total.inc()
+        if self._sink is not None:
+            with wtrace.stage("report_sink"):
+                self._sink(obj)
+
+    def _agents_view(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {a: {"frames": v["frames"], "window": v["window"],
+                        "last_ms": v["last_ms"],
+                        "staleness_s": round(now - v["last_mono"], 3),
+                        "stale": (now - v["last_mono"])
+                        > self._stale_after_s}
+                    for a, v in self._agents.items()}
+
+    def _update_staleness(self) -> None:
+        m = self._metrics
+        if m is None:
+            return
+        for agent, info in self._agents_view().items():
+            m.federation_agent_staleness_seconds.labels(agent).set(
+                info["staleness_s"])
+
+    # --- query surface (host-side, never a device op) -------------------
+    def snapshot(self) -> Optional[dict]:
+        """The last closed window's published snapshot (None before the
+        first roll publishes)."""
+        with self._snap_lock:
+            return self._snapshot
+
+    def status(self) -> dict:
+        with self._lock:
+            frames = self._frames_total
+            window_agents = sorted(self._window_agents)
+        snap = self.snapshot()
+        return {
+            "frames_total": frames,
+            "agents": self._agents_view(),
+            "current_window_agents": window_agents,
+            "last_published_window": None if snap is None
+            else snap["window"],
+            "window_s": self._window_s,
+            "mesh": self._distributed,
+            "format_version": fdelta.DELTA_FORMAT_VERSION,
+        }
+
+    def query_frequency(self, src: str, dst: str, src_port: int = 0,
+                        dst_port: int = 0, proto: int = 0) -> Optional[dict]:
+        """CM point query with error bars against the last closed window's
+        MERGED tables — pure host numpy (the hashing twins), non-blocking."""
+        snap = self.snapshot()
+        if snap is None:
+            return None
+        from netobserv_tpu.model import binfmt
+        from netobserv_tpu.model.columnar import pack_key_words
+        from netobserv_tpu.model.flow import FlowKey
+        from netobserv_tpu.ops.hashing import base_hashes_multi_np
+
+        fk = FlowKey.make(src, dst, src_port, dst_port, proto)
+        karr = np.zeros(1, binfmt.FLOW_KEY_DTYPE)
+        karr["src_ip"][0] = np.frombuffer(fk.src_ip, np.uint8)
+        karr["dst_ip"][0] = np.frombuffer(fk.dst_ip, np.uint8)
+        karr["src_port"] = src_port
+        karr["dst_port"] = dst_port
+        karr["proto"] = proto
+        words = pack_key_words(karr)
+        h = base_hashes_multi_np(words)
+        cm = snap["cm_bytes"]
+        d, w = cm.shape
+        with np.errstate(over="ignore"):
+            idx = (h["h1"][0] + np.arange(d, dtype=np.uint32) * h["h2"][0]) \
+                & np.uint32(w - 1)
+        est_bytes = float(np.min(snap["cm_bytes"][np.arange(d), idx]))
+        est_pkts = float(np.min(snap["cm_pkts"][np.arange(d), idx]))
+        # Cormode–Muthukrishnan: overestimate <= (e/w)*N with prob 1-e^-d
+        n_bytes = float(np.sum(snap["cm_bytes"][0]))
+        n_pkts = float(np.sum(snap["cm_pkts"][0]))
+        eps = np.e / w
+        return {
+            "window": snap["window"],
+            "est_bytes": est_bytes,
+            "est_packets": est_pkts,
+            "overestimate_bound_bytes": eps * n_bytes,
+            "overestimate_bound_packets": eps * n_pkts,
+            "confidence": 1.0 - float(np.exp(-d)),
+        }
+
+    # --- lifecycle ------------------------------------------------------
+    def flush(self) -> None:
+        """Close the current window now and publish synchronously."""
+        with self._lock:
+            self._close_window_locked()
+        self._publish_queued()
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._timer is not None:
+            self._timer.join(timeout=2.0)
+        self.flush()

@@ -1,0 +1,248 @@
+"""Sketch-delta frame codec (versioned, protobuf-framed, endian-independent).
+
+One frame per (agent, closed window) carries every MERGEABLE sketch table —
+the structures whose merge operators are exact by construction:
+
+- Count-Min planes           merge = elementwise add (linearity)
+- HLL register banks         merge = elementwise max
+- top-K candidate table      merge = concat + re-score vs the merged CM
+- latency log-histograms     merge = elementwise add
+- signal-plane window rates  merge = elementwise add (rates are additive)
+- window totals              merge = add
+
+EWMA *baselines* (mean/var) deliberately stay agent-local: the aggregator
+keeps its own cluster-level baselines over the merged per-window rates, so a
+fleet-wide surge scores against fleet history, not against any one host's.
+
+This module is jax-free on purpose: frame bytes must be producible and
+decodable on the big-endian qemu CI tier (tests/test_federation_golden.py
+pins a golden frame there, alongside test_pb_golden.py), and report-side
+encoding must never dispatch a device op. Tensor payloads are ALWAYS
+little-endian (explicit ``<`` numpy dtypes) regardless of host order.
+
+`TABLE_SPEC` is the canonical table-snapshot layout. The sketch checkpoint
+format stamps a fingerprint of the same spec (`sketch/checkpoint.py`), so a
+layout change bumps both surfaces together and both are pinned against the
+same goldens.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Mapping, NamedTuple
+
+import numpy as np
+
+from netobserv_tpu.pb import sketch_delta_pb2 as pb
+
+#: bump on ANY change to TABLE_SPEC, tensor encoding, or frame semantics.
+DELTA_FORMAT_VERSION = 1
+
+CODEC_RAW = 0
+CODEC_ZLIB = 1
+
+_DTYPE_TO_CODE = {"<f4": 1, "<i4": 2, "<u4": 3}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+
+#: canonical (name, little-endian dtype) of every tensor in a frame, in
+#: frame order. `sketch.state.state_tables` produces exactly these names;
+#: `scalars` packs the six window totals in SCALAR_FIELDS order.
+TABLE_SPEC: tuple[tuple[str, str], ...] = (
+    ("cm_bytes", "<f4"),
+    ("cm_pkts", "<f4"),
+    ("heavy_words", "<u4"),
+    ("heavy_h1", "<u4"),
+    ("heavy_h2", "<u4"),
+    ("heavy_counts", "<f4"),
+    ("heavy_valid", "<u4"),
+    ("hll_src", "<i4"),
+    ("hll_per_dst", "<i4"),
+    ("hll_per_src", "<i4"),
+    ("hist_rtt", "<f4"),
+    ("hist_dns", "<f4"),
+    ("ddos_rate", "<f4"),
+    ("syn_rate", "<f4"),
+    ("synack", "<f4"),
+    ("drops_rate", "<f4"),
+    ("drop_causes", "<f4"),
+    ("dscp_bytes", "<f4"),
+    ("conv_fwd", "<f4"),
+    ("conv_rev", "<f4"),
+    ("scalars", "<f4"),
+)
+
+#: layout of the `scalars` tensor (window totals; all additive)
+SCALAR_FIELDS = ("total_records", "total_bytes", "total_drop_bytes",
+                 "total_drop_packets", "quic_records", "nat_records")
+
+#: frame-header geometry fields (validated by the aggregator BEFORE its
+#: fixed-shape jitted merge ever sees the tensors)
+DIM_FIELDS = ("cm_depth", "cm_width", "hll_precision", "topk",
+              "ewma_buckets")
+
+
+class DeltaFrameError(ValueError):
+    """Malformed/incomplete frame (decode-time validation failure)."""
+
+
+class DeltaVersionError(DeltaFrameError):
+    """Frame format version does not match DELTA_FORMAT_VERSION."""
+
+
+class DeltaFrame(NamedTuple):
+    """Decoded frame: header metadata + the table dict (TABLE_SPEC names ->
+    little-endian numpy arrays, read-only views over the frame buffer)."""
+
+    version: int
+    agent_id: str
+    window: int
+    ts_ms: int
+    dims: dict
+    tables: dict
+
+
+def table_spec_fingerprint() -> int:
+    """Stable fingerprint of the canonical snapshot layout — stamped into
+    sketch checkpoints so the two table-snapshot surfaces (delta frame,
+    checkpoint) drift together or not at all."""
+    text = ";".join(f"{n}:{d}" for n, d in TABLE_SPEC) + \
+        "|" + ",".join(SCALAR_FIELDS)
+    return zlib.crc32(text.encode())
+
+
+def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
+                 window: int, ts_ms: int, dims: Mapping[str, int],
+                 codec: int = CODEC_ZLIB) -> bytes:
+    """Serialize a table snapshot into one SketchDelta frame.
+
+    `tables` must carry every TABLE_SPEC name (host numpy arrays; dtype is
+    coerced to the spec's little-endian type). `codec=CODEC_ZLIB` deflates
+    each tensor but keeps raw whenever deflate does not shrink it (the
+    per-tensor codec field records which one shipped).
+    """
+    missing = [n for n, _ in TABLE_SPEC if n not in tables]
+    if missing:
+        raise DeltaFrameError(f"table snapshot missing tensors: {missing}")
+    frame = pb.SketchDelta(
+        version=DELTA_FORMAT_VERSION, agent_id=agent_id,
+        window=int(window), ts_ms=int(ts_ms))
+    for f in DIM_FIELDS:
+        setattr(frame, f, int(dims[f]))
+    for name, dt in TABLE_SPEC:
+        arr = np.ascontiguousarray(np.asarray(tables[name]), dtype=dt)
+        raw = arr.tobytes()
+        t = frame.tensors.add()
+        t.name = name
+        t.dtype = _DTYPE_TO_CODE[dt]
+        t.shape.extend(int(s) for s in arr.shape)
+        if codec == CODEC_ZLIB:
+            packed = zlib.compress(raw, 1)
+            if len(packed) < len(raw):
+                t.codec, t.data = CODEC_ZLIB, packed
+            else:
+                t.codec, t.data = CODEC_RAW, raw
+        elif codec == CODEC_RAW:
+            t.codec, t.data = CODEC_RAW, raw
+        else:
+            raise DeltaFrameError(f"unknown codec {codec}")
+    return frame.SerializeToString(deterministic=True)
+
+
+#: hard per-tensor size ceiling (decoded bytes). Production tables top out
+#: around cm_depth*cm_width*4 ≈ 1 MiB; this bounds what a hostile/corrupt
+#: frame can make the aggregator allocate BEFORE any shape validation —
+#: both via a declared-huge shape and via a zlib bomb (decompression is
+#: capped at the declared size, never "whatever the stream inflates to").
+MAX_TENSOR_BYTES = 1 << 27  # 128 MiB
+
+#: spec dtype per tensor name — decode rejects a frame whose tensor dtype
+#: disagrees (a same-shape foreign dtype would otherwise reach the
+#: aggregator's fixed-signature jitted merge and force a retrace)
+_SPEC_DTYPES = dict(TABLE_SPEC)
+
+
+def decode_frame(data: bytes) -> DeltaFrame:
+    """Parse + validate one frame. Raises DeltaVersionError on a format
+    version mismatch and DeltaFrameError on anything structurally wrong
+    (unknown tensor name, dtype drift from TABLE_SPEC, size over
+    MAX_TENSOR_BYTES, payload/shape mismatch); the tensor arrays are
+    zero-copy read-only views over the frame bytes (copy before
+    mutating)."""
+    frame = pb.SketchDelta()
+    try:
+        frame.ParseFromString(data)
+    except Exception as exc:
+        raise DeltaFrameError(f"unparseable delta frame: {exc}") from exc
+    if frame.version != DELTA_FORMAT_VERSION:
+        raise DeltaVersionError(
+            f"delta frame version {frame.version} != supported "
+            f"{DELTA_FORMAT_VERSION} (agent {frame.agent_id!r})")
+    tables: dict[str, np.ndarray] = {}
+    for t in frame.tensors:
+        spec_dt = _SPEC_DTYPES.get(t.name)
+        if spec_dt is None:
+            raise DeltaFrameError(
+                f"unknown tensor {t.name!r} (not in TABLE_SPEC)")
+        dt = _CODE_TO_DTYPE.get(t.dtype)
+        if dt is None:
+            raise DeltaFrameError(f"tensor {t.name!r}: unknown dtype code "
+                                  f"{t.dtype}")
+        if dt != spec_dt:
+            raise DeltaFrameError(
+                f"tensor {t.name!r}: dtype {dt} != spec {spec_dt}")
+        shape = tuple(int(s) for s in t.shape)
+        n_elems = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        expected = n_elems * np.dtype(dt).itemsize
+        if not 0 <= expected <= MAX_TENSOR_BYTES:
+            raise DeltaFrameError(
+                f"tensor {t.name!r}: declared shape {shape} wants "
+                f"{expected} bytes (cap {MAX_TENSOR_BYTES})")
+        if t.codec == CODEC_ZLIB:
+            # bounded inflate: never allocate past the DECLARED size, and
+            # the stream must end exactly there (bomb/corruption guard)
+            d = zlib.decompressobj()
+            try:
+                raw = d.decompress(t.data, expected)
+            except zlib.error as exc:
+                raise DeltaFrameError(
+                    f"tensor {t.name!r}: bad zlib stream: {exc}") from exc
+            if len(raw) != expected or not d.eof or d.unconsumed_tail:
+                raise DeltaFrameError(
+                    f"tensor {t.name!r}: zlib payload inflates to "
+                    f"{len(raw)}B (eof={d.eof}), declared {expected}B")
+        elif t.codec == CODEC_RAW:
+            raw = t.data
+            if len(raw) != expected:
+                raise DeltaFrameError(
+                    f"tensor {t.name!r}: payload is {len(raw)}B, shape "
+                    f"{shape} wants {expected}B")
+        else:
+            raise DeltaFrameError(f"tensor {t.name!r}: unknown codec "
+                                  f"{t.codec}")
+        tables[t.name] = np.frombuffer(raw, dtype=dt).reshape(shape)
+    missing = [n for n, _ in TABLE_SPEC if n not in tables]
+    if missing:
+        raise DeltaFrameError(f"delta frame missing tensors: {missing}")
+    dims = {f: int(getattr(frame, f)) for f in DIM_FIELDS}
+    return DeltaFrame(version=int(frame.version), agent_id=frame.agent_id,
+                      window=int(frame.window), ts_ms=int(frame.ts_ms),
+                      dims=dims, tables=tables)
+
+
+def expected_shapes(template_tables: Mapping[str, np.ndarray]) -> dict:
+    """Shape dict of a snapshot (the aggregator's fixed-shape contract)."""
+    return {n: tuple(np.asarray(template_tables[n]).shape)
+            for n, _ in TABLE_SPEC}
+
+
+def validate_shapes(frame: DeltaFrame,
+                    expected: Mapping[str, tuple]) -> None:
+    """Reject a frame whose tensor shapes differ from the aggregator's own
+    snapshot template — a foreign shape must never reach the jitted merge
+    (it would retrace; the fixed-shape invariant is load-bearing)."""
+    for name, shape in expected.items():
+        got = tuple(frame.tables[name].shape)
+        if got != tuple(shape):
+            raise DeltaFrameError(
+                f"tensor {name!r}: shape {got} != aggregator's {shape} "
+                f"(agent {frame.agent_id!r} runs a different SketchConfig)")

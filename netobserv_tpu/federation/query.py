@@ -1,0 +1,150 @@
+"""Cluster-wide query surface: non-blocking HTTP over the aggregator.
+
+Same off-hot-path rules as /debug/traces: every route reads the HOST-side
+snapshot the aggregator published at its last window roll (or pure-numpy
+math over it) — a request never dispatches a device op, takes the
+aggregator's merge lock, or waits on anything the delta-ingest path needs.
+Also answers /healthz + /readyz with the supervised-stage semantics of
+`metrics/server.py` so the aggregator tier deploys behind the same probes
+as the agents.
+
+Routes (all GET, JSON):
+
+- /federation/topk          cluster-wide heavy hitters (?n= caps the list)
+- /federation/frequency     CM estimate + error bars for one 5-tuple
+                            (?src=&dst=&src_port=&dst_port=&proto=)
+- /federation/cardinality   global distinct-source estimate + totals
+- /federation/victims       suspect buckets per signal with victim names
+- /federation/status        per-agent delta freshness + plane counters
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+log = logging.getLogger("netobserv_tpu.federation.query")
+
+_READY_STATUSES = ("Started",)
+_LIVE_STATUSES = ("NotStarted", "Starting", "Started", "Degraded",
+                  "Stopping")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    aggregator = None                      # set per-server subclass
+    health_source: Optional[Callable[[], dict]] = None
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        url = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        path = url.path
+        try:
+            if path in ("/healthz", "/readyz"):
+                self._serve_health(path)
+                return
+            if path in ("/", "/federation", "/federation/"):
+                self._json(200, {"routes": [
+                    "/federation/topk", "/federation/frequency",
+                    "/federation/cardinality", "/federation/victims",
+                    "/federation/status", "/healthz", "/readyz"]})
+                return
+            if path == "/federation/status":
+                self._json(200, self.aggregator.status())
+                return
+            snap = self.aggregator.snapshot()
+            if path == "/federation/frequency":
+                if not q.get("src") or not q.get("dst"):
+                    self._json(400, {"error": "src and dst are required"})
+                    return
+                out = self.aggregator.query_frequency(
+                    q["src"], q["dst"], int(q.get("src_port", 0)),
+                    int(q.get("dst_port", 0)), int(q.get("proto", 0)))
+                if out is None:
+                    self._no_window()
+                    return
+                self._json(200, out)
+                return
+            if snap is None and path.startswith("/federation/"):
+                self._no_window()
+                return
+            report = snap["report"]
+            if path == "/federation/topk":
+                n = max(1, min(int(q.get("n", 100)), 1024))
+                self._json(200, {
+                    "window": snap["window"], "ts_ms": snap["ts_ms"],
+                    "topk": report["HeavyHitters"][:n]})
+                return
+            if path == "/federation/cardinality":
+                self._json(200, {
+                    "window": snap["window"], "ts_ms": snap["ts_ms"],
+                    "distinct_src_estimate":
+                        report["DistinctSrcEstimate"],
+                    "records": report["Records"],
+                    "bytes": report["Bytes"]})
+                return
+            if path == "/federation/victims":
+                self._json(200, {
+                    "window": snap["window"], "ts_ms": snap["ts_ms"],
+                    "ddos": report["DdosSuspectBuckets"],
+                    "syn_flood": report["SynFloodSuspectBuckets"],
+                    "port_scan": report["PortScanSuspectBuckets"],
+                    "drop_storm": report["DropAnomalyBuckets"],
+                    "asym_conv":
+                        report["AsymmetricConversationBuckets"]})
+                return
+            self.send_error(404)
+        except Exception as exc:  # the query surface must keep answering
+            log.error("federation query %s failed: %s", path, exc)
+            self._json(500, {"error": str(exc)})
+
+    def _no_window(self) -> None:
+        self._json(503, {"error": "no window published yet"})
+
+    def _serve_health(self, path: str) -> None:
+        try:
+            health = self.health_source() if self.health_source else {
+                "status": "Started", "degraded": False, "stages": {}}
+        except Exception as exc:
+            health = {"status": "Unknown", "degraded": True,
+                      "error": str(exc), "stages": {}}
+        status = health.get("status", "Unknown")
+        degraded = bool(health.get("degraded"))
+        if path == "/readyz":
+            ok = status in _READY_STATUSES and not degraded
+        else:
+            ok = status in _LIVE_STATUSES
+        self._json(200 if ok else 503, health)
+
+    def _json(self, code: int, obj: dict) -> None:
+        payload = json.dumps(obj, separators=(",", ":")).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, fmt, *args):
+        log.debug("federation query http: " + fmt, *args)
+
+
+def start_query_server(aggregator, port: int, address: str = "",
+                       health_source: Optional[Callable[[], dict]] = None,
+                       ) -> ThreadingHTTPServer:
+    """Start the query surface on a daemon thread; returns the server."""
+    handler = type("Handler", (_Handler,),
+                   {"aggregator": aggregator,
+                    "health_source": (staticmethod(health_source)
+                                      if health_source is not None
+                                      else None)})
+    srv = ThreadingHTTPServer((address or "0.0.0.0", port), handler)
+    srv.timeout = 10
+    t = threading.Thread(target=srv.serve_forever,
+                         name="federation-query", daemon=True)
+    t.start()
+    log.info("federation query surface on %s:%d", address or "0.0.0.0",
+             srv.server_address[1])
+    return srv

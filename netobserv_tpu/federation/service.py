@@ -1,0 +1,133 @@
+"""Aggregator-tier process wiring (`FEDERATION_MODE=aggregator`).
+
+Mirrors `agent.FlowsAgent`'s shape: a status machine, a supervisor watching
+every background stage (the aggregator's window timer), /healthz + /readyz
+surfaced from the same snapshot contract, SIGTERM-driven shutdown via
+`__main__`. Assembles: the Federation gRPC collector (delta ingest), the
+`FederationAggregator` (device merge + windowed cluster reports), the HTTP
+query surface, and optionally the Prometheus metrics server.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from netobserv_tpu.agent.supervisor import Supervisor
+from netobserv_tpu.federation.aggregator import FederationAggregator
+from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+
+log = logging.getLogger("netobserv_tpu.federation.service")
+
+
+class FederationAggregatorService:
+    """The central aggregator as a runnable process."""
+
+    def __init__(self, cfg, metrics: Optional[Metrics] = None,
+                 sink=None):
+        from netobserv_tpu.exporter.tpu_sketch import make_report_sink
+        from netobserv_tpu.sketch.state import SketchConfig
+
+        self.cfg = cfg
+        self.metrics = metrics or Metrics(MetricsSettings(
+            prefix=cfg.metrics_prefix, level=cfg.metrics_level))
+        self._status = "Starting"
+        self._status_lock = threading.Lock()
+        self.aggregator = FederationAggregator(
+            sketch_cfg=SketchConfig.from_agent_config(cfg),
+            window_s=cfg.federation_window,
+            mesh_shape=cfg.federation_mesh_shape,
+            metrics=self.metrics,
+            sink=sink if sink is not None else make_report_sink(cfg),
+            stale_after_s=cfg.federation_stale_after,
+            report_kwargs=dict(
+                scan_fanout_threshold=cfg.sketch_scan_fanout,
+                ddos_z_threshold=cfg.sketch_ddos_z,
+                synflood_min=cfg.sketch_synflood_min,
+                synflood_ratio=cfg.sketch_synflood_ratio,
+                drop_z_threshold=cfg.sketch_drop_z,
+                asym_min_bytes=cfg.sketch_asym_min_bytes,
+                asym_ratio=cfg.sketch_asym_ratio))
+        self.supervisor = Supervisor(
+            metrics=self.metrics,
+            check_period_s=cfg.supervisor_check_period,
+            on_degraded=self._on_degraded)
+        self.aggregator.register_supervised(
+            self.supervisor,
+            heartbeat_timeout_s=cfg.supervisor_heartbeat_timeout,
+            max_restarts=cfg.supervisor_max_restarts,
+            backoff_initial_s=cfg.supervisor_backoff_initial,
+            backoff_max_s=cfg.supervisor_backoff_max,
+            healthy_reset_s=cfg.supervisor_healthy_reset)
+        self._grpc_server = None
+        self._query_server = None
+        self._stop = threading.Event()
+        self.grpc_port = 0
+        self.query_port = 0
+
+    def _on_degraded(self, stage: str) -> None:
+        with self._status_lock:
+            if self._status == "Started":
+                self._status = "Degraded"
+        log.error("aggregator DEGRADED: stage %s exhausted its restart "
+                  "budget", stage)
+
+    def health_snapshot(self) -> dict:
+        with self._status_lock:
+            status = self._status
+        return {"status": status,
+                "degraded": self.supervisor.degraded,
+                "stages": self.supervisor.snapshot()}
+
+    def start(self) -> None:
+        from netobserv_tpu.federation.query import start_query_server
+        from netobserv_tpu.grpc.federation import start_federation_collector
+
+        cfg = self.cfg
+        self._grpc_server, self.grpc_port, _ = start_federation_collector(
+            port=cfg.federation_listen_port,
+            handler=self.aggregator.ingest_frame,
+            tls_cert=cfg.metrics_tls_cert_path,
+            tls_key=cfg.metrics_tls_key_path)
+        if cfg.federation_query_port >= 0:
+            self._query_server = start_query_server(
+                self.aggregator, cfg.federation_query_port,
+                health_source=self.health_snapshot)
+            self.query_port = self._query_server.server_address[1]
+        # NOTE: the Prometheus /metrics server is started by __main__ (the
+        # same wiring every agent gets); this service only owns the two
+        # federation-specific surfaces (delta ingest gRPC, query HTTP)
+        if cfg.supervisor_enable:
+            self.supervisor.start()
+        with self._status_lock:
+            self._status = "Started"
+        log.info("federation aggregator up: deltas on :%d, queries on :%s",
+                 self.grpc_port,
+                 self.query_port if self._query_server else "disabled")
+
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        self.start()
+        self._active_stop = stop = stop or self._stop
+        stop.wait()
+        self.shutdown()
+
+    def stop(self) -> None:
+        self._stop.set()
+        active = getattr(self, "_active_stop", None)
+        if active is not None:
+            active.set()
+
+    def shutdown(self) -> None:
+        with self._status_lock:
+            if self._status in ("Stopping", "Stopped"):
+                return
+            self._status = "Stopping"
+        self.supervisor.stop()
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=2.0)
+        self.aggregator.close()  # final window publishes synchronously
+        if self._query_server is not None:
+            self._query_server.shutdown()
+        with self._status_lock:
+            self._status = "Stopped"

@@ -1,0 +1,104 @@
+"""Federation gRPC plumbing (service `pbsketch.Federation`).
+
+Same shape as `grpc/flow.py` (the proven Collector plumbing): a thin unary
+client and an in-process server helper. Delta frames travel as RAW BYTES on
+both ends (serializer/deserializer pass-through) — the one
+encode/decode site is `federation.delta`, so the gRPC layer cannot drift
+from the frame format, and the aggregator can count/reject malformed frames
+itself instead of dying in the transport.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+from typing import Callable, Optional
+
+import grpc
+
+from netobserv_tpu.grpc.flow import _channel_credentials
+from netobserv_tpu.pb import sketch_delta_pb2
+
+log = logging.getLogger("netobserv_tpu.grpc.federation")
+
+_PUSH = "/pbsketch.Federation/Push"
+
+_identity = lambda b: b  # noqa: E731 — raw-bytes pass-through
+
+
+class FederationClient:
+    """Unary Push client; `send` takes an ALREADY-SERIALIZED delta frame."""
+
+    def __init__(self, host: str, port: int, tls_ca: str = "",
+                 tls_cert: str = "", tls_key: str = ""):
+        self._target = f"{host}:{port}"
+        self._creds = _channel_credentials(tls_ca, tls_cert, tls_key)
+        self._channel: Optional[grpc.Channel] = None
+        self._push = None
+        self.connect()
+
+    def connect(self) -> None:
+        self.close()
+        if self._creds is not None:
+            self._channel = grpc.secure_channel(self._target, self._creds)
+        else:
+            self._channel = grpc.insecure_channel(self._target)
+        self._push = self._channel.unary_unary(
+            _PUSH,
+            request_serializer=_identity,
+            response_deserializer=sketch_delta_pb2.DeltaAck.FromString,
+        )
+
+    def send(self, frame: bytes,
+             timeout_s: float = 10.0) -> sketch_delta_pb2.DeltaAck:
+        return self._push(frame, timeout=timeout_s)
+
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+
+
+def start_federation_collector(
+        port: int = 0,
+        handler: Optional[Callable[[bytes], sketch_delta_pb2.DeltaAck]] = None,
+        out: Optional["queue.Queue[bytes]"] = None,
+        tls_cert: str = "", tls_key: str = "", max_workers: int = 4):
+    """In-process Federation server; returns (server, bound_port, queue).
+
+    `handler(frame_bytes) -> DeltaAck` is the aggregator's ingest entry;
+    without one, frames land on `out` and are blanket-acked (test harness
+    shape, like `start_flow_collector`). A handler exception acks
+    `accepted=0` with the reason — a malformed frame must never tear down
+    the stream every OTHER agent is pushing on.
+    """
+    from concurrent import futures
+
+    out = out if out is not None else queue.Queue()
+
+    def push(request: bytes, context) -> sketch_delta_pb2.DeltaAck:
+        if handler is None:
+            out.put(request)
+            return sketch_delta_pb2.DeltaAck(accepted=1)
+        try:
+            return handler(request)
+        except Exception as exc:  # swallow: one bad frame, not the server
+            log.error("federation push handler failed: %s", exc)
+            return sketch_delta_pb2.DeltaAck(accepted=0, reason=str(exc))
+
+    generic = grpc.method_handlers_generic_handler(
+        "pbsketch.Federation",
+        {"Push": grpc.unary_unary_rpc_method_handler(
+            push,
+            request_deserializer=_identity,
+            response_serializer=sketch_delta_pb2.DeltaAck.SerializeToString)})
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((generic,))
+    if tls_cert and tls_key:
+        creds = grpc.ssl_server_credentials(
+            [(open(tls_key, "rb").read(), open(tls_cert, "rb").read())])
+        bound = server.add_secure_port(f"0.0.0.0:{port}", creds)
+    else:
+        bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    server.start()
+    return server, bound, out

@@ -214,6 +214,37 @@ class Metrics:
             "is a multi-second stall; see the retrace watchdog log line "
             "for the offending abstract shapes)", ["fn"],
             registry=self.registry)
+        # federation plane (federation/aggregator.py + the agent-side delta
+        # sink, exporter/federation.py)
+        self.federation_deltas_total = Counter(
+            p + "federation_deltas_total",
+            "Delta frames received by the aggregator, by outcome (ok / "
+            "version_mismatch / shape_mismatch / decode_error / "
+            "merge_error)", ["result"], registry=self.registry)
+        self.federation_delta_bytes_total = Counter(
+            p + "federation_delta_bytes_total",
+            "Wire bytes of received delta frames (the federation plane's "
+            "ingress volume)", registry=self.registry)
+        self.federation_deltas_sent_total = Counter(
+            p + "federation_deltas_sent_total",
+            "Delta frames pushed by this agent, by outcome (ok / rejected "
+            "/ error — error means the retry ladder was exhausted and the "
+            "window's frame was dropped)", ["result"],
+            registry=self.registry)
+        self.federation_merge_seconds = Histogram(
+            p + "federation_merge_seconds",
+            "On-device hierarchical merge latency per accepted delta frame",
+            buckets=(.0005, .001, .005, .01, .05, .1, .5, 1, 5),
+            registry=self.registry)
+        self.federation_agent_staleness_seconds = Gauge(
+            p + "federation_agent_staleness_seconds",
+            "Seconds since each known agent's last accepted delta "
+            "(cardinality = fleet size; an agent past ~2 windows is dark)",
+            ["agent"], registry=self.registry)
+        self.federation_active_agents = Gauge(
+            p + "federation_active_agents",
+            "Agents that contributed a delta to the last aggregator window",
+            registry=self.registry)
 
     # --- convenience methods used by pipeline stages ---
     def observe_eviction(self, source: str, n_flows: int, seconds: float) -> None:

@@ -13,6 +13,14 @@ Environment (standard JAX multi-process contract):
     SKETCH_PROCESS_ID    this process's index
 On TPU pods these usually come from the scheduler and jax.distributed
 auto-detects; the env vars are the manual override.
+
+The federation aggregator tier spans pods with the same machinery under its
+own prefix (FEDERATION_COORDINATOR / FEDERATION_NUM_PROCESSES /
+FEDERATION_PROCESS_ID) so a cross-pod aggregator deployment does not
+collide with per-host agents' SKETCH_* settings on shared nodes: agents
+read ONLY the SKETCH_* vars (default `prefixes`); the aggregator passes
+`prefixes=("FEDERATION_", "SKETCH_")` and all three variables resolve from
+the ONE prefix whose COORDINATOR is set (never mixed across prefixes).
 """
 
 from __future__ import annotations
@@ -23,25 +31,36 @@ import os
 log = logging.getLogger("netobserv_tpu.parallel.distributed")
 
 
-def maybe_initialize_distributed() -> bool:
+def maybe_initialize_distributed(
+        prefixes: tuple[str, ...] = ("SKETCH_",)) -> bool:
     """Initialize jax.distributed when configured; returns True if multi-host.
 
-    Safe to call unconditionally: no-op without configuration.
+    Safe to call unconditionally: no-op without configuration. `prefixes`
+    scopes which env-var families this PROCESS may join: per-host agents
+    keep the default (SKETCH_* only — an aggregator's FEDERATION_* vars on
+    a shared node must never pull an agent into the aggregator's mesh);
+    the aggregator tier passes ("FEDERATION_", "SKETCH_"). The first
+    prefix with COORDINATOR set wins, and nproc/pid come from that SAME
+    prefix only.
     """
     import jax
 
-    coord = os.environ.get("SKETCH_COORDINATOR", "")
-    nproc = os.environ.get("SKETCH_NUM_PROCESSES", "")
-    pid = os.environ.get("SKETCH_PROCESS_ID", "")
+    prefix = next((p for p in prefixes
+                   if os.environ.get(p + "COORDINATOR", "")), prefixes[-1])
+    coord_key = prefix + "COORDINATOR"
+    coord = os.environ.get(coord_key, "")
+    nproc = os.environ.get(prefix + "NUM_PROCESSES", "")
+    pid = os.environ.get(prefix + "PROCESS_ID", "")
     if coord and not nproc:
         raise ValueError(
-            "SKETCH_COORDINATOR is set but SKETCH_NUM_PROCESSES is not — "
-            "multi-host init needs both (plus SKETCH_PROCESS_ID per worker)")
+            f"{coord_key} is set but {prefix}NUM_PROCESSES is not — "
+            f"multi-host init needs both (plus {prefix}PROCESS_ID per "
+            "worker)")
     if coord and nproc:
         if not pid:
             raise ValueError(
-                "SKETCH_PROCESS_ID must be set per worker (0..N-1) when "
-                "SKETCH_COORDINATOR/SKETCH_NUM_PROCESSES are configured")
+                f"{prefix}PROCESS_ID must be set per worker (0..N-1) when "
+                f"{coord_key}/{prefix}NUM_PROCESSES are configured")
         jax.distributed.initialize(
             coordinator_address=coord, num_processes=int(nproc),
             process_id=int(pid))

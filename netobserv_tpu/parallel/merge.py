@@ -98,6 +98,13 @@ def _put_global(arr: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
         arr.shape, sharding, shards)
 
 
+def put_replicated(mesh: Mesh, arr: np.ndarray) -> jax.Array:
+    """device_put a host array fully replicated over the mesh (multi-process
+    safe — same explicit per-shard placement as `_put_global`). The
+    federation fold's delta tables ride this."""
+    return _put_global(np.asarray(arr), mesh, P())
+
+
 def init_dist_state(cfg: sk.SketchConfig, mesh: Mesh) -> sk.SketchState:
     """Per-device partial sketch state, zeros, laid out across the mesh."""
     ndata = mesh.shape[DATA_AXIS]
@@ -351,16 +358,76 @@ def merge_states(s: sk.SketchState, nsk: int) -> sk.SketchState:
     )
 
 
+def make_fold_delta_fn(mesh: Mesh, cfg: sk.SketchConfig,
+                       donate: bool = True) -> Callable:
+    """Jitted `(dist_state, tables, owner) -> dist_state` — the FEDERATION
+    aggregator's mesh fold: merge ONE agent's delta-frame tables
+    (`federation.delta.TABLE_SPEC` device arrays, replicated over the mesh)
+    into the data shard that OWNS that agent (`owner`: i32[1], a stable
+    hash of the agent id — deltas from one agent always land in one
+    shard's partial, the per-CPU-map analog one level up). Steady state
+    adds no collectives: every shard computes the masked merge locally;
+    all cross-shard reconciliation stays at window roll
+    (`make_merge_fn`'s two-axis gather), exactly like the flow ingest.
+
+    The federation mesh shards AGENT ownership over the data axis only:
+    a width-sharded (sketch axis > 1) mesh cannot accept deltas, because
+    an owner-sharded CM shard is an INDEPENDENT width-w/nsk sketch (keys
+    re-hash into the local width) — a whole-width delta table has no
+    decomposition into it. Width sharding stays an agent-side feature;
+    use an Nx1 federation mesh."""
+    from netobserv_tpu.federation import statemerge
+
+    nsk = mesh.shape[SKETCH_AXIS]
+    if nsk > 1:
+        raise ValueError(
+            "federation fold requires a data-axis-only mesh (Nx1): "
+            "owner-sharded CM shards re-hash keys into their local width, "
+            f"so a whole-width delta table cannot merge into a {nsk}-way "
+            "width-sharded aggregate")
+    template = sk.init_state(cfg)
+    specs = _state_specs(template)
+
+    def local_fold(pstate: sk.SketchState, t: dict, owner: jax.Array):
+        s = _drop_lead(pstate)
+        mine = jax.lax.axis_index(DATA_AXIS) == owner[0]
+        merged = statemerge.merge_tables(s, t)
+        new = jax.tree.map(lambda a, b: jnp.where(mine, a, b), merged, s)
+        return _add_lead(new)
+
+    shmapped = shard_map_compat(
+        local_fold, mesh=mesh,
+        # tables + owner are replicated to every device; the fold masks
+        in_specs=(specs, P(), P()),
+        out_specs=specs, check=False,
+    )
+    return retrace.watch(
+        jax.jit(shmapped, donate_argnums=(0,) if donate else ()),
+        "federation_fold_delta")
+
+
 def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
                   reset_sketches: bool = True,
-                  decay_factor: float | None = None) -> Callable:
+                  decay_factor: float | None = None,
+                  with_tables: bool = False) -> Callable:
     """Jitted `(dist_state) -> (dist_state, WindowReport)`.
 
     The report is fully replicated (every device computes the cluster-wide
     merge); the returned state is reset for the next window with EWMA baselines
     rolled on the merged rates.
+
+    `with_tables=True` additionally returns the REPLICATED merged table
+    snapshot (`sketch.state.state_tables` of the merged pre-roll state) —
+    the federation aggregator's query-surface source on mesh deployments.
+    Data-axis-only meshes (like the federation fold itself: on a
+    width-sharded mesh the per-shard CM planes are independent local-width
+    sketches with no replicated whole-width form).
     """
     nsk = mesh.shape[SKETCH_AXIS]
+    if with_tables and nsk > 1:
+        raise ValueError("with_tables requires a data-axis-only mesh (Nx1) "
+                         "— width-sharded CM planes have no replicated "
+                         "whole-width snapshot")
     template = sk.init_state(cfg)
     specs = _state_specs(template)
 
@@ -380,6 +447,9 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
     def local_roll(pstate: sk.SketchState):
         s = _drop_lead(pstate)
         merged = merge_states(s, nsk)
+        tables = None
+        if with_tables:
+            tables = sk.state_tables(merged)
         ddos_state, z = ewma.roll(merged.ddos, cfg.ewma_alpha)
         syn_state, syn_z = ewma.roll(merged.syn, cfg.ewma_alpha)
         drops_state, drop_z = ewma.roll(merged.drops_ewma, cfg.ewma_alpha)
@@ -433,11 +503,19 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
                              drops_ewma=drops_state,
                              synack=jnp.zeros_like(s.synack),
                              window=s.window + 1)
+        if with_tables:
+            return _add_lead(new), report, tables
         return _add_lead(new), report
 
+    if with_tables:
+        table_specs = {name: P() for name in
+                       sk.state_tables(sk.init_state(cfg))}
+        out_specs = (specs, report_specs, table_specs)
+    else:
+        out_specs = (specs, report_specs)
     shmapped = shard_map_compat(
         local_roll, mesh=mesh, in_specs=(specs,),
-        out_specs=(specs, report_specs), check=False,
+        out_specs=out_specs, check=False,
     )
     return retrace.watch(jax.jit(shmapped, donate_argnums=(0,)),
                          "sharded_merge")

@@ -9,6 +9,8 @@ restored with the same sharding layout.
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 from typing import Any, Optional
 
@@ -20,6 +22,29 @@ try:
     HAVE_ORBAX = True
 except Exception:  # pragma: no cover - orbax is baked into the image
     HAVE_ORBAX = False
+
+log = logging.getLogger("netobserv_tpu.sketch.checkpoint")
+
+#: checkpoint FORMAT version, stamped next to every save. Version 1 is the
+#: legacy unstamped era (accepted with an upgrade log — its pytree either
+#: restores or fails the structural check exactly as before); bump this
+#: whenever the state layout / table-snapshot spec changes incompatibly.
+#: The federation delta frame reuses the same table snapshot layout, so the
+#: stamp also records `federation.delta`'s spec fingerprint + format
+#: version — the two surfaces are pinned against the same goldens and must
+#: move together (tests/test_federation_golden.py).
+CHECKPOINT_FORMAT_VERSION = 2
+_LEGACY_VERSION = 1
+_STAMP_FILE = "FORMAT.json"
+
+#: known upgrade paths: stamped version -> upgrader (state-identity when the
+#: pytree itself is compatible). Missing entry = reject.
+_UPGRADERS = {_LEGACY_VERSION: lambda state: state}
+
+
+def _spec_fingerprint() -> int:
+    from netobserv_tpu.federation import delta as fdelta
+    return fdelta.table_spec_fingerprint()
 
 
 class SketchCheckpointer:
@@ -36,8 +61,54 @@ class SketchCheckpointer:
                 max_to_keep=max_to_keep, create=True),
         )
 
+    def _stamp_path(self) -> str:
+        return os.path.join(self._dir, _STAMP_FILE)
+
+    def _write_stamp(self) -> None:
+        from netobserv_tpu.federation import delta as fdelta
+        stamp = {"format_version": CHECKPOINT_FORMAT_VERSION,
+                 "table_spec_crc": _spec_fingerprint(),
+                 "delta_format_version": fdelta.DELTA_FORMAT_VERSION}
+        tmp = self._stamp_path() + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(stamp, fh)
+        os.replace(tmp, self._stamp_path())
+
+    def read_stamp(self) -> dict:
+        """The directory's format stamp; legacy (pre-stamp) checkpoints
+        report version 1."""
+        try:
+            with open(self._stamp_path()) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return {"format_version": _LEGACY_VERSION}
+
+    def check_format(self) -> Optional[int]:
+        """Validate the stamp BEFORE any tensor restore. Returns the
+        stamped version when an upgrade path exists (None = current);
+        raises RuntimeError when the checkpoint must be rejected."""
+        stamp = self.read_stamp()
+        version = int(stamp.get("format_version", _LEGACY_VERSION))
+        if version == CHECKPOINT_FORMAT_VERSION:
+            crc = stamp.get("table_spec_crc")
+            if crc is not None and crc != _spec_fingerprint():
+                raise RuntimeError(
+                    f"checkpoint under {self._dir} stamps format "
+                    f"{version} but a different table-snapshot layout "
+                    f"(crc {crc} != {_spec_fingerprint()}): the layout "
+                    "changed without a format bump — refuse rather than "
+                    "restore silently-misaligned tables")
+            return None
+        if version in _UPGRADERS:
+            return version
+        raise RuntimeError(
+            f"checkpoint under {self._dir} has format version {version}; "
+            f"this build reads {CHECKPOINT_FORMAT_VERSION} (known upgrade "
+            f"paths: {sorted(_UPGRADERS)}) — refusing to restore")
+
     def save(self, step: int, state: Any, wait: bool = False) -> None:
         self._mngr.save(step, args=ocp.args.StandardSave(state))
+        self._write_stamp()
         if wait:
             self._mngr.wait_until_finished()
 
@@ -46,7 +117,11 @@ class SketchCheckpointer:
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
         """Restore into the shardings/dtypes of `template` (an abstract or
-        concrete state pytree laid out as desired)."""
+        concrete state pytree laid out as desired). Rejects checkpoints
+        whose format stamp has no upgrade path; legacy/upgradable stamps
+        restore through their upgrader (the structural template check
+        still guards the pytree itself)."""
+        old_version = self.check_format()  # raises on reject
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self._dir}")
@@ -54,7 +129,13 @@ class SketchCheckpointer:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=getattr(x, "sharding", None)),
             template)
-        return self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        restored = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        if old_version is not None:
+            log.info("upgrading sketch checkpoint format %d -> %d",
+                     old_version, CHECKPOINT_FORMAT_VERSION)
+            restored = _UPGRADERS[old_version](restored)
+        return restored
 
     def close(self) -> None:
         self._mngr.wait_until_finished()

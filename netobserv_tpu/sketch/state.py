@@ -921,6 +921,53 @@ def roll_window(state: SketchState, cfg: SketchConfig,
     return new_state, report
 
 
+def state_tables(state: SketchState) -> dict[str, jax.Array]:
+    """The MERGEABLE table snapshot of a (pre-roll) state — the device twin
+    of the federation delta-frame layout (`federation.delta.TABLE_SPEC`; the
+    encoder itself is jax-free). Every entry merges exactly: CM planes and
+    histograms add, HLL registers max, top-K candidates concat + re-score,
+    signal-plane window rates add. EWMA baselines (mean/var) are absent by
+    design — the aggregator keeps its own cluster-level baselines."""
+    return {
+        "cm_bytes": state.cm_bytes.counts,
+        "cm_pkts": state.cm_pkts.counts,
+        "heavy_words": state.heavy.words,
+        "heavy_h1": state.heavy.h1,
+        "heavy_h2": state.heavy.h2,
+        "heavy_counts": state.heavy.counts,
+        "heavy_valid": state.heavy.valid,
+        "hll_src": state.hll_src.regs,
+        "hll_per_dst": state.hll_per_dst.regs,
+        "hll_per_src": state.hll_per_src.regs,
+        "hist_rtt": state.hist_rtt.counts,
+        "hist_dns": state.hist_dns.counts,
+        "ddos_rate": state.ddos.rate,
+        "syn_rate": state.syn.rate,
+        "synack": state.synack,
+        "drops_rate": state.drops_ewma.rate,
+        "drop_causes": state.drop_causes,
+        "dscp_bytes": state.dscp_bytes,
+        "conv_fwd": state.conv_fwd,
+        "conv_rev": state.conv_rev,
+        # federation.delta.SCALAR_FIELDS order
+        "scalars": jnp.stack([
+            state.total_records, state.total_bytes,
+            state.total_drop_bytes, state.total_drop_packets,
+            state.quic_records, state.nat_records]),
+    }
+
+
 def make_roll_fn(cfg: SketchConfig, reset_sketches: bool = True,
-                 decay_factor: float | None = None):
+                 decay_factor: float | None = None,
+                 with_tables: bool = False):
+    """Jitted window roll. `with_tables=True` additionally returns the
+    PRE-roll mergeable table snapshot (`state_tables`) for the federation
+    delta export — one extra output of the same executable, so a due window
+    still dispatches exactly one device program."""
+    if with_tables:
+        def fn(s):
+            new_state, report = roll_window(s, cfg, reset_sketches,
+                                            decay_factor)
+            return new_state, report, state_tables(s)
+        return jax.jit(fn)
     return jax.jit(lambda s: roll_window(s, cfg, reset_sketches, decay_factor))

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Federation smoke: two in-process agents -> local aggregator -> query.
+
+`make smoke-federation` (non-gating CI artifact, like bench-host/
+bench-evict): spins up a FederationAggregatorService on ephemeral ports,
+two TpuSketchExporters pushing delta frames through the REAL gRPC seam,
+folds a deterministic record stream through each, flushes both windows,
+and asserts the cluster-wide /federation/topk answer merges both agents'
+traffic. Prints ONE JSON line with what it saw.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from netobserv_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+
+    from netobserv_tpu.config import AgentConfig
+    from netobserv_tpu.exporter.federation import FederationDeltaSink
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.federation.service import FederationAggregatorService
+    from netobserv_tpu.model.flow import FlowKey
+    from netobserv_tpu.model.record import Record
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    cfg = AgentConfig()
+    cfg.sketch_cm_depth, cfg.sketch_cm_width = 2, 4096
+    cfg.sketch_hll_precision, cfg.sketch_topk = 8, 128
+    cfg.federation_listen_port = 0   # ephemeral
+    cfg.federation_query_port = 0    # ephemeral
+    cfg.federation_window = 3600.0
+    reports: list[dict] = []
+    svc = FederationAggregatorService(cfg, sink=reports.append)
+    svc.start()
+
+    def make_records(agent: int, n: int = 256) -> list[Record]:
+        now = time.time_ns()
+        out = []
+        for i in range(n):
+            # one shared mega-flow both agents see + per-agent chatter
+            if i % 4 == 0:
+                key = FlowKey.make("10.9.9.9", "10.8.8.8", 5000, 443, 6)
+                nbytes = 1_000_000
+            else:
+                key = FlowKey.make(f"10.{agent}.0.{i % 50}",
+                                   f"10.{agent}.1.{i % 20}",
+                                   1024 + i, 443, 6)
+                nbytes = 1000 + i
+            out.append(Record(
+                key=key, bytes_=nbytes, packets=3, eth_protocol=0x0800,
+                tcp_flags=0x12, direction=1, if_index=1, interface="eth0",
+                time_flow_start_ns=now - 10**9, time_flow_end_ns=now))
+        return out
+
+    sketch_cfg = SketchConfig(cm_depth=2, cm_width=4096, hll_precision=8,
+                              topk=128)
+    agents = []
+    for a in range(2):
+        sink = FederationDeltaSink("127.0.0.1", svc.grpc_port,
+                                   metrics=svc.metrics)
+        exp = TpuSketchExporter(
+            batch_size=256, window_s=3600.0, sketch_cfg=sketch_cfg,
+            sink=lambda obj: None, delta_sink=sink,
+            agent_id=f"smoke-agent-{a}")
+        exp.export_batch(make_records(a))
+        exp.flush()   # closes the window and pushes the delta frame
+        agents.append(exp)
+
+    svc.aggregator.flush()  # close the aggregator window, publish
+
+    def get(path: str) -> dict:
+        url = f"http://127.0.0.1:{svc.query_port}{path}"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    topk = get("/federation/topk?n=10")
+    status = get("/federation/status")
+    card = get("/federation/cardinality")
+    freq = get("/federation/frequency?src=10.9.9.9&dst=10.8.8.8"
+               "&src_port=5000&dst_port=443&proto=6")
+    healthz = get("/healthz")
+
+    ok = True
+    notes = []
+    if len(status["agents"]) != 2:
+        ok, _ = False, notes.append("expected 2 agents in /status")
+    hh = topk["topk"]
+    if not hh or hh[0]["SrcAddr"] != "10.9.9.9":
+        ok, _ = False, notes.append(
+            "shared mega-flow is not the top heavy hitter")
+    if card["records"] != 512.0:
+        ok, _ = False, notes.append(f"records {card['records']} != 512")
+    if freq["est_bytes"] < 2 * 64 * 1_000_000:  # both agents' shares
+        ok, _ = False, notes.append("frequency underestimates the "
+                                    "cluster-wide mega-flow")
+    if healthz.get("status") != "Started":
+        ok, _ = False, notes.append(f"healthz says {healthz.get('status')}")
+
+    for exp in agents:
+        exp.close()
+    svc.shutdown()
+    print(json.dumps({
+        "metric": "smoke_federation", "ok": ok, "notes": notes,
+        "agents": sorted(status["agents"]),
+        "top1": hh[0] if hh else None,
+        "records": card["records"],
+        "distinct_src_estimate": card["distinct_src_estimate"],
+        "megaflow_est_bytes": freq["est_bytes"],
+        "megaflow_bound_bytes": freq["overestimate_bound_bytes"],
+        "reports_published": len(reports),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,75 @@ def test_roundtrip_distributed(tmp_path):
     ckpt.close()
 
 
+def test_format_version_stamped_and_checked(tmp_path):
+    """Every save stamps FORMAT.json; restore validates it BEFORE touching
+    tensors: unknown versions are rejected, the legacy (unstamped) era
+    upgrades through the identity path, and a spec-fingerprint mismatch at
+    the current version is refused (layout changed without a bump)."""
+    import json
+    import os
+
+    import pytest
+
+    from netobserv_tpu.federation import delta as fdelta
+    from netobserv_tpu.sketch import checkpoint as ck
+
+    s = sk.init_state(CFG)
+    ckpt = SketchCheckpointer(str(tmp_path / "ck"))
+    ckpt.save(0, s, wait=True)
+    stamp_path = os.path.join(str(tmp_path / "ck"), "FORMAT.json")
+    stamp = json.load(open(stamp_path))
+    assert stamp["format_version"] == ck.CHECKPOINT_FORMAT_VERSION
+    # the delta frame reuses the table snapshot layout: both surfaces pin
+    # the same fingerprint (tests/test_federation_golden.py pins its value)
+    assert stamp["table_spec_crc"] == fdelta.table_spec_fingerprint()
+    assert stamp["delta_format_version"] == fdelta.DELTA_FORMAT_VERSION
+    ckpt.restore(s)  # current version restores
+
+    # unknown future version -> rejected before any tensor read
+    json.dump({"format_version": ck.CHECKPOINT_FORMAT_VERSION + 41},
+              open(stamp_path, "w"))
+    with pytest.raises(RuntimeError, match="format version"):
+        ckpt.restore(s)
+
+    # fingerprint drift at the current version -> rejected loudly
+    json.dump({"format_version": ck.CHECKPOINT_FORMAT_VERSION,
+               "table_spec_crc": 12345}, open(stamp_path, "w"))
+    with pytest.raises(RuntimeError, match="layout"):
+        ckpt.restore(s)
+
+    # legacy unstamped checkpoint -> upgrades (identity), still restores
+    os.remove(stamp_path)
+    restored = ckpt.restore(s)
+    for a, b in zip(jax.tree.leaves(s), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ckpt.close()
+
+
+def test_rejected_format_degrades_to_fresh_window(tmp_path):
+    """A version-rejected checkpoint must not kill the exporter — same
+    degrade-to-fresh-window path as a structurally incompatible one."""
+    import json
+    import os
+
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.sketch import checkpoint as ck
+
+    ckpt = SketchCheckpointer(str(tmp_path / "ck"))
+    ckpt.save(1, sk.init_state(CFG), wait=True)
+    ckpt.close()
+    json.dump({"format_version": ck.CHECKPOINT_FORMAT_VERSION + 1},
+              open(os.path.join(str(tmp_path / "ck"), "FORMAT.json"), "w"))
+    reports = []
+    exp = TpuSketchExporter(
+        batch_size=16, window_s=3600, sketch_cfg=CFG,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1,
+        sink=reports.append)
+    exp.flush()
+    exp.close()
+    assert reports and reports[0]["Records"] == 0.0
+
+
 def test_incompatible_checkpoint_degrades_to_fresh_window(tmp_path):
     """A checkpoint from an OLDER state layout (e.g. round-3 states lacking
     the signal planes) must not kill the exporter: restore raises, the

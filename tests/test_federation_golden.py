@@ -1,0 +1,111 @@
+"""Endian-independent golden vector for the sketch-delta frame codec.
+
+NO jax: like test_pb_golden.py / the hashing-twin goldens, this suite runs
+on the big-endian qemu-s390x CI tier, where it proves the delta frame's
+explicit little-endian tensor encoding survives a foreign host byte order
+byte-for-byte — a BE aggregator and an LE agent (or vice versa) speak the
+same wire format. The golden file pins frame bytes AND the table-spec
+fingerprint: changing TABLE_SPEC, the tensor encoding, or the protobuf
+schema without bumping DELTA_FORMAT_VERSION fails here (the checkpoint
+format stamps the same fingerprint — the two snapshot surfaces move
+together, sketch/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from netobserv_tpu.federation import delta as fdelta
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "sketch_delta_v1.hex")
+
+#: tiny-but-representative shapes per tensor (the codec itself is
+#: shape-agnostic; the aggregator's validate_shapes enforces geometry)
+SHAPES = {
+    "cm_bytes": (2, 8), "cm_pkts": (2, 8),
+    "heavy_words": (4, 10), "heavy_h1": (4,), "heavy_h2": (4,),
+    "heavy_counts": (4,), "heavy_valid": (4,),
+    "hll_src": (16,), "hll_per_dst": (4, 8), "hll_per_src": (4, 8),
+    "hist_rtt": (8,), "hist_dns": (8,),
+    "ddos_rate": (8,), "syn_rate": (8,), "synack": (8,),
+    "drops_rate": (8,), "drop_causes": (8,), "dscp_bytes": (8,),
+    "conv_fwd": (8,), "conv_rev": (8,), "scalars": (6,),
+}
+
+DIMS = {"cm_depth": 2, "cm_width": 8, "hll_precision": 4, "topk": 4,
+        "ewma_buckets": 8}
+
+
+def golden_tables() -> dict:
+    """Deterministic synthetic tables (pure numpy — identical on any host)."""
+    tables = {}
+    for i, (name, dt) in enumerate(fdelta.TABLE_SPEC):
+        shape = SHAPES[name]
+        n = int(np.prod(shape))
+        tables[name] = ((np.arange(n) * 3 + i * 17) % 251) \
+            .reshape(shape).astype(dt)
+    return tables
+
+
+def encode_golden() -> bytes:
+    return fdelta.encode_frame(
+        golden_tables(), agent_id="golden-agent", window=42,
+        ts_ms=1_700_000_000_123, dims=DIMS, codec=fdelta.CODEC_RAW)
+
+
+def test_frame_matches_golden_bytes():
+    """Byte-for-byte: the RAW-codec frame must equal the checked-in hex on
+    EVERY host, including big-endian (the tensors are explicit '<' dtypes;
+    protobuf scalars are endian-defined by the format)."""
+    golden = bytes.fromhex(open(GOLDEN).read().strip())
+    got = encode_golden()
+    assert got == golden, (
+        "delta frame bytes drifted from the golden vector — if the format "
+        "really changed, bump DELTA_FORMAT_VERSION (and the checkpoint "
+        "format), regenerate the golden, and add an aggregator upgrade "
+        f"path\n got: {got[:64].hex()}...\nwant: {golden[:64].hex()}...")
+
+
+def test_golden_bytes_decode_roundtrip():
+    golden = bytes.fromhex(open(GOLDEN).read().strip())
+    frame = fdelta.decode_frame(golden)
+    assert frame.version == fdelta.DELTA_FORMAT_VERSION
+    assert frame.agent_id == "golden-agent"
+    assert frame.window == 42
+    assert frame.ts_ms == 1_700_000_000_123
+    assert frame.dims == DIMS
+    want = golden_tables()
+    for name, _ in fdelta.TABLE_SPEC:
+        np.testing.assert_array_equal(frame.tables[name], want[name],
+                                      err_msg=name)
+        # decoded arrays must be native little-endian VIEWS regardless of
+        # host order (the frombuffer dtype is explicit)
+        assert frame.tables[name].dtype.str.startswith("<"), name
+
+
+def test_zlib_codec_roundtrip_host_local():
+    """zlib frames roundtrip (not golden-pinned: deflate bytes may vary
+    across zlib builds; only the RAW form is pinned byte-exact)."""
+    tables = golden_tables()
+    data = fdelta.encode_frame(tables, agent_id="z", window=1, ts_ms=2,
+                               dims=DIMS, codec=fdelta.CODEC_ZLIB)
+    frame = fdelta.decode_frame(data)
+    for name, _ in fdelta.TABLE_SPEC:
+        np.testing.assert_array_equal(frame.tables[name], tables[name])
+
+
+def test_table_spec_fingerprint_pinned():
+    """The spec fingerprint the CHECKPOINT format also stamps: a TABLE_SPEC
+    edit must bump DELTA_FORMAT_VERSION + CHECKPOINT_FORMAT_VERSION and
+    regenerate the golden — this pin makes a silent layout drift loud."""
+    assert fdelta.table_spec_fingerprint() == 1393615489
+    assert fdelta.DELTA_FORMAT_VERSION == 1
+
+
+def test_scalar_fields_order_pinned():
+    assert fdelta.SCALAR_FIELDS == (
+        "total_records", "total_bytes", "total_drop_bytes",
+        "total_drop_packets", "quic_records", "nat_records")
