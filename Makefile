@@ -77,6 +77,15 @@ smoke:
 smoke-federation:
 	JAX_PLATFORMS=cpu $(PY) scripts/smoke_federation.py
 
+# federation RAINY-day slice (~30s, non-gating CI artifact): agents come
+# up before the aggregator (cold-start catch-up), the aggregator restarts
+# once mid-run restoring its checkpoint, a query poller asserts no torn
+# snapshot — all with the delta-ingest fault point armed (every push eats
+# an injected delay), so the retry/idempotency machinery is exercised live
+smoke-federation-chaos:
+	JAX_PLATFORMS=cpu FAULT_POINTS="federation.delta_ingest:delay:0.02" \
+	  $(PY) scripts/smoke_federation.py --failure-path
+
 # kernel capture-plane load rig: sendmmsg storm -> parity check (needs root)
 perftest:
 	$(PY) examples/performance/local_perftest.py --packets 1000000 --flows 256

@@ -48,6 +48,12 @@ TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 BATCH = 16384
 N_BATCHES_POOL = 8
 _DEVICE_NOTE = ""
+#: claim forensics stamped into device_provenance: how many grant attempts
+#: the watchdog made and whether any attempt wedged (hung past its
+#: per-attempt timeout) — a CPU-fallback round becomes diagnosable
+#: (tunnel outage vs wedged grant vs genuinely CPU-only box), not just
+#: flagged
+_CLAIM = {"attempts": 0, "wedged": False, "deadline_hit": False}
 WARMUP_ITERS = 10  # the first executions after compile run measurably slower
 SEGMENT_ITERS = 12
 N_SEGMENTS = 8
@@ -792,7 +798,18 @@ def _device_watchdog(timeout_s: float | None = None,
     Env knobs: BENCH_TPU_PROBE_TIMEOUT (s/attempt, default 300),
     BENCH_TPU_PROBE_ATTEMPTS (default 3), BENCH_TPU_RETRY_SLEEP (default
     120 — observed tunnel outages recover on minute scales when they
-    recover at all, so a wider window catches more of them).
+    recover at all, so a wider window catches more of them),
+    BENCH_CLAIM_DEADLINE (default 900 — a HARD wall-clock budget across
+    ALL attempts: however the ladder goes, the bench starts within it).
+
+    Wedge handling: a hung attempt (TimeoutExpired) marks the claim
+    wedged, but gets exactly ONE retry with a FRESH grant (a new probe
+    subprocess claims from scratch; the hung child is left to die on its
+    own) — observed wedges are usually a poisoned grant, and one clean
+    re-claim recovers them; a second hang means the tunnel itself is
+    gone and stacking more claims behind it only worsens the wedge.
+    Every attempt and the wedge verdict land in `_CLAIM`, which
+    `device_provenance` stamps into the artifact.
     """
     import os
     import subprocess
@@ -802,30 +819,52 @@ def _device_watchdog(timeout_s: float | None = None,
     attempts = attempts or int(os.environ.get(
         "BENCH_TPU_PROBE_ATTEMPTS", "3"))
     retry_sleep = float(os.environ.get("BENCH_TPU_RETRY_SLEEP", "120"))
+    deadline = time.monotonic() + float(os.environ.get(
+        "BENCH_CLAIM_DEADLINE", "900"))
     reason = "no attempts made"
-    for i in range(attempts):
+    wedge_retries_left = 1
+    i = 0
+    while i < attempts:
+        if time.monotonic() >= deadline:
+            _CLAIM["deadline_hit"] = True
+            reason = "hard claim deadline (BENCH_CLAIM_DEADLINE) exhausted"
+            break
+        i += 1
+        _CLAIM["attempts"] = i
         probe = subprocess.Popen(
             [sys.executable, "-c",
              "import jax; print(jax.devices()[0].platform, flush=True)"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
         try:
-            out, _ = probe.communicate(timeout=timeout_s)
+            out, _ = probe.communicate(
+                timeout=min(timeout_s,
+                            max(1.0, deadline - time.monotonic())))
             platform = (out or "").strip()
             if platform == "cpu":
                 # CPU-only machine: that IS the device; no retries apply
                 return platform
             if platform:
                 return platform
-            reason = f"claim attempt {i + 1}/{attempts} errored"
-            if i + 1 < attempts:
+            reason = f"claim attempt {i}/{attempts} errored"
+            if i < attempts:
                 print(f"accelerator {reason}; retrying in "
                       f"{retry_sleep:.0f}s", file=sys.stderr)
-                time.sleep(retry_sleep)
+                time.sleep(min(retry_sleep,
+                               max(0.0, deadline - time.monotonic())))
         except subprocess.TimeoutExpired:
-            # deliberately NOT killed; a stacked second claim behind a hung
-            # one only worsens the wedge — stop probing entirely
-            reason = (f"claim attempt {i + 1} still hung after "
-                      f"{timeout_s:.0f}s")
+            # the hung child is deliberately NOT killed (killing a claim
+            # mid-flight wedges the tunnel harder); it is abandoned and a
+            # single fresh-grant probe gets one shot
+            _CLAIM["wedged"] = True
+            reason = f"claim attempt {i} still hung after probe timeout"
+            if wedge_retries_left and time.monotonic() < deadline:
+                wedge_retries_left -= 1
+                # the fresh-grant probe must run even when the hang was
+                # the FINAL ladder attempt — extend the ladder by one
+                attempts = max(attempts, i + 1)
+                print(f"accelerator {reason}; one retry with a fresh "
+                      "grant", file=sys.stderr)
+                continue
             break
     print(f"accelerator unavailable ({reason}); benchmarking on CPU",
           file=sys.stderr)
@@ -847,7 +886,13 @@ def device_provenance(cpu_requested: bool) -> dict:
     JAX_PLATFORMS=cpu run is distinguishable from an outage."""
     out: dict = {"platform": "unknown", "device_kind": "", "n_devices": 0,
                  "cpu_requested": bool(cpu_requested),
-                 "fell_back_to_cpu": _DEVICE_NOTE == "cpu-fallback"}
+                 "fell_back_to_cpu": _DEVICE_NOTE == "cpu-fallback",
+                 # claim forensics (the watchdog ladder): 0 attempts means
+                 # the claim path never ran (cpu_requested); wedged means
+                 # at least one grant hung past its probe timeout
+                 "claim_attempts": _CLAIM["attempts"],
+                 "claim_wedged": _CLAIM["wedged"],
+                 "claim_deadline_hit": _CLAIM["deadline_hit"]}
     try:
         import jax
         devs = jax.devices()

@@ -390,6 +390,23 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     #: detail and the staleness gauge commentary (2 windows by default)
     federation_stale_after: float = field(
         default=120.0, **_env("FEDERATION_STALE_AFTER", "120s"))
+    #: seconds without a delta before the aggregator EVICTS an agent: it
+    #: leaves the ownership view, its staleness gauge series is deleted
+    #: (label cardinality stays bounded by the live fleet), and its
+    #: delivery-ledger entry is forgotten. 0 disables eviction. A
+    #: returning agent re-registers cleanly (fresh epoch after a restart).
+    federation_agent_ttl: float = field(
+        default=600.0, **_env("FEDERATION_AGENT_TTL", "600s"))
+    #: aggregator checkpoint directory ("" = no checkpointing): the
+    #: aggregate SketchState + per-agent delivery ledger are saved at each
+    #: window roll and restored on startup — a restart loses at most the
+    #: uncheckpointed partial window, never a closed one, and redelivered
+    #: pre-crash frames still dedup against the restored ledger
+    federation_checkpoint_dir: str = field(
+        default="", **_env("FEDERATION_CHECKPOINT_DIR"))
+    #: checkpoint every Nth aggregator window roll (1 = every window)
+    federation_checkpoint_every: int = field(
+        default=1, **_env("FEDERATION_CHECKPOINT_EVERY", "1"))
 
     def resolved_pack_threads(self) -> int:
         """SKETCH_PACK_THREADS with 0 = auto (cpu count, capped at 8)."""
@@ -489,7 +506,7 @@ _DURATION_FIELDS = {
     "supervisor_check_period", "supervisor_backoff_initial",
     "supervisor_backoff_max", "supervisor_healthy_reset",
     "supervisor_heartbeat_timeout", "federation_window",
-    "federation_stale_after",
+    "federation_stale_after", "federation_agent_ttl",
 }
 
 

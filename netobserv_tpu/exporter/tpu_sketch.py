@@ -300,6 +300,11 @@ class TpuSketchExporter(Exporter):
         else:
             import socket
             self._agent_id = socket.gethostname()
+        # idempotent-delivery identity (wire v2): the epoch marks THIS
+        # process incarnation (monotonic across restarts), so a restarted
+        # agent's reset window counter re-registers as a fresh epoch at
+        # the aggregator instead of reading as a flood of stale frames
+        self._agent_epoch = time.time_ns()
         if self._delta_sink is not None and decay_factor is not None:
             # decayed tables are CUMULATIVE (sliding window): pushing them
             # per window would double-count every prior window's mass at
@@ -925,11 +930,16 @@ class TpuSketchExporter(Exporter):
                 with wtrace.stage("report_serialize"):
                     faultinject.fire("sketch.delta_export")
                     from netobserv_tpu.federation import delta as fdelta
+                    # window_seq rides the window counter (one frame per
+                    # closed window); frame_uuid is drawn ONCE here — the
+                    # sink's retry ladder resends these same bytes, so an
+                    # ambiguous-deadline redelivery dedups at the ledger
                     frame = fdelta.encode_frame(
                         {k: np.asarray(v) for k, v in tables.items()},
                         agent_id=self._agent_id,
                         window=int(np.asarray(report.window)),
                         ts_ms=time.time_ns() // 1_000_000,
+                        agent_epoch=self._agent_epoch,
                         dims={"cm_depth": self._cfg.cm_depth,
                               "cm_width": self._cfg.cm_width,
                               "hll_precision": self._cfg.hll_precision,

@@ -60,7 +60,9 @@ class FederationAggregator:
                  mesh_shape: str = "", metrics=None,
                  sink: Optional[Callable[[dict], None]] = None,
                  stale_after_s: float = 120.0,
-                 report_kwargs: Optional[dict] = None):
+                 report_kwargs: Optional[dict] = None,
+                 checkpoint_dir: str = "", checkpoint_every: int = 1,
+                 agent_ttl_s: float = 0.0):
         from netobserv_tpu.parallel.distributed import (
             maybe_initialize_distributed,
         )
@@ -122,22 +124,191 @@ class FederationAggregator:
         self._window_deadline = time.monotonic() + window_s
         #: agent id -> {"last_ms", "window", "frames"} (monotonic last too)
         self._agents: dict[str, dict] = {}
+        #: idempotent-delivery ledger: agent id -> {"epoch", "window_seq",
+        #: "frame_uuid"} of the LAST APPLIED v2 frame. Checkpointed next to
+        #: the aggregate state (same step) so redelivery across an
+        #: aggregator restart still dedups; bounded by agent-TTL eviction.
+        self._ledger: dict[str, dict] = {}
         self._window_agents: set[str] = set()
         self._frames_total = 0
+        #: staleness-based agent eviction (FEDERATION_AGENT_TTL; 0 = off):
+        #: past the TTL an agent leaves the ownership view AND its
+        #: staleness gauge series is deleted (label cardinality must not
+        #: grow forever with departed agents)
+        self._agent_ttl_s = agent_ttl_s
         self._snapshot: Optional[dict] = None
         self._snap_lock = threading.Lock()
+        self._snap_seq = 0
         self._closed = threading.Event()
+
+        # checkpoint/restore: aggregate SketchState + delivery ledger saved
+        # at window roll (post-roll state, so a restore can never re-publish
+        # a closed window); restart loses at most the uncheckpointed
+        # partial window
+        self._ckpt = None
+        self._ckpt_dir = checkpoint_dir
+        self._ckpt_every = max(1, int(checkpoint_every))
+        self._n_rolls = 0
+        self._pending_ckpt: Optional[tuple] = None
+        if checkpoint_dir:
+            from netobserv_tpu.sketch.checkpoint import SketchCheckpointer
+            self._ckpt = SketchCheckpointer(checkpoint_dir)
+            self._maybe_restore()
+
         self.heartbeat = lambda: None
         self._timer: Optional[threading.Thread] = None
         self.start_window_timer()
 
+    # --- checkpoint/restore ---------------------------------------------
+    def _maybe_restore(self) -> None:
+        """Restore the aggregate state + delivery ledger from the latest
+        checkpoint. A restore failure starts a fresh window (logged) — the
+        aggregator tier must come up in any case. The restored pytree has
+        the SAME shapes/dtypes as the init template, so the jitted
+        fold/roll entries never retrace across a restart."""
+        try:
+            step = self._ckpt.latest_step()
+            if step is not None:
+                self._state = self._ckpt.restore(self._state)
+                self._apply_restored_meta(
+                    self._ckpt.read_metadata(step) or {})
+            # publish-commit marker: with checkpoint_every > 1 (or before
+            # the first tensor save) windows PUBLISHED after the newest
+            # tensor checkpoint must neither re-use their window id nor
+            # re-merge their redelivered frames — fast-forward the window
+            # counter past the last published id and overlay the ledger
+            # those publishes committed (the skipped windows' tensor
+            # contribution is the documented every-N durability loss)
+            pub = self._ckpt.read_publish_marker()
+            restored_w = int(np.asarray(self._state.window))
+            if pub is not None and pub["window"] >= restored_w:
+                self._apply_restored_meta(pub["meta"])
+                self._state = self._state._replace(
+                    window=self._state.window
+                    + np.int32(pub["window"] + 1 - restored_w))
+            elif step is None:
+                return
+            log.info("restored federation aggregate (checkpoint step %s, "
+                     "next window %d, %d agents in the ledger)", step,
+                     int(np.asarray(self._state.window)),
+                     len(self._ledger))
+        except Exception as exc:
+            log.error("aggregator checkpoint restore failed "
+                      "(starting a fresh window): %s", exc)
+            if self._metrics is not None:
+                self._metrics.count_error("federation")
+            self._quarantine_checkpoints()
+
+    def _quarantine_checkpoints(self) -> None:
+        """An unrestorable checkpoint directory must not stay live: the
+        fresh process restarts its window counter at 0, so orbax retention
+        (highest steps win) would garbage-collect every NEW checkpoint
+        while latest_step() kept answering the corrupt high step — the
+        next restart would retry the same broken restore forever. Move the
+        directory aside (kept for forensics) and checkpoint into a clean
+        one; if even the rename fails, disable checkpointing rather than
+        write into a poisoned dir."""
+        import os
+        try:
+            self._ckpt.close()
+        except Exception:
+            pass
+        dest = f"{self._ckpt_dir}.corrupt-{os.getpid()}-{time.time_ns()}"
+        try:
+            os.rename(self._ckpt_dir, dest)
+            from netobserv_tpu.sketch.checkpoint import SketchCheckpointer
+            self._ckpt = SketchCheckpointer(self._ckpt_dir)
+            log.warning("quarantined unrestorable checkpoint dir to %s; "
+                        "checkpointing continues into a fresh %s",
+                        dest, self._ckpt_dir)
+        except Exception as exc:
+            self._ckpt = None
+            log.error("could not quarantine checkpoint dir %s (%s) — "
+                      "checkpointing DISABLED for this run",
+                      self._ckpt_dir, exc)
+
+    def _apply_restored_meta(self, meta: dict) -> None:
+        """Re-seat the delivery ledger + agent view from checkpointed
+        metadata (the roll-time sidecar, or the newer publish marker)."""
+        self._ledger = {a: dict(v)
+                        for a, v in (meta.get("ledger") or {}).items()}
+        # re-seat agent liveness from wall-clock last_ms: monotonic
+        # deadlines do not survive a process, so staleness restarts
+        # from the checkpointed wall gap (clamped at 0)
+        now_ms, now_mono = time.time() * 1e3, time.monotonic()
+        self._agents.clear()
+        for a, info in (meta.get("agents") or {}).items():
+            gap_s = max(0.0, (now_ms - float(info.get("last_ms", 0.0)))
+                        / 1e3)
+            self._agents[a] = {
+                "frames": int(info.get("frames", 0)),
+                "window": int(info.get("window", 0)),
+                "last_ms": float(info.get("last_ms", 0.0)),
+                "last_mono": now_mono - gap_s}
+
+    def _delivery_meta_locked(self) -> dict:
+        """JSON-able ledger + agent view (caller holds self._lock)."""
+        return {"ledger": {a: dict(v) for a, v in self._ledger.items()},
+                "agents": {a: {"frames": v["frames"], "window": v["window"],
+                               "last_ms": v["last_ms"]}
+                           for a, v in self._agents.items()}}
+
+    def _stage_checkpoint_locked(self, report) -> None:
+        """Stage this roll's checkpoint UNDER self._lock: later folds
+        DONATE self._state into the jitted merge, so the save must work
+        from a private device-side copy taken before any post-roll fold
+        can run. The disk I/O itself happens OFF the lock
+        (_run_pending_checkpoint, timer thread) — a HUNG checkpoint
+        filesystem stalls only the supervised timer thread (heartbeat
+        stops, supervisor flips DEGRADED), never delta ingest, which
+        would otherwise deadlock fleet-wide behind this lock."""
+        import jax
+        import jax.numpy as jnp
+
+        snap = jax.tree.map(jnp.copy, self._state)
+        jax.block_until_ready(snap)  # the copy must land before unlock
+        self._pending_ckpt = (int(np.asarray(report.window)),
+                              self._delivery_meta_locked(), snap)
+
+    def _run_pending_checkpoint(self) -> None:
+        """Persist the staged (ledger sidecar, then state) pair, OFF
+        self._lock, before any queued publish (durable checkpoint, then
+        publish — exactly-once across a restart). A checkpoint failure is
+        swallowed + counted: a wedged disk loses durability, never the
+        live plane."""
+        with self._lock:
+            payload, self._pending_ckpt = self._pending_ckpt, None
+        if payload is None or self._ckpt is None:
+            return
+        step, meta, snap = payload
+        m = self._metrics
+        try:
+            faultinject.fire("federation.checkpoint")
+            self._ckpt.save_metadata(step, meta)
+            # wait=True: the checkpoint is DURABLE before this window
+            # publishes — a kill any time after restores this boundary
+            self._ckpt.save(step, snap, wait=True)
+            if m is not None:
+                m.federation_checkpoints_total.labels("ok").inc()
+        except Exception as exc:
+            log.error("federation checkpoint failed (window keeps "
+                      "rolling without durability): %s", exc)
+            if m is not None:
+                m.federation_checkpoints_total.labels("error").inc()
+                m.count_error("federation")
+
     # --- delta ingest (gRPC handler) ------------------------------------
     def ingest_frame(self, data: bytes) -> sketch_delta_pb2.DeltaAck:
-        """Decode + validate + merge one frame; always returns an ack."""
+        """Decode + validate + ledger-check + merge one frame; always
+        returns an ack. Idempotent: a redelivered v2 frame (same agent /
+        epoch / window_seq / frame_uuid) acks accepted+duplicate without
+        merging, and an out-of-order stale window acks-and-discards — a
+        sender retrying after an ambiguous DEADLINE_EXCEEDED can never
+        double-count a window."""
         t0 = time.perf_counter()
         trace = tracing.start_trace("delta")
         try:
-            faultinject.fire("federation.ingest")
+            data = faultinject.fire("federation.delta_ingest", data)
             try:
                 with trace.stage("delta_decode"):
                     frame = fdelta.decode_frame(data)
@@ -155,7 +326,7 @@ class FederationAggregator:
                 return self._reject("shape_mismatch", str(exc))
             try:
                 with trace.stage("delta_merge_dispatch"):
-                    self._merge_frame(frame)
+                    result = self._merge_frame(frame)
             except Exception as exc:
                 log.error("delta merge failed (frame from %r dropped): %s",
                           frame.agent_id, exc)
@@ -164,11 +335,19 @@ class FederationAggregator:
             trace.finish()
         m = self._metrics
         if m is not None:
-            m.federation_deltas_total.labels("ok").inc()
+            m.federation_deltas_total.labels(result).inc()
             m.federation_delta_bytes_total.inc(len(data))
-            m.federation_merge_seconds.observe(time.perf_counter() - t0)
+            if result in ("ok", "legacy"):
+                # only real merges feed the histogram: discarded frames
+                # are near-no-ops and would bury the step change the docs
+                # say to watch for (retraces)
+                m.federation_merge_seconds.observe(time.perf_counter() - t0)
         return sketch_delta_pb2.DeltaAck(
-            accepted=1, version=fdelta.DELTA_FORMAT_VERSION)
+            accepted=1, version=fdelta.DELTA_FORMAT_VERSION,
+            duplicate=1 if result in ("duplicate", "stale") else 0,
+            reason=(fdelta.ACK_REASON_DUPLICATE if result == "duplicate"
+                    else fdelta.ACK_REASON_STALE if result == "stale"
+                    else ""))
 
     def _reject(self, result: str,
                 reason: str) -> sketch_delta_pb2.DeltaAck:
@@ -178,9 +357,67 @@ class FederationAggregator:
         return sketch_delta_pb2.DeltaAck(
             accepted=0, version=fdelta.DELTA_FORMAT_VERSION, reason=reason)
 
-    def _merge_frame(self, frame: fdelta.DeltaFrame) -> None:
+    def _ledger_verdict_locked(self, frame: fdelta.DeltaFrame) -> str:
+        """Classify a frame against the last-applied ledger (caller holds
+        self._lock). Returns one of:
+
+        - ``legacy``    v1 frame — no delivery header; merge unconditionally
+        - ``ok``        first delivery of a new window (or a new epoch —
+                        a returning agent re-registers cleanly)
+        - ``duplicate`` same (epoch, window_seq, frame_uuid) already
+                        applied — redelivery after an ambiguous deadline
+        - ``stale``     window_seq at-or-behind the last applied one (or a
+                        dead epoch's straggler) — out-of-order delivery;
+                        ack-and-discard, never merge
+        """
+        if frame.version < 2:
+            return "legacy"
+        last = self._ledger.get(frame.agent_id)
+        if last is None or frame.agent_epoch > last["epoch"]:
+            return "ok"
+        if frame.agent_epoch < last["epoch"]:
+            return "stale"
+        if frame.window_seq > last["window_seq"]:
+            return "ok"
+        if (frame.window_seq == last["window_seq"]
+                and frame.frame_uuid == last["frame_uuid"]):
+            return "duplicate"
+        return "stale"
+
+    def _note_discard_locked(self, frame: fdelta.DeltaFrame,
+                             verdict: str) -> None:
+        """Bookkeeping for a discarded frame (caller holds self._lock).
+        A DUPLICATE refreshes liveness — the agent is alive, its window
+        just doesn't contribute twice. A STALE frame deliberately does
+        NOT: if an agent's epoch ever regresses (a wall-clock step-back
+        across a restart), every frame it sends reads stale, and the only
+        self-healing path is the TTL eviction forgetting the poisoned
+        ledger entry so the agent can re-register — stale frames keeping
+        it 'alive' would block that forever."""
+        last = self._ledger.get(frame.agent_id)
+        if last is not None and frame.agent_epoch < last["epoch"]:
+            log.warning(
+                "agent %r sent epoch %d below its ledger epoch %d (clock "
+                "step-back across a restart?) — frames discarded as stale "
+                "until the FEDERATION_AGENT_TTL eviction re-admits it",
+                frame.agent_id, frame.agent_epoch, last["epoch"])
+        if verdict == "duplicate" and frame.agent_id in self._agents:
+            info = self._agents[frame.agent_id]
+            info["last_ms"] = time.time() * 1e3
+            info["last_mono"] = time.monotonic()
+
+    def _merge_frame(self, frame: fdelta.DeltaFrame) -> str:
         import jax
 
+        # advisory pre-check: a redelivered/stale frame must not pay the
+        # host->device transfer of the whole table set just to be
+        # discarded under the lock (a retry flood would otherwise steal
+        # transfer bandwidth from real merges)
+        with self._lock:
+            early = self._ledger_verdict_locked(frame)
+            if early in ("duplicate", "stale"):
+                self._note_discard_locked(frame, early)
+                return early
         if self._distributed:
             tables = {name: self._pm.put_replicated(
                 self._mesh, np.ascontiguousarray(arr))
@@ -191,10 +428,22 @@ class FederationAggregator:
             tables = {name: jax.device_put(arr)
                       for name, arr in frame.tables.items()}
         with self._lock:
+            # authoritative verdict + fold + ledger update are ONE critical
+            # section: two racing copies of the same frame serialize here,
+            # the second sees the first's ledger entry and discards
+            verdict = self._ledger_verdict_locked(frame)
+            if verdict not in ("ok", "legacy"):
+                self._note_discard_locked(frame, verdict)
+                return verdict
             if self._distributed:
                 self._state = self._fold(self._state, tables, owner)
             else:
                 self._state = self._fold(self._state, tables)
+            if verdict == "ok":
+                self._ledger[frame.agent_id] = {
+                    "epoch": frame.agent_epoch,
+                    "window_seq": frame.window_seq,
+                    "frame_uuid": frame.frame_uuid}
             self._frames_total += 1
             self._window_agents.add(frame.agent_id)
             info = self._agents.setdefault(
@@ -206,6 +455,7 @@ class FederationAggregator:
             info["last_mono"] = time.monotonic()
             if time.monotonic() >= self._window_deadline:
                 self._close_window_locked()
+        return verdict
 
     # --- window roll ----------------------------------------------------
     def start_window_timer(self) -> None:
@@ -241,6 +491,7 @@ class FederationAggregator:
                           exc)
                 if self._metrics is not None:
                     self._metrics.count_error("federation")
+            self._evict_stale_agents()
             self._update_staleness()
             self._publish_queued()
 
@@ -257,6 +508,14 @@ class FederationAggregator:
             raise
         agents = sorted(self._window_agents)
         self._window_agents = set()
+        # checkpoint the POST-roll state + the ledger at this step: a
+        # restore resumes the fresh window (never re-rolls, never
+        # re-publishes a closed one) and redelivered pre-crash frames
+        # still dedup against the restored ledger
+        if self._ckpt is not None:
+            self._n_rolls += 1
+            if self._n_rolls % self._ckpt_every == 0:
+                self._stage_checkpoint_locked(report)
         self._reports.append((report, tables, agents, wtrace))
         while len(self._reports) > self._max_queued_reports:
             try:
@@ -269,8 +528,19 @@ class FederationAggregator:
             if self._metrics is not None:
                 self._metrics.count_error("federation")
 
-    def _publish_queued(self) -> None:
-        with self._publish_lock:
+    def _publish_queued(self, timeout_s: Optional[float] = None) -> None:
+        # a bounded acquire (close()/shutdown path) must not deadlock
+        # behind a timer thread wedged inside a hung checkpoint save —
+        # the save holds this lock for the duration of its disk I/O
+        if not self._publish_lock.acquire(
+                timeout=-1 if timeout_s is None else timeout_s):
+            log.error("publish lock busy past %.1fs (hung checkpoint "
+                      "disk?) — skipping publish on this path", timeout_s)
+            if self._metrics is not None:
+                self._metrics.count_error("federation")
+            return
+        try:
+            self._run_pending_checkpoint()
             while self._reports:
                 try:
                     report, tables, agents, wtrace = self._reports.popleft()
@@ -285,6 +555,8 @@ class FederationAggregator:
                         self._metrics.count_error("federation")
                 finally:
                     wtrace.finish()
+        finally:
+            self._publish_lock.release()
 
     def _publish(self, report, tables, agents: list, wtrace) -> None:
         from netobserv_tpu.exporter.tpu_sketch import report_to_json
@@ -300,9 +572,13 @@ class FederationAggregator:
             cm_pkts = np.asarray(tables["cm_pkts"])
             heavy = {k: np.asarray(tables["heavy_" + k])
                      for k in ("words", "h1", "h2", "counts", "valid")}
+        with self._snap_lock:
+            self._snap_seq += 1
+            seq = self._snap_seq
         snap = {
             "window": obj["Window"],
             "ts_ms": obj["TimestampMs"],
+            "seq": seq,
             "report": obj,
             "agents": {a: dict(v) for a, v in self._agents_view().items()},
             "cm_bytes": cm_bytes,
@@ -317,6 +593,20 @@ class FederationAggregator:
         if m is not None:
             m.federation_active_agents.set(len(agents))
             m.sketch_window_reports_total.inc()
+        if self._ckpt is not None:
+            # publish-commit marker, written BEFORE the sink (at-most-once
+            # like the rest of the publish path): a restore from an older
+            # tensor checkpoint (checkpoint_every > 1) fast-forwards past
+            # this window id and keeps the ledger it committed
+            try:
+                with self._lock:
+                    meta = self._delivery_meta_locked()
+                self._ckpt.save_publish_marker(obj["Window"], meta)
+            except Exception as exc:
+                log.error("publish marker write failed (a restart may "
+                          "re-publish window %s): %s", obj["Window"], exc)
+                if m is not None:
+                    m.count_error("federation")
         if self._sink is not None:
             with wtrace.stage("report_sink"):
                 self._sink(obj)
@@ -328,7 +618,10 @@ class FederationAggregator:
                         "last_ms": v["last_ms"],
                         "staleness_s": round(now - v["last_mono"], 3),
                         "stale": (now - v["last_mono"])
-                        > self._stale_after_s}
+                        > self._stale_after_s,
+                        "epoch": self._ledger.get(a, {}).get("epoch", 0),
+                        "window_seq": self._ledger.get(a, {})
+                        .get("window_seq", 0)}
                     for a, v in self._agents.items()}
 
     def _update_staleness(self) -> None:
@@ -338,6 +631,32 @@ class FederationAggregator:
         for agent, info in self._agents_view().items():
             m.federation_agent_staleness_seconds.labels(agent).set(
                 info["staleness_s"])
+
+    def _evict_stale_agents(self) -> None:
+        """Agent lifecycle (FEDERATION_AGENT_TTL): drop agents silent past
+        the TTL from the ownership view, DELETE their per-agent gauge
+        series (departed agents must not pin label cardinality forever),
+        and forget their ledger entry — a returning agent re-registers
+        cleanly (same epoch + higher seq, or a fresh epoch after a
+        restart). Counted in federation_agent_evictions_total."""
+        ttl = self._agent_ttl_s
+        if not ttl:
+            return
+        now = time.monotonic()
+        with self._lock:
+            dead = [a for a, v in self._agents.items()
+                    if now - v["last_mono"] > ttl]
+            for a in dead:
+                del self._agents[a]
+                self._ledger.pop(a, None)
+                self._window_agents.discard(a)
+        m = self._metrics
+        for a in dead:
+            log.warning("evicting dark agent %r (no delta for > %.0fs)",
+                        a, ttl)
+            if m is not None:
+                m.remove_labeled(m.federation_agent_staleness_seconds, a)
+                m.federation_agent_evictions_total.inc()
 
     # --- query surface (host-side, never a device op) -------------------
     def snapshot(self) -> Optional[dict]:
@@ -360,6 +679,9 @@ class FederationAggregator:
             "window_s": self._window_s,
             "mesh": self._distributed,
             "format_version": fdelta.DELTA_FORMAT_VERSION,
+            "supported_versions": list(fdelta.SUPPORTED_VERSIONS),
+            "agent_ttl_s": self._agent_ttl_s,
+            "checkpointing": self._ckpt is not None,
         }
 
     def query_frequency(self, src: str, dst: str, src_port: int = 0,
@@ -404,14 +726,33 @@ class FederationAggregator:
         }
 
     # --- lifecycle ------------------------------------------------------
-    def flush(self) -> None:
-        """Close the current window now and publish synchronously."""
+    def flush(self, timeout_s: Optional[float] = None) -> None:
+        """Close the current window now and publish synchronously.
+        `timeout_s` bounds the wait for the publish lock (shutdown path:
+        a timer thread wedged inside a hung checkpoint save holds it —
+        close() must still return)."""
         with self._lock:
             self._close_window_locked()
-        self._publish_queued()
+        self._publish_queued(timeout_s)
 
     def close(self) -> None:
         self._closed.set()
         if self._timer is not None:
             self._timer.join(timeout=2.0)
-        self.flush()
+        # bounded: a hung checkpoint disk must wedge the timer thread at
+        # worst, never turn shutdown into a deadlock on the publish lock
+        self.flush(timeout_s=10.0)
+        if self._ckpt is not None:
+            try:
+                self._ckpt.close()
+            except Exception as exc:
+                log.error("checkpointer close failed: %s", exc)
+
+    def kill(self) -> None:
+        """Chaos-harness crash: stop the timer WITHOUT the final flush,
+        publish, or checkpoint — everything since the last roll-time
+        checkpoint is lost, exactly like a SIGKILL. Tests use this to pin
+        the restore semantics; production shutdown is close()."""
+        self._closed.set()
+        if self._timer is not None:
+            self._timer.join(timeout=2.0)

@@ -28,15 +28,33 @@ same goldens.
 
 from __future__ import annotations
 
+import uuid
 import zlib
-from typing import Mapping, NamedTuple
+from typing import Mapping, NamedTuple, Optional
 
 import numpy as np
 
 from netobserv_tpu.pb import sketch_delta_pb2 as pb
 
 #: bump on ANY change to TABLE_SPEC, tensor encoding, or frame semantics.
-DELTA_FORMAT_VERSION = 1
+#: v2 adds the idempotent-delivery header (window_seq / frame_uuid /
+#: agent_epoch) so the aggregator can ack-and-discard redelivered frames
+#: after an ambiguous DEADLINE_EXCEEDED instead of double-counting.
+DELTA_FORMAT_VERSION = 2
+
+#: versions decode_frame still accepts. v1 frames (pre-idempotency agents)
+#: carry no delivery header; the aggregator merges them unconditionally and
+#: counts them `legacy` — a mixed-version fleet keeps aggregating during a
+#: rollout, it just loses dedup protection for the old agents.
+SUPPORTED_VERSIONS = (1, 2)
+
+#: ack reason strings shared by the aggregator (producer) and
+#: FederationDeltaSink (consumer). Both verdicts set `duplicate=1` on the
+#: wire — retrying either is pointless — but only a true duplicate was
+#: MERGED; a stale discard is per-window data loss, and the reason string
+#: is how the agent side tells the two apart in its sent-counter.
+ACK_REASON_DUPLICATE = "window already applied"
+ACK_REASON_STALE = "stale window discarded"
 
 CODEC_RAW = 0
 CODEC_ZLIB = 1
@@ -91,7 +109,10 @@ class DeltaVersionError(DeltaFrameError):
 
 class DeltaFrame(NamedTuple):
     """Decoded frame: header metadata + the table dict (TABLE_SPEC names ->
-    little-endian numpy arrays, read-only views over the frame buffer)."""
+    little-endian numpy arrays, read-only views over the frame buffer).
+    `window_seq`/`frame_uuid`/`agent_epoch` are the v2 idempotent-delivery
+    header; on v1 frames they read as proto3 defaults (0 / "" / 0) and the
+    version field is how consumers tell the difference."""
 
     version: int
     agent_id: str
@@ -99,6 +120,9 @@ class DeltaFrame(NamedTuple):
     ts_ms: int
     dims: dict
     tables: dict
+    window_seq: int = 0
+    frame_uuid: str = ""
+    agent_epoch: int = 0
 
 
 def table_spec_fingerprint() -> int:
@@ -112,20 +136,32 @@ def table_spec_fingerprint() -> int:
 
 def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
                  window: int, ts_ms: int, dims: Mapping[str, int],
-                 codec: int = CODEC_ZLIB) -> bytes:
-    """Serialize a table snapshot into one SketchDelta frame.
+                 codec: int = CODEC_ZLIB, window_seq: Optional[int] = None,
+                 frame_uuid: str = "", agent_epoch: int = 0) -> bytes:
+    """Serialize a table snapshot into one SketchDelta frame (v2).
 
     `tables` must carry every TABLE_SPEC name (host numpy arrays; dtype is
     coerced to the spec's little-endian type). `codec=CODEC_ZLIB` deflates
     each tensor but keeps raw whenever deflate does not shrink it (the
     per-tensor codec field records which one shipped).
+
+    Idempotency header: `window_seq` defaults to `window` (one frame per
+    closed window, the counter IS the sequence); an empty `frame_uuid`
+    draws a fresh uuid4 — callers retrying the SAME frame must resend the
+    same bytes, not re-encode. `agent_epoch` is the sender's boot identity
+    (0 only looks legacy-ish to operators; the version field is what marks
+    a frame v1).
     """
     missing = [n for n, _ in TABLE_SPEC if n not in tables]
     if missing:
         raise DeltaFrameError(f"table snapshot missing tensors: {missing}")
+    if not frame_uuid:
+        frame_uuid = uuid.uuid4().hex
     frame = pb.SketchDelta(
         version=DELTA_FORMAT_VERSION, agent_id=agent_id,
-        window=int(window), ts_ms=int(ts_ms))
+        window=int(window), ts_ms=int(ts_ms),
+        window_seq=int(window if window_seq is None else window_seq),
+        frame_uuid=frame_uuid, agent_epoch=int(agent_epoch))
     for f in DIM_FIELDS:
         setattr(frame, f, int(dims[f]))
     for name, dt in TABLE_SPEC:
@@ -163,20 +199,21 @@ _SPEC_DTYPES = dict(TABLE_SPEC)
 
 def decode_frame(data: bytes) -> DeltaFrame:
     """Parse + validate one frame. Raises DeltaVersionError on a format
-    version mismatch and DeltaFrameError on anything structurally wrong
-    (unknown tensor name, dtype drift from TABLE_SPEC, size over
-    MAX_TENSOR_BYTES, payload/shape mismatch); the tensor arrays are
-    zero-copy read-only views over the frame bytes (copy before
-    mutating)."""
+    version outside SUPPORTED_VERSIONS and DeltaFrameError on anything
+    structurally wrong (unknown tensor name, dtype drift from TABLE_SPEC,
+    size over MAX_TENSOR_BYTES, payload/shape mismatch); the tensor arrays
+    are zero-copy read-only views over the frame bytes (copy before
+    mutating). v1 frames decode with an empty delivery header (proto3
+    defaults) — consumers branch on `frame.version`."""
     frame = pb.SketchDelta()
     try:
         frame.ParseFromString(data)
     except Exception as exc:
         raise DeltaFrameError(f"unparseable delta frame: {exc}") from exc
-    if frame.version != DELTA_FORMAT_VERSION:
+    if frame.version not in SUPPORTED_VERSIONS:
         raise DeltaVersionError(
-            f"delta frame version {frame.version} != supported "
-            f"{DELTA_FORMAT_VERSION} (agent {frame.agent_id!r})")
+            f"delta frame version {frame.version} not in supported "
+            f"{SUPPORTED_VERSIONS} (agent {frame.agent_id!r})")
     tables: dict[str, np.ndarray] = {}
     for t in frame.tensors:
         spec_dt = _SPEC_DTYPES.get(t.name)
@@ -226,7 +263,10 @@ def decode_frame(data: bytes) -> DeltaFrame:
     dims = {f: int(getattr(frame, f)) for f in DIM_FIELDS}
     return DeltaFrame(version=int(frame.version), agent_id=frame.agent_id,
                       window=int(frame.window), ts_ms=int(frame.ts_ms),
-                      dims=dims, tables=tables)
+                      dims=dims, tables=tables,
+                      window_seq=int(frame.window_seq),
+                      frame_uuid=frame.frame_uuid,
+                      agent_epoch=int(frame.agent_epoch))
 
 
 def expected_shapes(template_tables: Mapping[str, np.ndarray]) -> dict:

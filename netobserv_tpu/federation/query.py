@@ -72,15 +72,27 @@ class _Handler(BaseHTTPRequestHandler):
                 self._no_window()
                 return
             report = snap["report"]
+            # every snapshot-backed route carries the publish sequence
+            # number: the aggregator swaps whole snapshots atomically, so
+            # a reader seeing (seq, window, payload) from ONE dict can
+            # never observe a torn mix of two windows. seq is in-memory
+            # and restarts at 1 with the process; window-major ordering
+            # survives restarts only when FEDERATION_CHECKPOINT_DIR is
+            # set (pollers: compare (window, seq), and only across
+            # restarts of a checkpointed aggregator — see the smoke's
+            # poller)
+            seq = snap.get("seq", 0)
             if path == "/federation/topk":
                 n = max(1, min(int(q.get("n", 100)), 1024))
                 self._json(200, {
                     "window": snap["window"], "ts_ms": snap["ts_ms"],
+                    "seq": seq,
                     "topk": report["HeavyHitters"][:n]})
                 return
             if path == "/federation/cardinality":
                 self._json(200, {
                     "window": snap["window"], "ts_ms": snap["ts_ms"],
+                    "seq": seq,
                     "distinct_src_estimate":
                         report["DistinctSrcEstimate"],
                     "records": report["Records"],
@@ -89,6 +101,7 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/federation/victims":
                 self._json(200, {
                     "window": snap["window"], "ts_ms": snap["ts_ms"],
+                    "seq": seq,
                     "ddos": report["DdosSuspectBuckets"],
                     "syn_flood": report["SynFloodSuspectBuckets"],
                     "port_scan": report["PortScanSuspectBuckets"],

@@ -41,6 +41,9 @@ class FederationAggregatorService:
             metrics=self.metrics,
             sink=sink if sink is not None else make_report_sink(cfg),
             stale_after_s=cfg.federation_stale_after,
+            checkpoint_dir=cfg.federation_checkpoint_dir,
+            checkpoint_every=cfg.federation_checkpoint_every,
+            agent_ttl_s=cfg.federation_agent_ttl,
             report_kwargs=dict(
                 scan_fanout_threshold=cfg.sketch_scan_fanout,
                 ddos_z_threshold=cfg.sketch_ddos_z,

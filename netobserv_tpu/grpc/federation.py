@@ -25,6 +25,44 @@ _PUSH = "/pbsketch.Federation/Push"
 
 _identity = lambda b: b  # noqa: E731 — raw-bytes pass-through
 
+#: gRPC status codes worth a retry of the SAME frame bytes. UNAVAILABLE is
+#: the aggregator restarting/rebalancing; DEADLINE_EXCEEDED is the
+#: *ambiguous* one — the push may have been applied — and retrying it is
+#: only safe because v2 frames carry an idempotency key (agent/epoch/
+#: window_seq/frame_uuid) the aggregator dedups on.
+RETRY_SAFE_CODES = frozenset((
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+    grpc.StatusCode.ABORTED,
+    grpc.StatusCode.INTERNAL,      # transient stream resets land here
+    grpc.StatusCode.UNKNOWN,       # connectivity errors without a verdict
+))
+
+#: codes where resending the same bytes CANNOT succeed (a broken client, a
+#: wrong target, an auth failure) — burning the retry ladder on them only
+#: delays the local report pipeline.
+TERMINAL_CODES = frozenset((
+    grpc.StatusCode.INVALID_ARGUMENT,
+    grpc.StatusCode.UNIMPLEMENTED,
+    grpc.StatusCode.FAILED_PRECONDITION,
+    grpc.StatusCode.PERMISSION_DENIED,
+    grpc.StatusCode.UNAUTHENTICATED,
+    grpc.StatusCode.NOT_FOUND,
+))
+
+
+def classify_rpc_error(exc: Exception) -> str:
+    """`retry` / `terminal` for a push failure. Non-gRPC exceptions (bugs
+    in the stack below us) classify as terminal — retrying a TypeError
+    three times with backoff is pure stall."""
+    code = exc.code() if isinstance(exc, grpc.RpcError) else None
+    if code in TERMINAL_CODES:
+        return "terminal"
+    if code in RETRY_SAFE_CODES:
+        return "retry"
+    return "retry" if code is not None else "terminal"
+
 
 class FederationClient:
     """Unary Push client; `send` takes an ALREADY-SERIALIZED delta frame."""
@@ -39,10 +77,20 @@ class FederationClient:
 
     def connect(self) -> None:
         self.close()
+        # a LOCAL subchannel pool makes reconnect() an actual fresh start:
+        # by default grpc-python shares subchannels per target process-wide,
+        # so a "new" channel inherits the old subchannel's TRANSIENT_FAILURE
+        # backoff (seconds-to-minutes) and every retry fails fast with
+        # UNAVAILABLE even after the aggregator came back — a cold-started
+        # agent would never deliver a frame (pinned by the smoke failure
+        # path / tests/test_federation_chaos.py cold-start test)
+        opts = (("grpc.use_local_subchannel_pool", 1),)
         if self._creds is not None:
-            self._channel = grpc.secure_channel(self._target, self._creds)
+            self._channel = grpc.secure_channel(self._target, self._creds,
+                                                options=opts)
         else:
-            self._channel = grpc.insecure_channel(self._target)
+            self._channel = grpc.insecure_channel(self._target,
+                                                  options=opts)
         self._push = self._channel.unary_unary(
             _PUSH,
             request_serializer=_identity,

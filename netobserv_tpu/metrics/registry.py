@@ -219,18 +219,26 @@ class Metrics:
         self.federation_deltas_total = Counter(
             p + "federation_deltas_total",
             "Delta frames received by the aggregator, by outcome (ok / "
-            "version_mismatch / shape_mismatch / decode_error / "
-            "merge_error)", ["result"], registry=self.registry)
+            "duplicate / stale / legacy / version_mismatch / "
+            "shape_mismatch / decode_error / merge_error). duplicate and "
+            "stale are acked-and-discarded by the idempotency ledger; "
+            "legacy is a merged v1 frame with no delivery header",
+            ["result"], registry=self.registry)
         self.federation_delta_bytes_total = Counter(
             p + "federation_delta_bytes_total",
             "Wire bytes of received delta frames (the federation plane's "
             "ingress volume)", registry=self.registry)
         self.federation_deltas_sent_total = Counter(
             p + "federation_deltas_sent_total",
-            "Delta frames pushed by this agent, by outcome (ok / rejected "
-            "/ error — error means the retry ladder was exhausted and the "
-            "window's frame was dropped)", ["result"],
-            registry=self.registry)
+            "Delta frames pushed by this agent, by outcome (ok / "
+            "duplicate / stale / rejected / terminal / error). duplicate "
+            "= an ambiguous-deadline retry the aggregator's ledger safely "
+            "deduplicated; stale = the aggregator acked-and-DISCARDED the "
+            "window as out-of-order (that window's data is lost); "
+            "terminal = a non-retryable gRPC status "
+            "(INVALID_ARGUMENT class) failed fast; error = the retry "
+            "ladder was exhausted and the window's frame was dropped",
+            ["result"], registry=self.registry)
         self.federation_merge_seconds = Histogram(
             p + "federation_merge_seconds",
             "On-device hierarchical merge latency per accepted delta frame",
@@ -239,12 +247,26 @@ class Metrics:
         self.federation_agent_staleness_seconds = Gauge(
             p + "federation_agent_staleness_seconds",
             "Seconds since each known agent's last accepted delta "
-            "(cardinality = fleet size; an agent past ~2 windows is dark)",
+            "(cardinality = LIVE fleet size: series are deleted when the "
+            "agent is evicted past FEDERATION_AGENT_TTL; an agent past "
+            "~2 windows is dark)",
             ["agent"], registry=self.registry)
         self.federation_active_agents = Gauge(
             p + "federation_active_agents",
             "Agents that contributed a delta to the last aggregator window",
             registry=self.registry)
+        self.federation_agent_evictions_total = Counter(
+            p + "federation_agent_evictions_total",
+            "Agents evicted from the aggregator's ownership view after "
+            "FEDERATION_AGENT_TTL seconds without a delta (their "
+            "staleness gauge series is deleted at the same time)",
+            registry=self.registry)
+        self.federation_checkpoints_total = Counter(
+            p + "federation_checkpoints_total",
+            "Aggregator state+ledger checkpoints at window roll, by "
+            "outcome (ok / error — error means the window rolled without "
+            "durability; a restart then loses back to the previous "
+            "checkpoint)", ["result"], registry=self.registry)
 
     # --- convenience methods used by pipeline stages ---
     def observe_eviction(self, source: str, n_flows: int, seconds: float) -> None:
@@ -274,6 +296,16 @@ class Metrics:
 
     def count_error(self, component: str, severity: str = "error") -> None:
         self.errors_total.labels(component, severity).inc()
+
+    def remove_labeled(self, metric, *labelvalues: str) -> None:
+        """Delete one labeled series from a metric family — the
+        cardinality-lifecycle seam (departed federation agents, expired
+        trace-level series). Removing a series that never existed (or was
+        already removed) is a no-op, so callers can evict blindly."""
+        try:
+            metric.remove(*labelvalues)
+        except KeyError:
+            pass
 
     def observe_stage(self, stage: str, seconds: float) -> None:
         self.stage_seconds.labels(stage).observe(seconds)

@@ -112,6 +112,85 @@ class SketchCheckpointer:
         if wait:
             self._mngr.wait_until_finished()
 
+    # --- per-step JSON metadata sidecars (federation aggregator ledger) --
+    # Host-side metadata that must restore ATOMICALLY with a step's tensors
+    # (the aggregator's per-agent delivery ledger — restoring tensors with
+    # a ledger from another step would re-admit or falsely-discard frames).
+    # Contract: write the sidecar for step N BEFORE saving step N's tensors;
+    # restore reads the sidecar of the step it actually restored. A crash
+    # between the two writes leaves latest_step at N-1, whose sidecar
+    # already exists — (state, ledger) pairs can never tear.
+
+    def _meta_path(self, step: int) -> str:
+        return os.path.join(self._dir, f"META-{int(step)}.json")
+
+    def save_metadata(self, step: int, meta: dict) -> None:
+        """Atomically write step-paired JSON metadata (call BEFORE save());
+        old sidecars beyond the manager's retention are pruned."""
+        tmp = self._meta_path(step) + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"step": int(step), "meta": meta}, fh)
+        os.replace(tmp, self._meta_path(step))
+        keep = set(self._mngr.all_steps()) | {int(step)}
+        for name in os.listdir(self._dir):
+            if name.startswith("META-") and name.endswith(".json"):
+                try:
+                    s = int(name[len("META-"):-len(".json")])
+                except ValueError:
+                    continue
+                if s not in keep:
+                    try:
+                        os.remove(os.path.join(self._dir, name))
+                    except OSError:
+                        pass
+
+    def read_metadata(self, step: Optional[int] = None) -> Optional[dict]:
+        """The metadata paired with `step` (default: latest step). None when
+        the sidecar is absent (pre-metadata checkpoints) or unreadable —
+        callers must treat that as an EMPTY ledger, never a failure."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            return None
+        try:
+            with open(self._meta_path(step)) as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if int(payload.get("step", -1)) != int(step):
+            return None
+        return payload.get("meta")
+
+    # --- publish-commit marker (federation aggregator) -------------------
+    # A tiny atomic JSON updated at every WINDOW PUBLISH (not every tensor
+    # save): the last published window id plus the delivery ledger as of
+    # that publish. With FEDERATION_CHECKPOINT_EVERY > 1 the newest tensor
+    # checkpoint can trail published windows; the marker lets a restore
+    # fast-forward the window counter past every id that already reached
+    # the sink (closed windows never re-publish) and overlay the ledger
+    # those windows committed (their redelivered frames dedup, never
+    # double-count), at the cost of losing the skipped windows' tensor
+    # contribution — the documented every-N durability tradeoff.
+
+    def _publish_marker_path(self) -> str:
+        return os.path.join(self._dir, "PUBLISHED.json")
+
+    def save_publish_marker(self, window: int, meta: dict) -> None:
+        tmp = self._publish_marker_path() + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"window": int(window), "meta": meta}, fh)
+        os.replace(tmp, self._publish_marker_path())
+
+    def read_publish_marker(self) -> Optional[dict]:
+        """{"window": int, "meta": {...}} of the last publish, or None
+        (absent/unreadable markers mean no fast-forward, never a failure)."""
+        try:
+            with open(self._publish_marker_path()) as fh:
+                payload = json.load(fh)
+            return {"window": int(payload["window"]),
+                    "meta": payload.get("meta") or {}}
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 

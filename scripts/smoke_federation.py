@@ -7,14 +7,27 @@ two TpuSketchExporters pushing delta frames through the REAL gRPC seam,
 folds a deterministic record stream through each, flushes both windows,
 and asserts the cluster-wide /federation/topk answer merges both agents'
 traffic. Prints ONE JSON line with what it saw.
+
+`--failure-path` (`make smoke-federation-chaos`, also driven by
+tests/test_federation_chaos.py) runs the RAINY day instead: the agents
+come up FIRST and push into nothing (cold start — their sinks walk the
+retry ladder and drop), the aggregator starts late and catches up on the
+next window, is then shut down and restarted once mid-run (restoring from
+its checkpoint), while a query poller hammers the surface asserting it
+never serves a torn snapshot (every response internally consistent, seq/
+window monotonically non-decreasing across the restart thanks to the
+restored window counter).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import socket
 import sys
+import threading
 import time
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -122,5 +135,178 @@ def main() -> int:
     return 0 if ok else 1
 
 
+def run_failure_path(checkpoint_dir: str = "") -> dict:
+    """Cold-start + mid-run-restart schedule; returns the result dict
+    (also usable in-process by tests/test_federation_chaos.py). The
+    caller owns `checkpoint_dir` cleanup; "" runs without checkpointing
+    (the window counter then restarts at 0 — seq monotonicity is only
+    asserted when a checkpoint dir is given)."""
+    from netobserv_tpu.utils.platform import maybe_force_cpu
+    maybe_force_cpu()
+
+    from netobserv_tpu.config import AgentConfig
+    from netobserv_tpu.exporter.federation import FederationDeltaSink
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.federation.service import FederationAggregatorService
+    from netobserv_tpu.model.flow import FlowKey
+    from netobserv_tpu.model.record import Record
+    from netobserv_tpu.sketch.state import SketchConfig
+
+    # reserve a FIXED port so the restarted aggregator comes back where
+    # the agents' sinks are already pointed (ephemeral would re-roll it)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    grpc_port = s.getsockname()[1]
+    s.close()
+
+    cfg = AgentConfig()
+    cfg.sketch_cm_depth, cfg.sketch_cm_width = 2, 1024
+    cfg.sketch_hll_precision, cfg.sketch_topk = 6, 32
+    cfg.federation_listen_port = grpc_port
+    cfg.federation_query_port = 0
+    cfg.federation_window = 3600.0
+    cfg.federation_checkpoint_dir = checkpoint_dir
+
+    notes: list[str] = []
+    torn: list[str] = []
+    reports: list[dict] = []
+    query_port = [0]          # mutable: restarts re-seat the ephemeral port
+    stop_poll = threading.Event()
+    seen: list[tuple[int, int]] = []   # (seq, window) per good response
+
+    def poller() -> None:
+        while not stop_poll.wait(0.02):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{query_port[0]}"
+                        "/federation/topk?n=5", timeout=5) as r:
+                    obj = json.loads(r.read())
+            except (urllib.error.URLError, OSError, ValueError):
+                continue  # down/restarting or no window yet: that is fine
+            # torn = structurally inconsistent, not merely unavailable
+            # (window, seq) ordering: the WINDOW counter is the durable
+            # one (checkpoint-restored across restarts); seq breaks ties
+            # within one process incarnation
+            if not {"window", "ts_ms", "seq", "topk"} <= obj.keys():
+                torn.append(f"missing keys: {sorted(obj.keys())}")
+            elif seen and checkpoint_dir \
+                    and (obj["window"], obj["seq"]) < seen[-1]:
+                # without a checkpoint the restarted window counter
+                # legitimately restarts at 0 — only a CHECKPOINTED
+                # aggregator owes the poller monotonicity
+                torn.append(f"snapshot went backwards: {seen[-1]} -> "
+                            f"({obj['window']}, {obj['seq']})")
+            else:
+                seen.append((obj["window"], obj["seq"]))
+
+    def make_records(agent: int, salt: int, n: int = 128) -> list[Record]:
+        now = time.time_ns()
+        out = []
+        for i in range(n):
+            key = FlowKey.make(f"10.{agent}.{salt}.{i % 30}",
+                               f"10.{agent}.200.{i % 10}",
+                               1024 + i, 443, 6)
+            out.append(Record(
+                key=key, bytes_=1000 + i, packets=3, eth_protocol=0x0800,
+                tcp_flags=0x12, direction=1, if_index=1, interface="eth0",
+                time_flow_start_ns=now - 10**9, time_flow_end_ns=now))
+        return out
+
+    sketch_cfg = SketchConfig(cm_depth=2, cm_width=1024, hll_precision=6,
+                              topk=32)
+    agents, sinks = [], []
+    for a in range(2):
+        sink = FederationDeltaSink("127.0.0.1", grpc_port, retries=2,
+                                   backoff_initial_s=0.05, timeout_s=5.0)
+        exp = TpuSketchExporter(
+            batch_size=128, window_s=3600.0, sketch_cfg=sketch_cfg,
+            sink=lambda obj: None, delta_sink=sink,
+            agent_id=f"chaos-agent-{a}")
+        agents.append(exp)
+        sinks.append(sink)
+
+    def push_window(salt: int) -> None:
+        for a, exp in enumerate(agents):
+            exp.export_batch(make_records(a, salt))
+            exp.flush()
+
+    # window 0: NOTHING is listening — cold start; ladders exhaust, frames
+    # drop (per-window snapshots: the next window supersedes them)
+    push_window(salt=0)
+
+    svc = FederationAggregatorService(cfg, sink=reports.append)
+    svc.start()
+    query_port[0] = svc.query_port
+    threading.Thread(target=poller, daemon=True).start()
+
+    # window 1: catch-up — the late aggregator now sees both agents
+    push_window(salt=1)
+    svc.aggregator.flush()
+    status1 = svc.aggregator.status()
+
+    # mid-run restart (graceful here; the SIGKILL flavor is pinned by
+    # tests/test_federation_chaos.py against the checkpoint semantics)
+    svc.shutdown()
+    svc2 = FederationAggregatorService(cfg, sink=reports.append)
+    svc2.start()
+    query_port[0] = svc2.query_port
+
+    # window 2: the restarted aggregator serves on, sinks reconnect
+    push_window(salt=2)
+    svc2.aggregator.flush()
+    status2 = svc2.aggregator.status()
+    time.sleep(0.2)          # a few poller rounds against the new snapshot
+    stop_poll.set()
+
+    ok = True
+    if len(status1["agents"]) != 2 or len(status2["agents"]) != 2:
+        ok, _ = False, notes.append("expected 2 agents registered in both "
+                                    "aggregator incarnations")
+    if torn:
+        ok, _ = False, notes.append(f"torn snapshots: {torn[:3]}")
+    if not seen:
+        ok, _ = False, notes.append("poller never saw a published window")
+    # published reports: window 1 (pre-restart) + windows from svc2; the
+    # cold-start window 0 must be absent everywhere (it was dropped)
+    if len(reports) < 2:
+        ok, _ = False, notes.append(
+            f"expected >=2 published windows, saw {len(reports)}")
+    per_window = 2 * 128.0
+    recs = [r["Records"] for r in reports]
+    if any(r > per_window for r in recs):
+        ok, _ = False, notes.append(
+            f"a window over-counted: {recs} (> {per_window}/window means "
+            "a dropped/cold-start frame leaked back in)")
+    if checkpoint_dir and status2.get("last_published_window") is not None \
+            and status1.get("last_published_window") is not None \
+            and status2["last_published_window"] \
+            <= status1["last_published_window"]:
+        ok, _ = False, notes.append(
+            "restored window counter did not advance past the "
+            "pre-restart one")
+
+    for exp in agents:
+        exp.close()
+    svc2.shutdown()
+    return {
+        "metric": "smoke_federation_chaos", "ok": ok, "notes": notes,
+        "agents": sorted(status2["agents"]),
+        "published_windows": recs,
+        "poll_responses": len(seen),
+        "torn_responses": len(torn),
+        "last_published_window": status2.get("last_published_window"),
+        "checkpointed": bool(checkpoint_dir),
+    }
+
+
+def main_failure_path() -> int:
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="fed-ckpt-") as d:
+        out = run_failure_path(checkpoint_dir=d)
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main_failure_path() if "--failure-path" in sys.argv
+             else main())

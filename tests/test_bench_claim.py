@@ -1,0 +1,68 @@
+"""Regression pins for bench.py's device-claim watchdog (_device_watchdog).
+
+The wedge contract (bench satellite, PR 7): a hung probe gets exactly ONE
+retry with a fresh grant. The regression here is the final-attempt case —
+`continue` used to re-evaluate `while i < attempts` after the log line
+promised a retry, so a hang on the last ladder attempt silently fell back
+to CPU without the one recovery probe the docstring guarantees.
+"""
+
+import subprocess
+
+import pytest
+
+import bench
+
+
+@pytest.fixture()
+def claim_env(monkeypatch):
+    monkeypatch.setenv("BENCH_TPU_PROBE_TIMEOUT", "5")
+    monkeypatch.setenv("BENCH_TPU_PROBE_ATTEMPTS", "1")
+    monkeypatch.setenv("BENCH_TPU_RETRY_SLEEP", "0")
+    monkeypatch.setenv("BENCH_CLAIM_DEADLINE", "900")
+    claim = {"attempts": 0, "wedged": False, "deadline_hit": False}
+    monkeypatch.setattr(bench, "_CLAIM", claim)
+    return claim
+
+
+class _FakeProbe:
+    def __init__(self, outcome: str):
+        self._outcome = outcome
+
+    def communicate(self, timeout=None):
+        if self._outcome == "hang":
+            raise subprocess.TimeoutExpired("probe", timeout or 0)
+        return self._outcome + "\n", None
+
+
+def _fake_popen(script, calls):
+    def popen(args, **kwargs):
+        calls.append(args)
+        return _FakeProbe(script[min(len(calls) - 1, len(script) - 1)])
+    return popen
+
+
+def test_final_attempt_wedge_still_gets_fresh_grant(monkeypatch, claim_env):
+    """attempts=1 and the only probe hangs: the promised fresh-grant
+    retry must still run (and, a poisoned grant being the usual cause,
+    recover on the clean re-claim)."""
+    calls = []
+    monkeypatch.setattr(subprocess, "Popen",
+                        _fake_popen(["hang", "axon"], calls))
+    assert bench._device_watchdog() == "axon"
+    assert len(calls) == 2, "fresh-grant probe never ran"
+    assert claim_env["wedged"] is True
+    assert claim_env["attempts"] == 2
+
+
+def test_second_hang_falls_back_without_stacking_claims(monkeypatch,
+                                                        claim_env):
+    """Two hangs mean the tunnel itself is gone: exactly two probes
+    (original + the one fresh grant), then CPU fallback — stacking more
+    claims behind a dead tunnel only worsens the wedge."""
+    calls = []
+    monkeypatch.setattr(subprocess, "Popen",
+                        _fake_popen(["hang", "hang"], calls))
+    assert bench._device_watchdog() == "cpu-fallback"
+    assert len(calls) == 2, "a second hang must not stack more claims"
+    assert claim_env["wedged"] is True

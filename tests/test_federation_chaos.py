@@ -1,0 +1,796 @@
+"""Federation chaos suite: the plane's accounting under hostile delivery.
+
+The load-bearing claim (ISSUE 7 acceptance): under injected duplicate
+delivery, frame reorder, ambiguous gRPC deadlines, an aggregator
+kill/restart mid-stream, and a wedged checkpoint disk, the federated
+aggregate stays BIT-EXACT equal to the union roll of every frame that was
+legitimately applied — at most the one uncheckpointed partial window is
+lost (and redelivery recovers even that), and no frame is ever counted
+twice. The expected state for arbitrary fault schedules comes from a tiny
+host-side replay of the ledger semantics (`LedgerModel`), so every test
+derives its oracle from the SAME rules the aggregator pins.
+
+Fault points exercised here: `federation.delta_ingest` (delay => the
+ambiguous-deadline double-apply, corrupt => decode-layer robustness) and
+`federation.checkpoint` (crash => wedged checkpoint disk). Both must stay
+zero-cost when FAULT_POINTS is unset (pinned below, same bound as
+tests/test_supervision.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401  (forces the CPU backend)
+
+from netobserv_tpu.federation import delta as fdelta
+from netobserv_tpu.federation.aggregator import FederationAggregator
+from netobserv_tpu.metrics.registry import Metrics
+from netobserv_tpu.sketch import state as sk
+from netobserv_tpu.utils import faultinject
+from tests.test_federation import CFG, DIMS, make_arrays
+
+EPOCH0 = 1_000  # synthetic agent boot identities (monotonic per restart)
+
+
+def build_streams(n_agents=3, n_windows=2, seed=11, epoch=EPOCH0):
+    """Per-(agent, window) frames with explicit v2 delivery headers, plus
+    the raw batches behind each frame (the replay oracle folds the batches
+    of exactly the frames the ledger admits)."""
+    rng = np.random.default_rng(seed)
+    universe = rng.integers(0, 2**32, (40, 10), dtype=np.uint32)
+    roll = sk.make_roll_fn(CFG, with_tables=True)
+    frames = {}   # (agent, window) -> (frame_bytes, [batches])
+    for a in range(n_agents):
+        s = sk.init_state(CFG)
+        for w in range(n_windows):
+            batches = [make_arrays(rng, universe) for _ in range(2)]
+            for arrays in batches:
+                s = sk.ingest(s, arrays)
+            s, _, tables = roll(s)
+            frames[(a, w)] = (fdelta.encode_frame(
+                {k: np.asarray(v) for k, v in tables.items()},
+                agent_id=f"agent-{a}", window=w, ts_ms=1234, dims=DIMS,
+                window_seq=w, frame_uuid=f"uuid-{a}-{w}-{epoch}",
+                agent_epoch=epoch), batches)
+    return frames
+
+
+class LedgerModel:
+    """Host replay of the aggregator's admit/discard rules — the oracle.
+    Feeding a delivery schedule through this yields the exact batch set
+    the aggregator must have folded, whatever the faults did."""
+
+    def __init__(self):
+        self.last: dict[str, tuple] = {}   # agent -> (epoch, seq, uuid)
+
+    def admit(self, agent: str, epoch: int, seq: int, uuid_: str) -> bool:
+        last = self.last.get(agent)
+        if last is None or epoch > last[0] or (epoch == last[0]
+                                               and seq > last[1]):
+            self.last[agent] = (epoch, seq, uuid_)
+            return True
+        return False
+
+
+def union_of(batch_lists) -> sk.SketchState:
+    union = sk.init_state(CFG)
+    for batches in batch_lists:
+        for arrays in batches:
+            union = sk.ingest(union, arrays)
+    return union
+
+
+def assert_states_bit_exact(agg_state, union):
+    """The PR 6 equivalence claim, reused verbatim: linear/max structures
+    and the top-K set must match bit-for-bit."""
+    np.testing.assert_array_equal(np.asarray(agg_state.cm_bytes.counts),
+                                  np.asarray(union.cm_bytes.counts))
+    np.testing.assert_array_equal(np.asarray(agg_state.cm_pkts.counts),
+                                  np.asarray(union.cm_pkts.counts))
+    for name in ("hll_src", "hll_per_dst", "hll_per_src"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(agg_state, name).regs),
+            np.asarray(getattr(union, name).regs), err_msg=name)
+    for name in ("synack", "drop_causes", "dscp_bytes", "conv_fwd",
+                 "conv_rev"):
+        np.testing.assert_array_equal(np.asarray(getattr(agg_state, name)),
+                                      np.asarray(getattr(union, name)),
+                                      err_msg=name)
+    np.testing.assert_array_equal(np.asarray(agg_state.ddos.rate),
+                                  np.asarray(union.ddos.rate))
+    np.testing.assert_array_equal(np.asarray(agg_state.syn.rate),
+                                  np.asarray(union.syn.rate))
+    np.testing.assert_array_equal(np.asarray(agg_state.hist_rtt.counts),
+                                  np.asarray(union.hist_rtt.counts))
+    assert float(agg_state.total_records) == float(union.total_records)
+    assert float(agg_state.total_bytes) == float(union.total_bytes)
+
+    def entries(state):
+        words = np.asarray(state.heavy.words)
+        valid = np.asarray(state.heavy.valid)
+        return {words[i].tobytes() for i in range(len(valid)) if valid[i]}
+    assert entries(agg_state) == entries(union)
+
+
+def run_schedule(agg, frames, schedule):
+    """Deliver (agent, window) keys in `schedule` order (repeats allowed);
+    returns the ledger-model-expected union state."""
+    model = LedgerModel()
+    applied = []
+    for key in schedule:
+        data, batches = frames[key]
+        ack = agg.ingest_frame(data)
+        assert ack.accepted == 1, ack.reason
+        frame = fdelta.decode_frame(data)
+        if model.admit(frame.agent_id, frame.agent_epoch,
+                       frame.window_seq, frame.frame_uuid):
+            assert not ack.duplicate, f"fresh frame {key} acked duplicate"
+            applied.append(batches)
+        else:
+            assert ack.duplicate, f"redelivered frame {key} merged twice"
+    return union_of(applied)
+
+
+# --- idempotent delivery -------------------------------------------------
+
+class TestIdempotentDelivery:
+    @pytest.fixture()
+    def agg(self):
+        a = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                 sink=lambda obj: None)
+        yield a
+        a.close()
+
+    def test_duplicate_delivery_bit_exact(self, agg):
+        """Every frame delivered 1-3x (ambiguous-deadline redelivery):
+        the aggregate equals the union as if each arrived exactly once."""
+        frames = build_streams(n_agents=3, n_windows=2, seed=21)
+        rng = np.random.default_rng(0)
+        schedule = []
+        for a in range(3):
+            for w in range(2):
+                schedule += [(a, w)] * int(rng.integers(1, 4))
+        expected = run_schedule(agg, frames, schedule)
+        assert_states_bit_exact(agg._state, expected)
+
+    def test_reordered_and_stale_windows_discarded(self, agg):
+        """Out-of-order delivery: a stale window arriving after a newer
+        one is acked-and-discarded, never merged — and the aggregate still
+        matches the ledger-model oracle bit-exactly."""
+        frames = build_streams(n_agents=2, n_windows=3, seed=22)
+        schedule = [
+            (0, 1), (1, 0),          # agent 0 skips ahead
+            (0, 0),                  # late window 0: stale, discarded
+            (1, 2), (0, 2),
+            (1, 1),                  # late window 1: stale, discarded
+            (0, 1), (1, 2),          # exact duplicates on top
+        ]
+        expected = run_schedule(agg, frames, schedule)
+        assert_states_bit_exact(agg._state, expected)
+        # windows 0-for-agent-0 and 1-for-agent-1 must NOT be in the union
+        full = union_of([frames[k][1] for k in frames])
+        assert float(agg._state.total_records) < float(full.total_records)
+
+    def test_epoch_reregistration(self, agg):
+        """A restarted agent (fresh epoch, seq reset to 0) re-registers
+        cleanly; a dead epoch's straggler is discarded as stale."""
+        old = build_streams(n_agents=1, n_windows=2, seed=23, epoch=EPOCH0)
+        new = build_streams(n_agents=1, n_windows=1, seed=24,
+                            epoch=EPOCH0 + 7)
+        assert agg.ingest_frame(old[(0, 0)][0]).accepted == 1
+        assert agg.ingest_frame(old[(0, 1)][0]).accepted == 1
+        # restart: new epoch, window_seq back to 0 — must MERGE, not read
+        # as a flood of stale frames
+        ack = agg.ingest_frame(new[(0, 0)][0])
+        assert ack.accepted == 1 and not ack.duplicate
+        # straggler from the dead epoch: acked, discarded
+        ack = agg.ingest_frame(old[(0, 1)][0])
+        assert ack.accepted == 1 and ack.duplicate
+        expected = union_of([old[(0, 0)][1], old[(0, 1)][1],
+                             new[(0, 0)][1]])
+        assert_states_bit_exact(agg._state, expected)
+        # re-registration/rollover never changed a tensor shape: zero
+        # post-warmup retraces on the watched merge (compiles may read 0
+        # here — an identical jit lowered earlier in-process dedups the
+        # lowering event — so the retrace count is the witness)
+        assert agg._fold.calls == 3 and agg._fold.retraces == 0
+
+    def test_legacy_v1_frames_merge_unconditionally(self, agg):
+        """Wire compat: v1 frames (no delivery header) merge and count as
+        `legacy` — including redelivery, which v1 cannot dedup (the
+        documented reason the fleet should move to v2)."""
+        from netobserv_tpu.pb import sketch_delta_pb2 as pb
+        m = Metrics()
+        agg._metrics = m
+        frames = build_streams(n_agents=1, n_windows=1, seed=25)
+        msg = pb.SketchDelta.FromString(frames[(0, 0)][0])
+        msg.version = 1
+        msg.window_seq = 0
+        msg.frame_uuid = ""
+        msg.agent_epoch = 0
+        v1 = msg.SerializeToString(deterministic=True)
+        for _ in range(2):
+            ack = agg.ingest_frame(v1)
+            assert ack.accepted == 1 and not ack.duplicate
+        expected = union_of([frames[(0, 0)][1], frames[(0, 0)][1]])
+        assert_states_bit_exact(agg._state, expected)
+        assert m.registry.get_sample_value(
+            "ebpf_agent_federation_deltas_total",
+            {"result": "legacy"}) == 2
+
+    def test_duplicate_and_stale_counted(self):
+        m = Metrics()
+        agg = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                   metrics=m, sink=lambda obj: None)
+        try:
+            frames = build_streams(n_agents=1, n_windows=2, seed=26)
+            for key in ((0, 1), (0, 1), (0, 0)):
+                agg.ingest_frame(frames[key][0])
+        finally:
+            agg.close()
+        get = m.registry.get_sample_value
+        assert get("ebpf_agent_federation_deltas_total",
+                   {"result": "ok"}) == 1
+        assert get("ebpf_agent_federation_deltas_total",
+                   {"result": "duplicate"}) == 1
+        assert get("ebpf_agent_federation_deltas_total",
+                   {"result": "stale"}) == 1
+
+
+# --- aggregator kill/restart against the checkpoint ----------------------
+
+class TestCheckpointRestore:
+    def test_kill_restart_exactly_once(self, tmp_path):
+        """The acceptance pin: a SIGKILL-style restart mid-window loses at
+        most the uncheckpointed partial, never a closed window, never
+        double-publishes — and redelivery of the partial's frames (what
+        the agents' retry ladders do) recovers even that loss without a
+        single double-counted frame."""
+        ckpt = str(tmp_path / "agg")
+        reports: list[dict] = []
+        frames = build_streams(n_agents=2, n_windows=2, seed=31)
+
+        agg = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                   sink=reports.append,
+                                   checkpoint_dir=ckpt)
+        assert agg.ingest_frame(frames[(0, 0)][0]).accepted == 1
+        assert agg.ingest_frame(frames[(1, 0)][0]).accepted == 1
+        agg.flush()          # closes window 0: publish + checkpoint
+        assert len(reports) == 1
+        w0 = reports[0]["Window"]
+        # partial window: one agent's next frame lands, then SIGKILL
+        assert agg.ingest_frame(frames[(0, 1)][0]).accepted == 1
+        agg.kill()           # no flush, no publish, no final checkpoint
+
+        agg2 = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                    sink=reports.append,
+                                    checkpoint_dir=ckpt)
+        try:
+            # closed window 0 was restored as already-rolled: nothing to
+            # re-publish, and its agents' ledger entries survived — the
+            # RE-DELIVERED window-0 frames (an agent retrying across the
+            # outage) are discarded, not double-counted
+            ack = agg2.ingest_frame(frames[(0, 0)][0])
+            assert ack.accepted == 1 and ack.duplicate
+            # the partial window's frame was NOT checkpointed: its
+            # redelivery must merge (this is how retry recovers the loss)
+            ack = agg2.ingest_frame(frames[(0, 1)][0])
+            assert ack.accepted == 1 and not ack.duplicate
+            ack = agg2.ingest_frame(frames[(1, 1)][0])
+            assert ack.accepted == 1 and not ack.duplicate
+            # and a second copy of it dedups as usual
+            assert agg2.ingest_frame(frames[(0, 1)][0]).duplicate
+            expected = union_of([frames[(0, 1)][1], frames[(1, 1)][1]])
+            assert_states_bit_exact(agg2._state, expected)
+            # restore raised the window counter past the closed window:
+            # exactly-once publish across the restart
+            agg2.flush()
+            windows = [r["Window"] for r in reports]
+            assert windows.count(w0) == 1, "closed window double-published"
+            assert windows[-1] > w0
+            # restore + merges retraced nothing: the restored pytree has
+            # the exact shapes/dtypes the fixed-signature entries expect
+            assert agg2._fold.calls == 2 and agg2._fold.retraces == 0
+            assert agg2._roll.retraces == 0
+        finally:
+            agg2.close()
+
+    def test_checkpoint_every_n_never_republishes_closed_window(
+            self, tmp_path):
+        """checkpoint_every > 1 must not break exactly-once publish: the
+        publish-commit marker records every published window id (+ the
+        ledger it committed), so a restore from an OLDER tensor
+        checkpoint fast-forwards the counter past published ids and
+        still dedups their redelivered frames — the skipped windows'
+        tensor contribution is the documented every-N durability loss."""
+        ckpt = str(tmp_path / "agg")
+        reports: list[dict] = []
+        frames = build_streams(n_agents=1, n_windows=3, seed=35)
+        agg = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                   sink=reports.append,
+                                   checkpoint_dir=ckpt, checkpoint_every=2)
+        for w in range(3):
+            assert agg.ingest_frame(frames[(0, w)][0]).accepted == 1
+            agg.flush()       # tensor checkpoint only on the 2nd roll
+        assert len(reports) == 3
+        agg.kill()
+
+        agg2 = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                    sink=reports.append,
+                                    checkpoint_dir=ckpt, checkpoint_every=2)
+        try:
+            # window 2 was published but NOT tensor-checkpointed: its
+            # redelivered frame must still dedup (marker ledger), and its
+            # window id must never be re-used
+            ack = agg2.ingest_frame(frames[(0, 2)][0])
+            assert ack.accepted == 1 and ack.duplicate, \
+                "published-but-uncheckpointed window re-merged"
+            assert_states_bit_exact(agg2._state, sk.init_state(CFG))
+            agg2.flush()
+            windows = [r["Window"] for r in reports]
+            assert len(set(windows)) == len(windows), \
+                f"closed window id re-published: {windows}"
+            assert windows[-1] == windows[2] + 1
+            assert agg2._roll.retraces == 0
+        finally:
+            agg2.close()
+
+    def test_hung_checkpoint_stalls_only_the_timer_not_ingest(
+            self, tmp_path):
+        """A checkpoint filesystem that HANGS (blocks instead of raising)
+        must stall only the supervised timer/publish path: the save runs
+        from a staged copy OFF self._lock, so delta ingest — and with it
+        every agent's gRPC push — keeps flowing."""
+        import threading
+
+        frames = build_streams(n_agents=1, n_windows=2, seed=34)
+        reports: list[dict] = []
+        agg = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                   sink=reports.append,
+                                   checkpoint_dir=str(tmp_path / "agg"))
+        entered, release = threading.Event(), threading.Event()
+        real_save = agg._ckpt.save
+
+        def hung_save(step, state, wait=False):
+            entered.set()
+            assert release.wait(timeout=30), "release never came"
+            return real_save(step, state, wait=wait)
+
+        agg._ckpt.save = hung_save
+        try:
+            assert agg.ingest_frame(frames[(0, 0)][0]).accepted == 1
+            flusher = threading.Thread(target=agg.flush, daemon=True)
+            flusher.start()
+            assert entered.wait(timeout=30), "checkpoint save never ran"
+            # the publish path is wedged INSIDE the save; ingest must
+            # not be — it only needs self._lock, which the save does
+            # not hold
+            got: dict = {}
+            done = threading.Event()
+
+            def ingest():
+                got["ack"] = agg.ingest_frame(frames[(0, 1)][0])
+                done.set()
+
+            threading.Thread(target=ingest, daemon=True).start()
+            assert done.wait(timeout=10), \
+                "delta ingest deadlocked behind a hung checkpoint disk"
+            assert got["ack"].accepted == 1
+            assert not reports, "publish outran its window's checkpoint"
+            # shutdown must stay BOUNDED while the disk is still hung:
+            # close() times out on the publish lock (held inside the
+            # wedged save) instead of joining the deadlock
+            closed = threading.Event()
+            threading.Thread(target=lambda: (agg.close(), closed.set()),
+                             daemon=True).start()
+            assert closed.wait(timeout=25), \
+                "close() deadlocked behind the hung checkpoint disk"
+            release.set()
+            flusher.join(timeout=30)
+            deadline = time.time() + 30
+            while not reports and time.time() < deadline:
+                time.sleep(0.05)
+            assert reports, "unwedged checkpoint lost the publish"
+        finally:
+            release.set()
+            agg.close()
+
+    def test_failed_restore_quarantines_directory(self, tmp_path):
+        """An unrestorable checkpoint dir is moved aside, NOT left live:
+        the fresh window counter restarts at 0, and orbax retention
+        (highest steps win) in the old dir would garbage-collect every
+        new checkpoint while latest_step() kept serving the corrupt high
+        step — restarts would retry the broken restore forever."""
+        import json
+
+        ckpt = str(tmp_path / "agg")
+        frames = build_streams(n_agents=1, n_windows=2, seed=33)
+        agg = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                   sink=lambda obj: None,
+                                   checkpoint_dir=ckpt)
+        assert agg.ingest_frame(frames[(0, 0)][0]).accepted == 1
+        agg.flush()
+        agg.close()
+        # poison the format stamp: restore must reject BEFORE tensors
+        with open(os.path.join(ckpt, "FORMAT.json"), "w") as fh:
+            json.dump({"format_version": 99}, fh)
+
+        m = Metrics()
+        agg2 = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                    metrics=m, sink=lambda obj: None,
+                                    checkpoint_dir=ckpt)
+        try:
+            quarantined = [p for p in os.listdir(tmp_path)
+                           if p.startswith("agg.corrupt-")]
+            assert quarantined, "poisoned checkpoint dir was not moved"
+            # the fresh incarnation checkpoints into a CLEAN dir
+            assert agg2.ingest_frame(frames[(0, 1)][0]).accepted == 1
+            agg2.flush()
+            assert m.registry.get_sample_value(
+                "ebpf_agent_federation_checkpoints_total",
+                {"result": "ok"}) == 1
+        finally:
+            agg2.close()
+        # and the NEXT restart restores it (durability recovered)
+        agg3 = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                    sink=lambda obj: None,
+                                    checkpoint_dir=ckpt)
+        try:
+            assert agg3.ingest_frame(frames[(0, 1)][0]).duplicate, \
+                "restored ledger should dedup the checkpointed window"
+        finally:
+            agg3.close()
+
+    def test_wedged_checkpoint_never_stalls_the_plane(self, tmp_path):
+        """A failing checkpoint disk loses durability, never the window:
+        the roll still publishes, the error is counted, and the next
+        healthy roll checkpoints again."""
+        m = Metrics()
+        reports: list[dict] = []
+        agg = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                   metrics=m, sink=reports.append,
+                                   checkpoint_dir=str(tmp_path / "agg"))
+        try:
+            frames = build_streams(n_agents=1, n_windows=2, seed=32)
+            faultinject.arm("federation.checkpoint", "crash", times=1)
+            assert agg.ingest_frame(frames[(0, 0)][0]).accepted == 1
+            agg.flush()
+            assert len(reports) == 1, "wedged checkpoint lost the publish"
+            get = m.registry.get_sample_value
+            assert get("ebpf_agent_federation_checkpoints_total",
+                       {"result": "error"}) == 1
+            # disarmed: the next window checkpoints fine
+            assert agg.ingest_frame(frames[(0, 1)][0]).accepted == 1
+            agg.flush()
+            assert len(reports) == 2
+            assert get("ebpf_agent_federation_checkpoints_total",
+                       {"result": "ok"}) == 1
+        finally:
+            faultinject.clear()
+            agg.close()
+
+
+# --- transport chaos over real gRPC --------------------------------------
+
+class TestTransportChaos:
+    def _wire(self, metrics=None, **sink_kw):
+        from netobserv_tpu.exporter.federation import FederationDeltaSink
+        from netobserv_tpu.grpc.federation import start_federation_collector
+        agg = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                   metrics=metrics, sink=lambda obj: None)
+        server, port, _ = start_federation_collector(
+            port=0, handler=agg.ingest_frame)
+        sink = FederationDeltaSink("127.0.0.1", port, metrics=metrics,
+                                   **sink_kw)
+        return agg, server, sink
+
+    def test_ambiguous_deadline_applies_exactly_once(self):
+        """THE scenario the idempotency key exists for: the aggregator
+        applies a push after the client's deadline already fired; the
+        sink's retry redelivers the same bytes; the ledger dedups — one
+        application, not two, and the retry still reports success."""
+        m = Metrics()
+        agg, server, sink = self._wire(metrics=m, retries=3,
+                                       backoff_initial_s=0.05,
+                                       timeout_s=0.3)
+        try:
+            frames = build_streams(n_agents=1, n_windows=1, seed=41)
+            faultinject.arm("federation.delta_ingest", "delay", arg=1.0,
+                            times=1)
+            assert sink(frames[(0, 0)][0]) is True
+            # the delayed first request is still in flight: let it finish
+            # merging (and get deduplicated) before asserting
+            deadline = time.monotonic() + 5.0
+            get = m.registry.get_sample_value
+            while time.monotonic() < deadline:
+                if (get("ebpf_agent_federation_deltas_total",
+                        {"result": "ok"}) or 0) \
+                        + (get("ebpf_agent_federation_deltas_total",
+                               {"result": "duplicate"}) or 0) >= 2:
+                    break
+                time.sleep(0.02)
+            assert get("ebpf_agent_federation_deltas_total",
+                       {"result": "ok"}) == 1
+            assert get("ebpf_agent_federation_deltas_total",
+                       {"result": "duplicate"}) == 1
+            expected = union_of([frames[(0, 0)][1]])
+            assert_states_bit_exact(agg._state, expected)
+        finally:
+            faultinject.clear()
+            server.stop(grace=None)
+            sink.close()
+            agg.close()
+
+    def test_corrupted_frame_rejected_not_fatal(self):
+        """The corrupt action on federation.delta_ingest mangles the wire
+        bytes INSIDE the aggregator's ingest boundary: decode rejects,
+        the ack says no, the server keeps serving."""
+        m = Metrics()
+        agg, server, sink = self._wire(metrics=m, retries=1)
+        try:
+            frames = build_streams(n_agents=1, n_windows=2, seed=42)
+            faultinject.arm("federation.delta_ingest", "corrupt", times=1)
+            assert sink(frames[(0, 0)][0]) is False   # rejected, counted
+            assert sink(frames[(0, 1)][0]) is True    # plane survives
+            get = m.registry.get_sample_value
+            assert get("ebpf_agent_federation_deltas_total",
+                       {"result": "decode_error"}) == 1
+            assert get("ebpf_agent_federation_deltas_sent_total",
+                       {"result": "rejected"}) == 1
+        finally:
+            faultinject.clear()
+            server.stop(grace=None)
+            sink.close()
+            agg.close()
+
+    def test_cold_start_sink_recovers_after_server_appears(self):
+        """A sink whose first pushes hit nothing (aggregator not up yet)
+        must deliver once the server exists — the reconnect between
+        attempts uses a LOCAL subchannel pool, so it cannot inherit the
+        dead target's TRANSIENT_FAILURE backoff (the bug this pins)."""
+        import socket
+        from netobserv_tpu.exporter.federation import FederationDeltaSink
+        from netobserv_tpu.grpc.federation import start_federation_collector
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        sink = FederationDeltaSink("127.0.0.1", port, retries=2,
+                                   backoff_initial_s=0.01, timeout_s=2.0)
+        frames = build_streams(n_agents=1, n_windows=2, seed=43)
+        assert sink(frames[(0, 0)][0]) is False       # nothing listening
+        agg = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                   sink=lambda obj: None)
+        server, bound, _ = start_federation_collector(
+            port=port, handler=agg.ingest_frame)
+        try:
+            assert bound == port
+            assert sink(frames[(0, 1)][0]) is True, \
+                "sink never recovered from the cold start"
+        finally:
+            server.stop(grace=None)
+            sink.close()
+            agg.close()
+
+
+# --- sink classification + per-window ladder reset ------------------------
+
+class TestSinkClassification:
+    class _FakeClient:
+        """Scripted FederationClient: pops one behavior per send()."""
+
+        def __init__(self, script):
+            self.script = list(script)
+            self.sends = 0
+
+        def send(self, frame, timeout_s=0):
+            self.sends += 1
+            step = self.script.pop(0)
+            if isinstance(step, Exception):
+                raise step
+            return step
+
+        def connect(self):
+            pass
+
+        def close(self):
+            pass
+
+    @staticmethod
+    def _rpc_error(code):
+        import grpc
+
+        class _Err(grpc.RpcError):
+            def code(self):
+                return code
+        return _Err(code.name)
+
+    def _sink(self, script, **kw):
+        from netobserv_tpu.exporter.federation import FederationDeltaSink
+        m = Metrics()
+        sink = FederationDeltaSink("unused", 0, metrics=m,
+                                   client=self._FakeClient(script),
+                                   sleep=lambda s: None, **kw)
+        return sink, m
+
+    def test_terminal_code_fails_fast(self):
+        import grpc
+        sink, m = self._sink(
+            [self._rpc_error(grpc.StatusCode.INVALID_ARGUMENT)], retries=3)
+        assert sink(b"frame") is False
+        assert sink._client.sends == 1, "terminal code burned the ladder"
+        assert m.registry.get_sample_value(
+            "ebpf_agent_federation_deltas_sent_total",
+            {"result": "terminal"}) == 1
+
+    def test_retry_safe_code_walks_ladder_then_succeeds(self):
+        import grpc
+        from netobserv_tpu.pb import sketch_delta_pb2 as pb
+        sink, m = self._sink(
+            [self._rpc_error(grpc.StatusCode.UNAVAILABLE),
+             self._rpc_error(grpc.StatusCode.DEADLINE_EXCEEDED),
+             pb.DeltaAck(accepted=1)], retries=3)
+        assert sink(b"frame") is True
+        assert sink._client.sends == 3
+        assert m.registry.get_sample_value(
+            "ebpf_agent_federation_deltas_sent_total",
+            {"result": "ok"}) == 1
+
+    def test_duplicate_ack_counts_as_duplicate(self):
+        from netobserv_tpu.pb import sketch_delta_pb2 as pb
+        sink, m = self._sink([pb.DeltaAck(accepted=1, duplicate=1)])
+        assert sink(b"frame") is True
+        assert m.registry.get_sample_value(
+            "ebpf_agent_federation_deltas_sent_total",
+            {"result": "duplicate"}) == 1
+
+    def test_stale_ack_not_counted_as_benign_duplicate(self):
+        """A stale-window discard acks duplicate=1 on the wire (so the
+        sink stops resending) but its data was NOT merged — the sink must
+        count it `stale`, not bury a real per-window loss under the
+        benign `duplicate` outcome (the epoch step-back failure mode)."""
+        from netobserv_tpu.federation.delta import ACK_REASON_STALE
+        from netobserv_tpu.pb import sketch_delta_pb2 as pb
+        sink, m = self._sink([pb.DeltaAck(accepted=1, duplicate=1,
+                                          reason=ACK_REASON_STALE)])
+        assert sink(b"frame") is True, "stale acks must stop the ladder"
+        assert m.registry.get_sample_value(
+            "ebpf_agent_federation_deltas_sent_total",
+            {"result": "stale"}) == 1
+        assert m.registry.get_sample_value(
+            "ebpf_agent_federation_deltas_sent_total",
+            {"result": "duplicate"}) is None
+
+    def test_backoff_resets_between_windows(self):
+        """An exhausted ladder in window N must not escalate window N+1's
+        first backoff — the ladder is per-window state (the satellite
+        fix; previously implicit, now pinned)."""
+        import grpc
+        err = lambda: self._rpc_error(grpc.StatusCode.UNAVAILABLE)  # noqa
+        sink, _ = self._sink([err(), err(), err(),      # window N: exhaust
+                              err(), err(), err()],     # window N+1
+                             retries=3, backoff_initial_s=0.2,
+                             backoff_max_s=10.0)
+        assert sink(b"w0") is False
+        first = list(sink.last_ladder)
+        assert sink(b"w1") is False
+        assert sink.last_ladder == first, \
+            f"ladder escalated across windows: {first} -> {sink.last_ladder}"
+        assert sink.last_ladder[0] == pytest.approx(0.2)
+        assert sink.last_ladder == sorted(sink.last_ladder), \
+            "ladder must still escalate WITHIN a window"
+
+
+# --- agent lifecycle / label cardinality ----------------------------------
+
+class TestAgentLifecycle:
+    def test_ttl_eviction_deletes_gauge_series(self):
+        """The cardinality regression pin: a departed agent's staleness
+        series is DELETED at eviction (not pinned forever), the eviction
+        is counted, and the agent re-registers cleanly on return."""
+        m = Metrics()
+        agg = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                   metrics=m, sink=lambda obj: None,
+                                   agent_ttl_s=30.0)
+        try:
+            frames = build_streams(n_agents=2, n_windows=2, seed=51)
+            assert agg.ingest_frame(frames[(0, 0)][0]).accepted == 1
+            assert agg.ingest_frame(frames[(1, 0)][0]).accepted == 1
+            agg._update_staleness()
+            get = m.registry.get_sample_value
+            assert get("ebpf_agent_federation_agent_staleness_seconds",
+                       {"agent": "agent-0"}) is not None
+            # age agent-0 past the TTL without sleeping
+            with agg._lock:
+                agg._agents["agent-0"]["last_mono"] -= 31.0
+            agg._evict_stale_agents()
+            assert get("ebpf_agent_federation_agent_staleness_seconds",
+                       {"agent": "agent-0"}) is None, \
+                "evicted agent still pins a gauge series"
+            assert get("ebpf_agent_federation_agent_staleness_seconds",
+                       {"agent": "agent-1"}) is not None
+            assert get(
+                "ebpf_agent_federation_agent_evictions_total") == 1
+            assert "agent-0" not in agg.status()["agents"]
+            # the return: merges cleanly (ledger entry was dropped with
+            # the agent, so even its next seq is admitted fresh)
+            ack = agg.ingest_frame(frames[(0, 1)][0])
+            assert ack.accepted == 1 and not ack.duplicate
+            assert "agent-0" in agg.status()["agents"]
+        finally:
+            agg.close()
+
+    def test_epoch_regression_self_heals_via_ttl(self):
+        """A wall-clock step-back across an agent restart can hand out an
+        epoch BELOW the ledger's: every frame then reads stale. The
+        self-healing path: stale frames do NOT refresh liveness, so the
+        TTL eviction forgets the poisoned ledger entry and the agent
+        re-registers — silence bounded by one TTL, not forever."""
+        agg = FederationAggregator(sketch_cfg=CFG, window_s=3600,
+                                   sink=lambda obj: None, agent_ttl_s=30.0)
+        try:
+            cur = build_streams(n_agents=1, n_windows=1, seed=52,
+                                epoch=EPOCH0 + 5)
+            old = build_streams(n_agents=1, n_windows=2, seed=53,
+                                epoch=EPOCH0)
+            assert agg.ingest_frame(cur[(0, 0)][0]).accepted == 1
+            # regressed-epoch frames: acked-and-discarded
+            assert agg.ingest_frame(old[(0, 0)][0]).duplicate
+            # age the agent past the TTL; a further STALE frame must not
+            # refresh its liveness (that would block eviction forever)
+            with agg._lock:
+                agg._agents["agent-0"]["last_mono"] -= 31.0
+            assert agg.ingest_frame(old[(0, 1)][0]).duplicate
+            agg._evict_stale_agents()
+            assert "agent-0" not in agg.status()["agents"]
+            # the regressed agent re-registers cleanly post-eviction
+            ack = agg.ingest_frame(old[(0, 1)][0])
+            assert ack.accepted == 1 and not ack.duplicate
+        finally:
+            agg.close()
+
+    def test_remove_labeled_is_idempotent(self):
+        m = Metrics()
+        m.federation_agent_staleness_seconds.labels("ghost").set(1.0)
+        m.remove_labeled(m.federation_agent_staleness_seconds, "ghost")
+        m.remove_labeled(m.federation_agent_staleness_seconds, "ghost")
+        m.remove_labeled(m.federation_agent_staleness_seconds, "never-was")
+
+
+# --- zero-cost + smoke failure path ---------------------------------------
+
+def test_federation_fault_points_zero_cost_when_unset():
+    """The faultinject invariant applied to the two new points: disarmed
+    fire() is one load + one branch (~50x slack bound, same as
+    tests/test_supervision.py)."""
+    assert not faultinject.armed("federation.delta_ingest")
+    assert not faultinject.armed("federation.checkpoint")
+    payload = b"frame"
+    assert faultinject.fire("federation.delta_ingest", payload) is payload
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        faultinject.fire("federation.delta_ingest", payload)
+        faultinject.fire("federation.checkpoint")
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"disarmed federation fault points cost {dt:.3f}s/100k"
+
+
+def test_smoke_failure_path_cold_start_and_restart(tmp_path):
+    """scripts/smoke_federation.py --failure-path, in-process: aggregator
+    started AFTER the agents (cold-start catch-up), restarted once
+    mid-run restoring its checkpoint, query surface never serves a torn
+    snapshot (the satellite coverage for the smoke's rainy day)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    from smoke_federation import run_failure_path
+    out = run_failure_path(checkpoint_dir=str(tmp_path / "fed"))
+    assert out["ok"], out["notes"]
+    assert out["torn_responses"] == 0
+    assert out["agents"] == ["chaos-agent-0", "chaos-agent-1"]
+    assert out["poll_responses"] > 0

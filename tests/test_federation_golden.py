@@ -20,7 +20,12 @@ import numpy as np
 from netobserv_tpu.federation import delta as fdelta
 
 GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
-                      "sketch_delta_v1.hex")
+                      "sketch_delta_v2.hex")
+#: the v1-era frame (PR 6 agents, no delivery header) stays checked in:
+#: wire COMPAT is part of the contract — a v2 aggregator must keep
+#: decoding and merging v1 frames (counted `legacy`) during a rollout
+GOLDEN_V1 = os.path.join(os.path.dirname(__file__), "golden",
+                         "sketch_delta_v1.hex")
 
 #: tiny-but-representative shapes per tensor (the codec itself is
 #: shape-agnostic; the aggregator's validate_shapes enforces geometry)
@@ -51,9 +56,13 @@ def golden_tables() -> dict:
 
 
 def encode_golden() -> bytes:
+    # every v2 header field pinned explicitly — an auto-drawn uuid would
+    # make the frame non-deterministic and unpinnable
     return fdelta.encode_frame(
         golden_tables(), agent_id="golden-agent", window=42,
-        ts_ms=1_700_000_000_123, dims=DIMS, codec=fdelta.CODEC_RAW)
+        ts_ms=1_700_000_000_123, dims=DIMS, codec=fdelta.CODEC_RAW,
+        window_seq=42, frame_uuid="cafe0042feedbeef",
+        agent_epoch=1_700_000_000_000_000_000)
 
 
 def test_frame_matches_golden_bytes():
@@ -77,6 +86,9 @@ def test_golden_bytes_decode_roundtrip():
     assert frame.window == 42
     assert frame.ts_ms == 1_700_000_000_123
     assert frame.dims == DIMS
+    assert frame.window_seq == 42
+    assert frame.frame_uuid == "cafe0042feedbeef"
+    assert frame.agent_epoch == 1_700_000_000_000_000_000
     want = golden_tables()
     for name, _ in fdelta.TABLE_SPEC:
         np.testing.assert_array_equal(frame.tables[name], want[name],
@@ -84,6 +96,24 @@ def test_golden_bytes_decode_roundtrip():
         # decoded arrays must be native little-endian VIEWS regardless of
         # host order (the frombuffer dtype is explicit)
         assert frame.tables[name].dtype.str.startswith("<"), name
+
+
+def test_v1_golden_still_decodes_as_legacy():
+    """Wire compat: the PR 6 (v1) golden frame must keep decoding on a v2
+    build — an empty delivery header (proto3 defaults), version 1, same
+    tables byte-for-byte. The aggregator merges such frames as `legacy`."""
+    golden = bytes.fromhex(open(GOLDEN_V1).read().strip())
+    frame = fdelta.decode_frame(golden)
+    assert frame.version == 1
+    assert frame.window_seq == 0
+    assert frame.frame_uuid == ""
+    assert frame.agent_epoch == 0
+    assert frame.agent_id == "golden-agent"
+    assert frame.dims == DIMS
+    want = golden_tables()
+    for name, _ in fdelta.TABLE_SPEC:
+        np.testing.assert_array_equal(frame.tables[name], want[name],
+                                      err_msg=name)
 
 
 def test_zlib_codec_roundtrip_host_local():
@@ -101,8 +131,12 @@ def test_table_spec_fingerprint_pinned():
     """The spec fingerprint the CHECKPOINT format also stamps: a TABLE_SPEC
     edit must bump DELTA_FORMAT_VERSION + CHECKPOINT_FORMAT_VERSION and
     regenerate the golden — this pin makes a silent layout drift loud."""
+    # the TABLE layout did not change in v2 (only the frame header gained
+    # the delivery fields), so the fingerprint — and with it checkpoint
+    # compatibility — is unchanged from v1
     assert fdelta.table_spec_fingerprint() == 1393615489
-    assert fdelta.DELTA_FORMAT_VERSION == 1
+    assert fdelta.DELTA_FORMAT_VERSION == 2
+    assert fdelta.SUPPORTED_VERSIONS == (1, 2)
 
 
 def test_scalar_fields_order_pinned():
