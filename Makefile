@@ -45,6 +45,14 @@ bench-device:
 bench-evict:
 	JAX_PLATFORMS=cpu $(PY) bench.py --evict-only
 
+# overload control plane (~15s): overdriven synthetic feed against a
+# fault-slowed fold — sustained admitted rate, AIMD shed-factor
+# trajectory, heavy-hitter recall under shed vs unshed — the per-PR CI
+# artifact for the shedding seam (docs/architecture.md
+# "Overload & backpressure")
+bench-overload:
+	JAX_PLATFORMS=cpu $(PY) bench.py --overload-only
+
 gen-protobuf:
 	protoc --python_out=netobserv_tpu/pb -I proto proto/flow.proto proto/packet.proto
 

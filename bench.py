@@ -785,6 +785,117 @@ def roll_stall_stats(run_s: float = 3.2, sink_block_s: float = 0.5) -> dict:
     }
 
 
+def overload_stats(seconds: float = 4.0, fold_delay_s: float = 0.01,
+                   batch: int = 256) -> dict:
+    """`--overload-only` / `make bench-overload`: the overload control
+    plane (sketch/overload.py) under an overdriven synthetic feed against
+    a fault-slowed fold — every device dispatch eats an injected
+    `fold_delay_s` while evictions arrive 4 batches at a time, so the
+    AIMD controller must shed. Reports the sustained feed rate the seam
+    absorbed, the shed-factor trajectory (sampled each arrival), and
+    heavy-hitter recall of the exact top keys under shed vs an unshed
+    run of the SAME traffic — the offline evidence for the unbiasedness
+    bar tests/test_overload.py pins."""
+    from netobserv_tpu.datapath.fetcher import EvictedFlows
+    from netobserv_tpu.datapath.replay import SyntheticFetcher
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.model.columnar import pack_key_words
+    from netobserv_tpu.sketch.state import SketchConfig
+    from netobserv_tpu.utils import faultinject
+
+    cfg = SketchConfig(cm_depth=2, cm_width=1 << 12, topk=64,
+                       hll_precision=8, perdst_buckets=64,
+                       perdst_precision=4, persrc_buckets=64,
+                       persrc_precision=4, hist_buckets=64, ewma_buckets=64)
+    # zipf draws aggregate per eviction (duplicate keys merge), so the
+    # draw count is sized well past 4x so each eviction lands ~4 batches
+    # of UNIQUE rows — the controller's pressure score sees >= 4
+    fetcher = SyntheticFetcher(flows_per_eviction=32 * batch,
+                               n_distinct=4000, zipf_a=1.3, seed=11)
+    evs = [fetcher.lookup_and_delete() for _ in range(24)]
+    exact: dict[bytes, float] = {}
+    keyrow: dict[bytes, np.ndarray] = {}
+    for ev in evs:
+        for row in ev.events:
+            kb = row["key"].tobytes()
+            exact[kb] = exact.get(kb, 0.0) + float(row["stats"]["bytes"])
+            keyrow[kb] = row["key"]
+    top16 = {tuple(pack_key_words(keyrow[kb].reshape(1))[0])
+             for kb in sorted(exact, key=exact.get, reverse=True)[:16]}
+
+    def run(shed: bool, slow: bool) -> dict:
+        import jax
+
+        from netobserv_tpu.sketch.state import state_tables
+        exp = TpuSketchExporter(
+            batch_size=batch, window_s=3600.0, sketch_cfg=cfg,
+            sink=lambda obj: None,
+            shed_watermark=2.0 if shed else 0.0, shed_max=64)
+        try:
+            # warm past the jit compile BEFORE arming the fault or the
+            # timer: each warm arrival is several full batches, so the
+            # fold fn compiles here, not inside a timed segment
+            for w in range(2):
+                exp.export_evicted(EvictedFlows(evs[w].events.copy()))
+            if slow:
+                faultinject.arm("sketch.ingest", "delay", fold_delay_s)
+            factors: list[int] = []
+            fed = 0
+            i = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                ev = evs[i % len(evs)]
+                exp.export_evicted(EvictedFlows(ev.events.copy()))
+                fed += len(ev.events)
+                snap = exp.overload_snapshot()
+                factors.append(snap["shed_factor"] if snap else 1)
+                i += 1
+            dt = time.perf_counter() - t0
+            faultinject.clear("sketch.ingest")
+            with exp._lock:
+                exp._drain_pending_locked()
+            state = jax.block_until_ready(exp._state)
+            tables = state_tables(state)
+            hwords = np.asarray(tables["heavy_words"])
+            hvalid = np.asarray(tables["heavy_valid"])
+            heavy = {tuple(w) for w, v in
+                     zip(hwords.reshape(-1, hwords.shape[-1]),
+                         hvalid.reshape(-1)) if v}
+            snap = exp.overload_snapshot() or {}
+            return {"fed_records_per_sec": round(fed / dt),
+                    "recall_at_16": round(
+                        sum(t in heavy for t in top16) / len(top16), 3),
+                    "shed_factor_trajectory": factors,
+                    "shed_factor_max": max(factors, default=1),
+                    "shed_rows": snap.get("shed_rows", 0),
+                    "shed_batches": snap.get("shed_batches", 0)}
+        finally:
+            faultinject.clear("sketch.ingest")
+            exp.close()
+
+    unshed = run(shed=False, slow=False)
+    shed = run(shed=True, slow=True)
+    traj = shed.pop("shed_factor_trajectory")
+    # decimate the per-arrival trajectory to ~40 samples for the artifact
+    step = max(1, len(traj) // 40)
+    out = {"metric": "overload_fed_records_per_sec",
+           "value": shed["fed_records_per_sec"], "unit": "records/s",
+           "overload_fold_delay_ms": round(fold_delay_s * 1e3, 1),
+           "overload_shed": shed,
+           "overload_shed_factor_trajectory": traj[::step],
+           "overload_unshed": {k: unshed[k] for k in
+                               ("fed_records_per_sec", "recall_at_16")},
+           "overload_recall_delta": round(
+               shed["recall_at_16"] - unshed["recall_at_16"], 3)}
+    print(f"overload: fault-slowed feed sustained "
+          f"{shed['fed_records_per_sec'] / 1e3:.0f}K rec/s at shed "
+          f"factor <= {shed['shed_factor_max']} "
+          f"({shed['shed_rows']} rows shed); top-16 recall "
+          f"{shed['recall_at_16']} shed vs {unshed['recall_at_16']} "
+          "unshed", file=sys.stderr)
+    return out
+
+
 def _device_watchdog(timeout_s: float | None = None,
                      attempts: int | None = None) -> str:
     """Probe backend initialization in a SUBPROCESS with claim retries; fall
@@ -929,6 +1040,17 @@ def main():
         # columnar vs the per-key idiom + per-stage split; the non-gating
         # CI artifact next to bench-host/bench-device
         out = evict_stats()
+        out["device_provenance"] = device_provenance(cpu_requested)
+        print(json.dumps(out))
+        return
+    if "--overload-only" in sys.argv:
+        # `make bench-overload` (~15s): the overload control plane under an
+        # overdriven feed against a fault-slowed fold — shed-factor
+        # trajectory + heavy-hitter recall under shed; the non-gating CI
+        # artifact next to bench-host/bench-device/bench-evict
+        out = overload_stats()
+        if _DEVICE_NOTE:
+            out["device"] = _DEVICE_NOTE
         out["device_provenance"] = device_provenance(cpu_requested)
         print(json.dumps(out))
         return

@@ -89,6 +89,17 @@ class FlowsAgent:
             else:
                 from netobserv_tpu.flow.ssl_correlator import SSLCorrelator
                 self.ssl_correlator = SSLCorrelator()
+        # map capacity for the occupancy histogram + pressure relief:
+        # bpfman-mode fetchers report the REAL kernel map capacity (an
+        # external manager sized it); self-managed datapaths sized theirs
+        # from CACHE_MAX_FLOWS, so that is the honest denominator when no
+        # probe answers. Probed UNCONDITIONALLY: map_occupancy_ratio is the
+        # evidence operators read to decide whether to set
+        # MAP_PRESSURE_WATERMARK, so it must populate before the knob is on
+        probe = getattr(fetcher, "map_capacity", None)
+        map_capacity = probe() if probe is not None else 0
+        if not map_capacity:
+            map_capacity = cfg.cache_max_flows
         self.map_tracer = MapTracer(
             fetcher, self._evicted_q,
             active_timeout_s=cfg.cache_active_timeout, agent_ip=agent_ip,
@@ -99,7 +110,9 @@ class FlowsAgent:
             columnar=columnar,
             udn_mapper=udn_mapper,
             force_gc=cfg.force_garbage_collection,
-            ssl_correlator=self.ssl_correlator)
+            ssl_correlator=self.ssl_correlator,
+            map_capacity=map_capacity,
+            pressure_watermark=cfg.map_pressure_watermark)
         self.limiter = CapacityLimiter(
             self._evicted_q, self._export_q, metrics=self.metrics)
         self.terminal = QueueExporter(
@@ -204,10 +217,19 @@ class FlowsAgent:
 
     def health_snapshot(self) -> dict:
         """Machine-readable agent health for /healthz + /readyz
-        (metrics/server.py)."""
+        (metrics/server.py). `conditions` carries supervisor-registered
+        stage conditions (e.g. the overload controller's OVERLOADED);
+        `overloaded` hoists that one to the top level — it is DISTINCT
+        from `degraded`: an overloaded agent is healthy and serving,
+        deliberately trading resolution for stability, so it stays
+        ready (pulling it from rotation would just shift the load)."""
+        conditions = self.supervisor.conditions()
         return {
             "status": self.status.value,
             "degraded": self.supervisor.degraded,
+            "overloaded": bool(
+                conditions.get("overloaded", {}).get("active")),
+            "conditions": conditions,
             "stages": self.supervisor.snapshot(),
         }
 

@@ -69,6 +69,13 @@ class Supervisor:
         self._period = check_period_s
         self._on_degraded = on_degraded
         self._stages: dict[str, _Stage] = {}
+        #: named health CONDITIONS, probed at snapshot time — states a
+        #: stage reports about itself that are not crash/hang/degraded
+        #: (e.g. the exporter's OVERLOADED while the overload controller
+        #: sheds load). A probe returns a dict with at least
+        #: {"active": bool}; /healthz + /readyz surface them distinct
+        #: from DEGRADED.
+        self._conditions: dict[str, Callable[[], dict]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -138,6 +145,38 @@ class Supervisor:
             for st in self._stages.values():
                 if st.state != StageState.DEGRADED:
                     st.state = StageState.STOPPED
+
+    def register_condition(self, name: str,
+                           probe: Callable[[], dict]) -> None:
+        """Register a named health condition (see `_conditions`). The
+        latest registration under a name wins (a restarted stage
+        re-registers its condition)."""
+        with self._lock:
+            self._conditions[name] = probe
+
+    def conditions(self) -> dict:
+        """Evaluate every registered condition probe. A raising probe
+        reports {"active": False, "error": ...} — the health surface must
+        answer even when a stage's introspection is broken."""
+        with self._lock:
+            probes = dict(self._conditions)
+        out = {}
+        for name, probe in probes.items():
+            try:
+                out[name] = probe()
+            except Exception as exc:
+                out[name] = {"active": False, "error": str(exc)}
+        return out
+
+    def condition_active(self, name: str) -> bool:
+        with self._lock:
+            probe = self._conditions.get(name)
+        if probe is None:
+            return False
+        try:
+            return bool(probe().get("active"))
+        except Exception:
+            return False
 
     # --- introspection (health surface) ---
     @property

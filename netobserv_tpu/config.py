@@ -361,6 +361,33 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_superbatch: str = field(default="1,2,4",
                                    **_env("SKETCH_SUPERBATCH", "1,2,4"))
 
+    # --- overload control plane (sketch/overload.py; new) ---
+    #: high watermark (in BATCHES: pending-fold depth weighted by the
+    #: seam's fold-duty fraction, plus slot-wait pressure —
+    #: docs/architecture.md "Overload & backpressure") above which the
+    #: exporter sheds load by unbiased 1-in-N row sampling.
+    #: 0 (default) disables shedding entirely: the export path is
+    #: bit-identical to the unshedded agent (no RNG, no controller).
+    sketch_shed_watermark: float = field(
+        default=0.0, **_env("SKETCH_SHED_WATERMARK", "0"))
+    #: ceiling on the AIMD shed factor N (at most 1-in-N rows admitted
+    #: under sustained overload; the factor multiplies into each surviving
+    #: row's `sampling` field so estimates stay unbiased)
+    sketch_shed_max: int = field(default=64, **_env("SKETCH_SHED_MAX", "64"))
+    #: bound on how long ONE fold may wait for a staging-ring slot when
+    #: shedding is enabled — a wedged device then drops batches (counted)
+    #: instead of wedging the eviction feed. Generous by default: the
+    #: first on-chip compile legitimately stalls for minutes on cold
+    #: caches, and the ladder warm runs in the background.
+    sketch_shed_slot_budget: float = field(
+        default=30.0, **_env("SKETCH_SHED_SLOT_BUDGET", "30s"))
+    #: kernel aggregation-map occupancy fraction (of CACHE_MAX_FLOWS) at
+    #: which the map tracer starts early evictions (at most 2x the
+    #: configured cadence) to shrink the ringbuf-fallback window.
+    #: 0 (default) disables pressure relief.
+    map_pressure_watermark: float = field(
+        default=0.0, **_env("MAP_PRESSURE_WATERMARK", "0"))
+
     # --- sketch federation plane (federation/; new) ---
     #: "host:port" of the central aggregator's Federation gRPC endpoint;
     #: set on per-host agents to stream one delta frame per closed window
@@ -476,6 +503,14 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
                 f"SKETCH_REPORT_SINK={self.sketch_report_sink!r} "
                 "(want stdout|kafka)")
         self.parsed_superbatch_ladder()  # raises on a malformed ladder spec
+        if self.sketch_shed_watermark < 0:
+            raise ValueError("SKETCH_SHED_WATERMARK must be >= 0 (0 disables)")
+        if self.sketch_shed_max < 2:
+            raise ValueError("SKETCH_SHED_MAX must be >= 2 (it bounds the "
+                             "1-in-N shed factor)")
+        if not (0.0 <= self.map_pressure_watermark < 1.0):
+            raise ValueError("MAP_PRESSURE_WATERMARK must be in [0, 1) "
+                             "(a fraction of CACHE_MAX_FLOWS; 0 disables)")
         if self.federation_mode not in ("", "aggregator"):
             raise ValueError(
                 f"FEDERATION_MODE={self.federation_mode!r} "
@@ -507,6 +542,7 @@ _DURATION_FIELDS = {
     "supervisor_backoff_max", "supervisor_healthy_reset",
     "supervisor_heartbeat_timeout", "federation_window",
     "federation_stale_after", "federation_agent_ttl",
+    "sketch_shed_slot_budget",
 }
 
 

@@ -261,7 +261,13 @@ def decode_eviction(agg_keys: np.ndarray, agg_vals: np.ndarray,
             s["last_seen_ns"][n_agg:] = last_acc
     evicted = EvictedFlows(events, **features)
     evicted.decode_stats = {"merge_s": t1 - t0,
-                            "align_s": time.perf_counter() - t1}
+                            "align_s": time.perf_counter() - t1,
+                            # appended standalone rows: ringbuf-fallback
+                            # singles (or a racing eviction) whose flow
+                            # missed the aggregation drain — the bounded
+                            # double-count overload path, surfaced per
+                            # drain (evict_ringbuf_fallback_total)
+                            "fallback_rows": n_app}
     return evicted
 
 
@@ -318,6 +324,15 @@ class BpfmanFetcher:
     @classmethod
     def load(cls, cfg: AgentConfig) -> "BpfmanFetcher":
         return cls(cfg.bpfman_bpf_fs_path)
+
+    def map_capacity(self) -> int:
+        """max_entries of the kernel aggregation map — the denominator of
+        the map-pressure watermark. In bpfman mode the external manager
+        sized the map, so the agent reads the REAL capacity instead of
+        trusting its own CACHE_MAX_FLOWS; 0 when unknown."""
+        if self._agg is None:
+            return 0
+        return int(getattr(self._agg, "max_entries", 0) or 0)
 
     def lookup_and_delete(self) -> EvictedFlows:
         # columnar eviction plane: whole-array drain decode -> one batched

@@ -269,7 +269,11 @@ class TpuSketchExporter(Exporter):
                  superbatch: tuple = (1,),
                  warm_ladder: bool = False,
                  delta_sink=None,
-                 agent_id: str = ""):
+                 agent_id: str = "",
+                 shed_watermark: float = 0.0,
+                 shed_max: int = 64,
+                 shed_slot_budget_s: float = 30.0,
+                 shed_seed: int = 2026):
         # superbatch defaults to NO ladder for direct construction: the
         # ladder costs superbatch_max-sized ring buffers, dictionaries and
         # key-table rows up front, and only pays off once warmed — the
@@ -457,6 +461,25 @@ class TpuSketchExporter(Exporter):
         # close always flushes, so nothing waits past the window)
         self._pending_buf = staging.PendingEventBuffer(
             self._batch_size, getattr(self._ring, "superbatch_max", 1))
+        # overload control plane (sketch/overload.py): admission control at
+        # the export_evicted seam. Disabled (the default), _overload is None
+        # and the shed path is one is-None check — bit-identical to the
+        # unshedded exporter (no RNG, no copies). Enabled, the ring's slot
+        # wait is also bounded so a wedged device drops batches (counted)
+        # instead of wedging the eviction feed.
+        from netobserv_tpu.sketch import overload
+        self._overload = overload.maybe_controller(
+            self._batch_size, shed_watermark, shed_max, metrics=metrics,
+            seed=shed_seed)
+        if self._overload is not None:
+            self._ring.slot_wait_budget_s = shed_slot_budget_s
+        # fold-duty tracking for the controller's busy weight (the depth
+        # term of the pressure score only counts when the seam actually
+        # spends its wall clock folding — sketch/overload.py docstring);
+        # touched only when the controller exists
+        self._busy_fold_s = 0.0
+        self._busy_last_t: Optional[float] = None
+        self._busy_ewma = 0.0
         if warm_ladder:
             self.warm_superbatch_ladder()
         # the staging ring packs the next batch while the previous
@@ -586,6 +609,17 @@ class TpuSketchExporter(Exporter):
             + self._window_poll_s,
             **kwargs)
         self.heartbeat = beat
+        # the OVERLOADED condition rides the supervisor's condition
+        # registry so /healthz + /readyz surface it next to (and distinct
+        # from) DEGRADED — shedding is deliberate graceful degradation,
+        # not a dead stage
+        # getattr: timer-only harnesses (tests) build the exporter via
+        # __new__ and register just the window timer
+        ctl = getattr(self, "_overload", None)
+        if ctl is not None and hasattr(supervisor, "register_condition"):
+            supervisor.register_condition(
+                "overloaded",
+                lambda: {"active": ctl.overloaded, **ctl.snapshot()})
 
     @classmethod
     def from_config(cls, cfg, metrics=None, sink=None):
@@ -616,9 +650,22 @@ class TpuSketchExporter(Exporter):
                    feed=cfg.sketch_feed,
                    resident_slots=cfg.sketch_resident_slots,
                    superbatch=cfg.parsed_superbatch_ladder(),
+                   shed_watermark=cfg.sketch_shed_watermark,
+                   shed_max=cfg.sketch_shed_max,
+                   shed_slot_budget_s=cfg.sketch_shed_slot_budget,
                    warm_ladder=True,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
+
+    @property
+    def overloaded(self) -> bool:
+        """True while the overload controller is shedding load (the
+        /healthz OVERLOADED condition; always False when disabled)."""
+        return self._overload is not None and self._overload.overloaded
+
+    def overload_snapshot(self) -> Optional[dict]:
+        """Controller state for the health surface (None when disabled)."""
+        return None if self._overload is None else self._overload.snapshot()
 
     # --- Exporter interface ---
     def export_batch(self, records: list[Record]) -> None:
@@ -635,9 +682,31 @@ class TpuSketchExporter(Exporter):
         """Columnar fast path: fold raw evictions without building Records.
         Full batches fold as the rolling buffer fills (zero concatenation);
         a due window only dispatches the roll here — rendering and sink I/O
-        happen on the timer thread, so this never waits on a sink."""
+        happen on the timer thread, so this never waits on a sink.
+
+        Admission control (overload controller, when enabled): the
+        pending-fold depth at arrival plus the ring's slot-wait p95 drive
+        the AIMD shed factor, and the batch is thinned BEFORE buffering —
+        surviving rows carry the factor in their `sampling` field, so the
+        device de-bias keeps every estimate unbiased."""
         trace = getattr(evicted, "trace", None)
         with self._lock:
+            ctl = self._overload
+            if ctl is not None:
+                # busy = fold seconds per wall second since the previous
+                # arrival (EWMA): a healthy device that folds instantly
+                # zeroes the depth term no matter how large arrivals are
+                now = time.perf_counter()
+                last, self._busy_last_t = self._busy_last_t, now
+                if last is not None:
+                    inst = min(1.0, self._busy_fold_s
+                               / max(now - last, 1e-6))
+                    self._busy_ewma = 0.5 * self._busy_ewma + 0.5 * inst
+                self._busy_fold_s = 0.0
+                ctl.update(self._pending_buf.n + len(evicted),
+                           self._ring.slot_wait_p95(),
+                           busy=self._busy_ewma)
+                evicted = ctl.admit(evicted)
             if trace is not None:
                 if self._pending_trace is None:
                     self._pending_trace = trace  # the next fold finishes it
@@ -662,6 +731,23 @@ class TpuSketchExporter(Exporter):
                 faultinject.fire("sketch.ingest")
                 self._state = self._ring.fold(self._state, events,
                                               trace=trace, **feats)
+        except staging.StagingWedged as exc:
+            # the slot-wait budget tripped at a chunk boundary: the rows
+            # not yet packed drop (no dictionary slot was committed for
+            # them, so no epoch roll) — a wedged device costs at most one
+            # batch per fold while the eviction feed keeps its cadence.
+            # ADOPT the exception's state: earlier chunks of this fold may
+            # have dispatched, and their ingests DONATED the state we
+            # passed in — keeping self._state would keep deleted buffers
+            # (exc.state is self._state when nothing dispatched)
+            if exc.state is not None:
+                self._state = exc.state
+            log.error("staging slot-wait budget exceeded "
+                      "(up to %d rows dropped): %s", n, exc)
+            if self._metrics is not None:
+                self._metrics.sketch_ingest_errors_total.inc()
+                self._metrics.count_error("tpu-sketch-ingest")
+            return
         except Exception as exc:
             # graceful degradation: a device error loses THIS batch (counted)
             # instead of poisoning the exporter thread / window timer
@@ -669,6 +755,8 @@ class TpuSketchExporter(Exporter):
             return
         finally:
             trace.finish()
+            if self._overload is not None:
+                self._busy_fold_s += time.perf_counter() - t0
         if self._metrics is not None:
             self._metrics.sketch_batches_total.inc()
             self._metrics.sketch_records_total.inc(n)
@@ -866,6 +954,10 @@ class TpuSketchExporter(Exporter):
         window-timer thread, so `export_batch`/`export_evicted` callers
         blocked on this lock never wait behind a sink."""
         self._window_deadline = time.monotonic() + self._window_s
+        if self._overload is not None:
+            # bounded recovery: a pressure-free window snaps the shed
+            # factor back to 1 even if the feed went idle (no updates)
+            self._overload.window_roll()
         with wtrace.stage("roll_dispatch"):
             if self._delta_sink is not None:
                 self._state, report, tables = self._roll(self._state)
@@ -888,7 +980,10 @@ class TpuSketchExporter(Exporter):
             log.error("window report queue full (sink stalled?); "
                       "dropping the oldest unpublished report")
             if self._metrics is not None:
-                self._metrics.count_error("tpu-sketch")
+                # dedicated series (not the generic error counter): a
+                # wedged sink shedding whole windows of reports deserves
+                # its own alert line
+                self._metrics.sketch_reports_shed_total.inc()
         # checkpointing stays at roll time: later folds DONATE self._state
         # into the jitted ingest, so a deferred save could read a deleted
         # buffer. orbax copies to host before save() returns; the int()

@@ -31,10 +31,21 @@ class MapTracer:
                  namer: Optional[InterfaceNamer] = None,
                  metrics=None, stale_purge_s: float = 5.0,
                  columnar: bool = False, udn_mapper=None,
-                 force_gc: bool = False, ssl_correlator=None):
+                 force_gc: bool = False, ssl_correlator=None,
+                 map_capacity: int = 0,
+                 pressure_watermark: float = 0.0):
         self._fetcher = fetcher
         self._out = out
         self._timeout = active_timeout_s
+        # map-pressure relief (MAP_PRESSURE_WATERMARK): when a drain finds
+        # the kernel aggregation map at or above watermark * capacity, the
+        # next eviction comes EARLY — at half the configured period, so the
+        # cadence is bounded at 2x — shrinking the window in which a full
+        # map spills into the ringbuf fallback (whose singles can
+        # double-count across interfaces). Both values 0 = disabled.
+        self._map_capacity = map_capacity
+        self._pressure_watermark = pressure_watermark
+        self._pressure_relief = False
         self._agent_ip = agent_ip
         self._namer = namer
         self._clock = MonotonicClock()
@@ -81,8 +92,11 @@ class MapTracer:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            # wait for either the ticker period or an explicit flush
-            self._flush.wait(timeout=self._timeout)
+            # wait for either the ticker period or an explicit flush; under
+            # map pressure the period halves (bounded 2x cadence)
+            self._flush.wait(timeout=(self._timeout / 2
+                                      if self._pressure_relief
+                                      else self._timeout))
             self._flush.clear()
             self.heartbeat()
             if self._stop.is_set():
@@ -93,6 +107,41 @@ class MapTracer:
     def _evict_once(self) -> None:
         with self._evict_lock:
             self._evict_locked()
+
+    def _check_map_pressure(self, drained: int) -> None:
+        """Drive the pressure-relief latch from this drain's occupancy (a
+        drain empties the map, so its size IS the occupancy the drain
+        interval accumulated). At or above the watermark the next eviction
+        comes at half period. A LATCHED relief sustains down to HALF the
+        watermark: halved drains accumulate roughly half the flows, so
+        without hysteresis any watermark > 0.5 would oscillate latched/
+        clear on alternating drains (and re-log every other cycle) instead
+        of holding until load genuinely drops."""
+        if not self._map_capacity:
+            return
+        occupancy = drained / self._map_capacity
+        # the histogram populates whenever capacity is known — it is the
+        # evidence for whether to set the watermark at all; only the
+        # relief latch below is gated on the knob
+        if self._metrics is not None:
+            self._metrics.map_occupancy_ratio.observe(occupancy)
+        if not self._pressure_watermark:
+            return
+        pressured = occupancy >= self._pressure_watermark
+        sustained = (self._pressure_relief
+                     and occupancy >= self._pressure_watermark / 2)
+        relief = pressured or sustained
+        if relief:
+            # stage-boundary chaos seam: per drain, never per record
+            faultinject.fire("map_tracer.pressure_evict")
+            if not self._pressure_relief:
+                log.warning(
+                    "kernel map at %.0f%% of capacity (>= watermark %.0f%%);"
+                    " halving the eviction period until pressure clears",
+                    occupancy * 100, self._pressure_watermark * 100)
+            if self._metrics is not None:
+                self._metrics.map_pressure_evictions_total.inc()
+        self._pressure_relief = relief
 
     def _evict_locked(self) -> None:
         # flight recorder: a batch trace is born here and rides the evicted
@@ -123,10 +172,17 @@ class MapTracer:
             if ds is not None:
                 self._metrics.eviction_decode_seconds.observe(
                     ds.get("seconds", 0.0))
+                # ringbuf-fallback singles (feature rows whose flow missed
+                # the aggregation drain) — the one known double-count
+                # overload path, now observable per drain
+                fallback = ds.get("fallback_rows", 0)
+                if fallback:
+                    self._metrics.evict_ringbuf_fallback_total.inc(fallback)
             self._metrics.buffer_size.labels("evicted").set(
                 self._out.qsize())
             for key, val in self._fetcher.read_global_counters().items():
                 self._metrics.add_global_counter(key, val)
+        self._check_map_pressure(len(evicted))
         if self._force_gc and not self._columnar:
             # FORCE_GARBAGE_COLLECTION parity is for the record path's burst
             # of short-lived objects; the columnar fast path materializes no

@@ -168,6 +168,55 @@ class Metrics:
             "coalesced into one fixed-shape device dispatch; a healthy "
             "overloaded host shows mass at the largest k, an idle one at "
             "k=1)", ["k"], registry=self.registry)
+        # overload control plane (sketch/overload.py + flow/map_tracer.py)
+        self.sketch_shed_factor = Gauge(
+            p + "sketch_shed_factor",
+            "Current 1-in-N load-shedding factor at the exporter seam "
+            "(1 = no shedding). Driven by the AIMD overload controller "
+            "when SKETCH_SHED_WATERMARK is set; surviving rows carry the "
+            "factor in their sampling field so estimates stay unbiased",
+            registry=self.registry)
+        self.sketch_shed_rows_total = Counter(
+            p + "sketch_shed_rows_total",
+            "Rows dropped by overload shedding (unbiased 1-in-N row "
+            "sampling; the surviving rows stand in for these, scaled)",
+            registry=self.registry)
+        self.sketch_shed_batches_total = Counter(
+            p + "sketch_shed_batches_total",
+            "Eviction batches thinned by overload shedding",
+            registry=self.registry)
+        self.sketch_slot_wait_seconds = Histogram(
+            p + "sketch_slot_wait_seconds",
+            "Staging-ring slot wait per fold (time the feed spent blocked "
+            "on the device consuming a previous batch; the overload "
+            "controller's backpressure signal)",
+            buckets=(.0001, .0005, .001, .005, .01, .05, .1, .5, 1, 5),
+            registry=self.registry)
+        self.sketch_reports_shed_total = Counter(
+            p + "sketch_reports_shed_total",
+            "Unpublished window reports shed because the report queue "
+            "overflowed behind a wedged sink (that window's report is "
+            "lost; the sketch state already rolled)",
+            registry=self.registry)
+        self.map_occupancy_ratio = Histogram(
+            p + "map_occupancy_ratio",
+            "Kernel aggregation-map occupancy at each drain, as a "
+            "fraction of the map capacity (the probed max_entries in "
+            "bpfman mode, else CACHE_MAX_FLOWS; mass near 1.0 means the "
+            "map fills between evictions — the ringbuf fallback engages)",
+            buckets=(.1, .25, .5, .75, .9, .95, 1.0),
+            registry=self.registry)
+        self.map_pressure_evictions_total = Counter(
+            p + "map_pressure_evictions_total",
+            "Early (half-period) evictions triggered by the map-occupancy "
+            "watermark (MAP_PRESSURE_WATERMARK)", registry=self.registry)
+        self.evict_ringbuf_fallback_total = Counter(
+            p + "evict_ringbuf_fallback_total",
+            "Feature rows whose flow was missing from the aggregation "
+            "drain and became standalone appended events (ringbuf-fallback "
+            "singles or a racing eviction — the one bounded double-count "
+            "overload path, shared with the reference)",
+            registry=self.registry)
         self.sketch_window_records = Gauge(
             p + "sketch_window_records", "Flow records in the last window",
             registry=self.registry)

@@ -14,8 +14,17 @@ Health surface (supervision layer, agent/supervisor.py): when a
   rotation without killing it).
 
 Both return the same machine-readable JSON body:
-``{"status": ..., "degraded": ..., "stages": {name: {state, restarts,
-consecutive_failures, last_failure, heartbeat_age_s, ...}}}``.
+``{"status": ..., "degraded": ..., "overloaded": ..., "conditions": ...,
+"stages": {name: {state, restarts, consecutive_failures, last_failure,
+heartbeat_age_s, ...}}}``.
+
+``overloaded`` (the overload controller shedding load,
+docs/architecture.md "Overload & backpressure") is deliberately NOT a
+readiness failure: an overloaded agent is alive and serving, trading
+resolution for stability — pulling it out of rotation would shift the
+same load onto its peers and cascade. Orchestrators that want to act on
+it read the JSON body (or the ``sketch_shed_factor`` gauge), which also
+carries the controller's live state under ``conditions.overloaded``.
 """
 
 from __future__ import annotations

@@ -19,6 +19,7 @@ pack+put ceiling (measured on the axon chip, see PARITY.md).
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -26,6 +27,23 @@ import numpy as np
 from netobserv_tpu.datapath import flowpack
 from netobserv_tpu.model import binfmt
 from netobserv_tpu.utils import faultinject, tracing
+
+
+class StagingWedged(RuntimeError):
+    """A fold exceeded the ring's slot-wait budget: the device (or its
+    transfer link) is wedged. Raised only when `slot_wait_budget_s` is set
+    (the overload controller arms it); the exporter catches it like any
+    ingest failure — the unfolded remainder drops, counted, and the
+    eviction feed keeps its cadence instead of inheriting the wedge.
+
+    `state` carries the LAST VALID sketch state at the moment the wait
+    tripped. This is load-bearing: a multi-chunk fold may have already
+    dispatched earlier chunks, and every ingest jit DONATES its input
+    state — the caller's pre-fold reference is a deleted buffer by then.
+    The catcher must adopt `state` (identical to what it passed in when
+    nothing had dispatched yet), or every later fold reads freed memory."""
+
+    state = None
 
 
 def default_spill_cap(batch_size: int) -> int:
@@ -163,12 +181,38 @@ class _SlotRing:
     be a slice of the jitted ingest's input; blocking on the put result is
     not sufficient on zero-copy backends)."""
 
+    #: recent slot-wait samples kept for the p95 the overload controller
+    #: reads (fixed window: one float store per fold, no allocation)
+    WAIT_WINDOW = 64
+
     def _init_slots(self, bufs: list, metrics) -> None:
         self._bufs = bufs
         self._tokens: list = [None] * len(bufs)
         self._slot = 0
         self._metrics = metrics
         self.stalls = 0
+        #: optional bound on one fold's slot wait (seconds); None = wait
+        #: forever (the historical behavior). The tpu-sketch exporter sets
+        #: it when overload shedding is enabled so a wedged device drops
+        #: batches instead of wedging the eviction feed (StagingWedged).
+        self.slot_wait_budget_s: Optional[float] = None
+        self._waits = np.zeros(self.WAIT_WINDOW, np.float64)
+        self._wait_i = 0
+        self._wait_n = 0
+
+    def _record_wait(self, seconds: float) -> None:
+        self._waits[self._wait_i] = seconds
+        self._wait_i = (self._wait_i + 1) % self.WAIT_WINDOW
+        if self._wait_n < self.WAIT_WINDOW:
+            self._wait_n += 1
+
+    def slot_wait_p95(self) -> float:
+        """p95 of the last WAIT_WINDOW folds' slot waits (0.0 until any
+        fold has run) — the device-backpressure half of the overload
+        controller's pressure score."""
+        if not self._wait_n:
+            return 0.0
+        return float(np.percentile(self._waits[:self._wait_n], 95))
 
     def _fold_trace(self, trace):
         """Resolve a fold's trace context: the caller's (batch trace riding
@@ -191,15 +235,38 @@ class _SlotRing:
         faultinject.fire("sketch.staging_wait")
         slot = self._slot
         tok = self._tokens[slot]
+        wait_s = 0.0
         if tok is not None:
             if not tok.is_ready():
                 self.stalls += 1
                 if self._metrics is not None:
                     self._metrics.sketch_staging_stalls_total.inc()
+                t0 = time.perf_counter()
                 with trace.stage("staging_wait"):
-                    jax.block_until_ready(tok)
+                    budget = self.slot_wait_budget_s
+                    if budget is None:
+                        jax.block_until_ready(tok)
+                    else:
+                        # bounded wait: poll readiness up to the budget; a
+                        # still-busy slot past it means the device wedged —
+                        # raise instead of inheriting the wedge (the token
+                        # stays in place; a later fold re-waits on it)
+                        deadline = t0 + budget
+                        while not tok.is_ready():
+                            if time.perf_counter() >= deadline:
+                                self._record_wait(time.perf_counter() - t0)
+                                raise StagingWedged(
+                                    f"staging slot busy past the "
+                                    f"{budget:.1f}s slot-wait budget "
+                                    "(device/transfer wedged)")
+                            time.sleep(0.002)
+                        jax.block_until_ready(tok)
+                wait_s = time.perf_counter() - t0
+                if self._metrics is not None:
+                    self._metrics.sketch_slot_wait_seconds.observe(wait_s)
             else:
                 jax.block_until_ready(tok)
+        self._record_wait(wait_s)
         return slot
 
     def _advance(self, slot: int, token) -> None:
@@ -267,7 +334,11 @@ class DenseStagingRing(_SlotRing):
         the new sketch state (async — not blocked on)."""
         trace, owned = self._fold_trace(trace)
         try:
-            slot = self._wait_slot(trace)
+            try:
+                slot = self._wait_slot(trace)
+            except StagingWedged as exc:
+                exc.state = state  # nothing dispatched: caller's own state
+                raise
             feats = dict(extra=extra, dns=dns, drops=drops, xlat=xlat,
                          quic=quic)
             if self.spill_cap is not None:
@@ -473,7 +544,13 @@ class ShardedResidentStagingRing(_SlotRing):
         starts = [0] * nr
         first = True
         while any(starts[i] < len(shard_ev[i]) for i in range(nr)):
-            slot = self._wait_slot(trace)
+            try:
+                slot = self._wait_slot(trace)
+            except StagingWedged as exc:
+                # earlier chunks may have dispatched (donating the caller's
+                # state buffers); hand the last valid state to the catcher
+                exc.state = state
+                raise
             buf = self._bufs[slot]
 
             def pack_shard(i):
@@ -603,7 +680,13 @@ class ResidentStagingRing(_SlotRing):
                     self.dict_resets += 1
                     if self._metrics is not None:
                         self._metrics.sketch_resident_dict_epochs_total.inc()
-                slot = self._wait_slot(trace)
+                try:
+                    slot = self._wait_slot(trace)
+                except StagingWedged as exc:
+                    # earlier chunks may have dispatched (donating the
+                    # caller's state buffers); hand over the valid state
+                    exc.state = state
+                    raise
                 with trace.stage("resident_pack"):
                     buf, consumed = flowpack.pack_resident(
                         events, batch_size=self.batch_size, kdict=self.kdict,

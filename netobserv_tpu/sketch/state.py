@@ -292,7 +292,13 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
         # de-bias sampled traffic: a 1-in-N sampled flow record stands for N
         # flows' worth of volume (reference scales at the collector via the
         # exported Sampling field; sketches must fold the scaled estimate or
-        # heavy-hitter/volume numbers undercount). 0 = unsampled.
+        # heavy-hitter/volume numbers undercount). 0 = unsampled. The
+        # overload controller (sketch/overload.py) leans on exactly this
+        # lane: host-side shedding multiplies its 1-in-N factor into each
+        # surviving row's sampling, so kernel sampling and overload shed
+        # compose multiplicatively and both de-bias HERE — any change to
+        # this factor changes the shed-unbiasedness contract pinned by
+        # tests/test_overload.py.
         factor = jnp.maximum(samp, 1)
         bytes_f = bytes_f * factor.astype(jnp.float32)
         pkts = pkts * factor

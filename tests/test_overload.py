@@ -1,0 +1,810 @@
+"""Overload control plane (ISSUE 8): watermark backpressure, unbiased
+load shedding, and map-pressure relief.
+
+What is pinned here:
+
+- the AIMD controller's schedule (multiplicative increase under pressure,
+  additive recovery, snap-to-1 after a clean window) and its zero-cost
+  disabled gate (`SKETCH_SHED_WATERMARK` unset -> no controller object,
+  the export path is bit-identical to the unshedded agent);
+- UNBIASEDNESS: shedding thins rows 1-in-N but multiplies N into each
+  surviving row's `sampling` field, so the device de-bias
+  (sketch/state.ingest: factor = max(sampling, 1)) keeps CM frequency
+  and heavy-hitter estimates within the CM error bound of an unshed run
+  over the same traffic (fixed RNG schedule -> deterministic);
+- zero post-warmup retraces: shedding changes row COUNTS, never shapes —
+  the padded fixed-shape fold contract holds under any shed factor;
+- a wedged device trips the staging slot-wait budget and drops ONE batch
+  (counted, no dictionary epoch roll) instead of wedging the eviction
+  feed;
+- map-pressure relief: occupancy at/above MAP_PRESSURE_WATERMARK halves
+  the eviction period (cadence bounded at 2x) until pressure clears;
+- MapTracer.flush() racing an in-flight timer eviction: single
+  `_evict_lock` holder, no double-drain, no lost flush (the relief loop
+  leans on this path);
+- the OVERLOADED health condition: distinct from DEGRADED on
+  /healthz + /readyz, active while shedding, recovered within one window
+  of pressure clearing;
+- slow tier: a 4x overdriven feed against a fault-slowed device keeps
+  memory bounded, sheds, publishes, and recovers cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from netobserv_tpu.agent.supervisor import Supervisor
+from netobserv_tpu.datapath.fetcher import EvictedFlows, FakeFetcher
+from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+from netobserv_tpu.model import binfmt
+from netobserv_tpu.sketch import overload
+from netobserv_tpu.utils import faultinject, retrace
+
+from tests.test_pipeline import make_events
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faultinject.clear()
+    faultinject.hits.clear()
+
+
+def wait_for(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# controller unit tests (no jax)
+# ---------------------------------------------------------------------------
+
+
+class TestController:
+    def test_disabled_gate_returns_none(self):
+        assert overload.maybe_controller(256, 0, 64) is None
+        assert overload.maybe_controller(256, 0.0, 64) is None
+        assert overload.maybe_controller(256, 2.0, 64) is not None
+
+    def test_aimd_schedule(self):
+        ctl = overload.OverloadController(256, watermark=2.0, shed_max=8)
+        assert ctl.shed == 1 and not ctl.overloaded
+        # multiplicative increase above the high watermark
+        assert ctl.update(pending_rows=2 * 256, slot_wait_p95=0.0) == 2
+        assert ctl.update(2 * 256, 0.0) == 4
+        assert ctl.update(2 * 256, 0.0) == 8
+        assert ctl.update(10 * 256, 0.0) == 8  # capped at shed_max
+        assert ctl.overloaded
+        # hold between the low and high watermarks (hysteresis band)
+        assert ctl.update(int(1.5 * 256), 0.0) == 8
+        # additive decrease below the low watermark
+        assert ctl.update(0, 0.0) == 7
+        assert ctl.update(0, 0.0) == 6
+        # slot wait alone can carry the score over the watermark
+        ctl2 = overload.OverloadController(256, watermark=2.0, shed_max=8)
+        assert ctl2.update(0, 2 * overload.SLOT_WAIT_REF_S) == 2
+        # busy weighting: a zero-duty seam zeroes the depth term — arrival
+        # size alone is never pressure (the exporter measures busy)
+        ctl3 = overload.OverloadController(256, watermark=2.0, shed_max=8)
+        assert ctl3.update(100 * 256, 0.0, busy=0.0) == 1
+        assert ctl3.update(100 * 256, 0.0, busy=1.0) == 2
+
+    def test_window_roll_snaps_only_after_clean_window(self):
+        ctl = overload.OverloadController(256, watermark=1.0, shed_max=8)
+        ctl.update(4 * 256, 0.0)
+        assert ctl.shed > 1
+        # the window that SAW pressure ends: no snap yet
+        ctl.window_roll()
+        assert ctl.shed > 1
+        # a full pressure-free window: snap back to 1
+        ctl.window_roll()
+        assert ctl.shed == 1 and not ctl.overloaded
+
+    def test_admit_identity_at_factor_one(self):
+        ctl = overload.OverloadController(256, watermark=2.0)
+        ev = EvictedFlows(make_events(16))
+        assert ctl.admit(ev) is ev  # zero-copy no-op below the watermark
+
+    def test_admit_thins_scales_sampling_and_aligns_lanes(self):
+        ctl = overload.OverloadController(256, watermark=1.0, shed_max=4,
+                                          seed=11)
+        while ctl.shed < 4:
+            ctl.update(4 * 256, 0.0)
+        n = 512
+        events = make_events(n)
+        # mixed kernel sampling: 0 (unsampled) and 3 — the shed factor
+        # must compose multiplicatively on max(sampling, 1)
+        events["stats"]["sampling"][: n // 2] = 0
+        events["stats"]["sampling"][n // 2:] = 3
+        extra = np.zeros(n, binfmt.EXTRA_REC_DTYPE)
+        extra["rtt_ns"] = np.arange(n)
+        short = np.zeros(n // 2, binfmt.DNS_REC_DTYPE)
+        short["dns_id"] = np.arange(n // 2)
+        ev = EvictedFlows(events.copy(), extra=extra, dns=short)
+        ev.trace = object()
+
+        out = ctl.admit(ev)
+        assert out is not ev
+        kept = len(out.events)
+        # 1-in-4 sampling: the exact count rides the seeded RNG schedule
+        assert 0 < kept < n
+        assert abs(kept - n / 4) < 3 * np.sqrt(n * 0.25 * 0.75)
+        # surviving rows carry the composed factor
+        samp = out.events["stats"]["sampling"]
+        src = out.extra["rtt_ns"]  # original row index of each survivor
+        assert np.all(samp[src < n // 2] == 4)        # max(0,1)*4
+        assert np.all(samp[src >= n // 2] == 12)      # 3*4
+        # full lane stays aligned row-for-row with events
+        assert np.all(np.diff(src) > 0)
+        # a SHORT lane (zero-pad contract) thins over its own prefix, in
+        # the same order as the surviving events drawn from that prefix
+        n_short_kept = int((src < n // 2).sum())
+        assert len(out.dns) == n_short_kept
+        assert np.array_equal(out.dns["dns_id"], src[src < n // 2])
+        # accounting + trace continuity
+        assert ctl.shed_rows == n - kept and ctl.shed_batches == 1
+        assert out.trace is ev.trace
+        # the source eviction is untouched (admit copies, never aliases)
+        assert np.all(events["stats"]["sampling"][: n // 2] == 0)
+
+    def test_shed_fault_point_fires_per_batch(self):
+        ctl = overload.OverloadController(256, watermark=1.0)
+        ctl.update(4 * 256, 0.0)
+        faultinject.arm("sketch.overload_shed", "delay", 0.0)
+        ctl.admit(EvictedFlows(make_events(8)))
+        assert faultinject.hits.get("sketch.overload_shed") == 1
+
+
+# ---------------------------------------------------------------------------
+# exporter seam (jax)
+# ---------------------------------------------------------------------------
+
+from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter  # noqa: E402
+from netobserv_tpu.sketch import staging  # noqa: E402
+from netobserv_tpu.sketch.state import SketchConfig, state_tables  # noqa: E402
+
+SMALL_CFG = SketchConfig(cm_depth=2, cm_width=1 << 10, hll_precision=6,
+                         perdst_buckets=32, perdst_precision=4,
+                         persrc_buckets=32, persrc_precision=4,
+                         topk=16, hist_buckets=64, ewma_buckets=32)
+
+
+def make_exporter(metrics=None, sink=None, window_s=3600.0, batch=256,
+                  **kw):
+    return TpuSketchExporter(batch_size=batch, window_s=window_s,
+                             sketch_cfg=SMALL_CFG, metrics=metrics,
+                             sink=sink or (lambda obj: None), **kw)
+
+
+def synth_evictions(n_batches, rows, seed=7, n_distinct=400):
+    from netobserv_tpu.datapath.replay import SyntheticFetcher
+    f = SyntheticFetcher(flows_per_eviction=rows, n_distinct=n_distinct,
+                         zipf_a=1.3, seed=seed)
+    return [f.lookup_and_delete() for _ in range(n_batches)]
+
+
+def host_tables(exp) -> dict:
+    import jax
+    with exp._lock:
+        exp._drain_pending_locked()
+    state = jax.block_until_ready(exp._state)
+    return {k: np.asarray(v) for k, v in state_tables(state).items()}
+
+
+class TestExporterSeam:
+    def test_disabled_is_the_unshedded_exporter(self):
+        exp = make_exporter()
+        try:
+            assert exp._overload is None
+            assert exp._ring.slot_wait_budget_s is None
+            assert exp.overloaded is False
+            assert exp.overload_snapshot() is None
+        finally:
+            exp.close()
+
+    def test_idle_controller_is_bit_identical(self):
+        """An enabled controller that never crosses its watermark admits
+        every batch untouched: device tables bit-equal to the disabled
+        exporter over the same feed."""
+        evs = synth_evictions(6, 256)
+        tables = []
+        for kw in ({}, {"shed_watermark": 1e9}):
+            exp = make_exporter(**kw)
+            try:
+                for ev in evs:
+                    exp.export_evicted(
+                        EvictedFlows(ev.events.copy()))
+                tables.append(host_tables(exp))
+            finally:
+                exp.close()
+        a, b = tables
+        assert a.keys() == b.keys()
+        for k in a:
+            assert np.array_equal(a[k], b[k]), f"table {k} drifted"
+
+    def test_shed_ramps_under_pressure_recovers_and_never_retraces(self):
+        metrics = Metrics(MetricsSettings())
+        exp = make_exporter(metrics=metrics, window_s=0.4,
+                            shed_watermark=2.0, shed_max=8)
+        try:
+            # warm fold first so every later compile would be a retrace
+            exp.export_evicted(EvictedFlows(make_events(256)))
+            # 4x overdriven evictions against a fault-slowed fold: the
+            # seam's wall clock is all fold time (busy ~1), so the 4-batch
+            # depth scores >= watermark at every arrival after the first
+            faultinject.arm("sketch.ingest", "delay", 0.01)
+            for _ in range(6):
+                exp.export_evicted(EvictedFlows(make_events(1024)))
+            faultinject.clear("sketch.ingest")
+            assert exp.overloaded
+            snap = exp.overload_snapshot()
+            assert snap["shed_factor"] > 1
+            assert snap["shed_rows"] > 0
+            assert metrics.sketch_shed_factor._value.get() == \
+                snap["shed_factor"]
+            assert metrics.sketch_shed_rows_total._value.get() > 0
+            assert metrics.sketch_shed_batches_total._value.get() > 0
+            # pressure stops -> the window timer rolls -> one full clean
+            # window later the factor snaps back to 1
+            wait_for(lambda: not exp.overloaded, timeout=15,
+                     msg="shed factor recovery after pressure cleared")
+            assert metrics.sketch_shed_factor._value.get() == 1
+        finally:
+            exp.close()
+        # shedding changed row counts batch to batch; shapes never moved
+        for w in retrace.snapshot():
+            assert w["retraces"] == 0, w
+
+    def test_shed_is_unbiased_within_cm_error_bounds(self):
+        """Frequency and heavy-hitter estimates from a shed run agree with
+        the unshed run over the same traffic within the CM error budget:
+        the 1-in-N thin is de-biased by the device's sampling lane."""
+        evs = synth_evictions(30, 1024, seed=7, n_distinct=400)
+        # exact per-key byte totals (all rows unsampled in this feed) plus
+        # the per-row values, for the sampling-noise budget below
+        exact: dict[bytes, float] = {}
+        keyrow: dict[bytes, np.ndarray] = {}
+        rows_of: dict[bytes, list] = {}
+        for ev in evs:
+            for row in ev.events:
+                kb = row["key"].tobytes()
+                b = float(row["stats"]["bytes"])
+                exact[kb] = exact.get(kb, 0.0) + b
+                keyrow[kb] = row["key"]
+                rows_of.setdefault(kb, []).append(b)
+        top = sorted(exact, key=exact.get, reverse=True)[:12]
+
+        def run(pin_shed=None, **kw):
+            exp = make_exporter(**kw)
+            try:
+                if pin_shed is not None:
+                    # pin the factor for the whole run: THIS test pins the
+                    # thin+de-bias unbiasedness contract under one fixed
+                    # RNG schedule; the AIMD dynamics are pinned by the
+                    # ramp/recovery/healthy-device tests (a live
+                    # controller adapts to the harness's timing, which
+                    # would make the keep/drop schedule nondeterministic)
+                    ctl = exp._overload
+                    ctl.shed = pin_shed
+                    ctl.update = lambda *a, **k: pin_shed
+                for ev in evs:
+                    exp.export_evicted(EvictedFlows(ev.events.copy()))
+                with exp._lock:
+                    exp._drain_pending_locked()
+                import jax
+                state = jax.block_until_ready(exp._state)
+                # host-side CM point queries via the numpy hash twins.
+                # Under the conftest 8-virtual-device mesh the state is
+                # owner-sharded: every shard indexes a key identically
+                # (the hashes are shard-independent) and exactly one
+                # shard took its increments, so summing the per-shard
+                # tables reconstructs the union CM bit-exactly; the
+                # per-shard top-K candidate sets union by flattening.
+                from netobserv_tpu.model.columnar import pack_key_words
+                from netobserv_tpu.ops import countmin, hashing
+                counts = np.asarray(state.cm_bytes.counts)
+                if counts.ndim == 3:  # [shard, d, w]
+                    counts = counts.sum(axis=0)
+                words = np.stack([pack_key_words(
+                    keyrow[kb].reshape(1))[0] for kb in top])
+                mh = hashing.base_hashes_multi_np(words)
+                est = np.asarray(countmin.query(
+                    countmin.CountMin(counts=jax.numpy.asarray(counts)),
+                    mh["h1"], mh["h2"]))
+                hwords = np.asarray(state.heavy.words)
+                hvalid = np.asarray(state.heavy.valid)
+                heavy = {tuple(w) for w, v in
+                         zip(hwords.reshape(-1, hwords.shape[-1]),
+                             hvalid.reshape(-1)) if v}
+                shed = (exp._overload.shed_rows
+                        if exp._overload is not None else 0)
+                return est, heavy, shed
+            finally:
+                exp.close()
+
+        # the synthetic fetcher aggregates duplicate keys, so each
+        # 1024-draw eviction lands a few hundred unique rows — a LOW
+        # watermark keeps every arrival over pressure (the AIMD ramp
+        # itself is pinned separately; here we want sustained shedding)
+        # shed_seed pins ONE deterministic keep/drop schedule; this one's
+        # mean deviation sits near 0 (the estimator is unbiased — over 20
+        # seeds the grand mean measures -0.002 ± 0.074 — but any single
+        # fixed schedule carries its own sampling-noise offset)
+        est_a, heavy_a, _ = run()
+        est_b, heavy_b, shed_rows = run(shed_watermark=0.5, shed_max=4,
+                                        shed_seed=1, pin_shed=4)
+        assert shed_rows > 2_000, "the shed run did not actually shed"
+
+        total = sum(exact.values())
+        # per-key error budget = CM collision mass (classic eps*V with
+        # eps = e/width; common to both runs — same seeds — so only its
+        # slack leaks into the difference) + row-sampling noise. The
+        # synthetic fetcher aggregates duplicate keys per eviction, so a
+        # top key's volume rides ~30 LARGE rows — thinning those 1-in-N
+        # has std sqrt((N-1) * sum b_i^2) even though the estimator is
+        # unbiased; budget 4 sigma at the worst factor the run reached.
+        cm_budget = 2 * np.e * total / SMALL_CFG.cm_width
+        shed_hit = 4  # shed_max of the shed run below
+        for i, kb in enumerate(top):
+            diff = abs(float(est_b[i]) - float(est_a[i]))
+            b = np.asarray(rows_of[kb])
+            samp_sigma = np.sqrt((shed_hit - 1) * float((b * b).sum()))
+            tol = cm_budget + 4 * samp_sigma
+            assert diff <= tol, (
+                f"key {i}: shed estimate {est_b[i]:.0f} vs unshed "
+                f"{est_a[i]:.0f} (diff {diff:.0f} > tol {tol:.0f}; "
+                f"exact {exact[kb]:.0f})")
+        # UNBIASEDNESS has teeth in aggregate, where the per-key sampling
+        # noise averages out: the mean SIGNED relative deviation over the
+        # top keys sits near 0 for the de-biased thin, but at ~-(1-1/N)
+        # (≈ -0.75 here) if the shed ever forgot to scale `sampling`
+        rel = (est_b.astype(float) - est_a.astype(float)) / np.maximum(
+            est_a.astype(float), 1.0)
+        assert abs(float(rel.mean())) <= 0.15, (
+            f"systematic bias: mean relative deviation {rel.mean():+.3f} "
+            f"over the top {len(top)} keys (per-key: {np.round(rel, 3)})")
+        # heavy-hitter recall of the exact top-8 survives the shed
+        from netobserv_tpu.model.columnar import pack_key_words
+        top8 = [tuple(pack_key_words(keyrow[kb].reshape(1))[0])
+                for kb in top[:8]]
+        rec_a = sum(t in heavy_a for t in top8) / len(top8)
+        rec_b = sum(t in heavy_b for t in top8) / len(top8)
+        assert rec_a >= 0.75, f"unshed recall {rec_a} (harness broken?)"
+        assert rec_b >= rec_a - 0.25, (
+            f"shed recall {rec_b} collapsed vs unshed {rec_a}")
+
+    def test_healthy_device_with_large_arrivals_does_not_shed(self):
+        """Arrival SIZE alone is not pressure: a device that folds
+        instantly keeps the seam's busy fraction near 0, zeroing the
+        depth term — many-batch evictions on a lightly-loaded agent never
+        shed (shedding there would be permanent resolution loss with
+        nothing to protect)."""
+        exp = make_exporter(shed_watermark=2.0)
+        try:
+            exp.export_evicted(EvictedFlows(make_events(256)))  # warm
+            for _ in range(4):
+                time.sleep(0.25)  # idle gaps dwarf the fold time
+                exp.export_evicted(EvictedFlows(make_events(1024)))
+            assert not exp.overloaded
+            snap = exp.overload_snapshot()
+            assert snap["shed_rows"] == 0
+            assert snap["busy"] < 0.5
+        finally:
+            exp.close()
+
+    def test_wedged_continuation_adopts_partial_state(self):
+        """A slot-wait budget trip on a LATER chunk of a multi-chunk fold
+        hands the already-dispatched chunks' state to the exporter
+        (StagingWedged.state): earlier dispatches DONATED the pre-fold
+        state into the jit, so keeping the old reference would keep
+        deleted buffers and poison every later fold."""
+
+        class NeverReady:
+            def is_ready(self):
+                return False
+
+        # unwarmed k=4 ladder entry: a 4-batch arrival folds as FOUR k=1
+        # chunks through one _fold_events call (the multi-chunk seam);
+        # astronomically high watermark = controller armed, never shedding
+        exp = make_exporter(shed_watermark=1e9, shed_slot_budget_s=0.1,
+                            superbatch=(1, 4))
+        try:
+            exp.export_evicted(EvictedFlows(make_events(256)))  # warm k=1
+            pre = exp._state
+            ring = exp._ring
+            wedge_slot = (ring._slot + 1) % len(ring._tokens)
+            real = ring._tokens[wedge_slot]
+            ring._tokens[wedge_slot] = NeverReady()
+            try:
+                # chunk 1 dispatches (donating `pre`), chunk 2 wedges
+                exp.export_evicted(EvictedFlows(make_events(1024)))
+            finally:
+                ring._tokens[wedge_slot] = real
+            assert exp._state is not pre, \
+                "exporter kept the donated-away pre-fold state"
+            # the feed stays usable on the adopted state, and the device
+            # accounting shows exactly warm + chunk 1 + the recovery batch
+            exp.export_evicted(EvictedFlows(make_events(256)))
+            tables = host_tables(exp)
+            total = int(np.asarray(tables["scalars"])[0].sum())
+            assert total == 256 + 256 + 256
+        finally:
+            exp.close()
+
+    def test_wedged_device_drops_batch_not_the_feed(self):
+        """A staging slot busy past the slot-wait budget raises
+        StagingWedged: the batch drops (counted), the exporter thread
+        returns within the budget, and the resident dictionary does NOT
+        roll its epoch (nothing was packed for the dropped batch)."""
+
+        class NeverReady:
+            def is_ready(self):
+                return False
+
+        metrics = Metrics(MetricsSettings())
+        exp = make_exporter(metrics=metrics, shed_watermark=2.0,
+                            shed_slot_budget_s=0.1)
+        try:
+            assert exp._ring.slot_wait_budget_s == 0.1
+            exp.export_evicted(EvictedFlows(make_events(256)))  # warm
+            resets_before = exp._ring.dict_resets
+            errs_before = metrics.sketch_ingest_errors_total._value.get()
+            slot = exp._ring._slot
+            real = exp._ring._tokens[slot]
+            exp._ring._tokens[slot] = NeverReady()
+            try:
+                t0 = time.monotonic()
+                exp.export_evicted(EvictedFlows(make_events(256)))
+                waited = time.monotonic() - t0
+            finally:
+                exp._ring._tokens[slot] = real
+            assert waited < 5.0, f"feed wedged for {waited:.1f}s"
+            assert metrics.sketch_ingest_errors_total._value.get() == \
+                errs_before + 1
+            assert exp._ring.dict_resets == resets_before, \
+                "wedged drop must not roll the dictionary epoch"
+            # the feed keeps folding once the device recovers
+            exp.export_evicted(EvictedFlows(make_events(256)))
+        finally:
+            exp.close()
+
+
+# ---------------------------------------------------------------------------
+# map-pressure relief + flush race (flow/map_tracer.py)
+# ---------------------------------------------------------------------------
+
+import queue  # noqa: E402
+
+from netobserv_tpu.flow import MapTracer  # noqa: E402
+
+
+class SizedFetcher:
+    """Stub fetcher returning a fixed eviction size per drain (and
+    counting concurrent drains for the race test)."""
+
+    def __init__(self, rows: int):
+        self.rows = rows
+        self.calls = 0
+        self.concurrent = 0
+        self.max_concurrent = 0
+        self.block = None  # threading.Event to hold a drain in-flight
+        self._lock = threading.Lock()
+
+    def lookup_and_delete(self) -> EvictedFlows:
+        with self._lock:
+            self.calls += 1
+            self.concurrent += 1
+            self.max_concurrent = max(self.max_concurrent, self.concurrent)
+        try:
+            if self.block is not None:
+                self.block.wait(5)
+            return EvictedFlows(make_events(self.rows))
+        finally:
+            with self._lock:
+                self.concurrent -= 1
+
+    def read_global_counters(self):
+        return {}
+
+
+class TestMapPressure:
+    def test_latch_metrics_and_fault_point(self):
+        metrics = Metrics(MetricsSettings())
+        q: queue.Queue = queue.Queue(maxsize=100)
+        tracer = MapTracer(SizedFetcher(90), q, active_timeout_s=60,
+                           metrics=metrics, columnar=True,
+                           map_capacity=100, pressure_watermark=0.8)
+        faultinject.arm("map_tracer.pressure_evict", "delay", 0.0)
+        tracer._evict_once()
+        assert tracer._pressure_relief is True
+        assert metrics.map_pressure_evictions_total._value.get() == 1
+        assert faultinject.hits.get("map_tracer.pressure_evict") == 1
+        # occupancy histogram saw the 0.9 drain
+        assert metrics.map_occupancy_ratio._sum.get() == \
+            pytest.approx(0.9)
+        # pressure clears when occupancy falls below the watermark
+        tracer._fetcher = SizedFetcher(10)
+        tracer._evict_once()
+        assert tracer._pressure_relief is False
+
+    def test_pressure_halves_the_wait_and_relaxes_back(self):
+        q: queue.Queue = queue.Queue(maxsize=100)
+        fetcher = SizedFetcher(90)
+        tracer = MapTracer(fetcher, q, active_timeout_s=0.2, columnar=True,
+                           map_capacity=100, pressure_watermark=0.8)
+        waits: list[float] = []
+        real_wait = tracer._flush.wait
+
+        def recording_wait(timeout=None):
+            waits.append(timeout)
+            return real_wait(timeout=min(timeout, 0.02))
+
+        tracer._flush.wait = recording_wait
+        tracer.start()
+        try:
+            wait_for(lambda: fetcher.calls >= 3, msg="pressured drains")
+            # first wakeup used the configured period; every wakeup after
+            # a pressured drain uses half of it (cadence bounded at 2x)
+            assert waits[0] == pytest.approx(0.2)
+            assert any(w == pytest.approx(0.1) for w in waits[1:])
+            # relief relaxes once occupancy falls below the watermark
+            tracer._fetcher = SizedFetcher(10)
+            n = len(waits)
+            wait_for(lambda: len(waits) > n + 2, msg="relaxed waits")
+            assert waits[-1] == pytest.approx(0.2)
+        finally:
+            tracer.stop(final_evict=False)
+
+    def test_latched_relief_sustains_at_half_watermark(self):
+        """Halved drains accumulate roughly half the flows, so a latched
+        relief sustains down to watermark/2 instead of oscillating
+        latched/clear on alternating drains (any watermark > 0.5 would
+        otherwise never hold); an unlatched tracer at the same occupancy
+        must NOT latch."""
+        q: queue.Queue = queue.Queue(maxsize=100)
+        tracer = MapTracer(SizedFetcher(90), q, active_timeout_s=60,
+                           columnar=True, map_capacity=100,
+                           pressure_watermark=0.8)
+        tracer._evict_once()
+        assert tracer._pressure_relief is True    # 0.90 >= 0.8: latch
+        tracer._fetcher = SizedFetcher(45)
+        tracer._evict_once()
+        assert tracer._pressure_relief is True    # 0.45 >= 0.4: sustain
+        tracer._fetcher = SizedFetcher(30)
+        tracer._evict_once()
+        assert tracer._pressure_relief is False   # 0.30 < 0.4: clear
+        fresh = MapTracer(SizedFetcher(45), q, active_timeout_s=60,
+                          columnar=True, map_capacity=100,
+                          pressure_watermark=0.8)
+        fresh._evict_once()
+        assert fresh._pressure_relief is False    # hysteresis only sustains
+
+    def test_disabled_watermark_never_latches(self):
+        q: queue.Queue = queue.Queue(maxsize=100)
+        tracer = MapTracer(SizedFetcher(100), q, active_timeout_s=60,
+                           columnar=True)  # capacity/watermark unset
+        tracer._evict_once()
+        assert tracer._pressure_relief is False
+
+    def test_flush_racing_timer_eviction(self):
+        """One `_evict_lock` holder at a time, no drain is lost: a flush
+        raised WHILE a drain is in flight runs as its own drain right
+        after — never concurrently, never swallowed."""
+        q: queue.Queue = queue.Queue(maxsize=100)
+        fetcher = SizedFetcher(4)
+        fetcher.block = threading.Event()
+        tracer = MapTracer(fetcher, q, active_timeout_s=60, columnar=True)
+        tracer.start()
+        try:
+            tracer.flush()  # first drain: parks inside the fetcher
+            wait_for(lambda: fetcher.concurrent == 1, msg="drain in flight")
+            tracer.flush()  # raised mid-drain: must not be lost
+            # a direct evict (the ringbuf path's flusher analog) must
+            # serialize on _evict_lock with the in-flight timer drain
+            direct = threading.Thread(target=tracer._evict_once)
+            direct.start()
+            time.sleep(0.1)
+            assert fetcher.max_concurrent == 1, "double-drain"
+            fetcher.block.set()
+            direct.join(timeout=10)
+            assert not direct.is_alive()
+            wait_for(lambda: fetcher.calls >= 3, msg="flush honored")
+            assert fetcher.max_concurrent == 1
+        finally:
+            tracer.stop(final_evict=False)
+            fetcher.block.set()
+
+
+class TestAgentWiring:
+    def test_map_capacity_falls_back_to_cache_max_flows(self):
+        from netobserv_tpu.agent.agent import FlowsAgent
+        from netobserv_tpu.config import load_config
+        from netobserv_tpu.exporter.base import Exporter
+
+        class NullExporter(Exporter):
+            name = "null"
+
+            def export_batch(self, records):
+                pass
+
+        cfg = load_config(environ={
+            "EXPORT": "stdout", "MAP_PRESSURE_WATERMARK": "0.75",
+            "CACHE_MAX_FLOWS": "5000"})
+        agent = FlowsAgent(cfg, FakeFetcher(), NullExporter())
+        # FakeFetcher has no map_capacity probe: the agent sized the map
+        # itself, so CACHE_MAX_FLOWS is the denominator
+        assert agent.map_tracer._map_capacity == 5000
+        assert agent.map_tracer._pressure_watermark == 0.75
+
+
+# ---------------------------------------------------------------------------
+# OVERLOADED health condition (supervisor + /healthz + /readyz)
+# ---------------------------------------------------------------------------
+
+
+class TestHealthSurface:
+    def test_supervisor_condition_registry(self):
+        sup = Supervisor(check_period_s=3600)
+        assert sup.conditions() == {}
+        assert sup.condition_active("overloaded") is False
+        state = {"active": True, "shed_factor": 4}
+        sup.register_condition("overloaded", lambda: dict(state))
+        assert sup.condition_active("overloaded") is True
+        assert sup.conditions()["overloaded"]["shed_factor"] == 4
+        # a raising probe answers False + error, never raises through
+        sup.register_condition("broken", lambda: 1 / 0)
+        out = sup.conditions()["broken"]
+        assert out["active"] is False and "error" in out
+        assert sup.condition_active("broken") is False
+
+    def test_exporter_registers_overloaded_condition(self):
+        metrics = Metrics(MetricsSettings())
+        exp = make_exporter(metrics=metrics, shed_watermark=2.0,
+                            window_s=3600)
+        sup = Supervisor(metrics=metrics, check_period_s=3600)
+        try:
+            exp.register_supervised(sup, heartbeat_timeout_s=60)
+            assert sup.condition_active("overloaded") is False
+            faultinject.arm("sketch.ingest", "delay", 0.01)
+            for _ in range(4):
+                exp.export_evicted(EvictedFlows(make_events(1024)))
+            faultinject.clear("sketch.ingest")
+            assert sup.condition_active("overloaded") is True
+            cond = sup.conditions()["overloaded"]
+            assert cond["shed_factor"] > 1
+            assert cond["shed_rows"] > 0
+        finally:
+            sup.stop()
+            exp.close()
+
+    def test_agent_health_snapshot_hoists_overloaded(self):
+        from netobserv_tpu.agent.agent import FlowsAgent
+        from netobserv_tpu.config import load_config
+
+        cfg = load_config(environ={
+            "EXPORT": "stdout", "SKETCH_SHED_WATERMARK": "2"})
+        exp = make_exporter(shed_watermark=2.0)
+        agent = FlowsAgent(cfg, FakeFetcher(), exp)
+        try:
+            snap = agent.health_snapshot()
+            assert snap["overloaded"] is False
+            assert "conditions" in snap
+            faultinject.arm("sketch.ingest", "delay", 0.01)
+            for _ in range(4):
+                exp.export_evicted(EvictedFlows(make_events(1024)))
+            faultinject.clear("sketch.ingest")
+            snap = agent.health_snapshot()
+            assert snap["overloaded"] is True
+            assert snap["degraded"] is False  # distinct conditions
+            assert snap["conditions"]["overloaded"]["shed_factor"] > 1
+        finally:
+            agent.supervisor.stop()
+            exp.close()
+
+    def test_healthz_readyz_overloaded_semantics(self):
+        """OVERLOADED surfaces in both bodies but fails NEITHER probe: the
+        agent is alive and serving (deliberate graceful degradation);
+        DEGRADED still fails readiness."""
+        from prometheus_client import CollectorRegistry
+
+        from netobserv_tpu.metrics.server import start_metrics_server
+
+        health = {"status": "Started", "degraded": False,
+                  "overloaded": True,
+                  "conditions": {"overloaded": {"active": True,
+                                                "shed_factor": 8}},
+                  "stages": {}}
+        srv = start_metrics_server(CollectorRegistry(),
+                                   address="127.0.0.1", port=0,
+                                   health_source=lambda: dict(health))
+        try:
+            port = srv.server_address[1]
+
+            def get(path):
+                try:
+                    r = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=5)
+                    return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            code, body = get("/healthz")
+            assert code == 200 and body["overloaded"] is True
+            assert body["conditions"]["overloaded"]["shed_factor"] == 8
+            code, body = get("/readyz")
+            assert code == 200, "overload must not pull the agent " \
+                                "from rotation"
+            health["degraded"] = True
+            code, _ = get("/readyz")
+            assert code == 503, "DEGRADED still fails readiness"
+            code, _ = get("/healthz")
+            assert code == 200
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# slow tier: 4x overdriven soak against a fault-slowed device
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_overdriven_feed_bounded_sheds_and_recovers():
+    """The acceptance soak: a feed arriving ~4x faster than the
+    fault-slowed device folds keeps memory bounded (the pending buffer
+    never grows past its preallocated capacity), sheds (OVERLOADED
+    active), keeps publishing windows, and recovers to shed=1 within one
+    window of the pressure clearing — with zero post-warmup retraces."""
+    import resource
+
+    reports: list = []
+    metrics = Metrics(MetricsSettings())
+    exp = make_exporter(metrics=metrics, window_s=0.8,
+                        sink=lambda obj: reports.append(obj),
+                        shed_watermark=2.0, shed_max=64)
+    try:
+        exp.export_evicted(EvictedFlows(make_events(256)))  # warm
+        faultinject.arm("sketch.ingest", "delay", 0.01)
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        max_pending = 0
+        t_end = time.monotonic() + 4.0
+        i = 0
+        while time.monotonic() < t_end:
+            # each arrival is 4 batches' worth against a device whose
+            # every fold eats an injected 10ms
+            exp.export_evicted(EvictedFlows(
+                make_events(1024, sport0=1000 + (i % 40))))
+            max_pending = max(max_pending, exp._pending_buf.n)
+            i += 1
+        assert exp.overloaded, "the soak never tripped the controller"
+        assert exp.overload_snapshot()["shed_rows"] > 0
+        # bounded memory: the accumulator is preallocated and never grew
+        assert max_pending <= exp._pending_buf.capacity
+        rss_growth_mb = (resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss - rss0) / 1024
+        assert rss_growth_mb < 500, f"RSS grew {rss_growth_mb:.0f}MB"
+        faultinject.clear("sketch.ingest")
+        # pressure cleared: recovery within one clean window
+        wait_for(lambda: not exp.overloaded, timeout=20,
+                 msg="recovery after the overdrive stopped")
+        wait_for(lambda: len(reports) >= 2, timeout=20,
+                 msg="window reports under overload")
+    finally:
+        faultinject.clear()
+        exp.close()
+    for w in retrace.snapshot():
+        assert w["retraces"] == 0, w
