@@ -53,6 +53,15 @@ bench-evict:
 bench-overload:
 	JAX_PLATFORMS=cpu $(PY) bench.py --overload-only
 
+# adversarial scenario zoo (~90s): every netobserv_tpu/scenarios pcap
+# replayed through a full in-process agent and graded END TO END through
+# the live /query/* routes — top-K recall, alarm fire/quiet directions,
+# victim naming, HLL cardinality error, CM error-bar honesty — the
+# per-PR CI artifact for detection QUALITY (docs/architecture.md
+# "Query plane")
+bench-scenarios:
+	JAX_PLATFORMS=cpu $(PY) bench.py --scenarios
+
 gen-protobuf:
 	protoc --python_out=netobserv_tpu/pb -I proto proto/flow.proto proto/packet.proto
 

@@ -74,7 +74,8 @@ def main() -> int:
             metrics.registry, cfg.metrics_server_address,
             cfg.metrics_server_port, cfg.metrics_tls_cert_path,
             cfg.metrics_tls_key_path,
-            health_source=getattr(agent, "health_snapshot", None))
+            health_source=getattr(agent, "health_snapshot", None),
+            query_routes=getattr(agent, "query_routes", None))
 
     stop = threading.Event()
 

@@ -164,6 +164,11 @@ class FlowsAgent:
             self.iface_listener = InterfaceListener(
                 cfg, fetcher, metrics=self.metrics, informer=iface_informer)
 
+        # query plane: exporters that publish a window snapshot (tpu-sketch)
+        # expose a QueryRoutes handler; the metrics server serves it at
+        # /query/* (docs/architecture.md "Query plane")
+        self.query_routes = getattr(exporter, "query_routes", None)
+
         # supervision: every stage thread registers a heartbeat + restart;
         # crashed/hung stages restart with bounded backoff, exhausted
         # budgets degrade the agent explicitly (agent/supervisor.py)

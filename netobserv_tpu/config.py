@@ -360,6 +360,14 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     #: coalescing (docs/tpu_sketch.md "superbatch fold coalescing")
     sketch_superbatch: str = field(default="1,2,4",
                                    **_env("SKETCH_SUPERBATCH", "1,2,4"))
+    #: mid-window query-snapshot refresh period for the agent's /query/*
+    #: surface (e.g. "5s"): the supervised timer thread re-runs the
+    #: existing roll executable against the live state and publishes its
+    #: report + tables WITHOUT closing the window. 0 (default) disables the
+    #: refresh entirely — /query serves the last ROLL's snapshot and the
+    #: exporter path is bit-identical to pre-query-plane behavior
+    sketch_query_refresh: float = field(
+        default=0.0, **_env("SKETCH_QUERY_REFRESH", "0"))
 
     # --- overload control plane (sketch/overload.py; new) ---
     #: high watermark (in BATCHES: pending-fold depth weighted by the
@@ -503,6 +511,10 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
                 f"SKETCH_REPORT_SINK={self.sketch_report_sink!r} "
                 "(want stdout|kafka)")
         self.parsed_superbatch_ladder()  # raises on a malformed ladder spec
+        if self.sketch_query_refresh < 0:
+            raise ValueError(
+                "SKETCH_QUERY_REFRESH must be >= 0 (0 disables the "
+                "mid-window refresh)")
         if self.sketch_shed_watermark < 0:
             raise ValueError("SKETCH_SHED_WATERMARK must be >= 0 (0 disables)")
         if self.sketch_shed_max < 2:
@@ -542,7 +554,7 @@ _DURATION_FIELDS = {
     "supervisor_backoff_max", "supervisor_healthy_reset",
     "supervisor_heartbeat_timeout", "federation_window",
     "federation_stale_after", "federation_agent_ttl",
-    "sketch_shed_slot_budget",
+    "sketch_shed_slot_budget", "sketch_query_refresh",
 }
 
 

@@ -98,6 +98,16 @@ class PcapReplayFetcher:
 
     Minimal classic-pcap parser (no external deps): ethernet/IPv4/IPv6 + TCP/
     UDP/ICMP; non-IP packets are skipped.
+
+    Two kernel-datapath feature twins run during the parse so replayed
+    traffic exercises the same sketch signal planes as live capture
+    (the scenario zoo leans on both — netobserv_tpu/scenarios):
+
+    - DNS latency: UDP port-53 query/response pairs are correlated by
+      transaction id + client endpoint (the kernel's dns tracker analog);
+      the measured latency rides the RESPONSE flow's DNS feature record.
+    - QUIC: a long-header first byte on UDP/443 marks the flow's QUIC
+      feature record (the kernel datapath's payload probe analog).
     """
 
     def __init__(self, path: str, window_s: float = 5.0):
@@ -108,14 +118,18 @@ class PcapReplayFetcher:
         # rebase capture timestamps into the monotonic domain so the standard
         # mono->wall reconstruction yields sane (current) wall times
         if self._windows:
-            first_ts = min(int(w["stats"]["first_seen_ns"].min())
-                           for w in self._windows if len(w))
+            first_ts = min(int(w[0]["stats"]["first_seen_ns"].min())
+                           for w in self._windows if len(w[0]))
             offset = time.clock_gettime_ns(time.CLOCK_MONOTONIC) - first_ts
             for w in self._windows:
-                for fld in ("first_seen_ns", "last_seen_ns"):
-                    w["stats"][fld] = (
-                        w["stats"][fld].astype(np.int64) + offset
-                    ).astype(np.uint64)
+                for arr in w:
+                    if arr is None:
+                        continue
+                    stats = arr["stats"] if "stats" in (
+                        arr.dtype.names or ()) else arr
+                    for fld in ("first_seen_ns", "last_seen_ns"):
+                        stats[fld] = (stats[fld].astype(np.int64) + offset
+                                      ).astype(np.uint64)
 
     @property
     def n_windows(self) -> int:
@@ -144,8 +158,13 @@ class PcapReplayFetcher:
             raise ValueError(f"unsupported linktype {linktype} (want ethernet)")
         off = 24
         flows: dict[bytes, list] = {}
-        windows: list[np.ndarray] = []
+        windows: list[tuple] = []
         window_start: Optional[int] = None
+        #: (txid, client ip, client port) -> send timestamp (the kernel dns
+        #: tracker's in-flight map analog; response packets pop it). The
+        #: client endpoint is part of the key: 16-bit txids collide
+        #: routinely across clients in real captures
+        pending_dns: dict[tuple, int] = {}
         while off + 16 <= len(data):
             ts_sec, ts_sub, incl, orig = struct.unpack(
                 endian + "IIII", data[off:off + 16])
@@ -162,23 +181,48 @@ class PcapReplayFetcher:
             parsed = _parse_packet(pkt)
             if parsed is None:
                 continue
-            key_bytes, length, flags = parsed
+            key_bytes, length, flags, meta = parsed
             ent = flows.get(key_bytes)
             if ent is None:
-                flows[key_bytes] = [length, 1, flags, ts_ns, ts_ns]
+                # [bytes, pkts, flags, first, last,
+                #  dns_lat_ns, dns_id, dns_errno, quic_ver, quic_long]
+                ent = flows[key_bytes] = [length, 1, flags, ts_ns, ts_ns,
+                                          0, 0, 0, 0, 0]
             else:
                 ent[0] += length
                 ent[1] += 1
                 ent[2] |= flags
                 ent[4] = ts_ns
+            if meta is None:
+                continue
+            if meta[0] == "dns":
+                _kind, txid, is_response, rcode, client = meta
+                if not is_response:
+                    pending_dns[(txid, *client)] = ts_ns
+                else:
+                    sent = pending_dns.pop((txid, *client), None)
+                    if sent is not None:
+                        # latency rides the RESPONSE flow (server->client)
+                        ent[5] = max(ent[5], ts_ns - sent)
+                        ent[6] = txid
+                        ent[7] = rcode
+            else:  # quic long header
+                ent[8] = meta[1]
+                ent[9] = 1
         if flows:
             windows.append(self._to_events(flows))
         return windows
 
     @staticmethod
-    def _to_events(flows: dict[bytes, list]) -> np.ndarray:
+    def _to_events(flows: dict[bytes, list]) -> tuple:
+        """One window's (events, dns, quic) arrays; the feature arrays are
+        None when no flow in the window carried that feature (exactly like
+        a kernel datapath with the tracker disabled)."""
         events = np.zeros(len(flows), dtype=binfmt.FLOW_EVENT_DTYPE)
-        for i, (kb, (byts, pkts, flags, first, last)) in enumerate(flows.items()):
+        dns = quic = None
+        for i, (kb, ent) in enumerate(flows.items()):
+            (byts, pkts, flags, first, last,
+             dns_lat, dns_id, dns_errno, quic_ver, quic_long) = ent
             events[i]["key"] = np.frombuffer(
                 kb, dtype=binfmt.FLOW_KEY_DTYPE)[0]
             s = events[i]["stats"]
@@ -189,16 +233,31 @@ class PcapReplayFetcher:
             s["last_seen_ns"] = last
             s["eth_protocol"] = 0x0800
             s["if_index_first"] = 1
-        return events
+            if dns_lat:
+                if dns is None:
+                    dns = np.zeros(len(flows), binfmt.DNS_REC_DTYPE)
+                dns[i]["latency_ns"] = dns_lat
+                dns[i]["dns_id"] = dns_id
+                dns[i]["errno"] = dns_errno
+                dns[i]["first_seen_ns"] = first
+                dns[i]["last_seen_ns"] = last
+            if quic_long:
+                if quic is None:
+                    quic = np.zeros(len(flows), binfmt.QUIC_REC_DTYPE)
+                quic[i]["version"] = quic_ver
+                quic[i]["seen_long_hdr"] = 1
+                quic[i]["first_seen_ns"] = first
+                quic[i]["last_seen_ns"] = last
+        return events, dns, quic
 
     def lookup_and_delete(self) -> EvictedFlows:
         with self._lock:
             if self._idx >= len(self._windows):
                 return EvictedFlows(
                     np.zeros(0, dtype=binfmt.FLOW_EVENT_DTYPE))
-            events = self._windows[self._idx]
+            events, dns, quic = self._windows[self._idx]
             self._idx += 1
-        return EvictedFlows(events)
+        return EvictedFlows(events, dns=dns, quic=quic)
 
     def read_ringbuf(self, timeout_s: float) -> Optional[bytes]:
         time.sleep(timeout_s)
@@ -289,8 +348,20 @@ class PcapPacketFetcher:
         pass
 
 
+#: dns/quic feature-probe ports (kernel twins: DNS_TRACKING_PORT and the
+#: QUIC payload probe's UDP/443 gate)
+_DNS_PORT = 53
+_QUIC_PORT = 443
+
+
 def _parse_packet(pkt: bytes):
-    """Ethernet frame -> (flow_key bytes, ip_len, tcp_flags) or None."""
+    """Ethernet frame -> (flow_key bytes, ip_len, tcp_flags, meta) or None.
+
+    `meta` is the feature-probe result: ``("dns", txid, is_response,
+    rcode, (client_ip16, client_port))`` for a UDP port-53 packet with a
+    DNS header, ``("quic", version)`` for a long-header QUIC packet on
+    UDP/443, else None.
+    """
     if len(pkt) < 14:
         return None
     ethertype = struct.unpack(">H", pkt[12:14])[0]
@@ -314,12 +385,27 @@ def _parse_packet(pkt: bytes):
         return None
     key["proto"] = proto
     flags = 0
+    meta = None
     if proto in (6, 17) and len(l4) >= 4:  # TCP/UDP ports
-        key["src_port"], key["dst_port"] = struct.unpack(">HH", l4[:4])
+        sport, dport = struct.unpack(">HH", l4[:4])
+        key["src_port"], key["dst_port"] = sport, dport
         if proto == 6 and len(l4) >= 14:
             flags = classify_tcp_flags(l4[13])
+        elif proto == 17:
+            payload = l4[8:]
+            if _DNS_PORT in (sport, dport) and len(payload) >= 4:
+                txid = struct.unpack(">H", payload[:2])[0]
+                is_resp = bool(payload[2] & 0x80)
+                # the pairing key carries the CLIENT endpoint (query src /
+                # response dst) — txids collide across clients
+                client = ((key["dst_ip"].tobytes(), dport) if is_resp
+                          else (key["src_ip"].tobytes(), sport))
+                meta = ("dns", txid, is_resp, payload[3] & 0x0F, client)
+            elif (_QUIC_PORT in (sport, dport) and len(payload) >= 5
+                  and payload[0] & 0xC0 == 0xC0):
+                meta = ("quic", struct.unpack(">I", payload[1:5])[0])
     elif proto in (1, 58) and len(l4) >= 2:  # ICMP type/code
         key["icmp_type"], key["icmp_code"] = l4[0], l4[1]
     # L2 frame length (IP total + ethernet header) — the same accounting as
     # the kernel datapath's skb->len
-    return key.tobytes(), total_len + 14, flags
+    return key.tobytes(), total_len + 14, flags, meta

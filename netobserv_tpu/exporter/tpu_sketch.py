@@ -118,27 +118,14 @@ def report_to_json(report, max_heavy: int = 64,
                 "Proto": int(k["proto"]),
                 "EstBytes": float(counts[i]),
             })
-    # best-effort victim names: heavy-hitter dst addresses hashed into the
-    # same EWMA buckets the anomaly signals use (numpy hash twin — report
-    # rendering must never dispatch a device op). Spoofed floods' own flows
-    # rarely make the heavy table, but the victim's legitimate traffic does.
+    # best-effort victim names via the shared query core (the ONE
+    # implementation — numpy hash twin under DST_BUCKET_SEED; report
+    # rendering must never dispatch a device op)
+    from netobserv_tpu.query.core import victim_bucket_names
     n_buckets = np.asarray(report.ddos_z).shape[0]
-    dst_bucket_names: dict[int, list] = {}
-    if sel:
-        from netobserv_tpu.ops.hashing import DST_BUCKET_SEED, hash_words_np
-        sel_words = words[np.asarray(sel)]
-        # BOTH directions name a victim: its inbound traffic buckets via
-        # the dst words, its outbound (e.g. a flooded server still serving)
-        # via the src words — the device folds both into the same bucket
-        # family (state.py src_sym/dst_h1 share DST_BUCKET_SEED)
-        for cols, field in ((sel_words[:, 4:8], "DstAddr"),
-                            (sel_words[:, 0:4], "SrcAddr")):
-            buckets = hash_words_np(cols, seed=DST_BUCKET_SEED) \
-                & (n_buckets - 1)
-            for j, b in enumerate(buckets):
-                names = dst_bucket_names.setdefault(int(b), [])
-                if len(names) < 3 and heavy[j][field] not in names:
-                    names.append(heavy[j][field])
+    dst_bucket_names = victim_bucket_names(
+        words[np.asarray(sel, dtype=np.int64)] if sel
+        else words[:0], heavy, n_buckets)
 
     def victims(bucket: int) -> list:
         return dst_bucket_names.get(int(bucket), [])
@@ -273,7 +260,8 @@ class TpuSketchExporter(Exporter):
                  shed_watermark: float = 0.0,
                  shed_max: int = 64,
                  shed_slot_budget_s: float = 30.0,
-                 shed_seed: int = 2026):
+                 shed_seed: int = 2026,
+                 query_refresh_s: float = 0.0):
         # superbatch defaults to NO ladder for direct construction: the
         # ladder costs superbatch_max-sized ring buffers, dictionaries and
         # key-table rows up front, and only pays off once warmed — the
@@ -395,9 +383,16 @@ class TpuSketchExporter(Exporter):
                             "mesh; disabling it on this %dx%d exporter",
                             spec.data, spec.sketch)
                 self._drop_delta_sink()
+            # the query plane (and the delta export) need the merged
+            # whole-width table snapshot; it exists only on data-axis-only
+            # meshes — width-sharded CM planes are independent local-width
+            # sketches (parallel/merge.py make_merge_fn contract). Without
+            # tables the /query/frequency route answers 503; the
+            # report-backed routes still serve.
+            self._with_tables = spec.sketch == 1
             self._roll = pmerge.make_merge_fn(
                 self._mesh, self._cfg, decay_factor=decay_factor,
-                with_tables=self._delta_sink is not None)
+                with_tables=self._with_tables)
             if feed == "resident":
                 # resident feed over the mesh: per-data-shard dictionaries
                 # + device key tables (~15B/record instead of dense's 80;
@@ -447,9 +442,14 @@ class TpuSketchExporter(Exporter):
                 use_pallas=self._cfg.use_pallas,
                 enable_fanout=self._cfg.enable_fanout,
                 enable_asym=self._cfg.enable_asym), "ingest")
+            # with_tables unconditionally: the pre-roll table snapshot is
+            # one extra output of the same roll executable, and it feeds
+            # BOTH the federation delta export and the query plane's
+            # per-roll snapshot (/query/frequency needs the CM planes)
+            self._with_tables = True
             self._roll = retrace.watch(
                 sk.make_roll_fn(self._cfg, decay_factor=decay_factor,
-                                with_tables=self._delta_sink is not None),
+                                with_tables=True),
                 "roll")
             self._ring = self._make_single_device_ring(
                 feed, resident_slots, pack_threads, metrics)
@@ -480,6 +480,30 @@ class TpuSketchExporter(Exporter):
         self._busy_fold_s = 0.0
         self._busy_last_t: Optional[float] = None
         self._busy_ewma = 0.0
+        # query plane (netobserv_tpu/query): the roll's table snapshot +
+        # rendered report publish as this agent's queryable view at every
+        # window close; /query/* on the metrics server reads ONLY this
+        # (off the hot path, the /debug/traces rules). The optional
+        # mid-window refresh (SKETCH_QUERY_REFRESH) re-runs the existing
+        # roll executable on the timer thread WITHOUT adopting its state —
+        # no new jitted entry, so the refresh can never retrace.
+        from netobserv_tpu.query import QueryRoutes, SnapshotPublisher
+        self.query = SnapshotPublisher()
+        self.query_routes = QueryRoutes(self.query.get, self.query_status,
+                                        metrics=metrics)
+        if metrics is not None:
+            metrics.query_snapshot_age_seconds.set_function(self.query.age_s)
+        self._query_refresh_s = query_refresh_s
+        if query_refresh_s and jax.process_count() > 1:
+            # each process's timer would dispatch the roll's collectives on
+            # its own schedule — divergent collective order across
+            # processes is a hang, not a feature
+            log.warning("SKETCH_QUERY_REFRESH disabled on multi-process "
+                        "meshes (refresh rolls would run collectives on "
+                        "unsynchronized timers)")
+            self._query_refresh_s = 0.0
+        self._next_refresh = (time.monotonic() + self._query_refresh_s
+                              if self._query_refresh_s else None)
         if warm_ladder:
             self.warm_superbatch_ladder()
         # the staging ring packs the next batch while the previous
@@ -539,6 +563,8 @@ class TpuSketchExporter(Exporter):
         def _warm() -> None:
             import jax
             for k in ring.ladder:
+                if self._closed.is_set():
+                    return  # shutting down: stop compiling, exit promptly
                 if k in ring._available:
                     # already selectable (k=1, or a prior warm): live folds
                     # may be tracing it RIGHT NOW — a concurrent duplicate
@@ -574,8 +600,9 @@ class TpuSketchExporter(Exporter):
         if block:
             _warm()
         else:
-            threading.Thread(target=_warm, name="sketch-ladder-warm",
-                             daemon=True).start()
+            self._warm_thread = threading.Thread(
+                target=_warm, name="sketch-ladder-warm", daemon=True)
+            self._warm_thread.start()
 
     def _drop_delta_sink(self) -> None:
         """Disable delta export, CLOSING the sink (from_config already
@@ -653,6 +680,7 @@ class TpuSketchExporter(Exporter):
                    shed_watermark=cfg.sketch_shed_watermark,
                    shed_max=cfg.sketch_shed_max,
                    shed_slot_budget_s=cfg.sketch_shed_slot_budget,
+                   query_refresh_s=cfg.sketch_query_refresh,
                    warm_ladder=True,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
@@ -817,7 +845,21 @@ class TpuSketchExporter(Exporter):
 
     def close(self) -> None:
         self._closed.set()
-        self._timer.join(timeout=2.0)
+        # a mid-flight query refresh (roll dispatch + table transfer on the
+        # timer thread) must finish before the interpreter starts tearing
+        # down, or its in-flight device work on a daemon thread aborts the
+        # C++ runtime at exit ("terminate called without an active
+        # exception") — give the join a refresh-sized budget; without the
+        # refresh the timer only ever waits on its poll tick
+        self._timer.join(timeout=10.0 if self._query_refresh_s else 2.0)
+        # same exit hazard for the background ladder warm: an agent
+        # SIGTERMed during its first ~minute can still be compiling ladder
+        # entries here — _warm skips remaining entries once _closed is
+        # set, so this join only ever waits out the ONE in-flight compile
+        # (bounded: a wedged backend must not wedge shutdown forever)
+        warm = getattr(self, "_warm_thread", None)
+        if warm is not None and warm.is_alive():
+            warm.join(timeout=30.0)
         self.flush()
         if self._ckpt is not None:
             self._ckpt.close()
@@ -856,6 +898,23 @@ class TpuSketchExporter(Exporter):
             if self._reports:
                 faultinject.fire("sketch.window_publish")
             self._publish_queued()
+            self._maybe_refresh_query()
+
+    def _maybe_refresh_query(self) -> None:
+        """SKETCH_QUERY_REFRESH tick (timer thread). Disabled (the
+        default), this is one is-None check — the zero-cost bar. A refresh
+        failure is swallowed+counted; the next tick retries."""
+        nxt = getattr(self, "_next_refresh", None)
+        if nxt is None or self._closed.is_set() or time.monotonic() < nxt:
+            return
+        self._next_refresh = time.monotonic() + self._query_refresh_s
+        try:
+            self._refresh_query_snapshot()
+        except Exception as exc:
+            log.error("mid-window query refresh failed (will retry): %s",
+                      exc)
+            if self._metrics is not None:
+                self._metrics.count_error("tpu-sketch-query")
 
     # --- internals ---
     def _make_single_device_ring(self, feed: str, resident_slots: int,
@@ -959,7 +1018,7 @@ class TpuSketchExporter(Exporter):
             # factor back to 1 even if the feed went idle (no updates)
             self._overload.window_roll()
         with wtrace.stage("roll_dispatch"):
-            if self._delta_sink is not None:
+            if self._with_tables:
                 self._state, report, tables = self._roll(self._state)
             else:
                 self._state, report = self._roll(self._state)
@@ -1014,6 +1073,106 @@ class TpuSketchExporter(Exporter):
                 finally:
                     wtrace.finish()
 
+    def _render_report(self, report) -> dict:
+        """Render a device WindowReport with THIS exporter's thresholds."""
+        return report_to_json(
+            report, scan_fanout_threshold=self._scan_fanout,
+            ddos_z_threshold=self._ddos_z,
+            synflood_min=self._synflood_min,
+            synflood_ratio=self._synflood_ratio,
+            drop_z_threshold=self._drop_z,
+            asym_min_bytes=self._asym_min_bytes,
+            asym_ratio=self._asym_ratio)
+
+    def _publish_query_snapshot(self, obj: dict, tables,
+                                mid_window: bool = False) -> None:
+        """Swap in a fresh query snapshot (query/snapshot.py seq-stamps it).
+        The np.asarray touch is the device->host transfer of the CM planes
+        — per window (or per refresh), on the timer thread, never under
+        the exporter lock."""
+        snap = {
+            "window": obj["Window"],
+            "ts_ms": obj["TimestampMs"],
+            "report": obj,
+            "cm_bytes": (np.asarray(tables["cm_bytes"])
+                         if tables is not None else None),
+            "cm_pkts": (np.asarray(tables["cm_pkts"])
+                        if tables is not None else None),
+        }
+        self.query.publish(snap, mid_window=mid_window)
+
+    def query_status(self) -> dict:
+        """/query/status payload: snapshot freshness + plane counters.
+        Reads the publisher ONCE and derives seq/window/mid_window from
+        that same snapshot — stats() and a racing publish between two
+        reads would otherwise mix two snapshots' fields in one response
+        (the torn-read guarantee covers this route too)."""
+        snap = self.query.get()
+        st = self.query.stats()
+        st.update({"agent_id": self._agent_id,
+                   "window_s": self._window_s,
+                   "refresh_s": self._query_refresh_s,
+                   "overloaded": self.overloaded})
+        if snap is not None:
+            st.update({"published": True, "seq": snap["seq"],
+                       "window": snap["window"],
+                       "mid_window": snap["mid_window"]})
+            rep = snap["report"]
+            st.update({
+                "records": rep["Records"], "bytes": rep["Bytes"],
+                "distinct_src_estimate": rep["DistinctSrcEstimate"],
+                "drop_bytes": rep["DropBytes"],
+                "quic_records": rep["QuicRecords"],
+                "nat_records": rep["NatRecords"],
+                "rtt_quantiles_us": rep["RttQuantilesUs"],
+                "dns_latency_quantiles_us": rep["DnsLatencyQuantilesUs"],
+                "suspects": {
+                    "ddos": len(rep["DdosSuspectBuckets"]),
+                    "syn_flood": len(rep["SynFloodSuspectBuckets"]),
+                    "port_scan": len(rep["PortScanSuspectBuckets"]),
+                    "drop_storm": len(rep["DropAnomalyBuckets"]),
+                    "asym_conv": len(
+                        rep["AsymmetricConversationBuckets"])},
+            })
+        return st
+
+    def _refresh_query_snapshot(self) -> None:
+        """Mid-window refresh (SKETCH_QUERY_REFRESH): re-run the EXISTING
+        roll executable against a STAGED device-side copy of the live
+        state and publish its report + tables WITHOUT adopting the rolled
+        state — the live window keeps accumulating untouched. The copy is
+        load-bearing, not defensive: the mesh roll donates its input (the
+        single-device one does not), so rolling `self._state` directly
+        would delete the live buffers under the next fold (the federation
+        checkpoint staging pattern, aggregator.py). Only the copy happens
+        under the exporter lock; the roll dispatch, render, transfer and
+        publish all run OFF the lock on the timer thread. No new jitted
+        entry exists to retrace. The buffered sub-batch tail IS drained
+        first (the same padded fold the window close would dispatch —
+        additive merge semantics make the early fold invisible in the
+        window's final totals), so the refresh reflects every exported
+        row; the drain only ever runs with the refresh enabled, so the
+        disabled path keeps its exact fold sequence."""
+        import jax
+        import jax.numpy as jnp
+        with self._lock:
+            self._drain_pending_locked()
+            # the copy is donation protection, needed only on the mesh
+            # path; the single-device roll never donates, so the live
+            # reference is safe to roll directly — no HBM copy, shorter
+            # lock hold
+            staged = (jax.tree.map(jnp.copy, self._state)
+                      if self._distributed else self._state)
+        out = self._roll(staged)
+        if self._with_tables:
+            _discard, report, tables = out
+        else:
+            (_discard, report), tables = out, None
+        obj = self._render_report(report)
+        obj["TimestampMs"] = time.time_ns() // 1_000_000
+        faultinject.fire("sketch.query_snapshot")
+        self._publish_query_snapshot(obj, tables, mid_window=True)
+
     def _publish_report(self, report, wtrace=tracing.NULL_TRACE,
                         tables=None) -> None:
         if self._delta_sink is not None and tables is not None:
@@ -1051,15 +1210,22 @@ class TpuSketchExporter(Exporter):
             # includes the device->host transfer of the report arrays (the
             # first np.asarray touch) — deliberately not split out, so the
             # un-traced path never adds a blocking device sync
-            obj = report_to_json(
-                report, scan_fanout_threshold=self._scan_fanout,
-                ddos_z_threshold=self._ddos_z,
-                synflood_min=self._synflood_min,
-                synflood_ratio=self._synflood_ratio,
-                drop_z_threshold=self._drop_z,
-                asym_min_bytes=self._asym_min_bytes,
-                asym_ratio=self._asym_ratio)
+            obj = self._render_report(report)
         obj["TimestampMs"] = time.time_ns() // 1_000_000
+        # query-snapshot publish in its OWN try, BEFORE the sink: a failing
+        # publish (the sketch.query_snapshot fault point's job to prove)
+        # must never lose the window report, and a blocked sink must never
+        # delay query freshness. Per window, never per record.
+        try:
+            with wtrace.stage("query_snapshot"):
+                faultinject.fire("sketch.query_snapshot")
+                self._publish_query_snapshot(obj, tables)
+        except Exception as exc:
+            log.error("query snapshot publish failed (window report still "
+                      "publishes; /query serves the previous snapshot): %s",
+                      exc)
+            if self._metrics is not None:
+                self._metrics.count_error("tpu-sketch-query")
         with wtrace.stage("report_sink"):
             self._sink(obj)
         if self._metrics is not None:

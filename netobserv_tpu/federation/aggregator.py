@@ -687,43 +687,14 @@ class FederationAggregator:
     def query_frequency(self, src: str, dst: str, src_port: int = 0,
                         dst_port: int = 0, proto: int = 0) -> Optional[dict]:
         """CM point query with error bars against the last closed window's
-        MERGED tables — pure host numpy (the hashing twins), non-blocking."""
+        MERGED tables — delegated to the shared query core (pure host
+        numpy through the hashing twins, non-blocking)."""
         snap = self.snapshot()
         if snap is None:
             return None
-        from netobserv_tpu.model import binfmt
-        from netobserv_tpu.model.columnar import pack_key_words
-        from netobserv_tpu.model.flow import FlowKey
-        from netobserv_tpu.ops.hashing import base_hashes_multi_np
-
-        fk = FlowKey.make(src, dst, src_port, dst_port, proto)
-        karr = np.zeros(1, binfmt.FLOW_KEY_DTYPE)
-        karr["src_ip"][0] = np.frombuffer(fk.src_ip, np.uint8)
-        karr["dst_ip"][0] = np.frombuffer(fk.dst_ip, np.uint8)
-        karr["src_port"] = src_port
-        karr["dst_port"] = dst_port
-        karr["proto"] = proto
-        words = pack_key_words(karr)
-        h = base_hashes_multi_np(words)
-        cm = snap["cm_bytes"]
-        d, w = cm.shape
-        with np.errstate(over="ignore"):
-            idx = (h["h1"][0] + np.arange(d, dtype=np.uint32) * h["h2"][0]) \
-                & np.uint32(w - 1)
-        est_bytes = float(np.min(snap["cm_bytes"][np.arange(d), idx]))
-        est_pkts = float(np.min(snap["cm_pkts"][np.arange(d), idx]))
-        # Cormode–Muthukrishnan: overestimate <= (e/w)*N with prob 1-e^-d
-        n_bytes = float(np.sum(snap["cm_bytes"][0]))
-        n_pkts = float(np.sum(snap["cm_pkts"][0]))
-        eps = np.e / w
-        return {
-            "window": snap["window"],
-            "est_bytes": est_bytes,
-            "est_packets": est_pkts,
-            "overestimate_bound_bytes": eps * n_bytes,
-            "overestimate_bound_packets": eps * n_pkts,
-            "confidence": 1.0 - float(np.exp(-d)),
-        }
+        from netobserv_tpu.query import core as qcore
+        return qcore.frequency_payload(snap, src, dst, src_port, dst_port,
+                                       proto)
 
     # --- lifecycle ------------------------------------------------------
     def flush(self, timeout_s: Optional[float] = None) -> None:

@@ -1,5 +1,9 @@
 """Cluster-wide query surface: non-blocking HTTP over the aggregator.
 
+A thin adapter over the shared query core (`netobserv_tpu/query/core.py`)
+— the CM error-bar math and victim naming exist exactly ONCE, serving both
+this tier and the per-agent `/query/*` routes.
+
 Same off-hot-path rules as /debug/traces: every route reads the HOST-side
 snapshot the aggregator published at its last window roll (or pure-numpy
 math over it) — a request never dispatches a device op, takes the
@@ -26,6 +30,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
+
+from netobserv_tpu.query import core as qcore
 
 log = logging.getLogger("netobserv_tpu.federation.query")
 
@@ -71,43 +77,23 @@ class _Handler(BaseHTTPRequestHandler):
             if snap is None and path.startswith("/federation/"):
                 self._no_window()
                 return
-            report = snap["report"]
             # every snapshot-backed route carries the publish sequence
-            # number: the aggregator swaps whole snapshots atomically, so
-            # a reader seeing (seq, window, payload) from ONE dict can
-            # never observe a torn mix of two windows. seq is in-memory
-            # and restarts at 1 with the process; window-major ordering
-            # survives restarts only when FEDERATION_CHECKPOINT_DIR is
-            # set (pollers: compare (window, seq), and only across
-            # restarts of a checkpointed aggregator — see the smoke's
-            # poller)
-            seq = snap.get("seq", 0)
+            # number (stamped by the shared query core): the aggregator
+            # swaps whole snapshots atomically, so a reader seeing (seq,
+            # window, payload) from ONE dict can never observe a torn mix
+            # of two windows. seq is in-memory and restarts at 1 with the
+            # process; window-major ordering survives restarts only when
+            # FEDERATION_CHECKPOINT_DIR is set (pollers: compare
+            # (window, seq), and only across restarts of a checkpointed
+            # aggregator — see the smoke's poller)
             if path == "/federation/topk":
-                n = max(1, min(int(q.get("n", 100)), 1024))
-                self._json(200, {
-                    "window": snap["window"], "ts_ms": snap["ts_ms"],
-                    "seq": seq,
-                    "topk": report["HeavyHitters"][:n]})
+                self._json(200, qcore.topk_payload(snap, q.get("n", 100)))
                 return
             if path == "/federation/cardinality":
-                self._json(200, {
-                    "window": snap["window"], "ts_ms": snap["ts_ms"],
-                    "seq": seq,
-                    "distinct_src_estimate":
-                        report["DistinctSrcEstimate"],
-                    "records": report["Records"],
-                    "bytes": report["Bytes"]})
+                self._json(200, qcore.cardinality_payload(snap))
                 return
             if path == "/federation/victims":
-                self._json(200, {
-                    "window": snap["window"], "ts_ms": snap["ts_ms"],
-                    "seq": seq,
-                    "ddos": report["DdosSuspectBuckets"],
-                    "syn_flood": report["SynFloodSuspectBuckets"],
-                    "port_scan": report["PortScanSuspectBuckets"],
-                    "drop_storm": report["DropAnomalyBuckets"],
-                    "asym_conv":
-                        report["AsymmetricConversationBuckets"]})
+                self._json(200, qcore.victims_payload(snap))
                 return
             self.send_error(404)
         except Exception as exc:  # the query surface must keep answering

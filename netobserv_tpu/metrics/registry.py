@@ -217,6 +217,21 @@ class Metrics:
             "singles or a racing eviction — the one bounded double-count "
             "overload path, shared with the reference)",
             registry=self.registry)
+        # query plane (netobserv_tpu/query + the /query/* routes on the
+        # metrics server)
+        self.query_requests_total = Counter(
+            p + "query_requests_total",
+            "Agent query-surface requests by route (topk / frequency / "
+            "cardinality / victims / status) and result (ok / no_window / "
+            "bad_request / not_found / error)", ["route", "result"],
+            registry=self.registry)
+        self.query_snapshot_age_seconds = Gauge(
+            p + "query_snapshot_age_seconds",
+            "Seconds since the agent's query snapshot was last published "
+            "(resets at every window roll; with SKETCH_QUERY_REFRESH set "
+            "it also resets at each mid-window refresh — growth past the "
+            "window period means the publish path is failing)",
+            registry=self.registry)
         self.sketch_window_records = Gauge(
             p + "sketch_window_records", "Flow records in the last window",
             registry=self.registry)

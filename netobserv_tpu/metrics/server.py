@@ -1,4 +1,5 @@
-"""Async /metrics HTTP server with optional TLS, plus /healthz and /readyz.
+"""Async /metrics HTTP server with optional TLS, plus /healthz, /readyz and
+the agent query surface (/query/*).
 
 Reference analog: `pkg/prometheus/prom_server.go:27-70` (TLS1.3 minimum when
 certs are configured) and the hardened defaults in `pkg/server/common.go`.
@@ -25,6 +26,13 @@ resolution for stability — pulling it out of rotation would shift the
 same load onto its peers and cascade. Orchestrators that want to act on
 it read the JSON body (or the ``sketch_shed_factor`` gauge), which also
 carries the controller's live state under ``conditions.overloaded``.
+
+Query surface: when a ``query_routes`` handler is supplied
+(`netobserv_tpu/query/routes.py`, wired by the tpu-sketch exporter), the
+server additionally answers ``/query/topk|frequency|cardinality|victims|
+status`` against the agent's published window snapshot — host-side only,
+same off-hot-path rules as /debug/traces (docs/architecture.md
+"Query plane").
 """
 
 from __future__ import annotations
@@ -35,6 +43,7 @@ import ssl
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
 
 from prometheus_client import CollectorRegistry, generate_latest
 from prometheus_client.exposition import CONTENT_TYPE_LATEST
@@ -56,11 +65,15 @@ _LIVE_STATUSES = ("NotStarted", "Starting", "Started", "Degraded",
 class _Handler(BaseHTTPRequestHandler):
     registry: CollectorRegistry = None  # set per-server subclass
     health_source: Optional[HealthSource] = None
+    query_routes = None  # netobserv_tpu.query.routes.QueryRoutes
 
     def do_GET(self):  # noqa: N802 - http.server API
         path = self.path.split("?")[0]
         if path in ("/healthz", "/readyz"):
             self._serve_health(path)
+            return
+        if path == "/query" or path.startswith("/query/"):
+            self._serve_query()
             return
         if path not in ("/metrics", "/"):
             self.send_error(404)
@@ -68,6 +81,24 @@ class _Handler(BaseHTTPRequestHandler):
         payload = generate_latest(self.registry)
         self.send_response(200)
         self.send_header("Content-Type", CONTENT_TYPE_LATEST)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _serve_query(self) -> None:
+        """/query/* — the agent query plane (netobserv_tpu/query). All the
+        route/param logic lives in QueryRoutes so the federation surface
+        and tests share it; this method only speaks HTTP."""
+        if self.query_routes is None:
+            self.send_error(404, explain="no query source configured "
+                            "(EXPORT=tpu-sketch serves one)")
+            return
+        url = urlparse(self.path)
+        params = {k: v[0] for k, v in parse_qs(url.query).items()}
+        code, obj = self.query_routes.handle(url.path, params)
+        payload = json.dumps(obj, separators=(",", ":")).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
@@ -102,6 +133,7 @@ def start_metrics_server(registry: CollectorRegistry, address: str = "",
                          port: int = 9090, tls_cert_path: str = "",
                          tls_key_path: str = "",
                          health_source: Optional[HealthSource] = None,
+                         query_routes=None,
                          ) -> ThreadingHTTPServer:
     """Start the exposition server on a daemon thread; returns the server
     (call .shutdown() to stop)."""
@@ -111,6 +143,7 @@ def start_metrics_server(registry: CollectorRegistry, address: str = "",
     # bound methods like FlowsAgent.health_snapshot pass through unchanged
     handler = type("Handler", (_Handler,),
                    {"registry": registry,
+                    "query_routes": query_routes,
                     "health_source": (staticmethod(health_source)
                                       if health_source is not None
                                       else None)})

@@ -1,0 +1,18 @@
+"""Shared sketch query plane (jax-free).
+
+One query core serves BOTH tiers: the per-agent `/query/*` routes on the
+metrics server read the snapshot the tpu-sketch exporter publishes at every
+window roll (plus the optional `SKETCH_QUERY_REFRESH` mid-window refresh),
+and the central aggregator's `/federation/*` routes (`federation/query.py`)
+read the snapshot it publishes at each cluster roll. Every answer is pure
+host-side numpy over an immutable snapshot dict — a query never dispatches
+a device op, takes an ingest lock, or waits on anything the fold path
+needs (the /debug/traces off-hot-path rules).
+"""
+
+from netobserv_tpu.query.core import (  # noqa: F401
+    cardinality_payload, frequency_payload, topk_payload, victim_bucket_names,
+    victims_payload,
+)
+from netobserv_tpu.query.routes import QueryRoutes  # noqa: F401
+from netobserv_tpu.query.snapshot import SnapshotPublisher  # noqa: F401

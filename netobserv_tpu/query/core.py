@@ -1,0 +1,127 @@
+"""The ONE implementation of sketch query math, shared by the agent and
+federation query surfaces (jax-free: numpy + the `ops/hashing` numpy twins
+only, so it runs on accelerator-less hosts and never blocks on a device).
+
+All functions operate on an immutable host-side **snapshot dict** published
+at a window boundary:
+
+- ``window``   int — the closed (or live, for a mid-window refresh) window id
+- ``ts_ms``    int — publish wall time
+- ``seq``      int — monotonically increasing publish sequence (the
+                torn-read guard: snapshots swap as WHOLE dicts, so any
+                reader holding one sees a single window's consistent view;
+                pollers order responses by ``(window, seq)``)
+- ``report``   dict — the rendered window report (`report_to_json` shape)
+- ``cm_bytes``/``cm_pkts`` — f32[depth, width] Count-Min planes, or None
+                when the deployment has no whole-width snapshot
+                (width-sharded meshes)
+
+The CM error-bar math (Cormode–Muthukrishnan) and the victim-bucket naming
+(DST_BUCKET_SEED via `ops/hashing`, never inlined) live ONLY here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def victim_bucket_names(heavy_words: np.ndarray, heavy: list[dict],
+                        n_buckets: int) -> dict[int, list]:
+    """Best-effort victim names: heavy-hitter addresses hashed into the same
+    EWMA victim buckets the anomaly signals use (numpy hash twin — naming
+    must never dispatch a device op). BOTH directions name a victim: its
+    inbound traffic buckets via the dst words, its outbound (e.g. a flooded
+    server still serving) via the src words — the device folds both into one
+    bucket family (state.py src_sym/dst_h1 share DST_BUCKET_SEED). Spoofed
+    floods' own flows rarely make the heavy table, but the victim's
+    legitimate traffic does.
+
+    `heavy_words` are the (n, KEY_WORDS) packed key words of exactly the
+    rows rendered into `heavy` (same order)."""
+    from netobserv_tpu.ops.hashing import DST_BUCKET_SEED, hash_words_np
+
+    names: dict[int, list] = {}
+    if not len(heavy):
+        return names
+    for cols, field in ((heavy_words[:, 4:8], "DstAddr"),
+                        (heavy_words[:, 0:4], "SrcAddr")):
+        buckets = hash_words_np(cols, seed=DST_BUCKET_SEED) & (n_buckets - 1)
+        for j, b in enumerate(buckets):
+            lst = names.setdefault(int(b), [])
+            if len(lst) < 3 and heavy[j][field] not in lst:
+                lst.append(heavy[j][field])
+    return names
+
+
+def _stamp(snap: dict, payload: dict) -> dict:
+    """Prefix every snapshot-backed payload with the (window, ts_ms, seq)
+    triple pollers order by."""
+    return {"window": snap["window"], "ts_ms": snap["ts_ms"],
+            "seq": snap.get("seq", 0), **payload}
+
+
+def topk_payload(snap: dict, n: int = 100) -> dict:
+    n = max(1, min(int(n), 1024))
+    return _stamp(snap, {"topk": snap["report"]["HeavyHitters"][:n]})
+
+
+def cardinality_payload(snap: dict) -> dict:
+    report = snap["report"]
+    return _stamp(snap, {
+        "distinct_src_estimate": report["DistinctSrcEstimate"],
+        "records": report["Records"],
+        "bytes": report["Bytes"]})
+
+
+def victims_payload(snap: dict) -> dict:
+    report = snap["report"]
+    return _stamp(snap, {
+        "ddos": report["DdosSuspectBuckets"],
+        "syn_flood": report["SynFloodSuspectBuckets"],
+        "port_scan": report["PortScanSuspectBuckets"],
+        "drop_storm": report["DropAnomalyBuckets"],
+        "asym_conv": report["AsymmetricConversationBuckets"]})
+
+
+def frequency_payload(snap: dict, src: str, dst: str, src_port: int = 0,
+                      dst_port: int = 0, proto: int = 0) -> Optional[dict]:
+    """CM point query with error bars against the snapshot's merged planes —
+    pure host numpy through the hashing twins. Returns None when the
+    snapshot carries no whole-width CM planes (width-sharded mesh)."""
+    cm = snap.get("cm_bytes")
+    cm_pkts = snap.get("cm_pkts")
+    if cm is None or cm_pkts is None:
+        return None
+    from netobserv_tpu.model import binfmt
+    from netobserv_tpu.model.columnar import pack_key_words
+    from netobserv_tpu.model.flow import FlowKey
+    from netobserv_tpu.ops.hashing import base_hashes_multi_np
+
+    fk = FlowKey.make(src, dst, src_port, dst_port, proto)
+    karr = np.zeros(1, binfmt.FLOW_KEY_DTYPE)
+    karr["src_ip"][0] = np.frombuffer(fk.src_ip, np.uint8)
+    karr["dst_ip"][0] = np.frombuffer(fk.dst_ip, np.uint8)
+    karr["src_port"] = src_port
+    karr["dst_port"] = dst_port
+    karr["proto"] = proto
+    words = pack_key_words(karr)
+    h = base_hashes_multi_np(words)
+    d, w = cm.shape
+    with np.errstate(over="ignore"):
+        idx = (h["h1"][0] + np.arange(d, dtype=np.uint32) * h["h2"][0]) \
+            & np.uint32(w - 1)
+    est_bytes = float(np.min(cm[np.arange(d), idx]))
+    est_pkts = float(np.min(cm_pkts[np.arange(d), idx]))
+    # Cormode–Muthukrishnan: overestimate <= (e/w)*N with prob 1-e^-d
+    n_bytes = float(np.sum(cm[0]))
+    n_pkts = float(np.sum(cm_pkts[0]))
+    eps = np.e / w
+    return _stamp(snap, {
+        "est_bytes": est_bytes,
+        "est_packets": est_pkts,
+        "overestimate_bound_bytes": eps * n_bytes,
+        "overestimate_bound_packets": eps * n_pkts,
+        "confidence": 1.0 - float(np.exp(-d)),
+    })

@@ -1,0 +1,98 @@
+"""Route handler for the agent's `/query/*` surface.
+
+HTTP-host-agnostic: the metrics server (`metrics/server.py`) hands parsed
+``(path, params)`` in and writes the returned ``(status, json-able)`` out,
+and tests can drive the routes without a socket. Every request is counted
+in ``query_requests_total{route, result}``; every answer reads only the
+published snapshot (`query/snapshot.py`) — never a device op, never an
+exporter lock.
+
+Routes (all GET, JSON):
+
+- /query/topk          this agent's heavy hitters (?n= caps the list)
+- /query/frequency     CM estimate + error bars for one 5-tuple
+                       (?src=&dst=&src_port=&dst_port=&proto=)
+- /query/cardinality   distinct-source estimate + window totals
+- /query/victims       suspect buckets per signal with victim names
+- /query/status        snapshot freshness + plane counters
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from netobserv_tpu.query import core
+
+log = logging.getLogger("netobserv_tpu.query")
+
+ROUTES = ("topk", "frequency", "cardinality", "victims", "status")
+
+
+class QueryRoutes:
+    """Dispatch `/query/<route>` requests against a snapshot source.
+
+    `snapshot_fn` returns the published snapshot dict (or None);
+    `status_fn` returns the freshness/counters dict for /query/status.
+    """
+
+    def __init__(self, snapshot_fn: Callable[[], Optional[dict]],
+                 status_fn: Callable[[], dict], metrics=None):
+        self._snapshot = snapshot_fn
+        self._status = status_fn
+        self._metrics = metrics
+
+    def index(self) -> dict:
+        return {"routes": [f"/query/{r}" for r in ROUTES]}
+
+    def handle(self, path: str, params: dict) -> tuple[int, dict]:
+        """`path` is the URL path (e.g. "/query/topk"), `params` the parsed
+        single-valued query dict. Returns (http status, JSON-able body)."""
+        route = path.rstrip("/").rpartition("/")[2] or "index"
+        try:
+            code, body = self._dispatch(route, params)
+        except ValueError as exc:  # malformed params (e.g. ?n=bogus)
+            code, body = 400, {"error": str(exc)}
+        except Exception as exc:  # the query surface must keep answering
+            log.error("query route %s failed: %s", path, exc)
+            code, body = 500, {"error": str(exc)}
+        self._count(route, code)
+        return code, body
+
+    def _count(self, route: str, code: int) -> None:
+        if self._metrics is None:
+            return
+        result = ("ok" if code == 200 else
+                  "no_window" if code == 503 else
+                  "bad_request" if code == 400 else
+                  "not_found" if code == 404 else "error")
+        self._metrics.query_requests_total.labels(route, result).inc()
+
+    def _dispatch(self, route: str, params: dict) -> tuple[int, dict]:
+        if route in ("index", "query"):
+            return 200, self.index()
+        if route not in ROUTES:
+            return 404, {"error": f"unknown query route {route!r}",
+                         **self.index()}
+        if route == "status":
+            return 200, self._status()
+        snap = self._snapshot()
+        if snap is None:
+            return 503, {"error": "no window published yet"}
+        if route == "topk":
+            return 200, core.topk_payload(snap, params.get("n", 100))
+        if route == "cardinality":
+            return 200, core.cardinality_payload(snap)
+        if route == "victims":
+            return 200, core.victims_payload(snap)
+        # frequency
+        if not params.get("src") or not params.get("dst"):
+            return 400, {"error": "src and dst are required"}
+        out = core.frequency_payload(
+            snap, params["src"], params["dst"],
+            int(params.get("src_port", 0)), int(params.get("dst_port", 0)),
+            int(params.get("proto", 0)))
+        if out is None:
+            return 503, {"error": "no whole-width CM snapshot on this "
+                                  "deployment (width-sharded mesh)"}
+        return 200, out

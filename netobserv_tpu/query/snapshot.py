@@ -1,0 +1,71 @@
+"""Agent-side query snapshot publisher (the federation poller pattern).
+
+The tpu-sketch exporter publishes one snapshot per window roll (and,
+optionally, mid-window refreshes) from the supervised timer thread. Readers
+— the metrics server's `/query/*` routes — call :meth:`get` from arbitrary
+HTTP threads. Torn reads are impossible by construction: a publish builds a
+FRESH dict, stamps it with the next ``seq`` under the lock, and swaps the
+whole reference; a reader holding a snapshot therefore always sees one
+window's internally consistent view, and pollers detect ordering by
+``(window, seq)`` exactly like the federation smoke's poller.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class SnapshotPublisher:
+    """Thread-safe single-slot snapshot store with a publish sequence."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snap: Optional[dict] = None
+        self._seq = 0
+        self._published = 0
+        self._refreshes = 0
+        # age is measured from construction until the first publish so the
+        # gauge reads "how stale is the queryable view" even before any
+        # window closed
+        self._last_pub_mono = time.monotonic()
+
+    def publish(self, snap: dict, mid_window: bool = False) -> int:
+        """Stamp `snap` with the next seq and swap it in. `snap` must be a
+        fresh dict the caller never mutates afterwards."""
+        with self._lock:
+            self._seq += 1
+            snap["seq"] = self._seq
+            snap["mid_window"] = bool(mid_window)
+            self._snap = snap
+            self._published += 1
+            if mid_window:
+                self._refreshes += 1
+            self._last_pub_mono = time.monotonic()
+            return self._seq
+
+    def get(self) -> Optional[dict]:
+        """The last published snapshot (None before the first publish)."""
+        with self._lock:
+            return self._snap
+
+    def age_s(self) -> float:
+        """Seconds since the last publish (since construction when none) —
+        the `query_snapshot_age_seconds` gauge source."""
+        with self._lock:
+            return max(0.0, time.monotonic() - self._last_pub_mono)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "published": self._snap is not None,
+                "seq": self._seq,
+                "window": None if self._snap is None
+                else self._snap["window"],
+                "mid_window": bool(self._snap and self._snap["mid_window"]),
+                "snapshots_published": self._published,
+                "mid_window_refreshes": self._refreshes,
+                "snapshot_age_s": round(
+                    max(0.0, time.monotonic() - self._last_pub_mono), 3),
+            }

@@ -1,0 +1,12 @@
+"""Adversarial traffic scenario zoo (ROADMAP "Scenario zoo + live query
+surface"): deterministic pcap generators plus a full-agent replay runner
+that grades detection QUALITY — top-K recall, flood-ratio alarms, victim
+naming, HLL cardinality bounds, DNS-latency spikes, QUIC markers — through
+the agent's live `/query/*` routes, not by peeking at internals.
+
+- `zoo.SCENARIOS` — name -> builder(path) -> ground-truth dict
+- `runner.run_scenario(name, workdir)` — replay + grade one scenario
+- `runner.evaluate(truth, observations)` — the grading logic alone
+"""
+
+from netobserv_tpu.scenarios.zoo import SCENARIOS, SIGNALS  # noqa: F401

@@ -1,0 +1,277 @@
+"""Scenario runner: replay one zoo pcap through a FULL in-process agent and
+grade detection quality through the live `/query/*` HTTP routes.
+
+The pipeline under test is the real one — PcapReplayFetcher -> MapTracer ->
+CapacityLimiter -> QueueExporter -> TpuSketchExporter (columnar fast path,
+resident feed) -> window roll -> query snapshot -> metrics-server HTTP —
+with the supervisor running and the mid-window refresh enabled, so every
+scenario also exercises "the query plane answers during sustained ingest".
+
+Used by tests/test_scenarios.py (one fast smoke in tier-1, the full zoo in
+the slow tier) and `bench.py --scenarios` (the per-scenario quality
+artifact)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from netobserv_tpu.scenarios.zoo import SCENARIOS, SIGNALS
+
+log = logging.getLogger("netobserv_tpu.scenarios")
+
+#: one shared detection config for the WHOLE zoo — floods must fire and
+#: benign mixes stay quiet under the SAME thresholds, or the assertions
+#: prove nothing
+THRESHOLDS = dict(
+    synflood_min=64,
+    synflood_ratio=8.0,
+    scan_fanout_threshold=256,
+    asym_min_bytes=2048,
+    asym_ratio=0.95,
+)
+
+
+def _sketch_cfg():
+    from netobserv_tpu.sketch.state import SketchConfig
+    # compile-friendly but honest geometry (width >= 16*topk, the
+    # documented precision floor)
+    return SketchConfig(cm_depth=4, cm_width=16384, hll_precision=12,
+                        topk=256)
+
+
+def run_scenario(name: str, workdir: str, window_s: float = 600.0,
+                 evict_s: float = 0.25, query_refresh_s: float = 0.5,
+                 deadline_s: float = 240.0) -> dict:
+    """Build the scenario pcap, run the agent over it, poll /query/* while
+    the window is LIVE, and return the graded quality dict.
+
+    The window deliberately outlives the replay (a one-shot pcap's data
+    window would otherwise be queryable only until the next roll swapped in
+    an empty one): the mid-window refresh serves the ACCUMULATING live
+    window — the "query plane answers during sustained ingest" claim — and
+    the agent's shutdown flush closes the window, publishing the final
+    ROLL snapshot, which is graded too."""
+    from netobserv_tpu.agent.agent import FlowsAgent
+    from netobserv_tpu.config import AgentConfig
+    from netobserv_tpu.datapath.replay import PcapReplayFetcher
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+    from netobserv_tpu.metrics.registry import Metrics
+    from netobserv_tpu.metrics.server import start_metrics_server
+    from netobserv_tpu.utils import retrace
+
+    build = SCENARIOS[name]
+    pcap = os.path.join(workdir, f"{name}.pcap")
+    truth = build(pcap)
+
+    cfg = AgentConfig(export="tpu-sketch", cache_active_timeout=evict_s)
+    metrics = Metrics()
+    # one replay window: every scenario keeps its packets inside the
+    # virtual 5s span, so the whole pcap lands in ONE eviction and
+    # therefore ONE sketch window — deterministic per-window assertions
+    fetcher = PcapReplayFetcher(pcap, window_s=5.0)
+    if not query_refresh_s:
+        raise ValueError("the scenario runner grades the LIVE window "
+                         "through mid-window refreshes; query_refresh_s "
+                         "must be > 0")
+    exporter = TpuSketchExporter(
+        batch_size=512, window_s=window_s, sketch_cfg=_sketch_cfg(),
+        metrics=metrics, sink=lambda obj: None,
+        query_refresh_s=query_refresh_s,
+        ddos_z_threshold=6.0, drop_z_threshold=6.0, **THRESHOLDS)
+    agent = FlowsAgent(cfg, fetcher, exporter, metrics=metrics)
+    srv = start_metrics_server(metrics.registry, port=0,
+                               health_source=agent.health_snapshot,
+                               query_routes=agent.query_routes)
+    port = srv.server_address[1]
+    retraces_before = retrace.total_retraces()
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    stop = threading.Event()
+    t = threading.Thread(target=agent.run, args=(stop,), daemon=True)
+    t.start()
+
+    observations: list[dict] = []
+    freq_obs: list[dict] = []
+    min_records = truth.get("min_records", 1)
+    probe = truth.get("frequency_probe")
+
+    def observe() -> dict:
+        """One full /query/* round against the current snapshot; probes
+        frequency once the data window surfaced."""
+        obs: dict = {}
+        code, status = get("/query/status")
+        if code == 200:
+            obs["status"] = status
+        for route in ("topk?n=64", "victims", "cardinality"):
+            c, body = get(f"/query/{route}")
+            if c == 200:
+                obs[route.split("?")[0]] = body
+        records = obs.get("cardinality", {}).get("records", 0)
+        if probe is not None and records >= min_records:
+            c, f = get("/query/frequency?src={SrcAddr}&dst={DstAddr}"
+                       "&src_port={SrcPort}&dst_port={DstPort}"
+                       "&proto={Proto}".format(**probe))
+            if c == 200:
+                freq_obs.append(f)
+        observations.append(obs)
+        return obs
+
+    seen_seq, live_data_obs = 0, 0
+    deadline = time.monotonic() + deadline_s
+    try:
+        # phase 1: poll the LIVE window through the mid-window refreshes
+        # until the whole pcap is folded AND a couple more refresh
+        # snapshots answered over it (sustained-ingest answering)
+        while time.monotonic() < deadline and live_data_obs < 3:
+            code, status = get("/query/status")
+            if code == 200 and status.get("seq", 0) > seen_seq:
+                seen_seq = status["seq"]
+                obs = observe()
+                if (obs.get("cardinality", {}).get("records", 0)
+                        >= min_records and fetcher.exhausted()):
+                    live_data_obs += 1
+            time.sleep(0.1)
+    finally:
+        stop.set()
+        t.join(timeout=60)
+    # phase 2: the agent's shutdown flush closed the window and published
+    # the final ROLL snapshot (mid_window=False) — grade that one too
+    try:
+        if t.is_alive():
+            log.error("agent did not stop within 60s")
+        else:
+            final = observe()
+            if final.get("status", {}).get("mid_window", True):
+                log.warning("final snapshot is still a mid-window refresh "
+                            "(shutdown flush did not publish a roll?)")
+    finally:
+        srv.shutdown()
+    retraces = retrace.total_retraces() - retraces_before
+    return evaluate(truth, observations, freq_obs, retraces=retraces)
+
+
+def evaluate(truth: dict, observations: list[dict],
+             freq_obs: list[dict] | None = None,
+             retraces: int = 0) -> dict:
+    """Grade collected /query/* observations against the ground truth.
+    Returns {"name", "passed", "failures": [...], ...quality metrics}."""
+    failures: list[str] = []
+    out: dict = {"name": truth.get("name", "?"), "retraces": retraces,
+                 "windows_observed": len(
+                     {o["status"].get("window") for o in observations
+                      if "status" in o})}
+    data = [o for o in observations
+            if o.get("cardinality", {}).get("records", 0)
+            >= truth.get("min_records", 1)]
+    if not data:
+        failures.append("the data window never surfaced through /query/*")
+        out.update(passed=False, failures=failures)
+        return out
+
+    # --- heavy-hitter recall (through /query/topk) ---
+    if truth.get("heavy"):
+        want = {(h["SrcAddr"], h["DstAddr"], h["SrcPort"], h["DstPort"],
+                 h["Proto"]) for h in truth["heavy"]}
+        best = 0.0
+        for o in data:
+            top = o.get("topk", {}).get("topk", [])[:truth["topk_n"]]
+            got = {(e["SrcAddr"], e["DstAddr"], e["SrcPort"], e["DstPort"],
+                    e["Proto"]) for e in top}
+            best = max(best, len(want & got) / len(want))
+        out["topk_recall"] = best
+        if best < truth.get("min_recall", 0.9):
+            failures.append(
+                f"top-{truth['topk_n']} recall {best:.2f} < "
+                f"{truth.get('min_recall', 0.9)}")
+
+    # --- alarms: expected must fire in a data window, quiet must stay
+    # silent in EVERY observed window (including mid-window refreshes) ---
+    fired = {sig: any(o.get("victims", {}).get(sig) for o in data)
+             for sig in SIGNALS}
+    out["alarms_fired"] = sorted(s for s, f in fired.items() if f)
+    for sig in truth.get("expect_alarms", ()):
+        if not fired[sig]:
+            failures.append(f"expected {sig} alarm never fired")
+    for sig in truth.get("quiet_alarms", ()):
+        if any(o.get("victims", {}).get(sig) for o in observations):
+            failures.append(f"{sig} alarm fired on a benign signal")
+
+    # --- victim naming ---
+    if truth.get("victim"):
+        sig = truth["victim_signal"]
+        named = any(
+            truth["victim"] in b.get("probable_victims", ())
+            for o in data for b in o.get("victims", {}).get(sig, ()))
+        out["victim_named"] = named
+        if not named:
+            failures.append(
+                f"victim {truth['victim']} not named in {sig} buckets")
+
+    # --- cardinality within HLL bounds ---
+    if truth.get("distinct_src"):
+        est = max(o["cardinality"]["distinct_src_estimate"] for o in data)
+        rel = abs(est - truth["distinct_src"]) / truth["distinct_src"]
+        out["distinct_src_est"] = est
+        out["distinct_src_err"] = round(rel, 4)
+        if rel > truth.get("distinct_tol", 0.2):
+            failures.append(
+                f"distinct-src estimate {est:.0f} off ground truth "
+                f"{truth['distinct_src']} by {rel:.1%}")
+
+    # --- DNS latency spike (through /query/status quantiles) ---
+    if truth.get("dns_p50_min_us"):
+        p50 = max(float(o["status"]["dns_latency_quantiles_us"]["0.5"])
+                  for o in data if "dns_latency_quantiles_us" in o["status"])
+        out["dns_p50_us"] = p50
+        if p50 < truth["dns_p50_min_us"]:
+            failures.append(
+                f"dns latency p50 {p50:.0f}us below the injected spike "
+                f"({truth['dns_p50_min_us']}us)")
+
+    # --- QUIC marker plumbing ---
+    if truth.get("quic_min_records"):
+        quic = max(float(o["status"].get("quic_records", 0)) for o in data)
+        out["quic_records"] = quic
+        if quic < truth["quic_min_records"]:
+            failures.append(
+                f"QuicRecords {quic:.0f} < {truth['quic_min_records']}")
+
+    # --- CM frequency error-bar contract (through /query/frequency) ---
+    if truth.get("frequency_probe") is not None:
+        if not freq_obs:
+            failures.append("frequency probe never answered on the "
+                            "data window")
+        else:
+            true_b = truth["frequency_probe"]["true_bytes"]
+            best = min(freq_obs, key=lambda f: f["est_bytes"])
+            out["frequency_est_bytes"] = best["est_bytes"]
+            out["frequency_true_bytes"] = true_b
+            # CM never underestimates; the overestimate stays within the
+            # advertised (e/w)*N bound (float32 rounding slack)
+            if best["est_bytes"] < true_b * 0.999:
+                failures.append(
+                    f"CM estimate {best['est_bytes']:.0f} underestimates "
+                    f"true {true_b}")
+            bound = best["overestimate_bound_bytes"]
+            if best["est_bytes"] > true_b + bound + true_b * 0.001:
+                failures.append(
+                    f"CM estimate {best['est_bytes']:.0f} exceeds true "
+                    f"{true_b} + stated bound {bound:.0f}")
+
+    if retraces:
+        failures.append(f"{retraces} post-warmup retraces during the run")
+    out.update(passed=not failures, failures=failures)
+    return out

@@ -346,13 +346,28 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     else:
         hll_src = hll.update(state.hll_src, src_h1, src_h2, valid)
     per_dst = hll.update_per_dst(state.hll_per_dst, dst_h1, src_h1, src_h2, valid)
+    flags = arrays.get("tcp_flags")
     if enable_fanout:
         # port-scan signal: distinct (dst addr, dst port) fan-out per SOURCE
         # bucket — a scanner touches many; a normal client few. The (dst,
         # port) hashes come from the shared multi-hash sweep above (seed:
-        # hashing.DSTPORT_FANOUT_SEED)
+        # hashing.DSTPORT_FANOUT_SEED). Only INITIATOR-side flows count:
+        # a flow that sent SYN+ACK together (the TcpFlags.SYN_ACK
+        # composite) is a RESPONDER — without the gate a server answering
+        # one NAT'd client churning through hundreds of source ports
+        # sweeps hundreds of distinct (addr, port) pairs and lights the
+        # grid (the nat_churn scenario). Initiators count whether the
+        # handshake completed or not (SYN with or without a later ACK),
+        # so both lone-SYN and full-connect scans fire; flows with no
+        # SYN-side evidence at all (non-TCP rows, mid-capture sessions:
+        # flags without SYN) keep the pre-gate behavior only when they
+        # are not responders.
+        fanout_valid = valid
+        if flags is not None:
+            f32 = flags.astype(jnp.int32)
+            fanout_valid = valid & ((f32 & TcpFlags.SYN_ACK) == 0)
         per_src = hll.update_per_dst(state.hll_per_src, src_h1, mhash.dp_h1,
-                                     mhash.dp_h2, valid)
+                                     mhash.dp_h2, fanout_valid)
     else:
         per_src = state.hll_per_src
     rtt = arrays["rtt_us"]
@@ -371,8 +386,7 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     # victim-bucket hash the SYN-ACK side needs.
     src_sym = mhash.src_sym
     mass = factor.astype(jnp.float32) if samp is not None else 1.0
-    flags = arrays.get("tcp_flags")
-    if flags is not None:
+    if flags is not None:  # read above, at the fan-out gate
         # SYN-flood: half-open attempts (SYN seen, never ACKed — a spoofed
         # flood leaves one such record per probe) bucket by victim = dst;
         # SYN-ACK response flows bucket by victim = src (the responder),

@@ -1,0 +1,156 @@
+"""Scenario zoo (netobserv_tpu/scenarios): deterministic pcap generators +
+the full-agent replay runner grading detection quality through the live
+`/query/*` HTTP routes.
+
+Tiering (docs/architecture.md "Test tiering"): the generators and the
+grading logic are plain-python fast tests; ONE full end-to-end scenario
+(syn_flood — the cheapest pcap with the strongest assertion set: alarm
+fires, victim named, cardinality bounded) runs in tier-1 as the smoke; the
+remaining five scenarios are `slow` (each spins a full agent + metrics
+server + compile-heavy sketch mesh path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from netobserv_tpu.scenarios.runner import evaluate, run_scenario
+from netobserv_tpu.scenarios.zoo import SCENARIOS, SIGNALS
+
+
+# --- generators: determinism + ground-truth shape -----------------------
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_pcap_is_deterministic(name, tmp_path):
+    """Built twice -> byte-identical pcap and identical truth (assertions
+    must never chase RNG noise)."""
+    build = SCENARIOS[name]
+    t1 = build(str(tmp_path / "a.pcap"))
+    t2 = build(str(tmp_path / "b.pcap"))
+    d1 = hashlib.sha256((tmp_path / "a.pcap").read_bytes()).hexdigest()
+    d2 = hashlib.sha256((tmp_path / "b.pcap").read_bytes()).hexdigest()
+    assert d1 == d2
+    assert t1 == t2
+    assert t1["name"] == name
+    assert t1.get("min_records", 0) > 0
+    # every alarm key a scenario names must be a real /query/victims signal
+    for sig in (*t1.get("expect_alarms", ()), *t1.get("quiet_alarms", ())):
+        assert sig in SIGNALS
+
+
+def test_zoo_covers_fire_and_quiet_for_every_signal():
+    """The zoo proves both directions: each of the three targeted attack
+    signals fires somewhere, and EVERY signal has at least one scenario
+    asserting it stays quiet."""
+    truths = [SCENARIOS[n](str(p)) for n, p in
+              ((n, f"/dev/null") for n in sorted(SCENARIOS))]
+    fired = {s for t in truths for s in t.get("expect_alarms", ())}
+    quiet = {s for t in truths for s in t.get("quiet_alarms", ())}
+    assert {"syn_flood", "port_scan", "asym_conv"} <= fired
+    assert quiet == set(SIGNALS)
+
+
+# --- the grading logic alone (no agent) ---------------------------------
+
+def _obs(records=500.0, topk=(), victims=None, distinct=10.0):
+    return {
+        "status": {"window": 0, "seq": 1},
+        "topk": {"topk": list(topk)},
+        "victims": victims or {s: [] for s in SIGNALS},
+        "cardinality": {"records": records, "bytes": 1.0,
+                        "distinct_src_estimate": distinct},
+    }
+
+
+def test_evaluate_requires_a_data_window():
+    out = evaluate({"name": "x", "min_records": 100}, [_obs(records=5.0)])
+    assert not out["passed"]
+    assert "never surfaced" in out["failures"][0]
+
+
+def test_evaluate_alarm_directions():
+    truth = {"name": "x", "min_records": 1,
+             "expect_alarms": ["syn_flood"], "quiet_alarms": ["port_scan"]}
+    quiet = {s: [] for s in SIGNALS}
+    firing = dict(quiet, syn_flood=[{"bucket": 1, "probable_victims": []}])
+    assert evaluate(truth, [_obs(victims=firing)])["passed"]
+    # expected alarm missing
+    out = evaluate(truth, [_obs(victims=quiet)])
+    assert any("never fired" in f for f in out["failures"])
+    # quiet alarm firing — even in a NON-data observation
+    noisy = dict(quiet, port_scan=[{"bucket": 2}])
+    out = evaluate(truth, [_obs(victims=firing),
+                           _obs(records=0.0, victims=noisy)])
+    assert any("benign" in f for f in out["failures"])
+
+
+def test_evaluate_topk_recall_and_victim_naming():
+    heavy = [{"SrcAddr": "1.1.1.1", "DstAddr": "2.2.2.2", "SrcPort": 1,
+              "DstPort": 443, "Proto": 6}]
+    truth = {"name": "x", "min_records": 1, "heavy": heavy, "topk_n": 4,
+             "min_recall": 0.9, "victim": "2.2.2.2",
+             "victim_signal": "syn_flood"}
+    hit = dict(heavy[0], EstBytes=9.0)
+    victims = {s: [] for s in SIGNALS}
+    victims["syn_flood"] = [
+        {"bucket": 7, "probable_victims": ["2.2.2.2"]}]
+    out = evaluate(truth, [_obs(topk=[hit], victims=victims)])
+    assert out["passed"] and out["topk_recall"] == 1.0 and out["victim_named"]
+    out = evaluate(truth, [_obs(topk=[], victims=victims)])
+    assert not out["passed"] and out["topk_recall"] == 0.0
+
+
+def test_evaluate_cardinality_and_frequency_bounds():
+    truth = {"name": "x", "min_records": 1, "distinct_src": 100,
+             "distinct_tol": 0.1,
+             "frequency_probe": {"SrcAddr": "1.1.1.1", "DstAddr": "2.2.2.2",
+                                 "SrcPort": 1, "DstPort": 2, "Proto": 6,
+                                 "true_bytes": 1000}}
+    good = {"est_bytes": 1001.0, "overestimate_bound_bytes": 50.0}
+    out = evaluate(truth, [_obs(distinct=95.0)], [good])
+    assert out["passed"], out["failures"]
+    # HLL estimate out of tolerance
+    out = evaluate(truth, [_obs(distinct=150.0)], [good])
+    assert any("distinct-src" in f for f in out["failures"])
+    # CM must never underestimate; and must respect its stated bound
+    out = evaluate(truth, [_obs(distinct=100.0)],
+                   [{"est_bytes": 900.0, "overestimate_bound_bytes": 50.0}])
+    assert any("underestimates" in f for f in out["failures"])
+    out = evaluate(truth, [_obs(distinct=100.0)],
+                   [{"est_bytes": 1100.0, "overestimate_bound_bytes": 50.0}])
+    assert any("exceeds" in f for f in out["failures"])
+    out = evaluate(truth, [_obs(distinct=100.0)], [])
+    assert any("never answered" in f for f in out["failures"])
+
+
+def test_evaluate_flags_retraces():
+    out = evaluate({"name": "x", "min_records": 1}, [_obs()], retraces=2)
+    assert not out["passed"]
+    assert any("retraces" in f for f in out["failures"])
+
+
+# --- end to end through /query/* ----------------------------------------
+
+def _run(name, tmp_path):
+    result = run_scenario(name, str(tmp_path))
+    assert result["passed"], result["failures"]
+    assert result["retraces"] == 0
+    return result
+
+
+def test_scenario_smoke_syn_flood(tmp_path):
+    """Tier-1 smoke: the full pipeline — pcap -> replay -> agent -> sketch
+    -> query snapshot -> HTTP /query/* — detects the SYN flood and names
+    the victim."""
+    result = _run("syn_flood", tmp_path)
+    assert result["alarms_fired"] == ["syn_flood"]
+    assert result["victim_named"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(n for n in SCENARIOS
+                                        if n != "syn_flood"))
+def test_scenario_zoo_slow(name, tmp_path):
+    _run(name, tmp_path)
