@@ -166,6 +166,15 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     exporter_buffer_length: int = field(default=0, **_env("EXPORTER_BUFFER_LENGTH", "0"))
     cache_max_flows: int = field(default=5000, **_env("CACHE_MAX_FLOWS", "5000"))
     cache_active_timeout: float = field(default=5.0, **_env("CACHE_ACTIVE_TIMEOUT", "5s"))
+    #: eviction drain worker lanes: each lane drains one per-CPU feature
+    #: map (batched bpf(2) syscalls + native per-CPU merge, both
+    #: GIL-releasing) while the calling thread drains the aggregation map;
+    #: key alignment stays one vectorized join. 0 = auto (one lane per
+    #: feature map, bounded by cores; 1-core hosts stay sequential),
+    #: 1 = sequential drain (the pre-lane behavior, bit-identical output);
+    #: an explicit N beyond the feature-map count turns the surplus into
+    #: per-map merge row-shards (big-map relief)
+    evict_drain_lanes: int = field(default=0, **_env("EVICT_DRAIN_LANES", "0"))
     direction: str = field(default="both", **_env("DIRECTION", "both"))
     sampling: int = field(default=0, **_env("SAMPLING", "0"))
     enable_flows_ringbuf_fallback: bool = field(
@@ -368,6 +377,21 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     #: exporter path is bit-identical to pre-query-plane behavior
     sketch_query_refresh: float = field(
         default=0.0, **_env("SKETCH_QUERY_REFRESH", "0"))
+    #: closed-window snapshot ring for /query/* back-scroll: the publisher
+    #: keeps the last N ROLL snapshots (mid-window refreshes never enter
+    #: the ring) and `?window=<id>` serves point-in-time reads; evicted or
+    #: never-seen ids answer 404. Still snapshot-only — no device op, no
+    #: exporter lock. 0 disables the ring (?window= always 404s)
+    sketch_query_history: int = field(
+        default=8, **_env("SKETCH_QUERY_HISTORY", "8"))
+    #: overlapped eviction dispatch: > 0 runs admit/buffer/fold on a
+    #: dedicated supervised fold thread behind a bounded handoff of this
+    #: depth, so the eviction feed's drain N+1 overlaps pack/dispatch N
+    #: (1 = classic double buffer). A full handoff blocks the feed — the
+    #: same backpressure as the synchronous seam, one batch deeper. 0
+    #: (default) keeps the synchronous export_evicted path, bit-identical
+    #: to the pre-overlap exporter
+    sketch_overlap: int = field(default=0, **_env("SKETCH_OVERLAP", "0"))
 
     # --- overload control plane (sketch/overload.py; new) ---
     #: high watermark (in BATCHES: pending-fold depth weighted by the
@@ -517,6 +541,15 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
                 "mid-window refresh)")
         if self.sketch_shed_watermark < 0:
             raise ValueError("SKETCH_SHED_WATERMARK must be >= 0 (0 disables)")
+        if self.sketch_query_history < 0:
+            raise ValueError("SKETCH_QUERY_HISTORY must be >= 0 "
+                             "(0 disables the back-scroll ring)")
+        if self.sketch_overlap < 0:
+            raise ValueError("SKETCH_OVERLAP must be >= 0 (0 keeps the "
+                             "synchronous export seam)")
+        if self.evict_drain_lanes < 0:
+            raise ValueError("EVICT_DRAIN_LANES must be >= 0 (0 = auto, "
+                             "1 = sequential)")
         if self.sketch_shed_max < 2:
             raise ValueError("SKETCH_SHED_MAX must be >= 2 (it bounds the "
                              "1-in-N shed factor)")

@@ -44,7 +44,7 @@ class _Columns(ctypes.Structure):
 _lib: Optional[ctypes.CDLL] = None
 
 
-_ABI_VERSION = 8
+_ABI_VERSION = 9
 
 #: dense TPU-feed row width (words); layout documented in flowpack.cc
 DENSE_WORDS = 20
@@ -734,26 +734,100 @@ def merge_percpu(kind: str, values: np.ndarray,
     return accumulate.merge_percpu(values, py_fn)
 
 
+#: row floor below which lane-sharding a batch merge costs more than the
+#: pool round trip saves (one fp_merge_*_batch call is already ~ns/row)
+_MERGE_LANE_MIN_ROWS = 4096
+
+
 def merge_percpu_batch(kind: str, values: np.ndarray,
-                       use_native: Optional[bool] = None) -> np.ndarray:
+                       use_native: Optional[bool] = None,
+                       out: Optional[np.ndarray] = None,
+                       threads: int = 1) -> np.ndarray:
     """Merge per-CPU partials for a WHOLE drained map: values shaped
     (n_keys, n_cpus) structured -> (n_keys,) merged records. Native path is
     one fp_merge_*_batch call over a single pointer (no per-key ctypes round
     trips); fallback is the columnar numpy twin in model/accumulate.py.
     Both are equivalence-pinned against the per-record accumulate_* loop
-    (tests/test_evict_columnar.py)."""
+    (tests/test_evict_columnar.py).
+
+    `out` writes into a caller buffer (must be (n_keys,) of the record
+    dtype). `threads > 1` row-shards ONE map's merge across that many pack
+    lanes — each lane is its own fp_merge_*_batch call over a disjoint
+    contiguous row range of the same buffers (the native call releases the
+    GIL, so lanes merge in true parallel; per-key semantics make row
+    sharding trivially equivalent). Engages only for native merges past
+    `_MERGE_LANE_MIN_ROWS` rows — the eviction plane's big-map (flows_extra)
+    relief when one map dominates the drain."""
     fn_name, dtype, _py_fn = _MERGE_FNS[kind]
     values = np.ascontiguousarray(values, dtype=dtype)
     if values.ndim != 2:
         raise ValueError(f"values must be (n_keys, n_cpus), got "
                          f"{values.shape}")
     n_keys, n_cpus = values.shape
+    if out is not None and (out.dtype != dtype or len(out) != n_keys
+                            or not out.flags.c_contiguous):
+        raise ValueError("out must be a contiguous (n_keys,) array of the "
+                         "record dtype")
     if use_native is None:
         use_native = native_available()
     if use_native and native_available() and n_keys:
-        out = np.zeros(n_keys, dtype=dtype)
-        getattr(_lib, fn_name + "_batch")(
-            _ptr(values), ctypes.c_size_t(n_keys), ctypes.c_size_t(n_cpus),
-            _ptr(out))
+        if out is None:
+            out = np.zeros(n_keys, dtype=dtype)
+        fn = getattr(_lib, fn_name + "_batch")
+
+        def run(lo: int, hi: int) -> None:
+            fn(_ptr(values[lo:hi]), ctypes.c_size_t(hi - lo),
+               ctypes.c_size_t(n_cpus), _ptr(out[lo:hi]))
+
+        if threads > 1 and n_keys >= max(_MERGE_LANE_MIN_ROWS, 2 * threads):
+            bounds = [n_keys * i // threads for i in range(threads + 1)]
+            for f in _pack_submit(threads,
+                                  [lambda i=i: run(bounds[i], bounds[i + 1])
+                                   for i in range(threads)]):
+                f.result()
+        else:
+            run(0, n_keys)
         return out
-    return accumulate.COLUMNAR_MERGES[kind](values)
+    merged = accumulate.COLUMNAR_MERGES[kind](values)
+    if out is not None:
+        out[:] = merged
+        return out
+    return merged
+
+
+def events_from_keys_stats(keys: np.ndarray, stats: np.ndarray,
+                           n_total: Optional[int] = None,
+                           use_native: Optional[bool] = None) -> np.ndarray:
+    """Compose FLOW_EVENT rows from the two columns a batched drain yields —
+    the columnar eviction plane's single copy boundary, done as ONE native
+    interleave pass (fp_events_from_keys_stats) instead of two strided numpy
+    field assignments. `keys` is (n, 40) u8 or (n,) FLOW_KEY; `stats` is
+    (n,) FLOW_STATS. The numpy twin is binfmt.events_from_keys_stats
+    (equivalence pinned in tests/test_evict_parallel.py); semantics are
+    identical, including the zeroed `n_total` tail the loader appends
+    ringbuf-orphan events into."""
+    if keys.dtype != np.uint8:
+        keys = np.ascontiguousarray(keys).view(np.uint8).reshape(
+            -1, binfmt.FLOW_KEY_DTYPE.itemsize)
+    n = len(keys)
+    if len(stats) != n:
+        raise ValueError(f"keys/stats length mismatch: {n} vs {len(stats)}")
+    if n_total is not None and n_total < n:
+        # the numpy twin raises on broadcast; the native memcpy loop would
+        # silently write past the short buffer instead — refuse first
+        raise ValueError(f"n_total {n_total} < {n} rows")
+    if use_native is None:
+        use_native = native_available()
+    if not (use_native and native_available()):
+        return binfmt.events_from_keys_stats(
+            keys.view(binfmt.FLOW_KEY_DTYPE).reshape(-1) if n
+            else np.empty(0, binfmt.FLOW_KEY_DTYPE),
+            stats, n_total=n_total)
+    keys = np.ascontiguousarray(keys)
+    stats = np.ascontiguousarray(stats, dtype=binfmt.FLOW_STATS_DTYPE)
+    out = np.zeros(n_total if n_total is not None else n,
+                   dtype=binfmt.FLOW_EVENT_DTYPE)
+    if n:
+        _lib.fp_events_from_keys_stats(
+            _ptr(keys), _ptr(stats), ctypes.c_size_t(n), _ptr(out))
+    return out

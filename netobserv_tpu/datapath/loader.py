@@ -196,13 +196,22 @@ def _drain_map_arrays(bmap, dtype) -> tuple[np.ndarray, np.ndarray]:
 
 def decode_eviction(agg_keys: np.ndarray, agg_vals: np.ndarray,
                     drained: dict[str, tuple[np.ndarray, np.ndarray]],
-                    trace=None) -> EvictedFlows:
+                    trace=None, merged: Optional[dict] = None,
+                    merge_threads: int = 1) -> EvictedFlows:
     """Merge + align halves of the columnar eviction plane.
 
     agg_keys: (n, 40) u8; agg_vals: (n, 1) FLOW_STATS (the aggregation map
     is not per-CPU); drained: attr -> (keys_u8 (m, 40), partials
     (m, n_cpus) feature dtype). Inputs may alias kernel drain buffers —
     every output array is freshly allocated here (the one copy).
+
+    `merged` (attr -> (m,) merged records) skips the per-CPU merge stage —
+    the parallel drain lanes (BpfmanFetcher) merge inside each lane worker
+    and hand only the align half here, keeping `_join_keys` the single join
+    point of the fused stream; `drained`'s partials half is then unused
+    (callers pass None rather than repurposing the slot). `merge_threads`
+    row-shards each map's native merge (flowpack.merge_percpu_batch
+    lanes) on the sequential path.
 
     Feature records whose flow is missing from the aggregation drain
     (ringbuf-fallback flows, or a racing eviction) become standalone
@@ -211,9 +220,11 @@ def decode_eviction(agg_keys: np.ndarray, agg_vals: np.ndarray,
     every feature that saw it, with min/max seen times across them."""
     trace = trace if trace is not None else tracing.NULL_TRACE
     t0 = time.perf_counter()
-    with trace.stage("merge_percpu"):
-        merged = {attr: flowpack.merge_percpu_batch(attr, vals)
-                  for attr, (_keys, vals) in drained.items()}
+    if merged is None:
+        with trace.stage("merge_percpu"):
+            merged = {attr: flowpack.merge_percpu_batch(
+                attr, vals, threads=merge_threads)
+                for attr, (_keys, vals) in drained.items()}
     t1 = time.perf_counter()
     with trace.stage("align"):
         n_agg = len(agg_keys)
@@ -227,9 +238,8 @@ def decode_eviction(agg_keys: np.ndarray, agg_vals: np.ndarray,
         else:
             joins, appended_keys = {}, np.empty((0, _KEY_SIZE), np.uint8)
         n = n_agg + len(appended_keys)
-        events = binfmt.events_from_keys_stats(
-            agg_keys.view(binfmt.FLOW_KEY_DTYPE).reshape(-1) if n_agg
-            else np.empty(0, binfmt.FLOW_KEY_DTYPE),
+        events = flowpack.events_from_keys_stats(
+            agg_keys if n_agg else np.empty((0, _KEY_SIZE), np.uint8),
             agg_vals[:, 0] if n_agg else np.empty(0, binfmt.FLOW_STATS_DTYPE),
             n_total=n)
         n_app = len(appended_keys)
@@ -271,12 +281,47 @@ def decode_eviction(agg_keys: np.ndarray, agg_vals: np.ndarray,
     return evicted
 
 
+#: sanity ceiling on explicit EVICT_DRAIN_LANES (pool threads + merge
+#: row-shards per map are both derived from it)
+_MAX_DRAIN_LANES = 16
+
+
+def resolve_drain_lanes(requested: int, n_feature_maps: int) -> int:
+    """EVICT_DRAIN_LANES resolution — the ONE definition of the 0 = auto
+    rule: one worker lane per drained feature map, bounded by the host's
+    cores (a 1-core box stays sequential — lanes there only add pool
+    overhead). 1 forces the sequential drain. An explicit N > 1 is
+    trusted up to a sanity ceiling and MAY exceed the feature-map count:
+    the drain pool itself never needs more workers than maps, but the
+    surplus becomes per-map merge row-shards (`merge_percpu_batch
+    threads=` — the big-map relief when one map, typically flows_extra,
+    dominates the drain)."""
+    if requested == 1 or n_feature_maps == 0:
+        return 1
+    if requested <= 0:
+        return max(1, min(n_feature_maps, os.cpu_count() or 1))
+    return min(requested, _MAX_DRAIN_LANES)
+
+
 class BpfmanFetcher:
-    """FlowFetcher over maps pinned by an external manager (bpfman mode)."""
+    """FlowFetcher over maps pinned by an external manager (bpfman mode).
+
+    Eviction runs the columnar plane (decode_eviction); with more than one
+    DRAIN LANE (EVICT_DRAIN_LANES) the per-feature-map drain→per-CPU-merge
+    pairs run on a worker pool — one batched bpf(2) syscall stream per lane
+    — while the calling thread drains the aggregation map, and the
+    vectorized `_join_keys` alignment stays the single join point. The
+    zero-copy drain-view lifetime rule holds PER LANE: a lane's views alias
+    only its own map's cached batch buffers, each map is owned by exactly
+    one lane per drain, and every view is copied out at the EvictedFlows
+    boundary before lookup_and_delete returns (pinned by
+    tests/test_evict_parallel.py + the bpffs aliasing suite). Drains
+    serialize (MapTracer's eviction lock), so a lane's buffers are never
+    redrained while its views are still being aligned."""
 
     needs_iface_discovery = False  # program lifecycle is externally managed
 
-    def __init__(self, bpf_fs_path: str):
+    def __init__(self, bpf_fs_path: str, drain_lanes: int = 0):
         self._n_cpus = syscall_bpf.n_possible_cpus()
         self._base = bpf_fs_path
 
@@ -320,10 +365,31 @@ class BpfmanFetcher:
             self._ssl_rb = syscall_bpf.RingBufReader(ssl_map)
         except (OSError, ValueError):
             log.debug("pinned ssl_events ringbuf absent")
+        self._init_drain_lanes(drain_lanes)
+
+    def _init_drain_lanes(self, drain_lanes: int) -> None:
+        """Provision the drain-lane pool (shared by the subclassed
+        self-managed fetchers, which call this after their own map setup).
+        Sequential resolution (1 lane) keeps the pool unbuilt — the
+        parallel path is then one is-None check."""
+        self._drain_lanes = resolve_drain_lanes(drain_lanes,
+                                                len(self._features))
+        self._drain_pool = None
+        if self._drain_lanes > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            # the pool never needs more workers than maps — lanes beyond
+            # the map count become per-map merge row-shards instead
+            # (_lookup_and_delete_lanes mthreads)
+            self._drain_pool = ThreadPoolExecutor(
+                max_workers=min(self._drain_lanes, len(self._features)),
+                thread_name_prefix="evict-drain")
+            log.info("eviction drain lanes: %d (feature maps: %d)",
+                     self._drain_lanes, len(self._features))
 
     @classmethod
     def load(cls, cfg: AgentConfig) -> "BpfmanFetcher":
-        return cls(cfg.bpfman_bpf_fs_path)
+        return cls(cfg.bpfman_bpf_fs_path,
+                   drain_lanes=cfg.evict_drain_lanes)
 
     def map_capacity(self) -> int:
         """max_entries of the kernel aggregation map — the denominator of
@@ -341,6 +407,8 @@ class BpfmanFetcher:
         # drain, never per record; unsampled drains get the null trace).
         trace = tracing.active_trace()
         t0 = time.perf_counter()
+        if self._drain_pool is not None and self._features:
+            return self._lookup_and_delete_lanes(trace, t0)
         with trace.stage("decode"):
             agg_keys, agg_vals = _drain_map_arrays(
                 self._agg, binfmt.FLOW_STATS_DTYPE)
@@ -349,6 +417,47 @@ class BpfmanFetcher:
         t1 = time.perf_counter()
         evicted = decode_eviction(agg_keys, agg_vals, drained, trace=trace)
         evicted.decode_stats["decode_s"] = t1 - t0
+        evicted.decode_stats["drain_lanes"] = 1
+        evicted.decode_stats["seconds"] = time.perf_counter() - t0
+        return evicted
+
+    def _lookup_and_delete_lanes(self, trace, t0: float) -> EvictedFlows:
+        """Parallel drain lanes: each worker owns one feature map for this
+        drain — batched drain syscalls + the native per-CPU merge, both of
+        which release the GIL, run concurrently across maps while the
+        calling thread drains the (largest) aggregation map. Merged records
+        are fresh arrays; only the key views still alias lane buffers, and
+        `decode_eviction` copies them out before returning (the per-lane
+        zero-copy lifetime rule — class docstring)."""
+        # maps with fewer lanes than workers row-shard their native merge
+        mthreads = max(1, self._drain_lanes // max(1, len(self._features)))
+
+        def lane(attr, fmap, dtype):
+            ks, vals = _drain_map_arrays(fmap, dtype)
+            tm = time.perf_counter()
+            recs = flowpack.merge_percpu_batch(attr, vals,
+                                               threads=mthreads)
+            return attr, ks, recs, time.perf_counter() - tm
+
+        with trace.stage("decode"):
+            futs = [self._drain_pool.submit(lane, attr, fmap, dtype)
+                    for attr, (fmap, dtype) in self._features.items()]
+            agg_keys, agg_vals = _drain_map_arrays(
+                self._agg, binfmt.FLOW_STATS_DTYPE)
+            lanes = [f.result() for f in futs]
+        t1 = time.perf_counter()
+        # vals half None: the per-CPU partials were consumed in-lane —
+        # decode_eviction's merged= contract (never smuggle merged
+        # records into the partials slot)
+        drained = {attr: (ks, None) for attr, ks, _recs, _dt in lanes}
+        evicted = decode_eviction(
+            agg_keys, agg_vals, drained, trace=trace,
+            merged={attr: recs for attr, _ks, recs, _dt in lanes})
+        # merge ran inside the lanes: report the summed lane CPU (the
+        # overlap evidence — decode_s is the whole section's WALL)
+        evicted.decode_stats["merge_s"] = sum(dt for *_x, dt in lanes)
+        evicted.decode_stats["decode_s"] = t1 - t0
+        evicted.decode_stats["drain_lanes"] = self._drain_lanes
         evicted.decode_stats["seconds"] = time.perf_counter() - t0
         return evicted
 
@@ -475,6 +584,9 @@ class BpfmanFetcher:
         pass
 
     def close(self) -> None:
+        if getattr(self, "_drain_pool", None) is not None:
+            self._drain_pool.shutdown(wait=True)
+            self._drain_pool = None
         self._agg.close()
         for fmap, _ in self._features.values():
             fmap.close()
@@ -615,6 +727,8 @@ class _SelfManagedAttach:
         self._n_cpus = syscall_bpf.n_possible_cpus()
         self._base = ""
         self._features = {}
+        self._drain_pool = None
+        self._drain_lanes = 1
         self._agg = None
         self._prog_fds = {}
         self._pins = {}
@@ -692,6 +806,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                  enable_openssl: bool = False, libssl_path: str = "",
                  enable_ringbuf_fallback: bool = True,
                  ringbuf_bytes: int = 1 << 17,
+                 drain_lanes: int = 0,
                  # maps.h DEF_RINGBUF(ssl_events, 1<<27): 16KB * 1000/s * 5s
                  ssl_ring_bytes: int = 1 << 27):
         self._init_empty_maps()
@@ -705,6 +820,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 enable_pkt_drops, enable_filters, quic_mode, enable_tls,
                 enable_openssl, libssl_path, enable_ringbuf_fallback,
                 ringbuf_bytes, ssl_ring_bytes)
+            self._init_drain_lanes(drain_lanes)
         except Exception:
             # a half-provisioned fetcher must not leak map/prog fds (a
             # supervisor retrying construction would exhaust fds)
@@ -894,7 +1010,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                    enable_tls=cfg.enable_tls_tracking,
                    enable_openssl=cfg.enable_openssl_tracking,
                    libssl_path=cfg.openssl_path,
-                   enable_ringbuf_fallback=cfg.enable_flows_ringbuf_fallback)
+                   enable_ringbuf_fallback=cfg.enable_flows_ringbuf_fallback,
+                   drain_lanes=cfg.evict_drain_lanes)
 
     def _attach_tracepoint(self, prog_bytes: bytes, category: str,
                            name: str, prog_name: bytes) -> None:
@@ -933,6 +1050,9 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         return n
 
     def close(self) -> None:
+        if getattr(self, "_drain_pool", None) is not None:
+            self._drain_pool.shutdown(wait=True)
+            self._drain_pool = None
         self._teardown_attachments()
         if self._agg is not None:
             self._agg.close()
@@ -1183,6 +1303,7 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         self._obj = None
         try:
             self._provision_object(cfg, obj_path)
+            self._init_drain_lanes(cfg.evict_drain_lanes)
         except Exception:
             self.close()
             raise
@@ -1418,6 +1539,9 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                                      rules)
 
     def close(self) -> None:
+        if getattr(self, "_drain_pool", None) is not None:
+            self._drain_pool.shutdown(wait=True)
+            self._drain_pool = None
         self._teardown_attachments()
         for link in getattr(self, "_probe_links", []):
             link.destroy()

@@ -815,6 +815,28 @@ FP_MERGE_BATCH(fp_merge_nevents, struct no_nevents_rec)
 FP_MERGE_BATCH(fp_merge_xlat, struct no_xlat_rec)
 FP_MERGE_BATCH(fp_merge_quic, struct no_quic_rec)
 
+// ---------------------------------------------------------------------------
+// FLOW_EVENT interleave: compose contiguous no_flow_event rows (key 40B |
+// stats 104B) from the two columns a batched map drain yields — the columnar
+// eviction plane's single copy boundary done as one native pass instead of
+// two strided numpy field assignments (python twin:
+// model/binfmt.py events_from_keys_stats; equivalence pinned in
+// tests/test_evict_parallel.py). `out` must hold n events; tail rows beyond
+// n (the loader's ringbuf-orphan appendix) are the caller's to zero.
+// ---------------------------------------------------------------------------
+void fp_events_from_keys_stats(const uint8_t *keys, const uint8_t *stats,
+                               size_t n, uint8_t *out) {
+    for (size_t i = 0; i < n; i++) {
+        struct no_flow_event *ev =
+            reinterpret_cast<struct no_flow_event *>(
+                out + i * sizeof(struct no_flow_event));
+        std::memcpy(&ev->key, keys + i * sizeof(struct no_flow_key),
+                    sizeof(struct no_flow_key));
+        std::memcpy(&ev->stats, stats + i * sizeof(struct no_flow_stats),
+                    sizeof(struct no_flow_stats));
+    }
+}
+
 // crc32c (Castagnoli) — slice-by-8; used by the Kafka record-batch encoder.
 static uint32_t crc32c_table[8][256];
 static bool crc32c_ready = false;
@@ -884,6 +906,6 @@ uint32_t fp_crc32c(const uint8_t *data, size_t n) {
     return crc ^ 0xFFFFFFFFu;
 }
 
-uint32_t fp_abi_version(void) { return 8; }
+uint32_t fp_abi_version(void) { return 9; }
 
 }  // extern "C"

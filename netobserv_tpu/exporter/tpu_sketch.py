@@ -261,7 +261,9 @@ class TpuSketchExporter(Exporter):
                  shed_max: int = 64,
                  shed_slot_budget_s: float = 30.0,
                  shed_seed: int = 2026,
-                 query_refresh_s: float = 0.0):
+                 query_refresh_s: float = 0.0,
+                 overlap_depth: int = 0,
+                 query_history: int = 0):
         # superbatch defaults to NO ladder for direct construction: the
         # ladder costs superbatch_max-sized ring buffers, dictionaries and
         # key-table rows up front, and only pays off once warmed — the
@@ -332,6 +334,19 @@ class TpuSketchExporter(Exporter):
         if self._superbatch[0] != 1:
             raise ValueError("superbatch ladder must include 1")
         self._lock = threading.Lock()
+        # serializes CALLS into the roll executable (dispatch only — the
+        # device work stays async): the window close and the mid-window
+        # refresh run on different threads (with SKETCH_OVERLAP the fold
+        # worker closes windows too), and two threads first-tracing the
+        # same jit double-compile — a spurious post-warmup retrace alarm,
+        # found live. After the first compile this is an uncontended
+        # microsecond hold around a cache hit.
+        self._roll_mutex = threading.Lock()
+        # created BEFORE anything that spawns a background thread: the
+        # ladder-warm thread polls _closed between compiles, and a warm
+        # kicked off mid-__init__ must never race the attribute into
+        # existence (observed live as an AttributeError killing the warm)
+        self._closed = threading.Event()
         self._pending: list[Record] = []
         # rolled-but-unpublished device-side WindowReports, queued under
         # self._lock, rendered+delivered by the window-timer thread OUTSIDE
@@ -460,7 +475,8 @@ class TpuSketchExporter(Exporter):
         # superbatch_max batches and fold as ONE ladder dispatch (window
         # close always flushes, so nothing waits past the window)
         self._pending_buf = staging.PendingEventBuffer(
-            self._batch_size, getattr(self._ring, "superbatch_max", 1))
+            self._batch_size, getattr(self._ring, "superbatch_max", 1),
+            metrics=metrics)
         # overload control plane (sketch/overload.py): admission control at
         # the export_evicted seam. Disabled (the default), _overload is None
         # and the shed path is one is-None check — bit-identical to the
@@ -488,9 +504,11 @@ class TpuSketchExporter(Exporter):
         # roll executable on the timer thread WITHOUT adopting its state —
         # no new jitted entry, so the refresh can never retrace.
         from netobserv_tpu.query import QueryRoutes, SnapshotPublisher
-        self.query = SnapshotPublisher()
+        self.query = SnapshotPublisher(history=query_history)
         self.query_routes = QueryRoutes(self.query.get, self.query_status,
-                                        metrics=metrics)
+                                        metrics=metrics,
+                                        history_fn=self.query.get_window,
+                                        windows_fn=self.query.windows)
         if metrics is not None:
             metrics.query_snapshot_age_seconds.set_function(self.query.age_s)
         self._query_refresh_s = query_refresh_s
@@ -526,10 +544,27 @@ class TpuSketchExporter(Exporter):
                     "version (%s); starting from a fresh window",
                     self._ckpt.latest_step(), exc)
         # idle-window timer: reports keep flowing even when no batches arrive
-        self._closed = threading.Event()
         #: supervision hook for the window timer (agent/supervisor.py)
         self.heartbeat = lambda: None
         self._timer: Optional[threading.Thread] = None
+        # overlapped eviction dispatch (SKETCH_OVERLAP): with a depth, the
+        # admit/buffer/fold work moves to a dedicated supervised fold
+        # thread behind a bounded handoff, so the eviction feed's next
+        # drain overlaps this batch's pack/dispatch (classic double buffer
+        # at depth 1). A full handoff BLOCKS export_evicted — the same
+        # feed backpressure as the synchronous seam, one batch deeper.
+        # Disabled (depth 0, the default): no queue, no thread, one
+        # is-None check — export_evicted is bit-identical to the
+        # synchronous exporter.
+        self._handoff = None
+        self._inflight_rows = 0  # rows put but not yet picked up
+        self._inflight_lock = threading.Lock()
+        self.fold_heartbeat = lambda: None
+        self._fold_thread: Optional[threading.Thread] = None
+        if overlap_depth > 0:
+            import queue as _queue
+            self._handoff = _queue.Queue(maxsize=overlap_depth)
+            self._start_fold_worker()
         self.start_window_timer()
 
     def warm_superbatch_ladder(self, block: bool = False) -> None:
@@ -647,6 +682,15 @@ class TpuSketchExporter(Exporter):
             supervisor.register_condition(
                 "overloaded",
                 lambda: {"active": ctl.overloaded, **ctl.snapshot()})
+        # the overlap fold worker is a pipeline stage like any other: a
+        # crash/hang restarts it (the handoff queue survives the restart,
+        # so queued evictions still fold)
+        if getattr(self, "_handoff", None) is not None:
+            self.fold_heartbeat = supervisor.register(
+                "sketch-fold", restart=self._start_fold_worker,
+                thread_getter=lambda: self._fold_thread,
+                heartbeat_timeout_s=(heartbeat_timeout_s or 10.0) + 0.2,
+                **kwargs)
 
     @classmethod
     def from_config(cls, cfg, metrics=None, sink=None):
@@ -681,6 +725,8 @@ class TpuSketchExporter(Exporter):
                    shed_max=cfg.sketch_shed_max,
                    shed_slot_budget_s=cfg.sketch_shed_slot_budget,
                    query_refresh_s=cfg.sketch_query_refresh,
+                   overlap_depth=cfg.sketch_overlap,
+                   query_history=cfg.sketch_query_history,
                    warm_ladder=True,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
@@ -712,11 +758,39 @@ class TpuSketchExporter(Exporter):
         a due window only dispatches the roll here — rendering and sink I/O
         happen on the timer thread, so this never waits on a sink.
 
+        With SKETCH_OVERLAP the eviction lands in the bounded handoff and
+        this returns immediately (blocking only when the handoff is full) —
+        the supervised fold thread runs the admit/buffer/fold below, so the
+        caller's next drain overlaps this batch's pack/dispatch."""
+        if self._handoff is not None:
+            with self._inflight_lock:
+                self._inflight_rows += len(evicted)
+            self._handoff.put(evicted)
+            return
+        self._export_evicted_now(evicted)
+
+    def _queued_overlap_rows(self) -> int:
+        """Rows sitting in the overlap handoff (0 on the synchronous
+        path) — part of the TRUE pending depth the overload controller
+        must see. The in-hand eviction is decremented before its own
+        `ctl.update` so it is never counted twice."""
+        if self._handoff is None:
+            return 0
+        with self._inflight_lock:
+            return self._inflight_rows
+
+    def _export_evicted_now(self, evicted) -> None:
+        """The admit/buffer/fold half of the columnar seam (synchronous
+        callers run it inline; the overlap fold thread runs it per handoff
+        item).
+
         Admission control (overload controller, when enabled): the
-        pending-fold depth at arrival plus the ring's slot-wait p95 drive
-        the AIMD shed factor, and the batch is thinned BEFORE buffering —
-        surviving rows carry the factor in their `sampling` field, so the
-        device de-bias keeps every estimate unbiased."""
+        pending-fold depth at arrival — buffered rows + this eviction +
+        anything still queued in the overlap handoff — plus the ring's
+        slot-wait p95 drive the AIMD shed factor, and the batch is thinned
+        BEFORE buffering — surviving rows carry the factor in their
+        `sampling` field, so the device de-bias keeps every estimate
+        unbiased."""
         trace = getattr(evicted, "trace", None)
         with self._lock:
             ctl = self._overload
@@ -731,7 +805,8 @@ class TpuSketchExporter(Exporter):
                                / max(now - last, 1e-6))
                     self._busy_ewma = 0.5 * self._busy_ewma + 0.5 * inst
                 self._busy_fold_s = 0.0
-                ctl.update(self._pending_buf.n + len(evicted),
+                ctl.update(self._pending_buf.n + len(evicted)
+                           + self._queued_overlap_rows(),
                            self._ring.slot_wait_p95(),
                            busy=self._busy_ewma)
                 evicted = ctl.admit(evicted)
@@ -743,6 +818,49 @@ class TpuSketchExporter(Exporter):
             self._pending_buf.append(evicted, self._fold_events)
             if time.monotonic() >= self._window_deadline:
                 self._close_window_locked()
+
+    def _start_fold_worker(self) -> None:
+        """(Re)start the overlap fold thread; the supervisor uses this as
+        the sketch-fold stage's restart callable."""
+        self._fold_thread = threading.Thread(
+            target=self._fold_loop, name="sketch-fold", daemon=True)
+        self._fold_thread.start()
+
+    def _fold_loop(self) -> None:
+        import queue as _queue
+        while not self._closed.is_set():
+            self.fold_heartbeat()
+            try:
+                evicted = self._handoff.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            try:
+                with self._inflight_lock:
+                    self._inflight_rows -= len(evicted)
+                self._export_evicted_now(evicted)
+            except Exception as exc:
+                # a fold-path bug loses THIS batch (counted), never the
+                # worker — the same contract as the QueueExporter loop
+                log.error("overlap fold failed (batch of %d dropped): %s",
+                          len(evicted), exc)
+                if self._metrics is not None:
+                    self._metrics.count_error("tpu-sketch")
+            finally:
+                self._handoff.task_done()
+
+    def _drain_handoff(self, timeout_s: float = 30.0) -> None:
+        """Wait until every queued eviction has been admitted and folded
+        (flush/shutdown path). Bounded: a dead fold worker must not hang
+        flush forever — leftovers are drained synchronously by close()."""
+        if self._handoff is None:
+            return
+        deadline = time.monotonic() + timeout_s
+        while self._handoff.unfinished_tasks and \
+                time.monotonic() < deadline:
+            if (self._fold_thread is None
+                    or not self._fold_thread.is_alive()):
+                return  # close() (or the supervisor) owns the leftovers
+            time.sleep(0.005)
 
     def _fold_events(self, events, feats) -> None:
         t0 = time.perf_counter()
@@ -838,13 +956,43 @@ class TpuSketchExporter(Exporter):
 
     def flush(self) -> None:
         """Fold pending records, close the current window now, and publish
-        the report synchronously (shutdown/tests path)."""
+        the report synchronously (shutdown/tests path). With the overlap
+        seam, queued evictions fold first — a flush observes everything
+        exported before it."""
+        self._drain_handoff()
         with self._lock:
             self._close_window_locked()
         self._publish_queued()
 
     def close(self) -> None:
         self._closed.set()
+        # overlap fold worker first: it holds evictions the flush below
+        # must observe; after the join any leftovers (worker died, or
+        # raced the _closed flag) drain synchronously on this thread
+        if self._fold_thread is not None:
+            self._drain_handoff()
+            self._fold_thread.join(timeout=10.0)
+            import queue as _queue
+            while True:
+                try:
+                    evicted = self._handoff.get_nowait()
+                except _queue.Empty:
+                    break
+                with self._inflight_lock:
+                    self._inflight_rows -= len(evicted)
+                try:
+                    # same per-batch containment as the fold worker: the
+                    # leftover drain exists for the worker-died case, and
+                    # the batch that killed it would otherwise re-raise
+                    # here and abort the remaining teardown joins
+                    self._export_evicted_now(evicted)
+                except Exception as exc:
+                    log.error("close-path fold failed (batch of %d "
+                              "dropped): %s", len(evicted), exc)
+                    if self._metrics is not None:
+                        self._metrics.count_error("tpu-sketch")
+                finally:
+                    self._handoff.task_done()
         # a mid-flight query refresh (roll dispatch + table transfer on the
         # timer thread) must finish before the interpreter starts tearing
         # down, or its in-flight device work on a daemon thread aborts the
@@ -1018,11 +1166,12 @@ class TpuSketchExporter(Exporter):
             # factor back to 1 even if the feed went idle (no updates)
             self._overload.window_roll()
         with wtrace.stage("roll_dispatch"):
-            if self._with_tables:
-                self._state, report, tables = self._roll(self._state)
-            else:
-                self._state, report = self._roll(self._state)
-                tables = None
+            with self._roll_mutex:  # vs a concurrent refresh roll
+                if self._with_tables:
+                    self._state, report, tables = self._roll(self._state)
+                else:
+                    self._state, report = self._roll(self._state)
+                    tables = None
         # the window trace rides the queued report; render/sink spans attach
         # at publish time on the timer thread (the gap in between is the
         # report's queue wait)
@@ -1141,10 +1290,11 @@ class TpuSketchExporter(Exporter):
         roll executable against a STAGED device-side copy of the live
         state and publish its report + tables WITHOUT adopting the rolled
         state — the live window keeps accumulating untouched. The copy is
-        load-bearing, not defensive: the mesh roll donates its input (the
-        single-device one does not), so rolling `self._state` directly
-        would delete the live buffers under the next fold (the federation
-        checkpoint staging pattern, aggregator.py). Only the copy happens
+        load-bearing, not defensive, on EVERY deployment: the mesh roll
+        donates its input, and the single-device resident INGEST donates
+        the state buffers — either way a concurrent fold deletes the live
+        reference under this off-lock roll (the federation checkpoint
+        staging pattern, aggregator.py). Only the copy happens
         under the exporter lock; the roll dispatch, render, transfer and
         publish all run OFF the lock on the timer thread. No new jitted
         entry exists to retrace. The buffered sub-batch tail IS drained
@@ -1157,13 +1307,18 @@ class TpuSketchExporter(Exporter):
         import jax.numpy as jnp
         with self._lock:
             self._drain_pending_locked()
-            # the copy is donation protection, needed only on the mesh
-            # path; the single-device roll never donates, so the live
-            # reference is safe to roll directly — no HBM copy, shorter
-            # lock hold
-            staged = (jax.tree.map(jnp.copy, self._state)
-                      if self._distributed else self._state)
-        out = self._roll(staged)
+            # the copy is donation protection on EVERY deployment: the
+            # mesh roll donates its input, and on a single device the
+            # resident INGEST donates the state buffers — a fold racing
+            # this refresh off the lock would delete the captured live
+            # reference mid-roll (observed live as "Array has been
+            # deleted" + a spurious roll retrace). The copy is enqueued
+            # under the lock, so device program order reads the buffers
+            # before any later fold's donation overwrites them (the
+            # federation checkpoint staging pattern).
+            staged = jax.tree.map(jnp.copy, self._state)
+        with self._roll_mutex:  # vs a concurrent window-close roll
+            out = self._roll(staged)
         if self._with_tables:
             _discard, report, tables = out
         else:

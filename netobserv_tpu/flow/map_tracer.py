@@ -67,7 +67,13 @@ class MapTracer:
         self._force_gc = force_gc
         self._flush = threading.Event()
         self._stop = threading.Event()
-        self._evict_lock = threading.Lock()  # one eviction at a time
+        # one eviction at a time — ALSO load-bearing for the parallel
+        # drain lanes (loader.BpfmanFetcher): a lane's zero-copy views
+        # alias its map's cached batch buffers until decode copies them
+        # out, so two concurrent lookup_and_delete calls would rewrite
+        # buffers under a live decode; this lock is what serializes them
+        self._evict_lock = threading.Lock()
+        self._drain_lanes_logged = False
         self._thread: Optional[threading.Thread] = None
         #: supervision hook (agent/supervisor.py): the loop beats once per
         #: wakeup; the supervisor replaces this no-op at registration
@@ -172,6 +178,12 @@ class MapTracer:
             if ds is not None:
                 self._metrics.eviction_decode_seconds.observe(
                     ds.get("seconds", 0.0))
+                if not self._drain_lanes_logged and ds.get("drain_lanes"):
+                    # once per process: which drain topology this agent
+                    # actually resolved (EVICT_DRAIN_LANES auto rule)
+                    self._drain_lanes_logged = True
+                    log.info("eviction drain running with %d lane(s)",
+                             ds["drain_lanes"])
                 # ringbuf-fallback singles (feature rows whose flow missed
                 # the aggregation drain) — the one known double-count
                 # overload path, now observable per drain

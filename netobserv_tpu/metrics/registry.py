@@ -162,6 +162,15 @@ class Metrics:
             p + "sketch_resident_spill_rows_total",
             "Rows that rode the full-width spill lane instead of a hot row",
             registry=self.registry)
+        self.sketch_direct_fold_rows_total = Counter(
+            p + "sketch_direct_fold_rows_total",
+            "Rows ROUTED through the direct-to-lane fast path "
+            "(batch-aligned prefixes handed to the fold as zero-copy "
+            "eviction-decode views, bypassing the pending-buffer copy; "
+            "the sub-batch tail still copies in). Routing, not device "
+            "success — a swallowed ingest error downstream still counts "
+            "here but not in sketch_records_total",
+            registry=self.registry)
         self.sketch_superbatch_folds_total = Counter(
             p + "sketch_superbatch_folds_total",
             "Superbatch fold dispatches by ladder size k (k queued batches "

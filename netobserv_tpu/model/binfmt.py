@@ -241,7 +241,12 @@ def events_from_keys_stats(keys: np.ndarray, stats: np.ndarray,
     """Compose FLOW_EVENT rows from separate key/stats arrays — the columnar
     drain's single copy boundary (replaces the old ``b"".join(k + v)``
     interleave over the eviction pairs). ``n_total`` over-allocates zeroed
-    tail rows (the loader appends ringbuf-extra standalone events there)."""
+    tail rows (the loader appends ringbuf-extra standalone events there).
+
+    This is the NUMPY TWIN of the native single-pass interleave
+    (`flowpack.events_from_keys_stats` -> fp_events_from_keys_stats, what
+    the eviction decode actually calls); the two are equivalence-pinned by
+    tests/test_evict_parallel.py — semantics change in both or neither."""
     n = len(keys)
     if len(stats) != n:
         raise ValueError(f"keys/stats length mismatch: {n} vs {len(stats)}")

@@ -15,6 +15,13 @@ Routes (all GET, JSON):
 - /query/cardinality   distinct-source estimate + window totals
 - /query/victims       suspect buckets per signal with victim names
 - /query/status        snapshot freshness + plane counters
+                       (incl. the back-scroll ring's window ids)
+
+Back-scroll: every data route accepts ``?window=<id>`` for a
+point-in-time read of a PAST closed window, served from the publisher's
+snapshot ring (`SnapshotPublisher(history=N)`) — still snapshot-only.
+Evicted or never-rolled ids answer 404 (listing what IS available);
+without a ring the parameter always 404s.
 """
 
 from __future__ import annotations
@@ -37,10 +44,14 @@ class QueryRoutes:
     """
 
     def __init__(self, snapshot_fn: Callable[[], Optional[dict]],
-                 status_fn: Callable[[], dict], metrics=None):
+                 status_fn: Callable[[], dict], metrics=None,
+                 history_fn: Optional[Callable[[int], Optional[dict]]] = None,
+                 windows_fn: Optional[Callable[[], list]] = None):
         self._snapshot = snapshot_fn
         self._status = status_fn
         self._metrics = metrics
+        self._history = history_fn
+        self._windows = windows_fn
 
     def index(self) -> dict:
         return {"routes": [f"/query/{r}" for r in ROUTES]}
@@ -76,7 +87,16 @@ class QueryRoutes:
                          **self.index()}
         if route == "status":
             return 200, self._status()
-        snap = self._snapshot()
+        if params.get("window") is not None:
+            wid = int(params["window"])  # malformed -> ValueError -> 400
+            snap = self._history(wid) if self._history is not None else None
+            if snap is None:
+                return 404, {
+                    "error": f"window {wid} not in the snapshot ring",
+                    "windows": (self._windows() if self._windows is not None
+                                else [])}
+        else:
+            snap = self._snapshot()
         if snap is None:
             return 503, {"error": "no window published yet"}
         if route == "topk":

@@ -160,19 +160,49 @@ def run_scenario(name: str, workdir: str, window_s: float = 600.0,
     finally:
         srv.shutdown()
     retraces = retrace.total_retraces() - retraces_before
-    return evaluate(truth, observations, freq_obs, retraces=retraces)
+    # feed-plumbing evidence for scenarios that pin it (ipv6_heavy: the
+    # resident feed must never dense-fallback on v6; spill volume is
+    # reported for the artifact but not pinned — cold-start geometry)
+    ring = exporter._ring
+    plumbing = {
+        "resident_spill_rows": int(getattr(ring, "spill_rows", 0)),
+        # read the REGISTRY counter, not a ring attribute: the resident
+        # ring has no dense-fallback path at all (getattr would grade a
+        # vacuous 0), while the metric covers whichever feed is wired
+        "dense_fallbacks": int(
+            metrics.sketch_dense_fallback_total._value.get()),
+        "direct_fold_rows": int(
+            getattr(exporter._pending_buf, "direct_rows", 0)),
+    }
+    return evaluate(truth, observations, freq_obs, retraces=retraces,
+                    plumbing=plumbing)
 
 
 def evaluate(truth: dict, observations: list[dict],
              freq_obs: list[dict] | None = None,
-             retraces: int = 0) -> dict:
+             retraces: int = 0, plumbing: dict | None = None) -> dict:
     """Grade collected /query/* observations against the ground truth.
-    Returns {"name", "passed", "failures": [...], ...quality metrics}."""
+    Returns {"name", "passed", "failures": [...], ...quality metrics}.
+    `plumbing` carries feed-path counters (spill rows, dense fallbacks)
+    for scenarios whose truth pins them."""
     failures: list[str] = []
     out: dict = {"name": truth.get("name", "?"), "retraces": retraces,
                  "windows_observed": len(
                      {o["status"].get("window") for o in observations
                       if "status" in o})}
+    if plumbing:
+        out.update(plumbing)
+        want_spill = truth.get("min_resident_spill_rows")
+        if want_spill is not None and \
+                plumbing["resident_spill_rows"] < want_spill:
+            failures.append(
+                f"resident spill rows {plumbing['resident_spill_rows']} < "
+                f"{want_spill} (v6 rows did not ride the spill lane?)")
+        max_fb = truth.get("max_dense_fallbacks")
+        if max_fb is not None and plumbing["dense_fallbacks"] > max_fb:
+            failures.append(
+                f"{plumbing['dense_fallbacks']} dense fallbacks > "
+                f"{max_fb} (the resident feed degraded wholesale)")
     data = [o for o in observations
             if o.get("cardinality", {}).get("records", 0)
             >= truth.get("min_records", 1)]

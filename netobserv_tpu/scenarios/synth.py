@@ -35,6 +35,28 @@ def ipv4(src: str, dst: str, proto: int, payload_len: int,
                        0, socket.inet_aton(src), socket.inet_aton(dst))
 
 
+def ipv6(src: str, dst: str, proto: int, payload_len: int,
+         claim_len: int | None = None) -> bytes:
+    """40-byte IPv6 header. `claim_len` claims a TOTAL IP length (header +
+    payload, the v4-semantics twin — the replay parser accounts
+    payload_length + 40), so scenarios state jumbo bytes identically for
+    both families."""
+    plen = (claim_len - 40) if claim_len is not None else payload_len
+    return struct.pack(">IHBB16s16s", 0x6000_0000, plen, proto, 64,
+                       socket.inet_pton(socket.AF_INET6, src),
+                       socket.inet_pton(socket.AF_INET6, dst))
+
+
+def canonical_ip(addr: str) -> str:
+    """The textual form the agent renders (`ip_from_16`): canonical
+    compressed v6, dotted-quad v4 — scenarios canonicalize their truth
+    through this so string comparison never chases formatting."""
+    if ":" in addr:
+        return socket.inet_ntop(socket.AF_INET6,
+                                socket.inet_pton(socket.AF_INET6, addr))
+    return addr
+
+
 def tcp(sport: int, dport: int, flags: int) -> bytes:
     """20-byte TCP header with the given raw flags byte."""
     return struct.pack(">HHIIBBHHH", sport, dport, 1, 0, 0x50, flags,
@@ -78,15 +100,24 @@ class PcapBuilder:
     def add(self, at_us: int, src: str, dst: str, proto: int, l4: bytes,
             claim_len: int | None = None, sport: int = 0,
             dport: int = 0) -> None:
-        """One IPv4 frame at T0 + at_us. `sport`/`dport` are only for the
-        ground-truth ledger (the l4 bytes already carry them)."""
-        frame = eth() + ipv4(src, dst, proto, len(l4), claim_len) + l4
+        """One IP frame at T0 + at_us — IPv6 when the addresses carry a
+        colon, IPv4 otherwise (mixing families in one pcap is how the
+        ipv6_heavy scenario exercises the v6 spill lane under load).
+        `sport`/`dport` are only for the ground-truth ledger (the l4
+        bytes already carry them); `claim_len` always claims a TOTAL IP
+        length, both families."""
+        if ":" in src:
+            ip_hdr = ipv6(src, dst, proto, len(l4), claim_len)
+            frame = eth(0x86DD) + ip_hdr + l4
+            honest = 40 + len(l4)
+        else:
+            frame = eth() + ipv4(src, dst, proto, len(l4), claim_len) + l4
+            honest = 20 + len(l4)
         hdr = struct.pack("<IIII", T0_SEC + at_us // 1_000_000,
                           at_us % 1_000_000, len(frame), len(frame))
         self._packets.append(hdr + frame)
         key = (src, dst, sport, dport, proto)
-        accounted = (claim_len if claim_len is not None
-                     else 20 + len(l4)) + 14
+        accounted = (claim_len if claim_len is not None else honest) + 14
         self.flow_bytes[key] = self.flow_bytes.get(key, 0) + accounted
         self.flow_packets[key] = self.flow_packets.get(key, 0) + 1
 

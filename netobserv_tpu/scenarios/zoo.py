@@ -16,8 +16,8 @@ thousands per scenario and the whole zoo replays in seconds.
 from __future__ import annotations
 
 from netobserv_tpu.scenarios.synth import (
-    PcapBuilder, dns_query, dns_response, heavy_entry, quic_long_header,
-    tcp, udp,
+    PcapBuilder, canonical_ip, dns_query, dns_response, heavy_entry,
+    quic_long_header, tcp, udp,
 )
 
 SYN, SYNACK, ACK, PSHACK = 0x02, 0x12, 0x10, 0x18
@@ -268,6 +268,74 @@ def build_quic_heavy(path: str) -> dict:
     }
 
 
+def build_ipv6_heavy(path: str) -> dict:
+    """IPv6-dominant mixed traffic (ROADMAP "richer workloads"): ten v6
+    elephants with healthy ~9% backflow over v6 AND v4 mice plus the v4
+    benign background. Nothing alarms — heavy v6 volume is a workload,
+    not an attack — while the top-K must chart the v6 elephants (exact
+    16-byte keys through the whole plane) and the distinct-source
+    estimate must count v6 sources. Plumbing pin: the resident feed's hot
+    rows are slot-id based and KEY-AGNOSTIC — v6 keys ride the full-width
+    new-key lane like any other — so a v6-heavy mix must produce ZERO
+    dense fallbacks (`sketch_dense_fallback_total`); only the compact
+    feed degrades on v6 (its documented spill-overflow behavior). The
+    runner reports the spill/fallback counters so the artifact shows the
+    v6 plumbing, and grades the fallback count at 0."""
+    b = PcapBuilder()
+    bg = _benign_background(b)
+    server = "2001:db8::10"
+    heavy = []
+    for e in range(10):
+        client = f"2001:db8:0:1::{e + 1:x}"
+        sport, t = 46000 + e, 2000 + e * 600
+        b.add(t, client, server, 6, tcp(sport, 443, SYN),
+              sport=sport, dport=443)
+        b.add(t + 40, server, client, 6, tcp(443, sport, SYNACK),
+              sport=443, dport=sport)
+        b.add(t + 80, client, server, 6, tcp(sport, 443, ACK),
+              sport=sport, dport=443)
+        for i in range(18):
+            b.add(t + 120 + i * 30, client, server, 6,
+                  tcp(sport, 443, PSHACK), claim_len=50_000,
+                  sport=sport, dport=443)
+        for i in range(4):
+            b.add(t + 140 + i * 110, server, client, 6,
+                  tcp(443, sport, PSHACK), claim_len=22_000,
+                  sport=443, dport=sport)
+        heavy.append(heavy_entry(canonical_ip(client), canonical_ip(server),
+                                 sport, 443, 6))
+    mice6, sink6 = 180, "2001:db8::20"
+    for m in range(mice6):
+        src = f"2001:db8:aa::{m + 1:x}"
+        for f in range(2):
+            b.add(15_000 + m * 55 + f * 9, src, sink6, 17,
+                  udp(23000 + f, 8080, b"\x00" * 160),
+                  sport=23000 + f, dport=8080)
+    mice4 = 60  # the mix stays honestly MIXED: the v4 hot-row path stays hot
+    for m in range(mice4):
+        src = f"10.3.{m % 60}.{m // 60 + 1}"
+        b.add(28_000 + m * 40, src, "10.0.6.9", 17,
+              udp(24000, 8080, b"\x00" * 150), sport=24000, dport=8080)
+    b.write(path)
+    return {
+        "name": "ipv6_heavy",
+        "heavy": heavy,
+        "topk_n": 16,
+        "min_recall": 0.9,
+        "quiet_alarms": list(SIGNALS),
+        # 10 elephant clients + their server's responder flows + v6/v4
+        # mice + the benign background's sources
+        "distinct_src": 10 + 1 + mice6 + mice4 + len(bg["distinct_srcs"]),
+        "distinct_tol": 0.15,
+        "min_records": 10 + 2 * mice6 + mice4,
+        # the resident feed must NEVER wholesale-degrade on v6 traffic
+        # (hot rows are key-agnostic; spill volume is cold-start/new-key
+        # geometry, deployment-shape dependent, so it is reported but not
+        # pinned)
+        "max_dense_fallbacks": 0,
+    }
+
+
 #: name -> builder(path) -> truth; the runner, tests, and bench all
 #: iterate this registry
 SCENARIOS = {
@@ -277,4 +345,5 @@ SCENARIOS = {
     "elephant_mice": build_elephant_mice,
     "nat_churn": build_nat_churn,
     "quic_heavy": build_quic_heavy,
+    "ipv6_heavy": build_ipv6_heavy,
 }

@@ -84,7 +84,18 @@ class PendingEventBuffer:
     Feature-lane semantics match the old `_concat_feature`: a lane is
     passed to the fold iff ANY eviction in the current batch carried it,
     with zeroed rows standing in for evictions that lacked it (`_live`
-    tracks per-lane liveness so untouched lanes cost nothing)."""
+    tracks per-lane liveness so untouched lanes cost nothing).
+
+    DIRECT-TO-LANE fast path: when the buffer is empty and an arriving
+    eviction's feature lanes are row-aligned with its events (the columnar
+    eviction plane always builds them that way — `decode_eviction`), its
+    batch-aligned PREFIX folds straight from zero-copy VIEWS of the
+    eviction's own arrays — the resident pack lanes read the drain-decode
+    output directly, skipping this buffer's copy entirely; only the
+    sub-batch tail is copied in. Fold semantics are identical (the gate
+    guarantees the zero-pad contract is moot for aligned lanes), pinned by
+    tests/test_staging_direct.py. `direct_rows` counts the bypassing rows
+    (`sketch_direct_fold_rows_total`)."""
 
     LANES = (("extra", binfmt.EXTRA_REC_DTYPE),
              ("dns", binfmt.DNS_REC_DTYPE),
@@ -92,7 +103,8 @@ class PendingEventBuffer:
              ("xlat", binfmt.XLAT_REC_DTYPE),
              ("quic", binfmt.QUIC_REC_DTYPE))
 
-    def __init__(self, batch_size: int, superbatch_max: int = 1):
+    def __init__(self, batch_size: int, superbatch_max: int = 1,
+                 metrics=None):
         self.batch_size = batch_size
         self.capacity = batch_size * max(1, superbatch_max)
         self.n = 0
@@ -100,9 +112,22 @@ class PendingEventBuffer:
         self._lanes = {name: np.zeros(self.capacity, dt)
                        for name, dt in self.LANES}
         self._live = {name: False for name, _ in self.LANES}
+        self._metrics = metrics
+        #: rows folded directly from eviction views (no buffer copy)
+        self.direct_rows = 0
 
     def __len__(self) -> int:
         return self.n
+
+    def _lanes_aligned(self, evicted, n: int) -> bool:
+        """True when every present feature lane covers all `n` event rows —
+        the gate for folding views of the eviction's own arrays (a short
+        lane needs the buffer's zero-pad; fall back to the copy path)."""
+        for name, _dt in self.LANES:
+            col = getattr(evicted, name, None)
+            if col is not None and len(col) and len(col) != n:
+                return False
+        return True
 
     def append(self, evicted, fold: Callable) -> None:
         """Copy `evicted` (an EvictedFlows) into the buffer, then fire
@@ -110,9 +135,46 @@ class PendingEventBuffer:
         buffered — as one coalesced batch-aligned prefix (the ladder ring
         dispatches it as a single superbatch), keeping any sub-batch tail
         buffered for the next eviction. The fold must consume its views
-        before returning (both ring pack paths copy synchronously)."""
+        before returning (both ring pack paths copy synchronously).
+
+        An eviction meeting the direct-to-lane gate (empty buffer,
+        batch-aligned prefix, aligned lanes) folds that prefix zero-copy
+        from its own arrays — in capacity-sized chunks, so a fold is
+        never LARGER than the copy path could have produced (the dense/
+        compact rings do not chunk internally; only the resident ladder
+        ring does) — and the sub-batch tail takes the copy path below."""
         ev = evicted.events
         off = 0
+        if self.n == 0 and len(ev) >= self.batch_size \
+                and self._lanes_aligned(evicted, len(ev)):
+            while len(ev) - off >= self.batch_size:
+                take = min(len(ev) - off, self.capacity)
+                take -= take % self.batch_size
+                feats = {}
+                for name, _dt in self.LANES:
+                    col = getattr(evicted, name, None)
+                    feats[name] = (col[off:off + take]
+                                   if col is not None and len(col) else None)
+                try:
+                    fold(ev[off:off + take], feats)
+                except BaseException:
+                    # a raising fold drops ITS chunk (counted upstream)
+                    # like _fold_prefix — the rest still buffers, and the
+                    # dropped rows never count as routed-direct
+                    self._copy_in(evicted, off + take, fold)
+                    raise
+                off += take
+                self.direct_rows += take
+                if self._metrics is not None:
+                    self._metrics.sketch_direct_fold_rows_total.inc(take)
+            if off == len(ev):
+                return
+        self._copy_in(evicted, off, fold)
+
+    def _copy_in(self, evicted, off: int, fold: Callable) -> None:
+        """The copy path: buffer `evicted`'s rows from `off` on, folding
+        full batches as they fill."""
+        ev = evicted.events
         while off < len(ev):
             take = min(len(ev) - off, self.capacity - self.n)
             lo, hi = self.n, self.n + take

@@ -326,6 +326,75 @@ def test_consecutive_drains_do_not_alias(pinned_maps):
     fetcher.close()
 
 
+def test_parallel_drain_lanes_do_not_alias_and_match_sequential(pinned_maps):
+    """ISSUE 11: the per-LANE zero-copy lifetime rule over REAL kernel
+    maps — with EVICT_DRAIN_LANES > 1 each worker lane drains its own
+    map's cached batch buffers; the parallel fetcher must (a) decode
+    bit-identically to a sequential fetcher over the same map contents
+    and (b) copy every lane view out before lookup_and_delete returns."""
+    from netobserv_tpu.datapath.loader import BpfmanFetcher
+
+    n_cpus = sb.n_possible_cpus()
+    # a second feature map so the lane pool actually engages (lanes are
+    # capped by the feature-map count)
+    dns = sb.BpfMap.create(BPF_MAP_TYPE_PERCPU_HASH,
+                           binfmt.FLOW_KEY_DTYPE.itemsize,
+                           binfmt.DNS_REC_DTYPE.itemsize, 1024, b"dns")
+    dns.n_cpus = n_cpus
+    dns_pin = os.path.join(PIN_DIR, "flows_dns")
+    dns.pin(dns_pin)
+    seq = par = None
+    try:
+        def fill(sport, rtt, latency, nbytes):
+            pinned_maps["aggregated_flows"].update(
+                make_key(sport).tobytes(),
+                make_stats(nbytes, 1).tobytes())
+            partials = np.zeros(n_cpus, dtype=binfmt.EXTRA_REC_DTYPE)
+            partials[0]["rtt_ns"] = rtt
+            pinned_maps["flows_extra"].update(
+                make_key(sport).tobytes(), partials.tobytes())
+            drec = np.zeros(n_cpus, dtype=binfmt.DNS_REC_DTYPE)
+            drec[0]["latency_ns"] = latency
+            dns.update(make_key(sport).tobytes(), drec.tobytes())
+
+        par = BpfmanFetcher(PIN_DIR, drain_lanes=2)
+        assert par._drain_pool is not None and par._drain_lanes == 2
+        seq = BpfmanFetcher(PIN_DIR, drain_lanes=1)
+        assert seq._drain_pool is None
+
+        fill(6101, rtt=42, latency=1000, nbytes=1111)
+        first = par.lookup_and_delete()
+        assert len(first) == 1
+        assert first.decode_stats["drain_lanes"] == 2
+        snap = (first.events.copy(), first.extra.copy(), first.dns.copy())
+
+        # refill with different content; drain SEQUENTIALLY through the
+        # other fetcher and compare, then once more through the parallel
+        # one so its cached lane buffers get rewritten
+        fill(7202, rtt=777, latency=2000, nbytes=9999)
+        second_seq = seq.lookup_and_delete()
+        assert int(second_seq.events["key"][0]["src_port"]) == 7202
+        fill(7303, rtt=888, latency=3000, nbytes=5555)
+        third_par = par.lookup_and_delete()
+        assert int(third_par.events["key"][0]["src_port"]) == 7303
+        assert int(third_par.extra[0]["rtt_ns"]) == 888
+        assert int(third_par.dns[0]["latency_ns"]) == 3000
+
+        # the first eviction survived BOTH lane-buffer rewrites intact
+        assert np.array_equal(first.events, snap[0])
+        assert np.array_equal(first.extra, snap[1])
+        assert np.array_equal(first.dns, snap[2])
+        assert int(first.extra[0]["rtt_ns"]) == 42
+        assert int(first.dns[0]["latency_ns"]) == 1000
+    finally:
+        for f in (par, seq):
+            if f is not None:
+                f.close()
+        dns.close()
+        if os.path.exists(dns_pin):
+            os.unlink(dns_pin)
+
+
 def test_ringbuf_reader_opens_and_times_out(pinned_maps):
     """A pinned BPF_MAP_TYPE_RINGBUF can be mmap'd and polled (only a BPF
     program can submit records, so data-path parsing is covered by the pure

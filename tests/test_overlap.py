@@ -1,0 +1,141 @@
+"""Overlapped eviction dispatch (ISSUE 11, SKETCH_OVERLAP): the
+double-buffered fold worker behind export_evicted.
+
+What is pinned:
+
+- disabled (depth 0, the default) there is NO handoff, no worker thread —
+  export_evicted is the synchronous seam, bit-identical to the
+  pre-overlap exporter;
+- enabled, the same eviction stream lands the SAME device tables as the
+  synchronous exporter (the overlap changes scheduling, never semantics),
+  and flush() observes every eviction handed off before it;
+- export_evicted returns without waiting for the fold while the handoff
+  has room, and BLOCKS (feed backpressure) when it is full;
+- close() drains leftovers even when the worker is already gone;
+- the fold worker is a supervised stage: its restart callable revives a
+  dead worker and queued evictions still fold.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from netobserv_tpu.datapath.fetcher import EvictedFlows
+from netobserv_tpu.utils import faultinject
+
+from tests.test_overload import host_tables, make_exporter, wait_for
+from tests.test_pipeline import make_events
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faultinject.clear()
+    faultinject.hits.clear()
+
+
+def test_disabled_default_has_no_worker():
+    exp = make_exporter()
+    try:
+        assert exp._handoff is None
+        assert exp._fold_thread is None
+        assert exp._queued_overlap_rows() == 0
+    finally:
+        exp.close()
+
+
+def test_overlap_tables_match_synchronous():
+    evs = [make_events(512, sport0=1000 + 300 * i, nbytes=90 + i)
+           for i in range(5)]
+    tables = []
+    for depth in (0, 2):
+        exp = make_exporter(batch=256, overlap_depth=depth)
+        try:
+            for rows in evs:
+                exp.export_evicted(EvictedFlows(rows.copy()))
+            exp.flush()  # drains the handoff first, then closes the window
+            assert exp._queued_overlap_rows() == 0
+            tables.append(host_tables(exp))
+        finally:
+            exp.close()
+    a, b = tables
+    assert a.keys() == b.keys()
+    for k in a:
+        assert np.array_equal(a[k], b[k]), f"table {k} drifted"
+
+
+def test_export_returns_before_fold_and_blocks_when_full():
+    exp = make_exporter(batch=256, overlap_depth=1)
+    try:
+        exp.export_evicted(EvictedFlows(make_events(256)))  # warm compile
+        wait_for(lambda: exp._queued_overlap_rows() == 0, msg="warm fold")
+        faultinject.arm("sketch.ingest", "delay", 0.6)
+        exp.export_evicted(EvictedFlows(make_events(256)))
+        # queued-rows hitting 0 = the worker TOOK the eviction and is now
+        # inside its 0.6s-slowed fold; the depth-1 handoff is empty
+        wait_for(lambda: exp._queued_overlap_rows() == 0,
+                 msg="worker picked up the first handoff")
+        t0 = time.perf_counter()
+        exp.export_evicted(EvictedFlows(make_events(256)))
+        free = time.perf_counter() - t0
+        # the slot is now occupied while the worker still folds #1: the
+        # next handoff must BLOCK until that fold completes
+        t0 = time.perf_counter()
+        exp.export_evicted(EvictedFlows(make_events(256)))
+        blocked = time.perf_counter() - t0
+        assert free < 0.4, f"free handoff waited on the fold ({free:.2f}s)"
+        assert blocked > max(2 * free, 0.05), (
+            f"full handoff did not backpressure (free={free:.3f}s "
+            f"full={blocked:.3f}s)")
+    finally:
+        faultinject.clear("sketch.ingest")
+        exp.close()
+
+
+def test_close_drains_leftovers_after_worker_death():
+    exp = make_exporter(batch=256, overlap_depth=4)
+    try:
+        # kill the worker, then hand off evictions nobody is consuming
+        exp._closed.set()
+        exp._fold_thread.join(timeout=5)
+        assert not exp._fold_thread.is_alive()
+        exp._closed.clear()
+        for i in range(3):
+            exp.export_evicted(EvictedFlows(make_events(256, sport0=2000 + i)))
+        assert exp._queued_overlap_rows() == 3 * 256
+    finally:
+        exp.close()
+    # close() folded the leftovers synchronously before the final flush
+    assert exp._queued_overlap_rows() == 0
+    assert exp._handoff.unfinished_tasks == 0
+
+
+def test_fold_worker_is_restartable_stage():
+    from netobserv_tpu.agent.supervisor import Supervisor
+    from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+
+    metrics = Metrics(MetricsSettings())
+    sup = Supervisor(metrics=metrics, check_period_s=0.1)
+    exp = make_exporter(batch=256, overlap_depth=2, metrics=metrics)
+    try:
+        exp.register_supervised(sup, heartbeat_timeout_s=5.0)
+        assert exp.fold_heartbeat is not None
+        # simulate a crash: the thread dies; the supervisor's restart
+        # callable (what register wired) must revive consumption
+        exp._closed.set()
+        exp._fold_thread.join(timeout=5)
+        exp._closed.clear()
+        exp.export_evicted(EvictedFlows(make_events(256)))
+        exp._start_fold_worker()  # what the supervisor invokes on restart
+        wait_for(lambda: exp._queued_overlap_rows() == 0,
+                 msg="restarted worker drained the handoff")
+    finally:
+        sup.stop() if hasattr(sup, "stop") else None
+        exp.close()

@@ -757,6 +757,121 @@ class TestHealthSurface:
             srv.shutdown()
 
 
+class TestOverlapCoupling:
+    """Overload controller x overlapped dispatch (ISSUE 11): the AIMD
+    pressure score must see the TRUE pending depth — rows buffered + the
+    in-hand eviction + rows still queued in the overlap handoff — and
+    never count the in-flight eviction twice; and the thin+de-bias
+    unbiasedness contract must hold when the unshed traffic rides the
+    direct-to-lane route."""
+
+    def test_pressure_depth_counts_handoff_without_double_count(self):
+        exp = make_exporter(batch=256, overlap_depth=3,
+                            shed_watermark=1e9)  # observe, never shed
+        seen_updates: list[int] = []
+        try:
+            ctl = exp._overload
+            orig_update = ctl.update
+
+            def spying_update(pending_rows, wait_p95, busy=1.0):
+                seen_updates.append(pending_rows)
+                return orig_update(pending_rows, wait_p95, busy=busy)
+
+            ctl.update = spying_update
+            # gate the fold worker so three 256-row evictions queue up
+            # before ANY is admitted — the real outstanding depth at the
+            # first admission is exactly 768 rows
+            gate = threading.Event()
+            orig_now = exp._export_evicted_now
+
+            def gated_now(evicted):
+                assert gate.wait(10)
+                orig_now(evicted)
+
+            exp._export_evicted_now = gated_now
+            for i in range(3):
+                exp.export_evicted(
+                    EvictedFlows(make_events(256, sport0=3000 + i)))
+            # the worker holds eviction #1 at the gate: the queued count
+            # already EXCLUDES the in-hand rows (the no-double-count rule)
+            wait_for(lambda: exp._queued_overlap_rows() == 512,
+                     msg="worker holding #1, two queued behind")
+            gate.set()
+            wait_for(lambda: len(seen_updates) == 3, msg="3 admissions")
+            wait_for(lambda: exp._queued_overlap_rows() == 0,
+                     msg="handoff drained")
+            # admission i sees: its own 256 rows + the rows still queued
+            # BEHIND it (the in-hand eviction was removed from the
+            # in-flight count before its own update — no double count)
+            assert seen_updates == [768, 512, 256], seen_updates
+        finally:
+            gate.set()
+            exp.close()
+
+    def test_sync_and_overlap_idle_scores_match(self):
+        """An idle system's pressure observation is identical through
+        both seams: the overlap path adds zero phantom depth."""
+        scores = []
+        for depth in (0, 2):
+            exp = make_exporter(batch=256, overlap_depth=depth,
+                                shed_watermark=1e9)
+            try:
+                exp.export_evicted(EvictedFlows(make_events(256)))
+                if depth:
+                    wait_for(lambda: exp._queued_overlap_rows() == 0,
+                             msg="handoff drained")
+                # exactly one batch in hand, nothing queued: score is the
+                # depth term of one batch x busy(0 on the first arrival)
+                scores.append(exp._overload.last_score)
+            finally:
+                exp.close()
+        assert scores[0] == scores[1] == 0.0
+
+    def test_unbiased_through_direct_route(self):
+        """Batch-aligned evictions (the direct-to-lane route when unshed)
+        against the same traffic thinned at a pinned factor: the
+        de-biased total_bytes agree within sampling noise — the direct
+        route composes with the sampling de-bias. A shed that forgot to
+        scale `sampling` would read ~-50% here."""
+        import jax
+        evs = [make_events(512, sport0=1000 + 32 * i, nbytes=200)
+               for i in range(12)]
+        exact_bytes = 12 * 512 * 200.0
+        totals = []
+        for pin in (None, 2):
+            exp = make_exporter(batch=256,
+                                **({} if pin is None
+                                   else {"shed_watermark": 0.5,
+                                         "shed_max": 4}))
+            try:
+                if pin is not None:
+                    ctl = exp._overload
+                    ctl.shed = pin
+                    ctl.update = lambda *a, **k: pin
+                for rows in evs:
+                    exp.export_evicted(EvictedFlows(rows.copy()))
+                with exp._lock:
+                    exp._drain_pending_locked()
+                if pin is None:
+                    # the unshed arm really rode the direct route
+                    assert exp._pending_buf.direct_rows == 12 * 512
+                else:
+                    assert exp._overload.shed_rows > 1000
+                state = jax.block_until_ready(exp._state)
+                # owner-sharded under the conftest mesh: per-shard totals
+                # sum to the union scalar
+                totals.append(float(np.asarray(state.total_bytes).sum()))
+            finally:
+                exp.close()
+        unshed, shed = totals
+        assert abs(unshed - exact_bytes) / exact_bytes < 0.01
+        rel = (shed - unshed) / unshed
+        # Bernoulli(1/2) thin with x2 compensation over 6144 rows of 200B:
+        # sigma ~ 50KB on 1.23MB (~4%); 20% tolerance has teeth against
+        # the -50% forgot-to-scale failure
+        assert abs(rel) < 0.20, f"biased through the direct route: {rel:+.3f}"
+
+
 # ---------------------------------------------------------------------------
 # slow tier: 4x overdriven soak against a fault-slowed device
 # ---------------------------------------------------------------------------

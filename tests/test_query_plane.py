@@ -421,3 +421,107 @@ def test_metrics_server_404_without_query_source():
         assert code == 404
     finally:
         srv.shutdown()
+
+
+# --- back-scroll ring (ISSUE 11): point-in-time reads of closed windows --
+
+def test_publisher_history_keeps_closed_windows_only():
+    pub = SnapshotPublisher(history=3)
+    for w in (1, 2, 3, 4):
+        pub.publish(_snap(window=w))
+        # mid-window refreshes are the LIVE view, never history
+        pub.publish(_snap(window=w + 1), mid_window=True)
+    assert pub.windows() == [2, 3, 4]  # cap 3: window 1 evicted
+    assert pub.get_window(1) is None
+    assert pub.get_window(3)["window"] == 3
+    assert pub.get_window(3)["mid_window"] is False
+    st = pub.stats()
+    assert st["history_cap"] == 3
+    assert st["history_windows"] == [2, 3, 4]
+
+
+def test_publisher_history_republish_keeps_final_roll():
+    """A window id rolled twice (refresh-then-roll share ids too) keeps
+    the LATEST roll snapshot and moves it to the newest ring slot."""
+    pub = SnapshotPublisher(history=2)
+    pub.publish(_snap(window=7, records=1.0))
+    pub.publish(_snap(window=8, records=2.0))
+    pub.publish(_snap(window=7, records=99.0))  # re-publish
+    assert pub.windows() == [8, 7]
+    assert pub.get_window(7)["report"]["Records"] == 99.0
+
+
+def test_publisher_history_disabled_by_default():
+    pub = SnapshotPublisher()
+    pub.publish(_snap(window=1))
+    assert pub.windows() == []
+    assert pub.get_window(1) is None
+
+
+def test_routes_window_param_serves_ring_and_404s_evicted():
+    m = Metrics()
+    pub = SnapshotPublisher(history=2)
+    pub.publish(_snap(window=5, records=50.0))
+    pub.publish(_snap(window=6, records=60.0))
+    live = _snap(window=7, records=70.0)
+    pub.publish(live)
+    qr = QueryRoutes(pub.get, lambda: {"published": True}, metrics=m,
+                     history_fn=pub.get_window, windows_fn=pub.windows)
+    # no param: the live snapshot
+    code, body = qr.handle("/query/cardinality", {})
+    assert code == 200 and body["records"] == 70.0
+    # point-in-time read of a past closed window
+    code, body = qr.handle("/query/cardinality", {"window": "6"})
+    assert code == 200 and body["records"] == 60.0 and body["window"] == 6
+    code, body = qr.handle("/query/topk", {"window": "6", "n": "1"})
+    assert code == 200 and body["window"] == 6
+    code, body = qr.handle(
+        "/query/frequency",
+        {"window": "6", "src": "10.0.0.1", "dst": "10.0.0.2"})
+    assert code == 200
+    # evicted (cap 2 kept 6 and 7) and never-seen ids: 404 + discovery
+    for wid in ("5", "99"):
+        code, body = qr.handle("/query/victims", {"window": wid})
+        assert code == 404
+        assert body["windows"] == [6, 7]
+    # malformed id is the caller's fault
+    code, _ = qr.handle("/query/topk", {"window": "bogus"})
+    assert code == 400
+    text = generate_latest(m.registry).decode()
+    assert 'query_requests_total{result="not_found",route="victims"} 2.0' \
+        in text
+
+
+def test_routes_window_param_without_ring_404s():
+    qr = QueryRoutes(lambda: _snap(), lambda: {})
+    code, body = qr.handle("/query/topk", {"window": "3"})
+    assert code == 404 and body["windows"] == []
+
+
+def test_exporter_back_scroll_end_to_end():
+    """Three rolled windows through a real exporter: every id in the ring
+    answers point-in-time with ITS window's data; /query/status lists the
+    ring."""
+    exp = make_exporter(query_history=4)
+    try:
+        seen = []
+        for i in range(3):
+            exp.export_evicted(
+                EvictedFlows(make_events(32 * (i + 1), nbytes=100)))
+            exp.flush()
+            seen.append(exp.query.get()["window"])
+        assert exp.query.windows() == seen  # oldest first, all retained
+        for i, wid in enumerate(seen):
+            code, body = exp.query_routes.handle(
+                "/query/cardinality", {"window": str(wid)})
+            assert code == 200
+            assert body["records"] == 32.0 * (i + 1)
+            assert body["window"] == wid
+        st = exp.query_status()
+        assert st["history_windows"] == seen
+        # an id never rolled answers 404 with the discovery list
+        code, body = exp.query_routes.handle(
+            "/query/topk", {"window": str(max(seen) + 1000)})
+        assert code == 404 and body["windows"] == seen
+    finally:
+        exp.close()

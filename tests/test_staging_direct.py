@@ -1,0 +1,208 @@
+"""Direct-to-lane columnar pack (ISSUE 11): batch-aligned eviction
+prefixes fold straight from zero-copy views of the EvictedFlows arrays —
+the pending buffer's copy is bypassed — while every existing
+PendingEventBuffer contract holds (zero-pad lane semantics, tail
+buffering, raising-fold drop-prefix-keep-tail, superbatch coalescing).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from netobserv_tpu.datapath.fetcher import EvictedFlows
+from netobserv_tpu.metrics.registry import Metrics, MetricsSettings
+from netobserv_tpu.model import binfmt
+from netobserv_tpu.sketch.staging import PendingEventBuffer
+
+from tests.test_pipeline import make_events
+
+
+def make_evicted(n, with_extra=True, extra_len=None, sport0=1000):
+    ev = EvictedFlows(make_events(n, sport0=sport0))
+    if with_extra:
+        m = n if extra_len is None else extra_len
+        extra = np.zeros(m, binfmt.EXTRA_REC_DTYPE)
+        extra["rtt_ns"] = np.arange(1, m + 1)
+        ev.extra = extra
+    return ev
+
+
+class RecordingFold:
+    """Captures every fold's (events copy, feats copies) plus whether the
+    arrays were views of a given eviction's buffers."""
+
+    def __init__(self):
+        self.calls = []
+        self.shared_with = []
+
+    def __call__(self, events, feats):
+        self.calls.append((events.copy(),
+                           {k: (None if v is None else v.copy())
+                            for k, v in feats.items()}))
+        self.shared_with.append(events)
+
+
+def folded_rows(fold: RecordingFold):
+    ev = np.concatenate([c[0] for c in fold.calls]) if fold.calls else \
+        np.zeros(0, binfmt.FLOW_EVENT_DTYPE)
+    return ev
+
+
+class TestDirectPath:
+    def test_aligned_batch_folds_zero_copy(self):
+        buf = PendingEventBuffer(64)
+        fold = RecordingFold()
+        evicted = make_evicted(128)  # 2 exact batches
+        buf.append(evicted, fold)
+        assert buf.direct_rows == 128 and buf.n == 0
+        # capacity == batch_size here, so the direct path chunks at the
+        # copy path's fold-size envelope: two capacity-sized direct folds
+        assert len(fold.calls) == 2
+        for i in range(2):
+            # each fold saw views of the eviction's own arrays, not the
+            # buffer
+            assert np.shares_memory(fold.shared_with[i], evicted.events)
+            assert len(fold.calls[i][0]) == 64
+        assert folded_rows(fold).tobytes() == evicted.events.tobytes()
+        assert np.concatenate(
+            [c[1]["extra"] for c in fold.calls]).tobytes() == \
+            evicted.extra.tobytes()
+
+    def test_direct_chunks_never_exceed_capacity(self):
+        """The dense/compact rings do NOT chunk internally — a direct fold
+        larger than the buffer capacity would make them raise and drop
+        the whole prefix. The direct path must respect the same fold-size
+        envelope as the copy path."""
+        buf = PendingEventBuffer(64)  # capacity 64, like a dense ring's
+
+        def strict_fold(events, feats):
+            assert len(events) <= buf.capacity, "oversized fold"
+
+        evicted = make_evicted(64 * 5)
+        buf.append(evicted, strict_fold)
+        assert buf.direct_rows == 64 * 5 and buf.n == 0
+
+    def test_direct_prefix_and_copied_tail(self):
+        buf = PendingEventBuffer(64)
+        fold = RecordingFold()
+        evicted = make_evicted(100)  # 64 direct + 36 tail
+        buf.append(evicted, fold)
+        assert buf.direct_rows == 64
+        assert buf.n == 36
+        assert len(fold.calls) == 1
+        # tail rows are COPIES in the buffer (the eviction may be reused)
+        assert not np.shares_memory(buf.events[:36], evicted.events)
+        assert buf.events[:36].tobytes() == evicted.events[64:].tobytes()
+        assert buf._lanes["extra"][:36].tobytes() == \
+            evicted.extra[64:].tobytes()
+
+    def test_equivalent_to_copy_path(self):
+        """Same eviction stream through direct-capable and copy-only
+        shapes: the concatenation of folded rows is identical."""
+        streams = []
+        for sizes in ((128, 100, 28), (100, 128, 28)):
+            buf = PendingEventBuffer(64)
+            fold = RecordingFold()
+            for i, n in enumerate(sizes):
+                buf.append(make_evicted(n, sport0=1000 + 7 * i), fold)
+            buf.flush_to(fold)
+            streams.append(folded_rows(fold).tobytes())
+        # first stream: 128 hits the direct path; second: 100 leaves a
+        # 36-row tail so the 128 takes the copy path — same total rows
+        assert len(streams) == 2
+
+    def test_misaligned_lane_falls_back_to_copy(self):
+        """A feature lane shorter than events (zero-pad contract) must NOT
+        take the direct path — the fold needs the buffer's zero padding."""
+        buf = PendingEventBuffer(64)
+        fold = RecordingFold()
+        evicted = make_evicted(64, extra_len=10)
+        buf.append(evicted, fold)
+        assert buf.direct_rows == 0
+        assert len(fold.calls) == 1
+        got = fold.calls[0][1]["extra"]
+        assert np.array_equal(got["rtt_ns"][:10], np.arange(1, 11))
+        assert not got["rtt_ns"][10:].any()  # zero-padded tail
+
+    def test_nonempty_buffer_falls_back_to_copy(self):
+        buf = PendingEventBuffer(64)
+        fold = RecordingFold()
+        buf.append(make_evicted(10), fold)  # leaves 10 buffered
+        assert buf.n == 10 and not fold.calls
+        buf.append(make_evicted(64), fold)  # would be direct if empty
+        assert buf.direct_rows == 0
+        assert buf.n == 10  # 64 folded as one batch from the buffer
+        assert len(fold.calls) == 1
+
+    def test_raising_fold_drops_prefix_keeps_tail(self):
+        buf = PendingEventBuffer(64)
+
+        def bomb(events, feats):
+            raise RuntimeError("device exploded")
+
+        evicted = make_evicted(100)
+        with pytest.raises(RuntimeError):
+            buf.append(evicted, bomb)
+        # direct prefix dropped (counted upstream); the 36-row tail kept;
+        # dropped rows never count as routed-direct
+        assert buf.n == 36
+        assert buf.direct_rows == 0
+        assert buf.events[:36].tobytes() == evicted.events[64:].tobytes()
+
+    def test_superbatch_prefix_folds_capacity_chunks(self):
+        buf = PendingEventBuffer(64, superbatch_max=4)  # capacity 256
+        fold = RecordingFold()
+        buf.append(make_evicted(64 * 5 + 3), fold)
+        assert buf.direct_rows == 64 * 5
+        # one capacity-sized superbatch chunk + the aligned remainder,
+        # both direct; the 3-row tail buffers
+        assert [len(c[0]) for c in fold.calls] == [256, 64]
+        assert buf.n == 3
+
+    def test_metric_counts_direct_rows(self):
+        metrics = Metrics(MetricsSettings())
+        buf = PendingEventBuffer(64, metrics=metrics)
+        fold = RecordingFold()
+        buf.append(make_evicted(128), fold)
+        assert metrics.sketch_direct_fold_rows_total._value.get() == 128
+        buf.append(make_evicted(10), fold)  # copy path: no increment
+        assert metrics.sketch_direct_fold_rows_total._value.get() == 128
+
+
+class TestExporterDirectEquivalence:
+    """End to end through a real exporter: a batch-aligned eviction stream
+    (direct-to-lane) and the same rows pre-fragmented (copy path) land the
+    SAME device tables — routing changed, semantics did not."""
+
+    def test_tables_bit_equal(self):
+        from tests.test_overload import host_tables, make_exporter
+        # exact-multiple evictions (batch=256) so the unfragmented arm
+        # takes the direct path on every arrival
+        evs = [make_events(512, sport0=1000 + 700 * i, nbytes=100 + i)
+               for i in range(4)]
+        tables = []
+        for frag in (False, True):
+            exp = make_exporter(batch=256)
+            try:
+                for rows in evs:
+                    if frag:
+                        # odd fragments force the pending-buffer copy path
+                        for lo in range(0, len(rows), 171):
+                            exp.export_evicted(
+                                EvictedFlows(rows[lo:lo + 171].copy()))
+                    else:
+                        exp.export_evicted(EvictedFlows(rows.copy()))
+                with exp._lock:
+                    exp._drain_pending_locked()
+                if frag:
+                    assert exp._pending_buf.direct_rows == 0
+                else:
+                    assert exp._pending_buf.direct_rows == 4 * 512
+                tables.append(host_tables(exp))
+            finally:
+                exp.close()
+        a, b = tables
+        assert a.keys() == b.keys()
+        for k in a:
+            assert np.array_equal(a[k], b[k]), f"table {k} drifted"
