@@ -83,10 +83,14 @@ verify-maps:
 dryrun:
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-# minimum end-to-end slice: synthetic datapath -> pipeline -> stdout flows
+# minimum end-to-end slice: synthetic datapath -> pipeline -> stdout flows,
+# then one live alert raise→clear cycle against the real binary (zoo
+# syn_flood pcap -> tpu-sketch -> alert engine -> /query/alerts HTTP —
+# scripts/smoke_alerts.py)
 smoke:
 	DATAPATH=synthetic EXPORT=stdout CACHE_ACTIVE_TIMEOUT=300ms \
 	  timeout 3 $(PY) -m netobserv_tpu | head -5 || true
+	JAX_PLATFORMS=cpu $(PY) scripts/smoke_alerts.py
 
 # federation e2e slice (~20s, non-gating CI artifact): two in-process
 # agents stream delta frames over real gRPC into a local aggregator and

@@ -1209,6 +1209,13 @@ def scenario_stats() -> dict:
     recalls = [r["topk_recall"] for r in per.values() if "topk_recall" in r]
     errs = [r["distinct_src_err"] for r in per.values()
             if "distinct_src_err" in r]
+    # continuous detection plane: per-scenario time-to-detect (replay
+    # start -> first observed RAISE on /query/alerts) + transition counts
+    # ride each per-scenario dict; the max detect latency and total
+    # transitions aggregate here so the artifact's top level shows a
+    # detection regression at a glance
+    detects = [r["time_to_detect_s"] for r in per.values()
+               if r.get("time_to_detect_s") is not None]
     return {
         "metric": "scenario_pass_rate",
         "value": round(sum(r["passed"] for r in per.values()) / len(per), 3),
@@ -1219,6 +1226,9 @@ def scenario_stats() -> dict:
         # the artifact must still report scenario_pass_rate 0
         "topk_recall_min": min(recalls) if recalls else None,
         "max_distinct_src_err": max(errs) if errs else None,
+        "time_to_detect_max_s": max(detects) if detects else None,
+        "alert_transitions_total": sum(
+            r.get("alert_transitions", 0) for r in per.values()),
         "retraces_total": sum(r.get("retraces", 0) for r in per.values()),
         "scenarios": per,
     }

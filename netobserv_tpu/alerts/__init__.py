@@ -1,0 +1,27 @@
+"""Continuous detection & alerting plane (host-only, jax-free).
+
+The agent's signal plane computes per-window anomaly scores and the query
+plane publishes torn-read-proof snapshots at every roll and mid-window
+refresh; this package WATCHES them: a declarative rule set
+(`alerts/rules.py`), a hysteresis state machine driven by every snapshot
+publish (`alerts/engine.py`), and fan-out sinks (`alerts/sinks.py`).
+Mounted by the tpu-sketch exporter (`/query/alerts`) and the federation
+aggregator (`/federation/alerts`). `ALERT_RULES` unset means no engine
+exists at all — the exporter path stays bit-identical (one is-None check,
+the tracing/fault-point zero-cost bar). docs/architecture.md
+"Continuous detection plane" is the narrative.
+"""
+
+from netobserv_tpu.alerts.engine import AlertEngine, maybe_engine
+from netobserv_tpu.alerts.rules import (
+    SIGNAL_FIELDS, AlertRule, default_rules, parse_rules,
+)
+from netobserv_tpu.alerts.sinks import (
+    AlertSink, LogSink, MetricsSink, WebhookSink, build_sinks,
+)
+
+__all__ = [
+    "AlertEngine", "maybe_engine", "SIGNAL_FIELDS", "AlertRule",
+    "default_rules", "parse_rules", "AlertSink", "LogSink", "MetricsSink",
+    "WebhookSink", "build_sinks",
+]

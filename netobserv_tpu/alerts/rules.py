@@ -1,0 +1,207 @@
+"""Declarative alert rules over published query snapshots (jax-free).
+
+A rule names a snapshot field, a threshold, a hysteresis schedule
+(``raise_evals`` consecutive firing evaluations to RAISE, ``clear_evals``
+quiet ones to CLEAR) and a severity. Rules evaluate ONLY the host-side
+snapshot dict the exporter (or the federation aggregator) publishes —
+never a device array, never an exporter lock.
+
+One-truth notes (drift is the failure mode this module exists to prevent):
+
+- ``SIGNAL_FIELDS`` is THE signal-name -> report-key map. The scenario
+  zoo's ``SIGNALS`` tuple, the query core's ``/query/victims`` payload and
+  the default alert rules all derive from it — a new signal plane lands
+  here once and every surface follows.
+- The per-signal default rules carry NO numeric thresholds of their own:
+  they fire on the report's suspect-bucket lists, which
+  ``report_to_json`` already rendered under the exporter's configured
+  thresholds (``SKETCH_SYNFLOOD_MIN`` et al — the same values
+  ``scenarios/runner.THRESHOLDS`` wires into the zoo's exporter). Zoo
+  grading and live alerting therefore read one threshold set by
+  construction; there is no second copy to drift.
+- Victim naming rides the report's ``probable_victims`` entries, which
+  the renderer computed through ``query/core.victim_bucket_names``
+  (`ops/hashing.DST_BUCKET_SEED`, the ONE implementation) — rules never
+  re-hash an address.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: signal name -> rendered-report suspect-list key — the ONE map
+#: (scenarios/zoo.SIGNALS and query/core.victims_payload derive from it)
+SIGNAL_FIELDS = {
+    "ddos": "DdosSuspectBuckets",
+    "syn_flood": "SynFloodSuspectBuckets",
+    "port_scan": "PortScanSuspectBuckets",
+    "drop_storm": "DropAnomalyBuckets",
+    "asym_conv": "AsymmetricConversationBuckets",
+}
+
+#: default severity per signal (a drop storm or flood is actionable now;
+#: a scan or conversation asymmetry is investigate-next)
+_SEVERITIES = {
+    "ddos": "critical",
+    "syn_flood": "critical",
+    "drop_storm": "critical",
+    "port_scan": "warning",
+    "asym_conv": "warning",
+}
+
+#: per-bucket value field surfaced as the alert's ``value`` (best-effort;
+#: buckets lacking the key report 0.0)
+_VALUE_KEYS = {
+    "ddos": "z",
+    "syn_flood": "syn",
+    "port_scan": "distinct_dst_port_pairs",
+    "drop_storm": "z",
+    "asym_conv": "bytes",
+}
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    """One declarative rule. ``kind``:
+
+    - ``buckets`` — fire one instance per suspect bucket in
+      ``report[field]`` (fingerprint = (rule, bucket id); victims ride the
+      bucket's ``probable_victims``). ``threshold`` is the minimum bucket
+      count for the rule to fire at all (default 1 — the render thresholds
+      already gated each bucket).
+    - ``scalar``  — fire one instance (fingerprint bucket None) when
+      ``float(report[field]) >= threshold``.
+    - ``topk_share`` — fire when the top heavy hitter's ``EstBytes`` share
+      of the window's ``Bytes`` reaches ``threshold`` (a single flow
+      dominating the window).
+    """
+
+    name: str
+    field: str
+    kind: str = "buckets"
+    severity: str = "warning"
+    threshold: float = 1.0
+    value_key: str = ""
+    raise_evals: int = 2
+    clear_evals: int = 2
+
+    def firing(self, report: dict) -> list[dict]:
+        """Firing instances for this evaluation: a list of
+        ``{"bucket": id-or-None, "value": float, "victims": [...]}``."""
+        if self.kind == "scalar":
+            value = float(report.get(self.field) or 0.0)
+            if value >= self.threshold:
+                return [{"bucket": None, "value": value, "victims": []}]
+            return []
+        if self.kind == "topk_share":
+            heavy = report.get("HeavyHitters") or []
+            total = float(report.get("Bytes") or 0.0)
+            if not heavy or total <= 0.0:
+                return []
+            top = heavy[0]
+            share = float(top.get("EstBytes", 0.0)) / total
+            if share >= self.threshold:
+                return [{"bucket": None, "value": round(share, 4),
+                         "victims": [top.get("DstAddr", "")]}]
+            return []
+        buckets = report.get(self.field) or []
+        if len(buckets) < self.threshold:
+            return []
+        return [{"bucket": int(b.get("bucket", 0)),
+                 "value": float(b.get(self.value_key, 0.0) or 0.0)
+                 if self.value_key else 0.0,
+                 "victims": list(b.get("probable_victims", ()))}
+                for b in buckets]
+
+
+def signal_rule(signal: str, raise_evals: int = 2,
+                clear_evals: int = 2) -> AlertRule:
+    """The default rule for one anomaly signal: fire per suspect bucket of
+    the rendered report list (threshold truth lives in the renderer)."""
+    return AlertRule(
+        name=signal, field=SIGNAL_FIELDS[signal], kind="buckets",
+        severity=_SEVERITIES[signal], value_key=_VALUE_KEYS[signal],
+        raise_evals=raise_evals, clear_evals=clear_evals)
+
+
+def cardinality_rule(threshold: float, raise_evals: int = 2,
+                     clear_evals: int = 2) -> AlertRule:
+    """HLL cardinality surge: distinct-source estimate at/above
+    ``threshold`` (an amplification fleet or sweep appearing)."""
+    return AlertRule(
+        name="cardinality_surge", field="DistinctSrcEstimate",
+        kind="scalar", severity="warning", threshold=threshold,
+        raise_evals=raise_evals, clear_evals=clear_evals)
+
+
+def topk_share_rule(share: float, raise_evals: int = 2,
+                    clear_evals: int = 2) -> AlertRule:
+    """Top-K dominance: one heavy hitter carrying >= ``share`` of the
+    window's bytes."""
+    return AlertRule(
+        name="topk_share", field="HeavyHitters", kind="topk_share",
+        severity="warning", threshold=share,
+        raise_evals=raise_evals, clear_evals=clear_evals)
+
+
+def default_rules(raise_evals: int = 2, clear_evals: int = 2) -> list:
+    """One rule per anomaly signal (the ALERT_RULES=default set)."""
+    return [signal_rule(s, raise_evals, clear_evals) for s in SIGNAL_FIELDS]
+
+
+def parse_rules(spec: str, raise_evals: int = 2,
+                clear_evals: int = 2) -> list:
+    """Parse an ALERT_RULES spec into a rule list.
+
+    Grammar: comma-separated tokens; ``default`` expands to the five
+    signal rules; a bare signal name enables that one; parameterized
+    rules spell ``cardinality_surge:<count>`` / ``topk_share:<fraction>``.
+    Duplicate names keep the LAST occurrence (an override idiom)."""
+    def _num(arg: str, tok: str) -> float:
+        try:
+            return float(arg)
+        except ValueError:
+            raise ValueError(
+                f"ALERT_RULES: {tok!r} has a non-numeric parameter "
+                f"(want e.g. cardinality_surge:50000 or topk_share:0.5)"
+            ) from None
+
+    out: dict[str, AlertRule] = {}
+    for tok in filter(None, (t.strip() for t in spec.split(","))):
+        name, _, arg = tok.partition(":")
+        if name == "default" or name in SIGNAL_FIELDS:
+            if arg:
+                # fail-fast symmetry with the parameterized rules: a
+                # stray ":<arg>" here is a user expecting a per-rule
+                # threshold that does not exist — silently dropping it
+                # would run the stock rule against their intent
+                raise ValueError(
+                    f"ALERT_RULES: {name!r} takes no parameter "
+                    f"(got {tok!r}; signal thresholds live in the "
+                    "SKETCH_* render settings)")
+            if name == "default":
+                for r in default_rules(raise_evals, clear_evals):
+                    out[r.name] = r
+            else:
+                out[name] = signal_rule(name, raise_evals, clear_evals)
+        elif name == "cardinality_surge":
+            if not arg:
+                raise ValueError(
+                    "ALERT_RULES: cardinality_surge needs a threshold "
+                    "(e.g. cardinality_surge:50000)")
+            out[name] = cardinality_rule(_num(arg, tok), raise_evals,
+                                         clear_evals)
+        elif name == "topk_share":
+            if not arg:
+                raise ValueError("ALERT_RULES: topk_share needs a share "
+                                 "(e.g. topk_share:0.5)")
+            out[name] = topk_share_rule(_num(arg, tok), raise_evals,
+                                        clear_evals)
+        else:
+            raise ValueError(
+                f"ALERT_RULES: unknown rule {name!r} (one of "
+                f"{', '.join(SIGNAL_FIELDS)}, cardinality_surge:<n>, "
+                f"topk_share:<f>, default)")
+    if not out:
+        raise ValueError("ALERT_RULES is set but names no rules")
+    return list(out.values())

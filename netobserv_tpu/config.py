@@ -420,6 +420,38 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     map_pressure_watermark: float = field(
         default=0.0, **_env("MAP_PRESSURE_WATERMARK", "0"))
 
+    # --- continuous detection & alerting plane (alerts/; new) ---
+    #: declarative alert rule set over published query snapshots
+    #: ("default" = one rule per anomaly signal; comma list picks a
+    #: subset; cardinality_surge:<n> / topk_share:<f> add scalar rules —
+    #: alerts/rules.py). Unset (the default) means NO engine exists: the
+    #: exporter path is bit-identical to the alert-less agent (one
+    #: is-None check — the tracing/fault-point zero-cost bar)
+    alert_rules: str = field(default="", **_env("ALERT_RULES"))
+    #: hysteresis: consecutive firing evaluations to RAISE an alert
+    alert_raise_evals: int = field(default=2, **_env("ALERT_RAISE_EVALS", "2"))
+    #: hysteresis: consecutive quiet CLOSED-WINDOW (roll) evaluations to
+    #: CLEAR an active alert — mid-window refreshes hold state instead of
+    #: counting (the signal plane resets each roll, so a sustained
+    #: anomaly looks quiet while a fresh window re-accumulates)
+    alert_clear_evals: int = field(default=2, **_env("ALERT_CLEAR_EVALS", "2"))
+    #: transition fan-out sinks ("log,metrics" default; "webhook" POSTs
+    #: JSON to ALERT_WEBHOOK_URL with per-sink rate limiting + bounded
+    #: retry — alerts/sinks.py)
+    alert_sinks: str = field(default="log,metrics",
+                             **_env("ALERT_SINKS", "log,metrics"))
+    alert_webhook_url: str = field(default="", **_env("ALERT_WEBHOOK_URL"))
+    #: per-alert flap-suppression window for the webhook: a CLEAR landing
+    #: within this interval of the alert's last delivery is HELD (the
+    #: receiver keeps the alert visible through a flap) and reconciles
+    #: once the interval expires — per-fingerprint delivery rate is
+    #: bounded to ~2 per interval, distinct alerts are never throttled
+    #: (alerts/sinks.py delivery discipline)
+    alert_webhook_interval: float = field(
+        default=1.0, **_env("ALERT_WEBHOOK_INTERVAL", "1s"))
+    #: recent-transitions ring capacity (the /query/alerts "recent" list)
+    alert_ring: int = field(default=256, **_env("ALERT_RING", "256"))
+
     # --- sketch federation plane (federation/; new) ---
     #: "host:port" of the central aggregator's Federation gRPC endpoint;
     #: set on per-host agents to stream one delta frame per closed window
@@ -556,6 +588,22 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
         if not (0.0 <= self.map_pressure_watermark < 1.0):
             raise ValueError("MAP_PRESSURE_WATERMARK must be in [0, 1) "
                              "(a fraction of CACHE_MAX_FLOWS; 0 disables)")
+        if self.alert_raise_evals < 1 or self.alert_clear_evals < 1:
+            raise ValueError("ALERT_RAISE_EVALS and ALERT_CLEAR_EVALS "
+                             "must be >= 1")
+        if self.alert_ring < 1:
+            raise ValueError("ALERT_RING must be >= 1")
+        if self.alert_webhook_interval < 0:
+            raise ValueError("ALERT_WEBHOOK_INTERVAL must be >= 0")
+        if self.alert_rules:
+            # fail fast on a malformed rule spec or sink set (the engine
+            # would only parse them at exporter construction otherwise);
+            # the webhook-URL requirement is validated by the ONE sink
+            # builder via a throwaway registry-less construction
+            from netobserv_tpu.alerts.rules import parse_rules
+            from netobserv_tpu.alerts.sinks import build_sinks
+            parse_rules(self.alert_rules)
+            build_sinks(self)
         if self.federation_mode not in ("", "aggregator"):
             raise ValueError(
                 f"FEDERATION_MODE={self.federation_mode!r} "
@@ -588,6 +636,7 @@ _DURATION_FIELDS = {
     "supervisor_heartbeat_timeout", "federation_window",
     "federation_stale_after", "federation_agent_ttl",
     "sketch_shed_slot_budget", "sketch_query_refresh",
+    "alert_webhook_interval",
 }
 
 

@@ -24,6 +24,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from netobserv_tpu.alerts.rules import SIGNAL_FIELDS
 from netobserv_tpu.config import (
     DEFAULT_ASYM_MIN_BYTES, DEFAULT_ASYM_RATIO, DEFAULT_DDOS_Z,
     DEFAULT_DROP_Z, DEFAULT_SCAN_FANOUT, DEFAULT_SYNFLOOD_MIN,
@@ -263,7 +264,8 @@ class TpuSketchExporter(Exporter):
                  shed_seed: int = 2026,
                  query_refresh_s: float = 0.0,
                  overlap_depth: int = 0,
-                 query_history: int = 0):
+                 query_history: int = 0,
+                 alerts=None):
         # superbatch defaults to NO ladder for direct construction: the
         # ladder costs superbatch_max-sized ring buffers, dictionaries and
         # key-table rows up front, and only pays off once warmed — the
@@ -505,10 +507,17 @@ class TpuSketchExporter(Exporter):
         # no new jitted entry, so the refresh can never retrace.
         from netobserv_tpu.query import QueryRoutes, SnapshotPublisher
         self.query = SnapshotPublisher(history=query_history)
+        # continuous detection plane (netobserv_tpu/alerts): the engine
+        # rides EVERY snapshot publish (roll + mid-window refresh) on the
+        # timer thread — host-only, no new jit, nothing on the fold path.
+        # None (ALERT_RULES unset) keeps the publish path bit-identical:
+        # one is-None check, no engine object (the zero-cost bar).
+        self._alerts = alerts
         self.query_routes = QueryRoutes(self.query.get, self.query_status,
                                         metrics=metrics,
                                         history_fn=self.query.get_window,
-                                        windows_fn=self.query.windows)
+                                        windows_fn=self.query.windows,
+                                        alerts=alerts)
         if metrics is not None:
             metrics.query_snapshot_age_seconds.set_function(self.query.age_s)
         self._query_refresh_s = query_refresh_s
@@ -682,6 +691,12 @@ class TpuSketchExporter(Exporter):
             supervisor.register_condition(
                 "overloaded",
                 lambda: {"active": ctl.overloaded, **ctl.snapshot()})
+        # the ALERTING condition is OVERLOADED's sibling: a raised alert
+        # is the detection plane doing its job, not a failing stage —
+        # /readyz stays 200 (conditions never gate readiness)
+        eng = getattr(self, "_alerts", None)
+        if eng is not None and hasattr(supervisor, "register_condition"):
+            supervisor.register_condition("alerting", eng.condition)
         # the overlap fold worker is a pipeline stage like any other: a
         # crash/hang restarts it (the handoff queue survives the restart,
         # so queued evictions still fold)
@@ -694,6 +709,7 @@ class TpuSketchExporter(Exporter):
 
     @classmethod
     def from_config(cls, cfg, metrics=None, sink=None):
+        from netobserv_tpu.alerts import maybe_engine
         from netobserv_tpu.sketch.state import SketchConfig
         if sink is None:
             sink = make_report_sink(cfg)
@@ -727,6 +743,7 @@ class TpuSketchExporter(Exporter):
                    query_refresh_s=cfg.sketch_query_refresh,
                    overlap_depth=cfg.sketch_overlap,
                    query_history=cfg.sketch_query_history,
+                   alerts=maybe_engine(cfg, metrics),
                    warm_ladder=True,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
@@ -1249,6 +1266,13 @@ class TpuSketchExporter(Exporter):
                         if tables is not None else None),
         }
         self.query.publish(snap, mid_window=mid_window)
+        # alert evaluation rides the publish it just observed (timer
+        # thread); safe_evaluate swallows+counts — a failing evaluation
+        # can never lose the snapshot (already swapped in) or the report
+        # (the caller's own try covers that separately). The
+        # ``alerts.evaluate`` fault point fires inside evaluate().
+        if self._alerts is not None:
+            self._alerts.safe_evaluate(snap, mid_window=mid_window)
 
     def query_status(self) -> dict:
         """/query/status payload: snapshot freshness + plane counters.
@@ -1262,6 +1286,11 @@ class TpuSketchExporter(Exporter):
                    "window_s": self._window_s,
                    "refresh_s": self._query_refresh_s,
                    "overloaded": self.overloaded})
+        if self._alerts is not None:
+            # one view read (the read-once rule): active count and last
+            # transition seq come from the SAME published alert view, so a
+            # poller never needs a second racy /query/alerts round-trip
+            st["alerts"] = self._alerts.summary()
         if snap is not None:
             st.update({"published": True, "seq": snap["seq"],
                        "window": snap["window"],
@@ -1275,13 +1304,8 @@ class TpuSketchExporter(Exporter):
                 "nat_records": rep["NatRecords"],
                 "rtt_quantiles_us": rep["RttQuantilesUs"],
                 "dns_latency_quantiles_us": rep["DnsLatencyQuantilesUs"],
-                "suspects": {
-                    "ddos": len(rep["DdosSuspectBuckets"]),
-                    "syn_flood": len(rep["SynFloodSuspectBuckets"]),
-                    "port_scan": len(rep["PortScanSuspectBuckets"]),
-                    "drop_storm": len(rep["DropAnomalyBuckets"]),
-                    "asym_conv": len(
-                        rep["AsymmetricConversationBuckets"])},
+                "suspects": {sig: len(rep[key]) for sig, key
+                             in SIGNAL_FIELDS.items()},
             })
         return st
 
@@ -1387,10 +1411,6 @@ class TpuSketchExporter(Exporter):
             self._metrics.sketch_window_reports_total.inc()
             self._metrics.sketch_window_records.set(obj["Records"])
             self._metrics.sketch_window_drop_bytes.set(obj["DropBytes"])
-            for sig, key in (("ddos", "DdosSuspectBuckets"),
-                             ("port_scan", "PortScanSuspectBuckets"),
-                             ("syn_flood", "SynFloodSuspectBuckets"),
-                             ("drop_storm", "DropAnomalyBuckets"),
-                             ("asym_conv", "AsymmetricConversationBuckets")):
+            for sig, key in SIGNAL_FIELDS.items():
                 self._metrics.sketch_window_suspects.labels(sig).set(
                     len(obj[key]))

@@ -62,7 +62,7 @@ class FederationAggregator:
                  stale_after_s: float = 120.0,
                  report_kwargs: Optional[dict] = None,
                  checkpoint_dir: str = "", checkpoint_every: int = 1,
-                 agent_ttl_s: float = 0.0):
+                 agent_ttl_s: float = 0.0, alerts=None):
         from netobserv_tpu.parallel.distributed import (
             maybe_initialize_distributed,
         )
@@ -140,6 +140,12 @@ class FederationAggregator:
         self._snap_lock = threading.Lock()
         self._snap_seq = 0
         self._closed = threading.Event()
+        # cluster-wide continuous detection (netobserv_tpu/alerts): the
+        # SAME engine core the agents mount, driven here by the merged-
+        # window snapshot each roll publishes (thin-adapter pattern, like
+        # federation/query.py over query/core). None = disabled, one
+        # is-None check on the publish path.
+        self.alerts = alerts
 
         # checkpoint/restore: aggregate SketchState + delivery ledger saved
         # at window roll (post-roll state, so a restore can never re-publish
@@ -589,6 +595,12 @@ class FederationAggregator:
         }
         with self._snap_lock:
             self._snapshot = snap
+        # cluster-wide alert evaluation rides the snapshot it just
+        # published (timer thread; safe_evaluate swallows+counts — a
+        # failing evaluation never loses the publish or the sink
+        # delivery below)
+        if self.alerts is not None:
+            self.alerts.safe_evaluate(snap)
         m = self._metrics
         if m is not None:
             m.federation_active_agents.set(len(agents))
@@ -670,7 +682,7 @@ class FederationAggregator:
             frames = self._frames_total
             window_agents = sorted(self._window_agents)
         snap = self.snapshot()
-        return {
+        out = {
             "frames_total": frames,
             "agents": self._agents_view(),
             "current_window_agents": window_agents,
@@ -683,6 +695,10 @@ class FederationAggregator:
             "agent_ttl_s": self._agent_ttl_s,
             "checkpointing": self._ckpt is not None,
         }
+        if self.alerts is not None:
+            # one engine-view read, same read-once rule as /query/status
+            out["alerts"] = self.alerts.summary()
+        return out
 
     def query_frequency(self, src: str, dst: str, src_port: int = 0,
                         dst_port: int = 0, proto: int = 0) -> Optional[dict]:

@@ -19,6 +19,10 @@ Routes (all GET, JSON):
                             (?src=&dst=&src_port=&dst_port=&proto=)
 - /federation/cardinality   global distinct-source estimate + totals
 - /federation/victims       suspect buckets per signal with victim names
+- /federation/alerts        cluster-wide continuous detection view (the
+                            SAME engine core the agents mount, driven by
+                            merged-window snapshots; 404 when ALERT_RULES
+                            is unset)
 - /federation/status        per-agent delta freshness + plane counters
 """
 
@@ -56,10 +60,25 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"routes": [
                     "/federation/topk", "/federation/frequency",
                     "/federation/cardinality", "/federation/victims",
-                    "/federation/status", "/healthz", "/readyz"]})
+                    "/federation/alerts", "/federation/status",
+                    "/healthz", "/readyz"]})
                 return
             if path == "/federation/status":
                 self._json(200, self.aggregator.status())
+                return
+            if path == "/federation/alerts":
+                # thin adapter: the one route_payload body builder the
+                # agent's /query/alerts uses (never fork it back)
+                eng = self.aggregator.alerts
+                if eng is None:
+                    self._json(404, {"error": "alerting disabled "
+                                              "(ALERT_RULES unset)"})
+                    return
+                try:
+                    code, body = eng.route_payload(q.get("window"))
+                except ValueError as exc:  # malformed ?window=
+                    code, body = 400, {"error": str(exc)}
+                self._json(code, body)
                 return
             snap = self.aggregator.snapshot()
             if path == "/federation/frequency":

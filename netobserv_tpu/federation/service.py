@@ -26,6 +26,7 @@ class FederationAggregatorService:
 
     def __init__(self, cfg, metrics: Optional[Metrics] = None,
                  sink=None):
+        from netobserv_tpu.alerts import maybe_engine
         from netobserv_tpu.exporter.tpu_sketch import make_report_sink
         from netobserv_tpu.sketch.state import SketchConfig
 
@@ -35,6 +36,7 @@ class FederationAggregatorService:
         self._status = "Starting"
         self._status_lock = threading.Lock()
         self.aggregator = FederationAggregator(
+            alerts=maybe_engine(cfg, self.metrics, source="federation"),
             sketch_cfg=SketchConfig.from_agent_config(cfg),
             window_s=cfg.federation_window,
             mesh_shape=cfg.federation_mesh_shape,

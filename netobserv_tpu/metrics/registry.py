@@ -241,6 +241,31 @@ class Metrics:
             "it also resets at each mid-window refresh — growth past the "
             "window period means the publish path is failing)",
             registry=self.registry)
+        # continuous detection & alerting plane (netobserv_tpu/alerts +
+        # /query/alerts; the aggregator's engine shares these series)
+        self.alerts_active = Gauge(
+            p + "alerts_active",
+            "Alerts currently RAISED by the continuous detection plane "
+            "(hysteresis state machine over every snapshot publish; 0 with "
+            "ALERT_RULES unset — no engine exists)",
+            registry=self.registry)
+        self.alerts_transitions_total = Counter(
+            p + "alerts_transitions_total",
+            "Alert state transitions by rule and action (raise / clear), "
+            "exactly one per hysteresis crossing (incremented by the "
+            "metrics sink)", ["rule", "action"], registry=self.registry)
+        self.alert_sink_errors_total = Counter(
+            p + "alert_sink_errors_total",
+            "Alert transitions a sink failed to deliver after its bounded "
+            "retries (swallowed + counted; the engine state machine and "
+            "the other sinks were unaffected)", ["sink"],
+            registry=self.registry)
+        self.alert_eval_seconds = Histogram(
+            p + "alert_eval_seconds",
+            "Alert-engine evaluation latency per snapshot publish (host-"
+            "only rule walk on the timer thread; sink I/O excluded)",
+            buckets=(.0001, .0005, .001, .005, .01, .05, .1, .5),
+            registry=self.registry)
         self.sketch_window_records = Gauge(
             p + "sketch_window_records", "Flow records in the last window",
             registry=self.registry)

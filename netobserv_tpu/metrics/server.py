@@ -30,9 +30,13 @@ carries the controller's live state under ``conditions.overloaded``.
 Query surface: when a ``query_routes`` handler is supplied
 (`netobserv_tpu/query/routes.py`, wired by the tpu-sketch exporter), the
 server additionally answers ``/query/topk|frequency|cardinality|victims|
-status`` against the agent's published window snapshot — host-side only,
-same off-hot-path rules as /debug/traces (docs/architecture.md
-"Query plane").
+alerts|status`` against the agent's published window snapshot — host-side
+only, same off-hot-path rules as /debug/traces (docs/architecture.md
+"Query plane"). ``/query/alerts`` is the continuous detection plane's
+view (active alerts + recent transitions; 404 with ``ALERT_RULES``
+unset). Like OVERLOADED, a RAISED alert surfaces as the ``alerting``
+condition in the health bodies without failing readiness — detection is
+the agent working, not a broken stage.
 """
 
 from __future__ import annotations

@@ -76,13 +76,13 @@ def cardinality_payload(snap: dict) -> dict:
 
 
 def victims_payload(snap: dict) -> dict:
+    # the signal -> report-key map is the alerting plane's SIGNAL_FIELDS
+    # (one truth: /query/victims, the zoo's SIGNALS tuple and the default
+    # alert rules can never disagree about what a signal is called)
+    from netobserv_tpu.alerts.rules import SIGNAL_FIELDS
     report = snap["report"]
-    return _stamp(snap, {
-        "ddos": report["DdosSuspectBuckets"],
-        "syn_flood": report["SynFloodSuspectBuckets"],
-        "port_scan": report["PortScanSuspectBuckets"],
-        "drop_storm": report["DropAnomalyBuckets"],
-        "asym_conv": report["AsymmetricConversationBuckets"]})
+    return _stamp(snap, {sig: report[key]
+                         for sig, key in SIGNAL_FIELDS.items()})
 
 
 def frequency_payload(snap: dict, src: str, dst: str, src_port: int = 0,

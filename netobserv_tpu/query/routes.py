@@ -14,6 +14,9 @@ Routes (all GET, JSON):
                        (?src=&dst=&src_port=&dst_port=&proto=)
 - /query/cardinality   distinct-source estimate + window totals
 - /query/victims       suspect buckets per signal with victim names
+- /query/alerts        the continuous detection plane's live view
+                       (active alerts + recent transitions; 404 when
+                       ALERT_RULES is unset — no engine exists)
 - /query/status        snapshot freshness + plane counters
                        (incl. the back-scroll ring's window ids)
 
@@ -33,7 +36,8 @@ from netobserv_tpu.query import core
 
 log = logging.getLogger("netobserv_tpu.query")
 
-ROUTES = ("topk", "frequency", "cardinality", "victims", "status")
+ROUTES = ("topk", "frequency", "cardinality", "victims", "alerts",
+          "status")
 
 
 class QueryRoutes:
@@ -46,12 +50,16 @@ class QueryRoutes:
     def __init__(self, snapshot_fn: Callable[[], Optional[dict]],
                  status_fn: Callable[[], dict], metrics=None,
                  history_fn: Optional[Callable[[int], Optional[dict]]] = None,
-                 windows_fn: Optional[Callable[[], list]] = None):
+                 windows_fn: Optional[Callable[[], list]] = None,
+                 alerts=None):
         self._snapshot = snapshot_fn
         self._status = status_fn
         self._metrics = metrics
         self._history = history_fn
         self._windows = windows_fn
+        #: the alert engine (alerts/engine.py) or None when ALERT_RULES is
+        #: unset — the route then answers 404 (alerting disabled)
+        self._alerts = alerts
 
     def index(self) -> dict:
         return {"routes": [f"/query/{r}" for r in ROUTES]}
@@ -87,6 +95,14 @@ class QueryRoutes:
                          **self.index()}
         if route == "status":
             return 200, self._status()
+        if route == "alerts":
+            # the alert view has its own closed-window ring (the engine's)
+            # with the same ?window= back-scroll contract as the snapshot
+            # routes: 404 + available ids on evicted/unknown windows
+            if self._alerts is None:
+                return 404, {"error": "alerting disabled "
+                                      "(ALERT_RULES unset)"}
+            return self._alerts.route_payload(params.get("window"))
         if params.get("window") is not None:
             wid = int(params["window"])  # malformed -> ValueError -> 400
             snap = self._history(wid) if self._history is not None else None

@@ -4,8 +4,14 @@ grade detection quality through the live `/query/*` HTTP routes.
 The pipeline under test is the real one — PcapReplayFetcher -> MapTracer ->
 CapacityLimiter -> QueueExporter -> TpuSketchExporter (columnar fast path,
 resident feed) -> window roll -> query snapshot -> metrics-server HTTP —
-with the supervisor running and the mid-window refresh enabled, so every
-scenario also exercises "the query plane answers during sustained ingest".
+with the supervisor running, the mid-window refresh enabled, and the
+CONTINUOUS DETECTION PLANE mounted (default alert rules over the same
+snapshots), so every scenario also exercises "the query plane answers
+during sustained ingest" AND "the agent raises its own alarms without
+being polled for them". The runner records a per-scenario time-to-detect
+(replay start -> first observed RAISE through `/query/alerts`); with the
+refresh enabled, attack scenarios must detect in under one window period
+— sub-window detection is the plane's point.
 
 Used by tests/test_scenarios.py (one fast smoke in tier-1, the full zoo in
 the slow tier) and `bench.py --scenarios` (the per-scenario quality
@@ -58,6 +64,8 @@ def run_scenario(name: str, workdir: str, window_s: float = 600.0,
     the agent's shutdown flush closes the window, publishing the final
     ROLL snapshot, which is graded too."""
     from netobserv_tpu.agent.agent import FlowsAgent
+    from netobserv_tpu.alerts import AlertEngine, LogSink, MetricsSink
+    from netobserv_tpu.alerts.rules import default_rules
     from netobserv_tpu.config import AgentConfig
     from netobserv_tpu.datapath.replay import PcapReplayFetcher
     from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
@@ -79,10 +87,16 @@ def run_scenario(name: str, workdir: str, window_s: float = 600.0,
         raise ValueError("the scenario runner grades the LIVE window "
                          "through mid-window refreshes; query_refresh_s "
                          "must be > 0")
+    # the alerting plane runs with its DEFAULT rules: they fire on the
+    # report's suspect lists, which the exporter renders under the zoo's
+    # ONE shared threshold set below — grading and alerting read the same
+    # truth by construction (alerts/rules.py one-truth note)
+    engine = AlertEngine(default_rules(), metrics=metrics,
+                         sinks=[LogSink(), MetricsSink(metrics)])
     exporter = TpuSketchExporter(
         batch_size=512, window_s=window_s, sketch_cfg=_sketch_cfg(),
         metrics=metrics, sink=lambda obj: None,
-        query_refresh_s=query_refresh_s,
+        query_refresh_s=query_refresh_s, alerts=engine,
         ddos_z_threshold=6.0, drop_z_threshold=6.0, **THRESHOLDS)
     agent = FlowsAgent(cfg, fetcher, exporter, metrics=metrics)
     srv = start_metrics_server(metrics.registry, port=0,
@@ -115,7 +129,7 @@ def run_scenario(name: str, workdir: str, window_s: float = 600.0,
         code, status = get("/query/status")
         if code == 200:
             obs["status"] = status
-        for route in ("topk?n=64", "victims", "cardinality"):
+        for route in ("topk?n=64", "victims", "cardinality", "alerts"):
             c, body = get(f"/query/{route}")
             if c == 200:
                 obs[route.split("?")[0]] = body
@@ -130,6 +144,10 @@ def run_scenario(name: str, workdir: str, window_s: float = 600.0,
         return obs
 
     seen_seq, live_data_obs = 0, 0
+    expect = set(truth.get("expect_alarms", ()))
+    t_run0 = time.monotonic()  # replay start: time-to-detect is measured
+    #                            from here to the first observed RAISE
+    time_to_detect: float | None = None
     deadline = time.monotonic() + deadline_s
     try:
         # phase 1: poll the LIVE window through the mid-window refreshes
@@ -140,6 +158,18 @@ def run_scenario(name: str, workdir: str, window_s: float = 600.0,
             if code == 200 and status.get("seq", 0) > seen_seq:
                 seen_seq = status["seq"]
                 obs = observe()
+                if time_to_detect is None:
+                    view = obs.get("alerts", {})
+                    # an expected rule counts as detected whether it is
+                    # still ACTIVE or already visible as a raise in the
+                    # transitions ring (a raise that cleared between two
+                    # polls must not read as "never detected")
+                    if any(a.get("rule") in expect
+                           for a in view.get("active", ())) or any(
+                            t.get("rule") in expect
+                            and t.get("action") == "raise"
+                            for t in view.get("recent", ())):
+                        time_to_detect = time.monotonic() - t_run0
                 if (obs.get("cardinality", {}).get("records", 0)
                         >= min_records and fetcher.exhausted()):
                     live_data_obs += 1
@@ -175,16 +205,22 @@ def run_scenario(name: str, workdir: str, window_s: float = 600.0,
             getattr(exporter._pending_buf, "direct_rows", 0)),
     }
     return evaluate(truth, observations, freq_obs, retraces=retraces,
-                    plumbing=plumbing)
+                    plumbing=plumbing, time_to_detect_s=time_to_detect,
+                    window_s=window_s)
 
 
 def evaluate(truth: dict, observations: list[dict],
              freq_obs: list[dict] | None = None,
-             retraces: int = 0, plumbing: dict | None = None) -> dict:
+             retraces: int = 0, plumbing: dict | None = None,
+             time_to_detect_s: float | None = None,
+             window_s: float | None = None) -> dict:
     """Grade collected /query/* observations against the ground truth.
     Returns {"name", "passed", "failures": [...], ...quality metrics}.
     `plumbing` carries feed-path counters (spill rows, dense fallbacks)
-    for scenarios whose truth pins them."""
+    for scenarios whose truth pins them; `time_to_detect_s` the replay-
+    start -> first-observed-RAISE latency (None = no raise observed), and
+    `window_s` the window period the sub-window detection bar grades
+    against."""
     failures: list[str] = []
     out: dict = {"name": truth.get("name", "?"), "retraces": retraces,
                  "windows_observed": len(
@@ -238,6 +274,62 @@ def evaluate(truth: dict, observations: list[dict],
     for sig in truth.get("quiet_alarms", ()):
         if any(o.get("victims", {}).get(sig) for o in observations):
             failures.append(f"{sig} alarm fired on a benign signal")
+
+    # --- continuous detection plane (through /query/alerts): expected
+    # alarms must RAISE live (not just sit in suspect lists a poller
+    # would have to read), quiet ones must never raise in ANY observed
+    # view, and with the refresh enabled detection must land inside one
+    # window period (sub-window detection is the plane's point) ---
+    alert_views = [o["alerts"] for o in observations if "alerts" in o]
+    if not alert_views and (truth.get("expect_alarms")
+                            or truth.get("quiet_alarms")):
+        # a dead /query/alerts surface must FAIL the scenario, not
+        # silently skip every alert assertion — for attack scenarios AND
+        # benign ones (whose whole point is proving nothing raises)
+        failures.append("no /query/alerts view ever observed")
+    if alert_views:
+        raised = {a["rule"] for v in alert_views for a in v.get("active", ())}
+        raised |= {t["rule"] for v in alert_views
+                   for t in v.get("recent", ()) if t["action"] == "raise"}
+        out["alerts_raised"] = sorted(raised)
+        out["alert_transitions"] = max(
+            v.get("transition_seq", 0) for v in alert_views)
+        for sig in truth.get("expect_alarms", ()):
+            if sig not in raised:
+                failures.append(
+                    f"expected {sig} alert never RAISED on /query/alerts")
+        for sig in truth.get("quiet_alarms", ()):
+            if sig in raised:
+                failures.append(
+                    f"{sig} alert raised on a benign signal")
+        if truth.get("victim") and truth.get("victim_signal"):
+            sig = truth["victim_signal"]
+            # same active-OR-ring rule as detection: a raise that cleared
+            # between two polls still carries its victims in the ring
+            named = any(
+                truth["victim"] in a.get("victims", ())
+                for v in alert_views for a in v.get("active", ())
+                if a["rule"] == sig) or any(
+                truth["victim"] in t.get("victims", ())
+                for v in alert_views for t in v.get("recent", ())
+                if t["rule"] == sig and t["action"] == "raise")
+            out["alert_victim_named"] = named
+            if not named:
+                failures.append(
+                    f"victim {truth['victim']} not named by the "
+                    f"{sig} alert")
+        out["time_to_detect_s"] = (
+            None if time_to_detect_s is None
+            else round(time_to_detect_s, 3))
+        if truth.get("expect_alarms"):
+            if time_to_detect_s is None:
+                failures.append(
+                    "no live RAISE observed during the replay "
+                    "(time-to-detect unmeasurable)")
+            elif window_s is not None and time_to_detect_s >= window_s:
+                failures.append(
+                    f"time-to-detect {time_to_detect_s:.1f}s is not "
+                    f"sub-window (window {window_s:.0f}s)")
 
     # --- victim naming ---
     if truth.get("victim"):

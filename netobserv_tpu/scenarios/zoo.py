@@ -1,5 +1,5 @@
-"""The adversarial traffic scenario zoo: six deterministic generators, each
-producing a pcap plus machine-checkable ground truth.
+"""The adversarial traffic scenario zoo: eight deterministic generators,
+each producing a pcap plus machine-checkable ground truth.
 
 Every scenario is evaluated END TO END through the agent's `/query/*`
 routes (`scenarios/runner.py`): pcap -> datapath replay -> columnar feed ->
@@ -23,8 +23,12 @@ from netobserv_tpu.scenarios.synth import (
 SYN, SYNACK, ACK, PSHACK = 0x02, 0x12, 0x10, 0x18
 
 #: every victim-signal key of /query/victims — scenarios pick their
-#: expected/quiet subsets from this
-SIGNALS = ("ddos", "syn_flood", "port_scan", "drop_storm", "asym_conv")
+#: expected/quiet subsets from this. Derived from the alerting plane's
+#: SIGNAL_FIELDS (the ONE signal-name map: zoo grading, /query/victims
+#: and the default alert rules can never drift apart)
+from netobserv_tpu.alerts.rules import SIGNAL_FIELDS  # noqa: E402
+
+SIGNALS = tuple(SIGNAL_FIELDS)
 
 
 def _benign_background(b: PcapBuilder, at_us: int = 0) -> dict:
@@ -336,6 +340,44 @@ def build_ipv6_heavy(path: str) -> dict:
     }
 
 
+def build_overlay_syn_scan(path: str) -> dict:
+    """Mixed-attack OVERLAY (the ROADMAP leftover): a spoofed SYN flood
+    AND an independent port scan run simultaneously in one pcap. BOTH
+    alarms must fire with correct victim attribution — the flood names
+    its victim, the scan grid flags the scanner's fan-out — while the
+    dns/drop/asymmetry signals stay quiet (no cross-talk: the scanner's
+    800 one-SYN targets must not read as flood victims, the flood's 400
+    one-probe sources must not read as scanners), all under the zoo's ONE
+    shared threshold set."""
+    b = PcapBuilder()
+    bg = _benign_background(b)
+    victim = "10.0.0.80"
+    flood_srcs = 400
+    for i in range(flood_srcs):
+        src = f"172.16.{i % 200}.{i // 200 + 1}"
+        b.add(2000 + i * 50, src, victim, 6, tcp(2000 + i, 80, SYN),
+              sport=2000 + i, dport=80)
+    scanner = "10.0.9.9"
+    targets = 800
+    for i in range(targets):
+        dst = f"198.18.{i // 250}.{i % 250 + 1}"
+        # interleaved with the flood in time (a real mixed attack), still
+        # inside the one 5s replay window
+        b.add(2500 + i * 30, scanner, dst, 6,
+              tcp(55555, 1000 + i, SYN), sport=55555, dport=1000 + i)
+    b.write(path)
+    return {
+        "name": "overlay_syn_scan",
+        "expect_alarms": ["syn_flood", "port_scan"],
+        "quiet_alarms": ["asym_conv", "drop_storm"],
+        "victim": victim,
+        "victim_signal": "syn_flood",
+        "distinct_src": flood_srcs + 1 + len(bg["distinct_srcs"]),
+        "distinct_tol": 0.15,
+        "min_records": flood_srcs + targets,
+    }
+
+
 #: name -> builder(path) -> truth; the runner, tests, and bench all
 #: iterate this registry
 SCENARIOS = {
@@ -346,4 +388,5 @@ SCENARIOS = {
     "nat_churn": build_nat_churn,
     "quic_heavy": build_quic_heavy,
     "ipv6_heavy": build_ipv6_heavy,
+    "overlay_syn_scan": build_overlay_syn_scan,
 }

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Alerting-plane smoke (`make smoke`): one live raise→clear cycle
+against the REAL binary.
+
+Builds the zoo's syn_flood pcap, launches `python -m netobserv_tpu` with
+the tpu-sketch exporter + the continuous detection plane enabled
+(ALERT_RULES=default, mid-window refresh on, short windows), and polls
+the live `/query/alerts` HTTP route until
+
+1. the `syn_flood` alert RAISEs (with the victim named), then
+2. the flood rolls out of the window and the alert CLEARs
+   (a `clear` transition lands in the ring and the active set empties),
+
+then SIGTERMs the agent and expects a clean exit. Everything end to end
+is the production path: pcap replay datapath -> columnar fold -> window
+roll -> snapshot publish -> alert engine -> metrics-server HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+RAISE_DEADLINE_S = 240.0   # includes the first on-CPU sketch compile
+CLEAR_DEADLINE_S = 90.0
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except (urllib.error.URLError, ConnectionError, OSError):
+        return 0, {}
+
+
+def main() -> int:
+    from netobserv_tpu.scenarios.zoo import build_syn_flood
+
+    workdir = tempfile.mkdtemp(prefix="smoke_alerts_")
+    pcap = os.path.join(workdir, "syn_flood.pcap")
+    truth = build_syn_flood(pcap)
+    port = free_port()
+    env = dict(os.environ,
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+               DATAPATH=f"pcap:{pcap}",
+               EXPORT="tpu-sketch",
+               CACHE_ACTIVE_TIMEOUT="300ms",
+               METRICS_ENABLE="true",
+               METRICS_SERVER_ADDRESS="127.0.0.1",
+               METRICS_SERVER_PORT=str(port),
+               ALERT_RULES="default",
+               ALERT_RAISE_EVALS="1",
+               ALERT_CLEAR_EVALS="2",
+               # short windows: the flood's window closes and the empty
+               # follow-up windows drive the quiet evals that CLEAR
+               SKETCH_WINDOW="3s",
+               SKETCH_QUERY_REFRESH="500ms",
+               SKETCH_BATCH_SIZE="512",
+               SKETCH_CM_WIDTH="16384",
+               SKETCH_TOPK="256",
+               SKETCH_HLL_PRECISION="12",
+               SKETCH_SUPERBATCH="1",
+               SKETCH_SYNFLOOD_MIN="64",
+               SKETCH_SYNFLOOD_RATIO="8",
+               LOG_LEVEL="info")
+    # stderr to a FILE, never an undrained pipe: a chatty or error-looping
+    # agent would fill a ~64KB pipe and block its logging thread — the
+    # smoke would then report "never raised" while the actual error sat
+    # stuck in the pipe
+    errlog = os.path.join(workdir, "agent.stderr")
+    errfh = open(errlog, "wb")
+    try:
+        proc = subprocess.Popen([sys.executable, "-m", "netobserv_tpu"],
+                                env=env, stdout=subprocess.DEVNULL,
+                                stderr=errfh)
+    except BaseException:
+        errfh.close()
+        raise
+    raised = cleared = False
+    victim_named = False
+    try:
+        deadline = time.monotonic() + RAISE_DEADLINE_S
+        # keep polling until the victim is NAMED (or the deadline): the
+        # naming is OR-accumulated across buckets and views — a second
+        # victim-less syn_flood bucket, or an early view whose bucket
+        # detail has not named the victim yet, must not latch False
+        while time.monotonic() < deadline and not (raised and
+                                                   victim_named):
+            if proc.poll() is not None:
+                break
+            code, view = get(port, "/query/alerts")
+            if code == 200:
+                for a in view.get("active", ()):
+                    if a["rule"] == "syn_flood":
+                        if not raised:
+                            print(f"RAISED: syn_flood "
+                                  f"bucket={a['bucket']} "
+                                  f"victims={a['victims']}")
+                        raised = True
+                        victim_named = victim_named or (
+                            truth["victim"] in a.get("victims", ()))
+            time.sleep(0.25)
+        if raised:
+            deadline = time.monotonic() + CLEAR_DEADLINE_S
+            while time.monotonic() < deadline and not cleared:
+                if proc.poll() is not None:
+                    break
+                code, view = get(port, "/query/alerts")
+                if code == 200:
+                    active = {a["rule"] for a in view.get("active", ())}
+                    clears = [t for t in view.get("recent", ())
+                              if t["rule"] == "syn_flood"
+                              and t["action"] == "clear"]
+                    if "syn_flood" not in active and clears:
+                        cleared = True
+                        print(f"CLEARED: transition seq "
+                              f"{clears[-1]['seq']}")
+                time.sleep(0.25)
+    finally:
+        try:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                print("FAIL: agent did not exit cleanly on SIGTERM")
+                sys.stderr.write(tail_errlog(errlog))
+                return 1
+        finally:
+            errfh.close()
+    if not raised:
+        print("FAIL: syn_flood alert never raised on /query/alerts")
+    elif not victim_named:
+        print(f"FAIL: victim {truth['victim']} not named by the alert")
+    elif not cleared:
+        print("FAIL: alert never cleared after the flood window closed")
+    elif proc.returncode != 0:
+        print(f"FAIL: agent exited rc={proc.returncode}")
+    else:
+        print("PASS: live raise→clear cycle through the real binary")
+        return 0
+    sys.stderr.write(tail_errlog(errlog))
+    return 1
+
+
+def tail_errlog(path: str, n: int = 4000) -> str:
+    try:
+        with open(path, "rb") as fh:
+            return fh.read().decode(errors="replace")[-n:]
+    except OSError:
+        return ""
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,819 @@
+"""Continuous detection & alerting plane (netobserv_tpu/alerts).
+
+Pins the subsystem's contracts:
+
+- the hysteresis state machine: N consecutive firing evaluations to
+  RAISE, M quiet to CLEAR, exactly one transition per crossing no matter
+  how long the condition persists; dedup by (rule, victim-bucket)
+  fingerprint is stable across evaluations;
+- exactly-once transitions across a supervised timer restart (the engine
+  state lives on the exporter, publishes are exactly-once — so no
+  transition can double-fire);
+- sink failure semantics: a failing sink is swallowed + counted
+  (`alert_sink_errors_total{sink}`), other sinks and the state machine
+  are unaffected; per-sink rate limiting drops over-rate transitions for
+  that sink only; the `alerts.sink` / `alerts.evaluate` fault points are
+  zero-cost when FAULT_POINTS is unset;
+- ALERT_RULES unset is bit-identical to the pre-alert exporter path: no
+  engine object exists, /query/alerts answers 404, /query/status carries
+  no alerts block (one is-None check — the zero-cost bar);
+- surfacing: /query/alerts live + `?window=` back-scroll through
+  QueryRoutes and the metrics server; the `alerting` supervisor
+  condition (active alerts never fail readiness); the federation
+  aggregator's cluster-wide mount at /federation/alerts.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+from prometheus_client import generate_latest
+
+from netobserv_tpu.alerts import (
+    AlertEngine, LogSink, MetricsSink, WebhookSink,
+)
+from netobserv_tpu.alerts.rules import (
+    SIGNAL_FIELDS, cardinality_rule, default_rules, parse_rules,
+    signal_rule, topk_share_rule,
+)
+from netobserv_tpu.alerts.sinks import AlertSink
+from netobserv_tpu.datapath.fetcher import EvictedFlows
+from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+from netobserv_tpu.metrics.registry import Metrics
+from netobserv_tpu.query.routes import QueryRoutes
+from netobserv_tpu.sketch.state import SketchConfig
+from netobserv_tpu.utils import faultinject
+
+from tests.test_pipeline import make_events
+
+# injected crashes ARE unhandled thread exceptions — the scenario under
+# test in the restart suite
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+SMALL_CFG = SketchConfig(cm_depth=2, cm_width=1 << 10, hll_precision=6,
+                         perdst_buckets=32, perdst_precision=4,
+                         persrc_buckets=32, persrc_precision=4,
+                         topk=16, hist_buckets=64, ewma_buckets=32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faultinject.clear()
+    faultinject.hits.clear()
+
+
+def empty_report() -> dict:
+    rep = {key: [] for key in SIGNAL_FIELDS.values()}
+    rep.update(DistinctSrcEstimate=0.0, Bytes=0.0, HeavyHitters=[])
+    return rep
+
+
+def snap_of(report: dict, window=1, seq=1, ts_ms=1000) -> dict:
+    return {"window": window, "ts_ms": ts_ms, "seq": seq, "report": report}
+
+
+def flood_report(buckets=(7,), syn=200.0) -> dict:
+    rep = empty_report()
+    rep["SynFloodSuspectBuckets"] = [
+        {"bucket": b, "syn": syn, "synack": 0.0, "z": 9.0,
+         "probable_victims": ["10.0.0.80"]} for b in buckets]
+    return rep
+
+
+# --- rules ---------------------------------------------------------------
+
+def test_parse_rules_grammar_and_errors():
+    rs = parse_rules("default")
+    assert [r.name for r in rs] == list(SIGNAL_FIELDS)
+    rs = parse_rules("syn_flood,port_scan")
+    assert [r.name for r in rs] == ["syn_flood", "port_scan"]
+    rs = parse_rules("default,cardinality_surge:1000,topk_share:0.5",
+                     raise_evals=3, clear_evals=4)
+    assert rs[-1].threshold == 0.5 and rs[-2].threshold == 1000.0
+    assert all(r.raise_evals == 3 and r.clear_evals == 4 for r in rs)
+    for bad in ("nope", "cardinality_surge", "topk_share", "",
+                "syn_flood:500", "default:3", "topk_share:50%",
+                "cardinality_surge:50k"):
+        # signal/default tokens take no parameter: a stray ":<arg>" is a
+        # user expecting a threshold that does not exist — fail fast
+        with pytest.raises(ValueError):
+            parse_rules(bad)
+
+
+def test_scalar_and_share_rules_fire():
+    rep = empty_report()
+    rep["DistinctSrcEstimate"] = 5000.0
+    rep["Bytes"] = 100.0
+    rep["HeavyHitters"] = [{"SrcAddr": "1.1.1.1", "DstAddr": "2.2.2.2",
+                            "EstBytes": 80.0}]
+    card = cardinality_rule(1000.0)
+    assert card.firing(rep)[0]["value"] == 5000.0
+    assert not cardinality_rule(10_000.0).firing(rep)
+    share = topk_share_rule(0.5)
+    hit = share.firing(rep)
+    assert hit and hit[0]["value"] == 0.8 and hit[0]["victims"] == ["2.2.2.2"]
+    assert not topk_share_rule(0.9).firing(rep)
+
+
+def test_bucket_rule_carries_victims_and_value():
+    hits = signal_rule("syn_flood").firing(flood_report((3, 9)))
+    assert [h["bucket"] for h in hits] == [3, 9]
+    assert hits[0]["victims"] == ["10.0.0.80"]
+    assert hits[0]["value"] == 200.0
+
+
+# --- engine state machine ------------------------------------------------
+
+def test_hysteresis_raise_and_clear_schedules():
+    """raise_evals=3 / clear_evals=2: transitions happen exactly at the
+    hysteresis crossings, exactly once each."""
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=3,
+                                   clear_evals=2)])
+    fire = snap_of(flood_report())
+    quiet = snap_of(empty_report())
+    assert eng.evaluate(fire) == []
+    assert eng.evaluate(fire) == []
+    t = eng.evaluate(fire)
+    assert len(t) == 1 and t[0]["action"] == "raise"
+    assert t[0]["victims"] == ["10.0.0.80"]
+    # persistent firing: no further transitions, state stays active
+    for _ in range(5):
+        assert eng.evaluate(fire) == []
+    assert len(eng.view()["active"]) == 1
+    # one quiet eval: still active (hysteresis)
+    assert eng.evaluate(quiet) == []
+    assert len(eng.view()["active"]) == 1
+    t = eng.evaluate(quiet)
+    assert len(t) == 1 and t[0]["action"] == "clear"
+    # long quiet: nothing more; the tracked set is empty again
+    for _ in range(5):
+        assert eng.evaluate(quiet) == []
+    assert eng.view()["active"] == []
+    # an interrupted streak resets: 2 firing + 1 quiet + 2 firing < 3
+    # consecutive — no raise
+    eng.evaluate(fire), eng.evaluate(fire), eng.evaluate(quiet)
+    assert eng.evaluate(fire) == [] and eng.evaluate(fire) == []
+    t = eng.evaluate(fire)
+    assert len(t) == 1 and t[0]["action"] == "raise"
+
+
+def test_dedup_fingerprint_stability():
+    """Two suspect buckets are two alerts; the SAME bucket across many
+    evaluations stays ONE fingerprint (no per-eval re-raise), and a new
+    bucket joining raises independently."""
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=1,
+                                   clear_evals=1)])
+    t = eng.evaluate(snap_of(flood_report((3, 9))))
+    assert [(x["rule"], x["bucket"], x["action"]) for x in t] == [
+        ("syn_flood", 3, "raise"), ("syn_flood", 9, "raise")]
+    for _ in range(4):
+        assert eng.evaluate(snap_of(flood_report((3, 9)))) == []
+    t = eng.evaluate(snap_of(flood_report((3, 9, 12))))
+    assert [(x["bucket"], x["action"]) for x in t] == [(12, "raise")]
+    assert len(eng.view()["active"]) == 3
+
+
+def test_active_set_and_ring_are_bounded():
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=1,
+                                   clear_evals=1)],
+                      max_active=4, ring=3)
+    t = eng.evaluate(snap_of(flood_report(tuple(range(10)))))
+    assert len(t) == 4  # fingerprints beyond the cap are dropped, counted
+    view = eng.view()
+    assert view["dropped_fingerprints"] == 6
+    assert len(view["recent"]) == 3  # ring keeps the newest 3
+
+
+def test_roll_evals_enter_history_ring_mid_window_do_not():
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=1,
+                                   clear_evals=1)], history=2)
+    eng.evaluate(snap_of(flood_report(), window=5), mid_window=True)
+    assert eng.windows() == []
+    eng.evaluate(snap_of(flood_report(), window=5))
+    eng.evaluate(snap_of(flood_report(), window=6))
+    eng.evaluate(snap_of(flood_report(), window=7))
+    assert eng.windows() == [6, 7]  # cap 2, oldest evicted
+    code, body = eng.route_payload("6")
+    assert code == 200 and body["window"] == 6
+    code, body = eng.route_payload("5")
+    assert code == 404 and body["windows"] == [6, 7]
+    with pytest.raises(ValueError):
+        eng.route_payload("bogus")
+
+
+def test_mid_window_evals_count_toward_hysteresis():
+    """Sub-window detection: refresh evaluations accumulate the raise
+    streak — the raise does NOT wait for a window roll."""
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=2,
+                                   clear_evals=2)])
+    assert eng.evaluate(snap_of(flood_report()), mid_window=True) == []
+    t = eng.evaluate(snap_of(flood_report()), mid_window=True)
+    assert len(t) == 1 and t[0]["action"] == "raise"
+    assert eng.view()["mid_window"] is True
+
+
+def test_mid_window_quiet_never_clears_a_sustained_anomaly():
+    """The asymmetric hysteresis: the signal plane resets at each roll,
+    so a fresh window's first refreshes look quiet while a sustained
+    attack re-accumulates — those evaluations must HOLD the active
+    alert, not flap it clear/re-raise once per window. Only quiet
+    CLOSED-WINDOW evaluations count toward the clear."""
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=1,
+                                   clear_evals=2)])
+    eng.evaluate(snap_of(flood_report(), window=1))
+    assert len(eng.view()["active"]) == 1
+    # window 2 opens: many empty refreshes while the attack re-accumulates
+    for _ in range(10):
+        assert eng.evaluate(snap_of(empty_report(), window=2),
+                            mid_window=True) == []
+    assert len(eng.view()["active"]) == 1  # held, never flapped
+    # the re-accumulated window fires again: still the same alert
+    assert eng.evaluate(snap_of(flood_report(), window=2)) == []
+    # the attack genuinely ends: two quiet ROLLS clear exactly once
+    assert eng.evaluate(snap_of(empty_report(), window=3)) == []
+    t = eng.evaluate(snap_of(empty_report(), window=4))
+    assert len(t) == 1 and t[0]["action"] == "clear"
+    assert eng.view()["active"] == []
+
+
+# --- sinks ---------------------------------------------------------------
+
+class _BoomSink(AlertSink):
+    name = "boom"
+
+    def __init__(self, fail_times=10**9, **kw):
+        super().__init__(**kw)
+        self.calls = 0
+        self.fail_times = fail_times
+
+    def deliver(self, event):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise RuntimeError("sink down")
+
+
+class _ListSink(AlertSink):
+    name = "list"
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.events = []
+
+    def deliver(self, event):
+        self.events.append(event)
+
+
+def test_sink_failure_is_swallowed_counted_and_isolated():
+    m = Metrics()
+    boom, ok = _BoomSink(retries=1), _ListSink()
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=1,
+                                   clear_evals=1)],
+                      metrics=m, sinks=[boom, ok])
+    t = eng.evaluate(snap_of(flood_report()))
+    assert len(t) == 1
+    # the failing sink burned its bounded retries (2 attempts), the good
+    # sink still delivered, the state machine raised regardless
+    assert boom.calls == 2 and len(ok.events) == 1
+    assert len(eng.view()["active"]) == 1
+    text = generate_latest(m.registry).decode()
+    assert 'alert_sink_errors_total{sink="boom"} 1.0' in text
+    stats = eng.view()["sinks"]
+    assert stats["boom"]["errors"] == 1 and stats["list"]["delivered"] == 1
+
+
+def test_sink_bounded_retry_succeeds_within_budget():
+    s = _BoomSink(fail_times=1, retries=2)
+    s.emit({"rule": "x", "action": "raise"})
+    assert s.calls == 2 and s.delivered == 1 and s.errors == 0
+
+
+def test_sink_flap_suppression_dedup_and_reconciliation():
+    """The per-fingerprint delivery discipline: distinct simultaneous
+    alerts all deliver; a flapping alert's CLEAR inside the interval is
+    HELD (receiver keeps it visible), the re-raise dedups against the
+    receiver state, and flush() reconciles a REAL clear once the
+    interval expires — the receiver is never stuck-active or
+    stuck-cleared."""
+    fast, slow = _ListSink(), _ListSink(min_interval_s=0.4)
+    slow.name = "slow"
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=1,
+                                   clear_evals=1)], sinks=[fast, slow])
+    # two DISTINCT alerts in one evaluation: both deliver everywhere
+    eng.evaluate(snap_of(flood_report((1, 2))))
+    assert len(fast.events) == 2 and len(slow.events) == 2
+    assert slow.rate_limited == 0
+    # immediate flap: clear inside the interval is HELD for slow (the
+    # receiver keeps showing the alert), delivered for fast
+    eng.evaluate(snap_of(empty_report()))
+    assert len(fast.events) == 4
+    assert len(slow.events) == 2 and slow.rate_limited == 2
+    assert slow.stats()["pending_transitions"] == 2
+    # the flap re-raises: held clears cancel, receiver state (raised) is
+    # already right — slow dedups, fast gets the fresh raises
+    eng.evaluate(snap_of(flood_report((1, 2))))
+    assert len(fast.events) == 6
+    assert len(slow.events) == 2 and slow.rate_limited == 4
+    assert slow.stats()["pending_transitions"] == 0
+    # a REAL clear reconciles: held past the interval, flush() (driven by
+    # any later evaluation — here a quiet one) delivers it
+    eng.evaluate(snap_of(empty_report()))
+    assert slow.stats()["pending_transitions"] == 2
+    time.sleep(0.45)
+    eng.evaluate(snap_of(empty_report()))  # quiet eval: flush reconciles
+    assert [e["action"] for e in slow.events[2:]] == ["clear", "clear"]
+    assert slow.stats()["pending_transitions"] == 0
+
+
+def test_failed_clear_is_parked_and_reconciled_by_flush():
+    """A CLEAR whose delivery exhausts retries may be the fingerprint's
+    LAST transition ever: it is parked and flush() keeps retrying, so an
+    outage window can never leave the receiver stuck-active."""
+    class Flaky(_ListSink):
+        name = "flaky"
+        down = False
+
+        def deliver(self, event):
+            if self.down:
+                raise RuntimeError("endpoint down")
+            super().deliver(event)
+
+    s = Flaky(retries=0)
+    s.emit({"rule": "r", "bucket": 1, "action": "raise"})
+    s.down = True
+    s.emit({"rule": "r", "bucket": 1, "action": "clear"})
+    assert s.errors == 1 and s.stats()["pending_transitions"] == 1
+    s.down = False
+    assert s.flush() == 1  # the engine drives this each evaluation
+    assert [e["action"] for e in s.events] == ["raise", "clear"]
+    assert s.stats()["pending_transitions"] == 0
+
+
+def test_failed_raise_is_parked_and_reconciled_by_flush():
+    """Symmetric to the clear case: a RAISE lost to an endpoint outage
+    is parked and flush() delivers it once the endpoint recovers — a
+    long-lived alert must not be invisible to the receiver for its whole
+    active lifetime. A clear arriving while its raise is still parked
+    annihilates the pair (the receiver never saw either)."""
+    class Flaky(_ListSink):
+        name = "flaky"
+        down = False
+
+        def deliver(self, event):
+            if self.down:
+                raise RuntimeError("endpoint down")
+            super().deliver(event)
+
+    s = Flaky(retries=0)
+    s.down = True
+    s.emit({"rule": "r", "bucket": 1, "action": "raise"})
+    assert s.errors == 1 and s.stats()["pending_transitions"] == 1
+    s.down = False
+    assert s.flush() == 1
+    assert [e["action"] for e in s.events] == ["raise"]
+    # annihilation: raise parked during an outage, lifecycle ends before
+    # recovery — the receiver (which saw nothing) correctly gets nothing
+    s2 = Flaky(retries=0)
+    s2.down = True
+    s2.emit({"rule": "r", "bucket": 2, "action": "raise"})
+    s2.down = False
+    s2.emit({"rule": "r", "bucket": 2, "action": "clear"})
+    assert s2.flush() == 0 and s2.events == []
+    assert s2.stats()["pending_transitions"] == 0
+
+
+def test_sink_circuit_breaker_bounds_dead_endpoint_stall():
+    """Three consecutive exhausted failures open the breaker: later
+    deliveries are SKIPPED (no deliver() call, no retry stall) until the
+    open window passes — the receiver-state ledger is not advanced, so
+    reconciliation stays possible."""
+    boom = _BoomSink(retries=0)
+    for b in (1, 2, 3):
+        boom.emit({"rule": "syn_flood", "bucket": b, "action": "raise"})
+    assert boom.calls == 3 and boom.errors == 3
+    boom.emit({"rule": "syn_flood", "bucket": 4, "action": "raise"})
+    assert boom.calls == 3  # breaker open: deliver() never invoked
+    assert boom.stats()["breaker_skips"] == 1
+
+
+def test_webhook_sink_posts_json_with_retry():
+    got, fail_first = [], [True]
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            if fail_first[0]:
+                fail_first[0] = False
+                self.send_error(500)
+                return
+            got.append(json.loads(body))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    thr = threading.Thread(target=srv.serve_forever, daemon=True)
+    thr.start()
+    try:
+        sink = WebhookSink(f"http://127.0.0.1:{srv.server_address[1]}/",
+                           min_interval_s=0.0, retries=1)
+        sink.emit({"rule": "syn_flood", "action": "raise", "bucket": 7})
+        assert sink.delivered == 1 and sink.errors == 0
+        assert got == [{"rule": "syn_flood", "action": "raise",
+                        "bucket": 7}]
+    finally:
+        srv.shutdown()
+    with pytest.raises(ValueError):
+        WebhookSink("")
+
+
+def test_metrics_sink_counts_transitions():
+    m = Metrics()
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=1,
+                                   clear_evals=1)],
+                      metrics=m, sinks=[MetricsSink(m)])
+    eng.evaluate(snap_of(flood_report()))
+    eng.evaluate(snap_of(empty_report()))
+    text = generate_latest(m.registry).decode()
+    assert ('alerts_transitions_total{action="raise",rule="syn_flood"} 1.0'
+            in text)
+    assert ('alerts_transitions_total{action="clear",rule="syn_flood"} 1.0'
+            in text)
+    # the active gauge followed the state machine back to 0
+    assert [l for l in text.splitlines()
+            if l.startswith("ebpf_agent_alerts_active ")][0].endswith(" 0.0")
+
+
+def test_broken_rule_is_quiet_but_visible():
+    """A rule whose firing() raises must not silence the other rules —
+    but it must be COUNTED (view rule_errors + errors_total), never
+    silently disabled."""
+    import dataclasses
+
+    m = Metrics()
+    good = signal_rule("syn_flood", raise_evals=1, clear_evals=1)
+    # a scalar rule pointed at a list field: float() raises every eval
+    broken = dataclasses.replace(
+        cardinality_rule(1.0), name="broken", field="HeavyHitters")
+    eng = AlertEngine([broken, good], metrics=m)
+    rep = flood_report()
+    rep["HeavyHitters"] = [{"EstBytes": 1.0}]
+    t = eng.evaluate(snap_of(rep))
+    assert [x["rule"] for x in t] == ["syn_flood"]  # good rule unaffected
+    eng.evaluate(snap_of(rep))
+    assert eng.view()["rule_errors"] == {"broken": 2}
+    text = generate_latest(m.registry).decode()
+    assert ('errors_total{component="alerts",severity="error"} 2.0'
+            in text)
+
+
+def test_erroring_rule_holds_its_active_alerts():
+    """A rule that RAISED and then starts erroring must not read its own
+    failure as quiet: the active alert is HELD (no spurious clear while
+    the anomaly may still be live), and it clears normally once the rule
+    evaluates again."""
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=1,
+                                   clear_evals=1)])
+    t = eng.evaluate(snap_of(flood_report()))
+    assert t[0]["action"] == "raise"
+    broken = empty_report()
+    broken["SynFloodSuspectBuckets"] = [42]  # non-dict: firing() raises
+    for _ in range(3):
+        assert eng.evaluate(snap_of(broken)) == []  # held, never cleared
+    assert len(eng.view()["active"]) == 1
+    assert eng.view()["rule_errors"]["syn_flood"] == 3
+    # a healthy quiet evaluation clears normally
+    t = eng.evaluate(snap_of(empty_report()))
+    assert len(t) == 1 and t[0]["action"] == "clear"
+
+
+def test_fault_points_zero_cost_when_unset():
+    """alerts.evaluate / alerts.sink unset: fire() is a module-bool
+    branch — the shared zero-cost bar of every stage-boundary point."""
+    assert not faultinject.armed("alerts.evaluate")
+    assert not faultinject.armed("alerts.sink")
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        faultinject.fire("alerts.evaluate")
+        faultinject.fire("alerts.sink")
+    assert time.perf_counter() - t0 < 0.5
+
+
+def test_armed_sink_fault_point_is_swallowed_and_counted():
+    m = Metrics()
+    ok = _ListSink()
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=1,
+                                   clear_evals=1)], metrics=m, sinks=[ok])
+    faultinject.arm("alerts.sink", "crash")  # every attempt crashes
+    t = eng.evaluate(snap_of(flood_report()))
+    assert len(t) == 1  # the transition happened; only delivery failed
+    assert faultinject.hits["alerts.sink"] >= 2  # bounded retry attempted
+    assert ok.events == []
+    text = generate_latest(m.registry).decode()
+    assert 'alert_sink_errors_total{sink="list"} 1.0' in text
+
+
+# --- exporter integration ------------------------------------------------
+
+def make_exporter(metrics=None, sink=None, window_s=3600.0, alerts=None,
+                  **kw):
+    return TpuSketchExporter(batch_size=64, window_s=window_s,
+                             sketch_cfg=SMALL_CFG, metrics=metrics,
+                             sink=sink or (lambda obj: None),
+                             alerts=alerts, **kw)
+
+
+def any_data_rule(raise_evals=1, clear_evals=1):
+    """Fires on any window with records (generic make_events traffic has
+    no attack signature, so the integration tests key off cardinality)."""
+    return cardinality_rule(1.0, raise_evals=raise_evals,
+                            clear_evals=clear_evals)
+
+
+def test_roll_publish_drives_engine_and_status_block():
+    m = Metrics()
+    eng = AlertEngine([any_data_rule()], metrics=m, sinks=[MetricsSink(m)])
+    exp = make_exporter(metrics=m, alerts=eng)
+    try:
+        exp.export_evicted(EvictedFlows(make_events(32)))
+        exp.flush()
+        view = eng.view()
+        assert view["evals"] == 1 and not view["mid_window"]
+        assert view["active"][0]["rule"] == "cardinality_surge"
+        # /query/status carries the summary from the SAME view publisher
+        st = exp.query_status()
+        assert st["alerts"] == {"active": 1, "last_transition_seq": 1,
+                                "evals": 1}
+        # the engine's closed-window ring tracks the roll
+        assert eng.windows() == [view["window"]]
+        # /query/alerts through the shared routes
+        code, body = exp.query_routes.handle("/query/alerts", {})
+        assert code == 200 and len(body["active"]) == 1
+        code, body = exp.query_routes.handle(
+            "/query/alerts", {"window": str(view["window"])})
+        assert code == 200 and body["window"] == view["window"]
+        code, body = exp.query_routes.handle("/query/alerts",
+                                             {"window": "99999"})
+        assert code == 404 and "windows" in body
+        code, _ = exp.query_routes.handle("/query/alerts",
+                                          {"window": "bogus"})
+        assert code == 400
+    finally:
+        exp.close()
+
+
+def test_alert_evaluate_crash_never_loses_report_or_snapshot():
+    """An armed alerts.evaluate crash: the window report still reaches the
+    sink, the query snapshot still publishes, the error is counted, and
+    the NEXT publish evaluates normally."""
+    m = Metrics()
+    reports: list[dict] = []
+    eng = AlertEngine([any_data_rule()], metrics=m)
+    exp = make_exporter(metrics=m, sink=reports.append, alerts=eng)
+    try:
+        faultinject.arm("alerts.evaluate", "crash", times=1)
+        exp.export_evicted(EvictedFlows(make_events(8)))
+        exp.flush()
+        assert len(reports) == 1 and reports[0]["Records"] == 8.0
+        assert exp.query.get() is not None  # snapshot published
+        assert eng.view()["evals"] == 0  # the evaluation was the casualty
+        text = generate_latest(m.registry).decode()
+        assert ('errors_total{component="alerts",severity="error"} 1.0'
+                in text)
+        exp.export_evicted(EvictedFlows(make_events(4)))
+        exp.flush()
+        assert eng.view()["evals"] == 1  # next publish evaluated
+        assert len(reports) == 2
+    finally:
+        exp.close()
+
+
+def test_disabled_is_structurally_absent():
+    """ALERT_RULES unset: no engine object, one is-None check — the
+    pinned bit-identical bar. /query/alerts answers 404 (alerting
+    disabled), /query/status has no alerts block, no alert metrics move."""
+    m = Metrics()
+    exp = make_exporter(metrics=m)  # alerts defaults to None
+    try:
+        assert exp._alerts is None
+        exp.export_evicted(EvictedFlows(make_events(8)))
+        exp.flush()
+        code, body = exp.query_routes.handle("/query/alerts", {})
+        assert code == 404 and "disabled" in body["error"]
+        st = exp.query_status()
+        assert "alerts" not in st
+        text = generate_latest(m.registry).decode()
+        assert [l for l in text.splitlines()
+                if l.startswith("ebpf_agent_alerts_active ")][0] \
+            .endswith(" 0.0")
+        assert "alerts_transitions_total{" not in text
+    finally:
+        exp.close()
+
+
+def test_exactly_once_transitions_across_timer_restart():
+    """A window-timer crash between roll and publish restarts under the
+    supervisor; the queued report publishes exactly once — so the alert
+    engine sees exactly one evaluation for it and transitions never
+    double-fire (no duplicate (rule, bucket, action, window) ever)."""
+    from netobserv_tpu.agent.supervisor import Supervisor
+    from netobserv_tpu.model.record import records_from_events
+
+    def wait_for(pred, timeout=10.0, msg="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {msg}")
+
+    m = Metrics()
+    reports: list[dict] = []
+    # clear_evals high: the raise stays active across the idle windows
+    # the fast timer keeps rolling, so the transition ledger stays small
+    eng = AlertEngine([any_data_rule(raise_evals=1, clear_evals=50)],
+                      metrics=m)
+    exp = TpuSketchExporter(batch_size=32, window_s=0.4,
+                            sketch_cfg=SMALL_CFG, metrics=m,
+                            sink=reports.append, alerts=eng)
+    sup = Supervisor(metrics=m, check_period_s=0.05)
+    exp.register_supervised(sup, heartbeat_timeout_s=2.0, max_restarts=3,
+                            backoff_initial_s=0.05, backoff_max_s=0.2,
+                            healthy_reset_s=30.0)
+    sup.start()
+    try:
+        exp.export_batch(records_from_events(make_events(8)))
+        faultinject.arm("sketch.window_publish", "crash", times=1)
+        wait_for(lambda: faultinject.hits.get("sketch.window_publish",
+                                              0) >= 1,
+                 msg="publish crash to fire")
+        wait_for(lambda: sup.snapshot()["sketch-window"]["restarts"] >= 1,
+                 msg="window timer restart")
+        wait_for(lambda: len(reports) >= 2, msg="reports after restart")
+        # the supervisor surfaces the alerting condition (and it never
+        # fails readiness — conditions are not DEGRADED)
+        cond = sup.conditions()["alerting"]
+        assert cond["active"] and cond["active_alerts"] == 1
+        assert not sup.degraded
+    finally:
+        faultinject.clear()
+        sup.stop()
+        exp.close()
+    # every publish evaluated exactly once...
+    assert eng.view()["evals"] == len(reports)
+    # ...and no transition duplicated across the crash/restart boundary
+    seen = [(t["rule"], t["bucket"], t["action"], t["window"])
+            for t in eng.view()["recent"]]
+    assert len(seen) == len(set(seen)), f"duplicated transitions: {seen}"
+    raises = [t for t in eng.view()["recent"] if t["action"] == "raise"]
+    assert len(raises) == 1  # the one data window raised exactly once
+
+
+def test_metrics_server_serves_query_alerts():
+    from netobserv_tpu.metrics.server import start_metrics_server
+
+    m = Metrics()
+    eng = AlertEngine([any_data_rule()], metrics=m)
+    exp = make_exporter(metrics=m, alerts=eng)
+    srv = start_metrics_server(m.registry, "127.0.0.1", 0,
+                               query_routes=exp.query_routes)
+    port = srv.server_address[1]
+
+    def http_get(path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read())
+
+    try:
+        code, body = http_get("/query/alerts")
+        assert code == 200 and body["active"] == []  # queryable pre-publish
+        exp.export_evicted(EvictedFlows(make_events(16)))
+        exp.flush()
+        code, body = http_get("/query/alerts")
+        assert code == 200
+        assert body["active"][0]["rule"] == "cardinality_surge"
+        code, body = http_get("/query")
+        assert "/query/alerts" in body["routes"]
+    finally:
+        srv.shutdown()
+        exp.close()
+
+
+# --- federation mount ----------------------------------------------------
+
+def test_federation_aggregator_mounts_engine_and_serves_alerts():
+    """The aggregator drives the SAME engine core over its merged-window
+    snapshots; /federation/alerts is a thin adapter over the one
+    route_payload builder."""
+    from netobserv_tpu.federation.aggregator import FederationAggregator
+    from netobserv_tpu.federation.query import start_query_server
+
+    m = Metrics()
+    eng = AlertEngine([any_data_rule()], metrics=m, source="federation")
+    agg = FederationAggregator(sketch_cfg=SMALL_CFG, window_s=3600.0,
+                               metrics=m, alerts=eng)
+    srv = start_query_server(agg, port=0)
+    port = srv.server_address[1]
+
+    def http_get(path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read())
+
+    try:
+        agg.flush()  # closes an (empty) window -> publish -> evaluate
+        assert eng.view()["evals"] == 1
+        assert eng.view()["source"] == "federation"
+        code, body = http_get("/federation/alerts")
+        assert code == 200 and body["active"] == []  # empty window: quiet
+        code, body = http_get("/federation/alerts?window=424242")
+        assert code == 404 and "windows" in body
+        code, body = http_get("/federation/alerts?window=bogus")
+        assert code == 400
+        code, body = http_get("/federation/status")
+        assert code == 200 and body["alerts"]["evals"] >= 1
+        code, body = http_get("/federation")
+        assert "/federation/alerts" in body["routes"]
+    finally:
+        srv.shutdown()
+        agg.close()
+
+
+def test_federation_alerts_404_when_disabled():
+    from netobserv_tpu.federation.aggregator import FederationAggregator
+    from netobserv_tpu.federation.query import start_query_server
+
+    agg = FederationAggregator(sketch_cfg=SMALL_CFG, window_s=3600.0)
+    srv = start_query_server(agg, port=0)
+    port = srv.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/federation/alerts")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 404
+    finally:
+        srv.shutdown()
+        agg.close()
+
+
+# --- config-driven construction -----------------------------------------
+
+def test_maybe_engine_gated_on_alert_rules():
+    from netobserv_tpu.alerts import maybe_engine
+    from netobserv_tpu.config import load_config
+
+    assert maybe_engine(load_config(environ={})) is None
+    cfg = load_config(environ={
+        "EXPORT": "tpu-sketch",
+        "ALERT_RULES": "default,cardinality_surge:5000",
+        "ALERT_RAISE_EVALS": "3", "ALERT_CLEAR_EVALS": "4",
+        "ALERT_SINKS": "log"})
+    cfg.validate()
+    eng = maybe_engine(cfg, Metrics())
+    assert eng is not None
+    view = eng.view()
+    assert view["rules"] == [*SIGNAL_FIELDS, "cardinality_surge"]
+    assert [type(s).__name__ for s in eng._sinks] == ["LogSink"]
+    # the hysteresis overrides reached every rule
+    assert all(r.raise_evals == 3 and r.clear_evals == 4
+               for r in eng._rules)
+
+
+def test_config_validates_alert_specs():
+    from netobserv_tpu.config import load_config
+
+    base = {"EXPORT": "tpu-sketch"}
+    cfg = load_config(environ={**base, "ALERT_RULES": "bogus_rule"})
+    with pytest.raises(ValueError, match="unknown rule"):
+        cfg.validate()
+    cfg = load_config(environ={**base, "ALERT_RULES": "default",
+                               "ALERT_SINKS": "webhook"})
+    with pytest.raises(ValueError, match="ALERT_WEBHOOK_URL"):
+        cfg.validate()
+    cfg = load_config(environ={**base, "ALERT_RULES": "default",
+                               "ALERT_RAISE_EVALS": "0"})
+    with pytest.raises(ValueError, match="ALERT_RAISE_EVALS"):
+        cfg.validate()
+    cfg = load_config(environ={
+        **base, "ALERT_RULES": "default", "ALERT_SINKS": "log,webhook",
+        "ALERT_WEBHOOK_URL": "http://127.0.0.1:9/hook",
+        "ALERT_WEBHOOK_INTERVAL": "500ms"})
+    cfg.validate()
+    assert cfg.alert_webhook_interval == 0.5

@@ -4,10 +4,12 @@ the full-agent replay runner grading detection quality through the live
 
 Tiering (docs/architecture.md "Test tiering"): the generators and the
 grading logic are plain-python fast tests; ONE full end-to-end scenario
-(syn_flood — the cheapest pcap with the strongest assertion set: alarm
-fires, victim named, cardinality bounded) runs in tier-1 as the smoke; the
-remaining five scenarios are `slow` (each spins a full agent + metrics
-server + compile-heavy sketch mesh path).
+(overlay_syn_scan — the mixed-attack overlay with the strongest assertion
+set: BOTH alarms raise live through /query/alerts with correct victim
+attribution and no cross-talk, cardinality bounded, sub-window
+time-to-detect) runs in tier-1 as the smoke; the remaining seven
+scenarios are `slow` (each spins a full agent + metrics server +
+compile-heavy sketch mesh path).
 """
 
 from __future__ import annotations
@@ -50,6 +52,21 @@ def test_zoo_covers_fire_and_quiet_for_every_signal():
     quiet = {s for t in truths for s in t.get("quiet_alarms", ())}
     assert {"syn_flood", "port_scan", "asym_conv"} <= fired
     assert quiet == set(SIGNALS)
+    # the mixed-attack overlay is the one scenario expecting TWO alarms
+    # at once (the cross-talk pin)
+    overlay = next(t for t in truths if t["name"] == "overlay_syn_scan")
+    assert set(overlay["expect_alarms"]) == {"syn_flood", "port_scan"}
+    assert len(SCENARIOS) == 8
+
+
+def test_signals_share_one_truth_with_the_alert_rules():
+    """zoo.SIGNALS, /query/victims and the default alert rules all derive
+    from alerts.rules.SIGNAL_FIELDS — the drift this would catch is a new
+    signal plane landing in one surface but not the others."""
+    from netobserv_tpu.alerts.rules import SIGNAL_FIELDS, default_rules
+    assert SIGNALS == tuple(SIGNAL_FIELDS)
+    assert [r.name for r in default_rules()] == list(SIGNAL_FIELDS)
+    assert {r.field for r in default_rules()} == set(SIGNAL_FIELDS.values())
 
 
 # --- the grading logic alone (no agent) ---------------------------------
@@ -75,7 +92,14 @@ def test_evaluate_alarm_directions():
              "expect_alarms": ["syn_flood"], "quiet_alarms": ["port_scan"]}
     quiet = {s: [] for s in SIGNALS}
     firing = dict(quiet, syn_flood=[{"bucket": 1, "probable_victims": []}])
-    assert evaluate(truth, [_obs(victims=firing)])["passed"]
+    obs = _obs(victims=firing)
+    obs["alerts"] = {"active": [{"rule": "syn_flood", "victims": []}],
+                     "recent": [], "transition_seq": 1}
+    assert evaluate(truth, [obs], time_to_detect_s=1.0)["passed"]
+    # an attack truth with NO alert view ever observed must fail (a dead
+    # /query/alerts surface cannot silently skip the alert assertions)
+    out = evaluate(truth, [_obs(victims=firing)])
+    assert any("no /query/alerts view" in f for f in out["failures"])
     # expected alarm missing
     out = evaluate(truth, [_obs(victims=quiet)])
     assert any("never fired" in f for f in out["failures"])
@@ -84,6 +108,53 @@ def test_evaluate_alarm_directions():
     out = evaluate(truth, [_obs(victims=firing),
                            _obs(records=0.0, victims=noisy)])
     assert any("benign" in f for f in out["failures"])
+
+
+def _alert_view(active=(), recent=(), transition_seq=0):
+    return {"active": list(active), "recent": list(recent),
+            "transition_seq": transition_seq, "evals": 1}
+
+
+def test_evaluate_alert_directions_and_time_to_detect():
+    """The /query/alerts grading: expected alarms must RAISE live, quiet
+    ones must never raise, victim attribution rides the alert, and
+    detection must land sub-window."""
+    truth = {"name": "x", "min_records": 1,
+             "expect_alarms": ["syn_flood"], "quiet_alarms": ["port_scan"],
+             "victim": "2.2.2.2", "victim_signal": "syn_flood"}
+    quiet_v = {s: [] for s in SIGNALS}
+    firing_v = dict(quiet_v,
+                    syn_flood=[{"bucket": 1,
+                                "probable_victims": ["2.2.2.2"]}])
+    raised = _alert_view(
+        active=[{"rule": "syn_flood", "victims": ["2.2.2.2"],
+                 "bucket": 1}], transition_seq=1)
+    obs = _obs(victims=firing_v)
+    obs["alerts"] = raised
+    out = evaluate(truth, [obs], time_to_detect_s=1.2, window_s=600.0)
+    assert out["passed"], out["failures"]
+    assert out["alerts_raised"] == ["syn_flood"]
+    assert out["alert_victim_named"] and out["time_to_detect_s"] == 1.2
+    # expected alert never raised
+    obs_quiet = _obs(victims=firing_v)
+    obs_quiet["alerts"] = _alert_view()
+    out = evaluate(truth, [obs_quiet], time_to_detect_s=None,
+                   window_s=600.0)
+    assert any("never RAISED" in f for f in out["failures"])
+    assert any("no live RAISE" in f for f in out["failures"])
+    # a quiet alert raising (even via a ring transition) fails
+    obs_noisy = _obs(victims=firing_v)
+    obs_noisy["alerts"] = _alert_view(
+        active=[{"rule": "syn_flood", "victims": ["2.2.2.2"],
+                 "bucket": 1}],
+        recent=[{"rule": "port_scan", "action": "raise"}],
+        transition_seq=2)
+    out = evaluate(truth, [obs_noisy], time_to_detect_s=0.5,
+                   window_s=600.0)
+    assert any("benign" in f for f in out["failures"])
+    # detection slower than one window period is NOT sub-window
+    out = evaluate(truth, [obs], time_to_detect_s=700.0, window_s=600.0)
+    assert any("not sub-window" in f for f in out["failures"])
 
 
 def test_evaluate_topk_recall_and_victim_naming():
@@ -140,17 +211,22 @@ def _run(name, tmp_path):
     return result
 
 
-def test_scenario_smoke_syn_flood(tmp_path):
+def test_scenario_smoke_overlay_syn_scan(tmp_path):
     """Tier-1 smoke: the full pipeline — pcap -> replay -> agent -> sketch
-    -> query snapshot -> HTTP /query/* — detects the SYN flood and names
-    the victim."""
-    result = _run("syn_flood", tmp_path)
-    assert result["alarms_fired"] == ["syn_flood"]
-    assert result["victim_named"]
+    -> query snapshot -> alert engine -> HTTP /query/* — detects a MIXED
+    attack: the flood AND the scan both raise live through /query/alerts
+    with correct victim attribution, no cross-talk alarm fires, and
+    detection lands sub-window."""
+    result = _run("overlay_syn_scan", tmp_path)
+    assert sorted(result["alarms_fired"]) == ["port_scan", "syn_flood"]
+    assert sorted(result["alerts_raised"]) == ["port_scan", "syn_flood"]
+    assert result["victim_named"] and result["alert_victim_named"]
+    assert result["time_to_detect_s"] is not None
+    assert result["alert_transitions"] >= 2  # one raise per attack
 
 
 @pytest.mark.slow
 @pytest.mark.parametrize("name", sorted(n for n in SCENARIOS
-                                        if n != "syn_flood"))
+                                        if n != "overlay_syn_scan"))
 def test_scenario_zoo_slow(name, tmp_path):
     _run(name, tmp_path)
