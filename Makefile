@@ -45,6 +45,14 @@ bench-device:
 bench-evict:
 	JAX_PLATFORMS=cpu $(PY) bench.py --evict-only
 
+# persistent-slot top-K ablation (~60s, CPU-friendly): slot-table vs the
+# legacy concat+re-score update — cost (CM-only arm attributes the
+# table's share) and top-N recall vs exact truth at 10k/100k distinct
+# keys — the non-gating CI artifact for the device-resident heavy-hitter
+# plane (docs/tpu_sketch.md "Persistent-slot heavy-hitter plane")
+bench-topk:
+	JAX_PLATFORMS=cpu $(PY) bench.py --topk-only
+
 # overload control plane (~15s): overdriven synthetic feed against a
 # fault-slowed fold — sustained admitted rate, AIMD shed-factor
 # trajectory, heavy-hitter recall under shed vs unshed — the per-PR CI

@@ -746,6 +746,121 @@ def device_stage_stats() -> dict:
     return out
 
 
+def topk_ablation_stats() -> dict:
+    """`--topk-only` / `make bench-topk` (also folded into
+    `--device-only`): the persistent-slot heavy-hitter plane vs the legacy
+    concat+re-score update, at 10k and 100k distinct keys over a zipf
+    stream — update cost (records/s through CM fold + table maintenance;
+    a CM-only arm attributes the table's share) and top-N recall against
+    the exact host-side truth. The slot table must match or beat the
+    baseline's recall (ISSUE 13 acceptance); its win is the per-key churn
+    metadata and the ready-at-roll table neither exists in the baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    from netobserv_tpu.ops import countmin, hashing, topk
+
+    K = 1024
+    out: dict = {"metric": "topk_ablation", "unit": "records/s",
+                 "table_k": K, "batch": BATCH,
+                 "device_backend": jax.default_backend()}
+
+    step_cm = jax.jit(
+        lambda cm, words, vals, valid: countmin.update(
+            cm, *hashing.base_hashes(words), vals, valid),
+        donate_argnums=(0,))
+
+    # the fused Pallas reduction engages on TPU like production ingest
+    # does; off-TPU both arms run their scatter forms (interpret mode is
+    # a Python loop — meaningless for comparison, same policy as
+    # device_stage_stats)
+    slot_pallas = jax.default_backend() == "tpu"
+    out["slot_pallas_reduction"] = slot_pallas
+
+    def step_slot(cm, table, words, vals, valid):
+        h1, h2 = hashing.base_hashes(words)
+        cm = countmin.update(cm, h1, h2, vals, valid)
+        table, _ = topk.slot_update(table, cm, words, h1, h2, valid,
+                                    window=0, use_pallas=slot_pallas)
+        return cm, table
+    step_slot = jax.jit(step_slot, donate_argnums=(0, 1))
+
+    def step_legacy(cm, table, words, vals, valid):
+        h1, h2 = hashing.base_hashes(words)
+        cm = countmin.update(cm, h1, h2, vals, valid)
+        table = topk.update(table, cm, words, h1, h2, valid, salt=0)
+        return cm, table
+    step_legacy = jax.jit(step_legacy, donate_argnums=(0, 1))
+
+    for n_keys in (10_000, 100_000):
+        rng = np.random.default_rng(7)
+        universe = rng.integers(0, 2**32, (n_keys, 10), dtype=np.uint32)
+        truth = np.zeros(n_keys)
+        batches = []
+        for _ in range(24):
+            ranks = np.minimum(rng.zipf(1.1, BATCH) - 1, n_keys - 1)
+            vals = rng.integers(64, 9000, BATCH).astype(np.float32)
+            np.add.at(truth, ranks, vals)
+            batches.append((jnp.asarray(universe[ranks]),
+                            jnp.asarray(vals)))
+        valid = jnp.ones((BATCH,), jnp.bool_)
+        # identity -> universe rank (recall oracle; h1 is the table's key)
+        h1_all = np.asarray(hashing.base_hashes(jnp.asarray(universe))[0])
+        rank_of = {int(h): i for i, h in enumerate(h1_all)}
+
+        def run(step, with_table: bool):
+            cm = countmin.init(4, 1 << 16)
+            table = topk.init_slots(K) if step is step_slot else \
+                topk.init(K)
+            # warm the compile, then time the whole stream
+            if with_table:
+                cm, table = step(cm, table, *batches[0], valid)
+                jax.block_until_ready(cm.counts)
+                cm = countmin.init(4, 1 << 16)
+                table = topk.init_slots(K) if step is step_slot else \
+                    topk.init(K)
+                t0 = time.perf_counter()
+                for words, vals in batches:
+                    cm, table = step(cm, table, words, vals, valid)
+                jax.block_until_ready(cm.counts)
+            else:
+                cm = step(cm, *batches[0], valid)
+                jax.block_until_ready(cm.counts)
+                cm = countmin.init(4, 1 << 16)
+                t0 = time.perf_counter()
+                for words, vals in batches:
+                    cm = step(cm, words, vals, valid)
+                jax.block_until_ready(cm.counts)
+            rate = round(len(batches) * BATCH
+                         / (time.perf_counter() - t0))
+            return rate, table
+
+        def recall(table, n: int) -> float:
+            counts = np.asarray(table.counts)
+            tvalid = np.asarray(table.valid)
+            th1 = np.asarray(table.h1)
+            want = set(np.argsort(-truth)[:n])
+            order = np.argsort(-np.where(tvalid, counts, -1.0))[:n]
+            got = {rank_of.get(int(th1[i]), -1) for i in order
+                   if tvalid[i]}
+            return round(len(want & got) / n, 4)
+
+        cm_rate, _ = run(step_cm, False)
+        slot_rate, slot_table = run(step_slot, True)
+        legacy_rate, legacy_table = run(step_legacy, True)
+        tag = f"{n_keys // 1000}k"
+        out[f"topk_{tag}"] = {
+            "cm_only_records_per_sec": cm_rate,
+            "slot_records_per_sec": slot_rate,
+            "concat_rescore_records_per_sec": legacy_rate,
+            "slot_recall_16": recall(slot_table, 16),
+            "slot_recall_128": recall(slot_table, 128),
+            "concat_rescore_recall_16": recall(legacy_table, 16),
+            "concat_rescore_recall_128": recall(legacy_table, 128),
+        }
+    return out
+
+
 def _evict_synth(n_flows: int, n_cpus: int, rng) -> tuple:
     """Synthetic multi-CPU drain buffers: agg keys/stats + per-CPU feature
     partials with a live-traffic mix (extra on every flow, DNS on ~5%,
@@ -1276,6 +1391,18 @@ def main():
         # ablations, pallas A/B on TPU, superbatch ladder) — the non-gating
         # CI artifact tracking the fusion win release-over-release
         out = device_stage_stats()
+        out.update(topk_ablation_stats())
+        out["metric"] = "device_stage_breakdown"
+        if _DEVICE_NOTE:
+            out["device"] = _DEVICE_NOTE
+        out["device_provenance"] = device_provenance(cpu_requested)
+        print(json.dumps(out))
+        return
+    if "--topk-only" in sys.argv:
+        # `make bench-topk` (~30s, CPU-friendly): persistent-slot vs
+        # concat+re-score top-K update cost + recall at 10k/100k keys —
+        # the non-gating CI artifact tracking the slot plane's cost
+        out = topk_ablation_stats()
         if _DEVICE_NOTE:
             out["device"] = _DEVICE_NOTE
         out["device_provenance"] = device_provenance(cpu_requested)

@@ -74,6 +74,15 @@ class AlertRule:
     - ``topk_share`` — fire when the top heavy hitter's ``EstBytes`` share
       of the window's ``Bytes`` reaches ``threshold`` (a single flow
       dominating the window).
+    - ``flow_keys`` — fire one instance per PER-KEY churn entry in
+      ``report[field]`` (``FlowAscents`` / ``NewHeavyKeys``, rendered by
+      the persistent-slot heavy-hitter plane — no host sort exists
+      anywhere on this path: the slot table ships ready, the renderer
+      diffs K rows). Fingerprint = (rule, the entry's ``Key`` 5-tuple
+      string); victims = the flow's endpoints. For ``flow_ascent`` a
+      non-zero ``threshold`` RE-FILTERS the rendered entries by their
+      window-over-window ``Ratio`` (a per-rule factor on top of the
+      renderer's ``SKETCH_CHURN_ASCENT`` gate — it can only tighten).
     """
 
     name: str
@@ -104,6 +113,22 @@ class AlertRule:
                 return [{"bucket": None, "value": round(share, 4),
                          "victims": [top.get("DstAddr", "")]}]
             return []
+        if self.kind == "flow_keys":
+            out = []
+            for e in (report.get(self.field) or []):
+                if self.threshold and \
+                        float(e.get("Ratio", 0.0)) < self.threshold:
+                    continue
+                out.append({
+                    "bucket": e.get("Key", ""),
+                    "value": float(e.get(self.value_key, 0.0) or 0.0)
+                    if self.value_key else 0.0,
+                    # the flow's endpoints — rendered by report_to_json
+                    # from the slot's exact key words, never re-hashed
+                    "victims": [e.get("SrcAddr", ""),
+                                e.get("DstAddr", "")],
+                })
+            return out
         buckets = report.get(self.field) or []
         if len(buckets) < self.threshold:
             return []
@@ -144,9 +169,53 @@ def topk_share_rule(share: float, raise_evals: int = 2,
         raise_evals=raise_evals, clear_evals=clear_evals)
 
 
+def flow_ascent_rule(factor: float = 0.0, raise_evals: int = 1,
+                     clear_evals: int = 2) -> AlertRule:
+    """Per-flow ascent: a tracked key whose window count grew past the
+    renderer's SKETCH_CHURN_ASCENT factor of its previous window (a mouse
+    ramping into an elephant). `factor` > 0 additionally re-filters by the
+    entry's rendered Ratio — a per-rule tightening knob
+    (``flow_ascent:<factor>``); 0 fires on the rendered list as-is (the
+    one-threshold-truth default).
+
+    raise_evals defaults to 1, NOT the bucket rules' 2: a churn entry
+    already encodes a two-window crossing (count vs the closed previous
+    window), and in reset mode it exists in exactly ONE roll snapshot —
+    on a roll-only deployment (SKETCH_QUERY_REFRESH unset, the default) a
+    2-eval hysteresis could never accumulate two consecutive firing
+    evaluations and the rule would be structurally dead."""
+    return AlertRule(
+        name="flow_ascent", field="FlowAscents", kind="flow_keys",
+        severity="warning", threshold=factor, value_key="Ratio",
+        raise_evals=raise_evals, clear_evals=clear_evals)
+
+
+def new_heavy_key_rule(raise_evals: int = 1,
+                       clear_evals: int = 2) -> AlertRule:
+    """A key entering the heavy table for the first time this window with
+    real mass (>= SKETCH_CHURN_MIN_BYTES) — a brand-new elephant.
+    raise_evals defaults to 1 for the same one-roll-snapshot reason as
+    `flow_ascent_rule` (first_seen matches exactly one window)."""
+    return AlertRule(
+        name="new_heavy_key", field="NewHeavyKeys", kind="flow_keys",
+        severity="warning", value_key="EstBytes", threshold=0.0,
+        raise_evals=raise_evals, clear_evals=clear_evals)
+
+
 def default_rules(raise_evals: int = 2, clear_evals: int = 2) -> list:
-    """One rule per anomaly signal (the ALERT_RULES=default set)."""
-    return [signal_rule(s, raise_evals, clear_evals) for s in SIGNAL_FIELDS]
+    """One rule per anomaly signal, plus the two per-flow churn rules
+    (the ALERT_RULES=default set). The churn rules are structurally quiet
+    until the table has cross-window history (first window: prev_counts
+    are zero and NewHeavyKeys render only for window > 0), so enabling
+    them by default adds no cold-start noise."""
+    # the churn rules keep their own raise_evals=1 (one-roll-snapshot
+    # lifetime — see flow_ascent_rule); only the clear schedule follows
+    # the global setting
+    return [signal_rule(s, raise_evals, clear_evals)
+            for s in SIGNAL_FIELDS] + [
+        flow_ascent_rule(0.0, clear_evals=clear_evals),
+        new_heavy_key_rule(clear_evals=clear_evals),
+    ]
 
 
 def parse_rules(spec: str, raise_evals: int = 2,
@@ -154,8 +223,10 @@ def parse_rules(spec: str, raise_evals: int = 2,
     """Parse an ALERT_RULES spec into a rule list.
 
     Grammar: comma-separated tokens; ``default`` expands to the five
-    signal rules; a bare signal name enables that one; parameterized
-    rules spell ``cardinality_surge:<count>`` / ``topk_share:<fraction>``.
+    signal rules plus the two per-flow churn rules; a bare signal name
+    enables that one; parameterized rules spell
+    ``cardinality_surge:<count>`` / ``topk_share:<fraction>`` /
+    ``flow_ascent[:<factor>]``; ``new_heavy_key`` takes no parameter.
     Duplicate names keep the LAST occurrence (an override idiom)."""
     def _num(arg: str, tok: str) -> float:
         try:
@@ -197,11 +268,28 @@ def parse_rules(spec: str, raise_evals: int = 2,
                                  "(e.g. topk_share:0.5)")
             out[name] = topk_share_rule(_num(arg, tok), raise_evals,
                                         clear_evals)
+        elif name == "flow_ascent":
+            # optional factor: bare = the renderer's SKETCH_CHURN_ASCENT
+            # gate is the one truth; a factor only tightens on top of it
+            factor = _num(arg, tok) if arg else 0.0
+            if arg and factor <= 1.0:
+                raise ValueError(
+                    f"ALERT_RULES: {tok!r} — the flow_ascent factor is a "
+                    "window-over-window growth ratio and must be > 1")
+            out[name] = flow_ascent_rule(factor, clear_evals=clear_evals)
+        elif name == "new_heavy_key":
+            if arg:
+                raise ValueError(
+                    f"ALERT_RULES: new_heavy_key takes no parameter "
+                    f"(got {tok!r}; the mass floor lives in "
+                    "SKETCH_CHURN_MIN_BYTES)")
+            out[name] = new_heavy_key_rule(clear_evals=clear_evals)
         else:
             raise ValueError(
                 f"ALERT_RULES: unknown rule {name!r} (one of "
                 f"{', '.join(SIGNAL_FIELDS)}, cardinality_surge:<n>, "
-                f"topk_share:<f>, default)")
+                f"topk_share:<f>, flow_ascent[:<factor>], new_heavy_key, "
+                "default)")
     if not out:
         raise ValueError("ALERT_RULES is set but names no rules")
     return list(out.values())

@@ -82,6 +82,16 @@ DEFAULT_DROP_Z = 6.0
 DEFAULT_ASYM_MIN_BYTES = 1 << 20
 DEFAULT_ASYM_RATIO = 0.95
 
+#: heavy-hitter churn (persistent-slot top-K plane): a slot whose window
+#: count reaches ASCENT x its previous-window count (with at least
+#: MIN_BYTES of current mass) renders as a flow ascent; the reciprocal
+#: direction (prev >= MIN_BYTES, count <= prev/ASCENT) as a descent; a
+#: slot first seen this window with >= MIN_BYTES as a new heavy key.
+#: Single definitions — the renderer, the zoo runner, and the default
+#: flow_ascent/new_heavy_key alert rules all read these
+DEFAULT_CHURN_ASCENT = 8.0
+DEFAULT_CHURN_MIN_BYTES = 1 << 20
+
 VALID_EXPORTERS = (
     EXPORT_GRPC, EXPORT_KAFKA, EXPORT_IPFIX_UDP, EXPORT_IPFIX_TCP,
     EXPORT_DIRECT_FLP, EXPORT_TPU_SKETCH, EXPORT_STDOUT,
@@ -339,6 +349,16 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_asym_ratio: float = field(
         default=DEFAULT_ASYM_RATIO,
         **_env("SKETCH_ASYM_RATIO", str(DEFAULT_ASYM_RATIO)))
+    #: heavy-hitter churn render gates (persistent-slot top-K plane): the
+    #: count:prev_count growth factor that renders a slot as a flow
+    #: ascent/descent, and the current-mass floor for ascent + new-heavy
+    #: listings (see exporter/tpu_sketch.py report_to_json)
+    sketch_churn_ascent: float = field(
+        default=DEFAULT_CHURN_ASCENT,
+        **_env("SKETCH_CHURN_ASCENT", str(DEFAULT_CHURN_ASCENT)))
+    sketch_churn_min_bytes: int = field(
+        default=DEFAULT_CHURN_MIN_BYTES,
+        **_env("SKETCH_CHURN_MIN_BYTES", str(DEFAULT_CHURN_MIN_BYTES)))
     #: native packer threads (0 = auto: cpu count, max 8). Dense feed:
     #: row-sharded single-pass packs. RESIDENT feed (the default): the
     #: batch splits into this many pack LANES, each with its own
@@ -591,6 +611,11 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
         if self.alert_raise_evals < 1 or self.alert_clear_evals < 1:
             raise ValueError("ALERT_RAISE_EVALS and ALERT_CLEAR_EVALS "
                              "must be >= 1")
+        if self.sketch_churn_ascent <= 1.0:
+            raise ValueError("SKETCH_CHURN_ASCENT must be > 1 (it is a "
+                             "window-over-window growth factor)")
+        if self.sketch_churn_min_bytes < 0:
+            raise ValueError("SKETCH_CHURN_MIN_BYTES must be >= 0")
         if self.alert_ring < 1:
             raise ValueError("ALERT_RING must be >= 1")
         if self.alert_webhook_interval < 0:

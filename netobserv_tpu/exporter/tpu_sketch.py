@@ -26,9 +26,9 @@ import numpy as np
 
 from netobserv_tpu.alerts.rules import SIGNAL_FIELDS
 from netobserv_tpu.config import (
-    DEFAULT_ASYM_MIN_BYTES, DEFAULT_ASYM_RATIO, DEFAULT_DDOS_Z,
-    DEFAULT_DROP_Z, DEFAULT_SCAN_FANOUT, DEFAULT_SYNFLOOD_MIN,
-    DEFAULT_SYNFLOOD_RATIO,
+    DEFAULT_ASYM_MIN_BYTES, DEFAULT_ASYM_RATIO, DEFAULT_CHURN_ASCENT,
+    DEFAULT_CHURN_MIN_BYTES, DEFAULT_DDOS_Z, DEFAULT_DROP_Z,
+    DEFAULT_SCAN_FANOUT, DEFAULT_SYNFLOOD_MIN, DEFAULT_SYNFLOOD_RATIO,
 )
 from netobserv_tpu.datapath import flowpack
 from netobserv_tpu.exporter.base import Exporter
@@ -92,6 +92,46 @@ def make_report_sink(cfg) -> ReportSink:
     return _default_sink
 
 
+def _slot_key_entries(words: np.ndarray, rows) -> list[dict]:
+    """Render slot-table rows' packed key words into addr/port dicts, with
+    a stable `Key` fingerprint string (the churn alert rules' dedup id)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    out: list[dict] = []
+    if not len(rows):
+        return out
+    keys = unpack_key_words(words[rows])
+    for k in keys:
+        src = ip_from_16(k["src_ip"].tobytes())
+        dst = ip_from_16(k["dst_ip"].tobytes())
+        sp, dp, proto = int(k["src_port"]), int(k["dst_port"]), \
+            int(k["proto"])
+        out.append({
+            "SrcAddr": src, "DstAddr": dst, "SrcPort": sp, "DstPort": dp,
+            "Proto": proto,
+            "Key": f"{src}:{sp}->{dst}:{dp}/{proto}",
+        })
+    return out
+
+
+def heavy_identity_index(report) -> dict:
+    """(h1, h2) identity -> rendered key entry of every VALID slot — the
+    previous-roll index `report_to_json` diffs against to name EVICTED
+    keys (identities that left the table since the last closed window).
+    Host-side numpy only; the exporter/aggregator stash one per ROLL."""
+    valid = np.asarray(report.heavy.valid)
+    rows = np.nonzero(valid)[0]
+    h1 = np.asarray(report.heavy.h1)
+    h2 = np.asarray(report.heavy.h2)
+    counts = np.asarray(report.heavy.counts)
+    entries = _slot_key_entries(np.asarray(report.heavy.words), rows)
+    out = {}
+    for j, i in enumerate(rows):
+        e = dict(entries[j])
+        e["EstBytes"] = float(counts[i])
+        out[(int(h1[i]), int(h2[i]))] = e
+    return out
+
+
 def report_to_json(report, max_heavy: int = 64,
                    scan_fanout_threshold: float = DEFAULT_SCAN_FANOUT,
                    ddos_z_threshold: float = DEFAULT_DDOS_Z,
@@ -99,11 +139,28 @@ def report_to_json(report, max_heavy: int = 64,
                    synflood_ratio: float = DEFAULT_SYNFLOOD_RATIO,
                    drop_z_threshold: float = DEFAULT_DROP_Z,
                    asym_min_bytes: float = DEFAULT_ASYM_MIN_BYTES,
-                   asym_ratio: float = DEFAULT_ASYM_RATIO) -> dict:
-    """Render a device WindowReport into a host JSON object."""
+                   asym_ratio: float = DEFAULT_ASYM_RATIO,
+                   churn_ascent: float = DEFAULT_CHURN_ASCENT,
+                   churn_min_bytes: float = DEFAULT_CHURN_MIN_BYTES,
+                   prev_heavy_index: Optional[dict] = None,
+                   partial_window: bool = False) -> dict:
+    """Render a device WindowReport into a host JSON object.
+
+    The persistent-slot table makes this a per-KEY churn renderer too:
+    FlowAscents / FlowDescents / NewHeavyKeys derive from each slot's
+    (counts, prev_counts, first_seen) under the `churn_ascent` /
+    `churn_min_bytes` gates — the ONE threshold truth the zoo runner and
+    the default flow_ascent/new_heavy_key alert rules share (the
+    alerts/rules.py one-truth note). `prev_heavy_index` (the previous
+    ROLL's `heavy_identity_index`) names EvictedKeys by diffing identity
+    sets; without it the list renders empty (first window, refresh-only
+    consumers)."""
     words = np.asarray(report.heavy.words)
     valid = np.asarray(report.heavy.valid)
     counts = np.asarray(report.heavy.counts)
+    prevs = np.asarray(report.heavy.prev_counts)
+    first_seen = np.asarray(report.heavy.first_seen)
+    window = int(report.window)
     order = np.argsort(-np.where(valid, counts, -np.inf))[:max_heavy]
     heavy = []
     sel = [i for i in order if valid[i]]
@@ -118,7 +175,55 @@ def report_to_json(report, max_heavy: int = 64,
                 "DstPort": int(k["dst_port"]),
                 "Proto": int(k["proto"]),
                 "EstBytes": float(counts[i]),
+                "PrevEstBytes": float(prevs[i]),
+                "FirstSeenWindow": int(first_seen[i]),
             })
+    # --- per-key churn (the device-resident heavy-hitter plane) ---
+    # ascent: window-over-window growth >= churn_ascent with real current
+    # mass; descent: the reciprocal collapse of a previously-heavy key;
+    # new: first_seen == this window (gated to window > 0 — in the
+    # table's very first window EVERYTHING is new, which is noise, and
+    # prev_counts are all zero so ascents are structurally quiet too)
+    asc_all = np.nonzero(valid & (prevs > 0)
+                         & (counts >= churn_ascent * prevs)
+                         & (counts >= churn_min_bytes))[0]
+    asc_rows = asc_all[np.argsort(-counts[asc_all])][:32]
+    # descents render only for CLOSED windows: a mid-window refresh
+    # compares a partial window against a full previous one, so right
+    # after a roll EVERY steady incumbent would read as collapsed
+    # (ascents have no such problem — a partial count exceeding the full
+    # previous window is real growth, and it is what makes detection
+    # sub-window)
+    desc_all = np.nonzero(valid & (prevs >= churn_min_bytes)
+                          & (counts <= prevs / churn_ascent))[0] \
+        if not partial_window else np.zeros(0, np.int64)
+    desc_rows = desc_all[np.argsort(-prevs[desc_all])][:32]
+    new_all = np.nonzero(valid & (first_seen == window)
+                         & (counts >= churn_min_bytes))[0] \
+        if window > 0 else np.zeros(0, np.int64)
+    new_rows = new_all[np.argsort(-counts[new_all])][:32]
+
+    def churn_entries(rows) -> list[dict]:
+        out = _slot_key_entries(words, rows)
+        for j, i in enumerate(rows):
+            out[j].update({
+                "EstBytes": float(counts[i]),
+                "PrevEstBytes": float(prevs[i]),
+                "Ratio": round(float(counts[i] / max(prevs[i], 1.0)), 3),
+                "FirstSeenWindow": int(first_seen[i]),
+            })
+        return out
+
+    evicted_keys: list[dict] = []
+    if prev_heavy_index:
+        h1a = np.asarray(report.heavy.h1)
+        h2a = np.asarray(report.heavy.h2)
+        cur_ids = {(int(h1a[i]), int(h2a[i]))
+                   for i in np.nonzero(valid)[0]}
+        gone = [e for ident, e in prev_heavy_index.items()
+                if ident not in cur_ids]
+        gone.sort(key=lambda e: -e.get("EstBytes", 0.0))
+        evicted_keys = gone[:32]
     # best-effort victim names via the shared query core (the ONE
     # implementation — numpy hash twin under DST_BUCKET_SEED; report
     # rendering must never dispatch a device op)
@@ -231,6 +336,17 @@ def report_to_json(report, max_heavy: int = 64,
         "DscpBytes": {str(int(d)): float(dscp[d]) for d in dscp_idx},
         "DscpClassBytes": {dscp_name(int(d)): float(dscp[d])
                            for d in dscp_idx},
+        "FlowAscents": churn_entries(asc_rows),
+        "FlowDescents": churn_entries(desc_rows),
+        "NewHeavyKeys": churn_entries(new_rows),
+        "EvictedKeys": evicted_keys,
+        "HeavyChurn": {
+            "ascents": int(len(asc_all)),
+            "descents": int(len(desc_all)),
+            "new": int(len(new_all)),
+            "evictions": float(report.heavy_evictions),
+            "tracked": int(valid.sum()),
+        },
     }
 
 
@@ -265,7 +381,9 @@ class TpuSketchExporter(Exporter):
                  query_refresh_s: float = 0.0,
                  overlap_depth: int = 0,
                  query_history: int = 0,
-                 alerts=None):
+                 alerts=None,
+                 churn_ascent: float = DEFAULT_CHURN_ASCENT,
+                 churn_min_bytes: float = DEFAULT_CHURN_MIN_BYTES):
         # superbatch defaults to NO ladder for direct construction: the
         # ladder costs superbatch_max-sized ring buffers, dictionaries and
         # key-table rows up front, and only pays off once warmed — the
@@ -287,6 +405,12 @@ class TpuSketchExporter(Exporter):
         self._drop_z = drop_z_threshold
         self._asym_min_bytes = asym_min_bytes
         self._asym_ratio = asym_ratio
+        self._churn_ascent = churn_ascent
+        self._churn_min_bytes = churn_min_bytes
+        # previous ROLL's heavy identity index (EvictedKeys diff source):
+        # updated only at closed-window renders — a mid-window refresh
+        # diffs against the same last-closed window, never against itself
+        self._prev_heavy_index: Optional[dict] = None
         self._metrics = metrics
         # federation delta export (federation/delta.py): snapshot the
         # mergeable tables at roll, frame + push them on the timer thread
@@ -744,6 +868,8 @@ class TpuSketchExporter(Exporter):
                    overlap_depth=cfg.sketch_overlap,
                    query_history=cfg.sketch_query_history,
                    alerts=maybe_engine(cfg, metrics),
+                   churn_ascent=cfg.sketch_churn_ascent,
+                   churn_min_bytes=cfg.sketch_churn_min_bytes,
                    warm_ladder=True,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
@@ -1239,16 +1365,26 @@ class TpuSketchExporter(Exporter):
                 finally:
                     wtrace.finish()
 
-    def _render_report(self, report) -> dict:
-        """Render a device WindowReport with THIS exporter's thresholds."""
-        return report_to_json(
+    def _render_report(self, report, roll: bool = False) -> dict:
+        """Render a device WindowReport with THIS exporter's thresholds.
+        `roll=True` (closed-window publishes) additionally rotates the
+        previous-roll heavy index the EvictedKeys diff reads — refreshes
+        keep diffing against the last CLOSED window."""
+        obj = report_to_json(
             report, scan_fanout_threshold=self._scan_fanout,
             ddos_z_threshold=self._ddos_z,
             synflood_min=self._synflood_min,
             synflood_ratio=self._synflood_ratio,
             drop_z_threshold=self._drop_z,
             asym_min_bytes=self._asym_min_bytes,
-            asym_ratio=self._asym_ratio)
+            asym_ratio=self._asym_ratio,
+            churn_ascent=self._churn_ascent,
+            churn_min_bytes=self._churn_min_bytes,
+            prev_heavy_index=self._prev_heavy_index,
+            partial_window=not roll)
+        if roll:
+            self._prev_heavy_index = heavy_identity_index(report)
+        return obj
 
     def _publish_query_snapshot(self, obj: dict, tables,
                                 mid_window: bool = False) -> None:
@@ -1389,8 +1525,11 @@ class TpuSketchExporter(Exporter):
             # includes the device->host transfer of the report arrays (the
             # first np.asarray touch) — deliberately not split out, so the
             # un-traced path never adds a blocking device sync
-            obj = self._render_report(report)
+            obj = self._render_report(report, roll=True)
         obj["TimestampMs"] = time.time_ns() // 1_000_000
+        if self._metrics is not None:
+            self._metrics.sketch_heavy_evictions_total.inc(
+                obj["HeavyChurn"]["evictions"])
         # query-snapshot publish in its OWN try, BEFORE the sink: a failing
         # publish (the sketch.query_snapshot fault point's job to prove)
         # must never lose the window report, and a blocked sink must never

@@ -80,6 +80,8 @@ class FederationAggregator:
         self._sink = sink
         self._stale_after_s = stale_after_s
         self._report_kwargs = report_kwargs or {}
+        #: previous merged-window heavy identity index (EvictedKeys diff)
+        self._prev_heavy_index: Optional[dict] = None
         if metrics is not None:
             retrace.set_metrics(metrics)
             tracing.set_metrics(metrics)
@@ -136,6 +138,11 @@ class FederationAggregator:
         #: staleness gauge series is deleted (label cardinality must not
         #: grow forever with departed agents)
         self._agent_ttl_s = agent_ttl_s
+        #: host mirror of the aggregate's window counter (updated at roll/
+        #: restore): delta churn tensors re-base into the CLUSTER window
+        #: domain before merging (fdelta.localize_churn) and reading the
+        #: device scalar per frame would be a sync on the ingest path
+        self._window_host = 0
         self._snapshot: Optional[dict] = None
         self._snap_lock = threading.Lock()
         self._snap_seq = 0
@@ -194,10 +201,10 @@ class FederationAggregator:
                     + np.int32(pub["window"] + 1 - restored_w))
             elif step is None:
                 return
+            self._window_host = int(np.asarray(self._state.window))
             log.info("restored federation aggregate (checkpoint step %s, "
                      "next window %d, %d agents in the ledger)", step,
-                     int(np.asarray(self._state.window)),
-                     len(self._ledger))
+                     self._window_host, len(self._ledger))
         except Exception as exc:
             log.error("aggregator checkpoint restore failed "
                       "(starting a fresh window): %s", exc)
@@ -318,6 +325,12 @@ class FederationAggregator:
             try:
                 with trace.stage("delta_decode"):
                     frame = fdelta.decode_frame(data)
+                    # legacy (v1/v2) frames normalize to the current table
+                    # layout HERE — zero-filled churn tensors, padded
+                    # scalars — so the fixed-signature jitted merge sees
+                    # one layout for every supported version (no retrace)
+                    frame = frame._replace(
+                        tables=fdelta.upgrade_tables(frame))
             except fdelta.DeltaVersionError as exc:
                 return self._reject("version_mismatch", str(exc))
             except fdelta.DeltaFrameError as exc:
@@ -424,15 +437,21 @@ class FederationAggregator:
             if early in ("duplicate", "stale"):
                 self._note_discard_locked(frame, early)
                 return early
+        # churn tensors re-base into the CLUSTER window domain: the
+        # aggregate's own slot_roll maintains the cluster prev baseline
+        # (summing agents' agent-window prevs would double-count every
+        # persistent key), and first_seen stamps the cluster window a key
+        # first reached this table (fdelta.localize_churn)
+        host_tables = fdelta.localize_churn(frame.tables, self._window_host)
         if self._distributed:
             tables = {name: self._pm.put_replicated(
                 self._mesh, np.ascontiguousarray(arr))
-                for name, arr in frame.tables.items()}
+                for name, arr in host_tables.items()}
             owner = self._pm.put_replicated(self._mesh, np.asarray(
                 [agent_owner_shard(frame.agent_id, self._ndata)], np.int32))
         else:
             tables = {name: jax.device_put(arr)
-                      for name, arr in frame.tables.items()}
+                      for name, arr in host_tables.items()}
         with self._lock:
             # authoritative verdict + fold + ledger update are ONE critical
             # section: two racing copies of the same frame serialize here,
@@ -512,6 +531,7 @@ class FederationAggregator:
         except BaseException:
             wtrace.finish()
             raise
+        self._window_host += 1  # keep the host mirror on the roll counter
         agents = sorted(self._window_agents)
         self._window_agents = set()
         # checkpoint the POST-roll state + the ledger at this step: a
@@ -565,10 +585,17 @@ class FederationAggregator:
             self._publish_lock.release()
 
     def _publish(self, report, tables, agents: list, wtrace) -> None:
-        from netobserv_tpu.exporter.tpu_sketch import report_to_json
+        from netobserv_tpu.exporter.tpu_sketch import (
+            heavy_identity_index, report_to_json,
+        )
 
         with wtrace.stage("report_render"):
-            obj = report_to_json(report, **self._report_kwargs)
+            obj = report_to_json(report,
+                                 prev_heavy_index=self._prev_heavy_index,
+                                 **self._report_kwargs)
+            # cluster-tier EvictedKeys diff against the previous MERGED
+            # window (same rotate-at-roll contract as the exporter)
+            self._prev_heavy_index = heavy_identity_index(report)
             obj["Type"] = "federation_window_report"
             obj["Agents"] = agents
             obj["TimestampMs"] = time.time_ns() // 1_000_000
@@ -577,7 +604,8 @@ class FederationAggregator:
             cm_bytes = np.asarray(tables["cm_bytes"])
             cm_pkts = np.asarray(tables["cm_pkts"])
             heavy = {k: np.asarray(tables["heavy_" + k])
-                     for k in ("words", "h1", "h2", "counts", "valid")}
+                     for k in ("words", "h1", "h2", "counts", "valid",
+                               "prev_counts", "first_seen", "epoch")}
         with self._snap_lock:
             self._snap_seq += 1
             seq = self._snap_seq

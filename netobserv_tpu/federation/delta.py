@@ -40,13 +40,18 @@ from netobserv_tpu.pb import sketch_delta_pb2 as pb
 #: v2 adds the idempotent-delivery header (window_seq / frame_uuid /
 #: agent_epoch) so the aggregator can ack-and-discard redelivered frames
 #: after an ambiguous DEADLINE_EXCEEDED instead of double-counting.
-DELTA_FORMAT_VERSION = 2
+#: v3 adds the persistent-slot churn tensors (heavy_prev_counts /
+#: heavy_first_seen / heavy_epoch) and the heavy_evictions scalar — the
+#: per-key heavy-hitter plane rides the delta wire.
+DELTA_FORMAT_VERSION = 3
 
 #: versions decode_frame still accepts. v1 frames (pre-idempotency agents)
 #: carry no delivery header; the aggregator merges them unconditionally and
 #: counts them `legacy` — a mixed-version fleet keeps aggregating during a
-#: rollout, it just loses dedup protection for the old agents.
-SUPPORTED_VERSIONS = (1, 2)
+#: rollout, it just loses dedup protection for the old agents. v1/v2 frames
+#: carry no churn tensors; `upgrade_tables` zero-fills them (merging as "no
+#: history": the key set and counts still aggregate exactly).
+SUPPORTED_VERSIONS = (1, 2, 3)
 
 #: ack reason strings shared by the aggregator (producer) and
 #: FederationDeltaSink (consumer). Both verdicts set `duplicate=1` on the
@@ -64,7 +69,7 @@ _CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
 
 #: canonical (name, little-endian dtype) of every tensor in a frame, in
 #: frame order. `sketch.state.state_tables` produces exactly these names;
-#: `scalars` packs the six window totals in SCALAR_FIELDS order.
+#: `scalars` packs the window totals in SCALAR_FIELDS order.
 TABLE_SPEC: tuple[tuple[str, str], ...] = (
     ("cm_bytes", "<f4"),
     ("cm_pkts", "<f4"),
@@ -73,6 +78,11 @@ TABLE_SPEC: tuple[tuple[str, str], ...] = (
     ("heavy_h2", "<u4"),
     ("heavy_counts", "<f4"),
     ("heavy_valid", "<u4"),
+    # persistent-slot churn metadata (v3): prev_counts merge by sum,
+    # first_seen by min, epoch by max (ops/topk.merge_slot_tables)
+    ("heavy_prev_counts", "<f4"),
+    ("heavy_first_seen", "<i4"),
+    ("heavy_epoch", "<i4"),
     ("hll_src", "<i4"),
     ("hll_per_dst", "<i4"),
     ("hll_per_src", "<i4"),
@@ -89,9 +99,24 @@ TABLE_SPEC: tuple[tuple[str, str], ...] = (
     ("scalars", "<f4"),
 )
 
+#: the v1/v2-era table layout — kept for DECODE COMPAT (legacy frames) and
+#: for `encode_frame(version=...)` producing mixed-fleet test vectors; the
+#: v2 golden stays pinned against it (tests/test_federation_golden.py)
+TABLE_SPEC_V2: tuple[tuple[str, str], ...] = tuple(
+    (n, d) for n, d in TABLE_SPEC
+    if n not in ("heavy_prev_counts", "heavy_first_seen", "heavy_epoch"))
+
 #: layout of the `scalars` tensor (window totals; all additive)
 SCALAR_FIELDS = ("total_records", "total_bytes", "total_drop_bytes",
-                 "total_drop_packets", "quic_records", "nat_records")
+                 "total_drop_packets", "quic_records", "nat_records",
+                 "heavy_evictions")
+#: v1/v2 frames carry only the first six
+SCALAR_FIELDS_V2 = SCALAR_FIELDS[:6]
+
+
+def spec_for_version(version: int) -> tuple[tuple[str, str], ...]:
+    """The table layout a given frame format version carries."""
+    return TABLE_SPEC if version >= 3 else TABLE_SPEC_V2
 
 #: frame-header geometry fields (validated by the aggregator BEFORE its
 #: fixed-shape jitted merge ever sees the tensors)
@@ -137,13 +162,14 @@ def table_spec_fingerprint() -> int:
 def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
                  window: int, ts_ms: int, dims: Mapping[str, int],
                  codec: int = CODEC_ZLIB, window_seq: Optional[int] = None,
-                 frame_uuid: str = "", agent_epoch: int = 0) -> bytes:
-    """Serialize a table snapshot into one SketchDelta frame (v2).
+                 frame_uuid: str = "", agent_epoch: int = 0,
+                 version: Optional[int] = None) -> bytes:
+    """Serialize a table snapshot into one SketchDelta frame.
 
-    `tables` must carry every TABLE_SPEC name (host numpy arrays; dtype is
-    coerced to the spec's little-endian type). `codec=CODEC_ZLIB` deflates
-    each tensor but keeps raw whenever deflate does not shrink it (the
-    per-tensor codec field records which one shipped).
+    `tables` must carry every name of the frame version's spec (host numpy
+    arrays; dtype is coerced to the spec's little-endian type).
+    `codec=CODEC_ZLIB` deflates each tensor but keeps raw whenever deflate
+    does not shrink it (the per-tensor codec field records which shipped).
 
     Idempotency header: `window_seq` defaults to `window` (one frame per
     closed window, the counter IS the sequence); an empty `frame_uuid`
@@ -151,21 +177,40 @@ def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
     same bytes, not re-encode. `agent_epoch` is the sender's boot identity
     (0 only looks legacy-ish to operators; the version field is what marks
     a frame v1).
+
+    `version` (default: current) may name an OLDER supported version to
+    produce mixed-fleet/legacy frames: a v2 frame drops the churn tensors
+    and trims `scalars` to the six v2 totals; a v1 frame additionally
+    carries no delivery header. Production agents always encode current.
     """
-    missing = [n for n, _ in TABLE_SPEC if n not in tables]
+    version = DELTA_FORMAT_VERSION if version is None else int(version)
+    if version not in SUPPORTED_VERSIONS:
+        raise DeltaFrameError(f"cannot encode unsupported frame version "
+                              f"{version} (supported {SUPPORTED_VERSIONS})")
+    spec = spec_for_version(version)
+    missing = [n for n, _ in spec if n not in tables]
     if missing:
         raise DeltaFrameError(f"table snapshot missing tensors: {missing}")
     if not frame_uuid:
         frame_uuid = uuid.uuid4().hex
-    frame = pb.SketchDelta(
-        version=DELTA_FORMAT_VERSION, agent_id=agent_id,
-        window=int(window), ts_ms=int(ts_ms),
-        window_seq=int(window if window_seq is None else window_seq),
-        frame_uuid=frame_uuid, agent_epoch=int(agent_epoch))
+    if version >= 2:
+        frame = pb.SketchDelta(
+            version=version, agent_id=agent_id,
+            window=int(window), ts_ms=int(ts_ms),
+            window_seq=int(window if window_seq is None else window_seq),
+            frame_uuid=frame_uuid, agent_epoch=int(agent_epoch))
+    else:  # v1: pre-idempotency — no delivery header on the wire
+        frame = pb.SketchDelta(
+            version=version, agent_id=agent_id,
+            window=int(window), ts_ms=int(ts_ms))
     for f in DIM_FIELDS:
         setattr(frame, f, int(dims[f]))
-    for name, dt in TABLE_SPEC:
-        arr = np.ascontiguousarray(np.asarray(tables[name]), dtype=dt)
+    n_scalars = len(SCALAR_FIELDS if version >= 3 else SCALAR_FIELDS_V2)
+    for name, dt in spec:
+        arr = np.asarray(tables[name])
+        if name == "scalars":
+            arr = arr[:n_scalars]
+        arr = np.ascontiguousarray(arr, dtype=dt)
         raw = arr.tobytes()
         t = frame.tensors.add()
         t.name = name
@@ -195,6 +240,7 @@ MAX_TENSOR_BYTES = 1 << 27  # 128 MiB
 #: disagrees (a same-shape foreign dtype would otherwise reach the
 #: aggregator's fixed-signature jitted merge and force a retrace)
 _SPEC_DTYPES = dict(TABLE_SPEC)
+_SPEC_DTYPES_V2 = dict(TABLE_SPEC_V2)
 
 
 def decode_frame(data: bytes) -> DeltaFrame:
@@ -214,12 +260,15 @@ def decode_frame(data: bytes) -> DeltaFrame:
         raise DeltaVersionError(
             f"delta frame version {frame.version} not in supported "
             f"{SUPPORTED_VERSIONS} (agent {frame.agent_id!r})")
+    spec = spec_for_version(frame.version)
+    spec_dtypes = _SPEC_DTYPES if frame.version >= 3 else _SPEC_DTYPES_V2
     tables: dict[str, np.ndarray] = {}
     for t in frame.tensors:
-        spec_dt = _SPEC_DTYPES.get(t.name)
+        spec_dt = spec_dtypes.get(t.name)
         if spec_dt is None:
             raise DeltaFrameError(
-                f"unknown tensor {t.name!r} (not in TABLE_SPEC)")
+                f"unknown tensor {t.name!r} (not in the v{frame.version} "
+                "table spec)")
         dt = _CODE_TO_DTYPE.get(t.dtype)
         if dt is None:
             raise DeltaFrameError(f"tensor {t.name!r}: unknown dtype code "
@@ -257,7 +306,7 @@ def decode_frame(data: bytes) -> DeltaFrame:
             raise DeltaFrameError(f"tensor {t.name!r}: unknown codec "
                                   f"{t.codec}")
         tables[t.name] = np.frombuffer(raw, dtype=dt).reshape(shape)
-    missing = [n for n, _ in TABLE_SPEC if n not in tables]
+    missing = [n for n, _ in spec if n not in tables]
     if missing:
         raise DeltaFrameError(f"delta frame missing tensors: {missing}")
     dims = {f: int(getattr(frame, f)) for f in DIM_FIELDS}
@@ -267,6 +316,55 @@ def decode_frame(data: bytes) -> DeltaFrame:
                       window_seq=int(frame.window_seq),
                       frame_uuid=frame.frame_uuid,
                       agent_epoch=int(frame.agent_epoch))
+
+
+def upgrade_tables(frame: DeltaFrame) -> dict:
+    """Normalize a decoded frame's tables to the CURRENT (v3) layout.
+
+    v1/v2 frames carry no churn tensors and six-wide scalars: the missing
+    tensors zero-fill (shaped after the frame's own heavy_counts — merging
+    as "no churn history"; the key set and counts still aggregate exactly)
+    and `scalars` pads with zeros to the current width, so the aggregator's
+    fixed-signature jitted merge sees ONE table layout for every supported
+    frame version. Current frames return their table dict unchanged."""
+    if frame.version >= 3:
+        return frame.tables
+    tables = dict(frame.tables)
+    k = np.asarray(frame.tables["heavy_counts"]).shape
+    tables["heavy_prev_counts"] = np.zeros(k, "<f4")
+    tables["heavy_first_seen"] = np.zeros(k, "<i4")
+    tables["heavy_epoch"] = np.zeros(k, "<i4")
+    scal = np.asarray(frame.tables["scalars"], "<f4")
+    tables["scalars"] = np.concatenate(
+        [scal, np.zeros(len(SCALAR_FIELDS) - scal.shape[0], "<f4")])
+    return tables
+
+
+def localize_churn(tables: Mapping[str, np.ndarray],
+                   window: int) -> dict:
+    """Re-base a delta frame's churn tensors into the AGGREGATOR's window
+    domain before merging.
+
+    The churn baselines are tier-local by construction: an agent's
+    `heavy_prev_counts` is ITS previous agent-window's mass, and the
+    aggregator's own `slot_roll` already snapshots the previous CLUSTER
+    window's merged counts as the aggregate's baseline — summing the
+    agents' prevs on top would double-count every persistent key (and
+    worse with several agent windows per federation window). Likewise
+    `heavy_first_seen`/`heavy_epoch` are numbered in each agent's window/
+    insertion domain, meaningless at the cluster tier. So delta frames
+    merge with: prev_counts zeroed (the aggregate's own roll history IS
+    the cluster baseline), first_seen set to the aggregator's CURRENT
+    window (the segmented MIN keeps the aggregate's earlier stamp for
+    known keys and stamps genuinely-new keys with the window they first
+    reached the cluster table), epoch zeroed (the aggregate's own
+    generations count)."""
+    out = dict(tables)
+    k = np.asarray(tables["heavy_counts"]).shape
+    out["heavy_prev_counts"] = np.zeros(k, "<f4")
+    out["heavy_first_seen"] = np.full(k, int(window), "<i4")
+    out["heavy_epoch"] = np.zeros(k, "<i4")
+    return out
 
 
 def expected_shapes(template_tables: Mapping[str, np.ndarray]) -> dict:
@@ -281,6 +379,10 @@ def validate_shapes(frame: DeltaFrame,
     snapshot template — a foreign shape must never reach the jitted merge
     (it would retrace; the fixed-shape invariant is load-bearing)."""
     for name, shape in expected.items():
+        if name not in frame.tables:
+            raise DeltaFrameError(
+                f"tensor {name!r} absent (upgrade_tables the frame before "
+                "shape validation — legacy frames lack the churn tensors)")
         got = tuple(frame.tables[name].shape)
         if got != tuple(shape):
             raise DeltaFrameError(

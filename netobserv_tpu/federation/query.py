@@ -14,9 +14,13 @@ as the agents.
 
 Routes (all GET, JSON):
 
-- /federation/topk          cluster-wide heavy hitters (?n= caps the list)
+- /federation/topk          cluster-wide heavy hitters (?n= caps the
+                            list), with CM error bars
 - /federation/frequency     CM estimate + error bars for one 5-tuple
                             (?src=&dst=&src_port=&dst_port=&proto=)
+- /federation/churn         cluster-wide per-key heavy-hitter churn
+                            (the merged persistent-slot table's
+                            cross-window diff)
 - /federation/cardinality   global distinct-source estimate + totals
 - /federation/victims       suspect buckets per signal with victim names
 - /federation/alerts        cluster-wide continuous detection view (the
@@ -59,9 +63,9 @@ class _Handler(BaseHTTPRequestHandler):
             if path in ("/", "/federation", "/federation/"):
                 self._json(200, {"routes": [
                     "/federation/topk", "/federation/frequency",
-                    "/federation/cardinality", "/federation/victims",
-                    "/federation/alerts", "/federation/status",
-                    "/healthz", "/readyz"]})
+                    "/federation/churn", "/federation/cardinality",
+                    "/federation/victims", "/federation/alerts",
+                    "/federation/status", "/healthz", "/readyz"]})
                 return
             if path == "/federation/status":
                 self._json(200, self.aggregator.status())
@@ -107,6 +111,11 @@ class _Handler(BaseHTTPRequestHandler):
             # aggregator — see the smoke's poller)
             if path == "/federation/topk":
                 self._json(200, qcore.topk_payload(snap, q.get("n", 100)))
+                return
+            if path == "/federation/churn":
+                # thin adapter over the ONE churn body builder (the
+                # query/core rule: never fork the math back here)
+                self._json(200, qcore.churn_payload(snap))
                 return
             if path == "/federation/cardinality":
                 self._json(200, qcore.cardinality_payload(snap))

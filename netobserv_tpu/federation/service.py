@@ -53,7 +53,9 @@ class FederationAggregatorService:
                 synflood_ratio=cfg.sketch_synflood_ratio,
                 drop_z_threshold=cfg.sketch_drop_z,
                 asym_min_bytes=cfg.sketch_asym_min_bytes,
-                asym_ratio=cfg.sketch_asym_ratio))
+                asym_ratio=cfg.sketch_asym_ratio,
+                churn_ascent=cfg.sketch_churn_ascent,
+                churn_min_bytes=cfg.sketch_churn_min_bytes))
         self.supervisor = Supervisor(
             metrics=self.metrics,
             check_period_s=cfg.supervisor_check_period,

@@ -33,16 +33,29 @@ def merge_tables(state: sk.SketchState, t: dict,
     d_valid = t["heavy_valid"] != 0
     if candidate_valid is not None:
         d_valid = d_valid & candidate_valid
-    stacked = topk.TopK(
+    # persistent-slot merge: aggregate table + delta table concat, duplicate
+    # identities collapse with segmented metadata merges (prev_counts SUM —
+    # per-agent partials of one key add; first_seen MIN is best-effort at
+    # this tier, agents count windows independently; epoch MAX), counts
+    # re-score against the merged CM (ops/topk.merge_slot_tables — the one
+    # roll-time reconciliation primitive, shared with parallel/merge.py).
+    # v1/v2 frames reach here with zeroed churn tensors
+    # (federation.delta.upgrade_tables), which merge as "no history".
+    stacked = topk.SlotTable(
         words=jnp.concatenate([state.heavy.words,
                                t["heavy_words"].astype(jnp.uint32)], axis=0),
         h1=jnp.concatenate([state.heavy.h1, t["heavy_h1"]]),
         h2=jnp.concatenate([state.heavy.h2, t["heavy_h2"]]),
         counts=jnp.concatenate([state.heavy.counts, t["heavy_counts"]]),
+        prev_counts=jnp.concatenate([state.heavy.prev_counts,
+                                     t["heavy_prev_counts"]]),
+        first_seen=jnp.concatenate([state.heavy.first_seen,
+                                    t["heavy_first_seen"]]),
+        epoch=jnp.concatenate([state.heavy.epoch, t["heavy_epoch"]]),
         valid=jnp.concatenate([state.heavy.valid, d_valid]),
     )
-    heavy = topk.merge_stacked(stacked, cm_b, state.heavy.k,
-                               query_fn=query_fn)
+    heavy = topk.merge_slot_tables(stacked, cm_b, state.heavy.k,
+                                   query_fn=query_fn)
     scalars = t["scalars"]
     return state._replace(
         cm_bytes=cm_b, cm_pkts=cm_p, heavy=heavy,
@@ -74,4 +87,5 @@ def merge_tables(state: sk.SketchState, t: dict,
         total_drop_packets=state.total_drop_packets + scalars[3],
         quic_records=state.quic_records + scalars[4],
         nat_records=state.nat_records + scalars[5],
+        heavy_evictions=state.heavy_evictions + scalars[6],
     )

@@ -201,6 +201,14 @@ class Metrics:
             "controller's backpressure signal)",
             buckets=(.0001, .0005, .001, .005, .01, .05, .1, .5, 1, 5),
             registry=self.registry)
+        self.sketch_heavy_evictions_total = Counter(
+            p + "sketch_heavy_evictions_total",
+            "Valid heavy-hitter slot-table occupants evicted by heavier "
+            "challengers (persistent-slot top-K plane; incremented at "
+            "each window publish by that window's eviction count — "
+            "sustained high rates mean the table is churning under "
+            "capacity pressure: raise SKETCH_TOPK)",
+            registry=self.registry)
         self.sketch_reports_shed_total = Counter(
             p + "sketch_reports_shed_total",
             "Unpublished window reports shed because the report queue "

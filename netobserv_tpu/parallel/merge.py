@@ -48,7 +48,8 @@ def _state_specs(state: sk.SketchState) -> sk.SketchState:
     return sk.SketchState(
         cm_bytes=countmin.CountMin(counts=P(DATA_AXIS, None, SKETCH_AXIS)),
         cm_pkts=countmin.CountMin(counts=P(DATA_AXIS, None, SKETCH_AXIS)),
-        heavy=topk.TopK(words=h, h1=h, h2=h, counts=h, valid=h),
+        heavy=topk.SlotTable(words=h, h1=h, h2=h, counts=h, prev_counts=h,
+                             first_seen=h, epoch=h, valid=h),
         hll_src=hll.HLL(regs=d),
         hll_per_dst=hll.PerDstHLL(regs=d),
         hll_per_src=hll.PerDstHLL(regs=d),
@@ -62,7 +63,7 @@ def _state_specs(state: sk.SketchState) -> sk.SketchState:
         conv_fwd=d, conv_rev=d,
         total_records=d, total_bytes=d,
         total_drop_bytes=d, total_drop_packets=d,
-        quic_records=d, nat_records=d, window=d,
+        quic_records=d, nat_records=d, heavy_evictions=d, window=d,
     )
 
 
@@ -314,17 +315,18 @@ def merge_states(s: sk.SketchState, nsk: int) -> sk.SketchState:
             x = jax.lax.all_gather(x, SKETCH_AXIS, axis=0, tiled=True)
         return x
 
-    stacked = topk.TopK(
-        words=gather(s.heavy.words), h1=gather(s.heavy.h1),
-        h2=gather(s.heavy.h2), counts=gather(s.heavy.counts),
-        valid=gather(s.heavy.valid),
-    )
+    stacked = jax.tree.map(gather, s.heavy)
     if nsk > 1:
         qfn = lambda a, b: countmin.query_sharded(  # noqa: E731
             cm_b, a, b, SKETCH_AXIS, nsk)
     else:
         qfn = None
-    heavy = topk.merge_stacked(stacked, cm_b, s.heavy.k, query_fn=qfn)
+    # roll-time reconciliation of the persistent slot tables: duplicate
+    # identities across shards collapse with segmented metadata merges
+    # (prev_counts sum, first_seen min, epoch max) and counts re-score
+    # against the globally merged CM — the one place cross-shard top-K
+    # work happens (steady state stays collective-free)
+    heavy = topk.merge_slot_tables(stacked, cm_b, s.heavy.k, query_fn=qfn)
     return sk.SketchState(
         cm_bytes=cm_b, cm_pkts=cm_p, heavy=heavy,
         hll_src=hll.HLL(jax.lax.pmax(s.hll_src.regs, DATA_AXIS)),
@@ -354,6 +356,7 @@ def merge_states(s: sk.SketchState, nsk: int) -> sk.SketchState:
         total_drop_packets=jax.lax.psum(s.total_drop_packets, DATA_AXIS),
         quic_records=jax.lax.psum(s.quic_records, DATA_AXIS),
         nat_records=jax.lax.psum(s.nat_records, DATA_AXIS),
+        heavy_evictions=jax.lax.psum(s.heavy_evictions, DATA_AXIS),
         window=s.window,
     )
 
@@ -432,7 +435,9 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
     specs = _state_specs(template)
 
     report_specs = sk.WindowReport(
-        heavy=topk.TopK(words=P(), h1=P(), h2=P(), counts=P(), valid=P()),
+        heavy=topk.SlotTable(words=P(), h1=P(), h2=P(), counts=P(),
+                             prev_counts=P(), first_seen=P(), epoch=P(),
+                             valid=P()),
         distinct_src=P(), per_dst_cardinality=P(), per_src_fanout=P(),
         rtt_quantiles_us=P(),
         dns_quantiles_us=P(), ddos_z=P(), syn_z=P(), syn_rate=P(),
@@ -440,7 +445,7 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
         conv_fwd=P(), conv_rev=P(),
         total_records=P(), total_bytes=P(),
         total_drop_bytes=P(), total_drop_packets=P(),
-        quic_records=P(), nat_records=P(),
+        quic_records=P(), nat_records=P(), heavy_evictions=P(),
         window=P(),
     )
 
@@ -478,6 +483,7 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
             total_drop_packets=merged.total_drop_packets,
             quic_records=merged.quic_records,
             nat_records=merged.nat_records,
+            heavy_evictions=merged.heavy_evictions,
             window=merged.window,
         )
         ewma_rolled = dict(
@@ -493,8 +499,11 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
             )
         elif reset_sketches:
             fresh = jax.tree.map(jnp.zeros_like, s)
+            # each device's slot table PERSISTS through the roll (identity,
+            # first_seen, epoch stay local — no collectives): prev_counts
+            # take this window's final per-device estimates, counts reset
             new = fresh._replace(
-                heavy=topk.init(s.heavy.k, s.heavy.words.shape[-1]),
+                heavy=topk.slot_roll(s.heavy, 0.0),
                 window=s.window + 1, **ewma_rolled,
             )
         else:
@@ -502,6 +511,9 @@ def make_merge_fn(mesh: Mesh, cfg: sk.SketchConfig,
             new = s._replace(ddos=ddos_state, syn=syn_state,
                              drops_ewma=drops_state,
                              synack=jnp.zeros_like(s.synack),
+                             heavy=topk.slot_roll(s.heavy, 1.0),
+                             heavy_evictions=jnp.zeros_like(
+                                 s.heavy_evictions),
                              window=s.window + 1)
         if with_tables:
             return _add_lead(new), report, tables

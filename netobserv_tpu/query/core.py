@@ -62,9 +62,53 @@ def _stamp(snap: dict, payload: dict) -> dict:
             "seq": snap.get("seq", 0), **payload}
 
 
+def cm_error_bars(snap: dict) -> Optional[dict]:
+    """The Cormode–Muthukrishnan overestimate bound of the snapshot's CM
+    planes — THE error-bar math (shared by /query/frequency and
+    /query/topk; the slot-table counts ARE CM point estimates, so the
+    same bound applies to every rendered heavy hitter). None when the
+    deployment has no whole-width CM snapshot (width-sharded meshes)."""
+    cm = snap.get("cm_bytes")
+    if cm is None:
+        return None
+    d, w = cm.shape
+    eps = np.e / w
+    return {
+        "overestimate_bound_bytes": eps * float(np.sum(cm[0])),
+        "confidence": 1.0 - float(np.exp(-d)),
+    }
+
+
 def topk_payload(snap: dict, n: int = 100) -> dict:
     n = max(1, min(int(n), 1024))
-    return _stamp(snap, {"topk": snap["report"]["HeavyHitters"][:n]})
+    payload = {"topk": snap["report"]["HeavyHitters"][:n]}
+    bars = cm_error_bars(snap)
+    if bars is not None:
+        # every EstBytes (and churn count) is a CM point estimate: true
+        # count <= estimate <= true + bound with the stated confidence —
+        # the same bars /query/frequency renders, from the ONE helper
+        payload.update(bars)
+    return _stamp(snap, payload)
+
+
+def churn_payload(snap: dict) -> dict:
+    """Per-key heavy-hitter churn of the snapshot's window: ascents,
+    descents, new-heavy entries, evicted keys and the table's eviction
+    pressure, as rendered by the exporter under its configured
+    SKETCH_CHURN_* gates (the one threshold truth). Counts carry the same
+    CM error bars as /query/topk."""
+    report = snap["report"]
+    payload = {
+        "ascents": report.get("FlowAscents", []),
+        "descents": report.get("FlowDescents", []),
+        "new_heavy": report.get("NewHeavyKeys", []),
+        "evicted": report.get("EvictedKeys", []),
+        "summary": report.get("HeavyChurn", {}),
+    }
+    bars = cm_error_bars(snap)
+    if bars is not None:
+        payload.update(bars)
+    return _stamp(snap, payload)
 
 
 def cardinality_payload(snap: dict) -> dict:

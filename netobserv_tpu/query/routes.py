@@ -9,9 +9,14 @@ exporter lock.
 
 Routes (all GET, JSON):
 
-- /query/topk          this agent's heavy hitters (?n= caps the list)
+- /query/topk          this agent's heavy hitters (?n= caps the list),
+                       with the same CM error bars /query/frequency
+                       renders (slot counts ARE CM point estimates)
 - /query/frequency     CM estimate + error bars for one 5-tuple
                        (?src=&dst=&src_port=&dst_port=&proto=)
+- /query/churn         per-key heavy-hitter churn of the window: flow
+                       ascents/descents, new-heavy entries, evicted keys
+                       (the persistent-slot table's cross-window diff)
 - /query/cardinality   distinct-source estimate + window totals
 - /query/victims       suspect buckets per signal with victim names
 - /query/alerts        the continuous detection plane's live view
@@ -36,8 +41,8 @@ from netobserv_tpu.query import core
 
 log = logging.getLogger("netobserv_tpu.query")
 
-ROUTES = ("topk", "frequency", "cardinality", "victims", "alerts",
-          "status")
+ROUTES = ("topk", "frequency", "churn", "cardinality", "victims",
+          "alerts", "status")
 
 
 class QueryRoutes:
@@ -117,6 +122,8 @@ class QueryRoutes:
             return 503, {"error": "no window published yet"}
         if route == "topk":
             return 200, core.topk_payload(snap, params.get("n", 100))
+        if route == "churn":
+            return 200, core.churn_payload(snap)
         if route == "cardinality":
             return 200, core.cardinality_payload(snap)
         if route == "victims":

@@ -40,6 +40,10 @@ THRESHOLDS = dict(
     scan_fanout_threshold=256,
     asym_min_bytes=2048,
     asym_ratio=0.95,
+    # heavy-hitter churn gates (persistent-slot plane): the flow_ascent /
+    # new_heavy_key alert rules fire on lists rendered under exactly these
+    churn_ascent=8.0,
+    churn_min_bytes=256 * 1024,
 )
 
 
@@ -76,6 +80,12 @@ def run_scenario(name: str, workdir: str, window_s: float = 600.0,
     build = SCENARIOS[name]
     pcap = os.path.join(workdir, f"{name}.pcap")
     truth = build(pcap)
+    # multi-window scenarios (flow_ascent: the churn diff needs a ROLL
+    # between its phases) override the runner shape through their truth —
+    # thresholds stay the ONE shared set above
+    overrides = truth.get("runner") or {}
+    window_s = overrides.get("window_s", window_s)
+    deadline_s = overrides.get("deadline_s", deadline_s)
 
     cfg = AgentConfig(export="tpu-sketch", cache_active_timeout=evict_s)
     metrics = Metrics()
@@ -129,7 +139,8 @@ def run_scenario(name: str, workdir: str, window_s: float = 600.0,
         code, status = get("/query/status")
         if code == 200:
             obs["status"] = status
-        for route in ("topk?n=64", "victims", "cardinality", "alerts"):
+        for route in ("topk?n=64", "victims", "cardinality", "alerts",
+                      "churn"):
             c, body = get(f"/query/{route}")
             if c == 200:
                 obs[route.split("?")[0]] = body
@@ -269,7 +280,10 @@ def evaluate(truth: dict, observations: list[dict],
              for sig in SIGNALS}
     out["alarms_fired"] = sorted(s for s, f in fired.items() if f)
     for sig in truth.get("expect_alarms", ()):
-        if not fired[sig]:
+        # per-flow churn rules (flow_ascent/new_heavy_key) have no
+        # /query/victims bucket list — their only surface is the alert
+        # plane, graded below
+        if sig in SIGNALS and not fired[sig]:
             failures.append(f"expected {sig} alarm never fired")
     for sig in truth.get("quiet_alarms", ()):
         if any(o.get("victims", {}).get(sig) for o in observations):
@@ -302,6 +316,25 @@ def evaluate(truth: dict, observations: list[dict],
             if sig in raised:
                 failures.append(
                     f"{sig} alert raised on a benign signal")
+        want_key = truth.get("ascent_key")
+        if want_key:
+            # the acceptance bar "detects with the RIGHT KEY named": a
+            # raised flow_ascent whose fingerprint bucket is exactly the
+            # ramping flow's 5-tuple Key string
+            key = (f"{want_key['SrcAddr']}:{want_key['SrcPort']}->"
+                   f"{want_key['DstAddr']}:{want_key['DstPort']}/"
+                   f"{want_key['Proto']}")
+            named = any(
+                a.get("bucket") == key
+                for v in alert_views for a in v.get("active", ())
+                if a["rule"] == "flow_ascent") or any(
+                t.get("bucket") == key
+                for v in alert_views for t in v.get("recent", ())
+                if t["rule"] == "flow_ascent" and t["action"] == "raise")
+            out["ascent_key_named"] = named
+            if not named:
+                failures.append(
+                    f"flow_ascent never raised with key {key}")
         if truth.get("victim") and truth.get("victim_signal"):
             sig = truth["victim_signal"]
             # same active-OR-ring rule as detection: a raise that cleared
@@ -322,14 +355,18 @@ def evaluate(truth: dict, observations: list[dict],
             None if time_to_detect_s is None
             else round(time_to_detect_s, 3))
         if truth.get("expect_alarms"):
+            # multi-window scenarios whose attack STARTS after a roll
+            # (flow_ascent) budget detection relative to the attack
+            # window: truth's ttd_budget_s, else one window period
+            budget = truth.get("ttd_budget_s", window_s)
             if time_to_detect_s is None:
                 failures.append(
                     "no live RAISE observed during the replay "
                     "(time-to-detect unmeasurable)")
-            elif window_s is not None and time_to_detect_s >= window_s:
+            elif budget is not None and time_to_detect_s >= budget:
                 failures.append(
                     f"time-to-detect {time_to_detect_s:.1f}s is not "
-                    f"sub-window (window {window_s:.0f}s)")
+                    f"sub-window (budget {budget:.0f}s)")
 
     # --- victim naming ---
     if truth.get("victim"):

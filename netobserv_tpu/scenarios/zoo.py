@@ -1,4 +1,4 @@
-"""The adversarial traffic scenario zoo: eight deterministic generators,
+"""The adversarial traffic scenario zoo: nine deterministic generators,
 each producing a pcap plus machine-checkable ground truth.
 
 Every scenario is evaluated END TO END through the agent's `/query/*`
@@ -378,6 +378,90 @@ def build_overlay_syn_scan(path: str) -> dict:
     }
 
 
+def build_flow_ascent(path: str) -> dict:
+    """A mouse flow ramping into an elephant MID-RUN — the persistent-slot
+    churn scenario (ISSUE 13). One 5-tuple trickles ~600B per replay
+    window through the first sketch window, then ramps to ~360KB per
+    window; the slot table keeps the key's identity across the roll, so
+    the window-over-window count:prev ratio explodes and the
+    `flow_ascent` alert must RAISE — live, mid-window, with the exact key
+    named — while `new_heavy_key` stays quiet (the key is NOT new: its
+    slot's first_seen is window 0, which is exactly the new-vs-ascending
+    discrimination the per-slot metadata buys). SYN/scan/drop/asym stay
+    quiet (complete handshake, ~10%% backflow both phases); the DDoS
+    z-signal is deliberately un-asserted — a 300x volume ramp to one
+    destination is a legitimate surge either way.
+
+    Timing contract with the runner: replay windows are 5s virtual and
+    drain at ~0.25s wall each, so the phase boundary at virtual window 48
+    lands ~12s wall — safely AFTER the 10s sketch-window roll the
+    `runner` overrides configure (drains can lag but never lead, so the
+    elephant phase can only land later, never before the roll; the mouse
+    phase can only need window-0 mass, which the first drains deliver
+    seconds before the roll)."""
+    b = PcapBuilder()
+    bg = _benign_background(b)
+    client, server = "10.0.5.50", "10.0.6.1"
+    sport = 51000
+    # one replay window in virtual us. DELIBERATELY > the runner's 5s
+    # replay window: the parser splits on a STRICT > 5s gap from each
+    # window's first packet, so exactly-5s spacing would merge adjacent
+    # windows pairwise and halve the drain count the phase timing needs
+    W = 5_050_000
+    mouse_w, total_w, mice = 48, 68, 3
+    b.add(100, client, server, 6, tcp(sport, 443, SYN),
+          sport=sport, dport=443)
+    b.add(140, server, client, 6, tcp(443, sport, SYNACK),
+          sport=443, dport=sport)
+    b.add(180, client, server, 6, tcp(sport, 443, ACK),
+          sport=sport, dport=443)
+    # ONE time-ordered sweep: the pcap writer emits packets in call order
+    # and the replay parser windows a monotone timestamp stream (real
+    # captures are time-ordered) — interleaving per window keeps it so
+    for w in range(total_w):
+        if w < mouse_w:            # phase 1: the mouse (~600B/window)
+            b.add(w * W + 500, client, server, 6, tcp(sport, 443, PSHACK),
+                  claim_len=600, sport=sport, dport=443)
+            # tiny response keeps the pair bucket two-way (~10% backflow)
+            b.add(w * W + 700, server, client, 6, tcp(443, sport, PSHACK),
+                  claim_len=64, sport=443, dport=sport)
+        else:                      # phase 2: the elephant (~360KB/window)
+            for i in range(12):
+                b.add(w * W + 500 + i * 200, client, server, 6,
+                      tcp(sport, 443, PSHACK), claim_len=30_000,
+                      sport=sport, dport=443)
+            b.add(w * W + 3200, server, client, 6, tcp(443, sport, PSHACK),
+                  claim_len=36_000, sport=443, dport=sport)
+        if w % 5 == 0:             # steady mice, sparse enough that their
+            #                        one-way pair buckets stay under the
+            #                        asym volume floor in every window
+            for m in range(mice):
+                b.add(w * W + 2000 + m * 50, f"10.1.9.{m + 1}", "10.0.6.2",
+                      17, udp(22000 + m, 8080, b"\x00" * 160),
+                      sport=22000 + m, dport=8080)
+    b.write(path)
+    key = heavy_entry(client, server, sport, 443, 6)
+    return {
+        "name": "flow_ascent",
+        "expect_alarms": ["flow_ascent"],
+        # ddos deliberately absent from BOTH lists (see docstring)
+        "quiet_alarms": ["syn_flood", "port_scan", "drop_storm",
+                         "asym_conv", "new_heavy_key"],
+        "ascent_key": key,
+        "heavy": [key],
+        "topk_n": 4,
+        "min_recall": 1.0,
+        "distinct_src": 2 + mice + len(bg["distinct_srcs"]),
+        "distinct_tol": 0.3,
+        "min_records": 50,
+        # multi-window runner shape: two ~10s sketch windows; detection
+        # must land inside window 1 (the attack window) = sub-window
+        # relative to the ramp, budgeted as 2 x window_s from replay start
+        "runner": {"window_s": 10.0, "deadline_s": 120.0},
+        "ttd_budget_s": 20.0,
+    }
+
+
 #: name -> builder(path) -> truth; the runner, tests, and bench all
 #: iterate this registry
 SCENARIOS = {
@@ -389,4 +473,5 @@ SCENARIOS = {
     "quic_heavy": build_quic_heavy,
     "ipv6_heavy": build_ipv6_heavy,
     "overlay_syn_scan": build_overlay_syn_scan,
+    "flow_ascent": build_flow_ascent,
 }

@@ -33,7 +33,12 @@ log = logging.getLogger("netobserv_tpu.sketch.checkpoint")
 #: stamp also records `federation.delta`'s spec fingerprint + format
 #: version — the two surfaces are pinned against the same goldens and must
 #: move together (tests/test_federation_golden.py).
-CHECKPOINT_FORMAT_VERSION = 2
+#: v3: the persistent-slot heavy-hitter table (SketchState.heavy gained
+#: prev_counts/first_seen/epoch + the heavy_evictions scalar). v2-stamped
+#: checkpoints have NO upgrade path — their pytree cannot restore into the
+#: v3 layout — and are rejected by `check_format` BEFORE any tensor read
+#: (callers degrade to a fresh window, never crash).
+CHECKPOINT_FORMAT_VERSION = 3
 _LEGACY_VERSION = 1
 _STAMP_FILE = "FORMAT.json"
 

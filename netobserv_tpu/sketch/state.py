@@ -77,7 +77,11 @@ class SketchConfig(NamedTuple):
 class SketchState(NamedTuple):
     cm_bytes: countmin.CountMin
     cm_pkts: countmin.CountMin
-    heavy: topk.TopK
+    # persistent-slot heavy-hitter table (ops/topk.SlotTable): rows keep
+    # stable per-key identity across folds AND window rolls, so the roll
+    # ships a ready top-K with per-key churn (counts vs prev_counts,
+    # first_seen, epoch) — candidate maintenance lives in the batch walk
+    heavy: topk.SlotTable
     hll_src: hll.HLL
     hll_per_dst: hll.PerDstHLL
     hll_per_src: hll.PerDstHLL  # fan-out grid: distinct (dst,port) per src
@@ -105,13 +109,16 @@ class SketchState(NamedTuple):
     total_drop_packets: jax.Array  # f32[]
     quic_records: jax.Array   # f32[] — window records with QUIC marker
     nat_records: jax.Array    # f32[] — window records with a NAT translation
+    # valid slot-table occupants evicted by heavier challengers this window
+    # (the churn record's eviction pressure scalar)
+    heavy_evictions: jax.Array  # f32[]
     window: jax.Array         # i32[]
 
 
 class WindowReport(NamedTuple):
     """Snapshot emitted at each window roll (still on device until pulled)."""
 
-    heavy: topk.TopK
+    heavy: topk.SlotTable
     distinct_src: jax.Array        # f32[] global cardinality estimate
     per_dst_cardinality: jax.Array  # f32[D]
     per_src_fanout: jax.Array       # f32[S] distinct (dst,port) per src bucket
@@ -132,6 +139,7 @@ class WindowReport(NamedTuple):
     total_drop_packets: jax.Array
     quic_records: jax.Array
     nat_records: jax.Array
+    heavy_evictions: jax.Array
     window: jax.Array
 
 
@@ -151,7 +159,7 @@ def init_state(cfg: SketchConfig = SketchConfig()) -> SketchState:
         # 2^24 per window, and a single dtype lets the Pallas fold serve both
         cm_bytes=countmin.init(cfg.cm_depth, cfg.cm_width, jnp.float32),
         cm_pkts=countmin.init(cfg.cm_depth, cfg.cm_width, jnp.float32),
-        heavy=topk.init(cfg.topk, KEY_WORDS),
+        heavy=topk.init_slots(cfg.topk, KEY_WORDS),
         hll_src=hll.init(cfg.hll_precision),
         hll_per_dst=hll.init_per_dst(cfg.perdst_buckets, cfg.perdst_precision),
         hll_per_src=hll.init_per_dst(cfg.persrc_buckets,
@@ -172,6 +180,7 @@ def init_state(cfg: SketchConfig = SketchConfig()) -> SketchState:
         total_drop_packets=jnp.zeros((), jnp.float32),
         quic_records=jnp.zeros((), jnp.float32),
         nat_records=jnp.zeros((), jnp.float32),
+        heavy_evictions=jnp.zeros((), jnp.float32),
         window=jnp.zeros((), jnp.int32),
     )
 
@@ -323,9 +332,14 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
         else:
             cm_b, cm_p = countmin.update_two(
                 state.cm_bytes, state.cm_pkts, h1, h2, bytes_f, pkts, valid)
-        query_fn = None
-        heavy = topk.update(state.heavy, cm_b, words, h1, h2, valid,
-                            query_fn=None, salt=state.window)
+        # persistent-slot maintenance in the batch walk: the fused Pallas
+        # reduction twin engages with the other kernels (lane-aligned K);
+        # the scatter form everywhere else — bit-exact either way
+        # (tests/test_pallas_topk.py pins the two-form invariant)
+        heavy, evicted = topk.slot_update(
+            state.heavy, cm_b, words, h1, h2, valid,
+            window=state.window,
+            use_pallas=use_pallas and state.heavy.k % 128 == 0)
     else:
         cm_b = countmin.update_sharded(state.cm_bytes, h1, h2, bytes_f, valid,
                                        sketch_axis, sketch_shards)
@@ -334,11 +348,11 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
         # collective-free scoring: this shard fully owns its keys' counters,
         # so its table tracks exactly the keys it owns (the merge gathers
         # tables across the sketch axis and re-scores globally)
-        heavy = topk.update(
+        heavy, evicted = topk.slot_update(
             state.heavy, cm_b, words, h1, h2, valid,
             query_fn=lambda a, b: countmin.query_sharded_local(
                 cm_b, a, b, sketch_axis, sketch_shards),
-            salt=state.window)
+            window=state.window)
     if (use_pallas and sketch_axis is None
             and state.hll_src.regs.shape[0] % 512 == 0):
         from netobserv_tpu.ops.pallas import hll_kernel
@@ -525,6 +539,7 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
             jnp.where(valid, bytes_f, 0.0)),
         total_drop_bytes=tdb, total_drop_packets=tdp,
         quic_records=quic_rec, nat_records=nat_rec,
+        heavy_evictions=state.heavy_evictions + evicted,
         window=state.window,
     )
 
@@ -846,13 +861,13 @@ def make_ingest_dense_fn(donate: bool = True,
 def decay_state(state: SketchState, factor: float) -> SketchState:
     """Sliding-window flavor: scale the linear sketches by `factor` instead of
     zeroing them (Count-Min and histograms are linear, so decay is exact for
-    them; HLL registers cannot decay and are reset). Top-K counts are CM
+    them; HLL registers cannot decay and are reset). Slot-table counts are CM
     estimates, so they decay by the same factor to stay consistent with the
-    window totals (they are also re-scored at the next ingest)."""
+    window totals; `slot_roll` additionally snapshots this window's final
+    counts into `prev_counts` (the churn baseline) while identity, first_seen
+    and epoch persist."""
     return state._replace(
-        heavy=state.heavy._replace(
-            counts=jnp.where(state.heavy.valid, state.heavy.counts * factor,
-                             state.heavy.counts)),
+        heavy=topk.slot_roll(state.heavy, factor),
         cm_bytes=countmin.CountMin(state.cm_bytes.counts * factor),
         cm_pkts=countmin.CountMin(
             (state.cm_pkts.counts.astype(jnp.float32) * factor
@@ -875,6 +890,10 @@ def decay_state(state: SketchState, factor: float) -> SketchState:
         total_drop_packets=state.total_drop_packets * factor,
         quic_records=state.quic_records * factor,
         nat_records=state.nat_records * factor,
+        # eviction EVENTS are per-window in every mode (decaying an event
+        # count would re-report prior windows' fractional evictions
+        # forever, and the publish-time counter inc assumes a window delta)
+        heavy_evictions=jnp.zeros_like(state.heavy_evictions),
     )
 
 
@@ -910,6 +929,7 @@ def roll_window(state: SketchState, cfg: SketchConfig,
         total_drop_packets=state.total_drop_packets,
         quic_records=state.quic_records,
         nat_records=state.nat_records,
+        heavy_evictions=state.heavy_evictions,
         window=state.window,
     )
     if decay_factor is not None:
@@ -926,8 +946,13 @@ def roll_window(state: SketchState, cfg: SketchConfig,
             persrc_precision=int(state.hll_per_src.regs.shape[1]).bit_length() - 1,
             topk=state.heavy.k, hist_buckets=state.hist_rtt.n_buckets,
             ewma_buckets=state.ddos.rate.shape[0], ewma_alpha=cfg.ewma_alpha))
+        # the slot table PERSISTS across the roll (identity, first_seen,
+        # epoch); only its windowed counts roll: prev_counts <- counts,
+        # counts <- 0 — next window's estimates rebuild from the fresh CM
+        # while incumbents defend with last window's mass
         new_state = fresh._replace(ddos=ddos_state, syn=syn_state,
                                    drops_ewma=drops_state,
+                                   heavy=topk.slot_roll(state.heavy, 0.0),
                                    window=state.window + 1)
     else:
         # synack pairs with the syn EWMA's per-window rate (which roll just
@@ -937,6 +962,13 @@ def roll_window(state: SketchState, cfg: SketchConfig,
         new_state = state._replace(ddos=ddos_state, syn=syn_state,
                                    drops_ewma=drops_state,
                                    synack=jnp.zeros_like(state.synack),
+                                   # cumulative mode: counts keep growing
+                                   # with the kept CM; churn = counts -
+                                   # prev_counts per window. Eviction
+                                   # EVENTS stay per-window like synack
+                                   heavy=topk.slot_roll(state.heavy, 1.0),
+                                   heavy_evictions=jnp.zeros_like(
+                                       state.heavy_evictions),
                                    window=state.window + 1)
     return new_state, report
 
@@ -956,6 +988,12 @@ def state_tables(state: SketchState) -> dict[str, jax.Array]:
         "heavy_h2": state.heavy.h2,
         "heavy_counts": state.heavy.counts,
         "heavy_valid": state.heavy.valid,
+        # persistent-slot churn metadata (delta wire v3): prev_counts merge
+        # by SUM (per-shard partials of one key add), first_seen MIN,
+        # epoch MAX — federation.delta.TABLE_SPEC carries all three
+        "heavy_prev_counts": state.heavy.prev_counts,
+        "heavy_first_seen": state.heavy.first_seen,
+        "heavy_epoch": state.heavy.epoch,
         "hll_src": state.hll_src.regs,
         "hll_per_dst": state.hll_per_dst.regs,
         "hll_per_src": state.hll_per_src.regs,
@@ -973,7 +1011,8 @@ def state_tables(state: SketchState) -> dict[str, jax.Array]:
         "scalars": jnp.stack([
             state.total_records, state.total_bytes,
             state.total_drop_bytes, state.total_drop_packets,
-            state.quic_records, state.nat_records]),
+            state.quic_records, state.nat_records,
+            state.heavy_evictions]),
     }
 
 

@@ -92,16 +92,25 @@ def flood_report(buckets=(7,), syn=200.0) -> dict:
 
 def test_parse_rules_grammar_and_errors():
     rs = parse_rules("default")
-    assert [r.name for r in rs] == list(SIGNAL_FIELDS)
+    # default = the five bucket signals + the two per-flow churn rules
+    assert [r.name for r in rs] == [*SIGNAL_FIELDS, "flow_ascent",
+                                    "new_heavy_key"]
     rs = parse_rules("syn_flood,port_scan")
     assert [r.name for r in rs] == ["syn_flood", "port_scan"]
     rs = parse_rules("default,cardinality_surge:1000,topk_share:0.5",
                      raise_evals=3, clear_evals=4)
     assert rs[-1].threshold == 0.5 and rs[-2].threshold == 1000.0
-    assert all(r.raise_evals == 3 and r.clear_evals == 4 for r in rs)
+    assert all(r.clear_evals == 4 for r in rs)
+    assert all(r.raise_evals == (1 if r.kind == "flow_keys" else 3)
+               for r in rs)
+    rs = parse_rules("flow_ascent:12,new_heavy_key")
+    assert [(r.name, r.threshold) for r in rs] == [("flow_ascent", 12.0),
+                                                   ("new_heavy_key", 0.0)]
+    assert parse_rules("flow_ascent")[0].threshold == 0.0
     for bad in ("nope", "cardinality_surge", "topk_share", "",
                 "syn_flood:500", "default:3", "topk_share:50%",
-                "cardinality_surge:50k"):
+                "cardinality_surge:50k", "flow_ascent:0.5",
+                "flow_ascent:big", "new_heavy_key:3"):
         # signal/default tokens take no parameter: a stray ":<arg>" is a
         # user expecting a threshold that does not exist — fail fast
         with pytest.raises(ValueError):
@@ -121,6 +130,61 @@ def test_scalar_and_share_rules_fire():
     hit = share.firing(rep)
     assert hit and hit[0]["value"] == 0.8 and hit[0]["victims"] == ["2.2.2.2"]
     assert not topk_share_rule(0.9).firing(rep)
+
+
+def _ascent_entry(ratio=24.0, est=4.0e6, src="10.0.5.1", dst="10.0.6.1"):
+    return {"SrcAddr": src, "DstAddr": dst, "SrcPort": 50000,
+            "DstPort": 443, "Proto": 6,
+            "Key": f"{src}:50000->{dst}:443/6",
+            "EstBytes": est, "PrevEstBytes": est / ratio, "Ratio": ratio,
+            "FirstSeenWindow": 0}
+
+
+def test_flow_ascent_rule_fires_per_key_with_factor_refilter():
+    from netobserv_tpu.alerts.rules import flow_ascent_rule
+    rep = empty_report()
+    rep["FlowAscents"] = [_ascent_entry(ratio=24.0),
+                          _ascent_entry(ratio=9.0, src="10.0.5.2")]
+    hits = flow_ascent_rule().firing(rep)
+    # bare rule fires on the rendered list as-is (the renderer's
+    # SKETCH_CHURN_ASCENT gate is the one threshold truth)
+    assert [h["bucket"] for h in hits] == [
+        "10.0.5.1:50000->10.0.6.1:443/6", "10.0.5.2:50000->10.0.6.1:443/6"]
+    assert hits[0]["victims"] == ["10.0.5.1", "10.0.6.1"]
+    assert hits[0]["value"] == 24.0
+    # flow_ascent:<factor> re-filters by the rendered Ratio (tighten-only)
+    tight = flow_ascent_rule(12.0).firing(rep)
+    assert [h["value"] for h in tight] == [24.0]
+
+
+def test_new_heavy_key_rule_fires_per_key():
+    from netobserv_tpu.alerts.rules import new_heavy_key_rule
+    rep = empty_report()
+    rep["NewHeavyKeys"] = [_ascent_entry(est=2.0e6)]
+    hits = new_heavy_key_rule().firing(rep)
+    assert len(hits) == 1 and hits[0]["value"] == 2.0e6
+    assert hits[0]["bucket"].endswith("->10.0.6.1:443/6")
+    assert not new_heavy_key_rule().firing(empty_report())
+
+
+def test_flow_ascent_raises_through_engine_with_key_fingerprint():
+    """The engine treats the Key string as the fingerprint bucket: one
+    RAISE per ascending flow, deduped across evaluations, endpoints as
+    victims — the per-flow detection path the slot table unlocks."""
+    from netobserv_tpu.alerts.rules import flow_ascent_rule
+    eng = AlertEngine([flow_ascent_rule()], metrics=Metrics())
+    rep = empty_report()
+    rep["FlowAscents"] = [_ascent_entry()]
+    # churn rules default raise_evals=1: a churn entry already encodes a
+    # two-window crossing and lives in exactly ONE roll snapshot, so the
+    # FIRST firing evaluation raises (roll-only deployments would be
+    # structurally dead at 2)
+    t2 = eng.evaluate(snap_of(rep, window=2, seq=5), mid_window=True)
+    assert [t["action"] for t in t2] == ["raise"]
+    assert t2[0]["bucket"] == "10.0.5.1:50000->10.0.6.1:443/6"
+    assert t2[0]["victims"] == ["10.0.5.1", "10.0.6.1"]
+    # continued firing: no re-raise (exactly-once per crossing)
+    assert not eng.evaluate(snap_of(rep, window=2, seq=7), mid_window=True)
 
 
 def test_bucket_rule_carries_victims_and_value():
@@ -789,11 +853,16 @@ def test_maybe_engine_gated_on_alert_rules():
     eng = maybe_engine(cfg, Metrics())
     assert eng is not None
     view = eng.view()
-    assert view["rules"] == [*SIGNAL_FIELDS, "cardinality_surge"]
+    assert view["rules"] == [*SIGNAL_FIELDS, "flow_ascent",
+                             "new_heavy_key", "cardinality_surge"]
     assert [type(s).__name__ for s in eng._sinks] == ["LogSink"]
-    # the hysteresis overrides reached every rule
-    assert all(r.raise_evals == 3 and r.clear_evals == 4
-               for r in eng._rules)
+    # the hysteresis overrides reached every BUCKET rule; the churn
+    # rules keep their own raise_evals=1 (one-roll-snapshot lifetime)
+    assert all(r.clear_evals == 4 for r in eng._rules)
+    assert all(r.raise_evals == 3 for r in eng._rules
+               if r.kind != "flow_keys")
+    assert all(r.raise_evals == 1 for r in eng._rules
+               if r.kind == "flow_keys")
 
 
 def test_config_validates_alert_specs():

@@ -105,6 +105,38 @@ def test_format_version_stamped_and_checked(tmp_path):
     ckpt.close()
 
 
+def test_v2_stamped_checkpoint_rejected_before_tensor_restore(tmp_path):
+    """ISSUE 13: the persistent-slot table changed the state layout AND
+    the table-snapshot spec, bumping the checkpoint format 2 -> 3 with NO
+    v2 upgrade path (a v2 pytree cannot restore into the slot-table
+    layout). A v2-stamped directory — whatever its fingerprint — must
+    reject at `check_format`, BEFORE any tensor read."""
+    import json
+    import os
+
+    import pytest
+
+    from netobserv_tpu.sketch import checkpoint as ck
+
+    assert ck.CHECKPOINT_FORMAT_VERSION == 3
+    ckpt = SketchCheckpointer(str(tmp_path / "ck"))
+    ckpt.save(0, sk.init_state(CFG), wait=True)
+    stamp = os.path.join(str(tmp_path / "ck"), "FORMAT.json")
+    # the exact stamp a PR 7-12 era aggregator/exporter wrote (the v2-era
+    # fingerprint is the one test_federation_golden.py used to pin)
+    json.dump({"format_version": 2, "table_spec_crc": 1393615489,
+               "delta_format_version": 2}, open(stamp, "w"))
+    with pytest.raises(RuntimeError, match="format version 2"):
+        ckpt.check_format()
+    calls = []
+    orig = ckpt._mngr.restore
+    ckpt._mngr.restore = lambda *a, **k: calls.append(1) or orig(*a, **k)
+    with pytest.raises(RuntimeError, match="format version 2"):
+        ckpt.restore(sk.init_state(CFG))
+    assert not calls, "tensor restore ran on a rejected format"
+    ckpt.close()
+
+
 def test_rejected_format_degrades_to_fresh_window(tmp_path):
     """A version-rejected checkpoint must not kill the exporter — same
     degrade-to-fresh-window path as a structurally incompatible one."""

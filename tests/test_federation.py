@@ -197,11 +197,11 @@ class TestFederatedEqualsUnion:
         with agg._lock:
             agg._close_window_locked()
         agg._publish_queued()
-        yield agg, agg_state, union, reports
+        yield agg, agg_state, union, reports, frames
         agg.close()
 
     def test_linear_and_max_structures_bit_exact(self, merged):
-        agg, agg_state, union, _ = merged
+        agg, agg_state, union, _, _ = merged
         np.testing.assert_array_equal(np.asarray(agg_state.cm_bytes.counts),
                                       np.asarray(union.cm_bytes.counts))
         np.testing.assert_array_equal(np.asarray(agg_state.cm_pkts.counts),
@@ -224,25 +224,55 @@ class TestFederatedEqualsUnion:
         assert float(agg_state.total_records) == float(union.total_records)
         assert float(agg_state.total_bytes) == float(union.total_bytes)
 
-    def test_topk_set_bit_exact(self, merged):
-        _, agg_state, union, _ = merged
-        # union's table re-scores at the NEXT ingest; score both tables
-        # against the (identical) merged CM for an apples-to-apples set
-        def entries(state):
-            words = np.asarray(state.heavy.words)
-            valid = np.asarray(state.heavy.valid)
+    def test_topk_table_bit_exact_vs_table_union(self, merged):
+        """The persistent-slot analog of the old set equality: the
+        aggregate's slot table must BIT-EXACT equal the sequential
+        statemerge fold of the same frames into a fresh state — every
+        field, including the churn metadata (prev_counts sum, first_seen
+        min, epoch max). The raw-flow union's table is NOT the oracle any
+        more: a set-associative table under congestion is path-dependent
+        (an agent-local stream and the union stream legitimately keep
+        slightly different marginal keys; the heavy ones agree — pinned
+        by recall below)."""
+        import jax.numpy as jnp
+
+        from netobserv_tpu.federation import statemerge
+        _, agg_state, union, _, frames = merged
+        oracle = sk.init_state(CFG)
+        for data in frames:
+            frame = fdelta.decode_frame(data)
+            # same churn re-basing the aggregator applies (localize_churn;
+            # cluster window 0 — no roll happened before the capture)
+            host = fdelta.localize_churn(fdelta.upgrade_tables(frame), 0)
+            tabs = {k: jnp.asarray(np.ascontiguousarray(v))
+                    for k, v in host.items()}
+            oracle = statemerge.merge_tables(oracle, tabs)
+        for name in ("words", "h1", "h2", "counts", "prev_counts",
+                     "first_seen", "epoch", "valid"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(agg_state.heavy, name)),
+                np.asarray(getattr(oracle.heavy, name)), err_msg=name)
+
+    def test_topk_heavy_recall_vs_union(self, merged):
+        """The quality claim the set equality used to carry: the TOP
+        hitters by merged-CM mass chart in BOTH the federated table and
+        the union stream's table (marginal tail keys may differ — the
+        documented set-associative path dependence)."""
+        _, agg_state, union, _, _ = merged
+
+        def top_words(state, n):
             counts = np.asarray(state.heavy.counts)
-            return {(words[i].tobytes(), counts[i])
-                    for i in range(len(valid)) if valid[i]}
-        fed = entries(agg_state)
-        # the union top-K counts were queried against the same CM values
-        # (bit-exact tables proven above), so sets must match exactly
-        un = entries(union)
-        assert {w for w, _ in fed} == {w for w, _ in un}
-        assert fed == un
+            valid = np.asarray(state.heavy.valid)
+            words = np.asarray(state.heavy.words)
+            order = np.argsort(-np.where(valid, counts, -1.0))[:n]
+            return {words[i].tobytes() for i in order if valid[i]}
+
+        n = 16
+        fed, un = top_words(agg_state, n), top_words(union, n)
+        assert len(fed & un) / n >= 0.9
 
     def test_hll_cardinality_within_bound(self, merged):
-        _, agg_state, union, reports = merged
+        _, agg_state, union, reports, _ = merged
         # registers are bit-exact (above), so estimates agree; also sanity-
         # check the estimate against the true distinct count within the
         # standard HLL error bound (~1.04/sqrt(m), take 5 sigma)
@@ -252,7 +282,7 @@ class TestFederatedEqualsUnion:
                                             * N_DISTINCT, 3)
 
     def test_cluster_report_matches_union_roll(self, merged):
-        _, _, union, reports = merged
+        _, _, union, reports, _ = merged
         rep = reports[0]
         _, union_rep = sk.make_roll_fn(CFG)(union)
         assert rep["Records"] == float(union_rep.total_records)
@@ -266,7 +296,7 @@ class TestFederatedEqualsUnion:
         assert rep["Agents"] == [f"agent-{a}" for a in range(N_AGENTS)]
 
     def test_zero_postwarmup_retraces(self, merged):
-        agg, _, _, _ = merged
+        agg, _, _, _, _ = merged
         # the watchdog wrappers themselves: N_AGENTS merges through ONE
         # compile, the roll through one compile — any retrace means a
         # frame changed shape past validation

@@ -85,9 +85,39 @@ def union_of(batch_lists) -> sk.SketchState:
     return union
 
 
-def assert_states_bit_exact(agg_state, union):
-    """The PR 6 equivalence claim, reused verbatim: linear/max structures
-    and the top-K set must match bit-for-bit."""
+def table_union_of(frames_bytes) -> sk.SketchState:
+    """The slot-table oracle: fold the ADMITTED frames' tables, in
+    admission order, through the same statemerge primitive the aggregator
+    jits — the aggregate's persistent-slot table must equal this
+    BIT-EXACT, churn metadata included. (The raw-flow union stays the
+    oracle for the linear/max structures; a set-associative table under
+    congestion is path-dependent, so its oracle is the table-merge
+    replay, not the flow replay.)"""
+    import jax.numpy as jnp
+
+    from netobserv_tpu.federation import statemerge
+    state = sk.init_state(CFG)
+    for data in frames_bytes:
+        frame = fdelta.decode_frame(data)
+        # the aggregator re-bases churn tensors into ITS window domain
+        # before merging (fdelta.localize_churn — agent-window baselines
+        # would double-count); these schedules never roll mid-stream, so
+        # the cluster window is 0 throughout
+        host = fdelta.localize_churn(fdelta.upgrade_tables(frame), 0)
+        tabs = {k: jnp.asarray(np.ascontiguousarray(v))
+                for k, v in host.items()}
+        state = statemerge.merge_tables(state, tabs)
+    return state
+
+
+def assert_states_bit_exact(agg_state, union, table_union=None,
+                            heavy_metadata=True):
+    """The PR 6 equivalence claim, updated for the persistent-slot plane:
+    linear/max structures match the raw-flow union bit-for-bit; the slot
+    table matches the `table_union_of` replay of the admitted frames —
+    every field when `heavy_metadata` (fresh aggregators), identity+count
+    sets when the aggregate carries restored cross-window metadata a
+    fresh replay cannot have (kill/restart schedules)."""
     np.testing.assert_array_equal(np.asarray(agg_state.cm_bytes.counts),
                                   np.asarray(union.cm_bytes.counts))
     np.testing.assert_array_equal(np.asarray(agg_state.cm_pkts.counts),
@@ -110,18 +140,32 @@ def assert_states_bit_exact(agg_state, union):
     assert float(agg_state.total_records) == float(union.total_records)
     assert float(agg_state.total_bytes) == float(union.total_bytes)
 
+    if table_union is None:
+        return
+    if heavy_metadata:
+        for name in ("words", "h1", "h2", "counts", "prev_counts",
+                     "first_seen", "epoch", "valid"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(agg_state.heavy, name)),
+                np.asarray(getattr(table_union.heavy, name)), err_msg=name)
+        return
+
     def entries(state):
         words = np.asarray(state.heavy.words)
         valid = np.asarray(state.heavy.valid)
-        return {words[i].tobytes() for i in range(len(valid)) if valid[i]}
-    assert entries(agg_state) == entries(union)
+        counts = np.asarray(state.heavy.counts)
+        return {(words[i].tobytes(), counts[i])
+                for i in range(len(valid)) if valid[i]}
+    assert entries(agg_state) == entries(table_union)
 
 
 def run_schedule(agg, frames, schedule):
     """Deliver (agent, window) keys in `schedule` order (repeats allowed);
-    returns the ledger-model-expected union state."""
+    returns (ledger-model-expected union state, admitted frame bytes in
+    admission order — the slot-table oracle's input)."""
     model = LedgerModel()
     applied = []
+    admitted = []
     for key in schedule:
         data, batches = frames[key]
         ack = agg.ingest_frame(data)
@@ -131,9 +175,10 @@ def run_schedule(agg, frames, schedule):
                        frame.window_seq, frame.frame_uuid):
             assert not ack.duplicate, f"fresh frame {key} acked duplicate"
             applied.append(batches)
+            admitted.append(data)
         else:
             assert ack.duplicate, f"redelivered frame {key} merged twice"
-    return union_of(applied)
+    return union_of(applied), admitted
 
 
 # --- idempotent delivery -------------------------------------------------
@@ -155,8 +200,9 @@ class TestIdempotentDelivery:
         for a in range(3):
             for w in range(2):
                 schedule += [(a, w)] * int(rng.integers(1, 4))
-        expected = run_schedule(agg, frames, schedule)
-        assert_states_bit_exact(agg._state, expected)
+        expected, admitted = run_schedule(agg, frames, schedule)
+        assert_states_bit_exact(agg._state, expected,
+                                table_union_of(admitted))
 
     def test_reordered_and_stale_windows_discarded(self, agg):
         """Out-of-order delivery: a stale window arriving after a newer
@@ -170,8 +216,9 @@ class TestIdempotentDelivery:
             (1, 1),                  # late window 1: stale, discarded
             (0, 1), (1, 2),          # exact duplicates on top
         ]
-        expected = run_schedule(agg, frames, schedule)
-        assert_states_bit_exact(agg._state, expected)
+        expected, admitted = run_schedule(agg, frames, schedule)
+        assert_states_bit_exact(agg._state, expected,
+                                table_union_of(admitted))
         # windows 0-for-agent-0 and 1-for-agent-1 must NOT be in the union
         full = union_of([frames[k][1] for k in frames])
         assert float(agg._state.total_records) < float(full.total_records)
@@ -193,7 +240,8 @@ class TestIdempotentDelivery:
         assert ack.accepted == 1 and ack.duplicate
         expected = union_of([old[(0, 0)][1], old[(0, 1)][1],
                              new[(0, 0)][1]])
-        assert_states_bit_exact(agg._state, expected)
+        assert_states_bit_exact(agg._state, expected, table_union_of(
+            [old[(0, 0)][0], old[(0, 1)][0], new[(0, 0)][0]]))
         # re-registration/rollover never changed a tensor shape: zero
         # post-warmup retraces on the watched merge (compiles may read 0
         # here — an identical jit lowered earlier in-process dedups the
@@ -204,24 +252,59 @@ class TestIdempotentDelivery:
         """Wire compat: v1 frames (no delivery header) merge and count as
         `legacy` — including redelivery, which v1 cannot dedup (the
         documented reason the fleet should move to v2)."""
-        from netobserv_tpu.pb import sketch_delta_pb2 as pb
         m = Metrics()
         agg._metrics = m
         frames = build_streams(n_agents=1, n_windows=1, seed=25)
-        msg = pb.SketchDelta.FromString(frames[(0, 0)][0])
-        msg.version = 1
-        msg.window_seq = 0
-        msg.frame_uuid = ""
-        msg.agent_epoch = 0
-        v1 = msg.SerializeToString(deterministic=True)
+        # forge what a REAL v1 agent would have sent: the v1 table layout
+        # (no churn tensors, six scalars — encode_frame(version=1) trims
+        # both) and no delivery header
+        f3 = fdelta.decode_frame(frames[(0, 0)][0])
+        v1 = fdelta.encode_frame(f3.tables, agent_id=f3.agent_id,
+                                 window=f3.window, ts_ms=f3.ts_ms,
+                                 dims=f3.dims, version=1)
         for _ in range(2):
             ack = agg.ingest_frame(v1)
             assert ack.accepted == 1 and not ack.duplicate
         expected = union_of([frames[(0, 0)][1], frames[(0, 0)][1]])
-        assert_states_bit_exact(agg._state, expected)
+        assert_states_bit_exact(agg._state, expected,
+                                table_union_of([v1, v1]))
         assert m.registry.get_sample_value(
             "ebpf_agent_federation_deltas_total",
             {"result": "legacy"}) == 2
+
+    def test_legacy_v2_schedule_dedups_and_merges_with_zero_churn(self,
+                                                                  agg):
+        """Mixed-fleet rollout over the NEW delta table: a v2 agent (no
+        churn tensors on the wire) keeps FULL idempotent-delivery
+        protection on a v3 aggregator — duplicate and stale frames dedup
+        exactly as before — and its admitted tables merge bit-exact with
+        zero-filled churn metadata (federation.delta.upgrade_tables)."""
+        m = Metrics()
+        agg._metrics = m
+        frames = build_streams(n_agents=1, n_windows=2, seed=27)
+        v2 = {}
+        for key, (data, batches) in frames.items():
+            f = fdelta.decode_frame(data)
+            v2[key] = (fdelta.encode_frame(
+                f.tables, agent_id=f.agent_id, window=f.window,
+                ts_ms=f.ts_ms, dims=f.dims, version=2,
+                window_seq=f.window_seq, frame_uuid=f.frame_uuid,
+                agent_epoch=f.agent_epoch), batches)
+        schedule = [(0, 0), (0, 0),   # duplicate redelivery
+                    (0, 1), (0, 1),   # duplicate redelivery
+                    (0, 0)]           # out-of-order straggler: stale
+        expected, admitted = run_schedule(agg, v2, schedule)
+        assert_states_bit_exact(agg._state, expected,
+                                table_union_of(admitted))
+        # v2 frames carry no churn history: the merged metadata is zeros
+        assert float(np.sum(np.asarray(
+            agg._state.heavy.prev_counts))) == 0.0
+        assert not np.asarray(agg._state.heavy.first_seen).any()
+        get = m.registry.get_sample_value
+        total = "ebpf_agent_federation_deltas_total"
+        assert get(total, {"result": "ok"}) == 2
+        assert get(total, {"result": "duplicate"}) == 2
+        assert get(total, {"result": "stale"}) == 1
 
     def test_duplicate_and_stale_counted(self):
         m = Metrics()
@@ -286,7 +369,13 @@ class TestCheckpointRestore:
             # and a second copy of it dedups as usual
             assert agg2.ingest_frame(frames[(0, 1)][0]).duplicate
             expected = union_of([frames[(0, 1)][1], frames[(1, 1)][1]])
-            assert_states_bit_exact(agg2._state, expected)
+            # the restored table legitimately carries window-0 metadata a
+            # fresh replay cannot (prev_counts from the closed window,
+            # first_seen 0) — identity+count equality is the restart pin
+            assert_states_bit_exact(
+                agg2._state, expected,
+                table_union_of([frames[(0, 1)][0], frames[(1, 1)][0]]),
+                heavy_metadata=False)
             # restore raised the window counter past the closed window:
             # exactly-once publish across the restart
             agg2.flush()
@@ -331,6 +420,10 @@ class TestCheckpointRestore:
             assert ack.accepted == 1 and ack.duplicate, \
                 "published-but-uncheckpointed window re-merged"
             assert_states_bit_exact(agg2._state, sk.init_state(CFG))
+            # the restored slot table may keep closed-window IDENTITIES
+            # (persistence is the feature) but must carry zero live mass
+            assert float(np.sum(np.asarray(
+                agg2._state.heavy.counts))) == 0.0
             agg2.flush()
             windows = [r["Window"] for r in reports]
             assert len(set(windows)) == len(windows), \
@@ -519,7 +612,8 @@ class TestTransportChaos:
             assert get("ebpf_agent_federation_deltas_total",
                        {"result": "duplicate"}) == 1
             expected = union_of([frames[(0, 0)][1]])
-            assert_states_bit_exact(agg._state, expected)
+            assert_states_bit_exact(agg._state, expected,
+                                    table_union_of([frames[(0, 0)][0]]))
         finally:
             faultinject.clear()
             server.stop(grace=None)

@@ -1,15 +1,19 @@
-"""Endian-independent golden vector for the sketch-delta frame codec.
+"""Endian-independent golden vectors for the sketch-delta frame codec.
 
 NO jax: like test_pb_golden.py / the hashing-twin goldens, this suite runs
 on the big-endian qemu-s390x CI tier, where it proves the delta frame's
 explicit little-endian tensor encoding survives a foreign host byte order
 byte-for-byte — a BE aggregator and an LE agent (or vice versa) speak the
-same wire format. The golden file pins frame bytes AND the table-spec
+same wire format. The golden files pin frame bytes AND the table-spec
 fingerprint: changing TABLE_SPEC, the tensor encoding, or the protobuf
 schema without bumping DELTA_FORMAT_VERSION fails here (the checkpoint
 format stamps the same fingerprint — the two snapshot surfaces move
 together, sketch/checkpoint.py).
-"""
+
+Three eras are pinned: the current v3 frame (persistent-slot churn tensors
++ the heavy_evictions scalar), the v2 frame (the idempotent-delivery era —
+the COMPAT vector a mixed-fleet rollout leans on, reproduced byte-for-byte
+by `encode_frame(version=2)`), and the v1 frame (pre-idempotency)."""
 
 from __future__ import annotations
 
@@ -20,10 +24,14 @@ import numpy as np
 from netobserv_tpu.federation import delta as fdelta
 
 GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
-                      "sketch_delta_v2.hex")
-#: the v1-era frame (PR 6 agents, no delivery header) stays checked in:
-#: wire COMPAT is part of the contract — a v2 aggregator must keep
-#: decoding and merging v1 frames (counted `legacy`) during a rollout
+                      "sketch_delta_v3.hex")
+#: the v2-era frame (PR 7-12 agents: delivery header, no churn tensors)
+#: stays checked in: wire COMPAT is part of the contract — a v3 aggregator
+#: must keep decoding and merging v2 frames (zero-filled churn via
+#: upgrade_tables) during a rollout
+GOLDEN_V2 = os.path.join(os.path.dirname(__file__), "golden",
+                         "sketch_delta_v2.hex")
+#: the v1-era frame (PR 6 agents, no delivery header) likewise
 GOLDEN_V1 = os.path.join(os.path.dirname(__file__), "golden",
                          "sketch_delta_v1.hex")
 
@@ -33,36 +41,45 @@ SHAPES = {
     "cm_bytes": (2, 8), "cm_pkts": (2, 8),
     "heavy_words": (4, 10), "heavy_h1": (4,), "heavy_h2": (4,),
     "heavy_counts": (4,), "heavy_valid": (4,),
+    "heavy_prev_counts": (4,), "heavy_first_seen": (4,),
+    "heavy_epoch": (4,),
     "hll_src": (16,), "hll_per_dst": (4, 8), "hll_per_src": (4, 8),
     "hist_rtt": (8,), "hist_dns": (8,),
     "ddos_rate": (8,), "syn_rate": (8,), "synack": (8,),
     "drops_rate": (8,), "drop_causes": (8,), "dscp_bytes": (8,),
-    "conv_fwd": (8,), "conv_rev": (8,), "scalars": (6,),
+    "conv_fwd": (8,), "conv_rev": (8,), "scalars": (7,),
 }
+#: the v1/v2 table layout had no churn tensors and six scalars; its
+#: golden_tables values depend on each tensor's POSITION in that spec, so
+#: the legacy vectors enumerate TABLE_SPEC_V2 with the legacy shapes
+SHAPES_V2 = {**{n: SHAPES[n] for n, _ in fdelta.TABLE_SPEC_V2},
+             "scalars": (6,)}
 
 DIMS = {"cm_depth": 2, "cm_width": 8, "hll_precision": 4, "topk": 4,
         "ewma_buckets": 8}
 
 
-def golden_tables() -> dict:
+def golden_tables(spec=fdelta.TABLE_SPEC, shapes=SHAPES) -> dict:
     """Deterministic synthetic tables (pure numpy — identical on any host)."""
     tables = {}
-    for i, (name, dt) in enumerate(fdelta.TABLE_SPEC):
-        shape = SHAPES[name]
+    for i, (name, dt) in enumerate(spec):
+        shape = shapes[name]
         n = int(np.prod(shape))
         tables[name] = ((np.arange(n) * 3 + i * 17) % 251) \
             .reshape(shape).astype(dt)
     return tables
 
 
-def encode_golden() -> bytes:
-    # every v2 header field pinned explicitly — an auto-drawn uuid would
+def encode_golden(version=None) -> bytes:
+    # every header field pinned explicitly — an auto-drawn uuid would
     # make the frame non-deterministic and unpinnable
+    spec = fdelta.spec_for_version(version or fdelta.DELTA_FORMAT_VERSION)
+    shapes = SHAPES if spec is fdelta.TABLE_SPEC else SHAPES_V2
     return fdelta.encode_frame(
-        golden_tables(), agent_id="golden-agent", window=42,
+        golden_tables(spec, shapes), agent_id="golden-agent", window=42,
         ts_ms=1_700_000_000_123, dims=DIMS, codec=fdelta.CODEC_RAW,
         window_seq=42, frame_uuid="cafe0042feedbeef",
-        agent_epoch=1_700_000_000_000_000_000)
+        agent_epoch=1_700_000_000_000_000_000, version=version)
 
 
 def test_frame_matches_golden_bytes():
@@ -96,12 +113,48 @@ def test_golden_bytes_decode_roundtrip():
         # decoded arrays must be native little-endian VIEWS regardless of
         # host order (the frombuffer dtype is explicit)
         assert frame.tables[name].dtype.str.startswith("<"), name
+    # a current frame upgrades to itself (identity — no copies)
+    assert fdelta.upgrade_tables(frame) is frame.tables
+
+
+def test_v2_golden_still_decodes_and_upgrades():
+    """Wire compat: the PR 7 (v2) golden frame must keep decoding on a v3
+    build — same tables byte-for-byte, delivery header intact — and
+    `upgrade_tables` must zero-fill the churn tensors + pad scalars so the
+    aggregator's one jitted merge layout serves it (counted `ok`/dedup'd
+    exactly like before; only churn history is absent)."""
+    golden = bytes.fromhex(open(GOLDEN_V2).read().strip())
+    frame = fdelta.decode_frame(golden)
+    assert frame.version == 2
+    assert frame.window_seq == 42
+    assert frame.frame_uuid == "cafe0042feedbeef"
+    assert frame.agent_epoch == 1_700_000_000_000_000_000
+    want = golden_tables(fdelta.TABLE_SPEC_V2, SHAPES_V2)
+    for name, _ in fdelta.TABLE_SPEC_V2:
+        np.testing.assert_array_equal(frame.tables[name], want[name],
+                                      err_msg=name)
+    up = fdelta.upgrade_tables(frame)
+    assert up["scalars"].shape == (len(fdelta.SCALAR_FIELDS),)
+    np.testing.assert_array_equal(up["scalars"][:6], want["scalars"])
+    assert float(up["scalars"][6]) == 0.0
+    k = want["heavy_counts"].shape
+    for name in ("heavy_prev_counts", "heavy_first_seen", "heavy_epoch"):
+        assert up[name].shape == k and not up[name].any(), name
+
+
+def test_v2_encoder_reproduces_the_v2_golden():
+    """`encode_frame(version=2)` — the mixed-fleet/legacy test encoder —
+    must reproduce the v2-era wire bytes EXACTLY (it is how the chaos
+    suite forges old-agent traffic; drifting here would test a frame no
+    real v2 agent ever sent)."""
+    golden = bytes.fromhex(open(GOLDEN_V2).read().strip())
+    assert encode_golden(version=2) == golden
 
 
 def test_v1_golden_still_decodes_as_legacy():
-    """Wire compat: the PR 6 (v1) golden frame must keep decoding on a v2
-    build — an empty delivery header (proto3 defaults), version 1, same
-    tables byte-for-byte. The aggregator merges such frames as `legacy`."""
+    """Wire compat: the PR 6 (v1) golden frame must keep decoding — an
+    empty delivery header (proto3 defaults), version 1, same tables
+    byte-for-byte. The aggregator merges such frames as `legacy`."""
     golden = bytes.fromhex(open(GOLDEN_V1).read().strip())
     frame = fdelta.decode_frame(golden)
     assert frame.version == 1
@@ -110,10 +163,12 @@ def test_v1_golden_still_decodes_as_legacy():
     assert frame.agent_epoch == 0
     assert frame.agent_id == "golden-agent"
     assert frame.dims == DIMS
-    want = golden_tables()
-    for name, _ in fdelta.TABLE_SPEC:
+    want = golden_tables(fdelta.TABLE_SPEC_V2, SHAPES_V2)
+    for name, _ in fdelta.TABLE_SPEC_V2:
         np.testing.assert_array_equal(frame.tables[name], want[name],
                                       err_msg=name)
+    up = fdelta.upgrade_tables(frame)
+    assert up["scalars"].shape == (len(fdelta.SCALAR_FIELDS),)
 
 
 def test_zlib_codec_roundtrip_host_local():
@@ -131,15 +186,17 @@ def test_table_spec_fingerprint_pinned():
     """The spec fingerprint the CHECKPOINT format also stamps: a TABLE_SPEC
     edit must bump DELTA_FORMAT_VERSION + CHECKPOINT_FORMAT_VERSION and
     regenerate the golden — this pin makes a silent layout drift loud."""
-    # the TABLE layout did not change in v2 (only the frame header gained
-    # the delivery fields), so the fingerprint — and with it checkpoint
-    # compatibility — is unchanged from v1
-    assert fdelta.table_spec_fingerprint() == 1393615489
-    assert fdelta.DELTA_FORMAT_VERSION == 2
-    assert fdelta.SUPPORTED_VERSIONS == (1, 2)
+    # v3 changed the TABLE layout (churn tensors + 7th scalar), so the
+    # fingerprint moved WITH the version bump — v2 checkpoints reject
+    # before tensor restore (sketch/checkpoint.py)
+    assert fdelta.table_spec_fingerprint() == 3369050625
+    assert fdelta.DELTA_FORMAT_VERSION == 3
+    assert fdelta.SUPPORTED_VERSIONS == (1, 2, 3)
 
 
 def test_scalar_fields_order_pinned():
     assert fdelta.SCALAR_FIELDS == (
         "total_records", "total_bytes", "total_drop_bytes",
-        "total_drop_packets", "quic_records", "nat_records")
+        "total_drop_packets", "quic_records", "nat_records",
+        "heavy_evictions")
+    assert fdelta.SCALAR_FIELDS_V2 == fdelta.SCALAR_FIELDS[:6]

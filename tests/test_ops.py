@@ -215,6 +215,200 @@ class TestTopK:
         assert int(t.valid.sum()) == 0
 
 
+class TestSlotTable:
+    """The persistent-slot heavy-hitter plane (ISSUE 13): stable per-key
+    identity across folds and rolls, churn metadata, and the roll-time
+    merge graded against the exact-sort oracle."""
+
+    def _stream(self, rng, n_keys, n, k=256, cm_width=1 << 14,
+                zipf_a=1.3, batches=None):
+        words_all, ids = rand_keys(n, n_keys, rng, zipf_a=zipf_a)
+        vals = rng.integers(100, 1500, size=n)
+        cm = countmin.init(4, cm_width, jnp.float32)
+        table = topk.init_slots(k, KW)
+        bs = 8192
+        for s in range(0, n, bs):
+            chunk = words_all[s:s + bs]
+            pad = bs - len(chunk)
+            wj = jnp.asarray(np.pad(chunk, ((0, pad), (0, 0))))
+            vj = jnp.asarray(np.pad(vals[s:s + bs].astype(np.float32),
+                                    (0, pad)))
+            ok = jnp.asarray(np.pad(np.ones(len(chunk), bool), (0, pad)))
+            h1, h2 = hashing.base_hashes(wj)
+            cm = countmin.update(cm, h1, h2, vj, ok)
+            table, _ = topk.slot_update(table, cm, wj, h1, h2, ok)
+        exact = {}
+        for i, v in zip(ids, vals):
+            exact[i] = exact.get(i, 0) + int(v)
+        return cm, table, words_all, ids, exact
+
+    def test_recall_matches_concat_rescore_baseline(self):
+        """ISSUE 13 acceptance: recall on the zipf stream must be no
+        worse than the legacy path's pinned 0.99 bar (TestTopK above)."""
+        rng = np.random.default_rng(7)
+        k = 64
+        _cm, table, words, ids, exact = self._stream(rng, 5000, 50_000)
+        true_top = set(sorted(exact, key=exact.get, reverse=True)[:k])
+        counts = np.asarray(table.counts)
+        tvalid = np.asarray(table.valid)
+        order = np.argsort(-np.where(tvalid, counts, -1.0))[:k]
+        got = {tuple(r) for r in np.asarray(table.words)[order]}
+        true_words = {tuple(words[np.nonzero(ids == t)[0][0]])
+                      for t in true_top}
+        recall = len(got & true_words) / k
+        assert recall >= 0.99, f"top-{k} recall {recall}"
+
+    def test_identity_and_metadata_persist_across_rolls(self):
+        """The tentpole property: a slot keeps its key, first_seen and
+        epoch across a window roll; prev_counts snapshot the closed
+        window; the incumbent defends with last window's mass."""
+        rng = np.random.default_rng(9)
+        cm, table, *_ = self._stream(rng, 100, 4000)
+        pre_counts = np.asarray(table.counts).copy()
+        rolled = topk.slot_roll(table, 0.0)
+        np.testing.assert_array_equal(np.asarray(rolled.h1),
+                                      np.asarray(table.h1))
+        np.testing.assert_array_equal(np.asarray(rolled.words),
+                                      np.asarray(table.words))
+        np.testing.assert_array_equal(np.asarray(rolled.first_seen),
+                                      np.asarray(table.first_seen))
+        np.testing.assert_array_equal(np.asarray(rolled.epoch),
+                                      np.asarray(table.epoch))
+        np.testing.assert_array_equal(np.asarray(rolled.prev_counts),
+                                      pre_counts)
+        assert float(jnp.sum(rolled.counts)) == 0.0
+        # keep/decay carries
+        keep = topk.slot_roll(table, 1.0)
+        np.testing.assert_array_equal(np.asarray(keep.counts), pre_counts)
+        decay = topk.slot_roll(table, 0.5)
+        np.testing.assert_allclose(np.asarray(decay.counts),
+                                   pre_counts * 0.5)
+
+    def test_new_key_needs_to_beat_the_defense(self):
+        """A fresh window's challenger must out-mass the incumbent's
+        counts + prev_counts — a persistent elephant is not evicted by
+        the first mouse of the next window."""
+        rng = np.random.default_rng(3)
+        uni = rng.integers(0, 2**32, (2, KW), dtype=np.uint32)
+        cm = countmin.init(2, 1 << 10)
+        table = topk.init_slots(2, KW)  # K=2: maximal congestion
+        elephant = jnp.asarray(uni[0][None])
+        h1e, h2e = hashing.base_hashes(elephant)
+        ok1 = jnp.ones(1, jnp.bool_)
+        cm = countmin.update(cm, h1e, h2e,
+                             jnp.full(1, 1000.0, jnp.float32), ok1)
+        table, _ = topk.slot_update(table, cm, elephant, h1e, h2e, ok1)
+        table = topk.slot_roll(table, 0.0)  # counts 0, prev 1000
+        cm = countmin.init(2, 1 << 10)      # fresh window CM
+        mouse = jnp.asarray(uni[1][None])
+        h1m, h2m = hashing.base_hashes(mouse)
+        cm = countmin.update(cm, h1m, h2m,
+                             jnp.full(1, 10.0, jnp.float32), ok1)
+        t2, ev = topk.slot_update(table, cm, mouse, h1m, h2m, ok1,
+                                  window=1)
+        # the elephant's slot survives: either the mouse found the other
+        # slot (empty, defense -1) or lost the challenge — the elephant's
+        # identity is still in the table with prev mass intact
+        h1s = set(np.asarray(t2.h1)[np.asarray(t2.valid)].tolist())
+        assert int(np.asarray(h1e)[0]) in h1s
+        # and a true new elephant DOES take over a weak slot
+        cm = countmin.update(cm, h1m, h2m,
+                             jnp.full(1, 5000.0, jnp.float32), ok1)
+        t3, _ = topk.slot_update(t2, cm, mouse, h1m, h2m, ok1, window=1)
+        got = set(np.asarray(t3.h1)[np.asarray(t3.valid)].tolist())
+        assert int(np.asarray(h1m)[0]) in got
+
+    def test_merge_vs_exact_sort_within_cm_bounds(self):
+        """Window-merge equivalence (ISSUE 13 satellite): merging two
+        shards' slot tables against the merged CM recalls the exact-sort
+        oracle's top hitters (CM estimates over-count within e/w * N, so
+        the graded bar is recall of the true top set, not order), and the
+        churn metadata merges per segment (prev SUM, first_seen MIN,
+        epoch MAX)."""
+        rng = np.random.default_rng(21)
+        n, n_keys, k = 30_000, 2000, 128
+        words_all, ids = rand_keys(n, n_keys, rng, zipf_a=1.3)
+        vals = rng.integers(100, 1500, size=n)
+        cms, tables = [], []
+        for shard in range(2):
+            cm = countmin.init(4, 1 << 14, jnp.float32)
+            table = topk.init_slots(k, KW)
+            sl = slice(shard * (n // 2), (shard + 1) * (n // 2))
+            w, v = words_all[sl], vals[sl].astype(np.float32)
+            bs = 8192
+            for s in range(0, len(w), bs):
+                pad = bs - len(w[s:s + bs])
+                wj = jnp.asarray(np.pad(w[s:s + bs], ((0, pad), (0, 0))))
+                vj = jnp.asarray(np.pad(v[s:s + bs], (0, pad)))
+                ok = jnp.asarray(np.pad(np.ones(len(w[s:s + bs]), bool),
+                                        (0, pad)))
+                h1, h2 = hashing.base_hashes(wj)
+                cm = countmin.update(cm, h1, h2, vj, ok)
+                table, _ = topk.slot_update(table, cm, wj, h1, h2, ok)
+            cms.append(cm)
+            tables.append(topk.slot_roll(table, 1.0))  # prev = counts
+        cm_merged = countmin.merge(*cms)
+        stacked = jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0),
+                               tables[0], tables[1])
+        merged = topk.merge_slot_tables(stacked, cm_merged, k)
+        # recall vs the exact oracle, top-32
+        exact = {}
+        for i, v in zip(ids, vals):
+            exact[i] = exact.get(i, 0) + int(v)
+        top = 32
+        true_top = set(sorted(exact, key=exact.get, reverse=True)[:top])
+        counts = np.asarray(merged.counts)
+        order = np.argsort(-np.where(np.asarray(merged.valid), counts,
+                                     -1.0))[:top]
+        got = {tuple(r) for r in np.asarray(merged.words)[order]}
+        true_words = {tuple(words_all[np.nonzero(ids == t)[0][0]])
+                      for t in true_top}
+        assert len(got & true_words) / top >= 0.95
+        # counts are re-scored against the merged CM: never below truth
+        # for the true-top keys we recalled (CM never underestimates)
+        lookup = {tuple(words_all[np.nonzero(ids == t)[0][0]]):
+                  exact[t] for t in true_top}
+        for i in order:
+            key = tuple(np.asarray(merged.words)[i])
+            if key in lookup:
+                assert counts[i] >= lookup[key] * 0.999
+        # metadata: duplicated identities sum their prev partials
+        both = {}
+        for t in tables:
+            h1s = np.asarray(t.h1)
+            pv = np.asarray(t.prev_counts)
+            va = np.asarray(t.valid)
+            for i in range(len(va)):
+                if va[i]:
+                    both[int(h1s[i])] = both.get(int(h1s[i]), 0.0) \
+                        + float(pv[i])
+        mh1 = np.asarray(merged.h1)
+        mpv = np.asarray(merged.prev_counts)
+        mva = np.asarray(merged.valid)
+        for i in range(len(mva)):
+            if mva[i]:
+                assert mpv[i] == pytest.approx(both[int(mh1[i])])
+
+    def test_eviction_counter_counts_replacements(self):
+        rng = np.random.default_rng(17)
+        uni = rng.integers(0, 2**32, (64, KW), dtype=np.uint32)
+        cm = countmin.init(2, 1 << 10)
+        table = topk.init_slots(4, KW)  # tiny table: constant pressure
+        total = 0.0
+        for it in range(4):
+            wj = jnp.asarray(uni[rng.integers(0, 64, 128)])
+            h1, h2 = hashing.base_hashes(wj)
+            vj = jnp.asarray(
+                rng.integers(100, 10_000, 128).astype(np.float32))
+            ok = jnp.ones(128, jnp.bool_)
+            cm = countmin.update(cm, h1, h2, vj, ok)
+            table, ev = topk.slot_update(table, cm, wj, h1, h2, ok,
+                                         window=it)
+            total += float(ev)
+        assert total > 0  # 64 keys through 4 slots MUST churn
+        assert int(np.asarray(table.valid).sum()) == 4
+
+
 class TestQuantile:
     def test_relative_error(self):
         rng = np.random.default_rng(8)
@@ -401,7 +595,7 @@ def test_ddos_z_threshold_configurable():
     z = np.array([0.0, 5.0, 7.0], np.float32)
     zero3 = np.zeros(3, np.float32)
     report = WindowReport(
-        heavy=topk.init(4), distinct_src=np.float32(0),
+        heavy=topk.init_slots(4), distinct_src=np.float32(0),
         per_dst_cardinality=np.zeros(4, np.float32),
         per_src_fanout=np.zeros(4, np.float32),
         rtt_quantiles_us=np.zeros(5, np.float32),
@@ -413,6 +607,7 @@ def test_ddos_z_threshold_configurable():
         total_records=np.float32(0), total_bytes=np.float32(0),
         total_drop_bytes=np.float32(0), total_drop_packets=np.float32(0),
         quic_records=np.float32(0), nat_records=np.float32(0),
+        heavy_evictions=np.float32(0),
         window=np.int32(1))
     default = report_to_json(report)
     assert [s["bucket"] for s in default["DdosSuspectBuckets"]] == [2]
@@ -468,7 +663,7 @@ def test_drop_cause_names_in_report(monkeypatch):
     causes[N_DROP_CAUSES - 1] = 3.0  # saturated subsystem reasons
     zero = np.zeros(4, np.float32)
     report = WindowReport(
-        heavy=topk.init(4), distinct_src=np.float32(0),
+        heavy=topk.init_slots(4), distinct_src=np.float32(0),
         per_dst_cardinality=zero, per_src_fanout=zero,
         rtt_quantiles_us=np.zeros(5, np.float32),
         dns_quantiles_us=np.zeros(5, np.float32),
@@ -479,6 +674,7 @@ def test_drop_cause_names_in_report(monkeypatch):
         total_records=np.float32(0), total_bytes=np.float32(0),
         total_drop_bytes=np.float32(0), total_drop_packets=np.float32(0),
         quic_records=np.float32(0), nat_records=np.float32(0),
+        heavy_evictions=np.float32(0),
         window=np.int32(0))
     obj = report_to_json(report)
     assert obj["DropCauseNames"]["SKB_DROP_REASON_SOCKET_RCVBUFF"] == 12.0
@@ -512,7 +708,7 @@ def test_dscp_class_names_in_report():
     dscp[3] = 1.0     # unnamed
     zero = np.zeros(4, np.float32)
     report = WindowReport(
-        heavy=topk.init(4), distinct_src=np.float32(0),
+        heavy=topk.init_slots(4), distinct_src=np.float32(0),
         per_dst_cardinality=zero, per_src_fanout=zero,
         rtt_quantiles_us=np.zeros(5, np.float32),
         dns_quantiles_us=np.zeros(5, np.float32),
@@ -522,6 +718,7 @@ def test_dscp_class_names_in_report():
         total_records=np.float32(0), total_bytes=np.float32(0),
         total_drop_bytes=np.float32(0), total_drop_packets=np.float32(0),
         quic_records=np.float32(0), nat_records=np.float32(0),
+        heavy_evictions=np.float32(0),
         window=np.int32(0))
     obj = report_to_json(report)
     assert obj["DscpClassBytes"] == {

@@ -101,6 +101,10 @@ def _snap(window=3, records=10.0):
         "DdosSuspectBuckets": [], "SynFloodSuspectBuckets": [],
         "PortScanSuspectBuckets": [], "DropAnomalyBuckets": [],
         "AsymmetricConversationBuckets": [],
+        "FlowAscents": [{"SrcAddr": "10.0.5.9", "Ratio": 16.0, "Key": "k"}],
+        "FlowDescents": [], "NewHeavyKeys": [], "EvictedKeys": [],
+        "HeavyChurn": {"ascents": 1, "descents": 0, "new": 0,
+                       "evictions": 2.0, "tracked": 1},
     }
     return {"window": window, "ts_ms": 123, "seq": 5, "report": report,
             "cm_bytes": np.ones((2, 1 << 10), np.float32),
@@ -116,6 +120,18 @@ def test_routes_dispatch_and_metrics_labels():
     assert code == 200
     assert body["window"] == 3 and body["seq"] == 5
     assert body["topk"][0]["DstPort"] == 443
+
+    # /query/topk carries the SAME CM error bars /query/frequency renders
+    # (slot counts are CM point estimates; one bar-math helper in core)
+    assert body["overestimate_bound_bytes"] == pytest.approx(np.e)
+    assert 0 < body["confidence"] < 1
+
+    code, body = qr.handle("/query/churn", {})
+    assert code == 200 and body["window"] == 3
+    assert body["ascents"] == [{"SrcAddr": "10.0.5.9", "Ratio": 16.0,
+                                "Key": "k"}]
+    assert body["summary"]["evictions"] == 2.0
+    assert body["overestimate_bound_bytes"] == pytest.approx(np.e)
 
     code, body = qr.handle("/query/cardinality", {})
     assert code == 200 and body["distinct_src_estimate"] == 4.0

@@ -37,9 +37,11 @@ def test_scenario_pcap_is_deterministic(name, tmp_path):
     assert t1 == t2
     assert t1["name"] == name
     assert t1.get("min_records", 0) > 0
-    # every alarm key a scenario names must be a real /query/victims signal
+    # every alarm key a scenario names must be a real /query/victims
+    # signal OR a per-flow churn rule (the alert-plane-only surfaces)
+    churn_rules = ("flow_ascent", "new_heavy_key")
     for sig in (*t1.get("expect_alarms", ()), *t1.get("quiet_alarms", ())):
-        assert sig in SIGNALS
+        assert sig in SIGNALS or sig in churn_rules
 
 
 def test_zoo_covers_fire_and_quiet_for_every_signal():
@@ -50,13 +52,19 @@ def test_zoo_covers_fire_and_quiet_for_every_signal():
               ((n, f"/dev/null") for n in sorted(SCENARIOS))]
     fired = {s for t in truths for s in t.get("expect_alarms", ())}
     quiet = {s for t in truths for s in t.get("quiet_alarms", ())}
-    assert {"syn_flood", "port_scan", "asym_conv"} <= fired
-    assert quiet == set(SIGNALS)
+    assert {"syn_flood", "port_scan", "asym_conv", "flow_ascent"} <= fired
+    assert quiet >= set(SIGNALS)
+    # the churn rules have both directions too: flow_ascent fires in its
+    # scenario and stays quiet (with new_heavy_key) everywhere it is named
+    assert "new_heavy_key" in quiet
     # the mixed-attack overlay is the one scenario expecting TWO alarms
     # at once (the cross-talk pin)
     overlay = next(t for t in truths if t["name"] == "overlay_syn_scan")
     assert set(overlay["expect_alarms"]) == {"syn_flood", "port_scan"}
-    assert len(SCENARIOS) == 8
+    ascent = next(t for t in truths if t["name"] == "flow_ascent")
+    assert ascent["runner"]["window_s"] > 0  # multi-window runner shape
+    assert "new_heavy_key" in ascent["quiet_alarms"]  # ascending != new
+    assert len(SCENARIOS) == 9
 
 
 def test_signals_share_one_truth_with_the_alert_rules():
@@ -65,8 +73,10 @@ def test_signals_share_one_truth_with_the_alert_rules():
     signal plane landing in one surface but not the others."""
     from netobserv_tpu.alerts.rules import SIGNAL_FIELDS, default_rules
     assert SIGNALS == tuple(SIGNAL_FIELDS)
-    assert [r.name for r in default_rules()] == list(SIGNAL_FIELDS)
-    assert {r.field for r in default_rules()} == set(SIGNAL_FIELDS.values())
+    assert [r.name for r in default_rules()] == [
+        *SIGNAL_FIELDS, "flow_ascent", "new_heavy_key"]
+    assert {r.field for r in default_rules()} == (
+        set(SIGNAL_FIELDS.values()) | {"FlowAscents", "NewHeavyKeys"})
 
 
 # --- the grading logic alone (no agent) ---------------------------------
@@ -155,6 +165,48 @@ def test_evaluate_alert_directions_and_time_to_detect():
     # detection slower than one window period is NOT sub-window
     out = evaluate(truth, [obs], time_to_detect_s=700.0, window_s=600.0)
     assert any("not sub-window" in f for f in out["failures"])
+
+
+def test_evaluate_flow_ascent_key_and_ttd_budget():
+    """The churn-rule grading: a flow_ascent raise must carry the EXACT
+    ramping key as its fingerprint bucket, and multi-window scenarios
+    grade time-to-detect against their own ttd_budget_s (the attack
+    starts after a roll, so one window period is the wrong bar)."""
+    key = {"SrcAddr": "10.0.5.50", "DstAddr": "10.0.6.1",
+           "SrcPort": 51000, "DstPort": 443, "Proto": 6}
+    key_str = "10.0.5.50:51000->10.0.6.1:443/6"
+    truth = {"name": "fa", "min_records": 1,
+             "expect_alarms": ["flow_ascent"],
+             "quiet_alarms": ["new_heavy_key"],
+             "ascent_key": key, "ttd_budget_s": 20.0}
+    obs = _obs(victims={s: [] for s in SIGNALS})
+    obs["alerts"] = _alert_view(
+        active=[{"rule": "flow_ascent", "bucket": key_str,
+                 "victims": ["10.0.5.50", "10.0.6.1"]}], transition_seq=1)
+    out = evaluate(truth, [obs], time_to_detect_s=14.0, window_s=10.0)
+    assert out["passed"], out["failures"]
+    assert out["ascent_key_named"]
+    # the right RULE with the WRONG key fails the naming bar
+    obs_wrong = _obs(victims={s: [] for s in SIGNALS})
+    obs_wrong["alerts"] = _alert_view(
+        active=[{"rule": "flow_ascent", "bucket": "1.1.1.1:1->2.2.2.2:2/6",
+                 "victims": []}], transition_seq=1)
+    out = evaluate(truth, [obs_wrong], time_to_detect_s=14.0,
+                   window_s=10.0)
+    assert any("flow_ascent never raised with key" in f
+               for f in out["failures"])
+    # past the budget = not sub-window
+    out = evaluate(truth, [obs], time_to_detect_s=21.0, window_s=10.0)
+    assert any("not sub-window" in f for f in out["failures"])
+    # new_heavy_key raising when asserted quiet fails
+    obs_new = _obs(victims={s: [] for s in SIGNALS})
+    obs_new["alerts"] = _alert_view(
+        active=[{"rule": "flow_ascent", "bucket": key_str, "victims": []},
+                {"rule": "new_heavy_key", "bucket": key_str,
+                 "victims": []}], transition_seq=2)
+    out = evaluate(truth, [obs_new], time_to_detect_s=14.0, window_s=10.0)
+    assert any("new_heavy_key" in f and "benign" in f
+               for f in out["failures"])
 
 
 def test_evaluate_topk_recall_and_victim_naming():
