@@ -53,6 +53,14 @@ bench-evict:
 bench-topk:
 	JAX_PLATFORMS=cpu $(PY) bench.py --topk-only
 
+# tiered counter planes (~60s, CPU-friendly): tiered-vs-wide resident
+# sketch memory — batch-walk rate, per-table bytes (the sketch_memory
+# block), tier occupancy/promotion counts, heavy-hitter recall@100 vs the
+# exact oracle — the non-gating CI artifact for the self-adjusting sketch
+# memory plane (docs/tpu_sketch.md "Tiered counter planes")
+bench-tiered:
+	JAX_PLATFORMS=cpu $(PY) bench.py --tiered-only
+
 # overload control plane (~15s): overdriven synthetic feed against a
 # fault-slowed fold — sustained admitted rate, AIMD shed-factor
 # trajectory, heavy-hitter recall under shed vs unshed — the per-PR CI

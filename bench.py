@@ -746,6 +746,117 @@ def device_stage_stats() -> dict:
     return out
 
 
+def tiered_ablation_stats(segs: int = 4) -> dict:
+    """`--tiered-only` / `make bench-tiered` (also folded into
+    `--device-only`): the tiered-counter-plane ablation (ISSUE 14) —
+    tiered-vs-wide batch-walk rate, heavy-hitter recall@100 vs the exact
+    oracle over the SAME fold sequence, and the `sketch_memory` block
+    (per-table dtype/bytes, tier occupancy, promotion counts) so the
+    memory-bandwidth effect of the narrow resident planes on the walk is
+    MEASURED, not asserted. The byte-reduction claim is computed over the
+    tier-covered counter tables (CM planes + HLL banks) at equal geometry;
+    whole-state bytes are reported alongside."""
+    import jax
+
+    from netobserv_tpu.sketch import state as sk
+    from netobserv_tpu.sketch.tiered import (
+        BASE_MAX, TierSpec, array_bytes, counter_table_bytes,
+        plane_occupancy,
+    )
+
+    rng = np.random.default_rng(777)
+    universe, pool = make_pool(rng)
+    dev_batches = [
+        {k: jax.device_put(v) for k, v in arrays.items()}
+        for arrays, _ in pool]
+    spec = TierSpec()
+    out: dict = {"metric": "tiered_ablation", "unit": "records/s",
+                 "device_backend": jax.default_backend(), "batch": BATCH,
+                 "tier_spec": {"mid_group": spec.mid_group,
+                               "top_group": spec.top_group,
+                               "bytes_unit": spec.bytes_unit}}
+
+    def run(cfg):
+        """Deterministic fold sequence (feed tracked for the recall
+        oracle) + per-segment steady-state rates, like tpu_ingest_rate."""
+        state = sk.init_state(cfg)
+        ingest = sk.make_ingest_fn(donate=True)
+        feed: list[int] = []
+        it = 0
+        for _ in range(WARMUP_ITERS):
+            bi = it % len(dev_batches)
+            feed.append(bi)
+            state = ingest(state, dev_batches[bi])
+            it += 1
+        jax.block_until_ready(state)
+        rates = []
+        for _ in range(segs):
+            t0 = time.perf_counter()
+            for _ in range(SEGMENT_ITERS):
+                bi = it % len(dev_batches)
+                feed.append(bi)
+                state = ingest(state, dev_batches[bi])
+                it += 1
+            jax.block_until_ready(state)
+            rates.append(SEGMENT_ITERS * BATCH / (time.perf_counter() - t0))
+        return round(float(np.median(rates))), state, feed
+
+    # interleave-free but same-process A/B: wide first, tiered second (the
+    # tiered arm carrying any link/thermal drift penalty keeps the claim
+    # conservative)
+    wide_rate, wide_state, wide_feed = run(sk.SketchConfig())
+    tiered_rate, tiered_state, tiered_feed = run(
+        sk.SketchConfig(tiered=spec))
+    out["device_ingest_wide"] = wide_rate
+    out["device_ingest_tiered"] = tiered_rate
+    out["tiered_vs_wide_rate"] = round(tiered_rate / max(wide_rate, 1), 3)
+    out["wide_recall_at_100"] = round(
+        check_recall(wide_state, wide_feed, universe, pool), 4)
+    out["tiered_recall_at_100"] = round(
+        check_recall(tiered_state, tiered_feed, universe, pool), 4)
+
+    wide_b = counter_table_bytes(wide_state)
+    tier_b = counter_table_bytes(tiered_state)
+    dtypes = {
+        "cm_bytes": ("float32", "u8 base + u16 mid + u32 top "
+                     f"(unit {spec.bytes_unit}B)"),
+        "cm_pkts": ("float32", "u8 base + u16 mid + u32 top"),
+        "hll_src": ("int32", "u8 (6-bit packed, lossless)"),
+        "hll_per_dst": ("int32", "u8 (6-bit packed, lossless)"),
+        "hll_per_src": ("int32", "u8 (6-bit packed, lossless)"),
+    }
+    occ = {t: plane_occupancy(getattr(tiered_state.tables, t))
+           for t in ("cm_bytes", "cm_pkts")}
+    out["sketch_memory"] = {
+        "tables": {
+            name: {"wide_dtype": dtypes[name][0],
+                   "tiered_dtype": dtypes[name][1],
+                   "wide_bytes": wide_b[name],
+                   "tiered_bytes": tier_b[name],
+                   "reduction_x": round(wide_b[name] / tier_b[name], 2)}
+            for name in wide_b},
+        "counter_tables_wide_bytes": sum(wide_b.values()),
+        "counter_tables_tiered_bytes": sum(tier_b.values()),
+        "counter_tables_reduction_x": round(
+            sum(wide_b.values()) / sum(tier_b.values()), 2),
+        "state_wide_bytes": array_bytes(wide_state),
+        "state_tiered_bytes": array_bytes(tiered_state),
+        "state_reduction_x": round(
+            array_bytes(wide_state) / array_bytes(tiered_state), 2),
+        "tier_occupancy": occ,
+        "tier_promotions": {t: occ[t]["promoted"] for t in occ},
+        "base_span": {"cm_bytes": BASE_MAX * spec.bytes_unit,
+                      "cm_pkts": BASE_MAX},
+    }
+    print(f"tiered ablation: walk {tiered_rate / 1e6:.2f}M vs wide "
+          f"{wide_rate / 1e6:.2f}M rec/s; counter tables "
+          f"{sum(wide_b.values())} -> {sum(tier_b.values())} B "
+          f"({out['sketch_memory']['counter_tables_reduction_x']}x); "
+          f"recall@100 tiered {out['tiered_recall_at_100']} vs wide "
+          f"{out['wide_recall_at_100']}", file=sys.stderr)
+    return out
+
+
 def topk_ablation_stats() -> dict:
     """`--topk-only` / `make bench-topk` (also folded into
     `--device-only`): the persistent-slot heavy-hitter plane vs the legacy
@@ -1392,7 +1503,23 @@ def main():
         # CI artifact tracking the fusion win release-over-release
         out = device_stage_stats()
         out.update(topk_ablation_stats())
+        # tiered-counter-plane ablation + the sketch_memory block ride the
+        # same artifact (ISSUE 14 acceptance: bytes + walk rate + recall)
+        tiers = tiered_ablation_stats()
+        tiers.pop("metric", None)
+        out.update(tiers)
         out["metric"] = "device_stage_breakdown"
+        if _DEVICE_NOTE:
+            out["device"] = _DEVICE_NOTE
+        out["device_provenance"] = device_provenance(cpu_requested)
+        print(json.dumps(out))
+        return
+    if "--tiered-only" in sys.argv:
+        # `make bench-tiered` (~60s, CPU-friendly): tiered-vs-wide counter
+        # planes — walk rate, resident bytes (sketch_memory block), tier
+        # occupancy/promotions, recall@100 — the non-gating CI artifact
+        # for the self-adjusting sketch memory plane
+        out = tiered_ablation_stats()
         if _DEVICE_NOTE:
             out["device"] = _DEVICE_NOTE
         out["device_provenance"] = device_provenance(cpu_requested)

@@ -369,6 +369,27 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     #: ~80M rec/s it sits above any realistic link anyway)
     sketch_pack_threads: int = field(default=0,
                                      **_env("SKETCH_PACK_THREADS", "0"))
+    #: tiered counter planes (sketch/tiered.py): keep the RESIDENT form of
+    #: the CM planes + HLL banks narrow (u8 base + u16/u32 overflow tiers
+    #: with in-executable saturation promotion; 6-bit packed HLL
+    #: registers) and decode to the canonical wide tables transiently
+    #: inside the fold/roll executables — ~4x less HBM per resident sketch
+    #: window at equal geometry (docs/tpu_sketch.md "Tiered counter
+    #: planes"). Single-device only; unset is bit-identical to the
+    #: wide-resident path.
+    sketch_tiered: bool = field(default=False, **_env("SKETCH_TIERED", "false"))
+    #: CM columns sharing one u16 MID overflow cell (power of two)
+    sketch_tier_mid_group: int = field(
+        default=32, **_env("SKETCH_TIER_MID_GROUP", "32"))
+    #: CM columns sharing one u32 TOP overflow cell (power of two,
+    #: > mid_group, divides SKETCH_CM_WIDTH)
+    sketch_tier_top_group: int = field(
+        default=256, **_env("SKETCH_TIER_TOP_GROUP", "256"))
+    #: byte quantum of the bytes plane's tiered units (power of two; folds
+    #: CEIL to it — overestimate-preserving). The u8 base then spans
+    #: 255*unit bytes per counter per window before promotion.
+    sketch_tier_bytes_unit: int = field(
+        default=256, **_env("SKETCH_TIER_BYTES_UNIT", "256"))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))
     #: host->device feed format: "resident" (default, ~15B/record
     #: slot-id rows against a device key table; sharded meshes use one
@@ -573,6 +594,32 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
             raise ValueError("EXPORT=kafka: KAFKA_BROKERS is required")
         if self.sketch_cm_width < 2 or self.sketch_cm_width & (self.sketch_cm_width - 1):
             raise ValueError("SKETCH_CM_WIDTH must be a power of two >= 2")
+        if self.sketch_tiered:
+            for env_name, v, floor in (
+                    ("SKETCH_TIER_MID_GROUP", self.sketch_tier_mid_group, 2),
+                    ("SKETCH_TIER_TOP_GROUP", self.sketch_tier_top_group, 2),
+                    ("SKETCH_TIER_BYTES_UNIT", self.sketch_tier_bytes_unit,
+                     1)):
+                if v < floor or v & (v - 1):
+                    raise ValueError(
+                        f"{env_name} must be a power of two >= {floor} "
+                        f"(got {v}) — tier geometry must stay power-of-two-"
+                        "compatible with SKETCH_CM_WIDTH")
+            if self.sketch_tier_top_group <= self.sketch_tier_mid_group:
+                raise ValueError(
+                    f"SKETCH_TIER_TOP_GROUP ({self.sketch_tier_top_group}) "
+                    f"must exceed SKETCH_TIER_MID_GROUP "
+                    f"({self.sketch_tier_mid_group}): tiers must narrow as "
+                    "counters widen")
+            if self.sketch_cm_width % self.sketch_tier_top_group:
+                raise ValueError(
+                    f"SKETCH_TIER_TOP_GROUP ({self.sketch_tier_top_group}) "
+                    f"must divide SKETCH_CM_WIDTH ({self.sketch_cm_width})")
+            if self.sketch_mesh_shape:
+                raise ValueError(
+                    "SKETCH_TIERED has no owner-sharded form yet (tiered "
+                    "counter planes are single-device); unset "
+                    "SKETCH_MESH_SHAPE or SKETCH_TIERED")
         if not (4 <= self.sketch_hll_precision <= 18):
             raise ValueError("SKETCH_HLL_PRECISION must be in [4, 18]")
         if self.sketch_window_mode not in ("reset", "decay"):

@@ -501,6 +501,27 @@ class TpuSketchExporter(Exporter):
         import jax
         devs = jax.devices()
         self._distributed = len(devs) > 1 or ("x" in mesh_shape)
+        if self._distributed and self._cfg.tiered is not None:
+            # no owner-sharded tier form yet (config.validate blocks the
+            # env combination; direct construction degrades gracefully —
+            # exporters never crash the pipeline)
+            log.warning("SKETCH_TIERED has no sharded form; running the "
+                        "mesh exporter with wide-resident tables")
+            self._cfg = self._cfg._replace(tiered=None)
+        #: previous closed-window promoted-counter masks, per CM table —
+        #: the tier-promotions counter increments by NEW promotions only
+        #: (host bools, timer thread; see _publish_tier_metrics). Masks
+        #: are only kept when promotions PERSIST across windows (decay
+        #: mode); reset mode starts every window from fresh planes, so
+        #: there occupancy IS the window's new-promotion count.
+        self._tier_prev_promoted: dict = {}
+        self._tier_sticky_promotions = decay_factor is not None
+        #: jitted decode-to-wide for checkpoint saves (tiered mode only —
+        #: checkpoints keep the canonical wide SketchState layout, so the
+        #: format/version stamp never moves with the resident
+        #: representation). Built lazily, retrace-watched like every
+        #: jitted entry the exporter constructs.
+        self._tiered_decode = None
         if self._distributed:
             from netobserv_tpu.parallel import (
                 MeshSpec, make_mesh, merge as pmerge)
@@ -655,6 +676,12 @@ class TpuSketchExporter(Exporter):
             self._query_refresh_s = 0.0
         self._next_refresh = (time.monotonic() + self._query_refresh_s
                               if self._query_refresh_s else None)
+        if metrics is not None:
+            # resident sketch-state footprint (shape math, no transfer):
+            # the capacity story SKETCH_TIERED buys — several windows/
+            # tenants resident per HBM — made visible per agent
+            from netobserv_tpu.sketch.tiered import array_bytes
+            metrics.sketch_resident_hbm_bytes.set(array_bytes(self._state))
         if warm_ladder:
             self.warm_superbatch_ladder()
         # the staging ring packs the next batch while the previous
@@ -668,7 +695,18 @@ class TpuSketchExporter(Exporter):
         # pipeline — CLAUDE.md invariant)
         if self._ckpt is not None and self._ckpt.latest_step() is not None:
             try:
-                self._state = self._ckpt.restore(self._state)
+                if self._cfg.tiered is not None:
+                    # checkpoints are WIDE (steady-state tiers never reach
+                    # disk): restore into the wide layout, then encode —
+                    # a wide-era checkpoint restores into a tiered agent
+                    # and vice versa, no format bump
+                    from netobserv_tpu.sketch import tiered as sk_tiered
+                    wide = self._ckpt.restore(self._sk.init_state(
+                        self._cfg._replace(tiered=None)))
+                    self._state = sk_tiered.encode_state(
+                        wide, self._cfg.tiered)
+                else:
+                    self._state = self._ckpt.restore(self._state)
                 log.info("restored sketch state from checkpoint step %s",
                          self._ckpt.latest_step())
             except Exception as exc:
@@ -1342,7 +1380,8 @@ class TpuSketchExporter(Exporter):
         if self._ckpt is not None and self._ckpt_every:
             self._n_windows_saved += 1
             if self._n_windows_saved % self._ckpt_every == 0:
-                self._ckpt.save(int(report.window), self._state)
+                self._ckpt.save(int(report.window),
+                                self._ckpt_state_view(self._state))
 
     def _publish_queued(self) -> None:
         """Render and deliver every queued window report (timer thread, or
@@ -1488,6 +1527,45 @@ class TpuSketchExporter(Exporter):
         faultinject.fire("sketch.query_snapshot")
         self._publish_query_snapshot(obj, tables, mid_window=True)
 
+    def _ckpt_state_view(self, state):
+        """What a checkpoint saves: the state itself, or — tiered mode —
+        its canonical wide decode (checkpoints never see the resident tier
+        layout; format stamp unchanged). The decode is a retrace-watched
+        jitted entry dispatched only on checkpoint windows."""
+        if self._cfg.tiered is None:
+            return state
+        if self._tiered_decode is None:
+            import jax
+
+            from netobserv_tpu.sketch.tiered import decode_state
+            self._tiered_decode = retrace.watch(jax.jit(decode_state),
+                                                "tiered_decode")
+        return self._tiered_decode(state)
+
+    def _publish_tier_metrics(self, tables) -> None:
+        """Per-window tier telemetry from the published WIDE tables (the
+        host copy the snapshot already paid for). The counter counts NEW
+        promotions only: counters at/past base saturation this window that
+        were NOT saturated at the previous closed-window publish — in
+        decay/keep roll modes a steady heavy hitter stays promoted across
+        windows and must not re-count every publish (the per-window-
+        counter rule heavy_evictions pins). Reset mode clears the mask
+        with the window, so there the delta equals occupancy. Timer
+        thread, per window — never the fold path."""
+        from netobserv_tpu.sketch.tiered import BASE_MAX
+        spec = self._cfg.tiered
+        for table, span in (("cm_bytes", BASE_MAX * spec.bytes_unit),
+                            ("cm_pkts", BASE_MAX)):
+            promoted = np.asarray(tables[table]) >= span
+            fresh = promoted
+            if self._tier_sticky_promotions:
+                prev = self._tier_prev_promoted.get(table)
+                if prev is not None:
+                    fresh = promoted & ~prev
+                self._tier_prev_promoted[table] = promoted
+            self._metrics.sketch_tier_promotions_total.labels(
+                table=table).inc(int(fresh.sum()))
+
     def _publish_report(self, report, wtrace=tracing.NULL_TRACE,
                         tables=None) -> None:
         if self._delta_sink is not None and tables is not None:
@@ -1547,6 +1625,11 @@ class TpuSketchExporter(Exporter):
         with wtrace.stage("report_sink"):
             self._sink(obj)
         if self._metrics is not None:
+            if self._cfg.tiered is not None and tables is not None:
+                try:
+                    self._publish_tier_metrics(tables)
+                except Exception as exc:  # telemetry never loses a report
+                    log.warning("tier metrics publish failed: %s", exc)
             self._metrics.sketch_window_reports_total.inc()
             self._metrics.sketch_window_records.set(obj["Records"])
             self._metrics.sketch_window_drop_bytes.set(obj["DropBytes"])

@@ -209,6 +209,22 @@ class Metrics:
             "sustained high rates mean the table is churning under "
             "capacity pressure: raise SKETCH_TOPK)",
             registry=self.registry)
+        self.sketch_tier_promotions_total = Counter(
+            p + "sketch_tier_promotions_total",
+            "Counters promoted out of the narrow u8 base plane "
+            "(SKETCH_TIERED; incremented at each closed-window publish by "
+            "that window's count of base-saturated counters, per CM "
+            "table — sustained growth means the tier geometry is too "
+            "narrow for the traffic: raise SKETCH_TIER_BYTES_UNIT or "
+            "widen the sketch)", ["table"],
+            registry=self.registry)
+        self.sketch_resident_hbm_bytes = Gauge(
+            p + "sketch_resident_hbm_bytes",
+            "Resident sketch-state bytes on device (sum over all state "
+            "arrays; shape math, set once at exporter construction). "
+            "SKETCH_TIERED shrinks this ~4x over the counter tables — "
+            "the windows/tenants-per-HBM capacity signal",
+            registry=self.registry)
         self.sketch_reports_shed_total = Counter(
             p + "sketch_reports_shed_total",
             "Unpublished window reports shed because the report queue "

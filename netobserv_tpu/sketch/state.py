@@ -33,6 +33,7 @@ import numpy as np
 from netobserv_tpu.model.columnar import KEY_WORDS, FlowBatch
 from netobserv_tpu.model.flow import TcpFlags
 from netobserv_tpu.ops import countmin, ewma, hashing, hll, quantile, topk
+from netobserv_tpu.sketch import tiered
 
 
 class SketchConfig(NamedTuple):
@@ -58,6 +59,12 @@ class SketchConfig(NamedTuple):
     enable_fanout: bool = True
     #: False skips the conversation-asymmetry fold (one-way detection)
     enable_asym: bool = True
+    #: tiered counter planes (SKETCH_TIERED, sketch/tiered.py): the
+    #: resident form of the CM planes + HLL banks goes narrow (u8 base +
+    #: u16/u32 overflow tiers; 6-bit packed HLL registers), decoded to the
+    #: canonical wide tables transiently inside the fold/roll executables.
+    #: None (the default) keeps today's wide-resident path bit-identical.
+    tiered: "tiered.TierSpec | None" = None
 
     @classmethod
     def from_agent_config(cls, cfg) -> "SketchConfig":
@@ -68,10 +75,16 @@ class SketchConfig(NamedTuple):
             # accept every spelling the old bool field accepted, so an
             # explicit opt-out like SKETCH_USE_PALLAS=0/off stays an opt-out
             pallas = raw in ("1", "true", "yes", "on")
+        tiers = None
+        if getattr(cfg, "sketch_tiered", False):
+            tiers = tiered.TierSpec(
+                mid_group=cfg.sketch_tier_mid_group,
+                top_group=cfg.sketch_tier_top_group,
+                bytes_unit=cfg.sketch_tier_bytes_unit)
         return cls(cm_depth=cfg.sketch_cm_depth, cm_width=cfg.sketch_cm_width,
                    hll_precision=cfg.sketch_hll_precision, topk=cfg.sketch_topk,
                    ewma_alpha=cfg.sketch_ewma_alpha,
-                   use_pallas=pallas)
+                   use_pallas=pallas, tiered=tiers)
 
 
 class SketchState(NamedTuple):
@@ -153,7 +166,14 @@ N_DROP_CAUSES = 128
 N_DSCP = 64
 
 
-def init_state(cfg: SketchConfig = SketchConfig()) -> SketchState:
+def init_state(cfg: SketchConfig = SketchConfig()):
+    if cfg.tiered is not None:
+        # tiered counter planes (SKETCH_TIERED): encode a fresh wide state
+        # — from zeros, the encode is exact. Everything downstream
+        # branches on the state's TYPE, so this is the ONE entry gate.
+        cfg.tiered.check(cfg.cm_width)
+        return tiered.encode_state(init_state(cfg._replace(tiered=None)),
+                                   cfg.tiered)
     return SketchState(
         # both counter planes are float32: packet counts stay exact below
         # 2^24 per window, and a single dtype lets the Pallas fold serve both
@@ -286,6 +306,27 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     window-roll merge, which gathers per-shard tables and re-scores against
     the globally merged sketch (`parallel.merge.merge_states`).
     """
+    if isinstance(state, tiered.TieredState):
+        # tiered counter planes: decode the resident tiers to the canonical
+        # wide tables TRANSIENTLY (inside this same executable), run the
+        # exact same fold below — both equivalence-pinned forms (scatter
+        # chain and Pallas walk) unchanged — then fold the per-counter
+        # delta back through the saturation-promotion path. Static branch:
+        # resolved at trace time, the wide path is untouched when disabled.
+        if sketch_axis is not None:
+            raise NotImplementedError(
+                "SKETCH_TIERED has no owner-sharded form yet — tiered "
+                "counter planes are single-device (config.validate blocks "
+                "SKETCH_MESH_SHAPE with SKETCH_TIERED)")
+        spec = state.spec
+        cmb_wide = tiered.decode_plane(state.tables.cm_bytes, spec,
+                                       spec.bytes_unit)
+        cmp_wide = tiered.decode_plane(state.tables.cm_pkts, spec, 1)
+        new_wide = ingest(tiered.widen(state, cmb_wide, cmp_wide), arrays,
+                          use_pallas=use_pallas,
+                          enable_fanout=enable_fanout,
+                          enable_asym=enable_asym)
+        return tiered.fold_encode(state, cmb_wide, cmp_wide, new_wide)
     if use_pallas is None:
         # auto: the fused kernels (Count-Min fold + HLL) win on TPU at and
         # above the measured ~16K-width crossover (docs/tpu_sketch.md);
@@ -866,6 +907,13 @@ def decay_state(state: SketchState, factor: float) -> SketchState:
     window totals; `slot_roll` additionally snapshots this window's final
     counts into `prev_counts` (the churn baseline) while identity, first_seen
     and epoch persist."""
+    if isinstance(state, tiered.TieredState):
+        # decay the REST in the wide domain; the CM tiers scale at the
+        # representation level (decay_plane) — a decode->re-encode here
+        # would re-sum shared-cell attribution and compound the aliasing
+        # every decay (counts would GROW under decay; pinned)
+        wide_decayed = decay_state(tiered.decode_state(state), factor)
+        return tiered.decay_encode(state, wide_decayed, factor)
     return state._replace(
         heavy=topk.slot_roll(state.heavy, factor),
         cm_bytes=countmin.CountMin(state.cm_bytes.counts * factor),
@@ -903,6 +951,27 @@ def roll_window(state: SketchState, cfg: SketchConfig,
                 ) -> tuple[SketchState, WindowReport]:
     """Close the current window: emit a report, roll EWMA baselines, and
     reset (or decay) the windowed sketch state while keeping the baselines."""
+    if isinstance(state, tiered.TieredState):
+        # the decode-to-wide step folded into the existing roll executable:
+        # the report and (via state_tables) the delta wire / query snapshot
+        # see only canonical wide tables — no wire v4, no checkpoint bump.
+        # The FRESH state re-tiers per roll mode WITHOUT a decode->encode
+        # round trip (which would re-sum shared-overflow attribution and
+        # compound it every window): reset encodes fresh zeros (exact),
+        # decay scales the tier arrays elementwise, keep leaves them
+        # verbatim.
+        new_wide, report = roll_window(tiered.decode_state(state), cfg,
+                                       reset_sketches, decay_factor)
+        if decay_factor is not None:
+            new_state = tiered.decay_encode(state, new_wide, decay_factor)
+        elif reset_sketches:
+            new_state = tiered.encode_state(new_wide, state.spec)
+        else:
+            # keep mode leaves the CM planes and HLL banks untouched —
+            # the resident tier arrays ARE that, bit for bit
+            new_state = tiered.TieredState(
+                state.tables, tiered._strip(new_wide), state.spec)
+        return new_state, report
     ddos_state, z = ewma.roll(state.ddos, cfg.ewma_alpha)
     syn_state, syn_z = ewma.roll(state.syn, cfg.ewma_alpha)
     drops_state, drop_z = ewma.roll(state.drops_ewma, cfg.ewma_alpha)
@@ -980,6 +1049,10 @@ def state_tables(state: SketchState) -> dict[str, jax.Array]:
     histograms add, HLL registers max, top-K candidates concat + re-score,
     signal-plane window rates add. EWMA baselines (mean/var) are absent by
     design — the aggregator keeps its own cluster-level baselines."""
+    if isinstance(state, tiered.TieredState):
+        # the delta wire and checkpoints keep seeing wide tables (tiers are
+        # a steady-state representation only)
+        return state_tables(tiered.decode_state(state))
     return {
         "cm_bytes": state.cm_bytes.counts,
         "cm_pkts": state.cm_pkts.counts,

@@ -81,10 +81,17 @@ def heavy_metrics(report_heavy, universe, exact, k_eval=RECALL_AT):
     return recall, f1
 
 
-def run_case(zipf_s: float, width: int, k: int, mode: str, seed: int = 0):
+def run_case(zipf_s: float, width: int, k: int, mode: str, seed: int = 0,
+             tiered: bool = False):
     universe, batches, exact, distinct_true, rtt_all = make_traffic(
         zipf_s, seed)
-    cfg = sk.SketchConfig(cm_width=width, topk=k)
+    tiers = None
+    if tiered:
+        # tiered counter planes (SKETCH_TIERED) at the production tier
+        # geometry — graded against the SAME bars as the wide path
+        from netobserv_tpu.sketch.tiered import TierSpec
+        tiers = TierSpec()
+    cfg = sk.SketchConfig(cm_width=width, topk=k, tiered=tiers)
     state = sk.init_state(cfg)
     ingest = jax.jit(sk.ingest)
     if mode == "reset":

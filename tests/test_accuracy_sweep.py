@@ -27,6 +27,26 @@ def test_heavy_hitter_recall_bound(zipf_s, width, k, mode):
         assert q_err < 0.05, f"quantile err {q_err}"
 
 
+@pytest.mark.parametrize("zipf_s,width,k,mode", [
+    (1.2, 1 << 14, 1024, "reset"),
+    (1.2, 1 << 14, 1024, "decay"),
+])
+def test_tiered_heavy_hitter_recall_bound(zipf_s, width, k, mode):
+    """SKETCH_TIERED at the production tier geometry, graded against the
+    SAME (unrelaxed) bars as the wide path — plus the ISSUE-14 bar that
+    tiered recall@100 is EXACTLY 1.0 (tier aliasing and the ceil quantum
+    only ever OVERESTIMATE, so narrowing can never displace a true heavy
+    hitter; HLL packing is lossless, so the cardinality bound is the wide
+    bound)."""
+    recall, f1, hll_err, q_err = run_case(zipf_s, width, k, mode,
+                                          tiered=True)
+    assert recall == 1.0, f"tiered recall {recall} != 1.0"
+    assert f1 >= 0.9, f"tiered F1 {f1} breaches the wide-path bar"
+    assert hll_err < 0.03, f"HLL err {hll_err} (packing is lossless)"
+    if q_err is not None:
+        assert q_err < 0.05, f"quantile err {q_err}"
+
+
 def test_merged_mesh_hll_bound():
     err = run_mesh_hll_case(1.2)
     if err is None:

@@ -64,6 +64,37 @@ def test_validate_requires_target():
     c2.validate()
 
 
+def test_validate_tiered_knobs():
+    """SKETCH_TIERED validation: tier geometry must stay power-of-two-
+    compatible with the SKETCH_CM_WIDTH check, tiers must narrow as they
+    widen, and there is no sharded tier form — each with an error message
+    naming the offending knob."""
+    base = {"EXPORT": "stdout", "SKETCH_TIERED": "true"}
+    # defaults validate
+    cfg.load_config(environ=base).validate()
+    cases = [
+        ({"SKETCH_TIERED": "true", "SKETCH_TIER_MID_GROUP": "24"},
+         "SKETCH_TIER_MID_GROUP"),
+        ({"SKETCH_TIERED": "true", "SKETCH_TIER_TOP_GROUP": "100"},
+         "SKETCH_TIER_TOP_GROUP"),
+        ({"SKETCH_TIERED": "true", "SKETCH_TIER_BYTES_UNIT": "48"},
+         "SKETCH_TIER_BYTES_UNIT"),
+        ({"SKETCH_TIERED": "true", "SKETCH_TIER_MID_GROUP": "256",
+          "SKETCH_TIER_TOP_GROUP": "64"}, "must exceed"),
+        ({"SKETCH_TIERED": "true", "SKETCH_CM_WIDTH": "512",
+          "SKETCH_TIER_TOP_GROUP": "1024"}, "must divide SKETCH_CM_WIDTH"),
+        ({"SKETCH_TIERED": "true", "SKETCH_MESH_SHAPE": "2x4"},
+         "single-device"),
+    ]
+    for env, needle in cases:
+        with pytest.raises(ValueError, match=needle):
+            cfg.load_config(environ={**base, **env}).validate()
+    # the knobs are inert without SKETCH_TIERED (no surprise failures on
+    # half-configured deployments)
+    cfg.load_config(environ={"EXPORT": "stdout",
+                             "SKETCH_TIER_MID_GROUP": "24"}).validate()
+
+
 def test_filter_rules_parse():
     rules = cfg.parse_filter_rules(
         '[{"ip_cidr":"10.0.0.0/8","action":"Reject","protocol":"TCP",'
