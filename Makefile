@@ -61,6 +61,13 @@ bench-topk:
 bench-tiered:
 	JAX_PLATFORMS=cpu $(PY) bench.py --tiered-only
 
+# sketch warehouse (~60s, CPU-friendly): per-window write amplification,
+# raw-vs-compacted segment bytes, range-merge rate per ladder k, range
+# top-K recall vs the union oracle — the non-gating CI artifact for the
+# archive plane (docs/architecture.md "Sketch warehouse")
+bench-archive:
+	JAX_PLATFORMS=cpu $(PY) bench.py --archive-only
+
 # overload control plane (~15s): overdriven synthetic feed against a
 # fault-slowed fold — sustained admitted rate, AIMD shed-factor
 # trajectory, heavy-hitter recall under shed vs unshed — the per-PR CI

@@ -857,6 +857,135 @@ def tiered_ablation_stats(segs: int = 4) -> dict:
     return out
 
 
+def archive_stats(n_windows: int = 24, raw_windows: int = 4,
+                  compact_group: int = 2, max_levels: int = 2,
+                  ladder_max: int = 8) -> dict:
+    """`--archive-only` / `make bench-archive`: the sketch warehouse
+    (ISSUE 15) — write amplification per window (segment bytes vs the raw
+    table-snapshot bytes), raw-vs-compacted segment bytes, range-merge
+    rate per ladder k, and range top-K recall vs the union oracle. The
+    non-gating CI artifact tracking the warehouse's cost envelope."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from netobserv_tpu.archive import ArchiveStore, SketchArchive
+    from netobserv_tpu.sketch import state as sk
+
+    cfg = sk.SketchConfig(cm_depth=4, cm_width=1 << 14, hll_precision=10,
+                          perdst_buckets=256, perdst_precision=5,
+                          persrc_buckets=256, persrc_precision=5,
+                          topk=256, hist_buckets=256, ewma_buckets=256)
+    rng = np.random.default_rng(2026)
+    n_keys = 2048
+    universe = rng.integers(0, 2**32, (n_keys, 10), dtype=np.uint32)
+    # zipf-ish ranks so the top-K has a real head to recall
+    ranks = np.clip(rng.zipf(1.3, 65_536) - 1, 0, n_keys - 1)
+    # with_tables: the PRE-roll snapshot is what the exporter archives
+    roll = sk.make_roll_fn(cfg, with_tables=True)
+
+    def window_batch(w):
+        sel = ranks[rng.integers(0, len(ranks), 4096)]
+        return {
+            "keys": universe[sel],
+            "bytes": rng.integers(1, 1500, 4096).astype(np.float32),
+            "packets": np.ones(4096, np.int32),
+            "rtt_us": rng.integers(1, 5000, 4096).astype(np.int32),
+            "dns_latency_us": np.zeros(4096, np.int32),
+            "sampling": np.zeros(4096, np.int32),
+            "valid": np.ones(4096, np.bool_),
+            "tcp_flags": np.zeros(4096, np.int32),
+            "dscp": np.zeros(4096, np.int32),
+            "drop_bytes": np.zeros(4096, np.int32),
+            "drop_packets": np.zeros(4096, np.int32),
+        }
+
+    d = tempfile.mkdtemp(prefix="bench-archive-")
+    out: dict = {"metric": "archive_plane", "n_windows": n_windows,
+                 "raw_windows": raw_windows,
+                 "compact_group": compact_group,
+                 "max_levels": max_levels, "ladder_max": ladder_max}
+    try:
+        store = ArchiveStore(d, raw_windows=raw_windows,
+                             compact_group=compact_group,
+                             max_levels=max_levels)
+        arch = SketchArchive(store, cfg, agent_id="bench",
+                             ladder_max=ladder_max)
+        state = sk.init_state(cfg)
+        window_arrays = []
+        write_s, seg_bytes, table_bytes = 0.0, [], 0
+        for w in range(n_windows):
+            arrays = window_batch(w)
+            window_arrays.append(arrays)
+            state = sk.ingest(state, arrays)
+            state, _report, dev_tables = roll(state)
+            tables = {k: np.asarray(v) for k, v in dev_tables.items()}
+            table_bytes = sum(a.nbytes for a in tables.values())
+            t0 = time.perf_counter()
+            arch.write_window(tables, window=w, ts_ms=w)
+            write_s += time.perf_counter() - t0
+            if store.segments():
+                seg_bytes.append(store.segments()[-1].nbytes)
+        raw_segs = [s for s in store.segments() if s.level == 0]
+        comp_segs = [s for s in store.segments() if s.level > 0]
+        out["table_snapshot_bytes"] = table_bytes
+        out["segment_bytes_raw"] = int(np.mean(
+            [s.nbytes for s in raw_segs])) if raw_segs else 0
+        out["segment_bytes_compacted"] = int(np.mean(
+            [s.nbytes for s in comp_segs])) if comp_segs else 0
+        out["write_amplification"] = round(
+            out["segment_bytes_raw"] / max(table_bytes, 1), 4)
+        out["write_s_per_window"] = round(write_s / n_windows, 6)
+        out["segments"] = store.stats()["segments_per_level"]
+        out["disk_bytes"] = store.total_bytes()
+
+        # range-merge rate per ladder k (windows merged per second, one
+        # warmed dispatch each)
+        arch.engine.warm()
+        rates = {}
+        zero = arch.engine._zero_template()
+        for k in arch.engine.ladder:
+            stacked = {n: np.broadcast_to(z, (k,) + z.shape).copy()
+                       for n, z in zero.items()}
+            fn = arch.engine._merge_fn(k)
+            t0 = time.perf_counter()
+            reps = 5
+            for _ in range(reps):
+                report, _tables = fn(stacked)
+            jax.block_until_ready(report.window)
+            rates[str(k)] = round(reps * k
+                                  / (time.perf_counter() - t0), 2)
+        out["range_merge_windows_per_s"] = rates
+
+        # recall vs the union oracle over the covered range (the retained
+        # per-window streams re-fold into one state)
+        cov = store.coverage()
+        lo, hi = cov[0]["window_from"], cov[-1]["window_to"]
+        snap = arch.engine.range_snapshot(lo, hi)
+        heads = {(e["SrcAddr"], e["SrcPort"])
+                 for e in snap["report"]["HeavyHitters"][:100]}
+        union = sk.init_state(cfg)
+        for w in range(lo, min(hi + 1, n_windows)):
+            union = sk.ingest(union, window_arrays[w])
+        _, union_report, _tables = roll(union)
+        from netobserv_tpu.exporter.tpu_sketch import report_to_json
+        oracle_heads = {(e["SrcAddr"], e["SrcPort"]) for e in
+                        report_to_json(
+                            union_report)["HeavyHitters"][:100]}
+        out["range_recall_at_100"] = round(
+            len(heads & oracle_heads) / max(len(oracle_heads), 1), 4)
+        out["range_compacted"] = bool(snap["range"]["compacted"])
+        print(f"archive: write amp "
+              f"{out['write_amplification']}x, raw seg "
+              f"{out['segment_bytes_raw']}B vs compacted "
+              f"{out['segment_bytes_compacted']}B, recall@100 "
+              f"{out['range_recall_at_100']}", file=sys.stderr)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def topk_ablation_stats() -> dict:
     """`--topk-only` / `make bench-topk` (also folded into
     `--device-only`): the persistent-slot heavy-hitter plane vs the legacy
@@ -1520,6 +1649,17 @@ def main():
         # occupancy/promotions, recall@100 — the non-gating CI artifact
         # for the self-adjusting sketch memory plane
         out = tiered_ablation_stats()
+        if _DEVICE_NOTE:
+            out["device"] = _DEVICE_NOTE
+        out["device_provenance"] = device_provenance(cpu_requested)
+        print(json.dumps(out))
+        return
+    if "--archive-only" in sys.argv:
+        # `make bench-archive` (~60s, CPU-friendly): the sketch warehouse
+        # — per-window write amplification, raw-vs-compacted segment
+        # bytes, range-merge rate per ladder k, range recall vs the union
+        # oracle — the non-gating CI artifact for the archive plane
+        out = archive_stats()
         if _DEVICE_NOTE:
             out["device"] = _DEVICE_NOTE
         out["device_provenance"] = device_provenance(cpu_requested)

@@ -1,0 +1,127 @@
+"""Sketch warehouse: durable, queryable history of closed sketch windows.
+
+The query plane answers "what is happening"; this package answers "what
+happened" — over any archived time range, with honest Count-Min error
+bars, across restarts. Three pieces (docs/architecture.md "Sketch
+warehouse"):
+
+- `segment.py`  — the on-disk snapshot format (TABLE_SPEC tensors through
+  the SHARED per-tensor codec, endian-independent, golden-pinned);
+- `store.py`    — append-only directory with hierarchical RRD-style
+  retention (raw windows compact into super-windows; disk stays bounded,
+  old history survives coarser);
+- `query.py`    — the warmed device merge ladder behind
+  ``/query/range`` / ``/federation/range`` and the compactor.
+
+`SketchArchive` below is the plane's one facade: the tpu-sketch exporter
+(and the federation aggregator, for cluster-wide history) writes each
+closed window through it on the timer thread — off the exporter lock,
+behind the ``sketch.archive_write`` fault point — and mounts its
+`route_payload` on the query surface. ``ARCHIVE_DIR`` unset means NO
+archive object exists anywhere: one is-None check on the publish path,
+bit-identical to the pre-archive exporter (the established zero-cost
+bar).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from netobserv_tpu.archive import segment as aseg
+from netobserv_tpu.archive.query import ArchiveQueryEngine
+from netobserv_tpu.archive.store import ArchiveStore
+
+log = logging.getLogger("netobserv_tpu.archive")
+
+__all__ = ["ArchiveQueryEngine", "ArchiveStore", "SketchArchive",
+           "maybe_archive"]
+
+
+class SketchArchive:
+    """Writer + compactor + range-query surface over one archive dir.
+
+    `warm=True` (the production entry passes it; direct construction
+    defaults off — the superbatch-ladder rule) compiles the merge ladder
+    on a background daemon thread so the first compaction or range query
+    never stalls the timer/HTTP thread on a cold compile."""
+
+    def __init__(self, store: ArchiveStore, sketch_cfg, metrics=None,
+                 agent_id: str = "", ladder_max: int = 16,
+                 report_kwargs: Optional[dict] = None,
+                 warm: bool = False):
+        self._store = store
+        self._agent_id = agent_id
+        self.engine = ArchiveQueryEngine(store, sketch_cfg,
+                                         metrics=metrics,
+                                         ladder_max=ladder_max,
+                                         report_kwargs=report_kwargs)
+        if warm:
+            import threading
+
+            def _warm() -> None:
+                try:
+                    self.engine.warm()
+                except Exception as exc:  # best-effort, never fatal
+                    log.warning("archive merge-ladder warm failed "
+                                "(entries compile on first use): %s", exc)
+
+            threading.Thread(target=_warm, name="archive-ladder-warm",
+                             daemon=True).start()
+
+    def write_window(self, host_tables: dict, window: int,
+                     ts_ms: int) -> None:
+        """Land one closed window's table snapshot as a raw (level-0)
+        segment, then run retention: every due compaction group merges
+        through the ladder executables and the top level ages out. Timer
+        thread only; callers hold HOST copies (never live donated
+        buffers)."""
+        seg_bytes = aseg.encode_segment(
+            host_tables, agent_id=self._agent_id, level=0,
+            window_from=int(window), window_to=int(window), n_windows=1,
+            ts_ms=int(ts_ms), dims=self.engine.dims)
+        with self.engine.lock:
+            self._store.append(seg_bytes, 0, int(window), int(window))
+        # bounded: each pass strictly shrinks some level, so the loop
+        # terminates; steady state runs at most one compaction per window
+        while self.engine.compact_once():
+            pass
+        with self.engine.lock:
+            self._store.enforce_top_level_retention()
+
+    def route_payload(self, params: dict,
+                      view: Optional[str] = None) -> tuple[int, dict]:
+        return self.engine.route_payload(params, view)
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+
+def maybe_archive(cfg, sketch_cfg, metrics=None,
+                  agent_id: str = "") -> Optional[SketchArchive]:
+    """The ARCHIVE_DIR switch: None (unset) keeps the publish path
+    bit-identical to the pre-archive exporter — no store, no engine, one
+    is-None check at the call site. The report thresholds wire from the
+    SAME AgentConfig fields the live renderer uses (one threshold
+    truth)."""
+    if not getattr(cfg, "archive_dir", ""):
+        return None
+    store = ArchiveStore(cfg.archive_dir,
+                         raw_windows=cfg.archive_raw_windows,
+                         compact_group=cfg.archive_compact_group,
+                         max_levels=cfg.archive_max_levels,
+                         metrics=metrics)
+    return SketchArchive(
+        store, sketch_cfg, metrics=metrics,
+        agent_id=agent_id or cfg.federation_agent_id,
+        ladder_max=cfg.archive_merge_ladder_max, warm=True,
+        report_kwargs=dict(
+            scan_fanout_threshold=cfg.sketch_scan_fanout,
+            ddos_z_threshold=cfg.sketch_ddos_z,
+            synflood_min=cfg.sketch_synflood_min,
+            synflood_ratio=cfg.sketch_synflood_ratio,
+            drop_z_threshold=cfg.sketch_drop_z,
+            asym_min_bytes=cfg.sketch_asym_min_bytes,
+            asym_ratio=cfg.sketch_asym_ratio,
+            churn_ascent=cfg.sketch_churn_ascent,
+            churn_min_bytes=cfg.sketch_churn_min_bytes))

@@ -493,6 +493,31 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     #: recent-transitions ring capacity (the /query/alerts "recent" list)
     alert_ring: int = field(default=256, **_env("ALERT_RING", "256"))
 
+    # --- sketch warehouse (archive/; new) ---
+    #: on-disk window archive directory ("" = no archive — the publish
+    #: path is bit-identical to the pre-archive exporter). Set on a
+    #: tpu-sketch agent (per-agent history) or on the federation
+    #: aggregator (cluster-wide history); both mount /…/range over it
+    archive_dir: str = field(default="", **_env("ARCHIVE_DIR"))
+    #: RAW (per-window) segments kept per retention level before the
+    #: oldest ARCHIVE_COMPACT_GROUP of them compact one level up
+    archive_raw_windows: int = field(
+        default=64, **_env("ARCHIVE_RAW_WINDOWS", "64"))
+    #: segments merged per compaction (the RRD coarsening factor G):
+    #: level-N super-windows each cover G^N raw windows
+    archive_compact_group: int = field(
+        default=8, **_env("ARCHIVE_COMPACT_GROUP", "8"))
+    #: retention levels above raw; the top level deletes its oldest
+    #: beyond the cap, bounding disk at
+    #: (levels+1) * (ARCHIVE_RAW_WINDOWS + G - 1) segments
+    archive_max_levels: int = field(
+        default=3, **_env("ARCHIVE_MAX_LEVELS", "3"))
+    #: largest single-dispatch merge size of the range-query ladder
+    #: (power of two; one pre-built jit per power of two up to it —
+    #: wider ranges chain dispatches)
+    archive_merge_ladder_max: int = field(
+        default=16, **_env("ARCHIVE_MERGE_LADDER_MAX", "16"))
+
     # --- sketch federation plane (federation/; new) ---
     #: "host:port" of the central aggregator's Federation gRPC endpoint;
     #: set on per-host agents to stream one delta frame per closed window
@@ -676,6 +701,22 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
             from netobserv_tpu.alerts.sinks import build_sinks
             parse_rules(self.alert_rules)
             build_sinks(self)
+        if self.archive_compact_group < 2:
+            raise ValueError("ARCHIVE_COMPACT_GROUP must be >= 2 (it is "
+                             "the RRD coarsening factor)")
+        if self.archive_raw_windows < self.archive_compact_group:
+            raise ValueError(
+                f"ARCHIVE_RAW_WINDOWS ({self.archive_raw_windows}) must "
+                f"be >= ARCHIVE_COMPACT_GROUP "
+                f"({self.archive_compact_group})")
+        if self.archive_max_levels < 1:
+            raise ValueError("ARCHIVE_MAX_LEVELS must be >= 1")
+        v = self.archive_merge_ladder_max
+        if v < 1 or v & (v - 1) or v > 64:
+            raise ValueError(
+                f"ARCHIVE_MERGE_LADDER_MAX must be a power of two in "
+                f"[1, 64] (got {v}) — every power of two up to it costs "
+                "a pre-built merge executable")
         if self.federation_mode not in ("", "aggregator"):
             raise ValueError(
                 f"FEDERATION_MODE={self.federation_mode!r} "

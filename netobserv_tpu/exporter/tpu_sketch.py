@@ -382,6 +382,7 @@ class TpuSketchExporter(Exporter):
                  overlap_depth: int = 0,
                  query_history: int = 0,
                  alerts=None,
+                 archive=None,
                  churn_ascent: float = DEFAULT_CHURN_ASCENT,
                  churn_min_bytes: float = DEFAULT_CHURN_MIN_BYTES):
         # superbatch defaults to NO ladder for direct construction: the
@@ -658,11 +659,25 @@ class TpuSketchExporter(Exporter):
         # None (ALERT_RULES unset) keeps the publish path bit-identical:
         # one is-None check, no engine object (the zero-cost bar).
         self._alerts = alerts
+        # sketch warehouse (netobserv_tpu/archive): each closed window's
+        # table snapshot lands as an on-disk segment at publish time
+        # (timer thread, own try, sketch.archive_write fault point) and
+        # /query/range merges archived segments on demand. None
+        # (ARCHIVE_DIR unset) keeps the publish path bit-identical: no
+        # store, no engine, one is-None check (the zero-cost bar).
+        if archive is not None and not self._with_tables:
+            # width-sharded meshes have no whole-width table snapshot to
+            # archive (the same contract that disables the delta export)
+            log.warning("sketch archive needs a data-axis-only mesh; "
+                        "disabling it on this exporter")
+            archive = None
+        self._archive = archive
         self.query_routes = QueryRoutes(self.query.get, self.query_status,
                                         metrics=metrics,
                                         history_fn=self.query.get_window,
                                         windows_fn=self.query.windows,
-                                        alerts=alerts)
+                                        alerts=alerts,
+                                        archive=archive)
         if metrics is not None:
             metrics.query_snapshot_age_seconds.set_function(self.query.age_s)
         self._query_refresh_s = query_refresh_s
@@ -872,6 +887,7 @@ class TpuSketchExporter(Exporter):
     @classmethod
     def from_config(cls, cfg, metrics=None, sink=None):
         from netobserv_tpu.alerts import maybe_engine
+        from netobserv_tpu.archive import maybe_archive
         from netobserv_tpu.sketch.state import SketchConfig
         if sink is None:
             sink = make_report_sink(cfg)
@@ -881,9 +897,32 @@ class TpuSketchExporter(Exporter):
             host, _, port = cfg.federation_target.rpartition(":")
             delta_sink = FederationDeltaSink(host or "127.0.0.1", int(port),
                                              metrics=metrics)
+        sketch_cfg = SketchConfig.from_agent_config(cfg)
+        archive = None
+        if cfg.archive_dir:
+            # width-sharded meshes ("DxS", S > 1) have no whole-width
+            # table snapshot to archive — decide from the SHAPE STRING
+            # alone (touching jax.devices() here would race the
+            # distributed init the constructor performs) and skip the
+            # store construction entirely: opening a store scans, heals
+            # and rewrites the manifest, side effects a discarded
+            # feature must not have
+            from netobserv_tpu.parallel import MeshSpec
+            try:
+                width_sharded = MeshSpec.parse(
+                    cfg.sketch_mesh_shape, 1).sketch > 1
+            except ValueError:
+                width_sharded = False  # the ctor raises the real error
+            if width_sharded:
+                log.warning("ARCHIVE_DIR set on a width-sharded mesh "
+                            "(SKETCH_MESH_SHAPE=%s): no whole-width "
+                            "table snapshot exists — archive disabled",
+                            cfg.sketch_mesh_shape)
+            else:
+                archive = maybe_archive(cfg, sketch_cfg, metrics=metrics)
         return cls(delta_sink=delta_sink, agent_id=cfg.federation_agent_id,
                    batch_size=cfg.sketch_batch_size, window_s=cfg.sketch_window,
-                   sketch_cfg=SketchConfig.from_agent_config(cfg),
+                   sketch_cfg=sketch_cfg,
                    mesh_shape=cfg.sketch_mesh_shape, metrics=metrics, sink=sink,
                    checkpoint_dir=cfg.sketch_checkpoint_dir,
                    checkpoint_every=cfg.sketch_checkpoint_every,
@@ -906,6 +945,7 @@ class TpuSketchExporter(Exporter):
                    overlap_depth=cfg.sketch_overlap,
                    query_history=cfg.sketch_query_history,
                    alerts=maybe_engine(cfg, metrics),
+                   archive=archive,
                    churn_ascent=cfg.sketch_churn_ascent,
                    churn_min_bytes=cfg.sketch_churn_min_bytes,
                    warm_ladder=True,
@@ -1466,6 +1506,10 @@ class TpuSketchExporter(Exporter):
             # transition seq come from the SAME published alert view, so a
             # poller never needs a second racy /query/alerts round-trip
             st["alerts"] = self._alerts.summary()
+        if self._archive is not None:
+            # warehouse discovery: segment counts/levels/disk bytes so a
+            # poller can range-query without probing for 404s
+            st["archive"] = self._archive.stats()
         if snap is not None:
             st.update({"published": True, "seq": snap["seq"],
                        "window": snap["window"],
@@ -1624,6 +1668,29 @@ class TpuSketchExporter(Exporter):
                 self._metrics.count_error("tpu-sketch-query")
         with wtrace.stage("report_sink"):
             self._sink(obj)
+        # sketch-warehouse write LAST, in its own try: the report already
+        # reached the sink and the query snapshot already swapped in, so a
+        # failing (or wedged) archive disk loses only durability of THIS
+        # window's segment — counted, never the report. A hung write
+        # stalls only this supervised timer thread (heartbeat stops, the
+        # supervisor flips DEGRADED); ingest folds never wait here. The
+        # host copies below are the staged snapshot — the roll's table
+        # OUTPUTS, never the live donated state (the federation
+        # checkpoint staging rule).
+        if self._archive is not None and tables is not None:
+            try:
+                with wtrace.stage("archive_write"):
+                    faultinject.fire("sketch.archive_write")
+                    self._archive.write_window(
+                        {k: np.asarray(v) for k, v in tables.items()},
+                        window=int(obj["Window"]),
+                        ts_ms=int(obj["TimestampMs"]))
+            except Exception as exc:
+                log.error("archive segment write failed (window %s not "
+                          "archived; report already published): %s",
+                          obj["Window"], exc)
+                if self._metrics is not None:
+                    self._metrics.count_error("tpu-sketch-archive")
         if self._metrics is not None:
             if self._cfg.tiered is not None and tables is not None:
                 try:

@@ -62,7 +62,7 @@ class FederationAggregator:
                  stale_after_s: float = 120.0,
                  report_kwargs: Optional[dict] = None,
                  checkpoint_dir: str = "", checkpoint_every: int = 1,
-                 agent_ttl_s: float = 0.0, alerts=None):
+                 agent_ttl_s: float = 0.0, alerts=None, archive=None):
         from netobserv_tpu.parallel.distributed import (
             maybe_initialize_distributed,
         )
@@ -153,6 +153,12 @@ class FederationAggregator:
         # federation/query.py over query/core). None = disabled, one
         # is-None check on the publish path.
         self.alerts = alerts
+        # cluster-wide sketch warehouse (netobserv_tpu/archive): the SAME
+        # archive plane the agents mount, fed here by each MERGED window's
+        # tables at publish — /federation/range is a thin adapter over its
+        # route_payload (the federation/query.py never-fork rule). None =
+        # disabled, one is-None check on the publish path.
+        self.archive = archive
 
         # checkpoint/restore: aggregate SketchState + delivery ledger saved
         # at window roll (post-roll state, so a restore can never re-publish
@@ -650,6 +656,26 @@ class FederationAggregator:
         if self._sink is not None:
             with wtrace.stage("report_sink"):
                 self._sink(obj)
+        # cluster-wide warehouse write LAST, own try (the agent-side
+        # ordering rule): the snapshot and sink already committed, so a
+        # wedged archive disk loses only this merged window's durability —
+        # counted — and stalls only this supervised timer thread, never
+        # delta ingest. The tables here are the roll's outputs (staged by
+        # construction), and the np.asarray copies above already landed.
+        if self.archive is not None:
+            try:
+                faultinject.fire("sketch.archive_write")
+                host_tables = {name: np.asarray(tables[name])
+                               for name, _ in fdelta.TABLE_SPEC}
+                self.archive.write_window(host_tables,
+                                          window=int(obj["Window"]),
+                                          ts_ms=int(obj["TimestampMs"]))
+            except Exception as exc:
+                log.error("cluster archive write failed (window %s not "
+                          "archived; report already published): %s",
+                          obj["Window"], exc)
+                if m is not None:
+                    m.count_error("federation-archive")
 
     def _agents_view(self) -> dict:
         now = time.monotonic()
@@ -726,6 +752,8 @@ class FederationAggregator:
         if self.alerts is not None:
             # one engine-view read, same read-once rule as /query/status
             out["alerts"] = self.alerts.summary()
+        if self.archive is not None:
+            out["archive"] = self.archive.stats()
         return out
 
     def query_frequency(self, src: str, dst: str, src_port: int = 0,

@@ -34,7 +34,16 @@ from typing import Mapping, NamedTuple, Optional
 
 import numpy as np
 
-from netobserv_tpu.pb import sketch_delta_pb2 as pb
+from netobserv_tpu.utils import tensorcodec
+
+
+def _pb():
+    """Lazy protobuf import: TABLE_SPEC and the codec constants here are
+    the archive segment format's source of truth too (archive/segment.py),
+    and that consumer must import on the big-endian qemu CI tier, where no
+    protobuf package exists — only frame encode/decode need the pb."""
+    from netobserv_tpu.pb import sketch_delta_pb2
+    return sketch_delta_pb2
 
 #: bump on ANY change to TABLE_SPEC, tensor encoding, or frame semantics.
 #: v2 adds the idempotent-delivery header (window_seq / frame_uuid /
@@ -61,11 +70,14 @@ SUPPORTED_VERSIONS = (1, 2, 3)
 ACK_REASON_DUPLICATE = "window already applied"
 ACK_REASON_STALE = "stale window discarded"
 
-CODEC_RAW = 0
-CODEC_ZLIB = 1
+# the per-tensor codec is SHARED with the archive segment format
+# (utils/tensorcodec.py — one tensor format, not two drifting copies);
+# these aliases keep the wire constants importable from here
+CODEC_RAW = tensorcodec.CODEC_RAW
+CODEC_ZLIB = tensorcodec.CODEC_ZLIB
 
-_DTYPE_TO_CODE = {"<f4": 1, "<i4": 2, "<u4": 3}
-_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+_DTYPE_TO_CODE = tensorcodec.DTYPE_TO_CODE
+_CODE_TO_DTYPE = tensorcodec.CODE_TO_DTYPE
 
 #: canonical (name, little-endian dtype) of every tensor in a frame, in
 #: frame order. `sketch.state.state_tables` produces exactly these names;
@@ -193,6 +205,7 @@ def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
         raise DeltaFrameError(f"table snapshot missing tensors: {missing}")
     if not frame_uuid:
         frame_uuid = uuid.uuid4().hex
+    pb = _pb()
     if version >= 2:
         frame = pb.SketchDelta(
             version=version, agent_id=agent_id,
@@ -216,25 +229,18 @@ def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
         t.name = name
         t.dtype = _DTYPE_TO_CODE[dt]
         t.shape.extend(int(s) for s in arr.shape)
-        if codec == CODEC_ZLIB:
-            packed = zlib.compress(raw, 1)
-            if len(packed) < len(raw):
-                t.codec, t.data = CODEC_ZLIB, packed
-            else:
-                t.codec, t.data = CODEC_RAW, raw
-        elif codec == CODEC_RAW:
-            t.codec, t.data = CODEC_RAW, raw
-        else:
-            raise DeltaFrameError(f"unknown codec {codec}")
+        try:
+            t.codec, t.data = tensorcodec.encode_payload(raw, codec)
+        except tensorcodec.TensorCodecError as exc:
+            raise DeltaFrameError(str(exc)) from exc
     return frame.SerializeToString(deterministic=True)
 
 
-#: hard per-tensor size ceiling (decoded bytes). Production tables top out
-#: around cm_depth*cm_width*4 ≈ 1 MiB; this bounds what a hostile/corrupt
-#: frame can make the aggregator allocate BEFORE any shape validation —
-#: both via a declared-huge shape and via a zlib bomb (decompression is
-#: capped at the declared size, never "whatever the stream inflates to").
-MAX_TENSOR_BYTES = 1 << 27  # 128 MiB
+#: hard per-tensor size ceiling (decoded bytes) — the shared codec's
+#: bound (utils/tensorcodec.py): caps what a hostile/corrupt frame can
+#: make the aggregator allocate BEFORE any shape validation, both via a
+#: declared-huge shape and via a zlib bomb
+MAX_TENSOR_BYTES = tensorcodec.MAX_TENSOR_BYTES
 
 #: spec dtype per tensor name — decode rejects a frame whose tensor dtype
 #: disagrees (a same-shape foreign dtype would otherwise reach the
@@ -251,7 +257,7 @@ def decode_frame(data: bytes) -> DeltaFrame:
     are zero-copy read-only views over the frame bytes (copy before
     mutating). v1 frames decode with an empty delivery header (proto3
     defaults) — consumers branch on `frame.version`."""
-    frame = pb.SketchDelta()
+    frame = _pb().SketchDelta()
     try:
         frame.ParseFromString(data)
     except Exception as exc:
@@ -277,34 +283,14 @@ def decode_frame(data: bytes) -> DeltaFrame:
             raise DeltaFrameError(
                 f"tensor {t.name!r}: dtype {dt} != spec {spec_dt}")
         shape = tuple(int(s) for s in t.shape)
-        n_elems = int(np.prod(shape, dtype=np.int64)) if shape else 1
-        expected = n_elems * np.dtype(dt).itemsize
-        if not 0 <= expected <= MAX_TENSOR_BYTES:
-            raise DeltaFrameError(
-                f"tensor {t.name!r}: declared shape {shape} wants "
-                f"{expected} bytes (cap {MAX_TENSOR_BYTES})")
-        if t.codec == CODEC_ZLIB:
-            # bounded inflate: never allocate past the DECLARED size, and
-            # the stream must end exactly there (bomb/corruption guard)
-            d = zlib.decompressobj()
-            try:
-                raw = d.decompress(t.data, expected)
-            except zlib.error as exc:
-                raise DeltaFrameError(
-                    f"tensor {t.name!r}: bad zlib stream: {exc}") from exc
-            if len(raw) != expected or not d.eof or d.unconsumed_tail:
-                raise DeltaFrameError(
-                    f"tensor {t.name!r}: zlib payload inflates to "
-                    f"{len(raw)}B (eof={d.eof}), declared {expected}B")
-        elif t.codec == CODEC_RAW:
-            raw = t.data
-            if len(raw) != expected:
-                raise DeltaFrameError(
-                    f"tensor {t.name!r}: payload is {len(raw)}B, shape "
-                    f"{shape} wants {expected}B")
-        else:
-            raise DeltaFrameError(f"tensor {t.name!r}: unknown codec "
-                                  f"{t.codec}")
+        try:
+            # size-cap + bounded inflate live in the SHARED codec (the
+            # archive segment decoder runs the exact same guards)
+            expected = tensorcodec.declared_nbytes(t.name, shape, dt)
+            raw = tensorcodec.decode_payload(t.name, t.codec, t.data,
+                                             expected)
+        except tensorcodec.TensorCodecError as exc:
+            raise DeltaFrameError(str(exc)) from exc
         tables[t.name] = np.frombuffer(raw, dtype=dt).reshape(shape)
     missing = [n for n, _ in spec if n not in tables]
     if missing:

@@ -28,6 +28,12 @@ Routes (all GET, JSON):
                             merged-window snapshots; 404 when ALERT_RULES
                             is unset)
 - /federation/status        per-agent delta freshness + plane counters
+- /federation/range         cluster-wide sketch-warehouse time-range
+                            answers (?from=&to=; /federation/range/topk|
+                            frequency|cardinality|victims views) — a thin
+                            adapter over the archive plane's ONE body
+                            builder (archive/query.py); 404 when
+                            ARCHIVE_DIR is unset
 """
 
 from __future__ import annotations
@@ -65,7 +71,24 @@ class _Handler(BaseHTTPRequestHandler):
                     "/federation/topk", "/federation/frequency",
                     "/federation/churn", "/federation/cardinality",
                     "/federation/victims", "/federation/alerts",
-                    "/federation/status", "/healthz", "/readyz"]})
+                    "/federation/range", "/federation/status",
+                    "/healthz", "/readyz"]})
+                return
+            if path == "/federation/range" or \
+                    path.startswith("/federation/range/"):
+                # thin adapter over the archive plane's ONE body builder
+                # (archive/query.py route_payload — the federation/
+                # query.py never-fork rule); cluster-wide history fed by
+                # the aggregator's merged windows
+                arch = self.aggregator.archive
+                if arch is None:
+                    self._json(404, {"error": "archive disabled "
+                                              "(ARCHIVE_DIR unset)"})
+                    return
+                view = path.rpartition("/")[2] \
+                    if path.startswith("/federation/range/") else None
+                code, body = arch.route_payload(q, view)
+                self._json(code, body)
                 return
             if path == "/federation/status":
                 self._json(200, self.aggregator.status())

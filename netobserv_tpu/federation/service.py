@@ -27,6 +27,7 @@ class FederationAggregatorService:
     def __init__(self, cfg, metrics: Optional[Metrics] = None,
                  sink=None):
         from netobserv_tpu.alerts import maybe_engine
+        from netobserv_tpu.archive import maybe_archive
         from netobserv_tpu.exporter.tpu_sketch import make_report_sink
         from netobserv_tpu.sketch.state import SketchConfig
 
@@ -35,9 +36,14 @@ class FederationAggregatorService:
             prefix=cfg.metrics_prefix, level=cfg.metrics_level))
         self._status = "Starting"
         self._status_lock = threading.Lock()
+        sketch_cfg = SketchConfig.from_agent_config(cfg)
         self.aggregator = FederationAggregator(
             alerts=maybe_engine(cfg, self.metrics, source="federation"),
-            sketch_cfg=SketchConfig.from_agent_config(cfg),
+            # cluster-wide sketch warehouse (ARCHIVE_DIR on the
+            # aggregator archives each MERGED window; /federation/range)
+            archive=maybe_archive(cfg, sketch_cfg, metrics=self.metrics,
+                                  agent_id="federation"),
+            sketch_cfg=sketch_cfg,
             window_s=cfg.federation_window,
             mesh_shape=cfg.federation_mesh_shape,
             metrics=self.metrics,

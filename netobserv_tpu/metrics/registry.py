@@ -383,6 +383,27 @@ class Metrics:
             "FEDERATION_AGENT_TTL seconds without a delta (their "
             "staleness gauge series is deleted at the same time)",
             registry=self.registry)
+        # sketch warehouse (netobserv_tpu/archive): on-disk window
+        # archive + device-merged range queries
+        self.archive_segments_total = Counter(
+            p + "archive_segments_total",
+            "Archive segments written (raw closed-window segments AND "
+            "compacted super-windows)", registry=self.registry)
+        self.archive_bytes_total = Counter(
+            p + "archive_bytes_total",
+            "Bytes written into the archive directory (the warehouse's "
+            "write amplification numerator; compaction rewrites count)",
+            registry=self.registry)
+        self.archive_compactions_total = Counter(
+            p + "archive_compactions_total",
+            "Retention compactions: ARCHIVE_COMPACT_GROUP segments merged "
+            "into one coarser super-window one level up",
+            registry=self.registry)
+        self.archive_range_requests_total = Counter(
+            p + "archive_range_requests_total",
+            "Range-query requests against the archive (/query/range and "
+            "/federation/range), by result (ok / bad_request / "
+            "not_found / error)", ["result"], registry=self.registry)
         self.federation_checkpoints_total = Counter(
             p + "federation_checkpoints_total",
             "Aggregator state+ledger checkpoints at window roll, by "

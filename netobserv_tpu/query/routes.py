@@ -24,6 +24,12 @@ Routes (all GET, JSON):
                        ALERT_RULES is unset — no engine exists)
 - /query/status        snapshot freshness + plane counters
                        (incl. the back-scroll ring's window ids)
+- /query/range         sketch-warehouse time-range answers
+                       (?from=&to=; /query/range/topk|frequency|
+                       cardinality|victims views) — served by the archive
+                       plane (netobserv_tpu/archive), which merges the
+                       covering on-disk segments in one device dispatch;
+                       404 when ARCHIVE_DIR is unset (no archive exists)
 
 Back-scroll: every data route accepts ``?window=<id>`` for a
 point-in-time read of a PAST closed window, served from the publisher's
@@ -42,7 +48,7 @@ from netobserv_tpu.query import core
 log = logging.getLogger("netobserv_tpu.query")
 
 ROUTES = ("topk", "frequency", "churn", "cardinality", "victims",
-          "alerts", "status")
+          "alerts", "status", "range")
 
 
 class QueryRoutes:
@@ -56,7 +62,7 @@ class QueryRoutes:
                  status_fn: Callable[[], dict], metrics=None,
                  history_fn: Optional[Callable[[int], Optional[dict]]] = None,
                  windows_fn: Optional[Callable[[], list]] = None,
-                 alerts=None):
+                 alerts=None, archive=None):
         self._snapshot = snapshot_fn
         self._status = status_fn
         self._metrics = metrics
@@ -65,6 +71,9 @@ class QueryRoutes:
         #: the alert engine (alerts/engine.py) or None when ALERT_RULES is
         #: unset — the route then answers 404 (alerting disabled)
         self._alerts = alerts
+        #: the sketch warehouse (archive.SketchArchive) or None when
+        #: ARCHIVE_DIR is unset — /query/range then answers 404
+        self._archive = archive
 
     def index(self) -> dict:
         return {"routes": [f"/query/{r}" for r in ROUTES]}
@@ -72,7 +81,16 @@ class QueryRoutes:
     def handle(self, path: str, params: dict) -> tuple[int, dict]:
         """`path` is the URL path (e.g. "/query/topk"), `params` the parsed
         single-valued query dict. Returns (http status, JSON-able body)."""
-        route = path.rstrip("/").rpartition("/")[2] or "index"
+        parts = [p for p in path.split("/") if p]
+        # /query/range/<view> nests one level deeper than the snapshot
+        # routes: the view rides as a pseudo-param so the route counter
+        # still aggregates under "range"
+        if len(parts) >= 2 and parts[1] == "range":
+            route = "range"
+            if len(parts) > 2:
+                params = dict(params, view=parts[2])
+        else:
+            route = path.rstrip("/").rpartition("/")[2] or "index"
         try:
             code, body = self._dispatch(route, params)
         except ValueError as exc:  # malformed params (e.g. ?n=bogus)
@@ -108,6 +126,14 @@ class QueryRoutes:
                 return 404, {"error": "alerting disabled "
                                       "(ALERT_RULES unset)"}
             return self._alerts.route_payload(params.get("window"))
+        if route == "range":
+            # the sketch warehouse's time-range surface: answered entirely
+            # by the archive plane (device merge of on-disk segments —
+            # never the live snapshot, never the exporter lock)
+            if self._archive is None:
+                return 404, {"error": "archive disabled "
+                                      "(ARCHIVE_DIR unset)"}
+            return self._archive.route_payload(params)
         if params.get("window") is not None:
             wid = int(params["window"])  # malformed -> ValueError -> 400
             snap = self._history(wid) if self._history is not None else None

@@ -17,6 +17,8 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from netobserv_tpu.utils.atomicio import write_json_atomic
+
 try:
     import orbax.checkpoint as ocp
     HAVE_ORBAX = True
@@ -74,10 +76,9 @@ class SketchCheckpointer:
         stamp = {"format_version": CHECKPOINT_FORMAT_VERSION,
                  "table_spec_crc": _spec_fingerprint(),
                  "delta_format_version": fdelta.DELTA_FORMAT_VERSION}
-        tmp = self._stamp_path() + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(stamp, fh)
-        os.replace(tmp, self._stamp_path())
+        # temp + fsync + rename (utils/atomicio): a crash mid-write must
+        # never leave a torn stamp that misreads as a legacy checkpoint
+        write_json_atomic(self._stamp_path(), stamp)
 
     def read_stamp(self) -> dict:
         """The directory's format stamp; legacy (pre-stamp) checkpoints
@@ -132,10 +133,8 @@ class SketchCheckpointer:
     def save_metadata(self, step: int, meta: dict) -> None:
         """Atomically write step-paired JSON metadata (call BEFORE save());
         old sidecars beyond the manager's retention are pruned."""
-        tmp = self._meta_path(step) + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump({"step": int(step), "meta": meta}, fh)
-        os.replace(tmp, self._meta_path(step))
+        write_json_atomic(self._meta_path(step),
+                          {"step": int(step), "meta": meta})
         keep = set(self._mngr.all_steps()) | {int(step)}
         for name in os.listdir(self._dir):
             if name.startswith("META-") and name.endswith(".json"):
@@ -180,10 +179,8 @@ class SketchCheckpointer:
         return os.path.join(self._dir, "PUBLISHED.json")
 
     def save_publish_marker(self, window: int, meta: dict) -> None:
-        tmp = self._publish_marker_path() + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump({"window": int(window), "meta": meta}, fh)
-        os.replace(tmp, self._publish_marker_path())
+        write_json_atomic(self._publish_marker_path(),
+                          {"window": int(window), "meta": meta})
 
     def read_publish_marker(self) -> Optional[dict]:
         """{"window": int, "meta": {...}} of the last publish, or None

@@ -1,0 +1,142 @@
+"""Endian-independent golden vectors for the archive segment codec.
+
+NO jax: like test_federation_golden.py, this suite runs on the big-endian
+qemu-s390x CI tier, where it proves the segment's explicit little-endian
+envelope + tensor encoding survive a foreign host byte order
+byte-for-byte — an archive written on one host is readable on any other
+(restore a warehouse onto a different arch, ship segments for offline
+analysis). The golden additionally pins that the segment rides the SAME
+per-tensor codec as the delta wire (utils/tensorcodec.py): the tensor
+payload bytes inside the segment are identical to what the delta frame
+carries for the same tables.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from netobserv_tpu.archive import segment as aseg
+from netobserv_tpu.federation import delta as fdelta
+from netobserv_tpu.utils import tensorcodec
+from tests.test_federation_golden import DIMS, SHAPES, golden_tables
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "archive_segment_v1.hex")
+
+
+def encode_golden(codec=aseg.CODEC_RAW) -> bytes:
+    return aseg.encode_segment(
+        golden_tables(), agent_id="golden-agent", level=0, window_from=42,
+        window_to=42, n_windows=1, ts_ms=1_700_000_000_123, dims=DIMS,
+        codec=codec)
+
+
+def test_segment_matches_golden_bytes():
+    """Byte-for-byte on EVERY host, including big-endian: the envelope is
+    explicit '<' struct packing, the header is sorted-key JSON, and the
+    tensors are explicit little-endian dtypes."""
+    golden = bytes.fromhex(open(GOLDEN).read().strip())
+    got = encode_golden()
+    assert got == golden, (
+        "archive segment bytes drifted from the golden vector — if the "
+        "format really changed, bump SEGMENT_FORMAT_VERSION and "
+        "regenerate\n got: " + got[:64].hex() + "...\n"
+        "want: " + golden[:64].hex() + "...")
+
+
+def test_golden_bytes_decode_roundtrip():
+    golden = bytes.fromhex(open(GOLDEN).read().strip())
+    seg = aseg.decode_segment(golden)
+    assert seg.agent_id == "golden-agent"
+    assert (seg.level, seg.window_from, seg.window_to,
+            seg.n_windows) == (0, 42, 42, 1)
+    assert seg.ts_ms == 1_700_000_000_123
+    assert seg.dims == DIMS
+    want = golden_tables()
+    for name, _ in fdelta.TABLE_SPEC:
+        np.testing.assert_array_equal(seg.tables[name], want[name],
+                                      err_msg=name)
+        # decoded arrays must be native little-endian views regardless of
+        # host order (the frombuffer dtype is explicit)
+        assert seg.tables[name].dtype.str.startswith("<"), name
+
+
+def test_zlib_codec_roundtrip_host_local():
+    """zlib segments roundtrip (not golden-pinned: deflate bytes may vary
+    across zlib builds; only the RAW form is pinned byte-exact — the
+    delta-wire rule)."""
+    data = encode_golden(codec=aseg.CODEC_ZLIB)
+    seg = aseg.decode_segment(data)
+    want = golden_tables()
+    for name, _ in fdelta.TABLE_SPEC:
+        np.testing.assert_array_equal(seg.tables[name], want[name],
+                                      err_msg=name)
+
+
+def test_segment_shares_the_delta_wire_tensor_codec():
+    """One codec, not a fifth tensor format: the RAW tensor payload bytes
+    inside the segment equal the RAW delta frame's for the same tables
+    (both go through tensorcodec.encode_payload byte-for-byte)."""
+    want = golden_tables()
+    for name, dt in fdelta.TABLE_SPEC:
+        raw = np.ascontiguousarray(want[name], dtype=dt).tobytes()
+        code, payload = tensorcodec.encode_payload(raw,
+                                                   tensorcodec.CODEC_RAW)
+        assert code == tensorcodec.CODEC_RAW
+        golden = bytes.fromhex(open(GOLDEN).read().strip())
+        assert payload in golden, name  # the segment carries these bytes
+
+
+def test_reject_bad_magic_version_and_truncation():
+    golden = bytes.fromhex(open(GOLDEN).read().strip())
+    with pytest.raises(aseg.ArchiveSegmentError, match="magic"):
+        aseg.decode_segment(b"WRONGMAG" + golden[8:])
+    bad_ver = golden[:8] + b"\x63\x00\x00\x00" + golden[12:]
+    with pytest.raises(aseg.ArchiveSegmentError, match="version"):
+        aseg.decode_segment(bad_ver)
+    with pytest.raises(aseg.ArchiveSegmentError, match="truncated"):
+        aseg.decode_segment(golden[:-5])
+    with pytest.raises(aseg.ArchiveSegmentError, match="trailing"):
+        aseg.decode_segment(golden + b"\x00")
+
+
+def test_reject_table_spec_drift():
+    """A segment stamped with a foreign TABLE_SPEC fingerprint must refuse
+    to decode (the checkpoint-stamp rule: never restore silently
+    misaligned tables)."""
+    import json
+    import struct
+    golden = bytes.fromhex(open(GOLDEN).read().strip())
+    hdr_len = struct.unpack("<I", golden[12:16])[0]
+    header = json.loads(golden[16:16 + hdr_len])
+    header["table_crc"] = 12345
+    new_hdr = json.dumps(header, sort_keys=True,
+                         separators=(",", ":")).encode()
+    forged = golden[:12] + struct.pack("<I", len(new_hdr)) + new_hdr \
+        + golden[16 + hdr_len:]
+    with pytest.raises(aseg.ArchiveSegmentError, match="crc"):
+        aseg.decode_segment(forged)
+
+
+def test_reject_oversized_and_bomb_payloads():
+    """The shared codec's caps hold through the segment surface too: a
+    declared-huge shape rejects before allocation, and a zlib payload
+    that inflates past its declaration rejects."""
+    import zlib
+    with pytest.raises(tensorcodec.TensorCodecError, match="cap"):
+        tensorcodec.declared_nbytes("cm_bytes", (1 << 30, 1 << 10), "<f4")
+    bomb = zlib.compress(b"\x00" * 4096, 1)
+    with pytest.raises(tensorcodec.TensorCodecError, match="inflates"):
+        tensorcodec.decode_payload("cm_bytes", tensorcodec.CODEC_ZLIB,
+                                   bomb, 16)
+
+
+def test_shapes_cover_current_table_spec():
+    """The golden's synthetic shape table must cover the CURRENT spec — a
+    TABLE_SPEC change without regenerating this golden fails loudly here
+    rather than with a KeyError inside the encoder."""
+    assert set(SHAPES) == {n for n, _ in fdelta.TABLE_SPEC}
+    assert aseg.SEGMENT_FORMAT_VERSION == 1

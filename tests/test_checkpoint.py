@@ -189,3 +189,51 @@ def test_incompatible_checkpoint_degrades_to_fresh_window(tmp_path):
     exp.flush()  # a fresh window works; the agent never crashed
     exp.close()
     assert reports and reports[0]["Records"] == 0.0
+
+
+def test_truncated_sidecars_degrade_never_poison_restore(tmp_path):
+    """Torn sidecar robustness (the atomicio discipline's other half): a
+    crash can no longer TEAR a sidecar mid-write — temp + fsync + rename
+    — but a reader must also survive one torn by older builds or a dying
+    disk. Every truncated sidecar must read as ABSENT (legacy stamp /
+    empty ledger / no fast-forward), never poison the tensor restore."""
+    import os
+
+    import pytest
+
+    d = str(tmp_path / "ck")
+    s = sk.init_state(CFG)
+    ckpt = SketchCheckpointer(d)
+    ckpt.save_metadata(3, {"ledger": {"a": {"epoch": 1}}})
+    ckpt.save(3, s, wait=True)
+    ckpt.save_publish_marker(3, {"ledger": {}})
+
+    # the atomic writer leaves NO temp droppings on the happy path
+    assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
+
+    # truncate every sidecar mid-JSON (what a torn write looks like)
+    for name in ("FORMAT.json", "META-3.json", "PUBLISHED.json"):
+        path = os.path.join(d, name)
+        with open(path, "r+b") as fh:
+            fh.truncate(max(1, os.path.getsize(path) // 2))
+
+    ckpt2 = SketchCheckpointer(d)
+    # torn FORMAT.json reads as the legacy (pre-stamp) era — an upgrade
+    # path exists, so restore proceeds instead of crashing
+    assert ckpt2.read_stamp()["format_version"] == 1
+    assert ckpt2.check_format() == 1
+    # torn META/PUBLISHED read as absent: empty ledger, no fast-forward
+    assert ckpt2.read_metadata(3) is None
+    assert ckpt2.read_publish_marker() is None
+    restored = ckpt2.restore(s)
+    np.testing.assert_array_equal(np.asarray(restored.cm_bytes.counts),
+                                  np.asarray(s.cm_bytes.counts))
+    # a fresh save repairs every sidecar atomically
+    ckpt2.save_metadata(4, {"ledger": {}})
+    ckpt2.save(4, s, wait=True)
+    ckpt2.save_publish_marker(4, {})
+    assert ckpt2.read_stamp()["format_version"] > 1
+    assert ckpt2.read_metadata(4) == {"ledger": {}}
+    assert ckpt2.read_publish_marker()["window"] == 4
+    ckpt2.close()
+    ckpt.close()

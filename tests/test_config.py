@@ -164,3 +164,28 @@ def test_narrow_cm_width_warns(caplog):
     with caplog.at_level(logging.WARNING, "netobserv_tpu.config"):
         ok.validate()
     assert not caplog.records
+
+
+def test_validate_archive_knobs():
+    """ARCHIVE_* validation: the coarsening group must be a real group,
+    raw retention must hold at least one group, the ladder must be a
+    power of two (each entry costs a pre-built merge executable) — each
+    with an error naming the offending knob."""
+    base = {"EXPORT": "stdout", "ARCHIVE_DIR": "/tmp/arch"}
+    cfg.load_config(environ=base).validate()  # defaults validate
+    cases = [
+        ({"ARCHIVE_COMPACT_GROUP": "1"}, "ARCHIVE_COMPACT_GROUP"),
+        ({"ARCHIVE_RAW_WINDOWS": "2", "ARCHIVE_COMPACT_GROUP": "4"},
+         "ARCHIVE_RAW_WINDOWS"),
+        ({"ARCHIVE_MAX_LEVELS": "0"}, "ARCHIVE_MAX_LEVELS"),
+        ({"ARCHIVE_MERGE_LADDER_MAX": "3"}, "ARCHIVE_MERGE_LADDER_MAX"),
+        ({"ARCHIVE_MERGE_LADDER_MAX": "128"}, "ARCHIVE_MERGE_LADDER_MAX"),
+    ]
+    for env, needle in cases:
+        with pytest.raises(ValueError, match=needle):
+            cfg.load_config(environ={**base, **env}).validate()
+    # the knobs validate even with ARCHIVE_DIR unset (no surprise
+    # failures later if the operator turns the archive on)
+    with pytest.raises(ValueError, match="ARCHIVE_COMPACT_GROUP"):
+        cfg.load_config(environ={"EXPORT": "stdout",
+                                 "ARCHIVE_COMPACT_GROUP": "1"}).validate()
