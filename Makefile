@@ -45,6 +45,14 @@ bench-device:
 bench-evict:
 	JAX_PLATFORMS=cpu $(PY) bench.py --evict-only
 
+# fused one-call host pipeline (~10s, jax-free path): fp_drain_to_resident
+# vs the python island chain on identical injected drains — per-stage
+# drain/merge/join/pack split + GIL-interference probe — the non-gating
+# CI artifact for the native eviction pipeline (docs/architecture.md
+# "Eviction plane")
+bench-native:
+	JAX_PLATFORMS=cpu $(PY) bench.py --native-only
+
 # persistent-slot top-K ablation (~60s, CPU-friendly): slot-table vs the
 # legacy concat+re-score update — cost (CM-only arm attributes the
 # table's share) and top-N recall vs exact truth at 10k/100k distinct

@@ -1278,6 +1278,128 @@ def evict_stats(flow_counts=(10_000, 100_000), n_cpus: int = 8,
     return out
 
 
+def host_native_pipeline_stats(seconds: float = 3.0, n_cpus: int = 8,
+                               n_flows: int = 50_000) -> dict:
+    """`make bench-native`: the fused one-call host pipeline
+    (flowpack.fp_drain_to_resident, EVICT_NATIVE_PIPELINE) vs the python
+    island chain it replaces (merge_percpu_batch per map ->
+    decode_eviction), on identical injected drain buffers — no kernel in
+    the loop, so the A/B isolates exactly what fusing buys: no
+    per-island python glue, no repeated GIL round trips, worker lanes
+    that stay native across the whole chain. Reports the fused call's
+    per-stage split (drain/merge/join/pack — the
+    host_native_pipeline_seconds histogram's offline twin) and a
+    GIL-interference probe: a background pure-python spinner's loop rate
+    while each path runs, vs idle — the chain holds the GIL between its
+    native islands, the fused call releases it once for the whole
+    chain."""
+    import threading
+
+    from netobserv_tpu.datapath import flowpack, loader
+    from netobserv_tpu.model import binfmt
+
+    flowpack.build_native()
+    if not flowpack.native_available():
+        return {"host_native_pipeline": {"available": False}}
+    rng = np.random.default_rng(23)
+    agg_keys, stats, features = _evict_synth(n_flows, n_cpus, rng)
+    n_rec = n_flows + sum(len(k) for k, _ in features.values())
+    lanes = max(1, min(8, os.cpu_count() or 1))
+
+    maps = [(-1, "stats", binfmt.FLOW_STATS_DTYPE.itemsize, 1, n_flows)]
+    data = [(agg_keys, stats)]
+    for attr, (fk, fv) in features.items():
+        maps.append((-1, attr, fv.dtype.itemsize, n_cpus, n_flows))
+        data.append((fk, fv))
+    pipe = flowpack.NativePipe(maps, lanes=lanes)
+    for i, (k, v) in enumerate(data):
+        pipe.set_drained(i, k, v)
+
+    # the island chain, fed fresh views each round exactly like
+    # evict_stats (the batch drain hands buffers over per drain)
+    kraw, sraw = agg_keys.tobytes(), stats.tobytes()
+    fraw = {attr: (fk.tobytes(), fv.tobytes(), fv.shape, fv.dtype)
+            for attr, (fk, fv) in features.items()}
+
+    def run_chain():
+        ak = np.frombuffer(kraw, np.uint8).reshape(n_flows, 40)
+        av = np.frombuffer(sraw, dtype=stats.dtype).reshape(n_flows, 1)
+        dr = {attr: (np.frombuffer(kb, np.uint8).reshape(-1, 40),
+                     np.frombuffer(vb, dtype=dt).reshape(shape))
+              for attr, (kb, vb, shape, dt) in fraw.items()}
+        return loader.decode_eviction(ak, av, dr)
+
+    # GIL-interference probe: pure-python spins/sec while a path runs
+    class _Spinner:
+        def __init__(self):
+            self.count = 0
+            self.stop = threading.Event()
+
+        def run(self):
+            while not self.stop.is_set():
+                self.count += 1
+
+    def measure(fn, secs):
+        spin = _Spinner()
+        th = threading.Thread(target=spin.run, daemon=True)
+        th.start()
+        reps, last = 0, None
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < secs:
+            last = fn()
+            reps += 1
+        dt = time.perf_counter() - t0
+        spin.stop.set()
+        th.join()
+        return reps * n_rec / dt, spin.count / dt, last
+
+    run_chain()  # warm both paths (numpy internals, pipe scratch)
+    pipe.drain()
+    idle = _Spinner()
+    th = threading.Thread(target=idle.run, daemon=True)
+    th.start()
+    time.sleep(min(1.0, seconds / 3))
+    idle.stop.set()
+    th.join()
+    idle_rate = idle.count / min(1.0, seconds / 3)
+
+    chain_rate, chain_spin, _ = measure(run_chain, seconds / 2)
+    fused_rate, fused_spin, _ = measure(pipe.drain, seconds / 2)
+
+    # one pack-enabled drain for the full four-stage split (the A/B loop
+    # runs drain+merge+join, the chain's directly comparable span; the
+    # python chain packs through the same native pack_resident at fold
+    # time, so the pack stage has no slower twin to race)
+    kd = flowpack.KeyDict(slot_cap=1 << 18)
+    caps = flowpack.ResidentCaps(dns=256, drop=256, nk=256, spill=32)
+    res = pipe.drain(pack={"batch_size": 1024, "batch_per_region": 1024,
+                           "slot_cap": kd.slot_cap, "caps": caps,
+                           "ladder": [(1, [kd._live_handle()])]})
+    stage_ms = {"drain": res.drain_s, "merge": res.merge_s,
+                "join": res.join_s, "pack": res.pack_s}
+    res.free()
+    kd.close()
+    out = {
+        "fused_records_per_sec": round(fused_rate),
+        "chain_records_per_sec": round(chain_rate),
+        "fused_vs_chain_speedup": round(fused_rate / chain_rate, 2),
+        "stage_ms": {k: round(v * 1e3, 3) for k, v in stage_ms.items()},
+        "lanes": lanes, "n_cpus": n_cpus, "records_per_drain": n_rec,
+        # 1.0 = the concurrent python thread ran at full speed (path
+        # held the GIL ~never); the chain's lower share IS the wait the
+        # fused call deletes
+        "gil_free_share_chain": round(chain_spin / max(idle_rate, 1), 3),
+        "gil_free_share_fused": round(fused_spin / max(idle_rate, 1), 3),
+    }
+    pipe.close()
+    print(f"native pipeline: fused {fused_rate / 1e6:.2f}M rec/s vs chain "
+          f"{chain_rate / 1e6:.2f}M rec/s "
+          f"({fused_rate / chain_rate:.2f}x), gil-free share "
+          f"{out['gil_free_share_fused']:.2f} vs "
+          f"{out['gil_free_share_chain']:.2f}", file=sys.stderr)
+    return {"host_native_pipeline": out}
+
+
 def roll_stall_stats(run_s: float = 3.2, sink_block_s: float = 0.5) -> dict:
     """Fold latency ACROSS a window roll vs steady state, with a sink that
     blocks `sink_block_s` per report — the non-blocking-roll evidence: the
@@ -1704,6 +1826,20 @@ def main():
         out["device_provenance"] = device_provenance(cpu_requested)
         print(json.dumps(out))
         return
+    if "--native-only" in sys.argv:
+        # `make bench-native` (~10s): fused fp_drain_to_resident vs the
+        # python island chain on identical injected drains — the
+        # non-gating CI artifact for the one-call host pipeline
+        stats = host_native_pipeline_stats(seconds=6.0)
+        native = stats["host_native_pipeline"]
+        out = {"metric": "native_pipeline_speedup",
+               "value": native.get("fused_vs_chain_speedup", 0.0),
+               "unit": "x", **stats}
+        if _DEVICE_NOTE:
+            out["device"] = _DEVICE_NOTE
+        out["device_provenance"] = device_provenance(cpu_requested)
+        print(json.dumps(out))
+        return
     if "--host-only" in sys.argv:
         # `make bench-host` (~25s): host path + fused evict→fold stream +
         # roll stall, no device ingest loop or CPU oracle — the per-PR CI
@@ -1711,6 +1847,7 @@ def main():
         host = host_path_stats(seconds=4.0)
         host.update(fused_stream_stats())
         host.update(roll_stall_stats())
+        host.update(host_native_pipeline_stats())
         out = {"metric": "host_path_records_per_sec",
                "value": host["host_path_sustained"], "unit": "records/s",
                # self-describing artifact: the traced/untraced A/B

@@ -113,6 +113,18 @@ class FlowsAgent:
             ssl_correlator=self.ssl_correlator,
             map_capacity=map_capacity,
             pressure_watermark=cfg.map_pressure_watermark)
+        # fused native pipeline (EVICT_NATIVE_PIPELINE): when both ends
+        # speak it — a bpfman fetcher with the gate on and a sketch
+        # exporter whose resident ring can accept pre-packed regions —
+        # bind the exporter's pack surface so fused drains also run the
+        # resident pack natively. Either side missing leaves the fetcher
+        # on its drain+merge+join fusion (still one native call).
+        bind = getattr(fetcher, "bind_pack_surface", None)
+        surface_of = getattr(exporter, "resident_pack_surface", None)
+        if bind is not None and surface_of is not None:
+            surface = surface_of()
+            if surface is not None:
+                bind(surface)
         self.limiter = CapacityLimiter(
             self._evicted_q, self._export_q, metrics=self.metrics)
         self.terminal = QueueExporter(

@@ -185,6 +185,18 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     #: an explicit N beyond the feature-map count turns the surplus into
     #: per-map merge row-shards (big-map relief)
     evict_drain_lanes: int = field(default=0, **_env("EVICT_DRAIN_LANES", "0"))
+    #: fuse the whole per-drain host chain — batched bpf(2) drain, per-CPU
+    #: merge, key-alignment join — into ONE GIL-releasing native call
+    #: (flowpack fp_drain_to_resident) so drain lanes scale with cores
+    #: instead of re-entering the interpreter between native islands.
+    #: SCHEDULING ONLY: unset is bit-identical to the island chain (one
+    #: is-None check); enabled output is equivalence-pinned against it
+    #: (tests/test_native_pipeline.py). Requires the native library at the
+    #: current ABI and kernel batch-op support — both probed on the first
+    #: drain (which always runs the python chain), degrading silently to
+    #: the island chain when either is missing
+    evict_native_pipeline: bool = field(
+        default=False, **_env("EVICT_NATIVE_PIPELINE", "false"))
     direction: str = field(default="both", **_env("DIRECTION", "both"))
     sampling: int = field(default=0, **_env("SAMPLING", "0"))
     enable_flows_ringbuf_fallback: bool = field(

@@ -49,6 +49,11 @@ class EvictedFlows:
         self.nevents = nevents
         self.quic = quic
         self.decode_stats: Optional[dict] = None
+        #: fused-pipeline extra (loader.PackedEviction): resident regions
+        #: pre-packed at drain time. The raw arrays above are ALWAYS the
+        #: full eviction regardless — a consumer that can't ship the packed
+        #: arena (epoch moved, no surface) frees it and folds these.
+        self.packed = None
 
     def __len__(self) -> int:
         return len(self.events)

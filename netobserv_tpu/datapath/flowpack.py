@@ -44,7 +44,13 @@ class _Columns(ctypes.Structure):
 _lib: Optional[ctypes.CDLL] = None
 
 
-_ABI_VERSION = 9
+_ABI_VERSION = 10
+
+#: count of library loads rejected for ABI/symbol mismatch (stale `make
+#: native` build) — the agent degrades to the numpy/python twin chain
+#: instead of dying at import; MapTracer syncs this into the registry's
+#: flowpack_abi_fallback_total once per process.
+abi_fallbacks = 0
 
 #: dense TPU-feed row width (words); layout documented in flowpack.cc
 DENSE_WORDS = 20
@@ -180,14 +186,27 @@ class KeyDict:
 
 
 def _find_lib() -> Optional[ctypes.CDLL]:
+    global abi_fallbacks
     for path in _LIB_PATHS:
         if os.path.exists(path):
-            lib = ctypes.CDLL(path)
-            if lib.fp_abi_version() == _ABI_VERSION:
+            # a stale .so (wrong ABI, or so old it predates fp_abi_version)
+            # must degrade to the python twin chain, never raise at import
+            try:
+                lib = ctypes.CDLL(path)
+                ver = int(lib.fp_abi_version())
+            except (OSError, AttributeError) as exc:
+                abi_fallbacks += 1
+                log.warning("flowpack library unusable at %s (%s) — falling "
+                            "back to the python chain; rebuild with "
+                            "`make native`", path, exc)
+                continue
+            if ver == _ABI_VERSION:
                 lib.fp_crc32c.restype = ctypes.c_uint32
                 return lib
-            log.warning("flowpack ABI mismatch at %s (rebuild with "
-                        "`make native`)", path)
+            abi_fallbacks += 1
+            log.warning("flowpack ABI mismatch at %s (built %d, need %d) — "
+                        "falling back to the python chain; rebuild with "
+                        "`make native`", path, ver, _ABI_VERSION)
     return None
 
 
@@ -198,21 +217,29 @@ def crc32c(data: bytes) -> Optional[int]:
     return int(_lib.fp_crc32c(data, ctypes.c_size_t(len(data))))
 
 
-def build_native(force: bool = False) -> bool:
-    """Compile libflowpack.so with g++ (no cmake configure round trip)."""
-    out = _LIB_PATHS[0]
+def build_native(force: bool = False, out: Optional[str] = None,
+                 abi: Optional[int] = None) -> bool:
+    """Compile libflowpack.so with g++ (no cmake configure round trip).
+    The ABI version is stamped into the .so at compile time
+    (-DFP_ABI_VERSION) so the loader's mismatch fallback is a build
+    property, not a source edit; `abi`/`out` let tests build a deliberately
+    stale library somewhere harmless."""
+    want_abi = _ABI_VERSION if abi is None else abi
+    out = _LIB_PATHS[0] if out is None else out
     os.makedirs(os.path.dirname(out), exist_ok=True)
     if os.path.exists(out) and not force:
-        # a stale build from an older ABI must be rebuilt, not kept
+        # a stale build from another ABI must be rebuilt, not kept
         try:
-            if ctypes.CDLL(out).fp_abi_version() == _ABI_VERSION:
+            if ctypes.CDLL(out).fp_abi_version() == want_abi:
                 return True
         except (OSError, AttributeError):
             pass
     src = os.path.join(_NATIVE_DIR, "flowpack.cc")
     try:
         subprocess.run(
-            ["g++", "-O2", "-Wall", "-shared", "-fPIC", src, "-o", out],
+            ["g++", "-O3", "-fno-exceptions", "-Wall", "-Werror", "-pthread",
+             f"-DFP_ABI_VERSION={want_abi}", "-shared", "-fPIC",
+             src, "-o", out],
             check=True, capture_output=True, text=True)
         return True
     except (OSError, subprocess.CalledProcessError) as exc:
@@ -831,3 +858,245 @@ def events_from_keys_stats(keys: np.ndarray, stats: np.ndarray,
         _lib.fp_events_from_keys_stats(
             _ptr(keys), _ptr(stats), ctypes.c_size_t(n), _ptr(out))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Fused one-call eviction pipeline (flowpack.cc fp_drain_to_resident).
+# SCHEDULING ONLY: the native call chains the very same batched drain,
+# fp_merge_*_batch, _join_keys-twin join and fp_pack_resident the Python
+# chain orchestrates — never a fifth merge form, never a fourth resident
+# layout. The Python chain stays the equivalence oracle
+# (tests/test_native_pipeline.py pins the fused output bit-exact).
+# ---------------------------------------------------------------------------
+
+#: map kind ids of the fused pipeline (flowpack.cc FPK_*); map 0 of a pipe
+#: must be "stats" (the aggregation map, rows used verbatim)
+PIPE_KINDS = {"stats": 0, "extra": 1, "dns": 2, "drops": 3,
+              "nevents": 4, "xlat": 5, "quic": 6}
+
+#: record dtype per pipe kind (the aligned-feature view dtypes)
+PIPE_DTYPES = {
+    "stats": binfmt.FLOW_STATS_DTYPE, "extra": binfmt.EXTRA_REC_DTYPE,
+    "dns": binfmt.DNS_REC_DTYPE, "drops": binfmt.DROPS_REC_DTYPE,
+    "nevents": binfmt.NEVENTS_REC_DTYPE, "xlat": binfmt.XLAT_REC_DTYPE,
+    "quic": binfmt.QUIC_REC_DTYPE,
+}
+
+_PIPE_MAX_MAPS = 8
+_PIPE_MAX_LADDER = 8
+
+
+class _PipeMapCfg(ctypes.Structure):
+    _fields_ = [("fd", ctypes.c_int32), ("kind", ctypes.c_uint32),
+                ("value_size", ctypes.c_uint32), ("n_cpus", ctypes.c_uint32),
+                ("max_entries", ctypes.c_uint32)]
+
+
+class _PipeLadder(ctypes.Structure):
+    _fields_ = [("k", ctypes.c_uint32), ("nr", ctypes.c_uint32),
+                ("dicts", ctypes.POINTER(ctypes.c_uint64))]
+
+
+class _PipePackCfg(ctypes.Structure):
+    _fields_ = [("n_ladder", ctypes.c_uint32), ("batch_size", ctypes.c_uint32),
+                ("batch_per_region", ctypes.c_uint32),
+                ("slot_cap", ctypes.c_uint32), ("dns_cap", ctypes.c_uint32),
+                ("drop_cap", ctypes.c_uint32), ("nk_cap", ctypes.c_uint32),
+                ("spill_cap", ctypes.c_uint32),
+                ("ladder", _PipeLadder * _PIPE_MAX_LADDER)]
+
+
+class _PipeChunk(ctypes.Structure):
+    _fields_ = [("row_start", ctypes.c_uint64), ("rows", ctypes.c_uint64),
+                ("arena_off", ctypes.c_uint64), ("k", ctypes.c_uint32),
+                ("n_segs", ctypes.c_uint32), ("spills", ctypes.c_uint32),
+                ("resets", ctypes.c_uint32)]
+
+
+class _PipeResult(ctypes.Structure):
+    _fields_ = [("n_events", ctypes.c_uint64), ("n_agg", ctypes.c_uint64),
+                ("n_orphans", ctypes.c_uint64),
+                ("packed_rows", ctypes.c_uint64),
+                ("drain_ns", ctypes.c_uint64), ("merge_ns", ctypes.c_uint64),
+                ("join_ns", ctypes.c_uint64), ("pack_ns", ctypes.c_uint64),
+                ("syscalls", ctypes.c_uint64),
+                ("lex_fallback", ctypes.c_uint64),
+                ("batch_err_mask", ctypes.c_uint64),
+                ("n_chunks", ctypes.c_uint64),
+                ("arena_words", ctypes.c_uint64),
+                ("spill_rows", ctypes.c_uint64),
+                ("dict_resets", ctypes.c_uint64), ("segs", ctypes.c_uint64),
+                ("events", ctypes.c_void_p), ("arena", ctypes.c_void_p),
+                ("chunks", ctypes.c_void_p),
+                ("aligned", ctypes.c_void_p * _PIPE_MAX_MAPS),
+                ("map_rows", ctypes.c_uint64 * _PIPE_MAX_MAPS)]
+
+
+def _pipe_view(addr: Optional[int], nbytes: int, dtype) -> Optional[np.ndarray]:
+    if not addr or nbytes == 0:
+        return None
+    buf = (ctypes.c_uint8 * nbytes).from_address(addr)
+    return np.frombuffer(buf, dtype=dtype)
+
+
+class PipeChunk:
+    """One pack chunk of a fused drain — mirrors one outer iteration of
+    ShardedResidentStagingRing._fold_chunk (k-ladder selection, continuation
+    segments). The caller ships arena[arena_off : arena_off + n_segs *
+    (nr(k) * region_words)] as n_segs ring-slot images."""
+
+    __slots__ = ("row_start", "rows", "arena_off", "k", "n_segs", "spills",
+                 "resets")
+
+    def __init__(self, c: "_PipeChunk"):
+        self.row_start = int(c.row_start)
+        self.rows = int(c.rows)
+        self.arena_off = int(c.arena_off)
+        self.k = int(c.k)
+        self.n_segs = int(c.n_segs)
+        self.spills = int(c.spills)
+        self.resets = int(c.resets)
+
+
+class PipeResult:
+    """Outputs of one fused drain. `events`/`aligned[kind]` are zero-copy
+    VIEWS of pipe-handle scratch — valid only until the pipe's next drain
+    (the drain_batched_arrays cached-buffer rule; the one copy happens at
+    the EvictedFlows boundary). The packed `arena` is owned by THIS object:
+    call free() (or let __del__ catch it) after the regions are shipped."""
+
+    __slots__ = ("n_events", "n_agg", "n_orphans", "packed_rows", "drain_s",
+                 "merge_s", "join_s", "pack_s", "syscalls", "lex_fallback",
+                 "batch_err_mask", "map_rows", "events", "aligned", "arena",
+                 "chunks", "spill_rows", "dict_resets", "segs", "_arena_ptr")
+
+    def __init__(self, res: _PipeResult, kinds: list):
+        self.n_events = int(res.n_events)
+        self.n_agg = int(res.n_agg)
+        self.n_orphans = int(res.n_orphans)
+        self.packed_rows = int(res.packed_rows)
+        self.drain_s = res.drain_ns * 1e-9
+        self.merge_s = res.merge_ns * 1e-9
+        self.join_s = res.join_ns * 1e-9
+        self.pack_s = res.pack_ns * 1e-9
+        self.syscalls = int(res.syscalls)
+        self.lex_fallback = int(res.lex_fallback)
+        self.batch_err_mask = int(res.batch_err_mask)
+        self.spill_rows = int(res.spill_rows)
+        self.dict_resets = int(res.dict_resets)
+        self.segs = int(res.segs)
+        self.map_rows = [int(res.map_rows[i]) for i in range(len(kinds))]
+        self.events = _pipe_view(
+            res.events, self.n_events * binfmt.FLOW_EVENT_DTYPE.itemsize,
+            binfmt.FLOW_EVENT_DTYPE)
+        self.aligned = {}
+        for i, kind in enumerate(kinds):
+            if i == 0:
+                continue  # the stats map composes into events, not aligned
+            dt = PIPE_DTYPES[kind]
+            self.aligned[kind] = _pipe_view(
+                res.aligned[i], self.n_events * dt.itemsize, dt)
+        self._arena_ptr = res.arena or 0
+        self.arena = _pipe_view(self._arena_ptr,
+                                int(res.arena_words) * 4, np.uint32)
+        self.chunks = []
+        if res.n_chunks and res.chunks:
+            carr = (_PipeChunk * int(res.n_chunks)).from_address(res.chunks)
+            self.chunks = [PipeChunk(c) for c in carr]
+
+    def free(self) -> None:
+        if self._arena_ptr:
+            _lib.fp_buf_free(ctypes.c_void_p(self._arena_ptr))
+            self._arena_ptr = 0
+            self.arena = None
+
+    def __del__(self):  # best-effort; free() is the real API
+        try:
+            self.free()
+        except Exception:
+            pass
+
+
+class NativePipe:
+    """Handle on one fp_drain_to_resident pipeline over a fixed set of maps.
+    `maps` is [(fd, kind, value_size, n_cpus, max_entries)] with map 0 the
+    aggregation map (kind "stats", n_cpus 1); fd < 0 makes a map injected
+    (set_drained) for tests and bench. `lanes` fans the per-map drain+merge
+    over that many native worker threads (GIL released for the whole call)."""
+
+    def __init__(self, maps: list, lanes: int = 1):
+        if not native_available():
+            raise RuntimeError("native flowpack library unavailable")
+        if not maps or len(maps) > _PIPE_MAX_MAPS:
+            raise ValueError(f"1..{_PIPE_MAX_MAPS} maps required")
+        self.kinds = [m[1] for m in maps]
+        cfgs = (_PipeMapCfg * len(maps))()
+        for i, (fd, kind, value_size, n_cpus, max_entries) in enumerate(maps):
+            cfgs[i] = _PipeMapCfg(fd=fd, kind=PIPE_KINDS[kind],
+                                  value_size=value_size, n_cpus=n_cpus,
+                                  max_entries=max_entries)
+        _lib.fp_pipe_new.restype = ctypes.c_void_p
+        _lib.fp_drain_to_resident.restype = ctypes.c_int64
+        _lib.fp_pipe_set_drained.restype = ctypes.c_int
+        self._handle = _lib.fp_pipe_new(cfgs, ctypes.c_uint32(len(maps)),
+                                        ctypes.c_uint32(max(lanes, 1)))
+        if not self._handle:
+            raise ValueError("fp_pipe_new rejected the map configuration")
+
+    def set_drained(self, idx: int, keys: np.ndarray,
+                    vals: np.ndarray) -> None:
+        """Inject one drain's (keys, vals) for an fd<0 map: keys (n, 40) u8,
+        vals the kernel layout (n rows x n_cpus images, contiguous)."""
+        keys = np.ascontiguousarray(keys)
+        vals = np.ascontiguousarray(vals)
+        n = len(keys)
+        rc = _lib.fp_pipe_set_drained(
+            ctypes.c_void_p(self._handle), ctypes.c_uint32(idx),
+            _ptr(keys), _ptr(vals), ctypes.c_uint32(n))
+        if rc != 0:
+            raise ValueError(f"fp_pipe_set_drained({idx}) failed")
+
+    def drain(self, pack: Optional[dict] = None) -> PipeResult:
+        """Run the fused chain. `pack` (None = drain/merge/join only) is
+        {"batch_size", "batch_per_region", "slot_cap", "caps": ResidentCaps,
+        "ladder": [(k, [dict handles])]} with ladder ks ascending, k=1
+        first, handles from KeyDict._live_handle() in the ring's per-region
+        dictionary order."""
+        res = _PipeResult()
+        keepalive = []
+        pk_ref = None
+        if pack is not None:
+            caps = pack["caps"]
+            ladder = pack["ladder"]
+            if len(ladder) > _PIPE_MAX_LADDER:
+                raise ValueError("ladder too deep")
+            pk = _PipePackCfg(
+                n_ladder=len(ladder), batch_size=pack["batch_size"],
+                batch_per_region=pack["batch_per_region"],
+                slot_cap=pack["slot_cap"], dns_cap=caps.dns,
+                drop_cap=caps.drop, nk_cap=caps.nk, spill_cap=caps.spill)
+            for li, (k, handles) in enumerate(ladder):
+                arr = (ctypes.c_uint64 * len(handles))(*handles)
+                keepalive.append(arr)
+                pk.ladder[li] = _PipeLadder(
+                    k=k, nr=len(handles),
+                    dicts=ctypes.cast(arr, ctypes.POINTER(ctypes.c_uint64)))
+            pk_ref = ctypes.byref(pk)
+            keepalive.append(pk)
+        rc = int(_lib.fp_drain_to_resident(
+            ctypes.c_void_p(self._handle), pk_ref, ctypes.byref(res)))
+        del keepalive
+        if rc < 0:
+            raise RuntimeError(f"fp_drain_to_resident failed (rc={rc})")
+        return PipeResult(res, self.kinds)
+
+    def close(self) -> None:
+        if self._handle:
+            _lib.fp_pipe_free(ctypes.c_void_p(self._handle))
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

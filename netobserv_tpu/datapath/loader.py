@@ -281,6 +281,166 @@ def decode_eviction(agg_keys: np.ndarray, agg_vals: np.ndarray,
     return evicted
 
 
+class PackedEviction:
+    """Pre-packed resident regions riding an EvictedFlows (the fused
+    pipeline packed at drain time with the exporter ring's own
+    dictionaries). `arena` is owned by this object (free()); `chunks` is
+    the pack plan (flowpack.PipeChunk). `epoch` is the pack-surface epoch
+    at pack time — the exporter ships the arena only while the epoch still
+    matches (ship order must equal dict-mutation order; see
+    staging.ResidentPackSurface), otherwise it frees the arena and folds
+    the EvictedFlows' raw arrays instead."""
+
+    __slots__ = ("arena", "chunks", "epoch", "spill_rows", "dict_resets",
+                 "segs", "_res")
+
+    def __init__(self, res: "flowpack.PipeResult", epoch: int):
+        self.arena = res.arena
+        self.chunks = res.chunks
+        self.epoch = epoch
+        self.spill_rows = res.spill_rows
+        self.dict_resets = res.dict_resets
+        self.segs = res.segs
+        self._res = res
+
+    def free(self) -> None:
+        self._res.free()
+        self.arena = None
+
+
+class NativeEvictPipeline:
+    """EVICT_NATIVE_PIPELINE gate: run the whole per-drain host chain as
+    ONE GIL-releasing native call (flowpack.fp_drain_to_resident) —
+    batched bpf(2) drain, per-CPU merge, key join, and (with a bound pack
+    surface) the resident-region pack. SCHEDULING ONLY: output is
+    equivalence-pinned against the island chain
+    (tests/test_native_pipeline.py pins it bit-exact).
+
+    Engagement rules: the FIRST drain always runs the python chain — it
+    probes kernel batch-op support (syscall_bpf latches `_no_batch_ops`)
+    and warms the eviction path; the pipe builds on drain #2 only when
+    every map kept batch support, the native library is at the current
+    ABI, and the kernel reported map capacities. Any disqualifier (or a
+    mid-flight batch error, recorded in batch_err_mask) disables the
+    pipeline permanently for this process and the island chain carries
+    on — enabled-but-degraded must never crash or stall a drain."""
+
+    def __init__(self, fetcher: "BpfmanFetcher", lanes: int):
+        self._fetcher = fetcher
+        self._lanes = max(1, lanes)
+        self._pipe: Optional[flowpack.NativePipe] = None
+        self._surface = None
+        self._drains = 0
+        self.disabled = False
+
+    def bind_pack_surface(self, surface) -> None:
+        """Attach the exporter ring's ResidentPackSurface — fused drains
+        then also pack, handing the exporter pre-built regions."""
+        self._surface = surface
+
+    def _disable(self, why: str) -> None:
+        self.disabled = True
+        log.warning("native evict pipeline disabled: %s (island chain "
+                    "carries on)", why)
+
+    def _build(self) -> bool:
+        f = self._fetcher
+        if not flowpack.native_available():
+            self._disable("flowpack library unavailable or ABI-stale")
+            return False
+        if not f._features:
+            self._disable("no feature maps")
+            return False
+        maps = [(f._agg.fd, "stats", binfmt.FLOW_STATS_DTYPE.itemsize, 1,
+                 int(getattr(f._agg, "max_entries", 0) or 0))]
+        for attr, (fmap, dtype) in f._features.items():
+            maps.append((fmap.fd, attr, dtype.itemsize, fmap.n_cpus,
+                         int(getattr(fmap, "max_entries", 0) or 0)))
+        for bmap in [f._agg] + [fm for fm, _dt in f._features.values()]:
+            if getattr(bmap, "_no_batch_ops", True):
+                self._disable("kernel lacks batch map ops")
+                return False
+        if any(m[4] <= 0 for m in maps):
+            self._disable("unknown map capacity")
+            return False
+        for attr, (fmap, dtype) in f._features.items():
+            if fmap._pad_vs != dtype.itemsize:
+                self._disable(f"{attr} value stride is kernel-padded")
+                return False
+        try:
+            self._pipe = flowpack.NativePipe(maps, lanes=self._lanes)
+        except (RuntimeError, ValueError) as exc:
+            self._disable(str(exc))
+            return False
+        log.info("native evict pipeline engaged: %d maps, %d lanes%s",
+                 len(maps), self._lanes,
+                 ", pack surface bound" if self._surface else "")
+        return True
+
+    def drain(self, trace, t0: float) -> Optional[EvictedFlows]:
+        """One fused drain; None = not engaged (caller runs the island
+        chain — which is also how batch support gets probed on drain 1)."""
+        if self.disabled:
+            return None
+        self._drains += 1
+        if self._drains == 1:
+            return None  # probe drain: python chain latches batch support
+        if self._pipe is None and not self._build():
+            return None
+        surface = self._surface
+        epoch = 0
+        try:
+            with trace.stage("decode"):
+                if surface is not None:
+                    # the surface lock spans spec + native call: the ladder
+                    # set and dictionary handles must not move, and raw-fold
+                    # invalidations must serialize against the pack
+                    with surface.lock:
+                        res = self._pipe.drain(pack=surface.pack_spec())
+                        epoch = surface.epoch
+                        if res.arena is not None and res.chunks:
+                            surface.outstanding += 1
+                else:
+                    res = self._pipe.drain()
+        except RuntimeError as exc:
+            # alloc failure or a stuck pack — rare enough to bail on
+            self._disable(str(exc))
+            return None
+        if res.batch_err_mask:
+            # a map's batch drain errored mid-flight; banked rounds are in
+            # this result (their entries are deleted) — consume it, then
+            # hand future drains back to the python chain
+            self._disable(f"batch drain error mask {res.batch_err_mask:#x}")
+        # the one copy: EvictedFlows owns fresh arrays (res views alias
+        # pipe scratch reused by the next drain)
+        if res.events is not None:
+            events = res.events.copy()
+        else:
+            events = np.zeros(0, binfmt.FLOW_EVENT_DTYPE)
+        feats = {kind: (a.copy() if a is not None else None)
+                 for kind, a in res.aligned.items()}
+        evicted = EvictedFlows(events, **feats)
+        evicted.decode_stats = {
+            "merge_s": res.merge_s,      # summed lane CPU (the lanes rule)
+            "align_s": res.join_s,
+            "fallback_rows": res.n_orphans,
+            "decode_s": res.drain_s + res.merge_s + res.join_s,
+            "drain_lanes": self._lanes,
+            "seconds": time.perf_counter() - t0,
+            "native_path": "fused",
+            "native": {"drain_s": res.drain_s, "merge_s": res.merge_s,
+                       "join_s": res.join_s, "pack_s": res.pack_s},
+        }
+        if res.arena is not None and res.chunks:
+            evicted.packed = PackedEviction(res, epoch)
+        return evicted
+
+    def close(self) -> None:
+        if self._pipe is not None:
+            self._pipe.close()
+            self._pipe = None
+
+
 #: sanity ceiling on explicit EVICT_DRAIN_LANES (pool threads + merge
 #: row-shards per map are both derived from it)
 _MAX_DRAIN_LANES = 16
@@ -320,8 +480,12 @@ class BpfmanFetcher:
     redrained while its views are still being aligned."""
 
     needs_iface_discovery = False  # program lifecycle is externally managed
+    # class-level default so partially-constructed fetchers (subclasses
+    # mid-__init__, test stubs) read an absent gate, never AttributeError
+    _native_gate: Optional["NativeEvictPipeline"] = None
 
-    def __init__(self, bpf_fs_path: str, drain_lanes: int = 0):
+    def __init__(self, bpf_fs_path: str, drain_lanes: int = 0,
+                 native_pipeline: bool = False):
         self._n_cpus = syscall_bpf.n_possible_cpus()
         self._base = bpf_fs_path
 
@@ -366,6 +530,8 @@ class BpfmanFetcher:
         except (OSError, ValueError):
             log.debug("pinned ssl_events ringbuf absent")
         self._init_drain_lanes(drain_lanes)
+        if native_pipeline and self._features:
+            self._native_gate = NativeEvictPipeline(self, self._drain_lanes)
 
     def _init_drain_lanes(self, drain_lanes: int) -> None:
         """Provision the drain-lane pool (shared by the subclassed
@@ -375,6 +541,9 @@ class BpfmanFetcher:
         self._drain_lanes = resolve_drain_lanes(drain_lanes,
                                                 len(self._features))
         self._drain_pool = None
+        # EVICT_NATIVE_PIPELINE gate (bpfman mode only; unset = one
+        # is-None check on the drain path)
+        self._native_gate: Optional[NativeEvictPipeline] = None
         if self._drain_lanes > 1:
             from concurrent.futures import ThreadPoolExecutor
             # the pool never needs more workers than maps — lanes beyond
@@ -389,7 +558,15 @@ class BpfmanFetcher:
     @classmethod
     def load(cls, cfg: AgentConfig) -> "BpfmanFetcher":
         return cls(cfg.bpfman_bpf_fs_path,
-                   drain_lanes=cfg.evict_drain_lanes)
+                   drain_lanes=cfg.evict_drain_lanes,
+                   native_pipeline=cfg.evict_native_pipeline)
+
+    def bind_pack_surface(self, surface) -> None:
+        """Exporter hook: with EVICT_NATIVE_PIPELINE engaged, fused drains
+        also pack resident regions with the exporter ring's dictionaries
+        (staging.ResidentPackSurface). No-op when the gate is off."""
+        if self._native_gate is not None:
+            self._native_gate.bind_pack_surface(surface)
 
     def map_capacity(self) -> int:
         """max_entries of the kernel aggregation map — the denominator of
@@ -407,8 +584,16 @@ class BpfmanFetcher:
         # drain, never per record; unsampled drains get the null trace).
         trace = tracing.active_trace()
         t0 = time.perf_counter()
+        if self._native_gate is not None:
+            evicted = self._native_gate.drain(trace, t0)
+            if evicted is not None:
+                return evicted
+            # probe drain or disqualified: island chain carries this one
         if self._drain_pool is not None and self._features:
-            return self._lookup_and_delete_lanes(trace, t0)
+            evicted = self._lookup_and_delete_lanes(trace, t0)
+            if self._native_gate is not None:
+                evicted.decode_stats["native_path"] = "chain"
+            return evicted
         with trace.stage("decode"):
             agg_keys, agg_vals = _drain_map_arrays(
                 self._agg, binfmt.FLOW_STATS_DTYPE)
@@ -419,6 +604,8 @@ class BpfmanFetcher:
         evicted.decode_stats["decode_s"] = t1 - t0
         evicted.decode_stats["drain_lanes"] = 1
         evicted.decode_stats["seconds"] = time.perf_counter() - t0
+        if self._native_gate is not None:
+            evicted.decode_stats["native_path"] = "chain"
         return evicted
 
     def _lookup_and_delete_lanes(self, trace, t0: float) -> EvictedFlows:
@@ -587,6 +774,9 @@ class BpfmanFetcher:
         if getattr(self, "_drain_pool", None) is not None:
             self._drain_pool.shutdown(wait=True)
             self._drain_pool = None
+        if getattr(self, "_native_gate", None) is not None:
+            self._native_gate.close()
+            self._native_gate = None
         self._agg.close()
         for fmap, _ in self._features.values():
             fmap.close()
@@ -807,6 +997,7 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                  enable_ringbuf_fallback: bool = True,
                  ringbuf_bytes: int = 1 << 17,
                  drain_lanes: int = 0,
+                 native_pipeline: bool = False,
                  # maps.h DEF_RINGBUF(ssl_events, 1<<27): 16KB * 1000/s * 5s
                  ssl_ring_bytes: int = 1 << 27):
         self._init_empty_maps()
@@ -821,6 +1012,9 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                 enable_openssl, libssl_path, enable_ringbuf_fallback,
                 ringbuf_bytes, ssl_ring_bytes)
             self._init_drain_lanes(drain_lanes)
+            if native_pipeline and self._features:
+                self._native_gate = NativeEvictPipeline(self,
+                                                        self._drain_lanes)
         except Exception:
             # a half-provisioned fetcher must not leak map/prog fds (a
             # supervisor retrying construction would exhaust fds)
@@ -1011,7 +1205,8 @@ class MinimalKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
                    enable_openssl=cfg.enable_openssl_tracking,
                    libssl_path=cfg.openssl_path,
                    enable_ringbuf_fallback=cfg.enable_flows_ringbuf_fallback,
-                   drain_lanes=cfg.evict_drain_lanes)
+                   drain_lanes=cfg.evict_drain_lanes,
+                   native_pipeline=cfg.evict_native_pipeline)
 
     def _attach_tracepoint(self, prog_bytes: bytes, category: str,
                            name: str, prog_name: bytes) -> None:
@@ -1304,6 +1499,9 @@ class LibbpfKernelFetcher(_SelfManagedAttach, BpfmanFetcher):
         try:
             self._provision_object(cfg, obj_path)
             self._init_drain_lanes(cfg.evict_drain_lanes)
+            if cfg.evict_native_pipeline and self._features:
+                self._native_gate = NativeEvictPipeline(self,
+                                                        self._drain_lanes)
         except Exception:
             self.close()
             raise

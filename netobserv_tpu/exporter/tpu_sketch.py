@@ -745,6 +745,10 @@ class TpuSketchExporter(Exporter):
         self._handoff = None
         self._inflight_rows = 0  # rows put but not yet picked up
         self._inflight_lock = threading.Lock()
+        # fused-pipeline pack surface (EVICT_NATIVE_PIPELINE): built on
+        # demand by resident_pack_surface(); None keeps every fold path
+        # bit-identical (one is-None check)
+        self._pack_surface: Optional[staging.ResidentPackSurface] = None
         self.fold_heartbeat = lambda: None
         self._fold_thread: Optional[threading.Thread] = None
         if overlap_depth > 0:
@@ -962,6 +966,83 @@ class TpuSketchExporter(Exporter):
         """Controller state for the health surface (None when disabled)."""
         return None if self._overload is None else self._overload.snapshot()
 
+    def resident_pack_surface(self) -> Optional[staging.ResidentPackSurface]:
+        """The pack surface for the fused native drain pipeline
+        (EVICT_NATIVE_PIPELINE): lets `fp_drain_to_resident` pack resident
+        regions at drain time with THIS ring's dictionaries. None when the
+        feed can't accept pre-packed regions — non-resident/single-lane
+        feeds, no native library, or admission control enabled (the
+        controller thins rows AFTER drain; a pre-packed arena can't be
+        thinned, so fused drains would bypass shedding)."""
+        if self._pack_surface is not None:
+            return self._pack_surface
+        ring = self._ring
+        if not isinstance(ring, staging.ShardedResidentStagingRing):
+            return None
+        if self._overload is not None:
+            return None
+        if not flowpack.native_available():
+            return None
+        self._pack_surface = staging.ResidentPackSurface(ring)
+        return self._pack_surface
+
+    def _fold_packed_locked(self, packed, trace) -> bool:
+        """Ship a fused-pipeline arena (caller holds the exporter lock).
+        True = shipped (the eviction's raw rows are represented; don't
+        buffer them). False = discarded (stale epoch / no surface): the
+        caller folds the raw arrays instead — an EvictedFlows ALWAYS
+        carries them regardless of packing."""
+        surface = self._pack_surface
+        if surface is None or self._overload is not None:
+            packed.free()
+            return False
+        with surface.lock:
+            if packed.epoch != surface.epoch:
+                # an invalidation already re-zeroed `outstanding` and reset
+                # the dictionaries; this arena's slot references are stale
+                packed.free()
+                return False
+            surface.outstanding -= 1
+        t0 = time.perf_counter()
+        n = packed.segs  # row count rides the raw arrays; segs for logs
+        owned = trace is None
+        if owned:
+            trace = tracing.start_trace("fold")
+        try:
+            with trace.stage("fold"):
+                faultinject.fire("sketch.ingest")
+                self._state = self._ring.fold_packed(self._state, packed,
+                                                     trace=trace)
+        except staging.StagingWedged as exc:
+            # same adoption rule as _fold_events — dispatched segments
+            # donated the state; and the surface must invalidate (this
+            # arena's remaining slot definitions are dropping)
+            if exc.state is not None:
+                self._state = exc.state
+            surface.invalidate()
+            log.error("staging slot-wait budget exceeded mid packed fold "
+                      "(%d segments): %s", n, exc)
+            if self._metrics is not None:
+                self._metrics.sketch_ingest_errors_total.inc()
+                self._metrics.count_error("tpu-sketch-ingest")
+            packed.free()
+            return True  # rows up to the wedge shipped; never double-fold
+        except Exception as exc:
+            self._count_ingest_error(n, exc)  # rolls the surface epoch too
+            packed.free()
+            return True
+        finally:
+            if owned:
+                trace.finish()
+            if self._overload is not None:
+                self._busy_fold_s += time.perf_counter() - t0
+        packed.free()
+        if self._metrics is not None:
+            self._metrics.sketch_batches_total.inc()
+            self._metrics.sketch_ingest_seconds.observe(
+                time.perf_counter() - t0)
+        return True
+
     # --- Exporter interface ---
     def export_batch(self, records: list[Record]) -> None:
         with self._lock:
@@ -1014,6 +1095,21 @@ class TpuSketchExporter(Exporter):
         unbiased."""
         trace = getattr(evicted, "trace", None)
         with self._lock:
+            packed = getattr(evicted, "packed", None)
+            if packed is not None:
+                # fused-pipeline arena riding the eviction: ship it in
+                # place of the raw arrays (bit-exact the same fold —
+                # tests/test_native_pipeline.py); a stale epoch falls
+                # through to the raw path below
+                evicted.packed = None
+                if self._fold_packed_locked(packed, trace):
+                    if trace is not None:
+                        trace.finish()
+                    if self._metrics is not None:
+                        self._metrics.sketch_records_total.inc(len(evicted))
+                    if time.monotonic() >= self._window_deadline:
+                        self._close_window_locked()
+                    return
             ctl = self._overload
             if ctl is not None:
                 # busy = fold seconds per wall second since the previous
@@ -1096,6 +1192,13 @@ class TpuSketchExporter(Exporter):
         try:
             with trace.stage("fold"):
                 faultinject.fire("sketch.ingest")
+                if self._pack_surface is not None:
+                    # ship order must equal dict-mutation order: this raw
+                    # fold's pack mutates the dictionaries NOW, so any
+                    # fused arena still outstanding (packed earlier, not
+                    # yet shipped) must not ship afterwards — no-op when
+                    # none are outstanding (staging.ResidentPackSurface)
+                    self._pack_surface.invalidate_for_raw_fold()
                 self._state = self._ring.fold(self._state, events,
                                               trace=trace, **feats)
         except staging.StagingWedged as exc:
@@ -1152,6 +1255,11 @@ class TpuSketchExporter(Exporter):
             if self._metrics is not None:
                 self._metrics.sketch_resident_dict_epochs_total.inc(
                     len(kdicts))
+        surface = getattr(self, "_pack_surface", None)
+        if surface is not None:
+            # the reset above IS an epoch roll — outstanding fused arenas
+            # were packed against the pre-reset dictionaries
+            surface.note_external_reset()
 
     def _drain_pending_locked(self) -> None:
         if self._pending:

@@ -74,6 +74,14 @@ class MapTracer:
         # buffers under a live decode; this lock is what serializes them
         self._evict_lock = threading.Lock()
         self._drain_lanes_logged = False
+        if metrics is not None:
+            # one-time sync: library-load failures happened at import,
+            # before any registry existed (the counted-fallback contract —
+            # flowpack._find_lib warns AND counts instead of raising)
+            from netobserv_tpu.datapath import flowpack
+            if flowpack.abi_fallbacks:
+                metrics.flowpack_abi_fallback_total.inc(
+                    flowpack.abi_fallbacks)
         self._thread: Optional[threading.Thread] = None
         #: supervision hook (agent/supervisor.py): the loop beats once per
         #: wakeup; the supervisor replaces this no-op at registration
@@ -190,6 +198,18 @@ class MapTracer:
                 fallback = ds.get("fallback_rows", 0)
                 if fallback:
                     self._metrics.evict_ringbuf_fallback_total.inc(fallback)
+                # fused native pipeline (EVICT_NATIVE_PIPELINE): which host
+                # path carried this drain + the fused call's per-stage split
+                path = ds.get("native_path")
+                if path:
+                    self._metrics.flowpack_native_calls_total.labels(
+                        path).inc()
+                native = ds.get("native")
+                if native is not None:
+                    for stage in ("drain", "merge", "join", "pack"):
+                        (self._metrics.host_native_pipeline_seconds
+                         .labels(stage).observe(native.get(f"{stage}_s",
+                                                           0.0)))
             self._metrics.buffer_size.labels("evicted").set(
                 self._out.qsize())
             for key, val in self._fetcher.read_global_counters().items():

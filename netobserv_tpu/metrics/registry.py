@@ -122,6 +122,25 @@ class Metrics:
             "Flows returned by one map drain (eviction batch size)",
             buckets=(0, 10, 100, 1000, 10000, 100000, 1000000),
             registry=self.registry)
+        self.flowpack_abi_fallback_total = Counter(
+            p + "flowpack_abi_fallback_total",
+            "Native flowpack library loads that failed (missing .so or "
+            "stale ABI) — the pure-python twins carried the host path; "
+            "rebuild with `make native`", registry=self.registry)
+        self.flowpack_native_calls_total = Counter(
+            p + "flowpack_native_calls_total",
+            "Eviction drains by host path while EVICT_NATIVE_PIPELINE is "
+            "enabled (fused = one fp_drain_to_resident native call; chain "
+            "= the python island chain, incl. the batch-support probe "
+            "drain)", ["path"], registry=self.registry)
+        self.host_native_pipeline_seconds = Histogram(
+            p + "host_native_pipeline_seconds",
+            "Per-stage seconds inside the fused native drain pipeline "
+            "(drain = batched bpf(2) syscalls, merge = per-CPU columnar "
+            "merge, join = key join + feature alignment, pack = resident "
+            "region pack)", ["stage"],
+            buckets=(.0001, .0005, .001, .005, .01, .05, .1, .5),
+            registry=self.registry)
         # tpu-sketch backend metrics (new)
         self.sketch_batches_total = Counter(
             p + "sketch_batches_total", "Columnar batches folded on device",

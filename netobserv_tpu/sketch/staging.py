@@ -19,6 +19,7 @@ pack+put ceiling (measured on the axon chip, see PARITY.md).
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Optional
 
@@ -679,6 +680,147 @@ class ShardedResidentStagingRing(_SlotRing):
                     state, self.key_tables, self._put(buf[:ship_words]))
             self._advance(slot, token)
         return state
+
+    def fold_packed(self, state, packed, trace=None):
+        """Ship PRE-PACKED resident regions (the fused native pipeline's
+        arena — loader's fp_drain_to_resident ran the pack stage at drain
+        time with this ring's own dictionaries). SCHEDULING ONLY: the arena
+        is bit-exact what _fold_chunk would have packed for the same rows
+        (tests/test_native_pipeline.py), so this path only replaces the
+        per-region python pack loop with one memcpy per segment; counters
+        and metrics advance exactly as _fold_chunk would have. The caller
+        (exporter) holds the ResidentPackSurface lock and has already
+        checked the pack epoch."""
+        trace, owned = self._fold_trace(trace)
+        try:
+            rw = self._region_words
+            for ch in packed.chunks:
+                nr = self.n_shards * ch.k * self.lanes
+                seg_words = nr * rw
+                for s in range(ch.n_segs):
+                    try:
+                        slot = self._wait_slot(trace)
+                    except StagingWedged as exc:
+                        # chunks already dispatched donated the caller's
+                        # state buffers (the _fold_chunk rule) — hand the
+                        # last valid state over; the surface invalidates
+                        # (pre-packed slot definitions are dropping)
+                        exc.state = state
+                        raise
+                    buf = self._bufs[slot]
+                    off = ch.arena_off + s * seg_words
+                    with trace.stage("resident_pack"):
+                        np.copyto(buf[:seg_words],
+                                  packed.arena[off:off + seg_words])
+                    self.superbatch_folds[ch.k] = (
+                        self.superbatch_folds.get(ch.k, 0) + 1)
+                    if s:
+                        self.continuations += 1
+                    if self._metrics is not None:
+                        if s:
+                            (self._metrics
+                             .sketch_resident_continuations_total.inc())
+                        self._metrics.sketch_superbatch_folds_total.labels(
+                            str(ch.k)).inc()
+                    with trace.stage("ingest_dispatch"):
+                        state, self.key_tables, token = self._ingests[ch.k](
+                            state, self.key_tables,
+                            self._put(buf[:seg_words]))
+                    self._advance(slot, token)
+                # per-chunk counters the native pack already aggregated
+                self.spill_rows += ch.spills
+                self.dict_resets += ch.resets
+                if self._metrics is not None:
+                    if ch.spills:
+                        self._metrics.sketch_resident_spill_rows_total.inc(
+                            ch.spills)
+                    if ch.resets:
+                        self._metrics.sketch_resident_dict_epochs_total.inc(
+                            ch.resets)
+            return state
+        finally:
+            if owned:
+                trace.finish()
+
+
+class ResidentPackSurface:
+    """Coordination point between the drain-side fused pack
+    (loader.NativeEvictPipeline / fp_drain_to_resident) and the ring that
+    owns the dictionaries the pack mutates.
+
+    The load-bearing invariant is SHIP ORDER = DICT-MUTATION ORDER: a
+    shipped resident buffer must contain (or follow) every slot definition
+    its hot rows reference. Fused packs mutate the dictionaries at DRAIN
+    time but ship at FOLD time; a raw fold (python pack) mutates at ship
+    time. So whenever a raw fold would run while fused-packed arenas are
+    still outstanding (packed, not yet shipped), those arenas' slot
+    definitions would ship AFTER rows referencing them — `invalidate()`
+    resolves it by bumping the epoch (outstanding arenas are discarded at
+    their fold; their raw rows refold) and resetting every ring dictionary
+    (the safe epoch-roll: each live slot is redefined through the new-key
+    lane before any hot row references it). With no outstanding arena a
+    raw fold needs no invalidation — mixed steady state stays cheap.
+
+    Lock order: the exporter lock may be held when taking `lock`; `lock`
+    holders never take the exporter lock (the drain thread holds `lock`
+    across the whole fused native call)."""
+
+    def __init__(self, ring: "ShardedResidentStagingRing"):
+        self.ring = ring
+        self.lock = threading.Lock()
+        self.epoch = 0
+        #: fused-packed arenas produced but not yet shipped or discarded
+        self.outstanding = 0
+
+    def pack_spec(self) -> dict:
+        """The ring's current pack geometry for NativePipe.drain(pack=...).
+        Call under `lock` (the available-ladder set and the dictionary
+        handles must not move between spec and pack)."""
+        ring = self.ring
+        ks = sorted(k for k in ring.ladder if k in ring._available)
+        kmax_l = ring.superbatch_max * ring.lanes
+        ladder = []
+        for k in ks:
+            kl = k * ring.lanes
+            nr = ring.n_shards * k * ring.lanes
+            ladder.append((k, [
+                ring.kdicts[(i // kl) * kmax_l + (i % kl)]._live_handle()
+                for i in range(nr)]))
+        return {"batch_size": ring.batch_size,
+                "batch_per_region": ring.batch_per_region,
+                "slot_cap": ring.slot_cap, "caps": ring.caps,
+                "ladder": ladder}
+
+    def invalidate_for_raw_fold(self) -> None:
+        """Call BEFORE any raw (non-packed) fold while this surface is
+        bound. No-op when no fused arena is outstanding."""
+        with self.lock:
+            if self.outstanding:
+                self._invalidate_locked()
+
+    def invalidate(self) -> None:
+        with self.lock:
+            self._invalidate_locked()
+
+    def note_external_reset(self) -> None:
+        """The caller already reset the ring dictionaries itself (the
+        ingest-error epoch roll) — record the epoch move so outstanding
+        fused arenas (packed against the pre-reset dictionaries) discard
+        at their fold instead of shipping stale slot references."""
+        with self.lock:
+            self.epoch += 1
+            self.outstanding = 0
+
+    def _invalidate_locked(self) -> None:
+        self.epoch += 1
+        self.outstanding = 0
+        ring = self.ring
+        for kd in ring.kdicts:
+            kd.reset()
+        ring.dict_resets += len(ring.kdicts)
+        if ring._metrics is not None:
+            ring._metrics.sketch_resident_dict_epochs_total.inc(
+                len(ring.kdicts))
 
 
 class ResidentStagingRing(_SlotRing):

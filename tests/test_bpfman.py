@@ -535,3 +535,71 @@ def test_counters_scrape_and_reset(pinned_maps):
     # reset-on-read
     assert fetcher.read_global_counters() == {}
     fetcher.close()
+
+
+def test_native_pipeline_matches_python_chain_on_real_maps(pinned_maps):
+    """EVICT_NATIVE_PIPELINE twin over REAL kernel maps: drain 1 is the
+    python-chain probe (it latches kernel batch-op support), drain 2 runs
+    the whole chain as ONE native fp_drain_to_resident call against the
+    same refilled dataset — the fused drain must agree with the chain's
+    answer and leave the kernel maps just as empty (real batched
+    lookup-and-delete syscalls, not fakes)."""
+    from netobserv_tpu.datapath import flowpack
+    from netobserv_tpu.datapath.loader import BpfmanFetcher
+
+    if not flowpack.build_native():
+        pytest.skip("native flowpack build unavailable")
+    n_cpus = sb.n_possible_cpus()
+
+    def fill():
+        for sport, nbytes in ((2001, 1000), (2002, 2000), (2003, 64),
+                              (2004, 9)):
+            # fixed timestamps: both fills must produce IDENTICAL entries
+            # so the chain drain and the fused drain answers can compare
+            stats = make_stats(nbytes, 3)
+            stats["first_seen_ns"] = 10**9 + sport
+            stats["last_seen_ns"] = 2 * 10**9 + sport
+            pinned_maps["aggregated_flows"].update(
+                make_key(sport).tobytes(), stats.tobytes())
+        partials = np.zeros(n_cpus, dtype=binfmt.EXTRA_REC_DTYPE)
+        for c in range(min(n_cpus, 4)):
+            partials[c]["rtt_ns"] = (c + 1) * 1000
+        pinned_maps["flows_extra"].update(
+            make_key(2001).tobytes(), partials.tobytes())
+        # an ORPHAN feature row (no aggregation entry): must become a
+        # standalone event on both paths
+        pinned_maps["flows_extra"].update(
+            make_key(2999).tobytes(), partials.tobytes())
+
+    def snapshot(ev):
+        out = {}
+        for i in range(len(ev)):
+            sport = int(ev.events["key"][i]["src_port"])
+            extra = (ev.extra[i].tobytes()
+                     if ev.extra is not None else None)
+            out[sport] = (ev.events["stats"][i].tobytes(), extra)
+        return out
+
+    fetcher = BpfmanFetcher(PIN_DIR, native_pipeline=True)
+    try:
+        gate = fetcher._native_gate
+        assert gate is not None
+        fill()
+        ev1 = fetcher.lookup_and_delete()  # probe: python chain
+        assert ev1.decode_stats.get("native_path") == "chain"
+        oracle = snapshot(ev1)
+        assert set(oracle) == {2001, 2002, 2003, 2004, 2999}
+        fill()
+        ev2 = fetcher.lookup_and_delete()
+        if ev2.decode_stats.get("native_path") != "fused":
+            pytest.skip("native pipeline disqualified on this kernel "
+                        "(no batch map ops)")
+        native = ev2.decode_stats["native"]
+        assert set(native) == {"drain_s", "merge_s", "join_s", "pack_s"}
+        assert snapshot(ev2) == oracle
+        assert ev2.decode_stats["fallback_rows"] == 1  # the orphan
+        # the fused drain really deleted the kernel entries
+        assert pinned_maps["aggregated_flows"].keys() == []
+        assert len(fetcher.lookup_and_delete()) == 0  # fused, empty
+    finally:
+        fetcher.close()

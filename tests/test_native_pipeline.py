@@ -1,0 +1,475 @@
+"""Fused native host pipeline (flowpack.fp_drain_to_resident, ABI 10).
+
+The tentpole contract is SCHEDULING ONLY: one GIL-releasing native call
+replaces the python island chain (drain_batched_arrays ->
+merge_percpu_batch -> _join_keys -> pack_resident) but must produce
+BIT-EXACT the same events, aligned feature arrays, and resident-region
+arena the chain would have. The python chain stays in place as the
+equivalence oracle — every test here pins native output against it:
+
+- fuzzed join/merge equivalence over random map subsets, per-CPU widths,
+  worker lane counts, orphan feature rows and empty maps;
+- engineered u64-hash collisions exercising the lex-fallback join path
+  on BOTH sides;
+- pack-stage equivalence against a _fold_chunk replica (arena bytes,
+  chunk metadata, spill/reset counters) across multi-k ladders,
+  multi-shard/lane geometries, exhausted-lane region masking, and
+  tiny-slot_cap dictionary resets;
+- the NativeEvictPipeline gate rules (probe-first-drain, disqualifiers,
+  fused decode_stats) via injected-mode maps — no kernel needed;
+- ResidentPackSurface invalidation (ship order = dict-mutation order);
+- the counted ABI-mismatch fallback (flowpack_abi_fallback_total's
+  source) using a deliberately stale library build.
+
+The live-kernel twin (real bpf(2) batch syscalls) lives in
+tests/test_bpfman.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from netobserv_tpu.datapath import flowpack, loader
+from netobserv_tpu.model import binfmt
+from netobserv_tpu.utils import tracing
+
+pytestmark = pytest.mark.skipif(not flowpack.build_native(),
+                                reason="native flowpack build unavailable")
+
+_FEATURE_NAMES = ["extra", "dns", "drops", "nevents", "xlat", "quic"]
+
+
+def _fill(vals: np.ndarray, rng) -> np.ndarray:
+    for name in vals.dtype.names:
+        f = vals[name]
+        if f.dtype.kind in "ui":
+            hi = min(1000, int(np.iinfo(f.dtype).max)) + 1
+            vals[name] = rng.integers(0, hi, size=f.shape, dtype=f.dtype)
+    if "first_seen_ns" in vals.dtype.names:
+        vals["first_seen_ns"] = rng.integers(
+            0, 1 << 40, size=vals["first_seen_ns"].shape)
+        vals["last_seen_ns"] = vals["first_seen_ns"] + 5
+    return vals
+
+
+def _synth_map(n, dtype, n_cpus, keys_pool, rng):
+    idx = rng.choice(len(keys_pool), size=n, replace=False)
+    return (np.ascontiguousarray(keys_pool[idx]),
+            _fill(np.zeros((n, n_cpus), dtype=dtype), rng))
+
+
+def _assert_equivalent(res, ev_py, drained, ctx=""):
+    assert res.n_events == len(ev_py.events), \
+        (ctx, res.n_events, len(ev_py.events))
+    assert res.events.tobytes() == ev_py.events.tobytes(), \
+        f"{ctx}: events mismatch"
+    for kind in drained:
+        a, b = res.aligned[kind], getattr(ev_py, kind)
+        assert (a is None) == (b is None), (ctx, kind)
+        if a is not None:
+            assert a.tobytes() == b.tobytes(), f"{ctx}: {kind} mismatch"
+
+
+class TestJoinMergeEquivalence:
+    """Fused drain+merge+join+align vs the python island chain."""
+
+    def test_fuzzed_equivalence(self):
+        rng = np.random.default_rng(0)
+        for trial in range(12):
+            n_pool = int(rng.integers(5, 800))
+            pool = rng.integers(0, 256, size=(n_pool, 40), dtype=np.uint8)
+            n_agg = int(rng.integers(0, n_pool + 1))
+            specs = [("stats", binfmt.FLOW_STATS_DTYPE, 1, n_agg)]
+            kept = [nm for nm in _FEATURE_NAMES if rng.random() < 0.8]
+            for nm in kept:
+                specs.append((nm, flowpack.PIPE_DTYPES[nm],
+                              int(rng.integers(1, 9)),
+                              int(rng.integers(0, n_pool + 1))))
+            maps, data = [], []
+            for kind, dt, ncpu, n in specs:
+                k, v = _synth_map(n, dt, ncpu, pool, rng)
+                maps.append((-1, kind, dt.itemsize, ncpu, max(n_pool, 1)))
+                data.append((k, v))
+            pipe = flowpack.NativePipe(maps, lanes=int(rng.integers(1, 5)))
+            try:
+                for i, (k, v) in enumerate(data):
+                    pipe.set_drained(i, k, v)
+                res = pipe.drain()
+                drained = {kind: data[i]
+                           for i, (kind, *_r) in enumerate(specs) if i > 0}
+                ev_py = loader.decode_eviction(data[0][0], data[0][1],
+                                               drained)
+                _assert_equivalent(res, ev_py, drained, ctx=f"trial {trial}")
+                # orphan accounting matches the chain's fallback_rows
+                assert res.n_orphans == \
+                    ev_py.decode_stats["fallback_rows"], trial
+            finally:
+                pipe.close()
+
+    def test_all_feature_maps_multi_cpu(self):
+        """Every feature map present at once, wide per-CPU fan-in."""
+        rng = np.random.default_rng(3)
+        pool = rng.integers(0, 256, size=(500, 40), dtype=np.uint8)
+        specs = [("stats", binfmt.FLOW_STATS_DTYPE, 1, 400)]
+        for nm in _FEATURE_NAMES:
+            specs.append((nm, flowpack.PIPE_DTYPES[nm], 8, 250))
+        maps, data = [], []
+        for kind, dt, ncpu, n in specs:
+            k, v = _synth_map(n, dt, ncpu, pool, rng)
+            maps.append((-1, kind, dt.itemsize, ncpu, 1024))
+            data.append((k, v))
+        pipe = flowpack.NativePipe(maps, lanes=4)
+        try:
+            for i, (k, v) in enumerate(data):
+                pipe.set_drained(i, k, v)
+            res = pipe.drain()
+            drained = {kind: data[i]
+                       for i, (kind, *_r) in enumerate(specs) if i > 0}
+            ev_py = loader.decode_eviction(data[0][0], data[0][1], drained)
+            _assert_equivalent(res, ev_py, drained)
+        finally:
+            pipe.close()
+
+    def test_empty_drain(self):
+        maps = [(-1, "stats", binfmt.FLOW_STATS_DTYPE.itemsize, 1, 64),
+                (-1, "extra", binfmt.EXTRA_REC_DTYPE.itemsize, 2, 64)]
+        pipe = flowpack.NativePipe(maps)
+        try:
+            res = pipe.drain()
+            assert res.n_events == 0 and res.n_orphans == 0
+        finally:
+            pipe.close()
+
+    def test_hash_collision_lex_fallback(self):
+        """Engineered 64-bit key-hash collisions must route both sides
+        through the lexicographic fallback join and still agree. The hash
+        rounds are invertible (odd multipliers mod 2^64, xorshift), so a
+        colliding-but-different key is solvable in closed form."""
+        MASK = (1 << 64) - 1
+        C = 0xC2B2AE3D27D4EB4F
+        M = 0x9E3779B97F4A7C15
+        C_INV = pow(C, -1, 1 << 64)
+        M_INV = pow(M, -1, 1 << 64)
+
+        def fwd(words):
+            h = words[0]
+            for i in range(1, 5):
+                h = ((h ^ (words[i] * C & MASK)) * M) & MASK
+                h ^= h >> 29
+            return h
+
+        def unshift29(y):
+            # invert h ^= h >> 29 (three applications converge for 64-bit)
+            x = y
+            for _ in range(3):
+                x = y ^ (x >> 29)
+            return x
+
+        def collide(target_words, prefix):
+            """Solve words[4] so hash(prefix + [w4]) == hash(target)."""
+            t = fwd(target_words)
+            h = prefix[0]
+            for i in range(1, 4):
+                h = ((h ^ (prefix[i] * C & MASK)) * M) & MASK
+                h ^= h >> 29
+            h4 = unshift29(t)
+            w4 = ((((h4 * M_INV) & MASK) ^ h) * C_INV) & MASK
+            return list(prefix) + [w4]
+
+        rng = np.random.default_rng(11)
+        a = [int(x) for x in rng.integers(0, 1 << 63, size=5)]
+        b = collide(a, [int(x) for x in rng.integers(0, 1 << 63, size=4)])
+        assert fwd(a) == fwd(b) and a != b
+        key_a = np.frombuffer(np.array(a, "<u8").tobytes(), np.uint8)
+        key_b = np.frombuffer(np.array(b, "<u8").tobytes(), np.uint8)
+        # sanity: the numpy twin agrees these collide
+        kw = np.stack([key_a, key_b]).view("<u8").reshape(2, 5)
+        hs = loader._hash_keys_u64(kw)
+        assert hs[0] == hs[1]
+        rng2 = np.random.default_rng(12)
+        filler = rng2.integers(0, 256, size=(30, 40), dtype=np.uint8)
+        agg_keys = np.ascontiguousarray(
+            np.vstack([key_a[None, :], key_b[None, :], filler]))
+        agg_vals = _fill(np.zeros((len(agg_keys), 1),
+                                  binfmt.FLOW_STATS_DTYPE), rng2)
+        # feature rows for both colliding keys (alignment must not merge
+        # them) + an ORPHAN colliding with nothing
+        ex_keys = np.ascontiguousarray(np.vstack([key_b[None, :],
+                                                  key_a[None, :],
+                                                  filler[:5]]))
+        ex_vals = _fill(np.zeros((len(ex_keys), 4),
+                                 binfmt.EXTRA_REC_DTYPE), rng2)
+        maps = [(-1, "stats", binfmt.FLOW_STATS_DTYPE.itemsize, 1, 64),
+                (-1, "extra", binfmt.EXTRA_REC_DTYPE.itemsize, 4, 64)]
+        pipe = flowpack.NativePipe(maps, lanes=2)
+        try:
+            pipe.set_drained(0, agg_keys, agg_vals)
+            pipe.set_drained(1, ex_keys, ex_vals)
+            res = pipe.drain()
+            assert res.lex_fallback > 0, "collision did not trip fallback"
+            drained = {"extra": (ex_keys, ex_vals)}
+            ev_py = loader.decode_eviction(agg_keys, agg_vals, drained)
+            _assert_equivalent(res, ev_py, drained, ctx="collision")
+        finally:
+            pipe.close()
+
+
+class TestPackEquivalence:
+    """Fused pack stage vs a replica of the staging ring's _fold_chunk
+    loop over separate oracle dictionaries: arena bytes, chunk metadata,
+    spill rows and dictionary resets all pin bit-exact."""
+
+    def _run_trial(self, rng, n_pool, batch_size, n_shards, lanes,
+                   ladder_ks, slot_cap):
+        pool = rng.integers(0, 256, size=(n_pool, 40), dtype=np.uint8)
+        n_agg = int(rng.integers(1, n_pool + 1))
+        agg_keys, agg_vals = _synth_map(n_agg, binfmt.FLOW_STATS_DTYPE, 1,
+                                        pool, rng)
+        n_ex = int(rng.integers(0, n_pool + 1))
+        ex_keys, ex_vals = _synth_map(n_ex, binfmt.EXTRA_REC_DTYPE, 4,
+                                      pool, rng)
+        maps = [(-1, "stats", binfmt.FLOW_STATS_DTYPE.itemsize, 1, n_pool),
+                (-1, "extra", binfmt.EXTRA_REC_DTYPE.itemsize, 4, n_pool)]
+        pipe = flowpack.NativePipe(maps, lanes=2)
+        pipe.set_drained(0, agg_keys, agg_vals)
+        pipe.set_drained(1, ex_keys, ex_vals)
+
+        batch_per_region = batch_size // (n_shards * lanes)
+        caps = flowpack.ResidentCaps(dns=8, drop=8,
+                                     nk=max(batch_per_region // 4, 2),
+                                     spill=2)
+        superbatch_max = max(ladder_ks)
+        n_regions = n_shards * lanes
+        kd_native = [flowpack.KeyDict(slot_cap)
+                     for _ in range(n_regions * superbatch_max)]
+        kd_oracle = [flowpack.KeyDict(slot_cap)
+                     for _ in range(n_regions * superbatch_max)]
+        kmax_l = superbatch_max * lanes
+
+        def region_dicts(k, kd):
+            # the ring mapping (staging.ResidentPackSurface.pack_spec)
+            kl = k * lanes
+            nr = n_shards * k * lanes
+            return [kd[(i // kl) * kmax_l + (i % kl)] for i in range(nr)]
+
+        ladder = [(k, [d._live_handle() for d in region_dicts(k, kd_native)])
+                  for k in sorted(set(ladder_ks))]
+        res = pipe.drain(pack={"batch_size": batch_size,
+                               "batch_per_region": batch_per_region,
+                               "slot_cap": slot_cap, "caps": caps,
+                               "ladder": ladder})
+        try:
+            # ---- oracle: python decode + _fold_chunk replica ----
+            ev = loader.decode_eviction(agg_keys, agg_vals,
+                                        {"extra": (ex_keys, ex_vals)})
+            events, extra = ev.events, ev.extra
+            rw = flowpack.resident_buf_len(batch_per_region, caps)
+            arena_parts, chunks_py = [], []
+            row, n = 0, len(events)
+            avail = sorted(set(ladder_ks))
+            while row < n:
+                remaining = n - row
+                k = max([x for x in avail if x * batch_size <= remaining],
+                        default=1)
+                take = min(remaining, k * batch_size)
+                nr = n_shards * k * lanes
+                dicts = region_dicts(k, kd_oracle)
+                bounds = [take * i // nr for i in range(nr + 1)]
+                starts = [0] * nr
+                segs = spills = resets = 0
+                while any(starts[i] < bounds[i + 1] - bounds[i]
+                          for i in range(nr)):
+                    seg = np.zeros(nr * rw, np.uint32)
+                    for i in range(nr):
+                        region = seg[i * rw:(i + 1) * rw]
+                        lo, hi = row + bounds[i], row + bounds[i + 1]
+                        if starts[i] >= hi - lo:
+                            continue  # exhausted lane: full-region zeros
+                        d = dicts[i]
+                        if d.count() >= slot_cap:
+                            d.reset()
+                            resets += 1
+                        _, consumed = flowpack.pack_resident(
+                            events[lo:hi], batch_size=batch_per_region,
+                            kdict=d, caps=caps, start=starts[i], out=region,
+                            extra=(extra[lo:hi] if extra is not None
+                                   else None))
+                        assert consumed > 0
+                        spills += int(region[2])
+                        starts[i] += consumed
+                    arena_parts.append(seg)
+                    segs += 1
+                chunks_py.append((row, take, k, segs, spills, resets))
+                row += take
+            arena_py = (np.concatenate(arena_parts) if arena_parts
+                        else np.zeros(0, np.uint32))
+            assert res.packed_rows == n
+            got = [(c.row_start, c.rows, c.k, c.n_segs, c.spills, c.resets)
+                   for c in res.chunks]
+            assert got == chunks_py
+            assert res.arena is not None
+            assert len(res.arena) == len(arena_py)
+            assert res.arena.tobytes() == arena_py.tobytes()
+        finally:
+            res.free()
+            pipe.close()
+            for d in kd_native + kd_oracle:
+                d.close()
+
+    def test_multi_shard_ladder(self):
+        self._run_trial(np.random.default_rng(7), 300, 64, 2, 1,
+                        [1, 4], 1 << 10)
+
+    def test_pack_lanes_three_rung_ladder(self):
+        self._run_trial(np.random.default_rng(8), 700, 32, 1, 2,
+                        [1, 2, 8], 1 << 10)
+
+    def test_tiny_slot_cap_forces_dict_resets(self):
+        self._run_trial(np.random.default_rng(9), 50, 16, 1, 1, [1], 4)
+
+    def test_wide_mesh_exhausted_lanes(self):
+        # 4 shards with row counts that leave trailing regions exhausted
+        # mid-continuation (the full-region memset masking path)
+        self._run_trial(np.random.default_rng(10), 900, 128, 4, 1,
+                        [1, 2], 1 << 10)
+
+
+class _StubMap:
+    def __init__(self, dtype, n_cpus, max_entries=256, no_batch=False,
+                 pad=None):
+        self.fd = -1
+        self.n_cpus = n_cpus
+        self.max_entries = max_entries
+        self._no_batch_ops = no_batch
+        self._pad_vs = dtype.itemsize if pad is None else pad
+
+
+class _StubFetcher:
+    """Duck-typed BpfmanFetcher surface for the gate tests: injected-mode
+    maps (fd < 0) make NativePipe.drain legal without a kernel."""
+
+    def __init__(self, no_batch=False, max_entries=256, pad=None):
+        self._agg = _StubMap(binfmt.FLOW_STATS_DTYPE, 1, max_entries,
+                             no_batch)
+        self._features = {
+            "extra": (_StubMap(binfmt.EXTRA_REC_DTYPE, 4, max_entries,
+                               no_batch, pad), binfmt.EXTRA_REC_DTYPE)}
+
+
+class TestNativeEvictGate:
+    def test_first_drain_probes_via_python_chain(self):
+        gate = loader.NativeEvictPipeline(_StubFetcher(), lanes=1)
+        trace = tracing.start_trace("t")
+        assert gate.drain(trace, 0.0) is None  # probe drain
+        assert not gate.disabled
+        out = gate.drain(trace, 0.0)  # injected maps: empty fused drain
+        assert out is not None
+        assert out.decode_stats["native_path"] == "fused"
+        assert set(out.decode_stats["native"]) == \
+            {"drain_s", "merge_s", "join_s", "pack_s"}
+        assert len(out.events) == 0 and out.packed is None
+        gate.close()
+
+    def test_no_batch_ops_disables_permanently(self):
+        gate = loader.NativeEvictPipeline(_StubFetcher(no_batch=True),
+                                          lanes=1)
+        trace = tracing.start_trace("t")
+        assert gate.drain(trace, 0.0) is None
+        assert gate.drain(trace, 0.0) is None
+        assert gate.disabled
+
+    def test_unknown_capacity_disables(self):
+        gate = loader.NativeEvictPipeline(_StubFetcher(max_entries=0),
+                                          lanes=1)
+        trace = tracing.start_trace("t")
+        assert gate.drain(trace, 0.0) is None
+        assert gate.drain(trace, 0.0) is None
+        assert gate.disabled
+
+    def test_kernel_padded_values_disable(self):
+        pad = binfmt.EXTRA_REC_DTYPE.itemsize + 8
+        gate = loader.NativeEvictPipeline(_StubFetcher(pad=pad), lanes=1)
+        trace = tracing.start_trace("t")
+        assert gate.drain(trace, 0.0) is None
+        assert gate.drain(trace, 0.0) is None
+        assert gate.disabled
+
+    def test_config_gate_default_off(self):
+        from netobserv_tpu.config import AgentConfig
+        assert AgentConfig().evict_native_pipeline is False
+
+
+class _StubRingDict:
+    def __init__(self):
+        self.resets = 0
+
+    def reset(self):
+        self.resets += 1
+
+
+class _StubRing:
+    def __init__(self):
+        self.kdicts = [_StubRingDict() for _ in range(4)]
+        self.dict_resets = 0
+        self._metrics = None
+
+
+class TestPackSurface:
+    def test_raw_fold_invalidation_only_with_outstanding(self):
+        from netobserv_tpu.sketch import staging
+        surface = staging.ResidentPackSurface.__new__(
+            staging.ResidentPackSurface)
+        import threading
+        surface.ring = _StubRing()
+        surface.lock = threading.Lock()
+        surface.epoch = 0
+        surface.outstanding = 0
+        # no outstanding arena: raw folds must be free (no epoch move,
+        # no dictionary reset — the mixed steady state)
+        surface.invalidate_for_raw_fold()
+        assert surface.epoch == 0
+        assert all(d.resets == 0 for d in surface.ring.kdicts)
+        # an outstanding fused arena: the raw fold's pack would mutate
+        # dictionaries AHEAD of the arena's ship — epoch must roll and
+        # every dictionary resets (the safe epoch roll)
+        surface.outstanding = 2
+        surface.invalidate_for_raw_fold()
+        assert surface.epoch == 1 and surface.outstanding == 0
+        assert all(d.resets == 1 for d in surface.ring.kdicts)
+        assert surface.ring.dict_resets == 4
+
+    def test_external_reset_rolls_epoch_without_touching_dicts(self):
+        from netobserv_tpu.sketch import staging
+        import threading
+        surface = staging.ResidentPackSurface.__new__(
+            staging.ResidentPackSurface)
+        surface.ring = _StubRing()
+        surface.lock = threading.Lock()
+        surface.epoch = 5
+        surface.outstanding = 3
+        surface.note_external_reset()
+        assert surface.epoch == 6 and surface.outstanding == 0
+        assert all(d.resets == 0 for d in surface.ring.kdicts)
+
+
+class TestAbiFallback:
+    def test_stale_library_counts_and_degrades(self, tmp_path, monkeypatch):
+        """A wrong-ABI .so must fall back to the python twins — counted
+        (flowpack_abi_fallback_total's source), never an import error."""
+        stale = str(tmp_path / "libflowpack_stale.so")
+        assert flowpack.build_native(force=True, out=stale, abi=1)
+        monkeypatch.setattr(flowpack, "_LIB_PATHS", [stale])
+        monkeypatch.setattr(flowpack, "abi_fallbacks", 0)
+        lib = flowpack._find_lib()
+        assert lib is None
+        assert flowpack.abi_fallbacks == 1
+
+    def test_unreadable_library_counts_and_degrades(self, tmp_path,
+                                                    monkeypatch):
+        junk = tmp_path / "libflowpack_junk.so"
+        junk.write_bytes(b"not an elf")
+        monkeypatch.setattr(flowpack, "_LIB_PATHS", [str(junk)])
+        monkeypatch.setattr(flowpack, "abi_fallbacks", 0)
+        assert flowpack._find_lib() is None
+        assert flowpack.abi_fallbacks == 1
