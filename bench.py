@@ -1738,6 +1738,16 @@ def device_provenance(cpu_requested: bool) -> dict:
     return out
 
 
+def executables_snapshot() -> list:
+    """Per-executable device-accounting registry (utils/retrace): the same
+    view /debug/executables serves — dispatch count + wall seconds, compile
+    seconds, retraces, last shape signature, donated-bytes estimate per
+    watched jit — stamped into the per-PR artifacts so a round's dispatch
+    cost rides the committed JSON next to device_provenance."""
+    from netobserv_tpu.utils import retrace
+    return retrace.snapshot()
+
+
 def main():
     import os
 
@@ -1763,6 +1773,7 @@ def main():
         if _DEVICE_NOTE:
             out["device"] = _DEVICE_NOTE
         out["device_provenance"] = device_provenance(cpu_requested)
+        out["executables"] = executables_snapshot()
         print(json.dumps(out))
         return
     if "--tiered-only" in sys.argv:
@@ -1858,6 +1869,7 @@ def main():
         if _DEVICE_NOTE:
             out["device"] = _DEVICE_NOTE
         out["device_provenance"] = device_provenance(cpu_requested)
+        out["executables"] = executables_snapshot()
         print(json.dumps(out))
         return
     rng = np.random.default_rng(2026)

@@ -112,7 +112,11 @@ class FlowsAgent:
             force_gc=cfg.force_garbage_collection,
             ssl_correlator=self.ssl_correlator,
             map_capacity=map_capacity,
-            pressure_watermark=cfg.map_pressure_watermark)
+            pressure_watermark=cfg.map_pressure_watermark,
+            # fleet telemetry: a sketch exporter records the last drain's
+            # occupancy so its delta frames carry it (one float store per
+            # drain; exporters without the hook opt out via None)
+            occupancy_sink=getattr(exporter, "note_map_occupancy", None))
         # fused native pipeline (EVICT_NATIVE_PIPELINE): when both ends
         # speak it — a bpfman fetcher with the gate on and a sketch
         # exporter whose resident ring can accept pre-packed regions —

@@ -426,6 +426,15 @@ class TpuSketchExporter(Exporter):
         # agent's reset window counter re-registers as a fresh epoch at
         # the aggregator instead of reading as a flood of stale frames
         self._agent_epoch = time.time_ns()
+        # fleet-telemetry block (frames' optional AgentTelemetry): every
+        # value here is already computed elsewhere — the block is assembled
+        # once per PUBLISH on the timer thread, never on the fold path.
+        # _map_occupancy is a single float store per DRAIN
+        # (note_map_occupancy, wired through MapTracer's occupancy sink).
+        self._windows_published = 0
+        self._host_rate_ewma = 0.0
+        self._last_publish_mono: Optional[float] = None
+        self._map_occupancy = 0.0
         if self._delta_sink is not None and decay_factor is not None:
             # decayed tables are CUMULATIVE (sliding window): pushing them
             # per window would double-count every prior window's mass at
@@ -965,6 +974,45 @@ class TpuSketchExporter(Exporter):
     def overload_snapshot(self) -> Optional[dict]:
         """Controller state for the health surface (None when disabled)."""
         return None if self._overload is None else self._overload.snapshot()
+
+    def note_map_occupancy(self, ratio: float) -> None:
+        """Record the last kernel-map drain's occupancy for the fleet
+        telemetry block (MapTracer's occupancy sink; one float store per
+        drain — float assignment is atomic under the GIL, no lock)."""
+        self._map_occupancy = float(ratio)
+
+    def _telemetry_block(self, records: int) -> dict:
+        """Per-agent health block stamped into the delta frame. Assembled
+        once per window PUBLISH on the timer thread from values the
+        exporter already holds — no device op, no new clock on the fold
+        path. The rec/s EWMA smooths window-records / window-elapsed over
+        publishes (alpha 0.3; the first window seeds it)."""
+        now = time.monotonic()
+        if self._last_publish_mono is not None:
+            elapsed = max(now - self._last_publish_mono, 1e-6)
+            rate = records / elapsed
+            self._host_rate_ewma = (rate if self._host_rate_ewma == 0.0
+                                    else 0.3 * rate
+                                    + 0.7 * self._host_rate_ewma)
+        self._last_publish_mono = now
+        conditions = []
+        if self.overloaded:
+            conditions.append("OVERLOADED")
+        eng = self._alerts
+        if eng is not None:
+            try:
+                if eng.condition().get("active"):
+                    conditions.append("ALERTING")
+            except Exception:  # telemetry must never lose the frame
+                pass
+        ctl = self._overload
+        return {
+            "shed_factor": (float(ctl.shed) if ctl is not None else 1.0),
+            "conditions": conditions,
+            "host_records_per_s": round(self._host_rate_ewma, 3),
+            "map_occupancy": round(self._map_occupancy, 6),
+            "windows_published": self._windows_published,
+        }
 
     def resident_pack_surface(self) -> Optional[staging.ResidentPackSurface]:
         """The pack surface for the fused native drain pipeline
@@ -1720,6 +1768,7 @@ class TpuSketchExporter(Exporter):
 
     def _publish_report(self, report, wtrace=tracing.NULL_TRACE,
                         tables=None) -> None:
+        self._windows_published += 1  # telemetry: counts THIS window
         if self._delta_sink is not None and tables is not None:
             # federation delta FIRST, in its own try: a dead aggregator (or
             # a serialize bug) loses the frame — counted by the sink — but
@@ -1729,16 +1778,31 @@ class TpuSketchExporter(Exporter):
                 with wtrace.stage("report_serialize"):
                     faultinject.fire("sketch.delta_export")
                     from netobserv_tpu.federation import delta as fdelta
+                    # cross-process trace context: ONE check — an unsampled
+                    # window answers None and the frame stays byte-identical
+                    # to the context-less wire. Encoded once, here: the
+                    # sink's retries resend these bytes, never a re-derived
+                    # context.
+                    ctx = tracing.context_of(
+                        wtrace, origin=f"window@{self._agent_id}")
+                    if ctx is not None and self._metrics is not None:
+                        self._metrics.trace_context_propagated_total.labels(
+                            "stamped").inc()
+                    host_tables = {k: np.asarray(v)
+                                   for k, v in tables.items()}
                     # window_seq rides the window counter (one frame per
                     # closed window); frame_uuid is drawn ONCE here — the
                     # sink's retry ladder resends these same bytes, so an
                     # ambiguous-deadline redelivery dedups at the ledger
                     frame = fdelta.encode_frame(
-                        {k: np.asarray(v) for k, v in tables.items()},
+                        host_tables,
                         agent_id=self._agent_id,
                         window=int(np.asarray(report.window)),
                         ts_ms=time.time_ns() // 1_000_000,
                         agent_epoch=self._agent_epoch,
+                        trace_ctx=ctx,
+                        telemetry=self._telemetry_block(
+                            int(float(host_tables["scalars"][0]))),
                         dims={"cm_depth": self._cfg.cm_depth,
                               "cm_width": self._cfg.cm_width,
                               "hll_precision": self._cfg.hll_precision,

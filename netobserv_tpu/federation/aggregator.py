@@ -146,6 +146,18 @@ class FederationAggregator:
         self._snapshot: Optional[dict] = None
         self._snap_lock = threading.Lock()
         self._snap_seq = 0
+        #: continued agent traces parked for the current window (sampled
+        #: frames only); adopted by the window trace at roll so the
+        #: roll/publish spans complete each agent's cross-process journey
+        self._window_traces: list = []
+        self._max_window_traces = 32
+        #: published fleet snapshot (/federation/fleet): whole-dict
+        #: seq-stamped swaps, rebuilt on the timer thread — the route only
+        #: ever reads the published reference (torn reads impossible by
+        #: construction, merge lock never taken on the request path)
+        self._fleet: Optional[dict] = None
+        self._fleet_lock = threading.Lock()
+        self._fleet_seq = 0
         self._closed = threading.Event()
         # cluster-wide continuous detection (netobserv_tpu/alerts): the
         # SAME engine core the agents mount, driven here by the merged-
@@ -326,6 +338,12 @@ class FederationAggregator:
         double-count a window."""
         t0 = time.perf_counter()
         trace = tracing.start_trace("delta")
+        # the continued CROSS-PROCESS trace (the frame's optional
+        # trace_ctx): resolved right after decode; NULL_TRACE until then
+        # and on every unsampled/context-less frame — one is-None-shaped
+        # check per frame, the zero-cost bar
+        cont = tracing.NULL_TRACE
+        parked = False
         try:
             data = faultinject.fire("federation.delta_ingest", data)
             try:
@@ -341,23 +359,40 @@ class FederationAggregator:
                 return self._reject("version_mismatch", str(exc))
             except fdelta.DeltaFrameError as exc:
                 return self._reject("decode_error", str(exc))
+            cont = tracing.continue_trace(frame.trace_ctx,
+                                          "federation_delta")
+            if cont.sampled and self._metrics is not None:
+                self._metrics.trace_context_propagated_total.labels(
+                    "continued").inc()
+            # validate/ledger/merge spans land on BOTH the local delta
+            # trace and the continued agent trace (group collapses to one
+            # object — the shared NULL_TRACE — when neither is sampled)
+            tr = tracing.group(trace, cont)
             try:
-                fdelta.validate_shapes(frame, self._expected_shapes)
-                if frame.dims != self._dims:
-                    raise fdelta.DeltaFrameError(
-                        f"frame geometry {frame.dims} != aggregator's "
-                        f"{self._dims} (agent {frame.agent_id!r})")
+                with tr.stage("delta_validate"):
+                    fdelta.validate_shapes(frame, self._expected_shapes)
+                    if frame.dims != self._dims:
+                        raise fdelta.DeltaFrameError(
+                            f"frame geometry {frame.dims} != aggregator's "
+                            f"{self._dims} (agent {frame.agent_id!r})")
             except fdelta.DeltaFrameError as exc:
                 return self._reject("shape_mismatch", str(exc))
             try:
-                with trace.stage("delta_merge_dispatch"):
-                    result = self._merge_frame(frame)
+                with tr.stage("delta_merge_dispatch"):
+                    result = self._merge_frame(frame, tr)
             except Exception as exc:
                 log.error("delta merge failed (frame from %r dropped): %s",
                           frame.agent_id, exc)
                 return self._reject("merge_error", str(exc))
+            # a MERGED frame's continued trace parks until this window
+            # closes: the roll/publish spans attach there, completing the
+            # agent->cluster journey under one trace id
+            if cont.sampled and result in ("ok", "legacy"):
+                parked = self._park_window_trace(cont)
         finally:
             trace.finish()
+            if cont.sampled and not parked:
+                cont.finish()
         m = self._metrics
         if m is not None:
             m.federation_deltas_total.labels(result).inc()
@@ -431,18 +466,36 @@ class FederationAggregator:
             info["last_ms"] = time.time() * 1e3
             info["last_mono"] = time.monotonic()
 
-    def _merge_frame(self, frame: fdelta.DeltaFrame) -> str:
+    def _park_window_trace(self, cont) -> bool:
+        """Hold a continued (sampled, merged) agent trace until the window
+        it contributed to closes — the roll/publish spans attach there.
+        Bounded: past the cap the oldest parked trace seals early (its
+        ingest spans are already evidence) so a hot window cannot grow the
+        list without bound. Returns True when parked (the caller must not
+        finish it)."""
+        with self._lock:
+            self._window_traces.append(cont)
+            shed = (self._window_traces.pop(0)
+                    if len(self._window_traces) > self._max_window_traces
+                    else None)
+        if shed is not None:
+            shed.finish()
+        return True
+
+    def _merge_frame(self, frame: fdelta.DeltaFrame,
+                     tr=tracing.NULL_TRACE) -> str:
         import jax
 
         # advisory pre-check: a redelivered/stale frame must not pay the
         # host->device transfer of the whole table set just to be
         # discarded under the lock (a retry flood would otherwise steal
         # transfer bandwidth from real merges)
-        with self._lock:
-            early = self._ledger_verdict_locked(frame)
-            if early in ("duplicate", "stale"):
-                self._note_discard_locked(frame, early)
-                return early
+        with tr.stage("delta_ledger"):
+            with self._lock:
+                early = self._ledger_verdict_locked(frame)
+                if early in ("duplicate", "stale"):
+                    self._note_discard_locked(frame, early)
+                    return early
         # churn tensors re-base into the CLUSTER window domain: the
         # aggregate's own slot_roll maintains the cluster prev baseline
         # (summing agents' agent-window prevs would double-count every
@@ -484,6 +537,11 @@ class FederationAggregator:
             info["window"] = frame.window
             info["last_ms"] = time.time() * 1e3
             info["last_mono"] = time.monotonic()
+            if frame.telemetry is not None:
+                # latest-wins per-agent health block (the fleet table's
+                # row); frames without one leave the previous block in
+                # place (mixed-fleet rollouts keep their last report)
+                info["telemetry"] = frame.telemetry
             if time.monotonic() >= self._window_deadline:
                 self._close_window_locked()
         return verdict
@@ -524,12 +582,19 @@ class FederationAggregator:
                     self._metrics.count_error("federation")
             self._evict_stale_agents()
             self._update_staleness()
+            self._update_fleet()
             self._publish_queued()
 
     def _close_window_locked(self) -> None:
         """Dispatch the roll UNDER self._lock; render/publish happen on the
         timer thread outside it (delta merges never wait on a sink)."""
-        wtrace = tracing.start_trace("federation_window")
+        # the window trace is a GROUP: the aggregator's own trace plus
+        # every continued agent trace parked this window — one roll/publish
+        # serves them all, so its spans land on each (group() collapses to
+        # the shared NULL_TRACE when nothing is sampled)
+        conts, self._window_traces = self._window_traces, []
+        wtrace = tracing.group(
+            tracing.start_trace("federation_window"), *conts)
         self._window_deadline = time.monotonic() + self._window_s
         try:
             with wtrace.stage("roll_dispatch"):
@@ -687,8 +752,44 @@ class FederationAggregator:
                         > self._stale_after_s,
                         "epoch": self._ledger.get(a, {}).get("epoch", 0),
                         "window_seq": self._ledger.get(a, {})
-                        .get("window_seq", 0)}
+                        .get("window_seq", 0),
+                        "telemetry": v.get("telemetry")}
                     for a, v in self._agents.items()}
+
+    def _update_fleet(self) -> None:
+        """Rebuild + swap the published fleet snapshot (timer thread; also
+        run by flush() so tests/shutdown see a current table). The build
+        reads the agent view under the merge lock BRIEFLY here — the
+        /federation/fleet route never does: it reads only the reference
+        this whole-dict seq-stamped swap publishes."""
+        agents = self._agents_view()
+        counts = {"agents": len(agents),
+                  "stale": sum(1 for v in agents.values() if v["stale"]),
+                  "overloaded": 0, "degraded": 0, "alerting": 0}
+        for v in agents.values():
+            tel = v.get("telemetry")
+            conditions = (tel or {}).get("conditions", ())
+            if "OVERLOADED" in conditions:
+                counts["overloaded"] += 1
+            if "DEGRADED" in conditions:
+                counts["degraded"] += 1
+            if "ALERTING" in conditions:
+                counts["alerting"] += 1
+        with self._fleet_lock:
+            self._fleet_seq += 1
+            self._fleet = {"seq": self._fleet_seq,
+                           "ts_ms": time.time_ns() // 1_000_000,
+                           "window_s": self._window_s,
+                           "stale_after_s": self._stale_after_s,
+                           "counts": counts,
+                           "agents": agents}
+
+    def fleet(self) -> Optional[dict]:
+        """The published fleet snapshot (None before the first timer tick
+        sees any state). Host-side dict only — never a device op, never
+        the merge lock; an evicted agent drops out at the next rebuild."""
+        with self._fleet_lock:
+            return self._fleet
 
     def _update_staleness(self) -> None:
         m = self._metrics
@@ -776,6 +877,7 @@ class FederationAggregator:
         close() must still return)."""
         with self._lock:
             self._close_window_locked()
+        self._update_fleet()
         self._publish_queued(timeout_s)
 
     def close(self) -> None:

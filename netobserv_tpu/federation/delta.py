@@ -35,6 +35,7 @@ from typing import Mapping, NamedTuple, Optional
 import numpy as np
 
 from netobserv_tpu.utils import tensorcodec
+from netobserv_tpu.utils.tracing import TraceContext
 
 
 def _pb():
@@ -160,6 +161,12 @@ class DeltaFrame(NamedTuple):
     window_seq: int = 0
     frame_uuid: str = ""
     agent_epoch: int = 0
+    # fleet-observability extras (optional on the wire; None when absent —
+    # a frame without them is byte-identical to the pre-fleet encoding):
+    # trace_ctx is a utils.tracing.TraceContext-shaped tuple
+    # (trace_id, origin, sampled); telemetry is the per-agent health dict
+    trace_ctx: Optional[tuple] = None
+    telemetry: Optional[dict] = None
 
 
 def table_spec_fingerprint() -> int:
@@ -175,7 +182,9 @@ def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
                  window: int, ts_ms: int, dims: Mapping[str, int],
                  codec: int = CODEC_ZLIB, window_seq: Optional[int] = None,
                  frame_uuid: str = "", agent_epoch: int = 0,
-                 version: Optional[int] = None) -> bytes:
+                 version: Optional[int] = None,
+                 trace_ctx=None,
+                 telemetry: Optional[Mapping] = None) -> bytes:
     """Serialize a table snapshot into one SketchDelta frame.
 
     `tables` must carry every name of the frame version's spec (host numpy
@@ -194,6 +203,15 @@ def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
     produce mixed-fleet/legacy frames: a v2 frame drops the churn tensors
     and trims `scalars` to the six v2 totals; a v1 frame additionally
     carries no delivery header. Production agents always encode current.
+
+    Fleet observability (current-version frames only): `trace_ctx` (a
+    utils.tracing.TraceContext, or any (trace_id, origin, sampled)-shaped
+    object) and `telemetry` (the per-agent health dict — shed_factor /
+    conditions / host_records_per_s / map_occupancy / windows_published)
+    are OPTIONAL message fields: None (the default) writes zero bytes, so
+    a frame without them is byte-identical to the pre-fleet wire — not a
+    format bump. The context encodes ONCE per frame, here — a retry
+    resends the same bytes, never a re-derived context.
     """
     version = DELTA_FORMAT_VERSION if version is None else int(version)
     if version not in SUPPORTED_VERSIONS:
@@ -218,6 +236,20 @@ def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
             window=int(window), ts_ms=int(ts_ms))
     for f in DIM_FIELDS:
         setattr(frame, f, int(dims[f]))
+    if version >= 3 and trace_ctx is not None:
+        frame.trace_ctx.trace_id = str(trace_ctx.trace_id)
+        frame.trace_ctx.origin = str(getattr(trace_ctx, "origin", "") or "")
+        frame.trace_ctx.sampled = int(
+            bool(getattr(trace_ctx, "sampled", True)))
+    if version >= 3 and telemetry is not None:
+        tel = frame.telemetry
+        tel.shed_factor = float(telemetry.get("shed_factor", 1.0))
+        tel.conditions.extend(str(c) for c in
+                              telemetry.get("conditions", ()))
+        tel.host_records_per_s = float(
+            telemetry.get("host_records_per_s", 0.0))
+        tel.map_occupancy = float(telemetry.get("map_occupancy", 0.0))
+        tel.windows_published = int(telemetry.get("windows_published", 0))
     n_scalars = len(SCALAR_FIELDS if version >= 3 else SCALAR_FIELDS_V2)
     for name, dt in spec:
         arr = np.asarray(tables[name])
@@ -296,12 +328,30 @@ def decode_frame(data: bytes) -> DeltaFrame:
     if missing:
         raise DeltaFrameError(f"delta frame missing tensors: {missing}")
     dims = {f: int(getattr(frame, f)) for f in DIM_FIELDS}
+    # optional fleet-observability fields: message presence (HasField) is
+    # the absent/present signal — a zero-valued present block is still a
+    # block, an absent one decodes as None
+    trace_ctx = None
+    if frame.HasField("trace_ctx"):
+        trace_ctx = TraceContext(frame.trace_ctx.trace_id,
+                                 frame.trace_ctx.origin,
+                                 bool(frame.trace_ctx.sampled))
+    telemetry = None
+    if frame.HasField("telemetry"):
+        telemetry = {
+            "shed_factor": float(frame.telemetry.shed_factor),
+            "conditions": list(frame.telemetry.conditions),
+            "host_records_per_s": float(frame.telemetry.host_records_per_s),
+            "map_occupancy": float(frame.telemetry.map_occupancy),
+            "windows_published": int(frame.telemetry.windows_published),
+        }
     return DeltaFrame(version=int(frame.version), agent_id=frame.agent_id,
                       window=int(frame.window), ts_ms=int(frame.ts_ms),
                       dims=dims, tables=tables,
                       window_seq=int(frame.window_seq),
                       frame_uuid=frame.frame_uuid,
-                      agent_epoch=int(frame.agent_epoch))
+                      agent_epoch=int(frame.agent_epoch),
+                      trace_ctx=trace_ctx, telemetry=telemetry)
 
 
 def upgrade_tables(frame: DeltaFrame) -> dict:

@@ -28,6 +28,19 @@ Routes (all GET, JSON):
                             merged-window snapshots; 404 when ALERT_RULES
                             is unset)
 - /federation/status        per-agent delta freshness + plane counters
+- /federation/fleet         per-agent telemetry rollup (shed factor,
+                            conditions, host-path rec/s EWMA, map
+                            occupancy, windows published) from the
+                            frames' telemetry blocks — reads ONLY the
+                            seq-stamped fleet snapshot the window timer
+                            publishes (never the merge lock)
+- /debug/traces             the aggregator's flight recorder (same
+                            ?limit=/?trace= params as the agent debug
+                            server mount — a cross-process trace id
+                            stamped by an agent answers here too, so one
+                            id can be followed across both tiers)
+- /debug/executables        the aggregator process's per-executable
+                            device-accounting registry (utils/retrace)
 - /federation/range         cluster-wide sketch-warehouse time-range
                             answers (?from=&to=; /federation/range/topk|
                             frequency|cardinality|victims views) — a thin
@@ -72,7 +85,23 @@ class _Handler(BaseHTTPRequestHandler):
                     "/federation/churn", "/federation/cardinality",
                     "/federation/victims", "/federation/alerts",
                     "/federation/range", "/federation/status",
-                    "/healthz", "/readyz"]})
+                    "/federation/fleet", "/debug/traces",
+                    "/debug/executables", "/healthz", "/readyz"]})
+                return
+            if path == "/federation/fleet":
+                self._serve_fleet()
+                return
+            if path in ("/debug/traces", "/debug/executables"):
+                # thin adapters over the agent debug server's body
+                # builders (server/debug.py — the never-fork rule): the
+                # aggregator tier mounts the SAME flight recorder and
+                # executable-registry views, so a trace id stamped by an
+                # agent can be followed on both tiers with one URL shape
+                from netobserv_tpu.server.debug import (_executables_dump,
+                                                        _traces_dump)
+                dump = (_traces_dump if path == "/debug/traces"
+                        else _executables_dump)
+                self._json(200, json.loads(dump(q)))
                 return
             if path == "/federation/range" or \
                     path.startswith("/federation/range/"):
@@ -153,6 +182,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _no_window(self) -> None:
         self._json(503, {"error": "no window published yet"})
+
+    def _serve_fleet(self) -> None:
+        # reads only the published fleet reference (whole-dict seq-stamped
+        # swaps on the timer thread) — never the aggregator's merge lock
+        fleet = self.aggregator.fleet()
+        m = getattr(self.aggregator, "_metrics", None)
+        if fleet is None:
+            if m is not None:
+                m.federation_fleet_requests_total.labels("no_window").inc()
+            self._json(503, {"error": "no fleet snapshot published yet"})
+            return
+        if m is not None:
+            m.federation_fleet_requests_total.labels("ok").inc()
+        self._json(200, fleet)
 
     def _serve_health(self, path: str) -> None:
         try:

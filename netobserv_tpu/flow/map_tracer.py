@@ -33,7 +33,8 @@ class MapTracer:
                  columnar: bool = False, udn_mapper=None,
                  force_gc: bool = False, ssl_correlator=None,
                  map_capacity: int = 0,
-                 pressure_watermark: float = 0.0):
+                 pressure_watermark: float = 0.0,
+                 occupancy_sink=None):
         self._fetcher = fetcher
         self._out = out
         self._timeout = active_timeout_s
@@ -46,6 +47,11 @@ class MapTracer:
         self._map_capacity = map_capacity
         self._pressure_watermark = pressure_watermark
         self._pressure_relief = False
+        # optional per-DRAIN occupancy observer (the sketch exporter's
+        # fleet-telemetry block rides it): one callable-or-None check per
+        # drain, never per record; errors are the observer's problem, not
+        # the eviction loop's
+        self._occupancy_sink = occupancy_sink
         self._agent_ip = agent_ip
         self._namer = namer
         self._clock = MonotonicClock()
@@ -139,6 +145,11 @@ class MapTracer:
         # relief latch below is gated on the knob
         if self._metrics is not None:
             self._metrics.map_occupancy_ratio.observe(occupancy)
+        if self._occupancy_sink is not None:
+            try:
+                self._occupancy_sink(occupancy)
+            except Exception:
+                log.debug("occupancy sink failed", exc_info=True)
         if not self._pressure_watermark:
             return
         pressured = occupancy >= self._pressure_watermark

@@ -355,6 +355,20 @@ class Metrics:
             "is a multi-second stall; see the retrace watchdog log line "
             "for the offending abstract shapes)", ["fn"],
             registry=self.registry)
+        self.executable_dispatch_seconds_total = Counter(
+            p + "executable_dispatch_seconds_total",
+            "Cumulative wall seconds spent dispatching each watched jitted "
+            "entry point (the per-executable attribution split behind "
+            "/debug/executables; one monotonic-clock pair per batch "
+            "dispatch, never per record)", ["fn"],
+            registry=self.registry)
+        self.trace_context_propagated_total = Counter(
+            p + "trace_context_propagated_total",
+            "Cross-process trace contexts carried over the delta wire, by "
+            "result (stamped = an agent encoded a sampled window trace "
+            "into a frame; continued = the aggregator adopted a frame's "
+            "context and recorded child spans under the same trace id)",
+            ["result"], registry=self.registry)
         # federation plane (federation/aggregator.py + the agent-side delta
         # sink, exporter/federation.py)
         self.federation_deltas_total = Counter(
@@ -396,6 +410,12 @@ class Metrics:
             p + "federation_active_agents",
             "Agents that contributed a delta to the last aggregator window",
             registry=self.registry)
+        self.federation_fleet_requests_total = Counter(
+            p + "federation_fleet_requests_total",
+            "Fleet-table requests (/federation/fleet), by result (ok / "
+            "error). Served from the aggregator's published host-side "
+            "fleet snapshot only — no device op, no merge lock",
+            ["result"], registry=self.registry)
         self.federation_agent_evictions_total = Counter(
             p + "federation_agent_evictions_total",
             "Agents evicted from the aggregator's ownership view after "
@@ -474,6 +494,9 @@ class Metrics:
 
     def count_retrace(self, fn: str) -> None:
         self.sketch_retraces_total.labels(fn).inc()
+
+    def observe_dispatch(self, fn: str, seconds: float) -> None:
+        self.executable_dispatch_seconds_total.labels(fn).inc(seconds)
 
     def count_stage_failure(self, stage: str, kind: str) -> None:
         self.stage_failures_total.labels(stage, kind).inc()

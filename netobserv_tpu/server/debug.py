@@ -12,6 +12,7 @@ import traceback
 import tracemalloc
 from collections import Counter
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 log = logging.getLogger("netobserv_tpu.server.debug")
 
@@ -19,7 +20,7 @@ _JSON = "application/json"
 _TEXT = "text/plain; charset=utf-8"
 
 
-def _threads_dump() -> str:
+def _threads_dump(q=None) -> str:
     out = io.StringIO()
     frames = sys._current_frames()
     for t in threading.enumerate():
@@ -31,7 +32,7 @@ def _threads_dump() -> str:
     return out.getvalue()
 
 
-def _tracemalloc_dump(top: int = 25) -> str:
+def _tracemalloc_dump(q=None, top: int = 25) -> str:
     if not tracemalloc.is_tracing():
         tracemalloc.start()
         return "tracemalloc started; hit this endpoint again for a snapshot\n"
@@ -41,26 +42,50 @@ def _tracemalloc_dump(top: int = 25) -> str:
                    f"{s.traceback}\n" for s in stats)
 
 
-def _gc_dump() -> str:
+def _gc_dump(q=None) -> str:
     counts = Counter(type(o).__name__ for o in gc.get_objects())
     lines = [f"gc counts: {gc.get_count()} thresholds: {gc.get_threshold()}\n"]
     lines += [f"{n:>10}  {name}\n" for name, n in counts.most_common(40)]
     return "".join(lines)
 
 
-def _traces_dump() -> str:
+def _traces_dump(q=None) -> str:
     """Flight recorder: last N completed batch/window traces, newest first,
     each with per-stage durations and inter-stage queue-wait gaps
-    (utils/tracing.py; empty unless TRACE_SAMPLE > 0)."""
+    (utils/tracing.py; empty unless TRACE_SAMPLE > 0). ?limit= caps the
+    list; ?trace= returns only the spans of one trace id (cross-process
+    lookup: an agent-stamped id continued by the aggregator answers on
+    both tiers' mounts)."""
     from netobserv_tpu.utils import tracing
 
+    q = q or {}
+    limit = None
+    if q.get("limit"):
+        try:
+            limit = max(0, int(q["limit"]))
+        except ValueError:
+            limit = None
     return json.dumps({
         "sampling_enabled": tracing.enabled(),
-        "traces": tracing.snapshot(),
+        "traces": tracing.snapshot(limit=limit, trace_id=q.get("trace")),
     }, separators=(",", ":"))
 
 
-def _jax_dump() -> str:
+def _executables_dump(q=None) -> str:
+    """Per-executable device accounting from the retrace watchdog registry
+    (utils/retrace.py): every watched jit's dispatch count, cumulative
+    dispatch wall seconds, compile seconds, retraces, last abstract-shape
+    signature, and donated-bytes estimate. Host-side counters only — the
+    route never dispatches a device op."""
+    from netobserv_tpu.utils import retrace
+
+    return json.dumps({
+        "executables": retrace.snapshot(),
+        "retraces_total": retrace.total_retraces(),
+    }, separators=(",", ":"))
+
+
+def _jax_dump(q=None) -> str:
     """JAX runtime state: backend/platform, devices, live-array count,
     compilation-cache stats, and the retrace watchdog's per-entry-point
     compile accounting (utils/retrace.py). Touching this route initializes
@@ -108,7 +133,12 @@ _ROUTES = {
         _traces_dump, _JSON,
         "flight recorder: last completed batch/window traces, newest "
         "first, with per-stage durations and queue-wait gaps "
-        "(TRACE_SAMPLE)"),
+        "(TRACE_SAMPLE; ?limit= caps, ?trace= single-trace lookup)"),
+    "/debug/executables": (
+        _executables_dump, _JSON,
+        "per-executable device accounting: dispatch count + wall seconds, "
+        "compile seconds, retraces, last shape signature, donated-bytes "
+        "estimate for every watched jit"),
     "/debug/jax": (
         _jax_dump, _JSON,
         "jax backend/devices, live arrays, compilation cache, and the "
@@ -118,7 +148,9 @@ _ROUTES = {
 
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
-        path = self.path.split("?")[0]
+        url = urlparse(self.path)
+        path = url.path
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
         if path in ("/", "/debug", "/debug/"):
             body = "".join(f"{route:<22} {desc}\n"
                            for route, (_fn, _ct, desc)
@@ -126,7 +158,7 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = _TEXT
         elif path in _ROUTES:
             fn, ctype, _desc = _ROUTES[path]
-            body = fn()
+            body = fn(q)
         else:
             self.send_error(404)
             return

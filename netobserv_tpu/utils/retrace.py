@@ -22,7 +22,19 @@ an unbounded compile-cache leak. This module turns it into an alarm:
 
 ``RETRACE_WATCHDOG=0`` disables wrapping entirely (``watch`` returns the
 function untouched). The wrapper itself costs two thread-local attribute
-writes per call — per *batch*, never per record.
+writes plus one monotonic-clock pair per call — per *batch*, never per
+record.
+
+Beyond the alarm, the wrapper IS the per-executable accounting registry
+(``/debug/executables`` on agent and aggregator, stamped into bench
+artifacts): per watched jit it tracks dispatch count, cumulative dispatch
+wall seconds (fed to ``executable_dispatch_seconds_total{fn=...}`` when a
+Metrics facade is bound), cumulative compile seconds (the lowering
+listener's duration, warmup included), the last abstract-shape signature
+seen at a compile, and a donated-bytes estimate (sum of array-arg nbytes at
+the last compile — the HBM the executable's donation reuses per dispatch).
+This is the attribution surface the proof-of-performance round reads: where
+wall/compile/HBM went, per executable, not per lumped stage.
 
 Wrapped functions delegate attribute access to the underlying jit function,
 so AOT introspection (``fn.lower(...)``, ``fn._cache_size()``) keeps working
@@ -35,6 +47,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 import weakref
 from typing import Any, Callable, Optional
 
@@ -74,11 +87,31 @@ def _describe(args: tuple, limit: int = 600) -> str:
     return desc if len(desc) <= limit else desc[:limit] + "...(truncated)"
 
 
+def _donated_bytes(args: tuple) -> int:
+    """Sum of array-argument bytes at compile time: the donated-buffer HBM
+    estimate for one dispatch of this signature (the state arrays the fold
+    ladder donates dominate; scalars contribute 0)."""
+    total = 0
+    try:
+        import jax
+
+        for leaf in jax.tree.leaves(args):
+            nbytes = getattr(leaf, "nbytes", None)
+            if nbytes is not None:
+                total += int(nbytes)
+    except Exception:  # never let accounting break the caller
+        return 0
+    return total
+
+
 class Watched:
-    """Callable wrapper counting compilations of one jitted entry point."""
+    """Callable wrapper counting compilations of one jitted entry point,
+    and the per-executable accounting row behind /debug/executables."""
 
     __slots__ = ("_fn", "name", "warmup_calls", "calls", "compiles",
-                 "retraces", "last_retrace", "__weakref__")
+                 "retraces", "last_retrace", "dispatch_seconds",
+                 "compile_seconds", "last_signature", "donated_bytes",
+                 "__weakref__")
 
     def __init__(self, fn: Callable, name: str, warmup_calls: int):
         self._fn = fn
@@ -88,15 +121,30 @@ class Watched:
         self.compiles = 0
         self.retraces = 0
         self.last_retrace: str = ""
+        self.dispatch_seconds = 0.0
+        self.compile_seconds = 0.0
+        self.last_signature: str = ""
+        self.donated_bytes = 0
 
     def __call__(self, *args, **kwargs):
         self.calls += 1
         prev = getattr(_tls, "active", None)
         _tls.active = self
         _tls.args = args
+        t0 = time.perf_counter()
         try:
             return self._fn(*args, **kwargs)
         finally:
+            # one monotonic-clock pair per DISPATCH (per batch, never per
+            # record) — the wall attribution the accounting registry exists
+            # for. Async dispatch means this is enqueue cost on TPU and
+            # full execution on CPU; either way it is the wall the pipeline
+            # thread actually spent inside this executable's call.
+            dt = time.perf_counter() - t0
+            self.dispatch_seconds += dt
+            m = _metrics
+            if m is not None:
+                m.observe_dispatch(self.name, dt)
             _tls.active = prev
             _tls.args = None
 
@@ -104,14 +152,21 @@ class Watched:
         # delegate .lower / ._cache_size / __wrapped__-style access
         return getattr(object.__getattribute__(self, "_fn"), item)
 
-    def _note_compile(self) -> None:
+    def _note_compile(self, duration: float = 0.0) -> None:
         global _retraces_total
         self.compiles += 1
+        self.compile_seconds += duration
+        args = getattr(_tls, "args", None) or ()
+        # signature/donation refresh on EVERY compile, warmup included —
+        # the registry row must describe the executable that actually
+        # serves steady state, which is the last one compiled
+        self.last_signature = _describe(args)
+        self.donated_bytes = _donated_bytes(args)
         if self.calls <= self.warmup_calls:
             return  # expected warmup compile
         self.retraces += 1
         _retraces_total += 1
-        self.last_retrace = _describe(getattr(_tls, "args", None) or ())
+        self.last_retrace = self.last_signature
         log.error(
             "post-warmup XLA retrace of jitted entry %r (call %d, compile "
             "%d): the fixed-shape ingest invariant is broken; offending "
@@ -125,6 +180,11 @@ class Watched:
         return {"fn": self.name, "calls": self.calls,
                 "compiles": self.compiles, "retraces": self.retraces,
                 "warmup_calls": self.warmup_calls,
+                "dispatch_seconds": round(self.dispatch_seconds, 6),
+                "compile_seconds": round(self.compile_seconds, 6),
+                "donated_bytes_estimate": self.donated_bytes,
+                **({"last_signature": self.last_signature}
+                   if self.last_signature else {}),
                 **({"last_retrace": self.last_retrace}
                    if self.last_retrace else {})}
 
@@ -134,7 +194,7 @@ def _listener(event: str, duration: float, **kwargs) -> None:
         return
     w = getattr(_tls, "active", None)
     if w is not None:
-        w._note_compile()
+        w._note_compile(duration)
 
 
 def _ensure_installed() -> None:

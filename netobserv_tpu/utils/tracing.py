@@ -33,6 +33,19 @@ Sampling and the zero-cost contract:
 
 ``TRACE_RING`` (env, default 64) bounds how many completed traces the
 recorder keeps; snapshots are newest-first.
+
+Cross-process propagation (the federation seam): a sampled trace exposes a
+serializable :class:`TraceContext` via :func:`context_of` (fleet-unique hex
+trace id + origin span + sample bit). The agent stamps it into the delta
+frame; the aggregator calls :func:`continue_trace` to keep recording child
+spans under the SAME trace id, so ``/debug/traces?trace=<id>`` on either
+process shows one window's journey end to end. Both helpers keep the
+zero-cost bar: ``context_of(NULL_TRACE)`` is one attribute check returning
+``None`` (nothing serialized, the frame stays byte-identical), and
+``continue_trace`` with tracing disabled — or a ``None``/unsampled context —
+returns the shared :data:`NULL_TRACE`. The sampling decision is made ONCE at
+the origin: a receiver with tracing enabled always honors a propagated
+sampled context (its own period applies only to traces it originates).
 """
 
 from __future__ import annotations
@@ -42,12 +55,13 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import NamedTuple, Optional
 
 __all__ = [
-    "NULL_SPAN", "NULL_TRACE", "Trace", "FlightRecorder",
+    "NULL_SPAN", "NULL_TRACE", "Trace", "FlightRecorder", "TraceContext",
     "start_trace", "configure", "set_metrics", "snapshot", "enabled",
     "set_active", "clear_active", "active_trace",
+    "context_of", "continue_trace", "group",
 ]
 
 
@@ -80,6 +94,19 @@ class _NullTrace:
 
 
 NULL_TRACE = _NullTrace()
+
+
+class TraceContext(NamedTuple):
+    """Serializable identity of a sampled trace, for crossing a process
+    boundary (the delta frame's optional ``trace_ctx`` field). ``trace_id``
+    is the fleet-unique hex id (process salt + local counter), ``origin``
+    names the span/process that exported it, ``sampled`` is the origin's
+    sampling verdict — carried explicitly so an unsampled context decoded
+    off a hand-built frame still resolves to NULL_TRACE."""
+
+    trace_id: str
+    origin: str = ""
+    sampled: bool = True
 
 
 class _Span:
@@ -119,12 +146,20 @@ class Trace:
     the window timer), so appends take a per-trace lock — sampled traces are
     rare by construction, the lock never sits on the un-sampled path."""
 
-    __slots__ = ("kind", "id", "unix_t0", "t0", "spans", "_lock", "_done")
+    __slots__ = ("kind", "id", "trace_id", "origin", "unix_t0", "t0",
+                 "spans", "_lock", "_done")
     sampled = True
 
-    def __init__(self, kind: str, trace_id: int):
+    def __init__(self, kind: str, local_id: int,
+                 trace_id: Optional[str] = None, origin: str = ""):
         self.kind = kind
-        self.id = trace_id
+        self.id = local_id
+        # fleet-unique hex id: process salt + local counter for traces born
+        # here; a continued trace ADOPTS the origin's id verbatim so the
+        # recorder entries on both sides correlate by one string
+        self.trace_id = (trace_id if trace_id is not None
+                         else f"{_salt}{local_id:08x}")
+        self.origin = origin
         self.unix_t0 = time.time()
         self.t0 = time.perf_counter()
         self.spans: list[_Span] = []
@@ -176,13 +211,69 @@ class Trace:
             })
             prev_t1 = s.t1
         total = (spans[-1].t1 - spans[0].t0) if spans else 0.0
-        return {
+        out = {
             "id": self.id,
+            "trace_id": self.trace_id,
             "kind": self.kind,
             "start_unix_ms": int(self.unix_t0 * 1e3),
             "total_ms": round(total * 1e3, 3),
             "stages": stages,
         }
+        if self.origin:
+            out["origin"] = self.origin
+        return out
+
+
+class _GroupSpan:
+    """Context manager fanning one stage span out to several traces."""
+
+    __slots__ = ("_ctxs",)
+
+    def __init__(self, ctxs: list):
+        self._ctxs = ctxs
+
+    def __enter__(self):
+        for c in self._ctxs:
+            c.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        for c in self._ctxs:
+            c.__exit__(*exc)
+        return False
+
+
+class TraceGroup:
+    """Several sampled traces sharing the same spans — the aggregator's
+    window close, where one roll/publish serves every agent trace continued
+    into that window plus the aggregator's own window trace. stage() fans
+    out to each member; finish() seals them all (Trace.finish is
+    idempotent, so a member finished elsewhere is harmless)."""
+
+    __slots__ = ("traces",)
+    sampled = True
+
+    def __init__(self, traces: list):
+        self.traces = traces
+
+    def stage(self, name: str) -> _GroupSpan:
+        return _GroupSpan([t.stage(name) for t in self.traces])
+
+    def finish(self) -> None:
+        for t in self.traces:
+            t.finish()
+
+
+def group(*traces):
+    """Combine traces for shared spans: drops unsampled members, collapses
+    to the single member or the shared NULL_TRACE when possible (so the
+    common nothing-sampled case allocates nothing)."""
+    live = [t for t in traces if t.sampled]
+    if not live:
+        return NULL_TRACE
+    if len(live) == 1:
+        return live[0]
+    return TraceGroup(live)
 
 
 class FlightRecorder:
@@ -196,11 +287,20 @@ class FlightRecorder:
         with self._lock:
             self._dq.append(trace)
 
-    def snapshot(self) -> list[dict]:
-        """Newest-first JSON-ready dump (the /debug/traces body)."""
+    def snapshot(self, limit: Optional[int] = None,
+                 trace_id: Optional[str] = None) -> list[dict]:
+        """Newest-first JSON-ready dump (the /debug/traces body).
+        ``trace_id`` keeps only traces with that exact hex id (the
+        cross-process correlation lookup); ``limit`` caps the result
+        AFTER filtering."""
         with self._lock:
             traces = list(self._dq)
-        return [t.render() for t in reversed(traces)]
+        out = [t.render() for t in reversed(traces)]
+        if trace_id is not None:
+            out = [t for t in out if t.get("trace_id") == trace_id]
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return out
 
     def clear(self) -> None:
         with self._lock:
@@ -229,6 +329,10 @@ _period = 1
 _counters: dict = {}
 _counters_lock = threading.Lock()
 _next_id = itertools.count(1)
+# process-scoped salt prefixing every locally-born trace id: two agents (or
+# an agent and the aggregator) must never mint the same hex id, or the
+# cross-process correlation at /debug/traces?trace= aliases unrelated work
+_salt = f"{os.getpid() & 0xffffffff:08x}{int.from_bytes(os.urandom(4), 'big'):08x}"
 _metrics = None  # Metrics facade (set_metrics); observe_stage sink
 _recorder = FlightRecorder(int(os.environ.get("TRACE_RING", "64") or 64))
 
@@ -273,6 +377,28 @@ def start_trace(kind: str = "batch"):
     return Trace(kind, next(_next_id))
 
 
+def context_of(trace, origin: str = "") -> Optional[TraceContext]:
+    """Serializable context of a sampled trace, or ``None``. The zero-cost
+    gate for the wire: NULL_TRACE (tracing off or this window unsampled)
+    answers None in one attribute check, and the caller stamps nothing —
+    the frame stays byte-identical to the context-less encoding."""
+    if not trace.sampled:
+        return None
+    return TraceContext(trace.trace_id, origin or trace.kind, True)
+
+
+def continue_trace(ctx, kind: str = "batch"):
+    """Continue a propagated trace in THIS process: a live :class:`Trace`
+    adopting the context's trace id, or the shared NULL_TRACE when tracing
+    is disabled here or the context is absent/unsampled. The origin's
+    sampling verdict is honored as-is — the local period applies only to
+    locally-born traces."""
+    if not _enabled or ctx is None or not ctx.sampled or not ctx.trace_id:
+        return NULL_TRACE
+    return Trace(kind, next(_next_id), trace_id=ctx.trace_id,
+                 origin=ctx.origin)
+
+
 # Per-thread active trace: lets a deep callee (the kernel drain inside
 # BpfmanFetcher.lookup_and_delete) attach child spans to the trace born in
 # map_tracer WITHOUT widening the FlowFetcher protocol. Only SAMPLED traces
@@ -304,9 +430,11 @@ def set_metrics(metrics) -> None:
     _metrics = metrics
 
 
-def snapshot() -> list[dict]:
-    """Newest-first completed traces (the /debug/traces payload)."""
-    return _recorder.snapshot()
+def snapshot(limit: Optional[int] = None,
+             trace_id: Optional[str] = None) -> list[dict]:
+    """Newest-first completed traces (the /debug/traces payload); see
+    :meth:`FlightRecorder.snapshot` for the filter params."""
+    return _recorder.snapshot(limit=limit, trace_id=trace_id)
 
 
 # arm from the environment at import; unset -> disabled, start_trace stays

@@ -45,6 +45,13 @@ def main() -> int:
     from netobserv_tpu.model.flow import FlowKey
     from netobserv_tpu.model.record import Record
     from netobserv_tpu.sketch.state import SketchConfig
+    from netobserv_tpu.utils import tracing
+
+    # sample everything: the smoke asserts ONE cross-process trace end to
+    # end (agent window span + the aggregator's continued child spans under
+    # the same trace id, looked up via /debug/traces?trace= on the
+    # aggregator's query surface)
+    tracing.configure(sample=1.0, capacity=64)
 
     cfg = AgentConfig()
     cfg.sketch_cm_depth, cfg.sketch_cm_width = 2, 4096
@@ -102,9 +109,47 @@ def main() -> int:
     freq = get("/federation/frequency?src=10.9.9.9&dst=10.8.8.8"
                "&src_port=5000&dst_port=443&proto=6")
     healthz = get("/healthz")
+    fleet = get("/federation/fleet")
 
     ok = True
     notes = []
+
+    # one end-to-end trace: every continued agent trace in the recorder
+    # carries the SAME id as the agent window trace that stamped it; the
+    # ?trace= lookup on the aggregator's query surface must return spans
+    # from BOTH tiers (agent "window" + continued "federation_delta")
+    cont = next((t for t in tracing.snapshot()
+                 if t["kind"] == "federation_delta"), None)
+    trace_kinds: list[str] = []
+    journey: list[dict] = []
+    if cont is None:
+        ok, _ = False, notes.append("no continued federation_delta trace "
+                                    "in the flight recorder")
+    else:
+        journey = get(f"/debug/traces?trace={cont['trace_id']}")["traces"]
+        trace_kinds = sorted({t["kind"] for t in journey})
+        if not {"window", "federation_delta"} <= set(trace_kinds):
+            ok, _ = False, notes.append(
+                f"trace {cont['trace_id']} did not span both tiers: "
+                f"{trace_kinds}")
+        stages = {s["stage"] for t in journey for st in [t["stages"]]
+                  for s in st}
+        if not {"delta_validate", "report_render"} & stages:
+            ok, _ = False, notes.append(
+                f"aggregator child spans missing from {cont['trace_id']}: "
+                f"{sorted(stages)}")
+
+    # fleet rollup: both agents' telemetry blocks present and sane
+    fleet_agents = sorted(fleet.get("agents", {}))
+    if fleet_agents != ["smoke-agent-0", "smoke-agent-1"]:
+        ok, _ = False, notes.append(
+            f"/federation/fleet missing agents: {fleet_agents}")
+    for aid, row in fleet.get("agents", {}).items():
+        tel = row.get("telemetry") or {}
+        if tel.get("windows_published", 0) < 1 or \
+                tel.get("shed_factor", 0) <= 0:
+            ok, _ = False, notes.append(
+                f"fleet telemetry for {aid} not populated: {tel}")
     if len(status["agents"]) != 2:
         ok, _ = False, notes.append("expected 2 agents in /status")
     hh = topk["topk"]
@@ -131,6 +176,12 @@ def main() -> int:
         "megaflow_est_bytes": freq["est_bytes"],
         "megaflow_bound_bytes": freq["overestimate_bound_bytes"],
         "reports_published": len(reports),
+        # CI artifact extras: the fleet snapshot + ONE rendered
+        # cross-process trace (agent + aggregator spans, one id)
+        "fleet": fleet,
+        "trace_id": cont["trace_id"] if cont else None,
+        "trace_kinds": trace_kinds,
+        "trace": journey,
     }))
     return 0 if ok else 1
 

@@ -171,6 +171,60 @@ def test_v1_golden_still_decodes_as_legacy():
     assert up["scalars"].shape == (len(fdelta.SCALAR_FIELDS),)
 
 
+def test_trace_ctx_and_telemetry_absent_on_golden_frames():
+    """Fleet observability rides OPTIONAL proto fields: the checked-in v3
+    golden (encoded with no context/telemetry) decodes both as None — and
+    `test_frame_matches_golden_bytes` above already proves an unstamped
+    encode stays byte-identical to the pre-fleet wire, so no format bump."""
+    frame = fdelta.decode_frame(bytes.fromhex(open(GOLDEN).read().strip()))
+    assert frame.trace_ctx is None
+    assert frame.telemetry is None
+
+
+def test_frame_with_trace_ctx_and_telemetry_roundtrips():
+    """A stamped frame DIFFERS from the golden bytes (the optional fields
+    serialize) and round-trips both blocks exactly; the tensors are
+    untouched. The context decodes as a TraceContext (attribute access —
+    the aggregator's continue_trace reads .sampled/.trace_id)."""
+    from netobserv_tpu.utils.tracing import TraceContext
+
+    ctx = TraceContext("00c0ffee0badcafe00000001", "window@golden-agent",
+                       True)
+    tel = {"shed_factor": 4.0, "conditions": ["OVERLOADED", "ALERTING"],
+           "host_records_per_s": 12345.5, "map_occupancy": 0.75,
+           "windows_published": 9}
+    data = fdelta.encode_frame(
+        golden_tables(), agent_id="golden-agent", window=42,
+        ts_ms=1_700_000_000_123, dims=DIMS, codec=fdelta.CODEC_RAW,
+        window_seq=42, frame_uuid="cafe0042feedbeef",
+        agent_epoch=1_700_000_000_000_000_000, trace_ctx=ctx, telemetry=tel)
+    golden = bytes.fromhex(open(GOLDEN).read().strip())
+    assert data != golden and len(data) > len(golden)
+    frame = fdelta.decode_frame(data)
+    assert frame.trace_ctx == ctx
+    assert isinstance(frame.trace_ctx, TraceContext)
+    assert frame.telemetry == tel
+    want = golden_tables()
+    for name, _ in fdelta.TABLE_SPEC:
+        np.testing.assert_array_equal(frame.tables[name], want[name],
+                                      err_msg=name)
+
+
+def test_unsampled_trace_ctx_still_decodes_unsampled():
+    """A hand-built frame carrying sampled=0 must decode with
+    sampled=False — the receiver's continue_trace then resolves it to
+    NULL_TRACE (the sample bit travels explicitly, never inferred)."""
+    from netobserv_tpu.utils.tracing import TraceContext
+
+    data = fdelta.encode_frame(
+        golden_tables(), agent_id="a", window=1, ts_ms=2, dims=DIMS,
+        codec=fdelta.CODEC_RAW,
+        trace_ctx=TraceContext("deadbeef", "window@a", False))
+    frame = fdelta.decode_frame(data)
+    assert frame.trace_ctx == TraceContext("deadbeef", "window@a", False)
+    assert frame.trace_ctx.sampled is False
+
+
 def test_zlib_codec_roundtrip_host_local():
     """zlib frames roundtrip (not golden-pinned: deflate bytes may vary
     across zlib builds; only the RAW form is pinned byte-exact)."""

@@ -369,6 +369,52 @@ def test_debug_traces_and_jax_routes():
         srv.shutdown()
 
 
+def test_debug_traces_params_and_executables_route():
+    """?limit= caps the trace list, ?trace= is the single-id lookup (the
+    cross-process correlation URL), a malformed limit is ignored, and
+    /debug/executables serves the accounting registry with an index
+    description."""
+    from netobserv_tpu.server import start_debug_server
+
+    tracing.configure(sample=1.0, capacity=8)
+    tracing.recorder.clear()
+    ids = []
+    for _ in range(3):
+        t = tracing.start_trace("batch")
+        with t.stage("evict"):
+            pass
+        t.finish()
+        ids.append(t.trace_id)
+    srv = start_debug_server("127.0.0.1:0")
+    try:
+        _, _, body = _get(srv, "/debug/traces?limit=2")
+        assert len(json.loads(body)["traces"]) == 2
+        _, _, body = _get(srv, f"/debug/traces?trace={ids[0]}")
+        got = json.loads(body)["traces"]
+        assert [t["trace_id"] for t in got] == [ids[0]]
+        _, _, body = _get(srv, "/debug/traces?trace=no-such-id")
+        assert json.loads(body)["traces"] == []
+        _, _, body = _get(srv, "/debug/traces?limit=bogus")
+        assert len(json.loads(body)["traces"]) == 3  # param ignored
+
+        status, ctype, body = _get(srv, "/debug/executables")
+        assert status == 200 and ctype.startswith("application/json")
+        obj = json.loads(body)
+        assert isinstance(obj["executables"], list)
+        assert obj["retraces_total"] == retrace.total_retraces()
+        for row in obj["executables"]:
+            assert {"fn", "calls", "compiles", "retraces",
+                    "dispatch_seconds", "compile_seconds",
+                    "donated_bytes_estimate"} <= row.keys()
+
+        _, _, body = _get(srv, "/debug")
+        line = next(ln for ln in body.decode().splitlines()
+                    if ln.startswith("/debug/executables"))
+        assert len(line.split(None, 1)[1]) > 10
+    finally:
+        srv.shutdown()
+
+
 # --- retrace watchdog ------------------------------------------------------
 
 def test_retrace_watchdog_counts_post_warmup_recompiles():
@@ -409,7 +455,12 @@ def test_retrace_warmup_window_suppresses_false_positives():
     fn(jnp.ones(8))
     assert fn.compiles == 2 and fn.retraces == 0
     text = generate_latest(m.registry).decode()
-    assert 'fn="warmup_entry"' not in text
+    # no RETRACE series for this entry (warmup suppressed the alarm);
+    # the accounting registry's dispatch counter still reports it — that
+    # is attribution, not an alarm
+    assert 'sketch_retraces_total{fn="warmup_entry"}' not in text
+    assert ('executable_dispatch_seconds_total{fn="warmup_entry"}'
+            in text)
 
 
 def test_retrace_watchdog_on_real_ingest_changed_batch_shape():
