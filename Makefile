@@ -69,6 +69,15 @@ bench-topk:
 bench-tiered:
 	JAX_PLATFORMS=cpu $(PY) bench.py --tiered-only
 
+# multi-tenant stacked sketch plane (~2-4 min, CPU-friendly): the
+# one-dispatch-folds-every-tenant amortization ladder (N=1/8/64 tenants,
+# small per-tenant batches) vs N sequential single-tenant dispatches of
+# the same rows, plus per-tenant top-K recall through the production
+# router — the non-gating CI artifact for SKETCH_TENANTS
+# (docs/architecture.md "Multi-tenant sketch planes")
+bench-tenants:
+	JAX_PLATFORMS=cpu $(PY) bench.py --tenants-only
+
 # sketch warehouse (~60s, CPU-friendly): per-window write amplification,
 # raw-vs-compacted segment bytes, range-merge rate per ladder k, range
 # top-K recall vs the union oracle — the non-gating CI artifact for the

@@ -153,7 +153,12 @@ class AlertEngine:
                     if self._metrics is not None:
                         self._metrics.count_error("alerts")
                 for inst in instances:
-                    fp = (rule.name, inst["bucket"])
+                    # tenant-mode snapshots stamp their plane id: the
+                    # fingerprint carries it so tenant A's flood and
+                    # tenant B's flood on the same victim bucket raise,
+                    # streak and clear INDEPENDENTLY (None otherwise —
+                    # single-tenant fingerprints are unchanged)
+                    fp = (rule.name, inst["bucket"], snap.get("tenant"))
                     firing_now.add(fp)
                     st = self._states.get(fp)
                     if st is None:
@@ -281,18 +286,20 @@ class AlertEngine:
             "snapshot_seq": snap.get("seq", 0),
             "ts_ms": snap.get("ts_ms") or 0,
             "since_window": st.since_window,
+            **({"tenant": fp[2]} if fp[2] is not None else {}),
         }
 
     def _build_view_locked(self, window, ts_ms: int, seq: int,
                            mid_window: bool) -> dict:
         active = []
-        for (rule_name, bucket), st in self._states.items():
+        for (rule_name, bucket, tenant), st in self._states.items():
             if not st.active:
                 continue
             rule = self._rule(rule_name)
             active.append({
                 "rule": rule_name, "severity": rule.severity,
                 "bucket": bucket,
+                **({"tenant": tenant} if tenant is not None else {}),
                 "victims": list(st.detail.get("victims", ())),
                 "value": st.detail.get("value", 0.0),
                 "since_window": st.since_window,

@@ -35,7 +35,7 @@ from netobserv_tpu.archive.store import ArchiveStore
 log = logging.getLogger("netobserv_tpu.archive")
 
 __all__ = ["ArchiveQueryEngine", "ArchiveStore", "SketchArchive",
-           "maybe_archive"]
+           "TenantArchiveSet", "maybe_archive", "tenant_archives"]
 
 
 class SketchArchive:
@@ -95,6 +95,90 @@ class SketchArchive:
 
     def stats(self) -> dict:
         return self.engine.stats()
+
+
+class TenantArchiveSet:
+    """SKETCH_TENANTS x ARCHIVE_DIR: one `SketchArchive` per tenant, each
+    over its own ``<archive_dir>/tenant-<t>`` store — segments, retention
+    ladders and range answers stay tenant-local (planes are independent by
+    construction; merging tenant segments would invent a cross-tenant view
+    the live plane doesn't have). The exporter writes through
+    `write_tenant_window`; `/query/range` resolves ``?tenant=`` here with
+    the same 400/404 contract as the snapshot routes."""
+
+    def __init__(self, archives: list):
+        if not archives:
+            raise ValueError("TenantArchiveSet needs >= 1 tenant archive")
+        self._archives = archives
+
+    @property
+    def n_tenants(self) -> int:
+        return len(self._archives)
+
+    def write_tenant_window(self, host_tables: dict, window: int,
+                            ts_ms: int, tenant: int) -> None:
+        self._archives[int(tenant)].write_window(host_tables, window, ts_ms)
+
+    def route_payload(self, params: dict,
+                      view: Optional[str] = None) -> tuple[int, dict]:
+        if params.get("tenant") is None:
+            return 400, {
+                "error": "tenant is required (SKETCH_TENANTS mode)",
+                "tenants": len(self._archives)}
+        try:
+            tid = int(params["tenant"])
+        except ValueError:
+            return 400, {"error": f"bad tenant {params['tenant']!r}",
+                         "tenants": len(self._archives)}
+        if not 0 <= tid < len(self._archives):
+            return 404, {"error": f"unknown tenant {tid}",
+                         "tenants": len(self._archives)}
+        return self._archives[tid].route_payload(params, view)
+
+    def stats(self) -> dict:
+        per = [a.stats() for a in self._archives]
+        return {
+            "tenants": len(per),
+            "segments": sum(p.get("segments", 0) for p in per),
+            "disk_bytes": sum(p.get("disk_bytes", 0) for p in per),
+            "per_tenant": {str(t): p for t, p in enumerate(per)},
+        }
+
+
+def tenant_archives(cfg, sketch_cfg, n_tenants: int, metrics=None,
+                    agent_id: str = "") -> Optional["TenantArchiveSet"]:
+    """`maybe_archive`'s tenant-mode twin: one per-tenant store under
+    ``<archive_dir>/tenant-<t>``, same retention knobs and threshold
+    wiring. Ladders warm lazily (warm=False): N background compile
+    threads per agent start would be the superbatch-ladder anti-pattern —
+    the per-tenant engines share compiled-shape caches via jit anyway."""
+    if not getattr(cfg, "archive_dir", ""):
+        return None
+    import os
+
+    report_kwargs = dict(
+        scan_fanout_threshold=cfg.sketch_scan_fanout,
+        ddos_z_threshold=cfg.sketch_ddos_z,
+        synflood_min=cfg.sketch_synflood_min,
+        synflood_ratio=cfg.sketch_synflood_ratio,
+        drop_z_threshold=cfg.sketch_drop_z,
+        asym_min_bytes=cfg.sketch_asym_min_bytes,
+        asym_ratio=cfg.sketch_asym_ratio,
+        churn_ascent=cfg.sketch_churn_ascent,
+        churn_min_bytes=cfg.sketch_churn_min_bytes)
+    archives = []
+    for t in range(int(n_tenants)):
+        store = ArchiveStore(os.path.join(cfg.archive_dir, f"tenant-{t}"),
+                             raw_windows=cfg.archive_raw_windows,
+                             compact_group=cfg.archive_compact_group,
+                             max_levels=cfg.archive_max_levels,
+                             metrics=metrics)
+        archives.append(SketchArchive(
+            store, sketch_cfg, metrics=metrics,
+            agent_id=agent_id or cfg.federation_agent_id,
+            ladder_max=cfg.archive_merge_ladder_max, warm=False,
+            report_kwargs=report_kwargs))
+    return TenantArchiveSet(archives)
 
 
 def maybe_archive(cfg, sketch_cfg, metrics=None,

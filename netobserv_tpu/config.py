@@ -403,6 +403,15 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     sketch_tier_bytes_unit: int = field(
         default=256, **_env("SKETCH_TIER_BYTES_UNIT", "256"))
     sketch_decay_factor: float = field(default=0.5, **_env("SKETCH_DECAY_FACTOR", "0.5"))
+    #: multi-tenant sketch planes (sketch/tenancy.py): > 0 stacks that many
+    #: independent tenant states on a leading axis — ONE vmapped dispatch
+    #: folds every tenant's evictions (rows route by a key-derived
+    #: `ops/hashing.tenant_of` owner) and ONE roll closes every tenant's
+    #: window; /query/*?tenant=, alerts, archive segments and delta frames
+    #: fan out per tenant. 0 (default) is bit-identical to the
+    #: single-tenant path (no stack object, one is-None check).
+    #: Single-device only (config.validate rejects SKETCH_MESH_SHAPE).
+    sketch_tenants: int = field(default=0, **_env("SKETCH_TENANTS", "0"))
     #: host->device feed format: "resident" (default, ~15B/record
     #: slot-id rows against a device key table; sharded meshes use one
     #: dictionary+table per data shard), "compact" (40B v4-compact rows,
@@ -657,6 +666,13 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
                     "SKETCH_TIERED has no owner-sharded form yet (tiered "
                     "counter planes are single-device); unset "
                     "SKETCH_MESH_SHAPE or SKETCH_TIERED")
+        if self.sketch_tenants < 0:
+            raise ValueError("SKETCH_TENANTS must be >= 0")
+        if self.sketch_tenants and self.sketch_mesh_shape:
+            raise ValueError(
+                "SKETCH_TENANTS has no mesh-sharded form yet (the tenant "
+                "stack is single-device, like SKETCH_TIERED); unset "
+                "SKETCH_MESH_SHAPE or SKETCH_TENANTS")
         if not (4 <= self.sketch_hll_precision <= 18):
             raise ValueError("SKETCH_HLL_PRECISION must be in [4, 18]")
         if self.sketch_window_mode not in ("reset", "decay"):

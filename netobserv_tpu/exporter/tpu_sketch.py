@@ -384,7 +384,8 @@ class TpuSketchExporter(Exporter):
                  alerts=None,
                  archive=None,
                  churn_ascent: float = DEFAULT_CHURN_ASCENT,
-                 churn_min_bytes: float = DEFAULT_CHURN_MIN_BYTES):
+                 churn_min_bytes: float = DEFAULT_CHURN_MIN_BYTES,
+                 tenants: int = 0):
         # superbatch defaults to NO ladder for direct construction: the
         # ladder costs superbatch_max-sized ring buffers, dictionaries and
         # key-table rows up front, and only pays off once warmed — the
@@ -412,6 +413,10 @@ class TpuSketchExporter(Exporter):
         # updated only at closed-window renders — a mid-window refresh
         # diffs against the same last-closed window, never against itself
         self._prev_heavy_index: Optional[dict] = None
+        #: tenant-mode twin of _prev_heavy_index: one slot per tenant (the
+        #: EvictedKeys diff is per tenant plane — cross-tenant diffs would
+        #: read every routed key as churned)
+        self._tenant_prev_heavy: dict[int, Optional[dict]] = {}
         self._metrics = metrics
         # federation delta export (federation/delta.py): snapshot the
         # mergeable tables at roll, frame + push them on the timer thread
@@ -511,6 +516,18 @@ class TpuSketchExporter(Exporter):
         import jax
         devs = jax.devices()
         self._distributed = len(devs) > 1 or ("x" in mesh_shape)
+        #: multi-tenant sketch stack (SKETCH_TENANTS, sketch/tenancy.py):
+        #: N tenant states on a leading axis, ONE vmapped dispatch folds
+        #: every tenant's evictions. None (unset) keeps every path
+        #: bit-identical — no stack object, one is-None check.
+        self._tenancy = None
+        if tenants and self._distributed:
+            # no mesh-sharded stacked form yet (config.validate blocks the
+            # env combination; direct construction degrades gracefully —
+            # the SKETCH_TIERED pattern)
+            log.warning("SKETCH_TENANTS has no mesh-sharded form; running "
+                        "the mesh exporter single-tenant")
+            tenants = 0
         if self._distributed and self._cfg.tiered is not None:
             # no owner-sharded tier form yet (config.validate blocks the
             # env combination; direct construction degrades gracefully —
@@ -604,6 +621,24 @@ class TpuSketchExporter(Exporter):
                 self._ring = staging.DenseStagingRing(
                     self._batch_size, ingest_dense, put=dense_put,
                     metrics=metrics, pack_threads=pack_threads)
+        elif tenants:
+            from netobserv_tpu.sketch import tenancy
+            self._ndata = 1
+            self._tenancy = tenancy.TenantStack(
+                tenants, self._cfg, self._batch_size, metrics=metrics,
+                decay_factor=decay_factor)
+            self._state = tenancy.init_stacked_state(self._cfg, tenants)
+            # the Record path routes through the stack's fold_rows; there
+            # is no separate unstacked ingest entry to dispatch
+            self._ingest = None
+            self._with_tables = True
+            # ONE stacked roll closes every tenant's window; _roll_locked
+            # drives it through the same (state, report, tables) contract
+            self._roll = self._tenancy.roll
+            self._ring = self._tenancy
+            if feed != "dense":
+                log.info("tenant mode ships the dense stacked feed; "
+                         "SKETCH_FEED=%r does not apply", feed)
         else:
             self._ndata = 1
             self._state = sk.init_state(self._cfg)
@@ -625,6 +660,15 @@ class TpuSketchExporter(Exporter):
                 "roll")
             self._ring = self._make_single_device_ring(
                 feed, resident_slots, pack_threads, metrics)
+        if self._tenancy is not None and self._ckpt is not None:
+            # no stacked-tenant checkpoint layout yet: a wide-era restore
+            # into the (N, ...) stack (or vice versa) would tear — refuse
+            # with a warning rather than save state a future single-tenant
+            # agent restores corrupt (the SKETCH_TIERED degradation rule)
+            log.warning("sketch checkpointing has no stacked-tenant form; "
+                        "disabling it while SKETCH_TENANTS is set")
+            self._ckpt.close()
+            self._ckpt = None
         # zero-concat eviction accumulator (columnar fast path): rows copy
         # once into a preallocated rolling buffer instead of per-fold
         # np.concatenate over events + five feature lanes. Sized for the
@@ -662,6 +706,13 @@ class TpuSketchExporter(Exporter):
         # no new jitted entry, so the refresh can never retrace.
         from netobserv_tpu.query import QueryRoutes, SnapshotPublisher
         self.query = SnapshotPublisher(history=query_history)
+        #: tenant-mode query plane: one publisher per tenant — every data
+        #: route resolves ?tenant= to its publisher (query/routes.py); the
+        #: shared `self.query` slot stays unused so no route can serve one
+        #: tenant's estimates as another's
+        self._tenant_query = (
+            [SnapshotPublisher(history=query_history)
+             for _ in range(tenants)] if self._tenancy is not None else None)
         # continuous detection plane (netobserv_tpu/alerts): the engine
         # rides EVERY snapshot publish (roll + mid-window refresh) on the
         # timer thread — host-only, no new jit, nothing on the fold path.
@@ -680,15 +731,33 @@ class TpuSketchExporter(Exporter):
             log.warning("sketch archive needs a data-axis-only mesh; "
                         "disabling it on this exporter")
             archive = None
+        if self._tenancy is not None and archive is not None and \
+                not hasattr(archive, "write_tenant_window"):
+            # tenant segments must land in per-tenant stores (mixing them
+            # would merge tenants at range-query time); from_config builds
+            # the set — a direct single-store archive degrades off
+            log.warning("tenant mode needs a per-tenant archive set "
+                        "(archive.tenant_archives); disabling the archive "
+                        "on this exporter")
+            archive = None
         self._archive = archive
         self.query_routes = QueryRoutes(self.query.get, self.query_status,
                                         metrics=metrics,
                                         history_fn=self.query.get_window,
                                         windows_fn=self.query.windows,
                                         alerts=alerts,
-                                        archive=archive)
+                                        archive=archive,
+                                        tenant_publishers=self._tenant_query)
         if metrics is not None:
-            metrics.query_snapshot_age_seconds.set_function(self.query.age_s)
+            if self._tenant_query is not None:
+                # freshness = the most recent tenant publish (all tenants
+                # publish together at roll; a refresh updates all of them)
+                pubs = self._tenant_query
+                metrics.query_snapshot_age_seconds.set_function(
+                    lambda: min(p.age_s() for p in pubs))
+            else:
+                metrics.query_snapshot_age_seconds.set_function(
+                    self.query.age_s)
         self._query_refresh_s = query_refresh_s
         if query_refresh_s and jax.process_count() > 1:
             # each process's timer would dispatch the roll's collectives on
@@ -931,6 +1000,13 @@ class TpuSketchExporter(Exporter):
                             "(SKETCH_MESH_SHAPE=%s): no whole-width "
                             "table snapshot exists — archive disabled",
                             cfg.sketch_mesh_shape)
+            elif cfg.sketch_tenants > 0:
+                # per-tenant stores under ARCHIVE_DIR/tenant-<t>: range
+                # queries stay tenant-scoped (archive.tenant_archives)
+                from netobserv_tpu.archive import tenant_archives
+                archive = tenant_archives(cfg, sketch_cfg,
+                                          cfg.sketch_tenants,
+                                          metrics=metrics)
             else:
                 archive = maybe_archive(cfg, sketch_cfg, metrics=metrics)
         return cls(delta_sink=delta_sink, agent_id=cfg.federation_agent_id,
@@ -961,6 +1037,7 @@ class TpuSketchExporter(Exporter):
                    archive=archive,
                    churn_ascent=cfg.sketch_churn_ascent,
                    churn_min_bytes=cfg.sketch_churn_min_bytes,
+                   tenants=cfg.sketch_tenants,
                    warm_ladder=True,
                    decay_factor=(cfg.sketch_decay_factor
                                  if cfg.sketch_window_mode == "decay" else None))
@@ -1314,6 +1391,19 @@ class TpuSketchExporter(Exporter):
             self._fold(self._pending)
             self._pending = []
         self._pending_buf.flush_to(self._fold_events)
+        if self._tenancy is not None:
+            # ship any partially-filled tenant buffers as one last stacked
+            # fold — a roll (or refresh) must never strand routed rows
+            try:
+                self._state = self._tenancy.flush(self._state)
+            except staging.StagingWedged as exc:
+                if exc.state is not None:
+                    self._state = exc.state
+                log.error("tenant flush hit the slot-wait budget "
+                          "(buffered rows dropped): %s", exc)
+                if self._metrics is not None:
+                    self._metrics.sketch_ingest_errors_total.inc()
+                    self._metrics.count_error("tpu-sketch-ingest")
 
     def _close_window_locked(self) -> None:
         """Drain pending rows and dispatch the roll, under ONE window trace
@@ -1386,6 +1476,8 @@ class TpuSketchExporter(Exporter):
         if warm is not None and warm.is_alive():
             warm.join(timeout=30.0)
         self.flush()
+        if self._tenancy is not None:
+            self._tenancy.close()  # per-tenant series label hygiene
         if self._ckpt is not None:
             self._ckpt.close()
         sink_close = getattr(self._sink, "close", None)
@@ -1514,11 +1606,34 @@ class TpuSketchExporter(Exporter):
                                                batch_size=self._batch_size)
             try:
                 faultinject.fire("sketch.ingest")
-                with trace.stage("ingest_dispatch"):
+                if self._tenancy is not None:
+                    # Record path in tenant mode: pack through the columnar
+                    # twin (arrays_to_dense IS the pinned dense layout) and
+                    # route the valid rows — padding must not spend tenant
+                    # fill-buffer slots
                     arrays = self._sk.batch_to_device(batch)
-                    if self._distributed:
-                        arrays = self._pm.shard_batch(self._mesh, arrays)
-                    self._state = self._ingest(self._state, arrays)
+                    rows = self._sk.arrays_to_dense(arrays).reshape(
+                        -1, self._sk.DENSE_WORDS)
+                    self._state = self._tenancy.fold_rows(
+                        self._state, rows[arrays["valid"]], trace=trace)
+                else:
+                    with trace.stage("ingest_dispatch"):
+                        arrays = self._sk.batch_to_device(batch)
+                        if self._distributed:
+                            arrays = self._pm.shard_batch(self._mesh,
+                                                          arrays)
+                        self._state = self._ingest(self._state, arrays)
+            except staging.StagingWedged as exc:
+                # tenant path only: adopt the wedge's state (dispatched
+                # stacked folds donated the reference we passed in)
+                if exc.state is not None:
+                    self._state = exc.state
+                log.error("staging slot-wait budget exceeded (up to %d "
+                          "rows dropped): %s", len(records), exc)
+                if self._metrics is not None:
+                    self._metrics.sketch_ingest_errors_total.inc()
+                    self._metrics.count_error("tpu-sketch-ingest")
+                return
             except Exception as exc:
                 self._count_ingest_error(len(records), exc)
                 return
@@ -1600,11 +1715,17 @@ class TpuSketchExporter(Exporter):
                 finally:
                     wtrace.finish()
 
-    def _render_report(self, report, roll: bool = False) -> dict:
+    def _render_report(self, report, roll: bool = False,
+                       tenant: Optional[int] = None) -> dict:
         """Render a device WindowReport with THIS exporter's thresholds.
         `roll=True` (closed-window publishes) additionally rotates the
         previous-roll heavy index the EvictedKeys diff reads — refreshes
-        keep diffing against the last CLOSED window."""
+        keep diffing against the last CLOSED window. `tenant` (tenant-mode
+        fan-out) renders one tenant's slice of the stacked report against
+        that tenant's OWN previous-roll index and stamps the id into the
+        report object."""
+        prev = (self._prev_heavy_index if tenant is None
+                else self._tenant_prev_heavy.get(tenant))
         obj = report_to_json(
             report, scan_fanout_threshold=self._scan_fanout,
             ddos_z_threshold=self._ddos_z,
@@ -1615,18 +1736,27 @@ class TpuSketchExporter(Exporter):
             asym_ratio=self._asym_ratio,
             churn_ascent=self._churn_ascent,
             churn_min_bytes=self._churn_min_bytes,
-            prev_heavy_index=self._prev_heavy_index,
+            prev_heavy_index=prev,
             partial_window=not roll)
         if roll:
-            self._prev_heavy_index = heavy_identity_index(report)
+            idx = heavy_identity_index(report)
+            if tenant is None:
+                self._prev_heavy_index = idx
+            else:
+                self._tenant_prev_heavy[tenant] = idx
+        if tenant is not None:
+            obj["Tenant"] = int(tenant)
         return obj
 
     def _publish_query_snapshot(self, obj: dict, tables,
-                                mid_window: bool = False) -> None:
+                                mid_window: bool = False,
+                                tenant: Optional[int] = None) -> None:
         """Swap in a fresh query snapshot (query/snapshot.py seq-stamps it).
         The np.asarray touch is the device->host transfer of the CM planes
         — per window (or per refresh), on the timer thread, never under
-        the exporter lock."""
+        the exporter lock. `tenant` routes the snapshot to that tenant's
+        publisher (tenant-mode fan-out) and rides in the snap dict — the
+        alert engine's fingerprints and /query responses carry it."""
         snap = {
             "window": obj["Window"],
             "ts_ms": obj["TimestampMs"],
@@ -1636,7 +1766,11 @@ class TpuSketchExporter(Exporter):
             "cm_pkts": (np.asarray(tables["cm_pkts"])
                         if tables is not None else None),
         }
-        self.query.publish(snap, mid_window=mid_window)
+        if tenant is not None:
+            snap["tenant"] = int(tenant)
+            self._tenant_query[tenant].publish(snap, mid_window=mid_window)
+        else:
+            self.query.publish(snap, mid_window=mid_window)
         # alert evaluation rides the publish it just observed (timer
         # thread); safe_evaluate swallows+counts — a failing evaluation
         # can never lose the snapshot (already swapped in) or the report
@@ -1666,6 +1800,19 @@ class TpuSketchExporter(Exporter):
             # warehouse discovery: segment counts/levels/disk bytes so a
             # poller can range-query without probing for 404s
             st["archive"] = self._archive.stats()
+        if self._tenant_query is not None:
+            # tenant discovery: which planes have published, and each one's
+            # current window — read each publisher ONCE (same torn-read
+            # rule as the top-level snapshot)
+            snaps_t = [p.get() for p in self._tenant_query]
+            st["tenants"] = {
+                "n": len(self._tenant_query),
+                "published": sum(1 for s in snaps_t if s is not None),
+                "stacked_folds": self._tenancy.folds,
+                "routed_rows": self._tenancy.routed_rows,
+                "windows": {str(t): (None if s is None else s["window"])
+                            for t, s in enumerate(snaps_t)},
+            }
         if snap is not None:
             st.update({"published": True, "seq": snap["seq"],
                        "window": snap["window"],
@@ -1722,8 +1869,25 @@ class TpuSketchExporter(Exporter):
             _discard, report, tables = out
         else:
             (_discard, report), tables = out, None
+        ts_ms = time.time_ns() // 1_000_000
+        if self._tenancy is not None:
+            # stacked refresh: one staged roll already closed every
+            # tenant's view — fan the slices out to the per-tenant
+            # publishers (mid-window publishes never enter history rings)
+            from netobserv_tpu.sketch import tenancy
+            nt = self._tenancy.n_tenants
+            reps = tenancy.split_tenants(report, nt)
+            tabs = (tenancy.split_tenants(tables, nt)
+                    if tables is not None else [None] * nt)
+            faultinject.fire("sketch.query_snapshot")
+            for t, (rep, tab) in enumerate(zip(reps, tabs)):
+                obj = self._render_report(rep, tenant=t)
+                obj["TimestampMs"] = ts_ms
+                self._publish_query_snapshot(obj, tab, mid_window=True,
+                                             tenant=t)
+            return
         obj = self._render_report(report)
-        obj["TimestampMs"] = time.time_ns() // 1_000_000
+        obj["TimestampMs"] = ts_ms
         faultinject.fire("sketch.query_snapshot")
         self._publish_query_snapshot(obj, tables, mid_window=True)
 
@@ -1742,7 +1906,7 @@ class TpuSketchExporter(Exporter):
                                                 "tiered_decode")
         return self._tiered_decode(state)
 
-    def _publish_tier_metrics(self, tables) -> None:
+    def _publish_tier_metrics(self, tables, tenant=None) -> None:
         """Per-window tier telemetry from the published WIDE tables (the
         host copy the snapshot already paid for). The counter counts NEW
         promotions only: counters at/past base saturation this window that
@@ -1759,15 +1923,21 @@ class TpuSketchExporter(Exporter):
             promoted = np.asarray(tables[table]) >= span
             fresh = promoted
             if self._tier_sticky_promotions:
-                prev = self._tier_prev_promoted.get(table)
+                prev = self._tier_prev_promoted.get((table, tenant))
                 if prev is not None:
                     fresh = promoted & ~prev
-                self._tier_prev_promoted[table] = promoted
+                self._tier_prev_promoted[(table, tenant)] = promoted
             self._metrics.sketch_tier_promotions_total.labels(
                 table=table).inc(int(fresh.sum()))
 
     def _publish_report(self, report, wtrace=tracing.NULL_TRACE,
                         tables=None) -> None:
+        if self._tenancy is not None:
+            # stacked roll output: fan every tenant's slice out through the
+            # same publish discipline (delta -> render -> snapshot -> sink
+            # -> archive, each failure domain its own try)
+            self._publish_report_tenants(report, wtrace, tables)
+            return
         self._windows_published += 1  # telemetry: counts THIS window
         if self._delta_sink is not None and tables is not None:
             # federation delta FIRST, in its own try: a dead aggregator (or
@@ -1875,3 +2045,116 @@ class TpuSketchExporter(Exporter):
             for sig, key in SIGNAL_FIELDS.items():
                 self._metrics.sketch_window_suspects.labels(sig).set(
                     len(obj[key]))
+
+    def _publish_report_tenants(self, report, wtrace=tracing.NULL_TRACE,
+                                tables=None) -> None:
+        """Tenant-mode publish: split the stacked roll outputs ONCE (one
+        device pull for the whole stack, then zero-copy per-tenant views)
+        and run every tenant's slice through the same publish seams as the
+        single-tenant path — delta frames first (per-tenant TenantInfo on
+        the wire), render with per-tenant heavy-identity rotation, per-
+        tenant snapshot publishes + alert evaluations, the sink, and
+        per-tenant archive segments. Each failure domain keeps its own try
+        and its single-tenant semantics: a dead aggregator loses frames,
+        never the reports; a failing snapshot publish loses one tenant's
+        freshness, never the window."""
+        from netobserv_tpu.sketch import tenancy
+        n = self._tenancy.n_tenants
+        self._windows_published += 1  # telemetry: counts THIS window
+        with wtrace.stage("report_render"):
+            reps = tenancy.split_tenants(report, n)
+            tabs = (tenancy.split_tenants(tables, n)
+                    if tables is not None else [None] * n)
+            objs = [self._render_report(rep, roll=True, tenant=t)
+                    for t, rep in enumerate(reps)]
+        ts_ms = time.time_ns() // 1_000_000
+        for obj in objs:
+            obj["TimestampMs"] = ts_ms
+        if self._delta_sink is not None and tables is not None:
+            try:
+                with wtrace.stage("report_serialize"):
+                    faultinject.fire("sketch.delta_export")
+                    from netobserv_tpu.federation import delta as fdelta
+                    ctx = tracing.context_of(
+                        wtrace, origin=f"window@{self._agent_id}")
+                    if ctx is not None and self._metrics is not None:
+                        self._metrics.trace_context_propagated_total.labels(
+                            "stamped").inc()
+                    # ONE telemetry block per window (the publish-rate EWMA
+                    # must see one publish, not N), stamped into every
+                    # tenant's frame; window_seq rides the shared window
+                    # counter — the aggregator's ledger keys per
+                    # (agent, tenant) source (federation.delta.source_key)
+                    total = sum(int(float(tab["scalars"][0]))
+                                for tab in tabs)
+                    tel = self._telemetry_block(total)
+                    dims = {"cm_depth": self._cfg.cm_depth,
+                            "cm_width": self._cfg.cm_width,
+                            "hll_precision": self._cfg.hll_precision,
+                            "topk": self._cfg.topk,
+                            "ewma_buckets": self._cfg.ewma_buckets}
+                    window = int(reps[0].window)
+                    frames = [fdelta.encode_frame(
+                        {k: np.asarray(v) for k, v in tab.items()},
+                        agent_id=self._agent_id, window=window,
+                        ts_ms=ts_ms, agent_epoch=self._agent_epoch,
+                        trace_ctx=ctx, telemetry=tel, tenant=(t, n),
+                        dims=dims) for t, tab in enumerate(tabs)]
+                with wtrace.stage("delta_push"):
+                    for frame in frames:
+                        self._delta_sink(frame)  # sink swallows+counts
+            except Exception as exc:
+                log.error("tenant delta frame serialize/push failed "
+                          "(frames lost, reports still publish): %s", exc)
+                if self._metrics is not None:
+                    self._metrics.count_error("federation")
+        with wtrace.stage("query_snapshot"):
+            for t, (obj, tab) in enumerate(zip(objs, tabs)):
+                try:
+                    faultinject.fire("sketch.query_snapshot")
+                    self._publish_query_snapshot(obj, tab, tenant=t)
+                except Exception as exc:
+                    log.error("tenant %d query snapshot publish failed "
+                              "(window report still publishes): %s", t, exc)
+                    if self._metrics is not None:
+                        self._metrics.count_error("tpu-sketch-query")
+        with wtrace.stage("report_sink"):
+            for obj in objs:
+                self._sink(obj)
+        if self._archive is not None and tables is not None:
+            try:
+                with wtrace.stage("archive_write"):
+                    faultinject.fire("sketch.archive_write")
+                    for t, (obj, tab) in enumerate(zip(objs, tabs)):
+                        self._archive.write_tenant_window(
+                            {k: np.asarray(v) for k, v in tab.items()},
+                            window=int(obj["Window"]), ts_ms=ts_ms,
+                            tenant=t)
+            except Exception as exc:
+                log.error("tenant archive segment write failed (window %s "
+                          "not fully archived; reports already "
+                          "published): %s", objs[0]["Window"], exc)
+                if self._metrics is not None:
+                    self._metrics.count_error("tpu-sketch-archive")
+        if self._metrics is not None:
+            m = self._metrics
+            m.sketch_heavy_evictions_total.inc(
+                sum(o["HeavyChurn"]["evictions"] for o in objs))
+            if self._cfg.tiered is not None and tables is not None:
+                try:
+                    for t, tab in enumerate(tabs):
+                        self._publish_tier_metrics(tab, tenant=t)
+                except Exception as exc:  # telemetry never loses a report
+                    log.warning("tier metrics publish failed: %s", exc)
+            m.sketch_window_reports_total.inc()
+            # agent-level gauges aggregate across tenants; the per-tenant
+            # series carries each plane's own window totals
+            m.sketch_window_records.set(sum(o["Records"] for o in objs))
+            m.sketch_window_drop_bytes.set(
+                sum(o["DropBytes"] for o in objs))
+            for t, obj in enumerate(objs):
+                m.sketch_tenant_window_records.labels(str(t)).set(
+                    obj["Records"])
+            for sig, key in SIGNAL_FIELDS.items():
+                m.sketch_window_suspects.labels(sig).set(
+                    sum(len(o[key]) for o in objs))

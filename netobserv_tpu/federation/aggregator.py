@@ -432,7 +432,11 @@ class FederationAggregator:
         """
         if frame.version < 2:
             return "legacy"
-        last = self._ledger.get(frame.agent_id)
+        # tenant planes ledger independently (fdelta.source_key): a
+        # multi-tenant agent's N frames per window share agent_id, epoch
+        # and window_seq — keyed by bare agent_id, tenants 1..N-1 would
+        # read as stale deliveries of tenant 0's frame and be discarded
+        last = self._ledger.get(fdelta.source_key(frame))
         if last is None or frame.agent_epoch > last["epoch"]:
             return "ok"
         if frame.agent_epoch < last["epoch"]:
@@ -454,15 +458,16 @@ class FederationAggregator:
         self-healing path is the TTL eviction forgetting the poisoned
         ledger entry so the agent can re-register — stale frames keeping
         it 'alive' would block that forever."""
-        last = self._ledger.get(frame.agent_id)
+        src = fdelta.source_key(frame)
+        last = self._ledger.get(src)
         if last is not None and frame.agent_epoch < last["epoch"]:
             log.warning(
                 "agent %r sent epoch %d below its ledger epoch %d (clock "
                 "step-back across a restart?) — frames discarded as stale "
                 "until the FEDERATION_AGENT_TTL eviction re-admits it",
-                frame.agent_id, frame.agent_epoch, last["epoch"])
-        if verdict == "duplicate" and frame.agent_id in self._agents:
-            info = self._agents[frame.agent_id]
+                src, frame.agent_epoch, last["epoch"])
+        if verdict == "duplicate" and src in self._agents:
+            info = self._agents[src]
             info["last_ms"] = time.time() * 1e3
             info["last_mono"] = time.monotonic()
 
@@ -507,7 +512,8 @@ class FederationAggregator:
                 self._mesh, np.ascontiguousarray(arr))
                 for name, arr in host_tables.items()}
             owner = self._pm.put_replicated(self._mesh, np.asarray(
-                [agent_owner_shard(frame.agent_id, self._ndata)], np.int32))
+                [agent_owner_shard(fdelta.source_key(frame),
+                                   self._ndata)], np.int32))
         else:
             tables = {name: jax.device_put(arr)
                       for name, arr in host_tables.items()}
@@ -523,16 +529,17 @@ class FederationAggregator:
                 self._state = self._fold(self._state, tables, owner)
             else:
                 self._state = self._fold(self._state, tables)
+            src = fdelta.source_key(frame)
             if verdict == "ok":
-                self._ledger[frame.agent_id] = {
+                self._ledger[src] = {
                     "epoch": frame.agent_epoch,
                     "window_seq": frame.window_seq,
                     "frame_uuid": frame.frame_uuid}
             self._frames_total += 1
-            self._window_agents.add(frame.agent_id)
+            self._window_agents.add(src)
             info = self._agents.setdefault(
-                frame.agent_id, {"frames": 0, "window": 0, "last_ms": 0.0,
-                                 "last_mono": 0.0})
+                src, {"frames": 0, "window": 0, "last_ms": 0.0,
+                      "last_mono": 0.0})
             info["frames"] += 1
             info["window"] = frame.window
             info["last_ms"] = time.time() * 1e3

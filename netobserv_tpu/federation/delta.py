@@ -167,6 +167,10 @@ class DeltaFrame(NamedTuple):
     # (trace_id, origin, sampled); telemetry is the per-agent health dict
     trace_ctx: Optional[tuple] = None
     telemetry: Optional[dict] = None
+    #: SKETCH_TENANTS plane identity: (tenant_id, n_tenants) when the
+    #: frame carries one tenant plane of a multi-tenant agent; None on
+    #: single-tenant frames (absent on the wire — explicit presence)
+    tenant: Optional[tuple] = None
 
 
 def table_spec_fingerprint() -> int:
@@ -184,7 +188,8 @@ def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
                  frame_uuid: str = "", agent_epoch: int = 0,
                  version: Optional[int] = None,
                  trace_ctx=None,
-                 telemetry: Optional[Mapping] = None) -> bytes:
+                 telemetry: Optional[Mapping] = None,
+                 tenant: Optional[tuple] = None) -> bytes:
     """Serialize a table snapshot into one SketchDelta frame.
 
     `tables` must carry every name of the frame version's spec (host numpy
@@ -212,6 +217,12 @@ def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
     a frame without them is byte-identical to the pre-fleet wire — not a
     format bump. The context encodes ONCE per frame, here — a retry
     resends the same bytes, never a re-derived context.
+
+    `tenant` (SKETCH_TENANTS agents only): the `(tenant_id, n_tenants)`
+    plane identity, same optional-message presence rules — None writes
+    zero bytes. The aggregator ledgers each tenant plane as its own
+    source (`source_key`), so N tenant frames per window do not read as
+    N-1 stale deliveries.
     """
     version = DELTA_FORMAT_VERSION if version is None else int(version)
     if version not in SUPPORTED_VERSIONS:
@@ -250,6 +261,9 @@ def encode_frame(tables: Mapping[str, np.ndarray], *, agent_id: str,
             telemetry.get("host_records_per_s", 0.0))
         tel.map_occupancy = float(telemetry.get("map_occupancy", 0.0))
         tel.windows_published = int(telemetry.get("windows_published", 0))
+    if version >= 3 and tenant is not None:
+        frame.tenant.id = int(tenant[0])
+        frame.tenant.n_tenants = int(tenant[1])
     n_scalars = len(SCALAR_FIELDS if version >= 3 else SCALAR_FIELDS_V2)
     for name, dt in spec:
         arr = np.asarray(tables[name])
@@ -345,13 +359,32 @@ def decode_frame(data: bytes) -> DeltaFrame:
             "map_occupancy": float(frame.telemetry.map_occupancy),
             "windows_published": int(frame.telemetry.windows_published),
         }
+    tenant = None
+    if frame.HasField("tenant"):
+        tenant = (int(frame.tenant.id), int(frame.tenant.n_tenants))
     return DeltaFrame(version=int(frame.version), agent_id=frame.agent_id,
                       window=int(frame.window), ts_ms=int(frame.ts_ms),
                       dims=dims, tables=tables,
                       window_seq=int(frame.window_seq),
                       frame_uuid=frame.frame_uuid,
                       agent_epoch=int(frame.agent_epoch),
-                      trace_ctx=trace_ctx, telemetry=telemetry)
+                      trace_ctx=trace_ctx, telemetry=telemetry,
+                      tenant=tenant)
+
+
+def source_key(frame: "DeltaFrame") -> str:
+    """The aggregator-side delivery-source identity of a frame.
+
+    A multi-tenant agent publishes N frames per closed window — same
+    agent_id, same agent_epoch, same window_seq, different tenant planes.
+    Keying the ledger by bare agent_id would read tenants 1..N-1 as
+    duplicate/stale deliveries of tenant 0's frame and DISCARD them, so
+    each tenant plane ledgers as its own source. Single-tenant frames
+    (tenant absent) keep the bare agent_id — existing ledgers, checkpoint
+    sidecars and fleet views are unchanged."""
+    if frame.tenant is None:
+        return frame.agent_id
+    return f"{frame.agent_id}#t{frame.tenant[0]}"
 
 
 def upgrade_tables(frame: DeltaFrame) -> dict:

@@ -237,6 +237,27 @@ class Metrics:
             "narrow for the traffic: raise SKETCH_TIER_BYTES_UNIT or "
             "widen the sketch)", ["table"],
             registry=self.registry)
+        # multi-tenant sketch planes (sketch/tenancy.py)
+        self.sketch_tenant_folds_total = Counter(
+            p + "sketch_tenant_folds_total",
+            "Stacked tenant-fold dispatches (SKETCH_TENANTS): each folds "
+            "EVERY tenant's pending rows as one vmapped executable — the "
+            "dispatch-amortization the tenant stack exists for (compare "
+            "against sketch_records_total for rows-per-dispatch)",
+            registry=self.registry)
+        self.sketch_tenants_active = Gauge(
+            p + "sketch_tenants_active",
+            "Tenant states stacked in the live tenant plane (0 = "
+            "single-tenant path; set at exporter construction, zeroed at "
+            "close when the per-tenant labelled series are evicted)",
+            registry=self.registry)
+        self.sketch_tenant_window_records = Gauge(
+            p + "sketch_tenant_window_records",
+            "Per-tenant records in the last closed window (cardinality = "
+            "LIVE tenants: series ride Metrics.remove_labeled when a "
+            "tenant plane is drained/closed — the federation "
+            "agent-eviction hygiene pattern)",
+            ["tenant"], registry=self.registry)
         self.sketch_resident_hbm_bytes = Gauge(
             p + "sketch_resident_hbm_bytes",
             "Resident sketch-state bytes on device (sum over all state "

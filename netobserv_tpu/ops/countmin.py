@@ -16,6 +16,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax import custom_batching
 
 from netobserv_tpu.ops import hashing
 
@@ -58,6 +59,60 @@ def update(cm: CountMin, h1: jax.Array, h2: jax.Array, values: jax.Array,
     return CountMin(counts=new)
 
 
+@custom_batching.custom_vmap
+def _scatter_add_two(counts_a: jax.Array, counts_b: jax.Array,
+                     idx: jax.Array, va: jax.Array,
+                     vb: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """The scatter core of `update_two`: counts [d, w] f32, idx [d, B] i32,
+    va/vb [B] f32 (already masked). Unbatched, this is exactly the historic
+    one-scatter interleaved form. Under vmap (the tenant-stacked fold,
+    sketch/tenancy.py) the custom rule below replaces XLA's batched-scatter
+    lowering — which serializes pathologically on CPU — with a flat
+    (T*d, w) scatter per plane at the same per-update cost as the unbatched
+    form; bit-exact either way (same adds per cell in the same batch order;
+    tests/test_tenancy.py pins it per tenant)."""
+    d, w = counts_a.shape
+    stacked = jnp.stack([counts_a, counts_b], axis=-1)  # [d, w, 2]
+    vals = jnp.stack([va, vb], axis=-1)  # [B, 2]
+    vals = jnp.broadcast_to(vals[None], (d,) + vals.shape)  # [d, B, 2]
+    rows = jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32)[:, None],
+                            idx.shape)
+    new = stacked.at[rows, idx].add(vals, mode="drop", unique_indices=False)
+    return new[..., 0], new[..., 1]
+
+
+@_scatter_add_two.def_vmap
+def _scatter_add_two_batched(axis_size, in_batched, counts_a, counts_b,
+                             idx, va, vb):
+    t = axis_size
+
+    def bcast(x, batched):
+        return x if batched else jnp.broadcast_to(x[None], (t,) + x.shape)
+
+    counts_a = bcast(counts_a, in_batched[0])
+    counts_b = bcast(counts_b, in_batched[1])
+    idx = bcast(idx, in_batched[2])
+    va = bcast(va, in_batched[3])
+    vb = bcast(vb, in_batched[4])
+    d, w = counts_a.shape[1:]
+    b = va.shape[-1]
+    # flatten the tenant axis into the row axis: tenant t's depth-r row is
+    # flat row t*d + r, so one plain 2-coordinate scatter covers all t*d*b
+    # updates (reshape is a bitcast; the scatter stays in place under
+    # donation). Two per-plane scatters rather than one interleaved — the
+    # [t, d, w, 2] interleave would materialize a full copy of both planes.
+    rows = jnp.broadcast_to(jnp.arange(t * d, dtype=jnp.int32)[:, None],
+                            (t * d, b))
+    fidx = idx.reshape(t * d, b)
+
+    def one(counts, v):
+        vv = jnp.broadcast_to(v[:, None, :], (t, d, b)).reshape(t * d, b)
+        return counts.reshape(t * d, w).at[rows, fidx].add(
+            vv, mode="drop", unique_indices=False).reshape(t, d, w)
+
+    return (one(counts_a, va), one(counts_b, vb)), (True, True)
+
+
 def update_two(cm_a: CountMin, cm_b: CountMin, h1: jax.Array, h2: jax.Array,
                vals_a: jax.Array, vals_b: jax.Array,
                valid: jax.Array) -> tuple[CountMin, CountMin]:
@@ -74,18 +129,13 @@ def update_two(cm_a: CountMin, cm_b: CountMin, h1: jax.Array, h2: jax.Array,
             and jnp.issubdtype(cm_b.counts.dtype, jnp.inexact)), \
         "update_two requires float sketches (use countmin.update for int)"
     idx = hashing.row_indices(h1, h2, d, w).astype(jnp.int32)  # [d, B]
-    stacked = jnp.stack(
-        [cm_a.counts.astype(jnp.float32), cm_b.counts.astype(jnp.float32)],
-        axis=-1)  # [d, w, 2]
-    vals = jnp.stack([
-        jnp.where(valid, vals_a, 0).astype(jnp.float32),
-        jnp.where(valid, vals_b, 0).astype(jnp.float32)], axis=-1)  # [B, 2]
-    vals = jnp.broadcast_to(vals[None], (d,) + vals.shape)  # [d, B, 2]
-    rows = jnp.broadcast_to(jnp.arange(d, dtype=jnp.int32)[:, None],
-                            idx.shape)
-    new = stacked.at[rows, idx].add(vals, mode="drop", unique_indices=False)
-    return (CountMin(counts=new[..., 0].astype(cm_a.counts.dtype)),
-            CountMin(counts=new[..., 1].astype(cm_b.counts.dtype)))
+    va = jnp.where(valid, vals_a, 0).astype(jnp.float32)
+    vb = jnp.where(valid, vals_b, 0).astype(jnp.float32)
+    new_a, new_b = _scatter_add_two(cm_a.counts.astype(jnp.float32),
+                                    cm_b.counts.astype(jnp.float32), idx,
+                                    va, vb)
+    return (CountMin(counts=new_a.astype(cm_a.counts.dtype)),
+            CountMin(counts=new_b.astype(cm_b.counts.dtype)))
 
 
 def query(cm: CountMin, h1: jax.Array, h2: jax.Array) -> jax.Array:

@@ -76,6 +76,12 @@ SRC_BUCKET_SEED = 0x0517
 #: seed of the (dst addr, dst port) fan-out family — the port-scan signal's
 #: per-src HLL grid keys off it (was inlined in sketch/state.py)
 DSTPORT_FANOUT_SEED = 0x5CA7
+#: seed of the tenant-owner family (multi-tenant sketch planes): the host
+#: router assigns every evicted flow to a tenant by this hash of the FULL
+#: flow key, so a flow's tenant is stable across windows and agents. Both
+#: sides (device `tenant_of`, host `tenant_of_np`) derive from this one
+#: constant — never inline it
+TENANT_SEED = 0x7E4A
 
 #: base_hashes' two seed constants (h1 / h2 family); every derived family
 #: xors its bucket seed into these
@@ -220,6 +226,24 @@ def hash_words_np(words: np.ndarray, seed: int = 0) -> np.ndarray:
         h = h * _F2
         h = h ^ (h >> np.uint32(16))
     return h
+
+
+def tenant_of(words: jax.Array, n_tenants: int) -> jax.Array:
+    """Tenant owner of each flow key: int32[...] in [0, n_tenants).
+
+    Hashes the FULL key words under TENANT_SEED (h1 family), mod the tenant
+    count — decorrelated from every sketch family, so tenant routing never
+    biases bucket occupancy. `n_tenants` need not be a power of two."""
+    h = hash_words(words, jnp.uint32(_H1_SEED) ^ jnp.uint32(TENANT_SEED))
+    return (h % jnp.uint32(n_tenants)).astype(jnp.int32)
+
+
+def tenant_of_np(words: np.ndarray, n_tenants: int) -> np.ndarray:
+    """Pure-numpy twin of `tenant_of` — the HOST router (sketch/tenancy.py)
+    assigns evicted rows with this; equivalence + golden vectors pinned by
+    tests/test_tenancy.py (goldens run on the big-endian qemu tier)."""
+    h = hash_words_np(words, TENANT_SEED)
+    return (h % np.uint32(n_tenants)).astype(np.int32)
 
 
 def row_indices(h1: jax.Array, h2: jax.Array, depth: int, width: int) -> jax.Array:

@@ -36,6 +36,15 @@ point-in-time read of a PAST closed window, served from the publisher's
 snapshot ring (`SnapshotPublisher(history=N)`) — still snapshot-only.
 Evicted or never-rolled ids answer 404 (listing what IS available);
 without a ring the parameter always 404s.
+
+Tenancy: with SKETCH_TENANTS set, every DATA route (topk/frequency/churn/
+cardinality/victims) additionally REQUIRES ``?tenant=<id>`` — each tenant
+plane has its own publisher (snapshot + back-scroll ring), and there is no
+cross-tenant merged view to default to (planes are independent by
+construction). A missing tenant answers 400 listing the tenant count;
+out-of-range answers 404. /query/status, /query/alerts and /query/range
+keep their own tenant semantics (status reports all tenants; range takes
+?tenant= through the archive plane's own resolver).
 """
 
 from __future__ import annotations
@@ -62,7 +71,7 @@ class QueryRoutes:
                  status_fn: Callable[[], dict], metrics=None,
                  history_fn: Optional[Callable[[int], Optional[dict]]] = None,
                  windows_fn: Optional[Callable[[], list]] = None,
-                 alerts=None, archive=None):
+                 alerts=None, archive=None, tenant_publishers=None):
         self._snapshot = snapshot_fn
         self._status = status_fn
         self._metrics = metrics
@@ -74,6 +83,10 @@ class QueryRoutes:
         #: the sketch warehouse (archive.SketchArchive) or None when
         #: ARCHIVE_DIR is unset — /query/range then answers 404
         self._archive = archive
+        #: SKETCH_TENANTS mode: the per-tenant SnapshotPublisher list —
+        #: data routes then resolve snapshot/history/windows from the
+        #: requested tenant's publisher instead of the top-level fns
+        self._tenant_pubs = tenant_publishers
 
     def index(self) -> dict:
         return {"routes": [f"/query/{r}" for r in ROUTES]}
@@ -134,16 +147,32 @@ class QueryRoutes:
                 return 404, {"error": "archive disabled "
                                       "(ARCHIVE_DIR unset)"}
             return self._archive.route_payload(params)
+        snapshot_fn, history_fn, windows_fn = (
+            self._snapshot, self._history, self._windows)
+        if self._tenant_pubs is not None:
+            # tenant mode: data routes answer from ONE tenant's publisher
+            # (snapshot + ring) — there is no merged cross-tenant view
+            if params.get("tenant") is None:
+                return 400, {
+                    "error": "tenant is required (SKETCH_TENANTS mode)",
+                    "tenants": len(self._tenant_pubs)}
+            tid = int(params["tenant"])  # malformed -> ValueError -> 400
+            if not 0 <= tid < len(self._tenant_pubs):
+                return 404, {"error": f"unknown tenant {tid}",
+                             "tenants": len(self._tenant_pubs)}
+            pub = self._tenant_pubs[tid]
+            snapshot_fn, history_fn, windows_fn = (
+                pub.get, pub.get_window, pub.windows)
         if params.get("window") is not None:
             wid = int(params["window"])  # malformed -> ValueError -> 400
-            snap = self._history(wid) if self._history is not None else None
+            snap = history_fn(wid) if history_fn is not None else None
             if snap is None:
                 return 404, {
                     "error": f"window {wid} not in the snapshot ring",
-                    "windows": (self._windows() if self._windows is not None
+                    "windows": (windows_fn() if windows_fn is not None
                                 else [])}
         else:
-            snap = self._snapshot()
+            snap = snapshot_fn()
         if snap is None:
             return 503, {"error": "no window published yet"}
         if route == "topk":

@@ -111,9 +111,10 @@ class Watched:
     __slots__ = ("_fn", "name", "warmup_calls", "calls", "compiles",
                  "retraces", "last_retrace", "dispatch_seconds",
                  "compile_seconds", "last_signature", "donated_bytes",
-                 "__weakref__")
+                 "tenants", "__weakref__")
 
-    def __init__(self, fn: Callable, name: str, warmup_calls: int):
+    def __init__(self, fn: Callable, name: str, warmup_calls: int,
+                 tenants: Optional[int] = None):
         self._fn = fn
         self.name = name
         self.warmup_calls = warmup_calls
@@ -125,6 +126,10 @@ class Watched:
         self.compile_seconds = 0.0
         self.last_signature: str = ""
         self.donated_bytes = 0
+        #: tenant count of a tenant-stacked (vmapped) executable — the
+        #: /debug/executables registry reports the stacked fold as ONE fn
+        #: with its tenant axis named, never N anonymous entries
+        self.tenants = tenants
 
     def __call__(self, *args, **kwargs):
         self.calls += 1
@@ -160,7 +165,13 @@ class Watched:
         # signature/donation refresh on EVERY compile, warmup included —
         # the registry row must describe the executable that actually
         # serves steady state, which is the last one compiled
-        self.last_signature = _describe(args)
+        sig = _describe(args)
+        if self.tenants is not None:
+            # tenant-stacked entries prefix the axis size so the lowered
+            # signature reads as one executable folding N tenants (the
+            # leading dim of every stacked arg IS this count)
+            sig = f"tenants={self.tenants} {sig}"
+        self.last_signature = sig
         self.donated_bytes = _donated_bytes(args)
         if self.calls <= self.warmup_calls:
             return  # expected warmup compile
@@ -183,6 +194,8 @@ class Watched:
                 "dispatch_seconds": round(self.dispatch_seconds, 6),
                 "compile_seconds": round(self.compile_seconds, 6),
                 "donated_bytes_estimate": self.donated_bytes,
+                **({"tenants": self.tenants}
+                   if self.tenants is not None else {}),
                 **({"last_signature": self.last_signature}
                    if self.last_signature else {}),
                 **({"last_retrace": self.last_retrace}
@@ -209,14 +222,17 @@ def _ensure_installed() -> None:
 
 
 def watch(fn: Callable, name: str,
-          warmup_calls: Optional[int] = None) -> Callable:
+          warmup_calls: Optional[int] = None,
+          tenants: Optional[int] = None) -> Callable:
     """Wrap a jitted entry point for retrace accounting. Returns `fn`
-    unchanged when the watchdog is disabled; never double-wraps."""
+    unchanged when the watchdog is disabled; never double-wraps.
+    `tenants` marks a tenant-stacked (vmapped) executable: the registry
+    reports it as one fn with the tenant count in its signature string."""
     if not _enabled or isinstance(fn, Watched):
         return fn
     _ensure_installed()
     w = Watched(fn, name, _default_warmup if warmup_calls is None
-                else warmup_calls)
+                else warmup_calls, tenants=tenants)
     with _install_lock:
         _registry.append(weakref.ref(w))
         if len(_registry) % 64 == 0:  # amortized sweep of dead wrappers

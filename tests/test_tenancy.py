@@ -1,0 +1,420 @@
+"""Multi-tenant sketch planes (ISSUE 19): stacked-vs-routed bit-exactness,
+routing twins, retrace hygiene, and the integration seams.
+
+The load-bearing claim: `TenantStack` is a pure SCHEDULING change — tenant
+t's lane of the stacked vmapped fold sees exactly the (B, 20) dense batches
+a single-tenant exporter fed the routed slice would ingest, so every table,
+report field and rolled state is bit-exact per tenant against N independent
+single-tenant pipelines replaying the same dispatch schedule. Everything
+else here pins the fan-out seams: tenant routing twins (device vs numpy,
+golden vectors for the big-endian qemu tier), zero post-warmup retraces
+across the tenant-count ladder, the disabled path's bit-identity bar, the
+per-tenant query routes, tenant-aware delta frames + aggregator ledger
+keys, per-tenant alert fingerprints, and the per-tenant archive set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401  (forces the CPU backend)
+
+from netobserv_tpu import config as cfg_mod
+from netobserv_tpu.datapath.fetcher import EvictedFlows
+from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+from netobserv_tpu.federation import delta as fdelta
+from netobserv_tpu.federation.aggregator import FederationAggregator
+from netobserv_tpu.metrics.registry import Metrics
+from netobserv_tpu.ops import hashing
+from netobserv_tpu.sketch import state as sk
+from netobserv_tpu.sketch import tenancy
+from netobserv_tpu.sketch.tiered import TierSpec
+from netobserv_tpu.utils import retrace
+
+from tests.test_pipeline import make_events
+
+SMALL_CFG = sk.SketchConfig(cm_depth=2, cm_width=1 << 10, hll_precision=6,
+                            perdst_buckets=32, perdst_precision=4,
+                            persrc_buckets=32, persrc_precision=4,
+                            topk=16, hist_buckets=64, ewma_buckets=32)
+SMALL_TIERS = TierSpec(mid_group=8, top_group=32, bytes_unit=1)
+KW = 10   # key words per dense row
+B = 32    # per-tenant fill-buffer batch size used throughout
+
+
+def _rows(m, seed, universe=None):
+    """(M, 20) u32 dense rows with every feature lane populated.
+    Integer-valued floats keep float32 sums exact (the bit-exact claims
+    rely on it); `universe` shares keys across folds so merges happen."""
+    rng = np.random.default_rng(seed)
+    rows = np.zeros((m, tenancy.DENSE_WORDS), np.uint32)
+    if universe is None:
+        rows[:, :KW] = rng.integers(0, 2**32, (m, KW), dtype=np.uint32)
+    else:
+        rows[:, :KW] = universe[rng.integers(0, len(universe), m)]
+    rows[:, 10] = rng.integers(64, 9000, m).astype(np.float32).view(np.uint32)
+    rows[:, 11] = rng.integers(1, 50, m, dtype=np.uint32)
+    rows[:, 12] = rng.integers(0, 5000, m, dtype=np.uint32)   # rtt_us
+    rows[:, 13] = rng.integers(0, 2000, m, dtype=np.uint32)   # dns_lat_us
+    rows[:, 14] = 1                                           # valid
+    rows[:, 16] = (rng.integers(0, 0x100, m, dtype=np.uint32)
+                   | rng.integers(0, 64, m, dtype=np.uint32) << 16
+                   | rng.integers(0, 4, m, dtype=np.uint32) << 24)
+    rows[:, 17] = (rng.integers(0, 400, m, dtype=np.uint32)
+                   | rng.integers(0, 8, m, dtype=np.uint32) << 16)
+    rows[:, 18] = rng.integers(0, 5, m, dtype=np.uint32)
+    return rows
+
+
+def _oracle_chunks(folds, n, batch=B):
+    """Replay TenantStack's exact fill/dispatch schedule on the host: for
+    each fold, rows fill per-tenant buffers in arrival order; whenever ANY
+    tenant's buffer fills, ALL tenants ship their zero-padded prefixes as
+    one chunk. Returns per-tenant lists of (batch, 20) chunks — what
+    tenant t's lane of each stacked dispatch must have contained."""
+    fill = np.zeros((n, batch, tenancy.DENSE_WORDS), np.uint32)
+    cnt = [0] * n
+    chunks = [[] for _ in range(n)]
+
+    def dispatch():
+        for t in range(n):
+            c = np.zeros((batch, tenancy.DENSE_WORDS), np.uint32)
+            c[:cnt[t]] = fill[t, :cnt[t]]
+            chunks[t].append(c)
+            cnt[t] = 0
+
+    for rows in folds:
+        owners = hashing.tenant_of_np(rows[:, :KW], n)
+        for t in range(n):
+            sel = rows[owners == t]
+            off = 0
+            while off < len(sel):
+                take = min(len(sel) - off, batch - cnt[t])
+                fill[t, cnt[t]:cnt[t] + take] = sel[off:off + take]
+                cnt[t] += take
+                off += take
+                if cnt[t] == batch:
+                    dispatch()
+    if any(cnt):
+        dispatch()
+    return chunks
+
+
+def _assert_trees_equal(got, want, ctx):
+    import jax
+    gl, wl = jax.tree.leaves(got), jax.tree.leaves(want)
+    assert len(gl) == len(wl), ctx
+    for g, w in zip(gl, wl):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w),
+                                      err_msg=ctx)
+
+
+# --- the tentpole claim: stacked == routed single-tenant, bit-exact -----
+
+@pytest.mark.parametrize("cfg", [SMALL_CFG,
+                                 SMALL_CFG._replace(tiered=SMALL_TIERS)],
+                         ids=["wide", "tiered"])
+def test_stacked_fold_roll_matches_routed_single_tenant(cfg):
+    n = 4
+    universe = np.random.default_rng(3).integers(
+        0, 2**32, (64, KW), dtype=np.uint32)
+    folds = [_rows(m, seed=100 + i, universe=universe)
+             for i, m in enumerate((7, 64, 33, 128, 1, 200))]
+
+    stack = tenancy.TenantStack(n, cfg, B)
+    state = tenancy.init_stacked_state(cfg, n)
+    for rows in folds:
+        state = stack.fold_rows(state, rows)
+    state = stack.flush(state)
+    new_state, report, tables = stack.roll(state)
+    got_states = tenancy.split_tenants(new_state, n)
+    got_reports = tenancy.split_tenants(report, n)
+    got_tables = tenancy.split_tenants(tables, n)
+
+    # oracle: N independent single-tenant pipelines fed the SAME chunks
+    # the dispatch schedule shipped (zero padding included — invalid rows
+    # are the fold identity, so this is also what a routed single-tenant
+    # exporter would fold)
+    ingest = sk.make_ingest_dense_fn(donate=False,
+                                     use_pallas=cfg.use_pallas)
+    roll = sk.make_roll_fn(cfg, with_tables=True)
+    for t, chunks in enumerate(_oracle_chunks(folds, n)):
+        s1 = sk.init_state(cfg)
+        for c in chunks:
+            s1 = ingest(s1, c)
+        s1, want_report, want_tables = roll(s1)
+        _assert_trees_equal(got_tables[t], want_tables, f"tables t={t}")
+        _assert_trees_equal(got_reports[t], want_report, f"report t={t}")
+        _assert_trees_equal(got_states[t], s1, f"rolled state t={t}")
+
+
+def test_route_and_fold_events_match_fold_rows():
+    """The event-path fold (pack_dense + route) and the pre-packed
+    fold_rows path produce identical stacked states for the same flows."""
+    n = 3
+    events = make_events(50, nbytes=700)
+    stack_a = tenancy.TenantStack(n, SMALL_CFG, B)
+    sa = stack_a.fold(tenancy.init_stacked_state(SMALL_CFG, n), events)
+    sa = stack_a.flush(sa)
+
+    rows, _ = stack_a.route(events)
+    stack_b = tenancy.TenantStack(n, SMALL_CFG, B)
+    sb = stack_b.fold_rows(tenancy.init_stacked_state(SMALL_CFG, n), rows)
+    sb = stack_b.flush(sb)
+    _assert_trees_equal(sa, sb, "events-vs-rows fold")
+    assert stack_a.routed_rows == stack_b.routed_rows == 50
+
+
+# --- routing twins ------------------------------------------------------
+
+def test_tenant_of_np_golden_vectors():
+    """Pinned outputs (also run on the big-endian qemu tier): the numpy
+    tenant router is part of the wire-stable contract — rows must land on
+    the same tenant on every host that ever packs them."""
+    w = np.arange(50, dtype=np.uint32).reshape(5, 10)
+    assert hashing.tenant_of_np(w, 4).tolist() == [1, 1, 1, 3, 3]
+    assert hashing.tenant_of_np(w, 16).tolist() == [9, 5, 9, 11, 11]
+
+
+def test_tenant_of_device_twin_matches_numpy():
+    words = np.random.default_rng(9).integers(
+        0, 2**32, (200, KW), dtype=np.uint32)
+    for n in (3, 4, 16):
+        dev = np.asarray(hashing.tenant_of(words, n))
+        np.testing.assert_array_equal(dev, hashing.tenant_of_np(words, n),
+                                      err_msg=f"n={n}")
+        assert dev.min() >= 0 and dev.max() < n
+
+
+# --- retrace hygiene across the tenant-count ladder ---------------------
+
+def test_zero_postwarmup_retraces_across_tenant_ladder():
+    """Each N is its own watched executable pair; within one N, varied
+    fold sizes, flush remainders and repeated rolls never retrace."""
+    stacks = []
+    for n in (1, 4, 16):
+        stack = tenancy.TenantStack(n, SMALL_CFG, B)
+        stacks.append(stack)  # keep alive: snapshot() lists live watchers
+        state = tenancy.init_stacked_state(SMALL_CFG, n)
+        for m in (5, 90, 17, 64):
+            state = stack.fold_rows(state, _rows(m, seed=m))
+        state = stack.flush(state)
+        state, _, _ = stack.roll(state)
+        state = stack.fold_rows(state, _rows(40, seed=7))
+        state = stack.flush(state)
+        state, _, _ = stack.roll(state)
+    for w in retrace.snapshot():
+        if w["fn"] in ("tenant_ingest", "tenant_roll"):
+            assert w["retraces"] == 0, w
+
+
+def test_retrace_registry_reports_tenant_attribution():
+    """The stacked fold reports as ONE executable with the tenant count in
+    its signature — N dispatches never read as N hidden programs."""
+    stack = tenancy.TenantStack(4, SMALL_CFG, B)
+    state = stack.fold_rows(tenancy.init_stacked_state(SMALL_CFG, 4),
+                            _rows(8, seed=1))
+    state = stack.flush(state)
+    ws = [w for w in retrace.snapshot() if w["fn"] == "tenant_ingest"
+          and w.get("tenants") == 4]
+    assert ws and ws[0]["calls"] >= 1
+    assert ws[0]["last_signature"].startswith("tenants=4 ")
+
+
+# --- metrics hygiene ----------------------------------------------------
+
+def test_close_evicts_per_tenant_series():
+    from prometheus_client import generate_latest
+    m = Metrics()
+    stack = tenancy.TenantStack(2, SMALL_CFG, B, metrics=m)
+    m.sketch_tenant_window_records.labels("0").set(5.0)
+    m.sketch_tenant_window_records.labels("1").set(7.0)
+    assert 'sketch_tenant_window_records{tenant="0"}' in \
+        generate_latest(m.registry).decode()
+    stack.close()
+    text = generate_latest(m.registry).decode()
+    assert "sketch_tenant_window_records{" not in text
+    assert "sketch_tenants_active 0.0" in text
+
+
+# --- config gate --------------------------------------------------------
+
+def test_config_rejects_tenants_plus_mesh():
+    base = {"EXPORT": "tpu-sketch", "SKETCH_TENANTS": "4"}
+    c = cfg_mod.load_config(environ={**base, "SKETCH_MESH_SHAPE": "2x4"})
+    with pytest.raises(ValueError, match="SKETCH_TENANTS"):
+        c.validate()
+    cfg_mod.load_config(environ=base).validate()
+
+
+# --- exporter integration ----------------------------------------------
+
+def make_exporter(metrics=None, sink=None, **kw):
+    return TpuSketchExporter(batch_size=64, window_s=3600.0,
+                             sketch_cfg=SMALL_CFG, metrics=metrics,
+                             sink=sink or (lambda obj: None), **kw)
+
+
+def test_disabled_path_is_bit_identical():
+    """tenants=0 must build the exact pre-tenancy exporter: no stack, no
+    per-tenant publishers, no Tenant report key, no status block."""
+    reports = []
+    exp = make_exporter(sink=reports.append)
+    try:
+        assert exp._tenancy is None and exp._tenant_query is None
+        exp.export_evicted(EvictedFlows(make_events(8)))
+        exp.flush()
+        assert len(reports) == 1 and "Tenant" not in reports[0]
+        assert "tenants" not in exp.query_status()
+    finally:
+        exp.close()
+
+
+def test_exporter_tenant_fanout_and_routes(monkeypatch):
+    """tenants=3: one eviction stream fans out to three per-tenant window
+    reports whose Records conserve the routed rows exactly, the status
+    block accounts folds/rows, and /query/* requires+resolves ?tenant=."""
+    import jax
+
+    # conftest forces an 8-virtual-device mesh, on which the exporter
+    # (correctly) degrades tenants away — pin it to one device
+    real_devices = jax.devices
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **k: real_devices(*a, **k)[:1])
+    reports = []
+    m = Metrics()
+    exp = make_exporter(metrics=m, sink=reports.append, tenants=3)
+    try:
+        exp.export_evicted(EvictedFlows(make_events(64, nbytes=400)))
+        exp.export_evicted(EvictedFlows(make_events(37, sport0=5000)))
+        exp.flush()
+        assert sorted(obj["Tenant"] for obj in reports) == [0, 1, 2]
+        total = sum(obj["Records"] for obj in reports)
+        assert total == exp._tenancy.routed_rows == 101
+        st = exp.query_status()
+        assert st["tenants"]["n"] == 3
+        assert st["tenants"]["published"] == 3
+        assert st["tenants"]["routed_rows"] == 101
+
+        code, body = exp.query_routes.handle("/query/topk", {})
+        assert code == 400 and body["tenants"] == 3
+        code, body = exp.query_routes.handle("/query/topk", {"tenant": "1"})
+        assert code == 200
+        code, _ = exp.query_routes.handle("/query/topk", {"tenant": "9"})
+        assert code == 404
+        code, _ = exp.query_routes.handle("/query/topk", {"tenant": "x"})
+        assert code == 400
+    finally:
+        exp.close()
+
+
+def test_exporter_refuses_tenants_on_distributed():
+    """The SKETCH_TIERED pattern: a multi-device exporter (conftest's
+    8-virtual-device mesh counts) degrades tenants away with a warning,
+    never a crash or a silent tenant plane."""
+    exp = make_exporter(tenants=2)
+    try:
+        assert exp._tenancy is None
+    finally:
+        exp.close()
+
+
+# --- federation: tenant-aware frames ------------------------------------
+
+def _tables_and_dims():
+    tables = {k: np.asarray(v)
+              for k, v in sk.state_tables(sk.init_state(SMALL_CFG)).items()}
+    dims = {"cm_depth": 2, "cm_width": 1 << 10, "hll_precision": 6,
+            "topk": 16, "ewma_buckets": 32}
+    return tables, dims
+
+
+def test_delta_frame_tenant_roundtrip_and_source_key():
+    tables, dims = _tables_and_dims()
+    raw = fdelta.encode_frame(tables, agent_id="a", window=1, ts_ms=10,
+                              dims=dims, window_seq=1, frame_uuid="u1",
+                              agent_epoch=5, tenant=(2, 8))
+    frame = fdelta.decode_frame(raw)
+    assert frame.tenant == (2, 8)
+    assert fdelta.source_key(frame) == "a#t2"
+    # absent tenant: zero wire presence, bare agent key (v2 compat)
+    raw0 = fdelta.encode_frame(tables, agent_id="a", window=1, ts_ms=10,
+                               dims=dims, window_seq=1, frame_uuid="u2",
+                               agent_epoch=5)
+    frame0 = fdelta.decode_frame(raw0)
+    assert frame0.tenant is None
+    assert fdelta.source_key(frame0) == "a"
+
+
+def test_aggregator_ledgers_tenant_planes_independently():
+    """Two frames from the SAME agent/epoch/window_seq but different
+    tenants are different ledger sources: both merge, neither reads as a
+    duplicate or a stale window. A true duplicate within one tenant plane
+    still dedups."""
+    tables, dims = _tables_and_dims()
+    agg = FederationAggregator(sketch_cfg=SMALL_CFG, window_s=3600,
+                               sink=lambda obj: None)
+    frames = [fdelta.encode_frame(tables, agent_id="a", window=0, ts_ms=10,
+                                  dims=dims, window_seq=0,
+                                  frame_uuid=f"u-{t}", agent_epoch=7,
+                                  tenant=(t, 2))
+              for t in range(2)]
+    for raw in frames:
+        ack = agg.ingest_frame(raw)
+        assert (ack.accepted, ack.duplicate) == (1, 0)
+    ack = agg.ingest_frame(frames[1])   # retry of tenant 1's frame
+    assert (ack.accepted, ack.duplicate) == (1, 1)
+    assert set(agg._agents) == {"a#t0", "a#t1"}
+
+
+# --- alerts: per-tenant fingerprints ------------------------------------
+
+def test_alert_fingerprints_are_per_tenant():
+    """The same rule+bucket raises independently per tenant (and once
+    each): tenant 0's flood must not mask tenant 1's."""
+    from netobserv_tpu.alerts import AlertEngine
+    from netobserv_tpu.alerts.rules import signal_rule
+    from tests.test_alerts import flood_report, snap_of
+
+    eng = AlertEngine([signal_rule("syn_flood", raise_evals=1)],
+                      metrics=Metrics())
+    raised = []
+    for tenant in (0, 1, 0):
+        snap = snap_of(flood_report(), window=1, seq=1 + tenant)
+        snap["tenant"] = tenant
+        raised += [t for t in eng.evaluate(snap) if t["action"] == "raise"]
+    assert len(raised) == 2
+    assert sorted(t["tenant"] for t in raised) == [0, 1]
+    view = eng.view()
+    assert sorted(a["tenant"] for a in view["active"]) == [0, 1]
+
+
+# --- archive: per-tenant segment trees ----------------------------------
+
+def test_tenant_archive_set_routes_and_writes(tmp_path):
+    from netobserv_tpu.archive import TenantArchiveSet, tenant_archives
+
+    c = cfg_mod.load_config(environ={"ARCHIVE_DIR": str(tmp_path),
+                                     "SKETCH_TENANTS": "2"})
+    arch = tenant_archives(c, SMALL_CFG, 2)
+    assert isinstance(arch, TenantArchiveSet) and arch.n_tenants == 2
+    tables, _ = _tables_and_dims()
+    arch.write_tenant_window(tables, window=0, ts_ms=1000, tenant=1)
+    assert os.path.isdir(tmp_path / "tenant-1")
+    assert arch.stats()["tenants"] == 2
+
+    code, body = arch.route_payload({"from": "0", "to": "2"})
+    assert code == 400 and body["tenants"] == 2
+    code, _ = arch.route_payload({"from": "0", "to": "2", "tenant": "5"})
+    assert code == 404
+    code, _ = arch.route_payload({"from": "0", "to": "2", "tenant": "z"})
+    assert code == 400
+    code, body = arch.route_payload({"from": "0", "to": "2", "tenant": "1"})
+    assert code == 200
+    # unset ARCHIVE_DIR: no archive object at all (the is-None bar)
+    c0 = cfg_mod.load_config(environ={"SKETCH_TENANTS": "2"})
+    assert tenant_archives(c0, SMALL_CFG, 2) is None
