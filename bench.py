@@ -48,6 +48,10 @@ TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 BATCH = 16384
 N_BATCHES_POOL = 8
 _DEVICE_NOTE = ""
+#: strong refs to retrace-watched bench entry points: the accounting
+#: registry holds wrappers weakly, and the executables stamp is read at
+#: artifact-print time, after the measuring function returned
+_WATCHED_KEEPALIVE: list = []
 #: claim forensics stamped into device_provenance: how many grant attempts
 #: the watchdog made and whether any attempt wedged (hung past its
 #: per-attempt timeout) — a CPU-fallback round becomes diagnosable
@@ -763,6 +767,7 @@ def tiered_ablation_stats(segs: int = 4) -> dict:
         BASE_MAX, TierSpec, array_bytes, counter_table_bytes,
         plane_occupancy,
     )
+    from netobserv_tpu.utils import retrace
 
     rng = np.random.default_rng(777)
     universe, pool = make_pool(rng)
@@ -776,11 +781,23 @@ def tiered_ablation_stats(segs: int = 4) -> dict:
                                "top_group": spec.top_group,
                                "bytes_unit": spec.bytes_unit}}
 
-    def run(cfg):
+    def run(cfg, use_pallas=None, tier_interior=None):
         """Deterministic fold sequence (feed tracked for the recall
         oracle) + per-segment steady-state rates, like tpu_ingest_rate."""
         state = sk.init_state(cfg)
-        ingest = sk.make_ingest_fn(donate=True)
+        ingest = sk.make_ingest_fn(donate=True, use_pallas=use_pallas,
+                                   tier_interior=tier_interior)
+        if cfg.tiered is not None:
+            # watched so the artifact's executables stamp attributes the
+            # fold form (tiered=interior|decode), like /debug/executables;
+            # the registry holds wrappers weakly, so pin them until the
+            # artifact is printed (bench processes are short-lived)
+            form = sk.tiered_fold_form(cfg._replace(use_pallas=use_pallas))
+            if tier_interior is False:
+                form = "decode"
+            ingest = retrace.watch(
+                ingest, f"bench_tiered_ingest_{form}", tiered=form)
+            _WATCHED_KEEPALIVE.append(ingest)
         feed: list[int] = []
         it = 0
         for _ in range(WARMUP_ITERS):
@@ -815,6 +832,27 @@ def tiered_ablation_stats(segs: int = 4) -> dict:
     out["tiered_recall_at_100"] = round(
         check_recall(tiered_state, tiered_feed, universe, pool), 4)
 
+    # interior-vs-decode Pallas A/B (ISSUE 20): the tier-native walk folds
+    # on the packed u8/u16/u32 tiles in place; the decode wrap materializes
+    # the wide f32 temporary around the same fold. TPU only — interpret
+    # mode is a Python loop and would measure nothing real.
+    tier_cfg = sk.SketchConfig(tiered=spec, use_pallas=True)
+    out["tiered_fold_form"] = sk.tiered_fold_form(tier_cfg)
+    if jax.default_backend() == "tpu":
+        int_rate, int_state, int_feed = run(tier_cfg, use_pallas=True)
+        dec_rate, _, _ = run(tier_cfg, use_pallas=True, tier_interior=False)
+        out["device_ingest_tiered_interior"] = int_rate
+        out["device_ingest_tiered_decode_pallas"] = dec_rate
+        out["interior_vs_decode_rate"] = round(
+            int_rate / max(dec_rate, 1), 3)
+        out["tiered_interior_recall_at_100"] = round(
+            check_recall(int_state, int_feed, universe, pool), 4)
+    else:
+        out["tiered_interior_note"] = (
+            "interior/decode pallas A/B skipped off-TPU (interpret mode is "
+            "a Python loop); fold-form gate reported above, bytes-touched "
+            "estimate in sketch_memory either way")
+
     wide_b = counter_table_bytes(wide_state)
     tier_b = counter_table_bytes(tiered_state)
     dtypes = {
@@ -847,6 +885,15 @@ def tiered_ablation_stats(segs: int = 4) -> dict:
         "tier_promotions": {t: occ[t]["promoted"] for t in occ},
         "base_span": {"cm_bytes": BASE_MAX * spec.bytes_unit,
                       "cm_pkts": BASE_MAX},
+        # per-fold counter-table HBM traffic estimate, per fold form: the
+        # interior walk reads+writes the packed tiles in place; the decode
+        # wrap additionally materializes the wide f32 temporary (decode
+        # write, fold read+write, re-encode read) around the same fold
+        "fold_hbm_bytes_touched": {
+            "interior": 2 * sum(tier_b.values()),
+            "decode_wrapped": 2 * sum(tier_b.values())
+            + 4 * sum(wide_b.values()),
+        },
     }
     print(f"tiered ablation: walk {tiered_rate / 1e6:.2f}M vs wide "
           f"{wide_rate / 1e6:.2f}M rec/s; counter tables "
@@ -1927,6 +1974,7 @@ def main():
         if _DEVICE_NOTE:
             out["device"] = _DEVICE_NOTE
         out["device_provenance"] = device_provenance(cpu_requested)
+        out["executables"] = executables_snapshot()
         print(json.dumps(out))
         return
     if "--archive-only" in sys.argv:

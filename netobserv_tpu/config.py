@@ -384,11 +384,14 @@ class AgentConfig:  # noqa: PLR0902 - deliberately wide, mirrors reference
     #: tiered counter planes (sketch/tiered.py): keep the RESIDENT form of
     #: the CM planes + HLL banks narrow (u8 base + u16/u32 overflow tiers
     #: with in-executable saturation promotion; 6-bit packed HLL
-    #: registers) and decode to the canonical wide tables transiently
-    #: inside the fold/roll executables — ~4x less HBM per resident sketch
-    #: window at equal geometry (docs/tpu_sketch.md "Tiered counter
-    #: planes"). Single-device only; unset is bit-identical to the
-    #: wide-resident path.
+    #: registers) — ~4x less HBM per resident sketch window at equal
+    #: geometry (docs/tpu_sketch.md "Tiered counter planes"). With the
+    #: fused Pallas walks the fold runs TIER-INTERIOR, directly on the
+    #: packed tiles (no wide decode temporary; width % 512 == 0 and
+    #: top_group <= 512 dividing it); otherwise folds decode to the
+    #: canonical wide tables transiently inside the same executable —
+    #: bit-exact either way. Single-device only; unset is bit-identical
+    #: to the wide-resident path.
     sketch_tiered: bool = field(default=False, **_env("SKETCH_TIERED", "false"))
     #: CM columns sharing one u16 MID overflow cell (power of two)
     sketch_tier_mid_group: int = field(

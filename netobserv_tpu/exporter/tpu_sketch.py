@@ -40,6 +40,11 @@ from netobserv_tpu.utils import faultinject, retrace, tracing
 
 log = logging.getLogger("netobserv_tpu.exporter.tpu_sketch")
 
+#: once-per-process dedup of the multi-device SKETCH_TIERED degrade warning
+#: (chaos/restart loops rebuild exporters; the queryable truth is the
+#: tiered_degraded supervisor condition, not the log line)
+_TIERED_DEGRADE_WARNED = False
+
 ReportSink = Callable[[dict], None]
 
 
@@ -528,13 +533,30 @@ class TpuSketchExporter(Exporter):
             log.warning("SKETCH_TENANTS has no mesh-sharded form; running "
                         "the mesh exporter single-tenant")
             tenants = 0
+        #: True when SKETCH_TIERED was requested but degraded away (the
+        #: mesh has no sharded tier form) — surfaced as a supervisor
+        #: CONDITION so /healthz shows WHY resident memory is wide
+        self._tiered_degraded = False
         if self._distributed and self._cfg.tiered is not None:
             # no owner-sharded tier form yet (config.validate blocks the
             # env combination; direct construction degrades gracefully —
-            # exporters never crash the pipeline)
-            log.warning("SKETCH_TIERED has no sharded form; running the "
-                        "mesh exporter with wide-resident tables")
+            # exporters never crash the pipeline). The warning dedupes to
+            # once per PROCESS (exporters are rebuilt on restart/chaos
+            # loops; the log line is informational, the health condition
+            # below is the queryable truth)
+            global _TIERED_DEGRADE_WARNED
+            if not _TIERED_DEGRADE_WARNED:
+                _TIERED_DEGRADE_WARNED = True
+                log.warning("SKETCH_TIERED has no sharded form; running the "
+                            "mesh exporter with wide-resident tables")
+            self._tiered_degraded = True
             self._cfg = self._cfg._replace(tiered=None)
+        #: which tiered fold form this backend engages ("interior" |
+        #: "decode" | None) — the /debug/executables + bench attribution
+        #: for every watched ingest/roll entry (one program each, never
+        #: hidden variants). Rolls always ride the wide decode.
+        self._tier_form = sk.tiered_fold_form(self._cfg)
+        self._tier_roll_form = "decode" if self._cfg.tiered else None
         #: previous closed-window promoted-counter masks, per CM table —
         #: the tier-promotions counter increments by NEW promotions only
         #: (host bools, timer thread; see _publish_tier_metrics). Masks
@@ -648,7 +670,8 @@ class TpuSketchExporter(Exporter):
             self._ingest = retrace.watch(sk.make_ingest_fn(
                 use_pallas=self._cfg.use_pallas,
                 enable_fanout=self._cfg.enable_fanout,
-                enable_asym=self._cfg.enable_asym), "ingest")
+                enable_asym=self._cfg.enable_asym), "ingest",
+                tiered=self._tier_form)
             # with_tables unconditionally: the pre-roll table snapshot is
             # one extra output of the same roll executable, and it feeds
             # BOTH the federation delta export and the query plane's
@@ -657,7 +680,7 @@ class TpuSketchExporter(Exporter):
             self._roll = retrace.watch(
                 sk.make_roll_fn(self._cfg, decay_factor=decay_factor,
                                 with_tables=True),
-                "roll")
+                "roll", tiered=self._tier_roll_form)
             self._ring = self._make_single_device_ring(
                 feed, resident_slots, pack_threads, metrics)
         if self._tenancy is not None and self._ckpt is not None:
@@ -956,6 +979,17 @@ class TpuSketchExporter(Exporter):
         eng = getattr(self, "_alerts", None)
         if eng is not None and hasattr(supervisor, "register_condition"):
             supervisor.register_condition("alerting", eng.condition)
+        # tiered_degraded: SKETCH_TIERED was requested but the mesh has no
+        # sharded tier form — /healthz shows WHY resident memory is wide.
+        # A condition, never DEGRADED: the exporter made a deliberate,
+        # documented fallback; readiness is untouched.
+        if (getattr(self, "_tiered_degraded", False)
+                and hasattr(supervisor, "register_condition")):
+            supervisor.register_condition(
+                "tiered_degraded",
+                lambda: {"active": True,
+                         "reason": "SKETCH_TIERED has no sharded form; "
+                                   "resident tables are wide"})
         # the overlap fold worker is a pipeline stage like any other: a
         # crash/hang restarts it (the handoff queue survives the restart,
         # so queued evictions still fold)
@@ -1164,6 +1198,8 @@ class TpuSketchExporter(Exporter):
         packed.free()
         if self._metrics is not None:
             self._metrics.sketch_batches_total.inc()
+            if self._tier_form == "interior":
+                self._metrics.sketch_tiered_interior_folds_total.inc()
             self._metrics.sketch_ingest_seconds.observe(
                 time.perf_counter() - t0)
         return True
@@ -1354,6 +1390,8 @@ class TpuSketchExporter(Exporter):
                 self._busy_fold_s += time.perf_counter() - t0
         if self._metrics is not None:
             self._metrics.sketch_batches_total.inc()
+            if self._tier_form == "interior":
+                self._metrics.sketch_tiered_interior_folds_total.inc()
             self._metrics.sketch_records_total.inc(n)
             self._metrics.sketch_ingest_seconds.observe(
                 time.perf_counter() - t0)
@@ -1565,7 +1603,7 @@ class TpuSketchExporter(Exporter):
                     bpl, caps, k * lanes, use_pallas=self._cfg.use_pallas,
                     enable_fanout=self._cfg.enable_fanout,
                     enable_asym=self._cfg.enable_asym),
-                    f"ingest_resident_lanes_x{k}")
+                    f"ingest_resident_lanes_x{k}", tiered=self._tier_form)
                 for k in ladder}
             return staging.ShardedResidentStagingRing(
                 self._batch_size, 1, ingests,
@@ -1580,16 +1618,19 @@ class TpuSketchExporter(Exporter):
                 self._batch_size,
                 retrace.watch(
                     sk.make_ingest_compact_fn(self._batch_size, spill_cap,
-                                              **kw), "ingest_compact"),
+                                              **kw), "ingest_compact",
+                    tiered=self._tier_form),
                 spill_cap=spill_cap,
                 ingest_fallback=retrace.watch(
-                    sk.make_ingest_dense_fn(**kw), "ingest_dense"),
+                    sk.make_ingest_dense_fn(**kw), "ingest_dense",
+                    tiered=self._tier_form),
                 metrics=metrics, pack_threads=pack_threads)
         if feed != "dense":
             log.warning("unknown SKETCH_FEED %r; using dense", feed)
         return staging.DenseStagingRing(
             self._batch_size,
-            retrace.watch(sk.make_ingest_dense_fn(**kw), "ingest_dense"),
+            retrace.watch(sk.make_ingest_dense_fn(**kw), "ingest_dense",
+                          tiered=self._tier_form),
             metrics=metrics, pack_threads=pack_threads)
 
     def _fold(self, records: list[Record]) -> None:
@@ -1641,6 +1682,8 @@ class TpuSketchExporter(Exporter):
             trace.finish()
         if self._metrics is not None:
             self._metrics.sketch_batches_total.inc()
+            if self._tier_form == "interior":
+                self._metrics.sketch_tiered_interior_folds_total.inc()
             self._metrics.sketch_records_total.inc(len(records))
             self._metrics.sketch_ingest_seconds.observe(
                 time.perf_counter() - t0)
@@ -1791,6 +1834,10 @@ class TpuSketchExporter(Exporter):
                    "window_s": self._window_s,
                    "refresh_s": self._query_refresh_s,
                    "overloaded": self.overloaded})
+        if getattr(self, "_tiered_degraded", False):
+            # mirror of the tiered_degraded supervisor condition: why
+            # resident memory is wide despite SKETCH_TIERED being set
+            st["tiered_degraded"] = True
         if self._alerts is not None:
             # one view read (the read-once rule): active count and last
             # transition seq come from the SAME published alert view, so a
@@ -1903,7 +1950,8 @@ class TpuSketchExporter(Exporter):
 
             from netobserv_tpu.sketch.tiered import decode_state
             self._tiered_decode = retrace.watch(jax.jit(decode_state),
-                                                "tiered_decode")
+                                                "tiered_decode",
+                                                tiered="decode")
         return self._tiered_decode(state)
 
     def _publish_tier_metrics(self, tables, tenant=None) -> None:

@@ -237,6 +237,14 @@ class Metrics:
             "narrow for the traffic: raise SKETCH_TIER_BYTES_UNIT or "
             "widen the sketch)", ["table"],
             registry=self.registry)
+        self.sketch_tiered_interior_folds_total = Counter(
+            p + "sketch_tiered_interior_folds_total",
+            "Ingest folds served by the tier-interior Pallas walk "
+            "(SKETCH_TIERED + use_pallas: the fold ran directly on the "
+            "packed u8/u16/u32 tiles, no wide decode temporary — compare "
+            "against sketch_batches_total to confirm the interior form is "
+            "the one actually engaged)",
+            registry=self.registry)
         # multi-tenant sketch planes (sketch/tenancy.py)
         self.sketch_tenant_folds_total = Counter(
             p + "sketch_tenant_folds_total",

@@ -22,6 +22,7 @@ from jax.experimental import pallas as pl
 
 from netobserv_tpu.ops import hashing
 from netobserv_tpu.ops.countmin import CountMin
+from netobserv_tpu.ops.pallas import tier_tiles
 
 TILE_W = 512
 CHUNK_B = 1024
@@ -119,6 +120,147 @@ def update_two(cm_a: CountMin, cm_b: CountMin, h1: jax.Array, h2: jax.Array,
     )(stacked, idx, vals)
     return (CountMin(counts=new_counts[0].astype(cm_a.counts.dtype)),
             CountMin(counts=new_counts[1].astype(cm_b.counts.dtype)))
+
+
+def _tier2_kernel(base_ref, mid_ref, top_ref, idx_ref, vals_ref,
+                  base_out, mid_out, top_out, q_out, *, depth: int,
+                  n_chunks: int, mid_group: int, top_group: int,
+                  units: tuple[int, int]):
+    """Tier-interior dual-plane fold: decode the narrow tier tiles to a
+    wide f32 view IN VMEM, run the exact `_fold2_kernel` chunk walk on it,
+    then promote the per-fold delta back into the tiers — the wide array
+    never exists in HBM. A second walk gathers the post-fold bytes-plane
+    estimate per record (q_out accumulates across width tiles; each index
+    hits exactly one tile, so the sum is an exact gather) so the heavy-
+    hitter plane can query without a wide temporary either."""
+    j = pl.program_id(0)
+    base = j * TILE_W
+    lanes = base + jax.lax.broadcasted_iota(jnp.int32, (1, TILE_W), 1)
+    tm = TILE_W // mid_group
+    em = tier_tiles.expand_matrix(TILE_W, mid_group)
+    et = tier_tiles.expand_matrix(tm, top_group // mid_group)
+    gm = tier_tiles.groupsum_matrix(TILE_W, mid_group)
+    gt = tier_tiles.groupsum_matrix(tm, top_group // mid_group)
+
+    base_i = base_ref[...].astype(jnp.int32)   # [2, d, T]
+    mid_i = mid_ref[...].astype(jnp.int32)     # [2, d, T//mg]
+    top_u = top_ref[...]                       # [2, d, T//tg] u32
+    dec = jnp.stack([
+        tier_tiles.decode_tile(base_i[p], mid_i[p], top_u[p], em, et,
+                               units[p])
+        for p in range(2)])                    # [2, d, T] f32 wide view
+
+    def chunk_body(i, acc):  # _fold2_kernel's walk, acc seeded from dec
+        sl = pl.dslice(i * CHUNK_B, CHUNK_B)
+        vals = vals_ref[:, sl]                       # [2, CHUNK_B]
+        new_rows = []
+        for r in range(depth):  # static unroll over sketch depth
+            idx = idx_ref[r, sl].reshape(CHUNK_B, 1)
+            onehot = (idx == lanes).astype(jnp.float32)  # [CHUNK_B, TILE_W]
+            contrib = jnp.dot(vals, onehot,
+                              preferred_element_type=jnp.float32)  # [2, W]
+            new_rows.append(acc[:, r] + contrib)
+        return jnp.stack(new_rows, axis=1)           # [2, d, TILE_W]
+
+    new = jax.lax.fori_loop(0, n_chunks, chunk_body, dec)
+    for p in range(2):
+        nb, nm, nt = tier_tiles.promote_tile(
+            base_i[p], mid_i[p], top_u[p], dec[p], new[p], gm, gt, units[p])
+        base_out[p] = nb
+        mid_out[p] = nm
+        top_out[p] = nt
+
+    # bytes-plane query on the post-fold wide view (pre-promotion — the
+    # same values countmin.query reads in the decode-wrapped form)
+    @pl.when(j == 0)
+    def _zero():
+        q_out[...] = jnp.zeros_like(q_out[...])
+
+    wide0 = new[0]
+
+    def q_body(i, carry):
+        sl = pl.dslice(i * CHUNK_B, CHUNK_B)
+        for r in range(depth):
+            idx = idx_ref[r, sl].reshape(CHUNK_B, 1)
+            qc = jnp.sum(jnp.where(idx == lanes, wide0[r:r + 1, :], 0.0),
+                         axis=1)
+            q_out[r, sl] = q_out[r, sl] + qc
+        return carry
+
+    jax.lax.fori_loop(0, n_chunks, q_body, 0)
+
+
+def tiered_eligible(width: int, spec) -> bool:
+    """Static gate for the tier-interior walk: whole tiles, whole top
+    groups per tile (so promotion never crosses a tile boundary)."""
+    return width % TILE_W == 0 and TILE_W % spec.top_group == 0
+
+
+def update_two_tiered(plane_a, plane_b, h1: jax.Array, h2: jax.Array,
+                      vals_a: jax.Array, vals_b: jax.Array, valid: jax.Array,
+                      spec, interpret: bool | None = None):
+    """Tier-native twin of :func:`update_two`: folds BOTH Count-Min planes
+    straight into their (u8 base, u16 mid, u32 top) tier arrays and returns
+    ``(new_plane_a, new_plane_b, est)`` where ``est[b]`` is
+    ``countmin.query`` of the post-fold bytes plane's transient wide view
+    (what the slot table queries). Semantics are ``tiered.fold_encode`` of
+    the wide fold — pinned bit-exact by tests/test_tiered.py."""
+    from netobserv_tpu.sketch.tiered import TieredPlane
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    d, w = plane_a.base.shape
+    assert plane_b.base.shape == (d, w)
+    assert tiered_eligible(w, spec), \
+        f"width {w} / top_group {spec.top_group} ineligible for tier tiles"
+    mg, tg = spec.mid_group, spec.top_group
+    b = h1.shape[0]
+    pad = (-b) % CHUNK_B
+    if pad:
+        h1 = jnp.pad(h1, (0, pad))
+        h2 = jnp.pad(h2, (0, pad), constant_values=1)
+        vals_a = jnp.pad(vals_a, (0, pad))
+        vals_b = jnp.pad(vals_b, (0, pad))
+        valid = jnp.pad(valid, (0, pad))
+    idx = hashing.row_indices(h1, h2, d, w).astype(jnp.int32)  # [d, B]
+    vals = jnp.stack([
+        jnp.where(valid, vals_a, 0).astype(jnp.float32),
+        jnp.where(valid, vals_b, 0).astype(jnp.float32)])      # [2, B]
+    base_s = jnp.stack([plane_a.base, plane_b.base])   # [2, d, w] u8
+    mid_s = jnp.stack([plane_a.mid, plane_b.mid])      # [2, d, w//mg] u16
+    top_s = jnp.stack([plane_a.top, plane_b.top])      # [2, d, w//tg] u32
+    n_chunks = idx.shape[1] // CHUNK_B
+
+    kernel = functools.partial(
+        _tier2_kernel, depth=d, n_chunks=n_chunks, mid_group=mg,
+        top_group=tg, units=(spec.bytes_unit, 1))
+    nb, nm, nt, q = pl.pallas_call(
+        kernel,
+        grid=(w // TILE_W,),
+        in_specs=[
+            pl.BlockSpec((2, d, TILE_W), lambda j: (0, 0, j)),
+            pl.BlockSpec((2, d, TILE_W // mg), lambda j: (0, 0, j)),
+            pl.BlockSpec((2, d, TILE_W // tg), lambda j: (0, 0, j)),
+            pl.BlockSpec((d, idx.shape[1]), lambda j: (0, 0)),
+            pl.BlockSpec((2, idx.shape[1]), lambda j: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((2, d, TILE_W), lambda j: (0, 0, j)),
+            pl.BlockSpec((2, d, TILE_W // mg), lambda j: (0, 0, j)),
+            pl.BlockSpec((2, d, TILE_W // tg), lambda j: (0, 0, j)),
+            pl.BlockSpec((d, idx.shape[1]), lambda j: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((2, d, w), jnp.uint8),
+            jax.ShapeDtypeStruct((2, d, w // mg), jnp.uint16),
+            jax.ShapeDtypeStruct((2, d, w // tg), jnp.uint32),
+            jax.ShapeDtypeStruct((d, idx.shape[1]), jnp.float32),
+        ),
+        input_output_aliases={0: 0, 1: 1, 2: 2},
+        interpret=interpret,
+    )(base_s, mid_s, top_s, idx, vals)
+    est = jnp.min(q[:, :b], axis=0)
+    return (TieredPlane(base=nb[0], mid=nm[0], top=nt[0]),
+            TieredPlane(base=nb[1], mid=nm[1], top=nt[1]), est)
 
 
 def update(cm: CountMin, h1: jax.Array, h2: jax.Array, values: jax.Array,

@@ -1,0 +1,140 @@
+"""Shared tier-tile lanes for the tier-interior Pallas walks.
+
+The tiered counter planes (sketch/tiered.py) keep the resident Count-Min
+tables as a u8 base plane plus direct-mapped u16 MID / u32 TOP overflow
+groups, and the HLL banks as 6-bit-packed u8 bytes. The decode-wrapped fold
+streams a full-width f32 temporary through HBM; the tier-interior kernels
+(`countmin_kernel.update_two_tiered`, `signal_kernel.update_tiered`) instead
+load the NARROW tier tiles into VMEM, decode/fold/promote in registers, and
+store narrow tiles back — the wide array never exists in HBM.
+
+This module owns the tile load/promote/store lanes so the two kernels
+cannot drift from each other:
+
+- :func:`decode_tile` / :func:`promote_tile` — the in-VMEM twins of
+  ``tiered.decode_plane`` / ``tiered.plane_add`` (op-for-op: the same
+  ``ceil``-to-unit overestimate-only rounding, the same saturation
+  cascade, the same u32 integer sat-add at the TOP tier). Group
+  expand/sum ride iota-built one-hot matrices on the MXU instead of
+  reshapes (Mosaic-friendly; expand is an exact gather, group sums are
+  exact for the integer-valued-f32 < 2^24 regime every equivalence pin
+  in this repo already relies on).
+- :func:`unpack_reg_rows` / :func:`pack_reg_rows` — the in-VMEM twins of
+  ``tiered.unpack_hll`` / ``tiered.pack_hll`` over the kernel-facing
+  ``[3, m//4]`` byte-row layout (byte j of packed triple t lives at
+  ``[j, t]``; register ``4t + r`` is row ``r`` of the 6-bit expansion).
+  Lossless both ways — ranks are <= 33.
+
+The tier constants are duplicated here by value (ops must not import the
+sketch package — layering); tests/test_tiered.py pins them against
+sketch/tiered.py so the two definitions cannot drift either.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: value twins of sketch/tiered.py BASE_MAX / MID_MAX / TOP_MAX —
+#: equality pinned by tests/test_tiered.py (one-truth guard)
+BASE_MAX = 255
+MID_MAX = 65535
+TOP_MAX = 1 << 30
+
+
+# --------------------------------------------------------------------------
+# iota-built group matrices (expand = exact one-hot gather; group-sum =
+# one-hot MXU contraction)
+# --------------------------------------------------------------------------
+
+def expand_matrix(n: int, g: int) -> jax.Array:
+    """f32 ``[n//g, n]`` with ``E[t, k] = 1.0`` iff ``k // g == t`` —
+    ``x[d, n//g] @ E`` broadcasts each group cell over its g columns
+    (exactly ``tiered._expand``; one 1.0 per column, so the contraction
+    is an exact gather)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n // g, n), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n // g, n), 1)
+    return (cols // g == rows).astype(jnp.float32)
+
+
+def groupsum_matrix(n: int, g: int) -> jax.Array:
+    """f32 ``[n, n//g]`` with ``G[k, t] = 1.0`` iff ``k // g == t`` —
+    ``y[d, n] @ G`` sums each g-column group (``tiered._group_sum``)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n, n // g), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n, n // g), 1)
+    return (rows // g == cols).astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# Count-Min tier tiles (decode / promote), one [d, TILE] slab per plane
+# --------------------------------------------------------------------------
+
+def decode_tile(base_i: jax.Array, mid_i: jax.Array, top_u: jax.Array,
+                em: jax.Array, et: jax.Array, unit: int) -> jax.Array:
+    """Wide f32 view of one tier tile — ``tiered.decode_plane`` op-for-op
+    (same casts, same masked adds, so the f32 rounding on a large TOP cell
+    is bit-identical to the decode-wrapped form's).
+
+    base_i/mid_i: i32 tiles (cast from u8/u16 by the caller — compares
+    happen in 32-bit lanes); top_u: the resident u32 tile. ``em`` expands
+    mid cells over their columns (``expand_matrix(T, mid_group)``), ``et``
+    expands top cells over their mid cells."""
+    mid_f = mid_i.astype(jnp.float32)
+    top_per_mid = jnp.dot(top_u.astype(jnp.float32), et,
+                          preferred_element_type=jnp.float32)
+    mid_tot = mid_f + jnp.where(mid_i == MID_MAX, top_per_mid, 0.0)
+    per_col = jnp.dot(mid_tot, em, preferred_element_type=jnp.float32)
+    units = base_i.astype(jnp.float32) + jnp.where(
+        base_i == BASE_MAX, per_col, 0.0)
+    return units * unit if unit > 1 else units
+
+
+def promote_tile(base_i: jax.Array, mid_i: jax.Array, top_u: jax.Array,
+                 dec: jax.Array, new: jax.Array, gm: jax.Array,
+                 gt: jax.Array, unit: int
+                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Saturation promotion inside the walk — ``tiered.plane_add`` with
+    ``delta = new - dec`` (the exact per-counter fold delta, untouched
+    counters contribute 0), as masked in-place tier writes. Every rounding
+    step goes UP (ceil to the unit, top-tier u32 integer sat-add) —
+    overestimate-only, the one error direction tiers allow.
+
+    ``gm``/``gt`` are the column->mid / mid->top group-sum matrices.
+    Returns the new (u8 base, u16 mid, u32 top) tiles."""
+    du = jnp.ceil(jnp.maximum(new - dec, 0.0) / unit)
+    s = base_i.astype(jnp.float32) + du
+    new_base = jnp.minimum(s, float(BASE_MAX))
+    s2 = mid_i.astype(jnp.float32) + jnp.dot(
+        s - new_base, gm, preferred_element_type=jnp.float32)
+    new_mid = jnp.minimum(s2, float(MID_MAX))
+    spill = jnp.dot(s2 - new_mid, gt, preferred_element_type=jnp.float32)
+    # clamp BEFORE the u32 cast, then sat-add against the remaining room —
+    # tiered._spill verbatim (f32 at the top would round small spills away
+    # past 2^24 units: an undercount)
+    inc = jnp.minimum(spill, float(TOP_MAX)).astype(jnp.uint32)
+    room = jnp.uint32(TOP_MAX) - top_u
+    new_top = top_u + jnp.minimum(inc, room)
+    return (new_base.astype(jnp.uint8), new_mid.astype(jnp.uint16), new_top)
+
+
+# --------------------------------------------------------------------------
+# packed-HLL tiles (6-bit registers, 4 per 3 bytes, byte-row layout)
+# --------------------------------------------------------------------------
+
+def unpack_reg_rows(pk3: jax.Array) -> list[jax.Array]:
+    """u8 ``[3, T]`` byte-row tile -> four i32 ``[1, T]`` register rows
+    (row r holds register ``4t + r`` of packed triple t) — the in-VMEM
+    twin of ``tiered.unpack_hll`` over the transposed layout the wrapper
+    ships (elementwise bit ops only; no reshape inside the kernel)."""
+    b = pk3.astype(jnp.int32)
+    v = b[0:1, :] | (b[1:2, :] << 8) | (b[2:3, :] << 16)
+    return [(v >> (6 * r)) & 63 for r in range(4)]
+
+
+def pack_reg_rows(rows: list[jax.Array]) -> jax.Array:
+    """Inverse of :func:`unpack_reg_rows`: four i32 ``[1, T]`` register
+    rows -> u8 ``[3, T]`` byte rows. Lossless (ranks <= 33 fit 6 bits)."""
+    v = rows[0] | (rows[1] << 6) | (rows[2] << 12) | (rows[3] << 18)
+    return jnp.concatenate(
+        [v & 0xFF, (v >> 8) & 0xFF, (v >> 16) & 0xFF],
+        axis=0).astype(jnp.uint8)

@@ -287,11 +287,53 @@ def arrays_to_dense(arrays: dict[str, np.ndarray]) -> np.ndarray:
     return dense.reshape(-1)
 
 
+class _TierHook:
+    """Trace-time mailbox of one tier-interior fold: carries the resident
+    TieredState into the body's branch points (so the CM walk folds the
+    tier arrays directly and the fused signal walk folds the packed
+    global-src bank) and collects the kernels' tier outputs for
+    :func:`tiered.interior_encode`. Plain-Python mutation is safe here —
+    tracing is linear and the hook never crosses a jit boundary."""
+
+    __slots__ = ("state", "fuse_hll", "out")
+
+    def __init__(self, state, fuse_hll: bool):
+        self.state = state
+        self.fuse_hll = fuse_hll
+        self.out: dict = {}
+
+
+def _tier_interior_ok(state) -> bool:
+    """Static eligibility of the tier-interior Pallas walk (trace-time)."""
+    from netobserv_tpu.ops.pallas import countmin_kernel
+    width = state.tables.cm_bytes.base.shape[1]
+    return countmin_kernel.tiered_eligible(width, state.spec)
+
+
+def tiered_fold_form(cfg: SketchConfig) -> str | None:
+    """Which fold form a tiered pipeline under ``cfg`` engages on THIS
+    backend: ``"interior"`` (tier-native Pallas walk), ``"decode"``
+    (decode-to-wide wrap), or None when tiers are off. Mirrors the
+    trace-time gate in :func:`ingest` — accounting/attribution only."""
+    if cfg.tiered is None:
+        return None
+    up = cfg.use_pallas
+    if up is None:
+        up = jax.default_backend() == "tpu" and cfg.cm_width >= 16384
+    if up:
+        from netobserv_tpu.ops.pallas import countmin_kernel
+        if countmin_kernel.tiered_eligible(cfg.cm_width, cfg.tiered):
+            return "interior"
+    return "decode"
+
+
 def ingest(state: SketchState, arrays: dict[str, jax.Array],
            sketch_axis: str | None = None, sketch_shards: int = 1,
            use_pallas: bool | None = None,
            enable_fanout: bool = True,
-           enable_asym: bool = True) -> SketchState:
+           enable_asym: bool = True,
+           tier_interior: bool | None = None,
+           _tier: "_TierHook | None" = None) -> SketchState:
     """Fold one batch into all sketches. Pure; jit with donate_argnums=0.
 
     When `sketch_axis` is set (inside shard_map over a 2D mesh), the Count-Min
@@ -319,6 +361,34 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
                 "counter planes are single-device (config.validate blocks "
                 "SKETCH_MESH_SHAPE with SKETCH_TIERED)")
         spec = state.spec
+        up = use_pallas
+        if up is None:  # the same auto rule as the wide path, tier widths
+            up = (jax.default_backend() == "tpu"
+                  and state.tables.cm_bytes.base.shape[1] >= 16384)
+        if up and tier_interior is not False and _tier_interior_ok(state):
+            # TIER-INTERIOR fold: the Pallas walks read/promote the narrow
+            # tier arrays directly in VMEM — no wide CM temporary in HBM.
+            # The decode-wrapped path below stays verbatim as the scatter
+            # twin / equivalence oracle (tests/test_tiered.py pins
+            # interior vs decode-wrapped-scatter bit-exact).
+            from netobserv_tpu.ops.pallas import signal_kernel
+            r = state.rest
+            probe = signal_kernel.SignalPlanes(
+                ddos_rate=r.ddos.rate, syn_rate=r.syn.rate,
+                drops_rate=r.drops_ewma.rate, synack=r.synack,
+                conv_fwd=r.conv_fwd, conv_rev=r.conv_rev,
+                dscp_bytes=r.dscp_bytes, drop_causes=r.drop_causes)
+            m_hll = state.tables.hll_src.shape[0] // 3 * 4
+            fuse = (signal_kernel.eligible(probe)
+                    and signal_kernel.hll_fusible(m_hll))
+            hook = _TierHook(state, fuse)
+            work = tiered.widen_interior(state, fuse)
+            new_work = ingest(work, arrays, use_pallas=True,
+                              enable_fanout=enable_fanout,
+                              enable_asym=enable_asym, _tier=hook)
+            return tiered.interior_encode(
+                state, hook.out["cm_bytes"], hook.out["cm_pkts"],
+                hook.out.get("hll_src"), new_work)
         cmb_wide = tiered.decode_plane(state.tables.cm_bytes, spec,
                                        spec.bytes_unit)
         cmp_wide = tiered.decode_plane(state.tables.cm_pkts, spec, 1)
@@ -362,25 +432,47 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
     dst_h1 = mhash.dst_h1
 
     if sketch_axis is None:
-        # the Pallas kernel needs the width to tile; silently use the XLA
-        # scatter otherwise (static shape check, resolved at trace time)
-        if use_pallas and state.cm_bytes.width % 512 == 0:
+        # tier-interior first: the CM fields here are zero-size
+        # placeholders (whose width trivially tiles) — the walk reads and
+        # promotes the resident tier arrays directly
+        if _tier is not None:
             from netobserv_tpu.ops.pallas import countmin_kernel
-            # fused: both planes share hash indices AND one-hot construction
-            cm_b, cm_p = countmin_kernel.update_two(
-                state.cm_bytes, state.cm_pkts, h1, h2, bytes_f,
-                pkts.astype(jnp.float32), valid)
+            t = _tier.state.tables
+            new_cmb, new_cmp, est = countmin_kernel.update_two_tiered(
+                t.cm_bytes, t.cm_pkts, h1, h2, bytes_f,
+                pkts.astype(jnp.float32), valid, _tier.state.spec)
+            _tier.out["cm_bytes"] = new_cmb
+            _tier.out["cm_pkts"] = new_cmp
+            cm_b, cm_p = state.cm_bytes, state.cm_pkts  # stay placeholders
+            # the kernel already gathered the post-fold bytes estimate
+            # from its transient wide view — exactly countmin.query of the
+            # decode-wrapped form's cm_b
+            heavy, evicted = topk.slot_update(
+                state.heavy, cm_b, words, h1, h2, valid,
+                query_fn=lambda a, b: est,
+                window=state.window,
+                use_pallas=state.heavy.k % 128 == 0)
         else:
-            cm_b, cm_p = countmin.update_two(
-                state.cm_bytes, state.cm_pkts, h1, h2, bytes_f, pkts, valid)
-        # persistent-slot maintenance in the batch walk: the fused Pallas
-        # reduction twin engages with the other kernels (lane-aligned K);
-        # the scatter form everywhere else — bit-exact either way
-        # (tests/test_pallas_topk.py pins the two-form invariant)
-        heavy, evicted = topk.slot_update(
-            state.heavy, cm_b, words, h1, h2, valid,
-            window=state.window,
-            use_pallas=use_pallas and state.heavy.k % 128 == 0)
+            # the Pallas kernel needs the width to tile; silently use the
+            # XLA scatter otherwise (static check, resolved at trace time)
+            if use_pallas and state.cm_bytes.width % 512 == 0:
+                from netobserv_tpu.ops.pallas import countmin_kernel
+                # fused: both planes share hash indices + one-hot build
+                cm_b, cm_p = countmin_kernel.update_two(
+                    state.cm_bytes, state.cm_pkts, h1, h2, bytes_f,
+                    pkts.astype(jnp.float32), valid)
+            else:
+                cm_b, cm_p = countmin.update_two(
+                    state.cm_bytes, state.cm_pkts, h1, h2, bytes_f, pkts,
+                    valid)
+            # persistent-slot maintenance in the batch walk: the fused
+            # Pallas reduction twin engages with the other kernels
+            # (lane-aligned K); the scatter form everywhere else —
+            # bit-exact either way (tests/test_pallas_topk.py pins it)
+            heavy, evicted = topk.slot_update(
+                state.heavy, cm_b, words, h1, h2, valid,
+                window=state.window,
+                use_pallas=use_pallas and state.heavy.k % 128 == 0)
     else:
         cm_b = countmin.update_sharded(state.cm_bytes, h1, h2, bytes_f, valid,
                                        sketch_axis, sketch_shards)
@@ -394,7 +486,11 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
             query_fn=lambda a, b: countmin.query_sharded_local(
                 cm_b, a, b, sketch_axis, sketch_shards),
             window=state.window)
-    if (use_pallas and sketch_axis is None
+    if _tier is not None and _tier.fuse_hll:
+        # the global-src bank stays 6-bit packed; the fused signal walk
+        # below folds it and stashes the new packed bank in the hook
+        hll_src = state.hll_src  # zero-size placeholder
+    elif (use_pallas and sketch_axis is None
             and state.hll_src.regs.shape[0] % 512 == 0):
         from netobserv_tpu.ops.pallas import hll_kernel
         hll_src = hll_kernel.update(state.hll_src, src_h1, src_h2, valid)
@@ -514,11 +610,23 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
             v_dscp = jnp.where(valid, bytes_f, 0.0)
         else:
             dscp_idx, v_dscp = izeros_b, zeros_b
-        out = signal_kernel.update(
-            planes,
-            jnp.stack([dst_idx, src_idx, pair_idx, dscp_idx, cause_idx]),
-            jnp.stack([v_ddos, v_syn, v_drops, v_synack, v_fwd, v_rev,
-                       v_dscp, v_cause]))
+        sig_idx = jnp.stack([dst_idx, src_idx, pair_idx, dscp_idx,
+                             cause_idx])
+        sig_vals = jnp.stack([v_ddos, v_syn, v_drops, v_synack, v_fwd,
+                              v_rev, v_dscp, v_cause])
+        if _tier is not None and _tier.fuse_hll:
+            # tiered megakernel: the same signal fold plus the packed
+            # global-src HLL lane in one walk (idx/rank mirror
+            # hll_kernel.update exactly — max fold, bit-exact)
+            packed = _tier.state.tables.hll_src
+            m_hll = packed.shape[0] // 3 * 4
+            hll_idx = (src_h1 & jnp.uint32(m_hll - 1)).astype(jnp.int32)
+            hll_rank = jnp.where(valid, hll._rank(src_h2), 0)
+            out, new_packed = signal_kernel.update_tiered(
+                planes, packed, sig_idx, sig_vals, hll_idx, hll_rank)
+            _tier.out["hll_src"] = new_packed
+        else:
+            out = signal_kernel.update(planes, sig_idx, sig_vals)
         ddos = state.ddos._replace(rate=out.ddos_rate)
         syn_state = state.syn._replace(rate=out.syn_rate)
         drops_state = state.drops_ewma._replace(rate=out.drops_rate)
@@ -588,11 +696,13 @@ def ingest(state: SketchState, arrays: dict[str, jax.Array],
 def make_ingest_fn(donate: bool = True,
                    use_pallas: bool | None = None,
                    enable_fanout: bool = True,
-                   enable_asym: bool = True):
+                   enable_asym: bool = True,
+                   tier_interior: bool | None = None):
     """Jitted ingest; donates the state buffers so updates are in-place on HBM."""
     fn = lambda s, a: ingest(s, a, use_pallas=use_pallas,  # noqa: E731
                              enable_fanout=enable_fanout,
-                             enable_asym=enable_asym)
+                             enable_asym=enable_asym,
+                             tier_interior=tier_interior)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 

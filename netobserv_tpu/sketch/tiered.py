@@ -314,6 +314,39 @@ def widen(ts: TieredState, cmb_wide: jax.Array, cmp_wide: jax.Array):
         hll_per_src=hll.PerDstHLL(unpack_hll(t.hll_per_src)))
 
 
+def widen_interior(ts: TieredState, fuse_hll_src: bool):
+    """The transient SketchState the TIER-INTERIOR fold operates on: the
+    CM planes keep their zero-size placeholders (the interior kernel folds
+    the tier arrays directly — no wide decode), and the global-src HLL
+    bank stays packed too when the fused signal lane handles it
+    (``fuse_hll_src``). Only the per-bucket HLL grids unpack — their fold
+    is scatter-only by the measured gating verdict."""
+    t = ts.tables
+    rest = ts.rest._replace(
+        hll_per_dst=hll.PerDstHLL(unpack_hll(t.hll_per_dst)),
+        hll_per_src=hll.PerDstHLL(unpack_hll(t.hll_per_src)))
+    if not fuse_hll_src:
+        rest = rest._replace(hll_src=hll.HLL(unpack_hll(t.hll_src)))
+    return rest
+
+
+def interior_encode(ts: TieredState, cm_bytes: TieredPlane,
+                    cm_pkts: TieredPlane, hll_src_packed,
+                    new_work) -> TieredState:
+    """Close one tier-interior fold: the CM planes arrive already promoted
+    by the kernel, the global-src bank arrives packed when the fused lane
+    folded it (else re-packs from the wide work state), the per-bucket
+    grids re-pack losslessly, everything else rides ``new_work``."""
+    tables = TieredTables(
+        cm_bytes=cm_bytes,
+        cm_pkts=cm_pkts,
+        hll_src=(hll_src_packed if hll_src_packed is not None
+                 else pack_hll(new_work.hll_src.regs)),
+        hll_per_dst=pack_hll(new_work.hll_per_dst.regs),
+        hll_per_src=pack_hll(new_work.hll_per_src.regs))
+    return TieredState(tables, _strip(new_work), ts.spec)
+
+
 def decode_state(ts: TieredState):
     """The canonical wide SketchState (what roll / state_tables /
     checkpoints see)."""

@@ -111,10 +111,11 @@ class Watched:
     __slots__ = ("_fn", "name", "warmup_calls", "calls", "compiles",
                  "retraces", "last_retrace", "dispatch_seconds",
                  "compile_seconds", "last_signature", "donated_bytes",
-                 "tenants", "__weakref__")
+                 "tenants", "tiered", "__weakref__")
 
     def __init__(self, fn: Callable, name: str, warmup_calls: int,
-                 tenants: Optional[int] = None):
+                 tenants: Optional[int] = None,
+                 tiered: Optional[str] = None):
         self._fn = fn
         self.name = name
         self.warmup_calls = warmup_calls
@@ -130,6 +131,10 @@ class Watched:
         #: /debug/executables registry reports the stacked fold as ONE fn
         #: with its tenant axis named, never N anonymous entries
         self.tenants = tenants
+        #: tiered fold form of a SKETCH_TIERED executable ("interior" |
+        #: "decode") — same one-program rule: the registry attributes
+        #: which walk the entry compiled to, never a hidden variant
+        self.tiered = tiered
 
     def __call__(self, *args, **kwargs):
         self.calls += 1
@@ -171,6 +176,10 @@ class Watched:
             # signature reads as one executable folding N tenants (the
             # leading dim of every stacked arg IS this count)
             sig = f"tenants={self.tenants} {sig}"
+        if self.tiered is not None:
+            # tiered entries prefix the fold form so the signature reads
+            # as the tier-interior walk or the decode-to-wide wrap
+            sig = f"tiered={self.tiered} {sig}"
         self.last_signature = sig
         self.donated_bytes = _donated_bytes(args)
         if self.calls <= self.warmup_calls:
@@ -196,6 +205,8 @@ class Watched:
                 "donated_bytes_estimate": self.donated_bytes,
                 **({"tenants": self.tenants}
                    if self.tenants is not None else {}),
+                **({"tiered": self.tiered}
+                   if self.tiered is not None else {}),
                 **({"last_signature": self.last_signature}
                    if self.last_signature else {}),
                 **({"last_retrace": self.last_retrace}
@@ -223,16 +234,19 @@ def _ensure_installed() -> None:
 
 def watch(fn: Callable, name: str,
           warmup_calls: Optional[int] = None,
-          tenants: Optional[int] = None) -> Callable:
+          tenants: Optional[int] = None,
+          tiered: Optional[str] = None) -> Callable:
     """Wrap a jitted entry point for retrace accounting. Returns `fn`
     unchanged when the watchdog is disabled; never double-wraps.
     `tenants` marks a tenant-stacked (vmapped) executable: the registry
-    reports it as one fn with the tenant count in its signature string."""
+    reports it as one fn with the tenant count in its signature string.
+    `tiered` ("interior" | "decode") marks a SKETCH_TIERED executable with
+    the fold form it compiled to — one program either way, attributed."""
     if not _enabled or isinstance(fn, Watched):
         return fn
     _ensure_installed()
     w = Watched(fn, name, _default_warmup if warmup_calls is None
-                else warmup_calls, tenants=tenants)
+                else warmup_calls, tenants=tenants, tiered=tiered)
     with _install_lock:
         _registry.append(weakref.ref(w))
         if len(_registry) % 64 == 0:  # amortized sweep of dead wrappers

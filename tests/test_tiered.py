@@ -59,43 +59,42 @@ def _dev(arrays):
 
 
 # --------------------------------------------------------------------------
-# the numpy TWIN of the tier spec (the decode-equivalence oracle)
+# the numpy TWIN of the tier spec (the decode-equivalence oracle) lives in
+# tests/test_tiered_twin.py — a jax-free module so the big-endian qemu CI
+# tier executes it (with golden digests) on real big-endian byte order
 # --------------------------------------------------------------------------
 
-def twin_spill(over, mid, top, spec):
-    d = over.shape[0]
-    gs = over.reshape(d, -1, spec.mid_group).sum(-1, dtype=np.float32)
-    s2 = mid.astype(np.float32) + gs
-    nmid = np.minimum(s2, np.float32(MID_MAX))
-    g2 = (s2 - nmid).reshape(
-        d, -1, spec.top_group // spec.mid_group).sum(-1, dtype=np.float32)
-    # top accumulates in u32 INTEGER arithmetic (exact past 2^24 units,
-    # where f32 would round small spills away — an undercount)
-    inc = np.minimum(g2, np.float32(TOP_MAX)).astype(np.uint32)
-    room = (np.uint32(TOP_MAX) - top).astype(np.uint32)
-    return nmid.astype(np.uint16), top + np.minimum(inc, room)
+from tests.test_tiered_twin import (  # noqa: E402
+    GOLDEN, digest, fuzz_deltas, twin_decode, twin_plane_add,
+)
 
 
-def twin_plane_add(plane, delta, spec, unit):
-    delta = np.maximum(delta.astype(np.float32), np.float32(0))
-    du = np.ceil(delta / np.float32(unit))  # always ceil, like the device
-    s = plane[0].astype(np.float32) + du
-    nbase = np.minimum(s, np.float32(BASE_MAX))
-    nmid, ntop = twin_spill(s - nbase, plane[1], plane[2], spec)
-    return (nbase.astype(np.uint8), nmid, ntop)
+def test_twin_constants_match_device_modules():
+    """One value truth across the three homes of the tier constants: the
+    numpy twin module, sketch/tiered.py, and the Pallas tile helpers."""
+    import tests.test_tiered_twin as twin
+    from netobserv_tpu.ops.pallas import tier_tiles
+
+    for mod in (twin, tier_tiles):
+        assert mod.BASE_MAX == BASE_MAX
+        assert mod.MID_MAX == MID_MAX
+        assert mod.TOP_MAX == TOP_MAX
 
 
-def twin_decode(plane, spec, unit):
-    base, mid, top = (np.asarray(x) for x in plane)
-    d = base.shape[0]
-    rep = spec.top_group // spec.mid_group
-    mid_tot = mid.astype(np.float32) + np.where(
-        mid == MID_MAX,
-        np.repeat(top.astype(np.float32), rep, axis=-1), np.float32(0))
-    per_col = np.repeat(mid_tot, spec.mid_group, axis=-1).reshape(d, -1)
-    units = base.astype(np.float32) + np.where(
-        base == BASE_MAX, per_col, np.float32(0))
-    return units * np.float32(unit) if unit > 1 else units
+def test_device_plane_matches_twin_golden_schedule():
+    """The device plane over the twin module's deterministic fuzz schedule
+    reproduces the PINNED golden digests: device == twin == golden, so the
+    qemu tier's big-endian run pins the same counts this jax run does."""
+    for (spec, unit), want in GOLDEN.items():
+        dspec = TierSpec(spec.mid_group, spec.top_group, spec.bytes_unit)
+        plane = tiered.init_plane(2, 256, dspec)
+        for fold in range(6):
+            plane = tiered.plane_add(
+                plane, jnp.asarray(fuzz_deltas(fold, 2, 256, unit)),
+                dspec, unit)
+        host = tuple(np.asarray(x) for x in plane)
+        assert digest(host, np.asarray(
+            tiered.decode_plane(plane, dspec, unit))) == want
 
 
 @pytest.mark.parametrize("spec,unit", [
@@ -445,3 +444,199 @@ def test_exporter_end_to_end_tiered(monkeypatch):
         exp.close()
     # and the fresh window still folds (post-roll state is tiered)
     assert isinstance(exp._state, tiered.TieredState)
+
+
+# --------------------------------------------------------------------------
+# tier-native Pallas walks (ISSUE 20): fold on the packed u8/u16/u32 tiles,
+# no wide decode temporary — the decode wrap stays the equivalence oracle
+# --------------------------------------------------------------------------
+
+INTERIOR_SPECS = [
+    pytest.param(SMALL_TIERS, id="u1"),
+    pytest.param(TierSpec(mid_group=8, top_group=64, bytes_unit=64),
+                 id="u64"),
+]
+
+
+def _boundary_batches(spec, folds=4):
+    """Boundary-crossing fold schedule INSIDE the f32-exact regime: every
+    accumulated f32 value (wide CM cells, heavy slot counts) stays below
+    2^24, where scatter order vs matmul tree-sum order cannot differ — the
+    module's documented standing assumption, and the only regime where a
+    bit-exact pin is even well-defined. The u64 spec needs concentrated
+    mass (a 16-key universe) to drive whole mid GROUPS past 65535 units
+    without any single cell leaving the regime."""
+    if spec.bytes_unit == 1:
+        return [_dev(_batch(96, seed=i, max_bytes=60_000))
+                for i in range(folds)]
+    rng = np.random.default_rng(5)
+    universe = rng.integers(0, 2**32, (16, KW), dtype=np.uint32)
+    out = []
+    for i in range(6):
+        b = _batch(96, seed=i, max_bytes=400_000,
+                   keys=universe[rng.integers(0, 16, 96)])
+        out.append(_dev(b))
+    return out
+
+
+def _interior_cfg(spec, **kw):
+    """512-wide CM (tile-aligned: TILE_W | width, top_group | TILE_W) so
+    the interior gate passes on the small test geometry."""
+    base = dict(cm_depth=2, cm_width=512, hll_precision=6,
+                perdst_buckets=32, perdst_precision=4,
+                persrc_buckets=32, persrc_precision=4,
+                topk=16, hist_buckets=64, ewma_buckets=32, tiered=spec)
+    base.update(kw)
+    return sk.SketchConfig(**base)
+
+
+def _assert_tiered_states_equal(got, want):
+    for g, w in zip(jax.tree.leaves(got.tables),
+                    jax.tree.leaves(want.tables)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    dg, dw = tiered.decode_state(got), tiered.decode_state(want)
+    for name in dw._fields:
+        for g, w in zip(jax.tree.leaves(getattr(dg, name)),
+                        jax.tree.leaves(getattr(dw, name))):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w),
+                                          err_msg=name)
+
+
+@pytest.mark.parametrize("spec", INTERIOR_SPECS)
+def test_interior_walk_three_forms_bit_exact(spec):
+    """Saturation-boundary fuzz, three fold forms: the tier-interior walk
+    and the decode-wrapped Pallas walk both match the decode-wrapped
+    scatter chain bit-exactly — tier arrays AND full wide decode (heavy
+    table included). Deltas stay in the f32-exact regime (per-fold group
+    sums < 2^24 units) while still crossing base -> mid -> top."""
+    cfg = _interior_cfg(spec)
+    assert sk.tiered_fold_form(cfg._replace(use_pallas=True)) == "interior"
+    batches = _boundary_batches(spec)
+    out = {}
+    for name, kw in (("interior", dict(use_pallas=True)),
+                     ("decode_pallas",
+                      dict(use_pallas=True, tier_interior=False)),
+                     ("scatter", dict(use_pallas=False))):
+        s = sk.init_state(cfg)
+        for b in batches:
+            s = sk.ingest(s, b, **kw)
+        out[name] = s
+    # the schedule really promoted at every boundary
+    t = out["interior"].tables.cm_bytes
+    assert (np.asarray(t.base) == BASE_MAX).any()
+    assert (np.asarray(t.mid) == MID_MAX).any()
+    assert (np.asarray(t.top) > 0).any()
+    _assert_tiered_states_equal(out["interior"], out["scatter"])
+    _assert_tiered_states_equal(out["decode_pallas"], out["scatter"])
+
+
+def test_interior_fused_hll_lane_and_fallback(monkeypatch):
+    """ewma_buckets=128 makes the signal fold eligible, so the interior
+    walk fuses the packed global-src HLL bank into the signal megakernel
+    (spied via update_tiered); ewma_buckets=32 declines and the bank folds
+    through the unfused unpack->scatter->pack seam. Both stay bit-exact
+    vs the decode-wrapped scatter chain, packed bank included."""
+    from netobserv_tpu.ops.pallas import signal_kernel
+
+    spec = TierSpec(mid_group=8, top_group=64, bytes_unit=64)
+    orig = signal_kernel.update_tiered
+    for ewma, expect_fused in ((128, True), (32, False)):
+        cfg = _interior_cfg(spec, ewma_buckets=ewma)
+        calls = []
+        monkeypatch.setattr(
+            signal_kernel, "update_tiered",
+            lambda *a, **k: (calls.append(1), orig(*a, **k))[1])
+        si, ss = sk.init_state(cfg), sk.init_state(cfg)
+        for i in range(2):
+            b = _dev(_batch(96, seed=i, max_bytes=2_000_000))
+            si = sk.ingest(si, b, use_pallas=True)
+            ss = sk.ingest(ss, b, use_pallas=False)
+        assert bool(calls) == expect_fused, ewma
+        np.testing.assert_array_equal(
+            np.asarray(si.tables.hll_src), np.asarray(ss.tables.hll_src))
+        _assert_tiered_states_equal(si, ss)
+
+
+@pytest.mark.parametrize("spec", INTERIOR_SPECS)
+def test_interior_zero_retraces_across_superbatch_ladder(spec):
+    """The superbatch ladder rule under the interior walk: one fixed-shape
+    jit PER ladder size, each compiling exactly once (promotion changes
+    values, never shapes) — and each watched entry carries the
+    tiered=interior attribution /debug/executables reads."""
+    from netobserv_tpu.utils import retrace
+
+    cfg = _interior_cfg(spec)
+    assert sk.tiered_fold_form(cfg._replace(use_pallas=True)) == "interior"
+    s = sk.init_state(cfg)
+    for k in (1, 2, 4):
+        fn = retrace.watch(
+            sk.make_ingest_fn(donate=False, use_pallas=True),
+            f"tiered_interior_x{k}", tiered="interior")
+        for i in range(3):
+            s = fn(s, _dev(_batch(64 * k, seed=i, max_bytes=9000)))
+        jax.block_until_ready(jax.tree.leaves(s))
+        assert fn.compiles == 1 and fn.retraces == 0, k
+        assert fn.stats()["tiered"] == "interior"
+        assert "tiered=interior" in fn.last_signature
+
+
+def test_tiered_fold_form_gate():
+    """The accounting twin of the trace-time gate: interior only when
+    Pallas is on AND the geometry tiles (width % TILE_W == 0, top_group
+    divides the tile); every decline lands on the decode wrap, tiers off
+    is None. tier_interior=False (the bench A/B opt-out) is covered by
+    the three-forms test above."""
+    cfg = _interior_cfg(SMALL_TIERS)
+    assert sk.tiered_fold_form(sk.SketchConfig()) is None
+    assert sk.tiered_fold_form(cfg._replace(use_pallas=True)) == "interior"
+    assert sk.tiered_fold_form(cfg._replace(use_pallas=False)) == "decode"
+    assert sk.tiered_fold_form(
+        cfg._replace(use_pallas=True, cm_width=256)) == "decode"
+    wide_top = TierSpec(mid_group=8, top_group=1024, bytes_unit=1)
+    assert sk.tiered_fold_form(
+        cfg._replace(use_pallas=True, tiered=wide_top)) == "decode"
+
+
+def test_mesh_degrade_warns_once_and_registers_condition(caplog):
+    """Multi-device SKETCH_TIERED degrades to wide: the warning dedupes to
+    once per PROCESS (chaos/restart loops rebuild exporters; the log line
+    is informational), and the queryable truth is the tiered_degraded
+    supervisor condition — a condition, never DEGRADED."""
+    import netobserv_tpu.exporter.tpu_sketch as tsx
+    from netobserv_tpu.exporter.tpu_sketch import TpuSketchExporter
+
+    class _Sup:
+        def __init__(self):
+            self.conditions = {}
+
+        def register(self, *a, **k):
+            return lambda: None
+
+        def register_condition(self, name, probe):
+            self.conditions[name] = probe
+
+    tsx._TIERED_DEGRADE_WARNED = False
+    cfg = SMALL_CFG._replace(tiered=SMALL_TIERS)
+    exps = []
+    try:
+        with caplog.at_level(
+                "WARNING", logger="netobserv_tpu.exporter.tpu_sketch"):
+            for _ in range(2):  # a restart loop rebuilds the exporter
+                exps.append(TpuSketchExporter(
+                    batch_size=64, window_s=3600.0, sketch_cfg=cfg,
+                    sink=lambda obj: None))
+        hits = [r for r in caplog.records
+                if "SKETCH_TIERED has no sharded form" in r.getMessage()]
+        assert len(hits) == 1
+        for exp in exps:
+            assert exp._tiered_degraded
+            assert exp._cfg.tiered is None and exp._tier_form is None
+            sup = _Sup()
+            exp.register_supervised(sup)
+            cond = sup.conditions["tiered_degraded"]()
+            assert cond["active"] and "sharded" in cond["reason"]
+            # and the /query/status mirror of the same condition
+            assert exp.query_status().get("tiered_degraded") is True
+    finally:
+        for exp in exps:
+            exp.close()

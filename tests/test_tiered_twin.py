@@ -1,0 +1,177 @@
+"""Numpy twin of the tier spec (jax-free): the tiered planes' oracle.
+
+The twins here ARE the tier spec's executable definition — change
+sketch/tiered.py semantics and these together or not at all (the CLAUDE.md
+tiered invariant). The module is deliberately jax-free so the big-endian
+qemu CI tier (s390x/ppc64le, no jax wheels) really executes it: the golden
+digests pin the tier arrays' ENDIAN-NORMALIZED bytes over a deterministic
+RNG-free fuzz schedule, so byte-order drift in the twin arithmetic (or a
+little-endian assumption hiding in the spec) fails loudly on real
+big-endian hardware. tests/test_tiered.py imports the twins from here for
+the device-vs-twin equivalence pins.
+
+Regime note: every fuzz delta keeps per-fold group sums of integer-valued
+f32 below 2^24, the documented standing assumption ("per-fold spill is
+f32-exact") under which summation order cannot matter — which is exactly
+what makes a cross-platform bit-exact golden possible.
+"""
+
+import hashlib
+from collections import namedtuple
+
+import numpy as np
+
+BASE_MAX = 255        # u8 base plane saturation (twin of tiered.BASE_MAX)
+MID_MAX = 65535       # u16 mid plane saturation (twin of tiered.MID_MAX)
+TOP_MAX = 1 << 30     # top-tier sat-add clamp (twin of tiered.TOP_MAX)
+
+#: structural twin of sketch.tiered.TierSpec — attribute-compatible, so the
+#: twin functions accept either (test_tiered.py passes the real TierSpec)
+TwinSpec = namedtuple("TwinSpec", "mid_group top_group bytes_unit")
+
+
+def twin_spill(over, mid, top, spec):
+    d = over.shape[0]
+    gs = over.reshape(d, -1, spec.mid_group).sum(-1, dtype=np.float32)
+    s2 = mid.astype(np.float32) + gs
+    nmid = np.minimum(s2, np.float32(MID_MAX))
+    g2 = (s2 - nmid).reshape(
+        d, -1, spec.top_group // spec.mid_group).sum(-1, dtype=np.float32)
+    # top accumulates in u32 INTEGER arithmetic (exact past 2^24 units,
+    # where f32 would round small spills away — an undercount)
+    inc = np.minimum(g2, np.float32(TOP_MAX)).astype(np.uint32)
+    room = (np.uint32(TOP_MAX) - top).astype(np.uint32)
+    return nmid.astype(np.uint16), top + np.minimum(inc, room)
+
+
+def twin_plane_add(plane, delta, spec, unit):
+    delta = np.maximum(delta.astype(np.float32), np.float32(0))
+    du = np.ceil(delta / np.float32(unit))  # always ceil, like the device
+    s = plane[0].astype(np.float32) + du
+    nbase = np.minimum(s, np.float32(BASE_MAX))
+    nmid, ntop = twin_spill(s - nbase, plane[1], plane[2], spec)
+    return (nbase.astype(np.uint8), nmid, ntop)
+
+
+def twin_decode(plane, spec, unit):
+    base, mid, top = (np.asarray(x) for x in plane)
+    d = base.shape[0]
+    rep = spec.top_group // spec.mid_group
+    mid_tot = mid.astype(np.float32) + np.where(
+        mid == MID_MAX,
+        np.repeat(top.astype(np.float32), rep, axis=-1), np.float32(0))
+    per_col = np.repeat(mid_tot, spec.mid_group, axis=-1).reshape(d, -1)
+    units = base.astype(np.float32) + np.where(
+        base == BASE_MAX, per_col, np.float32(0))
+    return units * np.float32(unit) if unit > 1 else units
+
+
+def twin_init(d, w, spec):
+    return (np.zeros((d, w), np.uint8),
+            np.zeros((d, w // spec.mid_group), np.uint16),
+            np.zeros((d, w // spec.top_group), np.uint32))
+
+
+def fuzz_deltas(fold, d, w, unit):
+    """Deterministic boundary-biased integer byte masses — modular
+    arithmetic, no RNG, so the schedule (and hence the goldens) reproduces
+    on every numpy version and byte order. Most cells tiny, ~10% straddle
+    base saturation, ~2% are mid-tier sized; per-fold group sums stay well
+    under 2^24 units (the f32-exact regime)."""
+    i = np.arange(d * w, dtype=np.int64).reshape(d, w)
+    delta = ((i * 7 + fold * 13) % 40).astype(np.float32)
+    hot = (i + fold) % 10 == 0
+    delta = delta + hot * (200 + (i * 11) % 97).astype(np.float32)
+    heavy = (i * 3 + fold * 5) % 50 == 0
+    delta = delta + heavy * (30_000 + 64 * ((i * 29) % 700)).astype(
+        np.float32)
+    return delta * np.float32(unit)
+
+
+def run_schedule(spec, unit, d=2, w=256, folds=6):
+    plane = twin_init(d, w, spec)
+    for fold in range(folds):
+        plane = twin_plane_add(plane, fuzz_deltas(fold, d, w, unit),
+                               spec, unit)
+    return plane
+
+
+def digest(plane, dec):
+    """sha256 over ENDIAN-NORMALIZED tier-array + decode bytes: '<u2'/
+    '<u4'/'<f4' force little-endian layout regardless of host order, so
+    the same counts hash identically on s390x."""
+    h = hashlib.sha256()
+    base, mid, top = plane
+    h.update(np.ascontiguousarray(base).astype("u1").tobytes())
+    h.update(np.ascontiguousarray(mid).astype("<u2").tobytes())
+    h.update(np.ascontiguousarray(top).astype("<u4").tobytes())
+    h.update(np.ascontiguousarray(dec).astype("<f4").tobytes())
+    return h.hexdigest()
+
+
+#: (spec, unit) -> pinned digest of the 6-fold fuzz schedule's final tier
+#: arrays + decode. Regenerate ONLY with a deliberate tier-spec semantics
+#: change (and change sketch/tiered.py with it — the all-or-none rule).
+GOLDEN = {
+    (TwinSpec(4, 16, 1), 1):
+        "66bae2edfef435faa4294750a546ded3bdf0f657fe958c547951408d40a27e16",
+    (TwinSpec(8, 64, 64), 64):
+        "51b1678ba783ad28c4f02ac56e5aeb714ad15a5b2eb027fa81236f5e7050a98f",
+}
+
+
+def test_twin_fuzz_golden_digest():
+    for (spec, unit), want in GOLDEN.items():
+        plane = run_schedule(spec, unit)
+        got = digest(plane, twin_decode(plane, spec, unit))
+        assert got == want, (
+            f"tier-spec twin drifted for {spec} unit={unit}: {got}")
+
+
+def test_twin_fuzz_covers_every_tier_boundary():
+    """The golden is only load-bearing if the schedule actually promotes:
+    base-saturated, mid-saturated AND top-active cells must all exist."""
+    for (spec, unit) in GOLDEN:
+        base, mid, top = run_schedule(spec, unit)
+        assert (base == BASE_MAX).sum() > 0, (spec, unit, "base")
+        assert (mid == MID_MAX).sum() > 0, (spec, unit, "mid")
+        assert (top > 0).sum() > 0, (spec, unit, "top")
+
+
+def test_twin_sole_overflower_is_lossless():
+    """decode == exact running total across EVERY tier boundary while a
+    group has a single promoted member (the lossless-promotion contract,
+    twin-side so the qemu tier executes it too)."""
+    spec = TwinSpec(4, 16, 1)
+    plane = twin_init(1, 32, spec)
+    col, total = 5, np.float32(0)
+    for step in (254.0, 1.0, 1.0, 250.0, 65_300.0, 1000.0):
+        delta = np.zeros((1, 32), np.float32)
+        delta[0, col] = step
+        plane = twin_plane_add(plane, delta, spec, 1)
+        total = total + np.float32(step)
+        assert float(twin_decode(plane, spec, 1)[0, col]) == total
+    # top-tier sat-add: clamps, and STAYS clamped (never wraps)
+    delta = np.zeros((1, 32), np.float32)
+    delta[0, col] = 2.0**31
+    want = np.float32(BASE_MAX) + np.float32(MID_MAX) + np.float32(TOP_MAX)
+    for _ in range(2):
+        plane = twin_plane_add(plane, delta, spec, 1)
+        assert float(twin_decode(plane, spec, 1)[0, col]) == want
+
+
+def test_twin_top_tier_integer_exact_past_f32():
+    """100 consecutive +1-unit spills onto a top cell parked past 2^24
+    all land (u32 integer sat-add — f32 would round every one away)."""
+    spec = TwinSpec(4, 16, 1)
+    plane = twin_init(1, 32, spec)
+    big = np.zeros((1, 32), np.float32)
+    big[0, 5] = float(1 << 25)
+    plane = twin_plane_add(plane, big, spec, 1)
+    before = int(plane[2][0, 0])
+    assert before > (1 << 24)
+    one = np.zeros((1, 32), np.float32)
+    one[0, 5] = 1.0
+    for _ in range(100):
+        plane = twin_plane_add(plane, one, spec, 1)
+    assert int(plane[2][0, 0]) == before + 100
